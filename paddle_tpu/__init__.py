@@ -1,0 +1,126 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA/Pallas re-design with the full capability surface of the
+reference framework (PaddlePaddle 2.3, Graphcore-IPU fork): eager + static graph,
+hybrid-parallel distributed training (dp / mp / pp / sharding / moe / sp), AMP,
+high-level Model API, and an inference path — all lowering to single XLA
+computations per step (the whole-graph compile model the reference uses for IPU,
+reference: paddle/fluid/platform/device/ipu/).
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import jax as _jax
+
+# paddle dtype parity: int64 default for ints, float64 representable
+_jax.config.update("jax_enable_x64", True)
+
+# ---- core
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Generator,
+    Place,
+    TPUPlace,
+    Tensor,
+    device_count,
+    get_default_dtype,
+    get_device,
+    no_grad,
+    enable_grad,
+    seed,
+    set_default_dtype,
+    set_device,
+    to_tensor,
+)
+from .core.tape import is_grad_enabled  # noqa: F401
+
+# ---- functional op surface (paddle.* functions)
+from .tensor_ops import *  # noqa: F401,F403
+from .tensor_ops import methods as _methods
+
+_methods.install()
+
+from .tensor_ops import creation as _creation  # noqa: E402
+from .tensor_ops import math as _math  # noqa: E402
+
+# modules (populated lazily below to avoid import cycles)
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from . import text  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+
+from .framework.io import load, save  # noqa: E402,F401
+from .framework import grad, in_dynamic_mode, LazyGuard  # noqa: E402,F401
+from .hapi import Model, summary  # noqa: E402,F401
+from .nn.layer import ParamAttr  # noqa: E402,F401
+from .batch import batch  # noqa: E402,F401
+
+# paddle.disable_static/enable_static
+from .static.mode import disable_static, enable_static, in_static_mode  # noqa: E402,F401
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def is_grad_enabled_():  # pragma: no cover - alias
+    return is_grad_enabled()
+
+
+def set_grad_enabled(flag: bool):
+    from .core import tape
+
+    class _Ctx:
+        def __init__(self):
+            self._prev = tape.is_grad_enabled()
+            tape._set_grad_enabled(flag)
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            tape._set_grad_enabled(self._prev)
+
+    return _Ctx()
+
+
+def get_flags(flags=None):
+    from .utils import flags as _flags
+
+    return _flags.get_flags(flags)
+
+
+def set_flags(flags):
+    from .utils import flags as _flags
+
+    return _flags.set_flags(flags)

@@ -1,0 +1,98 @@
+"""Automatic mixed precision.
+
+Reference analog: python/paddle/amp/ (auto_cast.py, grad_scaler.py) + C++ cast
+hooks in imperative/amp_auto_cast.cc. TPU-native: bf16 is the default low dtype
+(MXU-native, no loss scaling needed); fp16+GradScaler supported for parity.
+auto_cast installs a dtype-policy on the op dispatch layer: matmul/conv run in
+low precision (O1 white-list semantics), reductions/norms stay fp32.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .grad_scaler import GradScaler  # noqa: F401
+
+_tls = threading.local()
+
+# O1 lists mirror the reference's amp lists (imperative/amp_auto_cast.cc white/black)
+WHITE_OPS = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "bmm", "mm", "einsum",
+             "scaled_dot_product_attention"}
+BLACK_OPS = {"reduce_sum", "softmax_with_cross_entropy", "cross_entropy", "layer_norm",
+             "batch_norm", "norm", "mse_loss", "log_softmax"}
+
+
+def amp_state():
+    return getattr(_tls, "amp", None)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = amp_state()
+    if enable:
+        white = set(WHITE_OPS)
+        black = set(BLACK_OPS)
+        if custom_white_list:
+            white |= set(custom_white_list)
+        if custom_black_list:
+            black |= set(custom_black_list)
+        _tls.amp = {"level": level, "dtype": dtype, "white": white, "black": black}
+    else:
+        _tls.amp = None
+    try:
+        yield
+    finally:
+        _tls.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(op_name, arrays):
+    """Called from the dispatch layer: cast op inputs per the active policy."""
+    st = amp_state()
+    if st is None:
+        return arrays
+    from ..core.dtype import to_jax_dtype
+
+    low = to_jax_dtype(st["dtype"])
+    if st["level"] == "O2":
+        if op_name in st["black"]:
+            return [a.astype(jnp.float32) if _is_low(a) else a for a in arrays]
+        return [a.astype(low) if _is_float(a) else a for a in arrays]
+    if op_name in st["white"]:
+        return [a.astype(low) if _is_float(a) else a for a in arrays]
+    if op_name in st["black"]:
+        return [a.astype(jnp.float32) if _is_low(a) else a for a in arrays]
+    return arrays
+
+
+def _is_float(a):
+    return hasattr(a, "dtype") and a.dtype in (jnp.float32, jnp.float16, jnp.bfloat16)
+
+
+def _is_low(a):
+    return hasattr(a, "dtype") and a.dtype in (jnp.float16, jnp.bfloat16)
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to low precision; optimizer keeps fp32 master weights
+    (reference: paddle.amp.decorate)."""
+    if level == "O2":
+        single = not isinstance(models, (list, tuple))
+        for m in [models] if single else models:
+            m.to(dtype=dtype)
+            m._casted_dtype = dtype
+        if optimizers is not None:
+            opts = [optimizers] if not isinstance(optimizers, (list, tuple)) else optimizers
+            for o in opts:
+                o._multi_precision = True
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,90 @@
+"""paddle.autograd (reference: python/paddle/autograd/) — backward, PyLayer."""
+from __future__ import annotations
+
+from ..core.tape import no_grad  # noqa: F401
+from ..core.tensor import Tensor
+from ..framework import grad  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.saved_tensor_list = []
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd op (reference: python/paddle/autograd/py_layer.py).
+
+    Subclass defines static forward(ctx, *args) and backward(ctx, *grads).
+    The tape node calls backward() for the cotangent instead of a jax vjp.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import tape as tape_mod
+
+        ctx = PyLayerContext()
+        with tape_mod.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        if not tape_mod.is_grad_enabled():
+            return out
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        out_tensors = [o for o in outs if isinstance(o, Tensor)]
+        in_tensors = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+        if not in_tensors:
+            return out
+
+        import jax
+
+        avals = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype) for o in out_tensors]
+
+        def vjp_fn(cts):
+            if not isinstance(cts, tuple):
+                cts = (cts,)
+            ct_tensors = [Tensor(c) for c in cts]
+            with tape_mod.no_grad():
+                gin = cls.backward(ctx, *ct_tensors)
+            gin = gin if isinstance(gin, (tuple, list)) else [gin]
+            # one input_struct (the flat in_tensors list) -> 1-tuple of ct lists
+            return (tuple(g._value if isinstance(g, Tensor) else g for g in gin),)
+
+        new_outs = []
+        for o in outs:
+            if isinstance(o, Tensor):
+                t = Tensor(o._value, stop_gradient=False)
+                new_outs.append(t)
+            else:
+                new_outs.append(o)
+        new_out_tensors = [t for t in new_outs if isinstance(t, Tensor)]
+        node = tape_mod.make_node(
+            vjp_fn, [in_tensors], new_out_tensors, avals,
+            is_tuple_out=len(new_out_tensors) > 1, name=cls.__name__,
+        )
+        for k, t in enumerate(new_out_tensors):
+            t._tape_node = node
+            t._out_index = k
+        if isinstance(out, (tuple, list)):
+            return tuple(new_outs)
+        return new_outs[0]

@@ -1,0 +1,16 @@
+"""paddle.batch parity (reference: python/paddle/batch.py) — reader decorator."""
+from __future__ import annotations
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for item in reader():
+            b.append(item)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader

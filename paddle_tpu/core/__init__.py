@@ -1,0 +1,14 @@
+from .dtype import convert_dtype, get_default_dtype, set_default_dtype, to_jax_dtype
+from .place import (
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    set_device,
+)
+from .rng import Generator, default_generator, get_rng_tracker, next_rng_key, seed, trace_rng_scope
+from .tape import enable_grad, is_grad_enabled, no_grad
+from .tensor import Tensor, to_tensor
+from .dispatch import primitive, primitive_call

@@ -1,0 +1,148 @@
+"""Eager op dispatch: pure-jax primitives -> Tensors with tape recording.
+
+Reference analog: the generated PHI C++ API + eager grad-node wiring
+(`/root/reference/paddle/phi/api/lib/`, `paddle/fluid/eager/auto_code_generator/`).
+Here one decorator replaces ~50k lines of codegen: any pure jax function becomes a
+framework op — forward runs through XLA, backward is its `jax.vjp` recorded on the
+tape (only when gradients are actually required).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import numpy as np
+
+from . import tape as tape_mod
+from .tensor import Tensor
+
+_GRAD_DTYPES = ("float16", "bfloat16", "float32", "float64", "complex64", "complex128")
+
+# (is_active(args) -> bool, record(fn, args, name) -> outputs); set by static mode
+_static_hook = None
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+def _unwrap(arg):
+    """Tensor -> jax array, recursively through lists/tuples/dicts."""
+    if isinstance(arg, Tensor):
+        return arg._value
+    if isinstance(arg, (list, tuple)):
+        return type(arg)(_unwrap(a) for a in arg)
+    if isinstance(arg, dict):
+        return {k: _unwrap(v) for k, v in arg.items()}
+    return arg
+
+
+def _collect_tensors(arg, out):
+    if isinstance(arg, Tensor):
+        out.append(arg)
+    elif isinstance(arg, (list, tuple)):
+        for a in arg:
+            _collect_tensors(a, out)
+    elif isinstance(arg, dict):
+        for v in arg.values():
+            _collect_tensors(v, out)
+
+
+def _requires_grad(t: Tensor) -> bool:
+    return (not t._stop_gradient) and str(t._value.dtype) in (
+        "float16",
+        "bfloat16",
+        "float32",
+        "float64",
+        "complex64",
+        "complex128",
+    )
+
+
+def primitive_call(fn, *args, name: str = "", **kwargs):
+    """Run `fn(*arrays, **kwargs)` eagerly, recording a tape node if needed.
+
+    `fn` must be a pure jax function of the positional array arguments; kwargs are
+    static. Positional args may be Tensors, nested lists/tuples of Tensors, arrays,
+    or python scalars.
+    """
+    if kwargs:
+        fn = functools.partial(fn, **kwargs)
+
+    # static-graph build mode: record an Operator on the default Program instead
+    # of executing (hook installed by paddle_tpu.static.program)
+    hook = _static_hook
+    if hook is not None and hook[0](args):
+        return hook[1](fn, args, name)
+
+    arrays = [_unwrap(a) for a in args]
+
+    # AMP dtype policy (O1/O2 auto_cast); no-op when autocast inactive
+    from ..amp import amp_state, maybe_cast_inputs
+
+    if amp_state() is not None:
+        arrays = maybe_cast_inputs(name, arrays)
+
+    diff_positions = []
+    if tape_mod.is_grad_enabled():
+        for i, a in enumerate(args):
+            ts: list[Tensor] = []
+            _collect_tensors(a, ts)
+            if any(_requires_grad(t) for t in ts):
+                diff_positions.append((i, ts))
+
+    if not diff_positions:
+        out = fn(*arrays)
+        return _wrap_outputs(out, None)
+
+    idxs = [i for i, _ in diff_positions]
+
+    def partial_fn(*diff_args):
+        full = list(arrays)
+        for i, d in zip(idxs, diff_args):
+            full[i] = d
+        return fn(*full)
+
+    out, vjp_fn = jax.vjp(partial_fn, *[arrays[i] for i in idxs])
+    is_tuple = isinstance(out, (tuple, list))
+    outs_list = list(out) if is_tuple else [out]
+    out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs_list]
+    out_tensors = [Tensor(o, stop_gradient=False) for o in outs_list]
+    node = tape_mod.make_node(
+        vjp_fn,
+        [ts for _, ts in diff_positions],
+        out_tensors,
+        out_avals,
+        is_tuple,
+        name=name,
+    )
+    for k, t in enumerate(out_tensors):
+        t._tape_node = node
+        t._out_index = k
+    if is_tuple:
+        return tuple(out_tensors)
+    return out_tensors[0]
+
+
+def _wrap_outputs(out, node):
+    if isinstance(out, (tuple, list)):
+        return tuple(Tensor(o, stop_gradient=True) for o in out)
+    return Tensor(out, stop_gradient=True)
+
+
+def primitive(fn=None, *, name: str = ""):
+    """Decorator form: turn a pure jax function into an eager framework op."""
+
+    def deco(f):
+        op_name = name or f.__name__
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return primitive_call(f, *args, name=op_name, **kwargs)
+
+        wrapper.raw = f  # the pure-jax version, used by the jit/static paths
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco

@@ -1,0 +1,81 @@
+"""Dtype taxonomy for the TPU-native framework.
+
+Mirrors the reference's VarType dtype enum (`/root/reference/paddle/fluid/framework/
+framework.proto:117`) but maps 1:1 onto XLA element types. bfloat16 is first-class
+(TPU MXU native); fp16 is supported for parity.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bfloat": "bfloat16",
+}
+
+_DEFAULT_DTYPE = "float32"
+
+
+def convert_dtype(dtype) -> str:
+    """Normalize any dtype spec (str, np.dtype, jnp dtype) to a canonical name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _NAME_TO_DTYPE:
+            return name
+        raise ValueError(f"Unknown dtype: {dtype!r}")
+    # jnp / np dtype objects
+    name = np.dtype(dtype).name if not hasattr(dtype, "name") else dtype.name
+    # np.dtype(jnp.bfloat16).name == 'bfloat16'
+    name = _ALIASES.get(name, name)
+    if name in _NAME_TO_DTYPE:
+        return name
+    raise ValueError(f"Unknown dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    if dtype is None:
+        return None
+    return _NAME_TO_DTYPE[convert_dtype(dtype)]
+
+
+def set_default_dtype(dtype):
+    global _DEFAULT_DTYPE
+    name = convert_dtype(dtype)
+    if name not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(f"set_default_dtype only accepts float dtypes, got {dtype}")
+    _DEFAULT_DTYPE = name
+
+
+def get_default_dtype() -> str:
+    return _DEFAULT_DTYPE
+
+
+def is_floating(dtype) -> bool:
+    return convert_dtype(dtype) in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer(dtype) -> bool:
+    return convert_dtype(dtype) in ("uint8", "int8", "int16", "int32", "int64")

@@ -1,0 +1,148 @@
+"""RNG state management, TPU-native.
+
+Reference analog: `phi::Generator` (`/root/reference/paddle/phi/core/generator.h`) and
+fleet's `RNGStatesTracker` (`python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/random.py:32`).
+
+Design: threefry counter-based keys instead of mutable Philox state.
+- Eager mode: a global stateful `Generator` that splits its key per draw.
+- Traced (jit) mode: purity demands no hidden state, so a `trace_rng_scope(base_key)`
+  installs a traced base key; draws fold in a monotonically increasing *Python int*
+  counter, which is static under trace. The train-step driver passes a fresh base key
+  each step, so compiled computations see a different stream every step with zero
+  recompilation.
+- `RNGStatesTracker` gives named parallel seeds (e.g. 'global_seed', 'local_seed')
+  for tensor-parallel dropout determinism, matching fleet semantics.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(np.asarray(state))
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+class _TraceRNG:
+    """Trace-mode RNG: fold static counters into a traced base key."""
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.counter = 0
+
+    def next_key(self):
+        self.counter += 1
+        return jax.random.fold_in(self.base_key, self.counter)
+
+
+_tls = threading.local()
+
+
+def _trace_rng() -> "_TraceRNG | None":
+    return getattr(_tls, "trace_rng", None)
+
+
+@contextlib.contextmanager
+def trace_rng_scope(base_key):
+    """Install a traced base key for the duration of a traced function body."""
+    prev = _trace_rng()
+    _tls.trace_rng = _TraceRNG(base_key)
+    try:
+        yield
+    finally:
+        _tls.trace_rng = prev
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int):
+    """paddle.seed: reseed the global generator (and the named trackers)."""
+    _default_generator.manual_seed(s)
+    get_rng_tracker().reset(s)
+    return _default_generator
+
+
+def next_rng_key():
+    """The single entry point ops use to draw randomness (dropout, init, ...)."""
+    tr = _trace_rng()
+    if tr is not None:
+        return tr.next_key()
+    return _default_generator.next_key()
+
+
+class RNGStatesTracker:
+    """Named RNG streams for tensor parallelism (fleet RNGStatesTracker parity).
+
+    'global' streams are identical across model-parallel ranks (e.g. for dropout on
+    replicated activations); 'local' streams differ per rank (dropout on sharded
+    activations). On TPU this is a fold_in of the (name, offset) pair.
+    """
+
+    def __init__(self):
+        self._states: dict[str, Generator] = {}
+
+    def reset(self, base_seed: int | None = None):
+        if base_seed is None:
+            self._states.clear()
+        else:
+            for i, (name, gen) in enumerate(sorted(self._states.items())):
+                gen.manual_seed(base_seed + 1000 + i)
+
+    def add(self, name: str, seed: int):
+        if name in self._states:
+            raise ValueError(f"RNG state {name!r} already added")
+        self._states[name] = Generator(seed)
+
+    def states(self):
+        return dict(self._states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self._states:
+            raise ValueError(f"RNG state {name!r} not added; call add() first")
+        global _default_generator
+        prev = _default_generator
+        _default_generator = self._states[name]
+        try:
+            yield
+        finally:
+            _default_generator = prev
+
+
+_rng_tracker = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _rng_tracker

@@ -1,0 +1,155 @@
+"""Eager autograd tape.
+
+Reference analog: the eager autograd graph (`/root/reference/paddle/fluid/eager/
+grad_node_info.h:161`, `backward.cc`) — but TPU-native: instead of codegen'd
+per-op GradNodes calling hand-written grad kernels, every eager op records a
+`jax.vjp` closure. XLA differentiates; the tape only does graph bookkeeping.
+
+The hot training path does NOT run through the tape: `paddle_tpu.jit`/hapi trace
+the whole step with `jax.value_and_grad` into one compiled computation. The tape
+exists for imperative-mode parity (`y = layer(x); y.backward()`).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import numpy as np
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def _set_grad_enabled(flag: bool):
+    _tls.grad_enabled = flag
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = is_grad_enabled()
+    _set_grad_enabled(False)
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = is_grad_enabled()
+    _set_grad_enabled(True)
+    try:
+        yield
+    finally:
+        _set_grad_enabled(prev)
+
+
+class TapeNode:
+    """One recorded op: inputs (leaf or intermediate Tensors), a vjp closure, outputs."""
+
+    __slots__ = ("vjp_fn", "input_structs", "outputs", "out_avals", "name", "_is_tuple_out")
+
+    def __init__(self, vjp_fn, input_structs, outputs, out_avals, name="", is_tuple_out=True):
+        self.vjp_fn = vjp_fn
+        # list (one per differentiable arg) of flat lists of input Tensors
+        self.input_structs = input_structs
+        self.outputs = outputs  # list of output Tensors (strong refs are fine; graph is per-iteration)
+        self.out_avals = out_avals  # list of jax.ShapeDtypeStruct
+        self.name = name
+        self._is_tuple_out = is_tuple_out
+
+    def _outputs_tuple(self):
+        return self._is_tuple_out
+
+
+def _zero_cotangent(aval):
+    if np.issubdtype(aval.dtype, np.floating) or aval.dtype == jax.dtypes.bfloat16:
+        return jax.numpy.zeros(aval.shape, aval.dtype)
+    # integer/bool outputs take symbolic-zero (float0) cotangents
+    return np.zeros(aval.shape, dtype=jax.dtypes.float0)
+
+
+def backward(tensor, grad=None, retain_graph=False):
+    """Reverse-accumulate gradients from `tensor` into leaf .grad fields."""
+    from .tensor import Tensor  # circular-safe
+
+    root_node = tensor._tape_node
+    if root_node is None:
+        if tensor.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True and no grad graph"
+            )
+        # a leaf: gradient of itself is ones
+        seed = grad._value if isinstance(grad, Tensor) else grad
+        if seed is None:
+            seed = jax.numpy.ones(tensor._value.shape, tensor._value.dtype)
+        tensor._accumulate_grad(seed)
+        return
+
+    # topo order over nodes
+    order, seen = [], set()
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for struct in node.input_structs:
+            for t in struct:
+                if t._tape_node is not None:
+                    visit(t._tape_node)
+        order.append(node)
+
+    visit(root_node)
+
+    # cotangent accumulation keyed by tensor id
+    cts: dict[int, object] = {}
+    seed = grad._value if isinstance(grad, Tensor) else grad
+    if seed is None:
+        if tensor._value.size != 1:
+            raise RuntimeError("grad must be provided for non-scalar backward()")
+        seed = jax.numpy.ones(tensor._value.shape, tensor._value.dtype)
+    cts[id(tensor)] = seed
+
+    for node in reversed(order):
+        out_cts = []
+        any_ct = False
+        for out, aval in zip(node.outputs, node.out_avals):
+            ct = cts.pop(id(out), None)
+            if ct is None:
+                ct = _zero_cotangent(aval)
+            else:
+                any_ct = True
+            out_cts.append(ct)
+        if not any_ct:
+            continue
+        if len(out_cts) == 1 and not node._outputs_tuple():
+            in_cts = node.vjp_fn(out_cts[0])
+        else:
+            in_cts = node.vjp_fn(tuple(out_cts))
+        for struct, ct_struct in zip(node.input_structs, in_cts):
+            flat_cts = jax.tree_util.tree_leaves(ct_struct)
+            for t, ct in zip(struct, flat_cts):
+                if isinstance(ct, np.ndarray) and ct.dtype == jax.dtypes.float0:
+                    continue
+                if t._tape_node is None:
+                    if not t.stop_gradient:
+                        t._accumulate_grad(ct)
+                else:
+                    prev = cts.get(id(t))
+                    cts[id(t)] = ct if prev is None else prev + ct
+                    if not t.stop_gradient and t._retain_grad:
+                        t._accumulate_grad(ct)
+        if not retain_graph:
+            node.vjp_fn = None
+
+    if not retain_graph:
+        for node in order:
+            node.outputs = ()
+
+
+def make_node(vjp_fn, input_structs, outputs, out_avals, is_tuple_out, name=""):
+    return TapeNode(vjp_fn, input_structs, outputs, out_avals, name, is_tuple_out)

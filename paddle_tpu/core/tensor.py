@@ -1,0 +1,271 @@
+"""The eager Tensor.
+
+Reference analog: `paddle::experimental::Tensor` (`/root/reference/paddle/phi/api/
+include/tensor.h:83`) + `phi::DenseTensor` (`paddle/phi/core/dense_tensor.h:37`).
+
+TPU-native design: a Tensor is a thin mutable handle over an immutable `jax.Array`
+(or a tracer, when executing under `paddle_tpu.jit` tracing). "In-place" mutation
+(optimizer updates, `set_value`) swaps the underlying array — which XLA turns into
+buffer donation on the jitted path. Autograd state lives on the handle
+(`stop_gradient`, `.grad`, tape node), exactly mirroring the eager-mode API of the
+reference without any C++ grad-kernel registry.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtype_mod
+from . import tape as tape_mod
+from .place import Place, _current_place
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "_stop_gradient",
+        "grad",
+        "_tape_node",
+        "_out_index",
+        "_retain_grad",
+        "name",
+        "_is_param",
+        "_sharding_spec",
+        "trainable",
+        "optimize_attr",
+        "regularizer",
+        "is_distributed",
+        "__weakref__",
+    )
+
+    def __init__(self, value, dtype=None, place=None, stop_gradient=True, name=None):
+        if isinstance(value, Tensor):
+            value = value._value
+        jdt = dtype_mod.to_jax_dtype(dtype)
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            was_ndarray = isinstance(value, np.ndarray)
+            arr = np.asarray(value)
+            if jdt is None and arr.dtype == np.float64 and not was_ndarray:
+                # python floats default to the framework float dtype (paddle parity)
+                jdt = dtype_mod.to_jax_dtype(dtype_mod.get_default_dtype())
+            value = jnp.asarray(arr, dtype=jdt)
+        elif jdt is not None and value.dtype != jdt:
+            value = value.astype(jdt)
+        self._value = value
+        self._stop_gradient = bool(stop_gradient)
+        self.grad = None
+        self._tape_node = None
+        self._out_index = 0
+        self._retain_grad = False
+        self.name = name
+        self._is_param = False
+        self._sharding_spec = None  # jax PartitionSpec for distributed training
+        self.trainable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self) -> str:
+        return dtype_mod.convert_dtype(self._value.dtype)
+
+    @property
+    def place(self) -> Place:
+        return _current_place()
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, flag: bool):
+        self._stop_gradient = bool(flag)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._tape_node is None
+
+    # ------------------------------------------------------------- conversion
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *idx):
+        a = self.numpy()
+        return a.item(*idx) if idx else a.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        from .dispatch import primitive_call
+
+        jdt = dtype_mod.to_jax_dtype(dtype)
+        return primitive_call(lambda x: x.astype(jdt), self, name="cast")
+
+    cast = astype
+
+    # ------------------------------------------------------------- autograd
+    def backward(self, grad_tensor=None, retain_graph=False):
+        tape_mod.backward(self, grad_tensor, retain_graph)
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def _accumulate_grad(self, ct):
+        if self.grad is None:
+            g = Tensor(ct, stop_gradient=True)
+            g.name = (self.name or "tensor") + "@GRAD"
+            self.grad = g
+        else:
+            self.grad._value = self.grad._value + ct
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def clone(self) -> "Tensor":
+        from .dispatch import primitive_call
+
+        return primitive_call(lambda x: x + 0, self, name="clone")
+
+    # ------------------------------------------------------------- mutation
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        new = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(new.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {new.shape} vs {self._value.shape}"
+            )
+        self._value = new
+
+    def copy_(self, other, *a):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self):
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def scale_(self, scale):
+        self._value = self._value * scale
+        return self
+
+    # ------------------------------------------------------------- misc
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        grad_s = "" if self._stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_s},\n"
+            f"       {np.array2string(self.numpy(), prefix='       ')})"
+        )
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of a multi-element Tensor is ambiguous")
+        return bool(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def cpu(self):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            try:
+                return self.astype(a)
+            except Exception:
+                continue
+        return self
+
+    def value(self):
+        return self
+
+    def get_tensor(self):
+        return self
+
+    def _md5sum(self):
+        import hashlib
+
+        return hashlib.md5(self.numpy().tobytes()).hexdigest()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """paddle.to_tensor parity (reference: python/paddle/tensor/creation.py)."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+# -- pytree registration: lets Tensors flow through jax.tree_util / jit boundaries
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t._stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor.__new__(Tensor)
+    t._value = children[0]
+    t._stop_gradient = aux[0]
+    t.grad = None
+    t._tape_node = None
+    t._out_index = 0
+    t._retain_grad = False
+    t.name = aux[1]
+    t._is_param = False
+    t._sharding_spec = None
+    t.trainable = True
+    t.optimize_attr = {"learning_rate": 1.0}
+    t.regularizer = None
+    t.is_distributed = False
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

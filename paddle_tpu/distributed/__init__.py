@@ -1,0 +1,49 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Layer map (survey §2.2 → TPU):
+- env/mesh bootstrap         ← init_parallel_env + TCPStore + ProcessGroup init
+- collective (functional)    ← collective.py c_* ops → XLA HLO collectives
+- topology                   ← fleet HybridCommunicateGroup (D9)
+- fleet                      ← Fleet façade + meta_parallel wrappers (D8, D13-D16)
+- sharding                   ← group_sharded ZeRO (D16)
+- launch                     ← paddle.distributed.launch CLI (D23)
+"""
+from . import env  # noqa: F401
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    global_mesh,
+    init_parallel_env,
+    is_initialized,
+    set_global_mesh,
+)
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    split as split_group,
+    wait,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .parallel import DataParallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .spawn import spawn  # noqa: F401
+
+
+def get_group(gid=0):
+    from .collective import _get_group
+
+    return _get_group(gid)

@@ -1,0 +1,299 @@
+"""Collective communication API.
+
+Reference analog: `python/paddle/distributed/collective.py` (all_reduce:621,
+new_group:344) over ProcessGroupNCCL (D2) / static `c_*` ops (D5).
+
+TPU-native model (survey §5.8): there are no per-process NCCL rings. A collective
+is an XLA HLO op over a named mesh axis, executed inside a compiled SPMD program:
+
+- **In-graph form** (`paddle_tpu.distributed.ops`): `c_allreduce_sum(x, 'mp')` etc.
+  call `jax.lax.psum/all_gather/psum_scatter/ppermute/all_to_all` — usable inside
+  `shard_map`. These are the lowerings of the reference's c_* op set.
+- **Eager form** (this module): mirrors the ProcessGroup API. The per-rank "local
+  tensor" convention is a global array with a leading `nranks` dim sharded over the
+  group's mesh axis (`scatter_ranks` builds one from per-rank values). Each call
+  jits a tiny shard_map program — cached by (op, shape, dtype, axis).
+
+`send`/`recv` (pipeline p2p) exist in-graph as `ppermute` shifts; the eager pair is
+emulated on host for API parity (tests) — real pipelining uses the in-graph form.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
+from . import env as env_mod
+from . import ops as cops
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communicator ≙ one named axis of a device mesh."""
+
+    def __init__(self, mesh: Mesh, axis: str, gid: int, ranks=None):
+        self.mesh = mesh
+        self.axis = axis
+        self.id = gid
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        self.nranks = sizes[axis] if axis in sizes else int(np.prod(mesh.devices.shape))
+        self.ranks = list(range(self.nranks)) if ranks is None else list(ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis!r}, nranks={self.nranks})"
+
+
+_groups: dict[int, Group] = {}
+_next_gid = [1]
+
+
+def _world_group() -> Group:
+    if 0 not in _groups:
+        mesh = env_mod.global_mesh()
+        # world group: all devices — flatten to one axis view
+        flat = Mesh(mesh.devices.reshape(-1), ("world",))
+        _groups[0] = Group(flat, "world", 0)
+    return _groups[0]
+
+
+def _get_group(group) -> Group:
+    if group is None or group == 0:
+        return _world_group()
+    if isinstance(group, Group):
+        return group
+    return _groups[int(group)]
+
+
+def new_group(ranks=None, backend=None, axis=None, mesh=None) -> Group:
+    """Create a communicator. TPU-native callers pass a mesh axis; rank-list calls
+    (reference API) get a sub-mesh built from the listed devices."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    if axis is not None:
+        g = Group(mesh or env_mod.global_mesh(), axis, gid, ranks)
+    else:
+        base = env_mod.global_mesh()
+        devs = base.devices.reshape(-1)
+        sel = devs if ranks is None else devs[list(ranks)]
+        g = Group(Mesh(sel, ("sub",)), "sub", gid, ranks)
+    _groups[gid] = g
+    return g
+
+
+def split(*args, **kwargs):  # reference has distributed.split for mp layers
+    raise NotImplementedError("use fleet.meta_parallel mp layers")
+
+
+# ------------------------------------------------------------------ helpers
+def scatter_ranks(values, group=None) -> Tensor:
+    """Stack per-rank numpy/Tensor values into the global [nranks, ...] layout
+    sharded over the group axis — the eager-collective input convention."""
+    g = _get_group(group)
+    arrs = [np.asarray(v.numpy() if isinstance(v, Tensor) else v) for v in values]
+    stacked = np.stack(arrs)
+    sharding = NamedSharding(g.mesh, P(g.axis))
+    return Tensor(jax.device_put(jnp.asarray(stacked), sharding))
+
+
+def rank_slices(t: Tensor, group=None):
+    """Inverse of scatter_ranks: list of per-rank numpy values."""
+    arr = np.asarray(t._value)
+    return [arr[i] for i in range(arr.shape[0])]
+
+
+@functools.lru_cache(maxsize=256)
+def _jit_collective(op_name, axis, mesh_key, extra=None):
+    mesh = _mesh_from_key(mesh_key)
+    fns = {
+        "all_reduce_sum": lambda x: jax.lax.psum(x, axis),
+        "all_reduce_max": lambda x: jax.lax.pmax(x, axis),
+        "all_reduce_min": lambda x: jax.lax.pmin(x, axis),
+        "all_reduce_prod": lambda x: jnp.exp(jax.lax.psum(jnp.log(x), axis)),
+        "all_reduce_avg": lambda x: jax.lax.pmean(x, axis),
+    }
+    if op_name in fns:
+        f = fns[op_name]
+        return jax.jit(
+            jax.shard_map(
+                lambda x: f(x), mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )
+        )
+    if op_name == "all_gather":
+        def f(x):
+            return jax.lax.all_gather(x[0], axis)
+
+        return jax.jit(
+            jax.shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+        )
+    if op_name == "reduce_scatter":
+        def f(x):
+            # local [nranks, ...] rows; scatter-sum row i to rank i -> local [1, ...]
+            return jax.lax.psum_scatter(x, axis, scatter_dimension=1, tiled=False)[None]
+
+        return jax.jit(
+            jax.shard_map(
+                lambda x: f(x[0]), mesh=mesh, in_specs=P(axis), out_specs=P(axis)
+            )
+        )
+    if op_name == "broadcast":
+        src = extra
+
+        def f(x):
+            full = jax.lax.all_gather(x[0], axis)
+            return full[src][None]
+
+        return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+    if op_name == "alltoall":
+        def f(x):
+            # x local: [1, nranks, ...] -> exchange row j to rank j
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=0, tiled=False)
+
+        return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis)))
+    raise ValueError(op_name)
+
+
+_mesh_registry: dict[int, Mesh] = {}
+
+
+def _mesh_key(mesh: Mesh):
+    k = id(mesh)
+    _mesh_registry[k] = mesh
+    return k
+
+
+def _mesh_from_key(k):
+    return _mesh_registry[k]
+
+
+# ------------------------------------------------------------------ eager API
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _get_group(group)
+    name = {ReduceOp.SUM: "all_reduce_sum", ReduceOp.MAX: "all_reduce_max",
+            ReduceOp.MIN: "all_reduce_min", ReduceOp.PROD: "all_reduce_prod",
+            ReduceOp.AVG: "all_reduce_avg"}[op]
+    fn = _jit_collective(name, g.axis, _mesh_key(g.mesh))
+    tensor._value = fn(tensor._value)
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    g = _get_group(group)
+    fn = _jit_collective("all_gather", g.axis, _mesh_key(g.mesh))
+    out = fn(tensor._value)  # [nranks(sharded), nranks, ...] -> rows identical
+    gathered = np.asarray(out)[0]
+    if tensor_list is not None:
+        del tensor_list[:]
+        tensor_list.extend(Tensor(gathered[i]) for i in range(gathered.shape[0]))
+        return tensor_list
+    return Tensor(out)
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _get_group(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        src = scatter_ranks([np.stack([np.asarray(t.numpy()) for t in src])] * g.nranks, g)
+    fn = _jit_collective("reduce_scatter", g.axis, _mesh_key(g.mesh))
+    out = fn(src._value)
+    tensor._value = out
+    return tensor
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _get_group(group)
+    fn = _jit_collective("broadcast", g.axis, _mesh_key(g.mesh), extra=src)
+    tensor._value = fn(tensor._value)
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # all ranks compute the sum; only dst's row is meaningful (matches semantics)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _get_group(group)
+    if tensor_list is not None:
+        out = scatter_ranks(tensor_list, g)
+        tensor._value = out._value
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    g = _get_group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        # per-rank list-of-lists not representable eagerly; host emulation
+        mat = [np.asarray(t.numpy() if isinstance(t, Tensor) else t) for t in in_tensor_list]
+        stacked = np.stack(mat)  # [nranks, ...] destined rows
+        out = [Tensor(stacked[i]) for i in range(len(mat))]
+        if out_tensor_list is not None:
+            del out_tensor_list[:]
+            out_tensor_list.extend(out)
+            return out_tensor_list
+        return out
+    g = _get_group(group)
+    fn = _jit_collective("alltoall", g.axis, _mesh_key(g.mesh))
+    return Tensor(fn(in_tensor_list._value))
+
+
+all_to_all = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = _get_group(group)
+    _p2p_box.setdefault(g.id, {})[dst] = np.asarray(tensor._value)
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = _get_group(group)
+    box = _p2p_box.get(g.id, {})
+    # single-controller emulation: the value sent to "us" was stored by send()
+    for k in list(box):
+        tensor._value = jnp.asarray(box.pop(k))
+        return tensor
+    return tensor
+
+
+_p2p_box: dict[int, dict] = {}
+
+
+def barrier(group=None):
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor):
+        tensor._value.block_until_ready()
+
+
+def get_world_size(group=None):
+    return _get_group(group).nranks
+
+
+def get_rank(group=None):
+    return env_mod.get_rank()
+
+
+def destroy_process_group(group=None):
+    if group is None:
+        _groups.clear()
+    else:
+        _groups.pop(_get_group(group).id, None)

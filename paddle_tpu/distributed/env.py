@@ -1,0 +1,114 @@
+"""Distributed environment & mesh bootstrap.
+
+Reference analog: paddle.distributed.init_parallel_env (parallel.py:91) +
+TCPStore/ProcessGroupNCCL rendezvous (collective.py:241). TPU-native: rendezvous
+is the JAX coordination service (`jax.distributed.initialize`) across hosts; the
+device fabric is a `jax.sharding.Mesh` over ICI/DCN. A single-process run sees
+all local devices (8-dev CPU mesh in tests; real chips under TPU runtime).
+
+Environment variables honored (launch CLI sets them, reference
+launch/controllers/collective.py:85-99): PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_MASTER / MASTER_ADDR:MASTER_PORT.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+import jax
+
+_initialized = False
+_global_mesh = None
+
+
+def init_parallel_env(mesh_shape=None, mesh_axes=None):
+    """Bootstraps multi-host (if env says so) and builds the global 1-D 'dp' mesh
+    unless an explicit shape is given."""
+    global _initialized, _global_mesh
+    if _initialized:
+        return ParallelEnv()
+    n_hosts = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    host_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
+    if n_hosts > 1 and master:
+        jax.distributed.initialize(
+            coordinator_address=master, num_processes=n_hosts, process_id=host_id
+        )
+    if mesh_shape is None:
+        mesh_shape = (jax.device_count(),)
+        mesh_axes = ("dp",)
+    devs = np.asarray(jax.devices()).reshape(mesh_shape)
+    _global_mesh = jax.sharding.Mesh(devs, mesh_axes)
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def set_global_mesh(mesh):
+    global _global_mesh, _initialized
+    _global_mesh = mesh
+    _initialized = True
+
+
+def global_mesh():
+    if _global_mesh is None:
+        init_parallel_env()
+    return _global_mesh
+
+
+def get_rank(group=None) -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_world_size(group=None) -> int:
+    """Data-parallel world size: devices on the 'dp'/'data' axis if a mesh exists,
+    else total device count."""
+    if _global_mesh is not None:
+        sizes = dict(zip(_global_mesh.axis_names, _global_mesh.devices.shape))
+        for ax in ("dp", "data"):
+            if ax in sizes:
+                return sizes[ax]
+        return int(np.prod(_global_mesh.devices.shape))
+    try:
+        return jax.device_count()
+    except Exception:
+        return 1
+
+
+class ParallelEnv:
+    """reference: python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def local_rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:6170")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "127.0.0.1:6170").split(",")

@@ -1,0 +1,35 @@
+"""Fleet — the user-facing distributed training façade.
+
+Reference analog: `python/paddle/distributed/fleet/base/fleet_base.py:139`
+(init:206, distributed_model:937, _minimize_impl:1508). Same API shape; the
+implementation routes everything through ONE pjit'd hybrid train step instead of
+meta-optimizer program rewriting.
+"""
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    Fleet,
+    distributed_model,
+    distributed_optimizer,
+    fleet,
+    get_hybrid_communicate_group,
+    init,
+)
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    LayerDesc,
+    ParallelCrossEntropy,
+    PipelineLayer,
+    RowParallelLinear,
+    SharedLayerDesc,
+    VocabParallelEmbedding,
+    get_rng_state_tracker,
+)
+from .hybrid_train import HybridParallelModel, hybrid_train_step  # noqa: F401
+from .recompute import recompute  # noqa: F401
+
+# module-level convenience (paddle.distributed.fleet.init style)
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+barrier_worker = fleet.barrier_worker

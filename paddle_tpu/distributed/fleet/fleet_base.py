@@ -1,0 +1,160 @@
+"""Fleet façade (reference: fleet_base.py:139)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...optimizer.optimizer import Optimizer
+from .. import env as env_mod
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+from .distributed_strategy import DistributedStrategy
+from .hybrid_train import HybridParallelModel
+from .meta_parallel import PipelineLayer
+from .pipeline_parallel import PipelineParallel
+
+
+class Fleet:
+    def __init__(self):
+        self._is_initialized = False
+        self._hcg = None
+        self._user_defined_strategy = DistributedStrategy()
+        self._role = None
+
+    # ------------------------------------------------------------ init
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        hc = self._user_defined_strategy.hybrid_configs
+        degrees = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                   hc.get("sharding_degree", 1), hc.get("mp_degree", 1)]
+        names = ["data", "pipe", "sharding", "model"]
+        if hc.get("sep_degree", 1) > 1:
+            names.append("sep")
+            degrees.append(hc["sep_degree"])
+        import jax
+
+        if int(np.prod(degrees)) == 1:
+            # pure DP over all devices
+            degrees[0] = jax.device_count()
+        topo = CommunicateTopology(names, degrees)
+        self._hcg = HybridCommunicateGroup(topo)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return env_mod.get_rank() == 0
+
+    def worker_index(self):
+        return env_mod.get_rank()
+
+    def worker_num(self):
+        return max(1, env_mod.get_world_size())
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def barrier_worker(self):
+        from ..collective import barrier
+
+        barrier()
+
+    def stop_worker(self):
+        pass
+
+    # ------------------------------------------------------------ hcg
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def hcg(self):
+        return self._hcg
+
+    # ------------------------------------------------------------ model/opt
+    def distributed_model(self, model, loss_fn=None):
+        """reference fleet_base.py:937 — dispatch by parallel mode (:1042-1069)."""
+        assert self._is_initialized, "call fleet.init first"
+        if isinstance(model, PipelineLayer) or self._hcg.get_pipe_parallel_world_size() > 1:
+            assert isinstance(model, PipelineLayer), (
+                "pipeline parallel requires a PipelineLayer model"
+            )
+            return PipelineParallel(model, self._hcg, self._user_defined_strategy)
+        return HybridParallelModel(model, self._hcg, self._user_defined_strategy,
+                                   loss_fn=loss_fn)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return HybridParallelOptimizer(optimizer, self._hcg, self._user_defined_strategy)
+
+    def minimize(self, optimizer, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        optimizer.step()
+        optimizer.clear_grad()
+        return [], []
+
+    # ------------------------------------------------------------ io
+    def save_persistables(self, executor=None, dirname=None, main_program=None, mode=0):
+        pass
+
+    def save_inference_model(self, *a, **k):
+        pass
+
+    @property
+    def util(self):
+        return _UtilBase()
+
+
+class _UtilBase:
+    def all_reduce(self, input, mode="sum"):
+        return input
+
+    def barrier(self):
+        pass
+
+    def get_file_shard(self, files):
+        return files
+
+
+class HybridParallelOptimizer:
+    """reference: dygraph_optimizer/hybrid_parallel_optimizer.py:170 — wraps the
+    inner optimizer. Under GSPMD, dp grad allreduce / sharding reduce-scatter /
+    mp-aware global-norm clip all happen inside the compiled step, so this wrapper
+    only carries API (step/clear_grad/lr) and the inner reference."""
+
+    def __init__(self, optimizer: Optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner_opt"], name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+
+
+def get_hybrid_communicate_group():
+    return fleet._hcg

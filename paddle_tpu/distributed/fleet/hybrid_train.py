@@ -1,0 +1,320 @@
+"""The hybrid-parallel train step — ONE pjit'd XLA computation.
+
+Reference analog: the entire meta-optimizer stack (D11) + HybridParallelOptimizer
+(D19) + Reducer (D12). TPU-native collapse: dp/mp/sharding(ZeRO)/sequence axes are
+expressed as GSPMD shardings on params/opt-state/batch; XLA inserts and schedules
+every collective (grad reduce-scatter, param all-gather, mp allreduce) inside one
+compiled program. Pipeline runs above this via the 1F1B scheduler
+(pipeline_parallel.py).
+
+Sharding rules (survey §7 table):
+- batch dim        → P(('dp','sharding'))          [data parallel + ZeRO-DP]
+- mp layer weights → their `_sharding_spec` (P(None,'mp') / P('mp',None))
+- ZeRO stage1/2    → optimizer slots sharded over 'sharding' on the largest
+                     divisible dim; stage2 grads reduce-scattered by XLA.
+- ZeRO stage3      → params themselves sharded the same way.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core import rng as rng_mod
+from ...core import tape as tape_mod
+from ...core.tensor import Tensor
+
+_tls = threading.local()
+
+
+def active_mesh():
+    return getattr(_tls, "mesh", None)
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh):
+    prev = active_mesh()
+    _tls.mesh = mesh
+    try:
+        yield
+    finally:
+        _tls.mesh = prev
+
+
+def maybe_shard(t, last_dim_axis=None, spec=None):
+    """with_sharding_constraint when tracing under a mesh; no-op otherwise."""
+    mesh = active_mesh()
+    if mesh is None:
+        return t
+    if spec is None:
+        if last_dim_axis is not None and last_dim_axis not in mesh.axis_names:
+            return t
+        nd = t.ndim
+        spec = P(*([None] * (nd - 1) + [last_dim_axis]))
+    arr = t._value if isinstance(t, Tensor) else t
+    try:
+        out = jax.lax.with_sharding_constraint(arr, NamedSharding(mesh, spec))
+    except Exception:
+        return t
+    if isinstance(t, Tensor):
+        nt = Tensor(out, stop_gradient=t.stop_gradient)
+        nt._tape_node = t._tape_node
+        nt._out_index = t._out_index
+        return nt
+    return out
+
+
+def _axis_sizes(mesh: Mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def _zero_spec(shape, mesh, axis="sharding"):
+    """Shard the largest divisible dim over `axis`; replicated if none fits."""
+    sizes = _axis_sizes(mesh)
+    n = sizes.get(axis, 1)
+    if n <= 1 or not shape:
+        return P()
+    dims = sorted(range(len(shape)), key=lambda i: -shape[i])
+    for d in dims:
+        if shape[d] % n == 0 and shape[d] >= n:
+            spec = [None] * len(shape)
+            spec[d] = axis
+            return P(*spec)
+    return P()
+
+
+def _param_spec(p: Tensor, mesh, zero_stage: int):
+    if p._sharding_spec is not None:
+        # drop axes not present in this mesh
+        spec = tuple(
+            s if (s is None or s in mesh.axis_names) else None for s in p._sharding_spec
+        )
+        return P(*spec)
+    if zero_stage >= 3:
+        return _zero_spec(tuple(p.shape), mesh)
+    return P()
+
+
+def _slot_spec(slot_shape, pspec, mesh, zero_stage):
+    if any(s is not None for s in (pspec or ())):
+        # follow the param's own sharding
+        return P(*list(pspec)[: len(slot_shape)]) if len(pspec) == len(slot_shape) else P()
+    if zero_stage >= 1:
+        return _zero_spec(tuple(slot_shape), mesh)
+    return P()
+
+
+def _batch_spec(ndim, mesh):
+    sizes = _axis_sizes(mesh)
+    axes = tuple(a for a in ("dp", "sharding") if sizes.get(a, 1) > 1)
+    if not axes:
+        return P()
+    return P(axes if len(axes) > 1 else axes[0], *([None] * (ndim - 1)))
+
+
+def build_hybrid_step(model, optimizer, loss_fn, mesh: Mesh, zero_stage: int = 0,
+                      amp_level: str = "O0", recompute: bool = False,
+                      sequence_parallel: bool = False, donate: bool = True):
+    """Build (init_fn, step_fn) for the hybrid-parallel training step.
+
+    init_fn() -> state dict of device arrays laid out per the sharding rules.
+    step_fn(state, key, lr, inputs, labels) -> (loss, new_state); pjit-compiled,
+    param/opt buffers donated.
+    """
+    params, buffers = model.functional_state()
+    train_p = {k: v for k, v in params.items() if v is not None and not v.stop_gradient}
+    frozen_p = {k: v for k, v in params.items() if v is not None and v.stop_gradient}
+
+    p_specs = {k: _param_spec(v, mesh, zero_stage) for k, v in train_p.items()}
+    f_specs = {k: _param_spec(v, mesh, 0) for k, v in frozen_p.items()}
+    b_specs = {k: P() for k in buffers}
+
+    opt_state_template = optimizer.functional_init({k: v._value for k, v in train_p.items()})
+    slot_specs = {
+        "step": P(),
+        "slots": {
+            k: {s: _slot_spec(np.shape(a), p_specs[k], mesh, zero_stage)
+                for s, a in slots.items()}
+            for k, slots in opt_state_template["slots"].items()
+        },
+    }
+
+    def _sh(spec):
+        return NamedSharding(mesh, spec)
+
+    state_shardings = {
+        "p": {k: _sh(s) for k, s in p_specs.items()},
+        "frozen": {k: _sh(s) for k, s in f_specs.items()},
+        "b": {k: _sh(s) for k, s in b_specs.items()},
+        "opt": jax.tree_util.tree_map(
+            _sh, slot_specs, is_leaf=lambda x: isinstance(x, P)
+        ),
+    }
+
+    def init_fn():
+        state = {
+            "p": {k: jax.device_put(v._value, state_shardings["p"][k])
+                  for k, v in train_p.items()},
+            "frozen": {k: jax.device_put(v._value, state_shardings["frozen"][k])
+                       for k, v in frozen_p.items()},
+            "b": {k: jax.device_put(v._value, state_shardings["b"][k])
+                  for k, v in buffers.items() if v is not None},
+            "opt": jax.tree_util.tree_map(
+                lambda a, s: jax.device_put(a, s),
+                opt_state_template,
+                state_shardings["opt"],
+            ),
+        }
+        return state
+
+    def forward_loss(pvals, frozen, bvals, key, inputs, labels):
+        with tape_mod.no_grad(), rng_mod.trace_rng_scope(key), mesh_scope(mesh):
+            ctx = contextlib.nullcontext()
+            if amp_level in ("O1", "O2"):
+                from ...amp import auto_cast
+
+                ctx = auto_cast(True, level=amp_level, dtype="bfloat16")
+            with ctx:
+                all_p = {**pvals, **frozen}
+                ins = [Tensor(maybe_shard(x, spec=_batch_spec(np.ndim(x), mesh)))
+                       for x in inputs]
+                out, new_b = model.functional_call(all_p, bvals, *ins)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            lv = loss_fn(*(list(outs) + [Tensor(x) for x in labels]))
+            loss_val = lv._value if isinstance(lv, Tensor) else lv
+            if loss_val.ndim > 0:
+                loss_val = jnp.mean(loss_val)
+        return loss_val.astype(jnp.float32), new_b
+
+    grad_fn = jax.value_and_grad(forward_loss, argnums=0, has_aux=True)
+    if recompute:
+        pass  # recompute is applied inside the model via fleet.recompute()
+
+    def step(state, key, lr, inputs, labels):
+        (loss, new_b), grads = grad_fn(
+            state["p"], state["frozen"], state["b"], key, inputs, labels
+        )
+        new_p, new_opt = optimizer.functional_update(state["p"], grads, state["opt"], lr)
+        return loss, {"p": new_p, "frozen": state["frozen"], "b": new_b,
+                      "opt": new_opt}
+
+    in_batch = None  # data shardings resolved at call time by GSPMD from device_put
+    step_jit = jax.jit(
+        step,
+        in_shardings=(state_shardings, None, None, None, None),
+        out_shardings=(NamedSharding(mesh, P()), state_shardings),
+        donate_argnums=(0,) if donate else (),
+    )
+
+    def shard_batch(arrays):
+        out = []
+        for x in arrays:
+            arr = jnp.asarray(np.asarray(x)) if not isinstance(x, jax.Array) else x
+            out.append(jax.device_put(arr, NamedSharding(mesh, _batch_spec(arr.ndim, mesh))))
+        return tuple(out)
+
+    return init_fn, step_jit, shard_batch
+
+
+class HybridParallelModel:
+    """Wrapper returned by fleet.distributed_model for non-pipeline modes.
+
+    train_batch([inputs..., labels...], optimizer) runs the pjit'd hybrid step.
+    """
+
+    def __init__(self, model, hcg, strategy, optimizer=None, loss_fn=None):
+        self._model = model
+        self._hcg = hcg
+        self._strategy = strategy
+        self._optimizer = optimizer
+        self._loss_fn = loss_fn
+        self._built = None
+        self._state = None
+        self.training = True
+
+    def __call__(self, *a, **k):
+        return self._model(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_model"], name)
+
+    def _ensure(self, optimizer, loss_fn):
+        if self._built is None:
+            zero = getattr(self._model, "_zero_stage", 0)
+            if self._strategy.sharding:
+                zero = max(zero, int(self._strategy.sharding_configs.get("stage", 1)))
+            amp_level = "O0"
+            if self._strategy.amp:
+                amp_level = self._strategy.amp_configs.get("level", "O1")
+            init_fn, step_fn, shard_batch = build_hybrid_step(
+                self._model, optimizer, loss_fn, self._hcg.mesh, zero_stage=zero,
+                amp_level=amp_level,
+                sequence_parallel=self._strategy.sequence_parallel,
+            )
+            self._built = (step_fn, shard_batch)
+            self._state = init_fn()
+
+    def train_batch(self, data, optimizer=None, lr=None, loss_fn=None):
+        optimizer = optimizer or self._optimizer
+        loss_fn = loss_fn or self._loss_fn or _default_loss
+        inner = getattr(optimizer, "_inner_opt", optimizer)
+        self._ensure(inner, loss_fn)
+        step_fn, shard_batch = self._built
+        n_in = getattr(self._model, "_n_inputs", 1)
+        inputs = shard_batch([_arr(d) for d in data[:n_in]])
+        labels = shard_batch([_arr(d) for d in data[n_in:]])
+        key = rng_mod.next_rng_key()
+        lr_v = jnp.asarray(inner.get_lr() if lr is None else lr, jnp.float32)
+        loss, self._state = step_fn(self._state, key, lr_v, inputs, labels)
+        return Tensor(loss)
+
+    def sync_params_to_layer(self):
+        params, buffers = self._model.functional_state()
+        for k, v in self._state["p"].items():
+            if k in params:
+                params[k]._value = v
+        for k, v in self._state["b"].items():
+            if k in buffers and buffers[k] is not None:
+                buffers[k]._value = v
+
+    def state_dict(self, *a, **k):
+        self.sync_params_to_layer()
+        return self._model.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        r = self._model.set_state_dict(sd, *a, **k)
+        self._built = None
+        return r
+
+    def parameters(self, *a, **k):
+        return self._model.parameters(*a, **k)
+
+    def eval(self):
+        self.training = False
+        self._model.eval()
+        return self
+
+    def train(self):
+        self.training = True
+        self._model.train()
+        return self
+
+
+def _default_loss(out, label):
+    from ...nn import functional as F
+
+    return F.cross_entropy(out, label)
+
+
+def _arr(d):
+    if isinstance(d, Tensor):
+        return d._value
+    return np.asarray(d)
+
+
+def hybrid_train_step(model, optimizer, loss_fn, mesh, **kwargs):
+    return build_hybrid_step(model, optimizer, loss_fn, mesh, **kwargs)

@@ -1,0 +1,263 @@
+"""Meta-parallel layers: tensor parallel + pipeline partitioning.
+
+Reference analog: fleet/meta_parallel/parallel_layers/{mp_layers.py,pp_layers.py,
+random.py} (D13, D14).
+
+TPU-native tensor parallelism — TWO cooperating mechanisms:
+1. GSPMD specs: each parallel layer tags its weights with a PartitionSpec
+   (`Tensor._sharding_spec`). `fleet.distributed_model` collects them and the
+   hybrid train step pjit's with those in_shardings — XLA inserts the identity/
+   allreduce pairs that ColumnParallelLinear/RowParallelLinear hand-coded via
+   `_c_identity`/`_mp_allreduce` in the reference (mp_layers.py:151,226).
+2. Explicit in-graph ops (`paddle_tpu.distributed.ops`) for shard_map users.
+
+Outside a mesh context the layers behave as ordinary Linear/Embedding — one model
+definition serves single-chip and hybrid-parallel runs.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ... import nn
+from ...core.rng import get_rng_tracker as _core_tracker
+from ...core.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layer import Layer
+
+
+def get_rng_state_tracker():
+    """reference: parallel_layers/random.py:32 RNGStatesTracker."""
+    tr = _core_tracker()
+    if "global_seed" not in tr.states():
+        tr.add("global_seed", 2021)
+    if "local_seed" not in tr.states():
+        tr.add("local_seed", 1024)
+    return tr
+
+
+def model_parallel_random_seed(seed=2021):
+    tr = _core_tracker()
+    tr._states.clear()
+    tr.add("global_seed", seed)
+    tr.add("local_seed", seed + 1024)
+
+
+class VocabParallelEmbedding(Layer):
+    """reference: mp_layers.py:30 — table row-sharded over 'mp'."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None,
+                 name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal(),
+        )
+        self.weight._sharding_spec = P("mp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """reference: mp_layers.py:97 — weight [in, out] sharded on out over 'mp';
+    gather_output=True adds an all-gather (the `_c_concat` path)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal(),
+        )
+        self.weight._sharding_spec = P(None, "mp")
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), is_bias=True,
+                default_initializer=nn.initializer.Constant(0.0),
+            )
+            self.bias._sharding_spec = P("mp")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        from .hybrid_train import maybe_shard
+
+        # activation sharded on last dim over mp unless gathered
+        if not self.gather_output:
+            out = maybe_shard(out, last_dim_axis="mp")
+        return out
+
+
+class RowParallelLinear(Layer):
+    """reference: mp_layers.py:170 — weight [in, out] sharded on in over 'mp';
+    forward ends in the mp allreduce (XLA inserts it from the specs)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal(),
+        )
+        self.weight._sharding_spec = P("mp", None)
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter(
+                (out_features,), is_bias=True,
+                default_initializer=nn.initializer.Constant(0.0),
+            )
+
+    def forward(self, x):
+        from .hybrid_train import maybe_shard
+
+        if not self.input_is_parallel:
+            x = maybe_shard(x, last_dim_axis="mp")
+        out = F.linear(x, self.weight, self.bias)
+        out = maybe_shard(out, last_dim_axis=None)  # replicated (allreduce happened)
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """reference: mp_layers.py:249 — vocab-parallel softmax CE. With GSPMD the
+    plain cross_entropy over mp-sharded logits compiles to the same comm pattern;
+    the explicit shard_map kernel lives in distributed.ops."""
+
+    def __init__(self, mp_group=None, name=None):
+        super().__init__()
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none")
+
+
+class LayerDesc:
+    """reference: pp_layers.py:58"""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """reference: pp_layers.py:76 — ties weights across stages (e.g. embeddings)."""
+
+    def __init__(self, key, layer_func, forward_func=None, shared_weight_attr="weight",
+                 *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """reference: pp_layers.py:91 — uniform & param-weighted segmentation."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self):
+        n = len(self.descs)
+        if self.method == "uniform":
+            return self.uniform(n, self.num_parts)
+        if self.method.startswith("layer:"):
+            # segment by occurrences of a named layer class
+            name = self.method.split(":", 1)[1]
+            weights = [1 if re.search(name, str(getattr(d, "layer_func", d))) else 0
+                       for d in self.descs]
+            return self.by_weights(weights)
+        raise ValueError(self.method)
+
+    @staticmethod
+    def uniform(num_items, num_parts):
+        base = num_items // num_parts
+        rem = num_items % num_parts
+        result = [0]
+        for i in range(num_parts):
+            result.append(result[-1] + base + (1 if i < rem else 0))
+        return result
+
+    def by_weights(self, weights):
+        total = sum(weights)
+        per = total / self.num_parts
+        result = [0]
+        acc = 0
+        for i, w in enumerate(weights):
+            acc += w
+            if acc >= per * len(result) and len(result) < self.num_parts:
+                result.append(i + 1)
+        while len(result) < self.num_parts + 1:
+            result.append(len(weights))
+        result[-1] = len(weights)
+        return result
+
+
+class PipelineLayer(Layer):
+    """reference: pp_layers.py:159 — builds all stages (single-controller SPMD
+    owns every device, unlike the per-rank reference which builds only its own).
+    Stage boundaries + per-stage sublayers feed the 1F1B scheduler."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self.descs = list(layers)
+        if topology is not None:
+            self.num_stages = topology.get_dim("pipe")
+        else:
+            self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        bounds = SegmentLayers(self.descs, self.num_stages, seg_method).do_segment()
+        self.stage_bounds = bounds
+        self._shared = {}  # key -> built layer (tied weights)
+        self.stages = nn.LayerList()
+        self._stage_fwd_funcs = []
+        for s in range(self.num_stages):
+            seg = self.descs[bounds[s] : bounds[s + 1]]
+            built, fwds = [], []
+            for d in seg:
+                if isinstance(d, SharedLayerDesc):
+                    if d.layer_name not in self._shared:
+                        self._shared[d.layer_name] = d.build_layer()
+                    built.append(self._shared[d.layer_name])
+                    fwds.append(d.forward_func)
+                elif isinstance(d, LayerDesc):
+                    built.append(d.build_layer())
+                    fwds.append(None)
+                else:
+                    built.append(d)  # already a Layer
+                    fwds.append(None)
+            self.stages.append(nn.LayerList(built))
+            self._stage_fwd_funcs.append(fwds)
+
+    def stage_forward(self, stage_idx, x):
+        layers = self.stages[stage_idx]
+        fwds = self._stage_fwd_funcs[stage_idx]
+        for layer, fwd in zip(layers, fwds):
+            x = fwd(layer, x) if fwd is not None else layer(x)
+        return x
+
+    def forward(self, x):
+        for s in range(self.num_stages):
+            x = self.stage_forward(s, x)
+        return x
+
+    def get_stage_params(self, stage_idx):
+        out = []
+        for layer in self.stages[stage_idx]:
+            out.extend(layer.parameters())
+        return out

@@ -1,0 +1,50 @@
+"""Activation recompute (reference: fleet/utils/recompute.py:209 RecomputeFunction
+— PyLayer + RNG state preservation).
+
+TPU-native: `jax.checkpoint` (rematerialization) IS recompute, with RNG handled
+by the counter-based key design (the same fold_in counters replay identically in
+the rematerialized forward). Works inside jitted train steps; in eager mode it
+simply calls the function (the tape holds activations anyway).
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core import tape as tape_mod
+from ...core.tensor import Tensor
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True, **kwargs):
+    # Under trace (inside a jitted step) wrap in jax.checkpoint; detect by tracer
+    leaves = [a._value for a in args if isinstance(a, Tensor)]
+    tracing = any(isinstance(v, jax.core.Tracer) for v in leaves)
+    if not tracing:
+        return function(*args, **kwargs)
+
+    arrs = [a._value if isinstance(a, Tensor) else a for a in args]
+
+    @jax.checkpoint
+    def inner(*arrays):
+        ts = [Tensor(x) if not isinstance(x, Tensor) else x for x in arrays]
+        out = function(*ts, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    out = inner(*arrs)
+    if isinstance(out, tuple):
+        return tuple(Tensor(o) for o in out)
+    return Tensor(out)
+
+
+class RecomputeLayer:
+    """Wrap a Layer so its forward is rematerialized in compiled steps."""
+
+    def __init__(self, layer):
+        self._layer = layer
+
+    def __call__(self, *args, **kwargs):
+        return recompute(self._layer, *args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layer"], name)

@@ -1,0 +1,180 @@
+"""In-graph collective ops — the `c_*` op set lowered to XLA HLO.
+
+Reference analog: `paddle/fluid/operators/collective/` (~130 files, D5): each op
+there is a CUDA kernel enqueueing NCCL on a ring; here each is a one-line
+`jax.lax` collective over a named mesh axis, legal inside `shard_map` /
+`pjit`-partitioned code. `ring_id` ⇒ `axis_name` (survey App. C).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min", "c_allreduce_prod",
+    "c_allreduce_avg", "c_allgather", "c_reducescatter", "c_broadcast",
+    "c_identity", "c_concat", "c_split", "send_next", "recv_prev", "send_prev",
+    "recv_next", "c_alltoall", "global_scatter", "global_gather",
+    "c_softmax_with_cross_entropy", "c_embedding", "axis_index", "axis_size",
+]
+
+
+def axis_index(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return jax.lax.axis_size(axis)
+
+
+def c_allreduce_sum(x, axis: str):
+    return jax.lax.psum(x, axis)
+
+
+def c_allreduce_max(x, axis: str):
+    return jax.lax.pmax(x, axis)
+
+
+def c_allreduce_min(x, axis: str):
+    return jax.lax.pmin(x, axis)
+
+
+def c_allreduce_avg(x, axis: str):
+    return jax.lax.pmean(x, axis)
+
+
+def c_allreduce_prod(x, axis: str):
+    return jnp.exp(jax.lax.psum(jnp.log(jnp.abs(x)), axis)) * jnp.prod(
+        jnp.sign(x)
+    )  # sign handling for completeness
+
+
+def c_allgather(x, axis: str, concat_axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis, axis=concat_axis, tiled=tiled)
+
+
+def c_reducescatter(x, axis: str, scatter_axis: int = 0):
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def c_broadcast(x, axis: str, src: int = 0):
+    full = jax.lax.all_gather(x, axis, axis=0, tiled=False)
+    return full[src]
+
+
+def c_identity(x, axis: str):
+    """mp forward no-op whose backward is allreduce (ColumnParallel input);
+    under jax autodiff this is exactly psum-transpose-of-identity."""
+
+    @jax.custom_vjp
+    def ident(v):
+        return v
+
+    def fwd(v):
+        return v, None
+
+    def bwd(_, g):
+        return (jax.lax.psum(g, axis),)
+
+    ident.defvjp(fwd, bwd)
+    return ident(x)
+
+
+def mp_allreduce(x, axis: str):
+    """forward allreduce, backward identity (RowParallel output)."""
+
+    @jax.custom_vjp
+    def ar(v):
+        return jax.lax.psum(v, axis)
+
+    def fwd(v):
+        return jax.lax.psum(v, axis), None
+
+    def bwd(_, g):
+        return (g,)
+
+    ar.defvjp(fwd, bwd)
+    return ar(x)
+
+
+def c_concat(x, axis: str, concat_axis: int = -1):
+    return jax.lax.all_gather(x, axis, axis=concat_axis if concat_axis >= 0 else x.ndim - 1,
+                              tiled=True)
+
+
+def c_split(x, axis: str, split_axis: int = -1):
+    idx = jax.lax.axis_index(axis)
+    n = jax.lax.axis_size(axis)
+    sa = split_axis if split_axis >= 0 else x.ndim - 1
+    size = x.shape[sa] // n
+    return jax.lax.dynamic_slice_in_dim(x, idx * size, size, axis=sa)
+
+
+def c_alltoall(x, axis: str, split_axis=0, concat_axis=0):
+    return jax.lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis,
+                              tiled=True)
+
+
+# ---------------- pipeline p2p: ppermute ring shifts (send_v2/recv_v2 analog)
+def send_next(x, axis: str):
+    n = jax.lax.axis_size(axis)
+    return jax.lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def send_prev(x, axis: str):
+    n = jax.lax.axis_size(axis)
+    return jax.lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+recv_prev = send_next  # receiving from prev == prev sent forward
+recv_next = send_prev
+
+
+# ---------------- MoE dispatch (global_scatter/global_gather, D18)
+def global_scatter(x, axis: str):
+    """Tokens pre-bucketed per target expert rank on dim 0 → exchange.
+    x: [n_ranks, cap, d] local → returns [n_ranks, cap, d] where row j now holds
+    tokens sent TO us by rank j (reference: global_scatter_op.cu)."""
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+
+
+def global_gather(x, axis: str):
+    return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=False)
+
+
+# ---------------- fused mp ops (reference: c_softmax_with_cross_entropy_op.cu,
+#                  c_embedding_op.cu — vocab-parallel ops)
+def c_softmax_with_cross_entropy(logits, labels, axis: str):
+    """Vocab-parallel softmax CE: logits sharded on the class dim over `axis`."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    v_local = logits.shape[-1]
+    # global max for stability
+    m = jax.lax.pmax(jnp.max(logits, axis=-1, keepdims=True), axis)
+    e = jnp.exp(logits - m)
+    denom = jax.lax.psum(jnp.sum(e, axis=-1, keepdims=True), axis)
+    # local logit of the true class (0 when out of this shard's range)
+    lo = idx * v_local
+    local_lab = labels - lo
+    in_range = (local_lab >= 0) & (local_lab < v_local)
+    safe_lab = jnp.clip(local_lab, 0, v_local - 1)
+    true_logit = jnp.take_along_axis(logits, safe_lab[..., None], axis=-1)
+    true_logit = jnp.where(in_range[..., None], true_logit, 0.0)
+    true_logit = jax.lax.psum(true_logit, axis)
+    loss = jnp.log(denom) + m - true_logit
+    return loss.squeeze(-1)
+
+
+def c_embedding(ids, table, axis: str, vocab_start: int = None):
+    """Vocab-parallel embedding lookup: table row-sharded over `axis`
+    (reference: VocabParallelEmbedding mp_layers.py:30)."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    v_local = table.shape[0]
+    lo = idx * v_local if vocab_start is None else vocab_start
+    local = ids - lo
+    in_range = (local >= 0) & (local < v_local)
+    safe = jnp.clip(local, 0, v_local - 1)
+    emb = jnp.take(table, safe.astype(jnp.int32), axis=0)
+    emb = jnp.where(in_range[..., None], emb, 0.0)
+    return jax.lax.psum(emb, axis)

@@ -1,0 +1,39 @@
+"""DataParallel (reference: python/paddle/fluid/dygraph/parallel.py:413 + C++
+Reducer bucketed allreduce, imperative/reducer.cc).
+
+TPU-native: there is no gradient bucketing/reducer — the train step is ONE pjit'd
+program with the batch sharded over the 'dp' mesh axis; XLA emits a fused
+reduce-scatter/all-gather (or all-reduce) for the grads at optimal bucket sizes.
+The wrapper exists for API parity and to mark the model's data axis.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def _inner(self):
+        return self._layers

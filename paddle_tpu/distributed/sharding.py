@@ -1,0 +1,54 @@
+"""ZeRO sharding API (reference: python/paddle/distributed/sharding/group_sharded.py:40
++ fleet/meta_parallel/sharding/ D16).
+
+TPU-native: ZeRO stages are SHARDING SPECS, not runtime hooks:
+- stage 1: optimizer slots sharded over the 'sharding'/'dp' axis.
+- stage 2: + gradients reduce-scattered (XLA does this automatically when grad
+  out-shardings are sharded — it lowers psum→reduce-scatter).
+- stage 3: + parameters sharded; XLA inserts all-gathers before use.
+No MarkVarReady/bucket machinery survives — GSPMD owns the schedule.
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+
+
+class _ShardedModel(Layer):
+    def __init__(self, layer, level, group):
+        super().__init__()
+        self._layers = layer
+        self._level = level
+        self._group = group
+        layer._zero_stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+
+    def forward(self, *a, **k):
+        return self._layers(*a, **k)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)
+
+
+def group_sharded_parallel(model, optimizer, level="os_g", scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False):
+    """Mark model+optimizer for ZeRO execution. The stage is consumed by
+    fleet's HybridParallelModel when building the pjit step."""
+    assert level in ("os", "os_g", "p_g_os")
+    wrapped = _ShardedModel(model, level, group)
+    optimizer._zero_stage = wrapped._layers._zero_stage
+    if scaler is not None:
+        return wrapped, optimizer, scaler
+    return wrapped, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ..framework.io import save
+
+    inner = model._layers if isinstance(model, _ShardedModel) else model
+    save(inner.state_dict(), output + ".pdmodel")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

@@ -1,0 +1,21 @@
+"""paddle.distributed.spawn (reference: python/paddle/distributed/spawn.py:434).
+
+Single-controller JAX note: inside one host, parallelism is SPMD over the local
+mesh — no per-device process fork is needed (or possible: the TPU runtime owns
+all chips). spawn() therefore runs `func` once with the full local mesh when
+nprocs<=local devices; true multi-host spawning is the launch CLI's job.
+"""
+from __future__ import annotations
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    from . import env as env_mod
+
+    env_mod.init_parallel_env()
+    result = func(*args)
+
+    class _Ctx:
+        def join(self):
+            return result
+
+    return _Ctx() if not join else result

@@ -1,0 +1,164 @@
+"""Hybrid-parallel topology.
+
+Reference analog: `python/paddle/distributed/fleet/base/topology.py`
+(CommunicateTopology:52, HybridCommunicateGroup:133). TPU-native: the rank mesh
+IS a `jax.sharding.Mesh`; per-axis comm groups are the mesh axes themselves, so
+`_set_p2p_group`-style endpoint plumbing disappears — `ppermute` on the 'pipe'
+axis is the p2p channel.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from . import collective as coll
+from . import env as env_mod
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*[range(d) for d in dims]))
+        self._rank_of = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coord = tuple(kwargs[n] for n in self._parallel_names)
+        return self._rank_of[coord]
+
+    def get_coord(self, rank):
+        return dict(zip(self._parallel_names, self.coordinate[rank]))
+
+    def get_axis_list(self, axis_name, index):
+        ai = self._parallel_names.index(axis_name)
+        return [r for r, c in enumerate(self.coordinate) if c[ai] == index]
+
+    def get_comm_list(self, axis_name):
+        """Groups of ranks that communicate along axis_name."""
+        ai = self._parallel_names.index(axis_name)
+        others = [i for i in range(len(self._dims)) if i != ai]
+        groups = {}
+        for r, c in enumerate(self.coordinate):
+            key = tuple(c[i] for i in others)
+            groups.setdefault(key, []).append(r)
+        return list(groups.values())
+
+
+class HybridCommunicateGroup:
+    """Builds the device mesh for dp×pp×sharding×mp (+sep) and exposes per-axis
+    groups. The single source of truth for distributed_model/optimizer."""
+
+    AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
+                "sep": "sep"}
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+        mesh_axes = tuple(self.AXIS_MAP.get(n, n) for n in names)
+        n_dev = jax.device_count()
+        need = int(np.prod(dims))
+        assert need <= n_dev, f"topology needs {need} devices, have {n_dev}"
+        devs = np.asarray(jax.devices()[:need]).reshape(dims)
+        self.mesh = Mesh(devs, mesh_axes)
+        env_mod.set_global_mesh(self.mesh)
+        self.global_rank = env_mod.get_rank()
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = topology.get_dim("sharding") if "sharding" in names else 1
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self._groups = {}
+        for name in names:
+            ax = self.AXIS_MAP.get(name, name)
+            self._groups[ax] = coll.new_group(axis=ax, mesh=self.mesh)
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._sharding_degree > 1:
+            return "sharding"
+        if self._mp_degree > 1:
+            return "model"
+        return "data"
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    # ranks (single-controller: coordinate of process; 0 for single host)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # groups
+    def get_data_parallel_group(self):
+        return self._groups.get("dp")
+
+    def get_model_parallel_group(self):
+        return self._groups.get("mp")
+
+    def get_pipe_parallel_group(self):
+        return self._groups.get("pp")
+
+    def get_sharding_parallel_group(self):
+        return self._groups.get("sharding")
+
+    def get_check_parallel_group(self):
+        return self._groups.get("mp")
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # axis names for in-graph collectives
+    def dp_axis(self):
+        return "dp"
+
+    def mp_axis(self):
+        return "mp"
+
+    def pp_axis(self):
+        return "pp"
+
+    def sharding_axis(self):
+        return "sharding"

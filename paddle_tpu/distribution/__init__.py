@@ -1,0 +1,166 @@
+"""paddle.distribution (reference: python/paddle/distribution/)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.rng import next_rng_key
+from ..core.tensor import Tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Multinomial", "kl_divergence"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x), jnp.float32)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        z = jax.random.normal(next_rng_key(), shp)
+        return Tensor(self.loc + self.scale * z)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale**2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale) * jnp.ones_like(self.loc))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+
+    def sample(self, shape=(), seed=0):
+        shp = tuple(shape) + jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        u = jax.random.uniform(next_rng_key(), shp)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _v(logits)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.categorical(next_rng_key(), self.logits, shape=tuple(shape) + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        lp = jax.nn.log_softmax(self.logits)
+        v = _v(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(lp, v[..., None], axis=-1).squeeze(-1))
+
+    def probs(self, value=None):
+        p = jax.nn.softmax(self.logits)
+        if value is None:
+            return Tensor(p)
+        v = _v(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, v[..., None], axis=-1).squeeze(-1))
+
+    def entropy(self):
+        p = jax.nn.softmax(self.logits)
+        lp = jax.nn.log_softmax(self.logits)
+        return Tensor(-jnp.sum(p * lp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _v(probs)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.bernoulli(next_rng_key(), self.probs, tuple(shape) + self.probs.shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(next_rng_key(), self.alpha, self.beta,
+                                      tuple(shape) + jnp.broadcast_shapes(self.alpha.shape, self.beta.shape)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        from jax.scipy.special import betaln
+
+        return Tensor((self.alpha - 1) * jnp.log(v) + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _v(concentration)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(next_rng_key(), self.concentration, tuple(shape)))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = total_count
+        self.probs = _v(probs)
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs, 1e-30))
+        draws = jax.random.categorical(
+            next_rng_key(), logits, shape=tuple(shape) + (self.total_count,) + logits.shape[:-1]
+        )
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=len(shape))
+        return Tensor(counts)
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        pp = jax.nn.softmax(p.logits)
+        return Tensor(jnp.sum(pp * (jax.nn.log_softmax(p.logits) - jax.nn.log_softmax(q.logits)), axis=-1))
+    raise NotImplementedError(f"kl_divergence({type(p)}, {type(q)})")

@@ -1,0 +1,67 @@
+"""paddle.framework parity: mode queries, functional grad, io."""
+from __future__ import annotations
+
+import contextlib
+
+from ..core.tensor import Tensor
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401
+
+
+def in_dynamic_mode() -> bool:
+    from ..static.mode import in_static_mode
+
+    return not in_static_mode()
+
+
+in_dygraph_mode = in_dynamic_mode
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad: functional gradients via the eager tape.
+
+    reference: python/paddle/fluid/dygraph/base.py grad().
+    """
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    # save/restore existing .grad, use tape backward to accumulate
+    saved = [t.grad for t in ins]
+    for t in ins:
+        t.grad = None
+        t._retain_grad = True
+    for i, o in enumerate(outs):
+        go = None
+        if grad_outputs is not None and i < len(grad_outputs):
+            go = grad_outputs[i]
+        o.backward(go, retain_graph=bool(retain_graph))
+    results = []
+    for t, prev in zip(ins, saved):
+        g = t.grad
+        if g is None and not allow_unused:
+            import jax.numpy as jnp
+
+            g = Tensor(jnp.zeros(t._value.shape, t._value.dtype))
+        results.append(g)
+        t.grad = prev
+    return results
+
+
+class LazyGuard:  # pragma: no cover - API stub for parity
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+@contextlib.contextmanager
+def set_grad_enabled(flag: bool):
+    from ..core import tape
+
+    prev = tape.is_grad_enabled()
+    tape._set_grad_enabled(flag)
+    try:
+        yield
+    finally:
+        tape._set_grad_enabled(prev)

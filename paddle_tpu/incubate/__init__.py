@@ -1,0 +1,4 @@
+"""paddle.incubate (reference: python/paddle/incubate/) — MoE, ASP sparsity."""
+from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
+from .distributed.models.moe import MoELayer  # noqa: F401

@@ -1,0 +1,49 @@
+"""ASP — 2:4 structured sparsity (reference: python/paddle/incubate/asp/,
+fleet asp_optimizer). Mask computation + optimizer decoration."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_masks: dict[int, np.ndarray] = {}
+
+
+def compute_mask_2_4(w: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest-|w| of every 4 along the last dim."""
+    orig = w.shape
+    flat = w.reshape(-1, 4) if w.size % 4 == 0 else None
+    if flat is None:
+        return np.ones_like(w, dtype=bool)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :2]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=1)
+    return mask.reshape(orig)
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    for p in model.parameters():
+        if p.ndim == 2 and p.size % 4 == 0:
+            w = p.numpy()
+            mask = compute_mask_2_4(w)
+            _masks[id(p)] = mask
+            p.set_value(w * mask)
+    return _masks
+
+
+def decorate(optimizer):
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p in optimizer._parameter_list or []:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p.set_value(p.numpy() * mask)
+
+    optimizer.step = step
+    return optimizer
+
+
+def reset_excluded_layers(model=None):
+    _masks.clear()

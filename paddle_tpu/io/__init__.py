@@ -1,0 +1,26 @@
+"""paddle.io — Dataset / DataLoader (reference: python/paddle/fluid/dataloader/).
+
+TPU-native dataloading: worker threads fill a blocking queue (C++ SPMC queue via
+paddle_tpu.runtime when built, Python queue fallback) and batches are converted to
+device arrays asynchronously so the accelerator never waits on host collation.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    RandomSplit,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

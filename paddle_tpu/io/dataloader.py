@@ -1,0 +1,160 @@
+"""DataLoader (reference: python/paddle/fluid/reader.py:273 +
+dataloader/dataloader_iter.py:147).
+
+Design: N worker threads (numpy collation releases the GIL for the heavy copies)
+feed a bounded blocking queue; the C++ SPMC queue from paddle_tpu.runtime backs it
+when available. Workers produce numpy batches; conversion to device Tensors
+happens in the consumer so jax stays single-threaded per device.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _pyqueue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return [default_collate_fn([b[i] for b in batch]) for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    return batch
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, (list, tuple)):
+        return [_to_tensor_tree(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    return obj
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.return_list = return_list
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("length of IterableDataset loader is unknown")
+        return len(self.batch_sampler)
+
+    def __call__(self):
+        return self.__iter__()
+
+    def __iter__(self):
+        if self._iterable_mode:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_threaded()
+
+    def _fetch(self, indices):
+        batch = [self.dataset[i] for i in indices]
+        return self.collate_fn(batch)
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield self._fetch(indices)
+
+    def _iter_iterable(self):
+        batch = []
+        for item in self.dataset:
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _iter_threaded(self):
+        from ..runtime import blocking_queue
+
+        cap = self.num_workers * self.prefetch_factor
+        out_q = blocking_queue.BlockingQueue(capacity=cap)
+        idx_q: _pyqueue.Queue = _pyqueue.Queue()
+        batches = list(self.batch_sampler)
+        n_batches = len(batches)
+        for i, b in enumerate(batches):
+            idx_q.put((i, b))
+        for _ in range(self.num_workers):
+            idx_q.put(None)
+
+        reorder: dict[int, object] = {}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def worker():
+            while not stop.is_set():
+                task = idx_q.get()
+                if task is None:
+                    break
+                i, indices = task
+                try:
+                    data = self._fetch(indices)
+                    out_q.put((i, data))
+                except Exception as e:  # propagate
+                    out_q.put((i, e))
+
+        threads = [threading.Thread(target=worker, daemon=True) for _ in range(self.num_workers)]
+        for t in threads:
+            t.start()
+
+        try:
+            next_idx = 0
+            received = 0
+            while next_idx < n_batches:
+                while next_idx in reorder:
+                    item = reorder.pop(next_idx)
+                    if isinstance(item, Exception):
+                        raise item
+                    yield item
+                    next_idx += 1
+                if next_idx >= n_batches:
+                    break
+                i, data = out_q.get()
+                received += 1
+                if i == next_idx:
+                    if isinstance(data, Exception):
+                        raise data
+                    yield data
+                    next_idx += 1
+                else:
+                    reorder[i] = data
+        finally:
+            stop.set()
+            out_q.close()

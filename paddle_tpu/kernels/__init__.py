@@ -1,0 +1,7 @@
+"""Hand-written TPU kernels (Pallas) + composite fallbacks.
+
+Reference analog: `paddle/fluid/operators/fused/` (fused_attention_op.cu,
+fused_feedforward_op.cu) and hand-rolled CUDA in phi/kernels/gpu — here the hot
+fused ops are Pallas TPU kernels; everything else trusts XLA fusion.
+"""
+from . import attention  # noqa: F401

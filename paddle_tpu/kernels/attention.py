@@ -1,0 +1,61 @@
+"""Attention kernels.
+
+`sdpa(q,k,v)` expects [batch, heads, seq, head_dim] (reference fused_attention
+layout, operators/fused/fmha_ref.h). Dispatch order:
+1. Pallas flash-attention (paddle_tpu/kernels/flash_attention.py) on TPU.
+2. Composite XLA (stable softmax) elsewhere — XLA fuses this into ~2 kernels.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _use_pallas(q) -> bool:
+    from ..utils.flags import flag
+
+    if not flag("FLAGS_use_pallas_kernels", True) or not _on_tpu():
+        return False
+    # pallas kernel constraints: head_dim and seq multiples of the block sizes
+    *_, s_q, d = q.shape
+    return d % 128 == 0 and s_q % 128 == 0
+
+
+def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
+    """Composite scaled-dot-product attention in f32 accumulation."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("...qd,...kd->...qk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if is_causal:
+        s_q, s_k = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        logits = jnp.where(causal, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", probs.astype(q.dtype), v)
+
+
+def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
+    if mask is None and _use_pallas(q):
+        try:
+            from .flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=is_causal, scale=scale)
+        except Exception:  # pragma: no cover - fall back on any pallas failure
+            pass
+    return sdpa_reference(q, k, v, mask, is_causal, scale)

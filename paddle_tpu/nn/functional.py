@@ -1,0 +1,951 @@
+"""nn.functional — neural-net ops lowered to XLA (reference: python/paddle/nn/functional/).
+
+Conv/pool map to lax.conv_general_dilated / reduce_window (MXU + fused by XLA);
+attention has a Pallas flash-attention fast path (paddle_tpu/kernels/) gated by
+FLAGS_use_pallas_kernels when running on real TPU.
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.rng import next_rng_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "relu", "relu6", "gelu", "sigmoid", "tanh", "softmax", "log_softmax",
+    "leaky_relu", "elu", "selu", "silu", "swish", "hardswish", "hardsigmoid",
+    "hardtanh", "mish", "softplus", "softsign", "tanhshrink", "softshrink",
+    "hardshrink", "prelu", "glu", "maxout",
+    "linear", "conv1d", "conv2d", "conv3d", "conv2d_transpose",
+    "max_pool1d", "max_pool2d", "avg_pool1d", "avg_pool2d",
+    "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "local_response_norm",
+    "embedding", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
+    "cosine_similarity", "normalize", "label_smooth", "one_hot", "pad",
+    "interpolate", "upsample", "pixel_shuffle", "unfold", "grid_sample",
+    "scaled_dot_product_attention", "sequence_mask", "temperature_scaled_softmax",
+    "rrelu", "celu", "logsigmoid", "gumbel_softmax", "square_error_cost",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+# ------------------------------------------------------------------ activations
+def relu(x, name=None):
+    return primitive_call(jax.nn.relu, _t(x), name="relu")
+
+
+def relu6(x, name=None):
+    return primitive_call(jax.nn.relu6, _t(x), name="relu6")
+
+
+def gelu(x, approximate=False, name=None):
+    return primitive_call(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x), name="gelu")
+
+
+def sigmoid(x, name=None):
+    return primitive_call(jax.nn.sigmoid, _t(x), name="sigmoid")
+
+
+def logsigmoid(x, name=None):
+    return primitive_call(jax.nn.log_sigmoid, _t(x), name="logsigmoid")
+
+
+def tanh(x, name=None):
+    return primitive_call(jnp.tanh, _t(x), name="tanh")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ..core.dtype import to_jax_dtype
+
+    def f(a):
+        if dtype is not None:
+            a = a.astype(to_jax_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+
+    return primitive_call(f, _t(x), name="softmax")
+
+
+def temperature_scaled_softmax(x, temperature=1.0, axis=-1, name=None):
+    return primitive_call(lambda a: jax.nn.softmax(a / temperature, axis=axis), _t(x))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return primitive_call(lambda a: jax.nn.log_softmax(a, axis=axis), _t(x), name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return primitive_call(lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return primitive_call(lambda a: jax.nn.elu(a, alpha), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return primitive_call(lambda a: jax.nn.celu(a, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return primitive_call(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x))
+
+
+def silu(x, name=None):
+    return primitive_call(jax.nn.silu, _t(x), name="silu")
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def hardswish(x, name=None):
+    return primitive_call(lambda a: a * jnp.clip(a + 3, 0, 6) / 6, _t(x))
+
+
+def hardsigmoid(x, slope=1 / 6, offset=0.5, name=None):
+    return primitive_call(lambda a: jnp.clip(a * slope + offset, 0, 1), _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return primitive_call(lambda a: jnp.clip(a, min, max), _t(x))
+
+
+def mish(x, name=None):
+    return primitive_call(lambda a: a * jnp.tanh(jax.nn.softplus(a)), _t(x))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return primitive_call(
+        lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta), _t(x)
+    )
+
+
+def softsign(x, name=None):
+    return primitive_call(jax.nn.soft_sign, _t(x))
+
+
+def tanhshrink(x, name=None):
+    return primitive_call(lambda a: a - jnp.tanh(a), _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return primitive_call(
+        lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)),
+        _t(x),
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return primitive_call(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format == "NCHW" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return primitive_call(f, _t(x), _t(weight), name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=True, name=None):
+    if training:
+        a = jax.random.uniform(next_rng_key(), (), float, lower, upper)
+    else:
+        a = (lower + upper) / 2
+    return primitive_call(lambda v: jnp.where(v >= 0, v, a * v), _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    return primitive_call(lambda a: jax.nn.glu(a, axis=axis), _t(x))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        shape = list(a.shape)
+        c = shape[axis]
+        new_shape = shape[:axis] + [groups, c // groups] + shape[axis + 1 :]
+        return jnp.max(a.reshape(new_shape), axis=axis)
+
+    return primitive_call(f, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    g = jax.random.gumbel(next_rng_key(), tuple(x.shape))
+
+    def f(a):
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard + jax.lax.stop_gradient(y) - y  # straight-through... (swap)
+            y = y_hard - jax.lax.stop_gradient(y_hard) + jax.nn.softmax((a + g) / temperature, axis=axis)
+        return y
+
+    return primitive_call(f, _t(x))
+
+
+# ------------------------------------------------------------------ linear/conv
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return primitive_call(lambda a, w: a @ w, _t(x), _t(weight), name="linear")
+    return primitive_call(lambda a, w, b: a @ w + b, _t(x), _t(weight), _t(bias), name="linear")
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, k, dilation, n):
+    """Return lax-style padding config for int / list / SAME / VALID."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad = _conv_padding(padding, None, dilation, 2)
+    dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
+
+    def f(a, w, *b):
+        if data_format == "NHWC":
+            w = jnp.transpose(w, (2, 3, 1, 0))
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=jnp.float32 if a.dtype == jnp.float32 else None,
+        )
+        if b:
+            bias_shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+            out = out + b[0].reshape(bias_shape)
+        return out.astype(a.dtype)
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return primitive_call(f, *args, name="conv2d")
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, None, dilation, 1)
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=("NCH", "OIH", "NCH"), feature_group_count=groups,
+        )
+        if b:
+            out = out + b[0].reshape(1, -1, 1)
+        return out.astype(a.dtype)
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return primitive_call(f, *args, name="conv1d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, None, dilation, 3)
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"), feature_group_count=groups,
+        )
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1, 1)
+        return out.astype(a.dtype)
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return primitive_call(f, *args, name="conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, output_size=None, data_format="NCHW", name=None):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    pad_cfg = padding
+
+    def f(a, w, *b):
+        # weight layout IOHW (paddle convention for transpose conv: [in, out/groups, H, W])
+        kh, kw = w.shape[2], w.shape[3]
+        if isinstance(pad_cfg, int):
+            pads = [(pad_cfg, pad_cfg), (pad_cfg, pad_cfg)]
+        elif isinstance(pad_cfg, str):
+            pads = pad_cfg.upper()
+        else:
+            pads = _conv_padding(pad_cfg, None, dilation, 2)
+        if isinstance(pads, list):
+            # lax.conv_transpose padding semantics: pad the *output*; convert
+            lax_pads = [
+                (dilation[i] * (k - 1) - p[0], dilation[i] * (k - 1) - p[1])
+                for i, (p, k) in enumerate(zip(pads, (kh, kw)))
+            ]
+        else:
+            lax_pads = pads
+        w_t = jnp.transpose(w, (1, 0, 2, 3))  # -> OIHW with O=out
+        w_t = jnp.flip(w_t, axis=(2, 3))
+        out = jax.lax.conv_general_dilated(
+            a, w_t, window_strides=(1, 1), padding=lax_pads, lhs_dilation=stride,
+            rhs_dilation=dilation, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups,
+        )
+        if b:
+            out = out + b[0].reshape(1, -1, 1, 1)
+        return out.astype(a.dtype)
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return primitive_call(f, *args, name="conv2d_transpose")
+
+
+# ------------------------------------------------------------------ pooling
+def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", avg=False,
+          ceil_mode=False, exclusive=True, nd=2):
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride if stride is not None else kernel, nd)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        p = _pair(padding, nd)
+        pad = [(0, 0), (0, 0)] + [(pi, pi) for pi in p]
+    spatial_off = 2 if data_format.startswith("NC") else 1
+    window = [1] * spatial_off + list(kernel) + ([1] if not data_format.startswith("NC") else [])
+    strides = [1] * spatial_off + list(stride) + ([1] if not data_format.startswith("NC") else [])
+    if data_format.startswith("NC"):
+        window = [1, 1] + list(kernel)
+        strides = [1, 1] + list(stride)
+    if isinstance(pad, list) and not data_format.startswith("NC"):
+        pad = [(0, 0)] + pad[2:] + [(0, 0)]
+
+    def f(a):
+        out = jax.lax.reduce_window(a, init, reducer, window, strides, pad)
+        if avg:
+            if isinstance(pad, str) or all(p == (0, 0) for p in pad) or not exclusive:
+                denom = float(np.prod(kernel))
+                if exclusive and not isinstance(pad, str):
+                    return out / denom
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad)
+                return out / counts
+            counts = jax.lax.reduce_window(
+                jnp.ones_like(a), 0.0, jax.lax.add, window, strides, pad
+            )
+            return out / counts
+        return out
+
+    return primitive_call(f, _t(x), name="pool")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, data_format,
+                 avg=True, exclusive=exclusive)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is not None else k
+    s = s if isinstance(s, int) else s[0]
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def f(a):
+        return jax.lax.reduce_window(
+            a, -jnp.inf, jax.lax.max, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)]
+        )
+
+    return primitive_call(f, _t(x))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = stride if stride is not None else k
+    s = s if isinstance(s, int) else s[0]
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def f(a):
+        out = jax.lax.reduce_window(
+            a, 0.0, jax.lax.add, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)]
+        )
+        return out / k
+
+    return primitive_call(f, _t(x))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size)
+
+    def f(a):
+        h, w = (a.shape[2], a.shape[3]) if data_format == "NCHW" else (a.shape[1], a.shape[2])
+        oh, ow = out_hw
+        kh, kw = h // oh, w // ow
+        window = (1, 1, kh, kw) if data_format == "NCHW" else (1, kh, kw, 1)
+        out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, window, "VALID")
+        return out / (kh * kw)
+
+    return primitive_call(f, _t(x), name="adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+
+    def f(a):
+        k = a.shape[2] // o
+        out = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, k), "VALID")
+        return out / k
+
+    return primitive_call(f, _t(x))
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _pair(output_size)
+
+    def f(a):
+        oh, ow = out_hw
+        kh, kw = a.shape[2] // oh, a.shape[3] // ow
+        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
+
+    return primitive_call(f, _t(x))
+
+
+# ------------------------------------------------------------------ norm
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def f(a, rm, rv, *wb):
+        reduce_axes = tuple(i for i in range(a.ndim) if i != (ch_axis % a.ndim))
+        if use_batch_stats:
+            mean = jnp.mean(a, axis=reduce_axes)
+            var = jnp.var(a, axis=reduce_axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * a.ndim
+        shape[ch_axis % a.ndim] = -1
+        out = (a - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        if wb:
+            w, b = wb
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out
+
+    args = [_t(x), _t(running_mean).detach(), _t(running_var).detach()]
+    if weight is not None:
+        args += [_t(weight), _t(bias)]
+    out = primitive_call(f, *args, name="batch_norm")
+
+    if use_batch_stats and isinstance(running_mean, Tensor):
+        # update running stats in-place (buffer semantics, excluded from autograd)
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        reduce_axes = tuple(i for i in range(xv.ndim) if i != (ch_axis % xv.ndim))
+        bm = jax.lax.stop_gradient(jnp.mean(xv, axis=reduce_axes))
+        bv = jax.lax.stop_gradient(jnp.var(xv, axis=reduce_axes))
+        n = float(np.prod([xv.shape[i] for i in reduce_axes])) if not isinstance(
+            xv, jax.core.Tracer
+        ) else None
+        unbiased = bv if n is None or n <= 1 else bv * n / (n - 1)
+        running_mean._value = running_mean._value * momentum + bm * (1 - momentum)
+        running_var._value = running_var._value * momentum + unbiased * (1 - momentum)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+
+    def f(a, *wb):
+        axes = tuple(range(a.ndim - nd, a.ndim))
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        if wb:
+            w, b = wb
+            out = out * w + b
+        return out.astype(a.dtype)
+
+    args = [_t(x)]
+    if weight is not None:
+        args += [_t(weight), _t(bias)]
+    return primitive_call(f, *args, name="layer_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        rest = a.shape[2:]
+        g = a.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        if wb:
+            w, b = wb
+            shape = [1, c] + [1] * len(rest)
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args += [_t(weight), _t(bias)]
+    return primitive_call(f, *args, name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    def f(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        if wb:
+            w, b = wb
+            shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out
+
+    args = [_t(x)]
+    if weight is not None:
+        args += [_t(weight), _t(bias)]
+    return primitive_call(f, *args, name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def f(a):
+        sq = a * a
+        half = size // 2
+        pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (a.ndim - 2)
+        sq_p = jnp.pad(sq, pad)
+        acc = sum(sq_p[:, i : i + a.shape[1]] for i in range(size))
+        return a / jnp.power(k + alpha * acc / size, beta)
+
+    return primitive_call(f, _t(x))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return primitive_call(
+        lambda a: a / jnp.maximum(
+            jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True), epsilon
+        ),
+        _t(x),
+    )
+
+
+# ------------------------------------------------------------------ embedding / dropout
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return primitive_call(f, _t(x).detach(), _t(weight), name="embedding")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0:
+        return _t(x)
+    key = next_rng_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return primitive_call(f, _t(x), name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0:
+        return _t(x)
+    key = next_rng_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return a_coef * jnp.where(keep, a, alpha_p) + b_coef
+
+    return primitive_call(f, _t(x))
+
+
+# ------------------------------------------------------------------ losses
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lab, *w):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30)
+        )
+        if soft_label:
+            loss = -jnp.sum(lab * lp, axis=axis)
+        else:
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == lp.ndim:
+                lab_i = jnp.squeeze(lab_i, axis=axis)
+            if label_smoothing > 0.0:
+                n = lp.shape[axis]
+                onehot = jax.nn.one_hot(lab_i, n, axis=axis, dtype=lp.dtype)
+                smooth = onehot * (1 - label_smoothing) + label_smoothing / n
+                loss = -jnp.sum(smooth * lp, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    lp, jnp.expand_dims(lab_i, axis), axis=axis
+                ).squeeze(axis)
+            if w:
+                wt = jnp.take(w[0], lab_i, axis=0)
+                loss = loss * wt
+            valid = lab_i != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid), 1)
+                if w:
+                    denom = jnp.maximum(jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label).detach()]
+    if weight is not None:
+        args.append(_t(weight))
+    return primitive_call(f, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               axis=-1, return_softmax=False, name=None):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis
+    )
+    loss = loss.unsqueeze(axis) if loss.ndim < _t(logits).ndim else loss
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return primitive_call(
+        lambda a, b: _reduce((a - b) ** 2, reduction), _t(input), _t(label), name="mse_loss"
+    )
+
+
+def square_error_cost(input, label):
+    return primitive_call(lambda a, b: (a - b) ** 2, _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return primitive_call(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), _t(input), _t(label)
+    )
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def f(lp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        loss = -jnp.take_along_axis(lp, lab_i[:, None], axis=1).squeeze(1)
+        if w:
+            wt = jnp.take(w[0], lab_i, axis=0)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(wt)
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label).detach()]
+    if weight is not None:
+        args.append(_t(weight))
+    return primitive_call(f, *args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *w):
+        loss = -(y * jnp.log(jnp.maximum(p, 1e-12)) + (1 - y) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    args = [_t(input), _t(label)]
+    if weight is not None:
+        args.append(_t(weight))
+    return primitive_call(f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, y, *rest):
+        # numerically stable: max(z,0) - z*y + log(1+exp(-|z|))
+        loss = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        i = 0
+        if pos_weight is not None:
+            pw = rest[i]
+            i += 1
+            log_w = (pw - 1) * y + 1
+            loss = loss * log_w
+        if weight is not None:
+            loss = loss * rest[i]
+        return _reduce(loss, reduction)
+
+    args = [_t(logit), _t(label)]
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    if weight is not None:
+        args.append(_t(weight))
+    return primitive_call(f, *args)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return primitive_call(f, _t(input), _t(label))
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(lp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return primitive_call(f, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return primitive_call(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        _t(input), _t(other), _t(label),
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return primitive_call(
+        lambda a, y: _reduce(jnp.where(y == 1, a, jnp.maximum(0.0, margin - a)), reduction),
+        _t(input), _t(label),
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    return primitive_call(
+        lambda a, b: jnp.sum(a * b, axis=axis)
+        / jnp.maximum(jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis), eps),
+        _t(x1), _t(x2),
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(y):
+        n = y.shape[-1]
+        return y * (1 - epsilon) + epsilon / n
+
+    return primitive_call(f, _t(label))
+
+
+def one_hot(x, num_classes, name=None):
+    return primitive_call(
+        lambda a: jax.nn.one_hot(a.astype(jnp.int32), num_classes), _t(x).detach()
+    )
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    from ..core.dtype import to_jax_dtype
+
+    ml = maxlen if maxlen is not None else int(np.asarray(_t(lengths)._value).max())
+    return primitive_call(
+        lambda l: (jnp.arange(ml)[None, :] < l[:, None]).astype(to_jax_dtype(dtype)),
+        _t(lengths).detach(),
+    )
+
+
+# ------------------------------------------------------------------ shape ops
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    def f(a):
+        p = list(pad)
+        if len(p) == 2 * a.ndim:
+            cfg = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle convention: pad applies to last len(p)//2 spatial dims (reversed pairs)
+            n = len(p) // 2
+            cfg = [(0, 0)] * (a.ndim - n)
+            # NCHW: [l, r, t, b] applies to (W, H) — pairs fill trailing dims from the end
+            pairs = [(p[2 * i], p[2 * i + 1]) for i in range(n)]
+            cfg += list(reversed(pairs)) if data_format.startswith("NC") else list(reversed(pairs))
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, cfg, mode="constant", constant_values=value)
+        return jnp.pad(a, cfg, mode=jmode)
+
+    return primitive_call(f, _t(x), name="pad")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    def f(a):
+        n, c = a.shape[0], a.shape[1]
+        ih, iw = a.shape[2], a.shape[3]
+        if size is not None:
+            oh, ow = _pair(size)
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
+            oh, ow = int(ih * sf[0]), int(iw * sf[1])
+        method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+                  "linear": "linear", "trilinear": "linear", "area": "linear"}[mode]
+        out = jax.image.resize(a, (n, c, oh, ow), method=method)
+        return out
+
+    return primitive_call(f, _t(x), name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+
+    return primitive_call(f, _t(x))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])])
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patches.append(
+                    a[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0],
+                      j * d[1] : j * d[1] + ow * s[1] : s[1]]
+                )
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return primitive_call(f, _t(x))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros", align_corners=True, name=None):
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = (g[..., 0] + 1) * (w - 1) / 2 if align_corners else ((g[..., 0] + 1) * w - 1) / 2
+        gy = (g[..., 1] + 1) * (h - 1) / 2 if align_corners else ((g[..., 1] + 1) * h - 1) / 2
+        x0 = jnp.floor(gx).astype(jnp.int32)
+        y0 = jnp.floor(gy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+
+        def sample(yy, xx):
+            valid = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yy = jnp.clip(yy, 0, h - 1)
+            xx = jnp.clip(xx, 0, w - 1)
+            v = a[jnp.arange(n)[:, None, None], :, yy, xx]  # n,oh,ow,c
+            return jnp.where(valid[..., None], v, 0.0)
+
+        wx = gx - x0
+        wy = gy - y0
+        out = (
+            sample(y0, x0) * ((1 - wx) * (1 - wy))[..., None]
+            + sample(y0, x1) * (wx * (1 - wy))[..., None]
+            + sample(y1, x0) * ((1 - wx) * wy)[..., None]
+            + sample(y1, x1) * (wx * wy)[..., None]
+        )
+        return jnp.transpose(out, (0, 3, 1, 2))
+
+    return primitive_call(f, _t(x), _t(grid))
+
+
+# ------------------------------------------------------------------ attention
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """Fused attention (reference: operators/fused/fused_attention_op.cu).
+
+    Uses the Pallas flash-attention kernel on TPU when enabled; composite XLA
+    otherwise (XLA fuses the softmax chain well on its own).
+    """
+    from ..kernels import attention as _attn
+
+    args = [_t(query), _t(key), _t(value)]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+
+    def f(q, k, v, *m):
+        return _attn.sdpa(q, k, v, m[0] if m else None, is_causal=is_causal)
+
+    out = primitive_call(f, *args, name="scaled_dot_product_attention")
+    if dropout_p > 0.0 and training:
+        out = dropout(out, dropout_p, training=training)
+    return out

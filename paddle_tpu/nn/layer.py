@@ -1,0 +1,361 @@
+"""Layer: the module base class.
+
+Reference analog: `python/paddle/fluid/dygraph/layers.py` (`Layer:84`) — parameter
+registry, sublayer tree, state_dict, hooks, train/eval. TPU-native additions:
+`functional_state()` / `functional_call()` which expose the layer as a pure
+function over a params pytree — the bridge to `jax.jit`/`jax.grad`/`pjit` whole-step
+compilation, and per-parameter sharding specs (PartitionSpec) for GSPMD.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterator
+
+import numpy as np
+
+from ..core.dtype import get_default_dtype
+from ..core.tensor import Tensor
+from ..utils.misc import unique_name
+from . import initializer as I
+
+
+class ParamAttr:
+    """reference: python/paddle/fluid/param_attr.py"""
+
+    def __init__(
+        self,
+        name=None,
+        initializer=None,
+        learning_rate=1.0,
+        regularizer=None,
+        trainable=True,
+        need_clip=True,
+    ):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if attr is False:
+            return False
+        raise TypeError(f"Invalid ParamAttr spec: {attr!r}")
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (reference: framework.Parameter)."""
+
+    def __init__(self, value, trainable=True, name=None):
+        super().__init__(value, stop_gradient=not trainable)
+        self._is_param = True
+        self.trainable = trainable
+        self.name = name or unique_name.generate("param")
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype=None):
+        self.training = True
+        self._dtype = dtype or get_default_dtype()
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._parameters: collections.OrderedDict[str, Parameter] = collections.OrderedDict()
+        self._sub_layers: collections.OrderedDict[str, Layer] = collections.OrderedDict()
+        self._buffers: collections.OrderedDict[str, Tensor] = collections.OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks = collections.OrderedDict()
+        self._forward_post_hooks = collections.OrderedDict()
+        self._casted_dtype = None  # set by amp O2 decorate
+
+    # ------------------------------------------------------------ parameters
+    def create_parameter(
+        self,
+        shape,
+        attr=None,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+    ):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        value = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(value, trainable=attr.trainable, name=attr.name)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = getattr(attr, "need_clip", True)
+        return p
+
+    def add_parameter(self, name: str, parameter: Parameter | None):
+        if parameter is None:
+            self._parameters[name] = None
+        else:
+            self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Tensor | None, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # ------------------------------------------------------------ attr magic
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter) and params is not None:
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer) and layers is not None:
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params and value is None:
+                params[name] = None
+            elif buffers is not None and name in buffers:
+                buffers[name] = value
+            else:
+                object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{self.__class__.__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # ------------------------------------------------------------ traversal
+    def parameters(self, include_sublayers=True) -> list:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True) -> Iterator:
+        seen = set()
+        for name, sub, pfx in self._walk(prefix, include_sublayers):
+            for pname, p in sub._parameters.items():
+                if p is not None and id(p) not in seen:
+                    seen.add(id(p))
+                    yield (f"{pfx}{pname}", p)
+
+    def _walk(self, prefix="", include_sublayers=True):
+        yield ("", self, prefix)
+        if include_sublayers:
+            for name, sub in self._sub_layers.items():
+                if sub is None:
+                    continue
+                yield from sub._walk(f"{prefix}{name}.", True)
+
+    def sublayers(self, include_self=False) -> list:
+        out = [self] if include_self else []
+        for _, sub in self._sub_layers.items():
+            if sub is not None:
+                out.extend(sub.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield (prefix.rstrip("."), self)
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield from sub.named_sublayers(f"{prefix}{name}.", include_self=True)
+
+    def children(self):
+        return iter([l for l in self._sub_layers.values() if l is not None])
+
+    def named_children(self):
+        return iter([(n, l) for n, l in self._sub_layers.items() if l is not None])
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, sub, pfx in self._walk(prefix, include_sublayers):
+            for bname, b in sub._buffers.items():
+                if b is not None and id(b) not in seen:
+                    seen.add(id(b))
+                    yield (f"{pfx}{bname}", b)
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def apply(self, fn: Callable):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # ------------------------------------------------------------ state
+    def state_dict(self, destination=None, include_sublayers=True, use_hook=True):
+        dest = destination if destination is not None else collections.OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf not in self._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for k, v in state_dict.items():
+            if k in own:
+                arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                own[k].set_value(arr.astype(own[k].numpy().dtype, copy=False))
+            else:
+                unexpected.append(k)
+        for k in own:
+            if k not in state_dict:
+                missing.append(k)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------ modes
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            from ..core.dtype import to_jax_dtype
+
+            jdt = to_jax_dtype(dtype)
+            for p in self.parameters():
+                p._value = p._value.astype(jdt)
+            for b in self.buffers():
+                if b is not None and np.issubdtype(np.asarray(b._value).dtype, np.floating):
+                    b._value = b._value.astype(jdt)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    # ------------------------------------------------------------ hooks
+    def register_forward_pre_hook(self, hook):
+        h = _HookHandle(self._forward_pre_hooks, hook)
+        return h
+
+    def register_forward_post_hook(self, hook):
+        h = _HookHandle(self._forward_post_hooks, hook)
+        return h
+
+    # ------------------------------------------------------------ call
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # ------------------------------------------------------------ functional bridge
+    def functional_state(self):
+        """(params, buffers) as flat name->Tensor dicts — the jit/pjit bridge."""
+        params = collections.OrderedDict(self.named_parameters())
+        buffers = collections.OrderedDict(self.named_buffers())
+        return params, buffers
+
+    def functional_call(self, params: dict, buffers: dict, *inputs, **kwargs):
+        """Run forward with parameter/buffer values substituted (pure w.r.t. params).
+
+        Values in `params`/`buffers` may be jax arrays or tracers; originals are
+        restored afterwards. Buffer updates (e.g. BN running stats) performed during
+        the call are captured and returned as the new buffers dict.
+        """
+        own_p, own_b = self.functional_state()
+        saved = {k: t._value for k, t in {**own_p, **own_b}.items() if t is not None}
+        saved_sg = {k: t._stop_gradient for k, t in {**own_p, **own_b}.items() if t is not None}
+        try:
+            for k, v in params.items():
+                if k in own_p and own_p[k] is not None:
+                    own_p[k]._value = v._value if isinstance(v, Tensor) else v
+            for k, v in (buffers or {}).items():
+                if k in own_b and own_b[k] is not None:
+                    own_b[k]._value = v._value if isinstance(v, Tensor) else v
+            out = self(*inputs, **kwargs)
+            new_buffers = {k: t._value for k, t in own_b.items() if t is not None}
+            return out, new_buffers
+        finally:
+            for k, t in {**own_p, **own_b}.items():
+                if t is not None and k in saved:
+                    t._value = saved[k]
+                    t._stop_gradient = saved_sg[k]
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        main = f"{self.__class__.__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def extra_repr(self):
+        return ""
+
+
+class _HookHandle:
+    _next_id = 0
+
+    def __init__(self, hooks_dict, hook):
+        self._hooks_dict = hooks_dict
+        self._id = _HookHandle._next_id
+        _HookHandle._next_id += 1
+        hooks_dict[self._id] = hook
+
+    def remove(self):
+        self._hooks_dict.pop(self._id, None)

@@ -1,0 +1,238 @@
+"""Recurrent layers via lax.scan (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is a `jax.lax.scan` inside one primitive — XLA compiles
+the whole sequence into a single fused loop instead of per-step op dispatch.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+from . import initializer as I
+from .layer import Layer
+
+
+class _RNNBase(Layer):
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirectional else 1
+        self.num_directions = num_dirs
+        gate_mult = {"RNN": 1, "GRU": 3, "LSTM": 4}[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                sfx = f"_reverse" if d else ""
+                self.add_parameter(
+                    f"weight_ih_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size, in_sz),
+                                          attr=weight_ih_attr,
+                                          default_initializer=I.Uniform(-std, std)),
+                )
+                self.add_parameter(
+                    f"weight_hh_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size, hidden_size),
+                                          attr=weight_hh_attr,
+                                          default_initializer=I.Uniform(-std, std)),
+                )
+                self.add_parameter(
+                    f"bias_ih_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size,), attr=bias_ih_attr,
+                                          is_bias=True, default_initializer=I.Uniform(-std, std)),
+                )
+                self.add_parameter(
+                    f"bias_hh_l{layer}{sfx}",
+                    self.create_parameter((gate_mult * hidden_size,), attr=bias_hh_attr,
+                                          is_bias=True, default_initializer=I.Uniform(-std, std)),
+                )
+
+    def _cell(self, x_t, state, w_ih, w_hh, b_ih, b_hh):
+        raise NotImplementedError
+
+    def _layer_params(self, layer, reverse):
+        sfx = "_reverse" if reverse else ""
+        return (
+            self._parameters[f"weight_ih_l{layer}{sfx}"],
+            self._parameters[f"weight_hh_l{layer}{sfx}"],
+            self._parameters[f"bias_ih_l{layer}{sfx}"],
+            self._parameters[f"bias_hh_l{layer}{sfx}"],
+        )
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        has_cell = self.MODE == "LSTM"
+        batch_axis = 1 if self.time_major else 0
+        x = inputs
+        b = x.shape[batch_axis]
+        nl, nd, h = self.num_layers, self.num_directions, self.hidden_size
+
+        if initial_states is None:
+            z = Tensor(jnp.zeros((nl * nd, b, h), x._value.dtype))
+            initial_states = (z, z.clone()) if has_cell else z
+
+        mode = self.MODE
+        time_major = self.time_major
+
+        def run(xv, h0v, c0v, *flat_params):
+            if not time_major:
+                xv = jnp.swapaxes(xv, 0, 1)  # -> [T, B, ...]
+            layer_in = xv
+            hs, cs = [], []
+            p_iter = iter(flat_params)
+            for layer in range(nl):
+                dir_outs = []
+                for d in range(nd):
+                    w_ih, w_hh, b_ih, b_hh = (next(p_iter) for _ in range(4))
+                    sidx = layer * nd + d
+                    h_init = h0v[sidx]
+                    c_init = c0v[sidx] if has_cell else None
+                    seq = jnp.flip(layer_in, 0) if d else layer_in
+
+                    def step(carry, x_t, w_ih=w_ih, w_hh=w_hh, b_ih=b_ih, b_hh=b_hh):
+                        return _cell_step(mode, carry, x_t, w_ih, w_hh, b_ih, b_hh)
+
+                    carry0 = (h_init, c_init) if has_cell else h_init
+                    carry_f, outs = jax.lax.scan(step, carry0, seq)
+                    if d:
+                        outs = jnp.flip(outs, 0)
+                    dir_outs.append(outs)
+                    if has_cell:
+                        hs.append(carry_f[0])
+                        cs.append(carry_f[1])
+                    else:
+                        hs.append(carry_f)
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if nd == 2 else dir_outs[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_n = jnp.stack(hs, 0)
+            if has_cell:
+                return out, h_n, jnp.stack(cs, 0)
+            return out, h_n
+
+        flat_params = []
+        for layer in range(nl):
+            for d in range(nd):
+                flat_params.extend(self._layer_params(layer, bool(d)))
+
+        if has_cell:
+            h0, c0 = initial_states
+            res = primitive_call(run, x, h0, c0, *flat_params, name=f"{mode}_forward")
+            out, h_n, c_n = res
+            return out, (h_n, c_n)
+        h0 = initial_states
+        zero_c = Tensor(jnp.zeros_like(h0._value))
+        res = primitive_call(
+            lambda xv, h0v, *ps: run(xv, h0v, None, *ps), x, h0, *flat_params,
+            name=f"{mode}_forward",
+        )
+        out, h_n = res
+        return out, h_n
+
+
+def _cell_step(mode, carry, x_t, w_ih, w_hh, b_ih, b_hh):
+    if mode == "LSTM":
+        h, c = carry
+        gates = x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+    if mode == "GRU":
+        h = carry
+        gi = x_t @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_n_ = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        n = jnp.tanh(i_n + r * h_n_)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+    h = carry
+    h_new = jnp.tanh(x_t @ w_ih.T + b_ih + h @ w_hh.T + b_hh)
+    return h_new, h_new
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter((4 * hidden_size,), is_bias=True,
+                                             default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter((4 * hidden_size,), is_bias=True,
+                                             default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = Tensor(jnp.zeros((inputs.shape[0], self.hidden_size), inputs._value.dtype))
+            states = (z, z.clone())
+        h, c = states
+
+        def f(x_t, hv, cv, w_ih, w_hh, b_ih, b_hh):
+            (h_new, c_new), _ = _cell_step("LSTM", (hv, cv), x_t, w_ih, w_hh, b_ih, b_hh)
+            return h_new, c_new
+
+        h_new, c_new = primitive_call(
+            f, inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh
+        )
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size, name=None, **kw):
+        super().__init__()
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter((3 * hidden_size,), is_bias=True,
+                                             default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter((3 * hidden_size,), is_bias=True,
+                                             default_initializer=I.Uniform(-std, std))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = Tensor(jnp.zeros((inputs.shape[0], self.hidden_size), inputs._value.dtype))
+
+        def f(x_t, hv, w_ih, w_hh, b_ih, b_hh):
+            h_new, _ = _cell_step("GRU", hv, x_t, w_ih, w_hh, b_ih, b_hh)
+            return h_new
+
+        h_new = primitive_call(
+            f, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh
+        )
+        return h_new, h_new

@@ -1,0 +1,15 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
+from . import lr  # noqa: F401
+from .optimizer import L1Decay, L2Decay, Optimizer  # noqa: F401
+from .optimizers import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    LarsMomentum,
+    Momentum,
+    RMSProp,
+)

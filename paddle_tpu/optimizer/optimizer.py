@@ -1,0 +1,224 @@
+"""Optimizer base.
+
+Reference analog: `python/paddle/optimizer/optimizer.py:89`. TPU-native design:
+every optimizer defines ONE pure function `_apply_dense(p, g, slots, lr, step)`
+over jax arrays. The eager `step()` loops params; the jit path
+(`functional_update`) maps the same function over the whole params pytree inside
+the compiled train step — the analog of the reference's fused GPU optimizer
+kernels (operators/optimizers/), but fused by XLA instead of hand-written CUDA.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..utils.clip_grad import ClipGradBase
+from .lr import LRScheduler
+
+_LOW_PRECISION = ("float16", "bfloat16")
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._learning_rate = learning_rate
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._weight_decay = _wd_coeff(weight_decay)
+        self._decoupled_wd = False  # AdamW overrides
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._slots: dict[int, dict[str, jnp.ndarray]] = {}
+        self._step_count = 0
+        self.helper = None
+
+    # ------------------------------------------------------------ lr
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr not allowed when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # ------------------------------------------------------------ slots
+    def _slot_init(self, p_value) -> dict:
+        """Per-param optimizer state arrays. Override."""
+        return {}
+
+    def _apply_dense(self, p, g, slots: dict, lr, step):
+        """Pure update: returns (new_p, new_slots). Override."""
+        raise NotImplementedError
+
+    def _get_slots(self, p: Tensor) -> dict:
+        key = id(p)
+        if key not in self._slots:
+            slots = self._slot_init(p._value)
+            if self._multi_precision and p.dtype in _LOW_PRECISION:
+                slots["master_weight"] = p._value.astype(jnp.float32)
+            self._slots[key] = slots
+        return self._slots[key]
+
+    # ------------------------------------------------------------ eager step
+    def step(self):
+        self._step_count += 1
+        params = [p for p in self._parameter_list if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply([p.grad._value for p in params], [p._value for p in params])
+        else:
+            grads = [p.grad._value for p in params]
+        lr = self.get_lr()
+        for p, g in zip(params, grads):
+            if g is None:
+                continue
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            slots = self._get_slots(p)
+            g = self._apply_weight_decay_to_grad(p, g)
+            target = slots.get("master_weight", p._value)
+            new_p, new_slots = self._apply_dense(target, g.astype(target.dtype), slots, plr, self._step_count)
+            if "master_weight" in slots:
+                new_slots["master_weight"] = new_p
+                p._value = new_p.astype(p._value.dtype)
+            else:
+                p._value = new_p
+            self._slots[id(p)] = new_slots
+
+    def _apply_weight_decay_to_grad(self, p, g):
+        # L2 regularization folded into grad (paddle semantics); AdamW decouples.
+        wd = self._param_wd(p)
+        if wd and not self._decoupled_wd:
+            g = g + wd * p._value.astype(g.dtype)
+        return g
+
+    def _param_wd(self, p):
+        if getattr(p, "regularizer", None) is not None:
+            return getattr(p.regularizer, "coeff", 0.0)
+        return self._weight_decay or 0.0
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static.program import Variable, default_main_program
+
+        if isinstance(loss, Variable):
+            # static mode: register the train spec; the Executor lowers
+            # forward+grad+update into one XLA computation
+            default_main_program()._minimize_spec = (self, loss)
+            return [], []
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return [], []
+
+    # ------------------------------------------------------------ functional/jit path
+    def functional_init(self, params: dict):
+        """params: dict name -> jax array. Returns the full opt-state pytree."""
+        state = {}
+        for name, v in params.items():
+            slots = self._slot_init(v)
+            if self._multi_precision and str(v.dtype) in _LOW_PRECISION:
+                slots["master_weight"] = v.astype(jnp.float32)
+            state[name] = slots
+        return {"step": jnp.zeros((), jnp.int32), "slots": state}
+
+    def functional_update(self, params: dict, grads: dict, state: dict, lr=None,
+                          wd_mask=None):
+        """Pure pytree update used inside jit/pjit train steps.
+
+        params/grads: dict name -> array; state from functional_init.
+        lr: traced scalar (defaults to current python lr).
+        Returns (new_params, new_state).
+        """
+        lr = self.get_lr() if lr is None else lr
+        step = state["step"] + 1
+        new_params, new_state = {}, {}
+        # grad clip across the whole pytree
+        names = [n for n, g in grads.items() if g is not None]
+        if self._grad_clip is not None:
+            clipped = self._grad_clip.apply([grads[n] for n in names], [params[n] for n in names])
+            grads = {**grads, **dict(zip(names, clipped))}
+        for name, p in params.items():
+            g = grads.get(name)
+            slots = state["slots"].get(name, {})
+            if g is None:
+                new_params[name] = p
+                new_state[name] = slots
+                continue
+            wd_on = True if wd_mask is None else wd_mask.get(name, True)
+            if self._weight_decay and not self._decoupled_wd and wd_on:
+                g = g + self._weight_decay * p.astype(g.dtype)
+            target = slots.get("master_weight", p)
+            g = g.astype(target.dtype)
+            if self._decoupled_wd and self._weight_decay and wd_on:
+                target = target * (1.0 - lr * self._weight_decay)
+            new_p, new_slots = self._apply_dense(target, g, slots, lr, step)
+            if "master_weight" in slots:
+                new_slots["master_weight"] = new_p
+                new_params[name] = new_p.astype(p.dtype)
+            else:
+                new_params[name] = new_p
+            new_state[name] = new_slots
+        return new_params, {"step": step, "slots": new_state}
+
+    # ------------------------------------------------------------ state io
+    def state_dict(self):
+        sd = {}
+        name_of = {}
+        for p in self._parameter_list or []:
+            name_of[id(p)] = p.name
+        for key, slots in self._slots.items():
+            pname = name_of.get(key, str(key))
+            for sname, v in slots.items():
+                sd[f"{pname}.{sname}"] = Tensor(v)
+        sd["@step"] = self._step_count
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("@step", 0))
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        by_param = collections.defaultdict(dict)
+        for k, v in state_dict.items():
+            if k in ("@step", "LR_Scheduler"):
+                continue
+            pname, sname = k.rsplit(".", 1)
+            by_param[pname][sname] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+        for p in self._parameter_list or []:
+            if p.name in by_param:
+                self._slots[id(p)] = dict(by_param[p.name])
+
+
+def _wd_coeff(weight_decay):
+    if weight_decay is None:
+        return 0.0
+    if isinstance(weight_decay, (int, float)):
+        return float(weight_decay)
+    return float(getattr(weight_decay, "coeff", getattr(weight_decay, "_coeff", 0.0)))
+
+
+class L2Decay:
+    """reference: python/paddle/regularizer.py"""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)

@@ -1,0 +1,240 @@
+"""Concrete optimizers (reference: python/paddle/optimizer/{sgd,momentum,adam,adamw,
+lamb,rmsprop,adagrad}.py + PHI kernels phi/kernels/gpu/adam_kernel.cu etc.).
+
+Each `_apply_dense` is a pure jax function — XLA fuses the whole parameter update
+into the train step (the analog of the reference's fused CUDA optimizer kernels).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _slot_init(self, v):
+        return {"velocity": jnp.zeros_like(v, dtype=jnp.float32 if v.dtype != jnp.float64 else v.dtype)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        vel = slots["velocity"] * self._momentum + g.astype(slots["velocity"].dtype)
+        if self._nesterov:
+            upd = g.astype(vel.dtype) + self._momentum * vel
+        else:
+            upd = vel
+        return (p - lr * upd.astype(p.dtype)), {"velocity": vel}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _slot_init(self, v):
+        f32 = jnp.float32 if v.dtype != jnp.float64 else v.dtype
+        return {
+            "moment1": jnp.zeros_like(v, dtype=f32),
+            "moment2": jnp.zeros_like(v, dtype=f32),
+        }
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(slots["moment1"].dtype)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * (g32 * g32)
+        step_f = jnp.asarray(step, jnp.float32)
+        bc1 = 1 - self._beta1**step_f
+        bc2 = 1 - self._beta2**step_f
+        m_hat = m / bc1
+        v_hat = v / bc2
+        new_p = p - (lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision)
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_weight_decay_to_grad(self, p, g):
+        return g  # decoupled
+
+    def step(self):
+        # decoupled weight decay before the adam update (paddle adamw semantics)
+        lr = self.get_lr()
+        for p in self._parameter_list or []:
+            if p.stop_gradient or p.grad is None:
+                continue
+            if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+                continue
+            wd = self._weight_decay
+            if wd:
+                slots = self._get_slots(p)
+                if "master_weight" in slots:
+                    slots["master_weight"] = slots["master_weight"] * (1 - lr * wd)
+                    p._value = slots["master_weight"].astype(p._value.dtype)
+                else:
+                    p._value = p._value * (1 - lr * wd)
+        super().step()
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-06, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _slot_init(self, v):
+        f32 = jnp.float32 if v.dtype != jnp.float64 else v.dtype
+        return {"moment1": jnp.zeros_like(v, dtype=f32), "moment2": jnp.zeros_like(v, dtype=f32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(slots["moment1"].dtype)
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * (g32 * g32)
+        step_f = jnp.asarray(step, jnp.float32)
+        m_hat = m / (1 - self._beta1**step_f)
+        v_hat = v / (1 - self._beta2**step_f)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + self._lamb_wd * p.astype(m.dtype)
+        w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p - lr * trust * r.astype(p.dtype)).astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """reference: fluid LarsMomentumOptimizer / fleet lars_optimizer."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=1e-9, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _slot_init(self, v):
+        return {"velocity": jnp.zeros_like(v, dtype=jnp.float32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm + self._eps),
+            lr,
+        )
+        vel = self._momentum * slots["velocity"] + local_lr * (g32 + self._lars_wd * p32)
+        return (p - vel.astype(p.dtype)), {"velocity": vel}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _slot_init(self, v):
+        s = {"mean_square": jnp.zeros_like(v, dtype=jnp.float32),
+             "momentum": jnp.zeros_like(v, dtype=jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(v, dtype=jnp.float32)
+        return s
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * slots["mean_square"] + (1 - self._rho) * g32 * g32
+        out = {"mean_square": ms}
+        if self._centered:
+            mg = self._rho * slots["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - mg * mg + self._epsilon)
+            out["mean_grad"] = mg
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * slots["momentum"] + lr * g32 / denom
+        out["momentum"] = mom
+        return p - mom.astype(p.dtype), out
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _slot_init(self, v):
+        return {"moment": jnp.full_like(v, self._init_acc, dtype=jnp.float32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        acc = slots["moment"] + g32 * g32
+        return p - (lr * g32 / (jnp.sqrt(acc) + self._epsilon)).astype(p.dtype), {"moment": acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _slot_init(self, v):
+        return {"avg_squared_grad": jnp.zeros_like(v, dtype=jnp.float32),
+                "avg_squared_update": jnp.zeros_like(v, dtype=jnp.float32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * slots["avg_squared_grad"] + (1 - self._rho) * g32 * g32
+        upd = jnp.sqrt(slots["avg_squared_update"] + self._epsilon) / jnp.sqrt(asg + self._epsilon) * g32
+        asu = self._rho * slots["avg_squared_update"] + (1 - self._rho) * upd * upd
+        return p - (lr * upd).astype(p.dtype), {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-08,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _slot_init(self, v):
+        return {"moment": jnp.zeros_like(v, dtype=jnp.float32),
+                "inf_norm": jnp.zeros_like(v, dtype=jnp.float32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * slots["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * slots["inf_norm"], jnp.abs(g32))
+        step_f = jnp.asarray(step, jnp.float32)
+        lr_t = lr / (1 - self._beta1**step_f)
+        return p - (lr_t * m / (u + self._epsilon)).astype(p.dtype), {"moment": m, "inf_norm": u}

@@ -1,0 +1,151 @@
+"""paddle.profiler — wraps the JAX/XLA (xplane) profiler.
+
+Reference analog: platform/profiler/ (HostTracer + CudaTracer → chrome trace) and
+python/paddle/profiler/profiler.py. On TPU, device tracing comes from XLA's
+profiler (TensorBoard xplane); host annotations use jax.profiler traces.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "make_scheduler",
+           "export_chrome_tracing", "benchmark"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        cycle = closed + ready + record
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof.export(dir_name)
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False):
+        self._timer_only = timer_only
+        self._on_trace_ready = on_trace_ready
+        self._dir = None
+        self._running = False
+        self._step = 0
+        self._step_times = []
+        self._last = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+    def start(self):
+        self._last = time.perf_counter()
+        if not self._timer_only:
+            import tempfile
+
+            self._dir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._running = True
+            except Exception:
+                self._running = False
+
+    def stop(self):
+        if self._running:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._running = False
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+
+    def export(self, path=None, format=None):
+        return self._dir
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+
+        ts = np.asarray(self._step_times) * 1000
+        return (f"steps: {len(ts)}  avg: {ts.mean():.3f}ms  p50: {np.percentile(ts, 50):.3f}ms  "
+                f"max: {ts.max():.3f}ms")
+
+
+@contextlib.contextmanager
+def RecordEvent(name, event_type=None):
+    """Host annotation visible in the xplane trace (reference: RecordEvent
+    platform/profiler/event_tracing.h:47)."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class benchmark:
+    """Throughput timer (reference: python/paddle/profiler/timer.py)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._times = []
+        self._last = None
+
+    def begin(self):
+        self._last = time.perf_counter()
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append((now - self._last, num_samples or 1))
+        self._last = now
+
+    def end(self):
+        pass
+
+    def report(self):
+        if not self._times:
+            return {}
+        total_t = sum(t for t, _ in self._times)
+        total_n = sum(n for _, n in self._times)
+        return {"ips": total_n / total_t, "steps": len(self._times)}

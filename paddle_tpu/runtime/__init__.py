@@ -1,0 +1,16 @@
+"""Native runtime components (C++ via ctypes; reference analog: the C++ core).
+
+- blocking_queue: SPMC bounded queue backing the DataLoader
+  (reference: paddle/fluid/operators/reader/ blocking queues).
+- tcp_store: rendezvous KV store (reference: distributed/store/tcp_store.h).
+
+Each has a pure-Python fallback so the framework works without the native build;
+`paddle_tpu.runtime.build_native()` compiles the C++ once per install.
+"""
+from . import blocking_queue  # noqa: F401
+
+
+def build_native(force=False):
+    from .native import build
+
+    return build(force=force)

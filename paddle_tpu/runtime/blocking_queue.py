@@ -1,0 +1,91 @@
+"""Bounded blocking queue for the DataLoader pipeline.
+
+Python objects can't cross a C++ queue without serialization, so the C++ queue
+(csrc/queue.cc) stores opaque slot ids while payloads live in a Python-side slab;
+when the native lib is unavailable this degrades to queue.Queue transparently.
+"""
+from __future__ import annotations
+
+import queue as _pyqueue
+import threading
+
+
+class BlockingQueue:
+    def __init__(self, capacity: int = 8):
+        self._native = None
+        try:
+            from .native import lib as _lib
+
+            if _lib is not None:
+                self._native = _NativeQueue(_lib, capacity)
+        except Exception:
+            self._native = None
+        self._q: _pyqueue.Queue = _pyqueue.Queue(maxsize=capacity)
+        self._closed = threading.Event()
+
+    def put(self, item, timeout=None):
+        if self._native is not None:
+            return self._native.put(item, timeout)
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except _pyqueue.Full:
+                continue
+        return False
+
+    def get(self, timeout=None):
+        if self._native is not None:
+            return self._native.get(timeout)
+        while True:
+            try:
+                return self._q.get(timeout=timeout if timeout else None)
+            except _pyqueue.Empty:
+                if self._closed.is_set():
+                    raise
+                continue
+
+    def close(self):
+        if self._native is not None:
+            self._native.close()
+        self._closed.set()
+
+    def qsize(self):
+        if self._native is not None:
+            return self._native.size()
+        return self._q.qsize()
+
+
+class _NativeQueue:
+    """C++ SPMC ring holding slot tickets; payloads held in a Python slab."""
+
+    def __init__(self, lib, capacity):
+        self._lib = lib
+        self._h = lib.ptq_queue_new(capacity)
+        self._slab: dict[int, object] = {}
+        self._slab_lock = threading.Lock()
+        self._ticket = 0
+
+    def put(self, item, timeout=None):
+        with self._slab_lock:
+            t = self._ticket
+            self._ticket += 1
+            self._slab[t] = item
+        ok = self._lib.ptq_queue_put(self._h, t, int((timeout or -1) * 1000))
+        if not ok:
+            with self._slab_lock:
+                self._slab.pop(t, None)
+        return bool(ok)
+
+    def get(self, timeout=None):
+        t = self._lib.ptq_queue_get(self._h, int((timeout or -1) * 1000))
+        if t < 0:
+            raise _pyqueue.Empty
+        with self._slab_lock:
+            return self._slab.pop(t)
+
+    def size(self):
+        return self._lib.ptq_queue_size(self._h)
+
+    def close(self):
+        self._lib.ptq_queue_close(self._h)

@@ -1,0 +1,82 @@
+"""Builds & loads the native C++ runtime library (csrc/) via ctypes.
+
+No pybind11 in this environment — the C ABI + ctypes is the binding layer.
+The build is lazy and cached in ~/.cache/paddle_tpu; failures leave `lib = None`
+and every consumer falls back to pure Python.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import tempfile
+
+_CSRC = pathlib.Path(__file__).resolve().parent.parent.parent / "csrc"
+_CACHE = pathlib.Path(
+    os.environ.get("PADDLE_TPU_CACHE", os.path.expanduser("~/.cache/paddle_tpu"))
+)
+_SO = _CACHE / "libpaddle_tpu_runtime.so"
+
+lib = None
+
+
+def build(force=False):
+    global lib
+    if _SO.exists() and not force:
+        return _load()
+    sources = sorted(str(p) for p in _CSRC.glob("*.cc"))
+    if not sources:
+        return None
+    _CACHE.mkdir(parents=True, exist_ok=True)
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           "-o", str(_SO), *sources]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except Exception:
+        return None
+    return _load()
+
+
+def _load():
+    global lib
+    try:
+        lib = ctypes.CDLL(str(_SO))
+        _declare(lib)
+        return lib
+    except OSError:
+        lib = None
+        return None
+
+
+def _declare(l):
+    l.ptq_queue_new.restype = ctypes.c_void_p
+    l.ptq_queue_new.argtypes = [ctypes.c_int]
+    l.ptq_queue_put.restype = ctypes.c_int
+    l.ptq_queue_put.argtypes = [ctypes.c_void_p, ctypes.c_long, ctypes.c_int]
+    l.ptq_queue_get.restype = ctypes.c_long
+    l.ptq_queue_get.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    l.ptq_queue_size.restype = ctypes.c_int
+    l.ptq_queue_size.argtypes = [ctypes.c_void_p]
+    l.ptq_queue_close.argtypes = [ctypes.c_void_p]
+    # tcp store
+    l.ptq_store_server_new.restype = ctypes.c_void_p
+    l.ptq_store_server_new.argtypes = [ctypes.c_int]
+    l.ptq_store_server_free.argtypes = [ctypes.c_void_p]
+    l.ptq_store_client_new.restype = ctypes.c_void_p
+    l.ptq_store_client_new.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    l.ptq_store_client_free.argtypes = [ctypes.c_void_p]
+    l.ptq_store_set.restype = ctypes.c_int
+    l.ptq_store_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    l.ptq_store_get.restype = ctypes.c_int
+    l.ptq_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                                ctypes.c_int, ctypes.c_int]
+    l.ptq_store_add.restype = ctypes.c_long
+    l.ptq_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
+    l.ptq_store_wait.restype = ctypes.c_int
+    l.ptq_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+
+
+# attempt load of an existing build at import (no compile at import time)
+if _SO.exists():
+    _load()

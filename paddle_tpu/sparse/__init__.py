@@ -1,0 +1,51 @@
+"""paddle.sparse — COO/CSR tensors (reference: python/paddle/sparse/ +
+phi/kernels/sparse/). TPU-native: wraps jax.experimental.sparse (BCOO), which
+lowers to gather/scatter + dot_general on the MXU."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+try:
+    from jax.experimental import sparse as jsparse
+
+    _HAS = True
+except Exception:  # pragma: no cover
+    _HAS = False
+
+
+class SparseCooTensor(Tensor):
+    def __init__(self, indices, values, shape, stop_gradient=True):
+        iv = indices._value if isinstance(indices, Tensor) else jnp.asarray(np.asarray(indices))
+        vv = values._value if isinstance(values, Tensor) else jnp.asarray(np.asarray(values))
+        self._bcoo = jsparse.BCOO((vv, iv.T.astype(jnp.int32)), shape=tuple(shape))
+        super().__init__(self._bcoo.todense(), stop_gradient=stop_gradient)
+        self._indices = iv
+        self._values = vv
+
+    def indices(self):
+        return Tensor(self._indices)
+
+    def values(self):
+        return Tensor(self._values)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None, stop_gradient=True):
+    return SparseCooTensor(indices, values, shape, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None, stop_gradient=True):
+    crows_np = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np])
+    return SparseCooTensor(idx.T, values, shape, stop_gradient)
+
+
+def is_same_shape(x, y):
+    return tuple(x.shape) == tuple(y.shape)

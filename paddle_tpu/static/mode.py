@@ -1,0 +1,18 @@
+"""Dynamic/static mode switch (reference: paddle.enable_static/disable_static)."""
+from __future__ import annotations
+
+_STATIC_MODE = False
+
+
+def enable_static():
+    global _STATIC_MODE
+    _STATIC_MODE = True
+
+
+def disable_static():
+    global _STATIC_MODE
+    _STATIC_MODE = False
+
+
+def in_static_mode() -> bool:
+    return _STATIC_MODE

@@ -1,0 +1,50 @@
+"""paddle.static.nn (reference: python/paddle/static/nn/) — static-graph layer
+helpers. Because static mode records through the same op dispatch, these simply
+instantiate the dygraph layers and call them."""
+from __future__ import annotations
+
+from .. import nn as dynn
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_dim = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_dim *= int(s)
+    if len(x.shape) > num_flatten_dims + 1:
+        x = x.flatten(num_flatten_dims)
+    layer = dynn.Linear(in_dim, size, weight_attr=weight_attr, bias_attr=bias_attr)
+    out = layer(x)
+    if activation:
+        out = getattr(dynn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCHW"):
+    in_c = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+    layer = dynn.Conv2D(in_c, num_filters, filter_size, stride, padding, dilation,
+                        groups, weight_attr=param_attr, bias_attr=bias_attr,
+                        data_format=data_format)
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None, **kw):
+    c = int(input.shape[1] if data_layout == "NCHW" else input.shape[-1])
+    layer = dynn.BatchNorm2D(c, momentum=momentum, epsilon=epsilon,
+                             weight_attr=param_attr, bias_attr=bias_attr)
+    layer.training = not is_test
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, param_attr=None, dtype="float32"):
+    layer = dynn.Embedding(size[0], size[1], weight_attr=param_attr)
+    return layer(input)

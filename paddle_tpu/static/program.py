@@ -1,0 +1,287 @@
+"""Program IR.
+
+Reference analog: ProgramDesc/BlockDesc/OpDesc (`paddle/fluid/framework/
+framework.proto`, program_desc.h:31) + python mirrors (fluid/framework.py:4834).
+
+TPU-native design: the Program is a *build-time op tape*. In static mode every
+framework op (the same `primitive_call` the eager mode uses) appends an Operator
+carrying the pure-jax lowering closure + op_role (survey App. A), and outputs
+become symbolic Variables (jax.eval_shape avals). Lowering a Program to XLA is
+then trivial: replay the tape over tracers inside one jit — the IPU
+"whole program → one compiled computation" model (survey §3.5), with no
+per-op kernel registry because each Operator carries its own lowering.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+
+import jax
+import numpy as np
+
+from ..core import dispatch as dispatch_mod
+from ..core.dtype import convert_dtype, to_jax_dtype
+from ..core.tensor import Tensor
+from ..utils.misc import unique_name
+
+
+class OpRole:
+    """reference: paddle/fluid/framework/op_proto_maker.h:25"""
+
+    Forward = 0
+    Backward = 1
+    Optimize = 2
+    RPC = 4
+    Dist = 8
+    LRSched = 16
+    Loss = 0x100
+
+
+class Variable(Tensor):
+    """Symbolic tensor: _value is a jax.ShapeDtypeStruct (aval)."""
+
+    def __init__(self, shape, dtype, name=None, block=None, is_data=False,
+                 stop_gradient=True):
+        aval = jax.ShapeDtypeStruct(tuple(int(s) if s != -1 else 1 for s in shape),
+                                    to_jax_dtype(dtype))
+        Tensor.__init__(self, np.zeros((), np.float32), stop_gradient=stop_gradient)
+        self._value = aval
+        self.name = name or unique_name.generate("var")
+        self.block = block
+        self.is_data = is_data
+        self.desc_shape = list(shape)
+
+    @property
+    def shape(self):
+        return list(self.desc_shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable {self.name!r} has no value at build time; run the program "
+            "through an Executor first"
+        )
+
+    def __repr__(self):
+        return f"Variable(name={self.name}, shape={self.desc_shape}, dtype={self.dtype})"
+
+
+class Operator:
+    """One recorded op: type name, the pure-jax lowering, inputs, outputs, attrs."""
+
+    __slots__ = ("type", "fn", "inputs", "outputs", "attrs", "op_role")
+
+    def __init__(self, type, fn, inputs, outputs, attrs=None, op_role=OpRole.Forward):
+        self.type = type
+        self.fn = fn  # pure jax callable over input arrays
+        self.inputs = inputs  # list of Tensor/Variable (or nested lists)
+        self.outputs = outputs  # list of Variable
+        self.attrs = attrs or {}
+        self.op_role = op_role
+
+    def __repr__(self):
+        return f"{self.type}({[getattr(i, 'name', '?') for i in self.inputs]}) -> " \
+               f"{[o.name for o in self.outputs]}"
+
+
+class Block:
+    def __init__(self, program, idx, parent_idx=-1):
+        self.program = program
+        self.idx = idx
+        self.parent_idx = parent_idx
+        self.ops: list[Operator] = []
+        self.vars: dict[str, Variable] = collections.OrderedDict()
+
+    def var(self, name):
+        return self.vars[name]
+
+    def has_var(self, name):
+        return name in self.vars
+
+    def append_op(self, op: Operator):
+        self.ops.append(op)
+        return op
+
+    def create_var(self, shape, dtype, name=None, **kw):
+        v = Variable(shape, dtype, name, block=self, **kw)
+        self.vars[v.name] = v
+        return v
+
+
+class Program:
+    def __init__(self):
+        self.blocks = [Block(self, 0)]
+        self.current_block_idx = 0
+        self._data_vars: list[Variable] = []
+        self._minimize_spec = None  # (optimizer, loss_var)
+        self.random_seed = 0
+        self._lowered_cache = {}
+
+    @property
+    def global_block(self):
+        return self.blocks[0]
+
+    def current_block(self):
+        return self.blocks[self.current_block_idx]
+
+    def all_ops(self):
+        return [op for b in self.blocks for op in b.ops]
+
+    def list_vars(self):
+        return list(self.global_block.vars.values())
+
+    def clone(self, for_test=False):
+        import copy
+
+        new = Program.__new__(Program)
+        new.blocks = self.blocks  # share the tape (reference clones share descs)
+        new.current_block_idx = self.current_block_idx
+        new._data_vars = list(self._data_vars)
+        new._minimize_spec = None if for_test else self._minimize_spec
+        new.random_seed = self.random_seed
+        new._lowered_cache = {}
+        return new
+
+    # ------------------------------------------------------------ param capture
+    def captured_params(self):
+        """Concrete Tensors referenced by ops (weights) in deterministic order."""
+        seen, out = set(), []
+        for op in self.all_ops():
+            for t in _flat_inputs(op.inputs):
+                if isinstance(t, Tensor) and not isinstance(t, Variable):
+                    if id(t) not in seen:
+                        seen.add(id(t))
+                        out.append(t)
+        return out
+
+    def __repr__(self):
+        lines = [f"Program(blocks={len(self.blocks)}, ops={len(self.all_ops())})"]
+        for op in self.all_ops()[:50]:
+            lines.append("  " + repr(op))
+        return "\n".join(lines)
+
+
+def _flat_inputs(inputs):
+    for i in inputs:
+        if isinstance(i, (list, tuple)):
+            yield from _flat_inputs(i)
+        else:
+            yield i
+
+
+# --------------------------------------------------------------- build context
+_default_main = Program()
+_default_startup = Program()
+_current_role = [OpRole.Forward]
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev_m, prev_s = _default_main, _default_startup
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev_m, prev_s
+
+
+@contextlib.contextmanager
+def name_scope(prefix):
+    yield
+
+
+@contextlib.contextmanager
+def op_role_guard(role):
+    _current_role.append(role)
+    try:
+        yield
+    finally:
+        _current_role.pop()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """reference: paddle.static.data — declares a feed Variable."""
+    prog = default_main_program()
+    shape = [1 if s in (-1, None) else s for s in shape]
+    v = prog.global_block.create_var(shape, dtype, name=name, is_data=True)
+    prog._data_vars.append(v)
+    return v
+
+
+# --------------------------------------------------------------- static tracer
+def _static_record(fn, args, name):
+    """Called from core.dispatch when static mode is active: append an Operator."""
+    prog = default_main_program()
+    block = prog.current_block()
+
+    avals = []
+    for a in args:
+        avals.append(_to_aval(a))
+    out_aval = jax.eval_shape(fn, *avals)
+    is_tuple = isinstance(out_aval, (tuple, list))
+    outs_avals = list(out_aval) if is_tuple else [out_aval]
+    outputs = [
+        block.create_var(o.shape, convert_dtype(o.dtype),
+                         name=unique_name.generate(name or "op"))
+        for o in outs_avals
+    ]
+    op = Operator(name or getattr(fn, "__name__", "op"), fn, list(args), outputs,
+                  op_role=_current_role[-1])
+    block.append_op(op)
+    if is_tuple:
+        return tuple(outputs)
+    return outputs[0]
+
+
+def _to_aval(a):
+    if isinstance(a, Variable):
+        return a._value
+    if isinstance(a, Tensor):
+        return jax.ShapeDtypeStruct(tuple(a._value.shape), a._value.dtype)
+    if isinstance(a, (list, tuple)):
+        return type(a)(_to_aval(x) for x in a)
+    return a
+
+
+def _static_active(args) -> bool:
+    from .mode import in_static_mode
+
+    if not in_static_mode():
+        return False
+    return True
+
+
+dispatch_mod._static_hook = (_static_active, _static_record)
+
+
+class Scope:
+    """reference: paddle/fluid/framework/scope.h:78 — name→value store."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope

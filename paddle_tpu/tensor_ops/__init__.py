@@ -1,0 +1,14 @@
+"""Functional tensor op library (the PHI-kernel analog).
+
+Reference analog: `/root/reference/paddle/phi/kernels/` (~150k LoC of CPU+CUDA
+kernels) + `python/paddle/tensor/`. TPU-native: every op is a small pure-jax
+lowering to XLA HLO; there are no per-backend kernels because XLA owns codegen.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401

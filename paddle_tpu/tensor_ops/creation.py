@@ -1,0 +1,165 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.dtype import get_default_dtype, to_jax_dtype
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty",
+    "empty_like",
+    "arange",
+    "linspace",
+    "eye",
+    "tril",
+    "triu",
+    "diag",
+    "diagflat",
+    "meshgrid",
+    "assign",
+    "clone",
+    "numel",
+    "one_hot",
+]
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        dtype = default or get_default_dtype()
+    return to_jax_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return primitive_call(lambda a: jnp.zeros_like(a, dtype=to_jax_dtype(dtype)), x, name="zeros_like")
+
+
+def ones_like(x, dtype=None, name=None):
+    return primitive_call(lambda a: jnp.ones_like(a, dtype=to_jax_dtype(dtype)), x, name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return primitive_call(
+        lambda a: jnp.full_like(a, fill_value, dtype=to_jax_dtype(dtype)), x, name="full_like"
+    )
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else get_default_dtype()
+        )
+    return Tensor(jnp.arange(start, end, step, dtype=to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(start, stop, int(num), dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return primitive_call(lambda a: jnp.tril(a, diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return primitive_call(lambda a: jnp.triu(a, diagonal), x, name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            d = jnp.diag(a, offset)
+            if padding_value != 0:
+                mask = jnp.eye(d.shape[0], dtype=bool)
+                mask = jnp.roll(mask, offset, axis=1) if offset else mask
+            return d if padding_value == 0 else jnp.where(
+                jnp.eye(*d.shape, k=0, dtype=bool), d, padding_value
+            )
+        return jnp.diagonal(a, offset)
+
+    return primitive_call(f, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return primitive_call(lambda a: jnp.diagflat(a, offset), x, name="diagflat")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[a._value if isinstance(a, Tensor) else a for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    src = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is not None:
+        output._value = jnp.asarray(src, dtype=output._value.dtype)
+        return output
+    return primitive_call(lambda a: a + 0, x, name="assign") if isinstance(x, Tensor) else Tensor(src)
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def one_hot(x, num_classes, name=None):
+    return primitive_call(
+        lambda a: jnp.eye(num_classes, dtype=jnp.float32)[a.astype(jnp.int32)], x, name="one_hot"
+    )

@@ -1,0 +1,12 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — direct XLA lowering."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+
+def einsum(equation, *operands):
+    ts = [o if isinstance(o, Tensor) else Tensor(o) for o in operands]
+    return primitive_call(lambda *arrs: jnp.einsum(equation, *arrs), *ts, name="einsum")

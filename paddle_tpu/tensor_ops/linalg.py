@@ -1,0 +1,135 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+__all__ = ["norm", "bmm", "mm", "histogram", "mv", "matrix_power", "cholesky",
+           "svd", "pinv", "solve", "triangular_solve", "qr", "eig", "eigvals",
+           "matrix_rank", "det", "slogdet", "inv", "cross", "dist", "cond"]
+
+
+def _to_t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(a * a))
+        if axis is None:
+            return jnp.linalg.norm(a.reshape(-1), ord=p, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.linalg.norm(a, ord=p if p != "fro" else None, axis=ax, keepdims=keepdim)
+
+    return primitive_call(f, _to_t(x), name="norm")
+
+
+def bmm(x, y, name=None):
+    return primitive_call(lambda a, b: jnp.matmul(a, b), _to_t(x), _to_t(y), name="bmm")
+
+
+def mm(input, mat2, name=None):
+    return primitive_call(jnp.matmul, _to_t(input), _to_t(mat2), name="mm")
+
+
+def mv(x, vec, name=None):
+    return primitive_call(jnp.matmul, _to_t(x), _to_t(vec), name="mv")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        return jnp.histogram(a, bins=bins, range=(lo, hi))[0].astype(jnp.int64)
+
+    return primitive_call(f, _to_t(input).detach())
+
+
+def matrix_power(x, n, name=None):
+    return primitive_call(lambda a: jnp.linalg.matrix_power(a, n), _to_t(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return primitive_call(f, _to_t(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    return primitive_call(lambda a: jnp.linalg.svd(a, full_matrices=full_matrices), _to_t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return primitive_call(lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian), _to_t(x))
+
+
+def solve(x, y, name=None):
+    return primitive_call(jnp.linalg.solve, _to_t(x), _to_t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    import jax.scipy.linalg as jsl
+
+    return primitive_call(
+        lambda a, b: jsl.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        ),
+        _to_t(x),
+        _to_t(y),
+    )
+
+
+def qr(x, mode="reduced", name=None):
+    return primitive_call(lambda a: jnp.linalg.qr(a, mode=mode), _to_t(x))
+
+
+def eig(x, name=None):
+    return primitive_call(lambda a: jnp.linalg.eig(a), _to_t(x).detach())
+
+
+def eigvals(x, name=None):
+    return primitive_call(lambda a: jnp.linalg.eigvals(a), _to_t(x).detach())
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return primitive_call(
+        lambda a: jnp.linalg.matrix_rank(a, tol=tol).astype(jnp.int64), _to_t(x).detach()
+    )
+
+
+def det(x, name=None):
+    return primitive_call(jnp.linalg.det, _to_t(x))
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+
+    return primitive_call(f, _to_t(x))
+
+
+def inv(x, name=None):
+    return primitive_call(jnp.linalg.inv, _to_t(x))
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis if axis != 9 else next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+
+    return primitive_call(f, _to_t(x), _to_t(y))
+
+
+def dist(x, y, p=2, name=None):
+    return primitive_call(
+        lambda a, b: jnp.linalg.norm((a - b).reshape(-1), ord=p), _to_t(x), _to_t(y)
+    )
+
+
+def cond(x, p=None, name=None):
+    return primitive_call(lambda a: jnp.linalg.cond(a, p=p), _to_t(x).detach())

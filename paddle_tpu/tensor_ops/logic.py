@@ -1,0 +1,77 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "allclose", "isclose", "equal_all", "is_empty", "is_tensor", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not",
+]
+
+
+def _to_t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _cmp(name, f):
+    def op(x, y, name=None):
+        x = _to_t(x)
+        y = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+        return primitive_call(lambda a, b: f(a, b), x.detach(), y.detach())
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return primitive_call(jnp.logical_not, _to_t(x).detach())
+
+
+def bitwise_not(x, name=None):
+    return primitive_call(jnp.bitwise_not, _to_t(x).detach())
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(
+        jnp.allclose(_to_t(x)._value, _to_t(y)._value, rtol=rtol, atol=atol, equal_nan=equal_nan)
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return primitive_call(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        _to_t(x).detach(),
+        _to_t(y).detach(),
+    )
+
+
+def equal_all(x, y, name=None):
+    return Tensor(jnp.array_equal(_to_t(x)._value, _to_t(y)._value))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,347 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "concat", "split", "stack", "unstack",
+    "squeeze", "unsqueeze", "flatten", "expand", "expand_as", "tile",
+    "broadcast_to", "gather", "gather_nd", "scatter", "scatter_nd_add", "slice",
+    "index_select", "masked_select", "where", "roll", "flip", "chunk", "unbind",
+    "cast", "t", "moveaxis", "tensordot", "repeat_interleave", "take_along_axis",
+    "put_along_axis", "flatten_", "rot90", "as_complex", "as_real", "tolist",
+    "strided_slice", "unique", "broadcast_shape", "squeeze_", "unsqueeze_",
+]
+
+
+def _to_t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    shp = _shape_list(shape)
+    # paddle semantics: 0 means copy the corresponding input dim
+    shp = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shp))
+    return primitive_call(lambda a: jnp.reshape(a, shp), _to_t(x), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    shp = _shape_list(shape)
+    x._value = jnp.reshape(x._value, shp)
+    return x
+
+
+def transpose(x, perm, name=None):
+    return primitive_call(lambda a: jnp.transpose(a, tuple(perm)), _to_t(x), name="transpose")
+
+
+def t(x, name=None):
+    return primitive_call(lambda a: a.T, _to_t(x), name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return primitive_call(lambda a: jnp.moveaxis(a, source, destination), _to_t(x))
+
+
+def concat(x, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    ts = [_to_t(v) for v in x]
+    return primitive_call(lambda xs: jnp.concatenate(list(xs), axis=axis), ts, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [_to_t(v) for v in x]
+    return primitive_call(lambda xs: jnp.stack(list(xs), axis=axis), ts, name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    outs = primitive_call(
+        lambda a: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis)),
+        _to_t(x),
+        name="unstack",
+    )
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    dim = x.shape[axis]
+    if isinstance(num_or_sections, int):
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        n_neg = [i for i, s in enumerate(sections) if s < 0]
+        if n_neg:
+            sections[n_neg[0]] = dim - sum(s for s in sections if s >= 0)
+    offsets = np.cumsum([0] + sections[:-1]).tolist()
+    outs = primitive_call(
+        lambda a: tuple(
+            jnp.asarray(a[(np.s_[:],) * axis + (np.s_[o : o + s],)]) for o, s in zip(offsets, sections)
+        ),
+        _to_t(x),
+        name="split",
+    )
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        ax = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a0 for a0 in ax if a.shape[a0] == 1)
+        return jnp.squeeze(a, axis=ax) if ax else a
+
+    return primitive_call(f, _to_t(x), name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    y = squeeze(x, axis)
+    x._value = y._value
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    ax = axis if isinstance(axis, (list, tuple)) else [axis]
+    ax = tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in ax)
+
+    def f(a):
+        for d in sorted(ax):
+            a = jnp.expand_dims(a, d if d >= 0 else d + a.ndim + 1)
+        return a
+
+    return primitive_call(f, _to_t(x), name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    y = unsqueeze(x, axis)
+    x._value = y._value
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis if start_axis >= 0 else start_axis + nd
+        e = stop_axis if stop_axis >= 0 else stop_axis + nd
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+        return jnp.reshape(a, new_shape)
+
+    return primitive_call(f, _to_t(x), name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    y = flatten(x, start_axis, stop_axis)
+    x._value = y._value
+    return x
+
+
+def expand(x, shape, name=None):
+    shp = _shape_list(shape)
+
+    def f(a):
+        tgt = tuple(
+            a.shape[i - (len(shp) - a.ndim)] if s == -1 else s for i, s in enumerate(shp)
+        )
+        return jnp.broadcast_to(a, tgt)
+
+    return primitive_call(f, _to_t(x), name="expand")
+
+
+def expand_as(x, y, name=None):
+    return primitive_call(lambda a, b: jnp.broadcast_to(a, b.shape), _to_t(x), _to_t(y).detach())
+
+
+def broadcast_to(x, shape, name=None):
+    return primitive_call(lambda a: jnp.broadcast_to(a, _shape_list(shape)), _to_t(x))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_list(repeat_times)
+    return primitive_call(lambda a: jnp.tile(a, reps), _to_t(x), name="tile")
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    return primitive_call(
+        lambda a, i: jnp.take(a, i.astype(jnp.int32).reshape(-1), axis=axis),
+        _to_t(x),
+        _to_t(index),
+        name="gather",
+    )
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        return a[tuple(jnp.moveaxis(idx, -1, 0))] if k == a.ndim else a[
+            tuple(jnp.moveaxis(idx, -1, 0))
+        ]
+
+    return primitive_call(f, _to_t(x), _to_t(index), name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        return a.at[idx].add(upd)
+
+    return primitive_call(f, _to_t(x), _to_t(index), _to_t(updates), name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return primitive_call(f, _to_t(x), _to_t(index), _to_t(updates), name="scatter_nd_add")
+
+
+def slice(x, axes, starts, ends, name=None):
+    def f(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            st = int(st.item()) if isinstance(st, Tensor) else int(st)
+            en = int(en.item()) if isinstance(en, Tensor) else int(en)
+            idx[ax] = np.s_[st:en]
+        return a[tuple(idx)]
+
+    return primitive_call(f, _to_t(x), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [np.s_[:]] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = np.s_[int(st) : int(en) : int(sd)]
+        return a[tuple(idx)]
+
+    return primitive_call(f, _to_t(x), name="strided_slice")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic-shape op: executes on host (XLA needs static shapes)
+    xv, mv = np.asarray(_to_t(x)._value), np.asarray(_to_t(mask)._value)
+    return Tensor(xv[mv])
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return tuple(Tensor(v) for v in np.nonzero(np.asarray(_to_t(condition)._value)))
+    return primitive_call(
+        lambda c, a, b: jnp.where(c, a, b), _to_t(condition).detach(), _to_t(x), _to_t(y), name="where"
+    )
+
+
+def roll(x, shifts, axis=None, name=None):
+    return primitive_call(lambda a: jnp.roll(a, shifts, axis=axis), _to_t(x), name="roll")
+
+
+def flip(x, axis, name=None):
+    return primitive_call(lambda a: jnp.flip(a, axis=axis), _to_t(x), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return primitive_call(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _to_t(x))
+
+
+def cast(x, dtype):
+    return _to_t(x).astype(dtype)
+
+
+def tensordot(x, y, axes=2, name=None):
+    def _ax(axes):
+        if isinstance(axes, Tensor):
+            return axes.tolist()
+        return axes
+
+    return primitive_call(lambda a, b: jnp.tensordot(a, b, axes=_ax(axes)), _to_t(x), _to_t(y))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = repeats.numpy() if isinstance(repeats, Tensor) else repeats
+    return primitive_call(lambda a: jnp.repeat(a, r, axis=axis), _to_t(x))
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return primitive_call(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+        _to_t(arr),
+        _to_t(indices),
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        if reduce == "add":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False) if False else _put(a, i, v, axis, add=True)
+        return _put(a, i, v, axis, add=False)
+
+    return primitive_call(f, _to_t(arr), _to_t(indices), _to_t(values))
+
+
+def _put(a, i, v, axis, add):
+    idx = list(jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij"))
+    idx[axis] = i
+    v = jnp.broadcast_to(v, i.shape)
+    return a.at[tuple(idx)].add(v) if add else a.at[tuple(idx)].set(v)
+
+
+def as_complex(x, name=None):
+    return primitive_call(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _to_t(x))
+
+
+def as_real(x, name=None):
+    return primitive_call(lambda a: jnp.stack([a.real, a.imag], axis=-1), _to_t(x))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, name=None):
+    # dynamic-shape: host computation
+    res = np.unique(
+        np.asarray(_to_t(x)._value),
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    if isinstance(res, tuple):
+        return tuple(Tensor(r) for r in res)
+    return Tensor(res)
+
+
+def tolist(x):
+    return _to_t(x).tolist()
+
+
+import jax  # noqa: E402  (used by as_complex)

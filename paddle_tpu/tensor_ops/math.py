@@ -1,0 +1,312 @@
+"""Math ops (reference: python/paddle/tensor/math.py; PHI math kernels)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
+    "pow", "matmul", "sqrt", "rsqrt", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "abs", "neg", "sign", "sin", "cos", "tan", "sinh", "cosh", "tanh",
+    "asin", "acos", "atan", "atan2", "floor", "ceil", "round", "trunc", "clip",
+    "maximum", "minimum", "fmax", "fmin", "sum", "mean", "max", "min", "prod",
+    "cumsum", "cumprod", "std", "var", "square", "reciprocal", "erf", "add_n",
+    "logsumexp", "isnan", "isinf", "isfinite", "all", "any", "scale", "increment",
+    "dot", "outer", "inner", "multiplex", "logit", "lerp", "rad2deg", "deg2rad",
+    "amax", "amin", "nanmean", "nansum", "count_nonzero", "frac", "diff", "angle",
+    "stanh", "multiply_", "add_", "clip_", "scale_", "subtract_",
+]
+
+
+def _wrap2(name, f):
+    def op(x, y, name=None):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        if isinstance(y, Tensor):
+            return primitive_call(f, x, y, name=name)
+        if isinstance(y, (np.ndarray, list, tuple)):
+            return primitive_call(f, x, Tensor(y), name=name)
+        # python scalar: keep it static (jax weak-type promotion preserves x dtype)
+        return primitive_call(lambda a: f(a, y), x, name=name)
+
+    op.__name__ = name
+    return op
+
+
+add = _wrap2("add", lambda a, b: a + b)
+subtract = _wrap2("subtract", lambda a, b: a - b)
+multiply = _wrap2("multiply", lambda a, b: a * b)
+divide = _wrap2("divide", lambda a, b: a / b)
+floor_divide = _wrap2("floor_divide", lambda a, b: jnp.floor_divide(a, b))
+remainder = _wrap2("remainder", lambda a, b: jnp.remainder(a, b))
+mod = remainder
+maximum = _wrap2("maximum", jnp.maximum)
+minimum = _wrap2("minimum", jnp.minimum)
+fmax = _wrap2("fmax", jnp.fmax)
+fmin = _wrap2("fmin", jnp.fmin)
+atan2 = _wrap2("atan2", jnp.arctan2)
+
+
+def pow(x, y, name=None):
+    if isinstance(y, Tensor):
+        return primitive_call(jnp.power, x, y, name="elementwise_pow")
+    return primitive_call(lambda a: jnp.power(a, y), x, name="pow")
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return primitive_call(f, x, y, name="matmul")
+
+
+def _wrap1(name, f):
+    def op(x, name=None, **kw):
+        if not isinstance(x, Tensor):
+            x = Tensor(x)
+        return primitive_call(f, x, name=name)
+
+    op.__name__ = name
+    return op
+
+
+sqrt = _wrap1("sqrt", jnp.sqrt)
+rsqrt = _wrap1("rsqrt", lambda a: jax.lax.rsqrt(a))
+exp = _wrap1("exp", jnp.exp)
+expm1 = _wrap1("expm1", jnp.expm1)
+log = _wrap1("log", jnp.log)
+log2 = _wrap1("log2", jnp.log2)
+log10 = _wrap1("log10", jnp.log10)
+log1p = _wrap1("log1p", jnp.log1p)
+abs = _wrap1("abs", jnp.abs)
+neg = _wrap1("neg", jnp.negative)
+sign = _wrap1("sign", jnp.sign)
+sin = _wrap1("sin", jnp.sin)
+cos = _wrap1("cos", jnp.cos)
+tan = _wrap1("tan", jnp.tan)
+sinh = _wrap1("sinh", jnp.sinh)
+cosh = _wrap1("cosh", jnp.cosh)
+tanh = _wrap1("tanh", jnp.tanh)
+asin = _wrap1("asin", jnp.arcsin)
+acos = _wrap1("acos", jnp.arccos)
+atan = _wrap1("atan", jnp.arctan)
+floor = _wrap1("floor", jnp.floor)
+ceil = _wrap1("ceil", jnp.ceil)
+round = _wrap1("round", jnp.round)
+trunc = _wrap1("trunc", jnp.trunc)
+square = _wrap1("square", jnp.square)
+reciprocal = _wrap1("reciprocal", lambda a: 1.0 / a)
+erf = _wrap1("erf", jax.scipy.special.erf)
+isnan = _wrap1("isnan", jnp.isnan)
+isinf = _wrap1("isinf", jnp.isinf)
+isfinite = _wrap1("isfinite", jnp.isfinite)
+frac = _wrap1("frac", lambda a: a - jnp.trunc(a))
+rad2deg = _wrap1("rad2deg", jnp.rad2deg)
+deg2rad = _wrap1("deg2rad", jnp.deg2rad)
+angle = _wrap1("angle", jnp.angle)
+logit = _wrap1("logit", lambda a: jnp.log(a / (1 - a)))
+stanh = _wrap1("stanh", lambda a: 1.7159 * jnp.tanh(0.66667 * a))
+
+
+def clip(x, min=None, max=None, name=None):
+    return primitive_call(lambda a: jnp.clip(a, min, max), x, name="clip")
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.dtype import to_jax_dtype
+
+    return primitive_call(
+        lambda a: jnp.sum(a, axis=_axis(axis), dtype=to_jax_dtype(dtype), keepdims=keepdim),
+        x,
+        name="reduce_sum",
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return primitive_call(
+        lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x, name="reduce_mean"
+    )
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return primitive_call(
+        lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x, name="reduce_max"
+    )
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return primitive_call(
+        lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x, name="reduce_min"
+    )
+
+
+amax, amin = max, min
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    from ..core.dtype import to_jax_dtype
+
+    return primitive_call(
+        lambda a: jnp.prod(a, axis=_axis(axis), dtype=to_jax_dtype(dtype), keepdims=keepdim),
+        x,
+        name="reduce_prod",
+    )
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return primitive_call(lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return primitive_call(lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return primitive_call(
+        lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim).astype(jnp.int64), x
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a)
+        return jnp.cumsum(a, axis=int(axis))
+
+    return primitive_call(f, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return primitive_call(lambda a: jnp.cumprod(a, axis=dim), x, name="cumprod")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return primitive_call(
+        lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return primitive_call(
+        lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        x,
+        name="var",
+    )
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return primitive_call(lambda xs: jax.tree_util.tree_reduce(jnp.add, list(xs)), list(inputs), name="add_n")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return primitive_call(
+        lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim),
+        x,
+        name="logsumexp",
+    )
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return primitive_call(lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), x, name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return primitive_call(lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), x, name="any")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else scale
+
+    def f(a):
+        out = a * s + bias if bias_after_scale else (a + bias) * s
+        return out
+
+    return primitive_call(f, x, name="scale")
+
+
+def increment(x, value=1.0, name=None):
+    x._value = x._value + value
+    return x
+
+
+def dot(x, y, name=None):
+    return primitive_call(
+        lambda a, b: jnp.sum(a * b, axis=-1), x, y, name="dot"
+    )
+
+
+def outer(x, y, name=None):
+    return primitive_call(lambda a, b: jnp.outer(a, b), x, y, name="outer")
+
+
+def inner(x, y, name=None):
+    return primitive_call(lambda a, b: jnp.inner(a, b), x, y, name="inner")
+
+
+def multiplex(inputs, index, name=None):
+    def f(xs, idx):
+        stacked = jnp.stack(list(xs), axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32), axis=0
+        )[0]
+
+    return primitive_call(f, list(inputs), index, name="multiplex")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return primitive_call(lambda a, b, w: a + w * (b - a), x, y, weight, name="lerp")
+    return primitive_call(lambda a, b: a + weight * (b - a), x, y, name="lerp")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return primitive_call(lambda a: jnp.diff(a, n=n, axis=axis), x, name="diff")
+
+
+# -------- in-place variants (swap underlying buffer; paddle `op_` convention)
+def add_(x, y, name=None):
+    x._value = x._value + (y._value if isinstance(y, Tensor) else y)
+    return x
+
+
+def subtract_(x, y, name=None):
+    x._value = x._value - (y._value if isinstance(y, Tensor) else y)
+    return x
+
+
+def multiply_(x, y, name=None):
+    x._value = x._value * (y._value if isinstance(y, Tensor) else y)
+    return x
+
+
+def clip_(x, min=None, max=None, name=None):
+    x._value = jnp.clip(x._value, min, max)
+    return x
+
+
+def scale_(x, scale=1.0, bias=0.0, name=None):
+    x._value = x._value * scale + bias
+    return x

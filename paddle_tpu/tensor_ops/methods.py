@@ -1,0 +1,102 @@
+"""Install tensor methods & operators on Tensor.
+
+Reference analog: `python/paddle/tensor/__init__.py` monkey-patching +
+`fluid/dygraph/math_op_patch.py`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+from . import creation, linalg, logic, manipulation, math, random, search
+from .einsum import einsum  # noqa: F401
+
+
+def _norm_idx(item):
+    """Convert Tensor indices to arrays for jax indexing."""
+    if isinstance(item, tuple):
+        return tuple(_norm_idx(i) for i in item)
+    if isinstance(item, Tensor):
+        v = item._value
+        if v.dtype == jnp.bool_:
+            return np.asarray(v)  # boolean mask → host (dynamic shape)
+        return v.astype(jnp.int32)
+    if isinstance(item, (list, np.ndarray)):
+        arr = np.asarray(item)
+        return arr
+    return item
+
+
+def _getitem(self, item):
+    idx = _norm_idx(item)
+
+    def has_bool(i):
+        if isinstance(i, tuple):
+            return any(has_bool(x) for x in i)
+        return isinstance(i, np.ndarray) and i.dtype == np.bool_
+
+    if has_bool(idx):
+        return Tensor(np.asarray(self._value)[idx])
+    return primitive_call(lambda a: a[idx], self, name="getitem")
+
+
+def _setitem(self, item, value):
+    idx = _norm_idx(item)
+    v = value._value if isinstance(value, Tensor) else value
+    self._value = self._value.at[idx].set(jnp.asarray(v, dtype=self._value.dtype))
+
+
+_BINOPS = {
+    "__add__": math.add,
+    "__radd__": lambda x, y: math.add(x, y),
+    "__sub__": math.subtract,
+    "__rsub__": lambda x, y: primitive_call(lambda a: y - a, x, name="rsub"),
+    "__mul__": math.multiply,
+    "__rmul__": lambda x, y: math.multiply(x, y),
+    "__truediv__": math.divide,
+    "__rtruediv__": lambda x, y: primitive_call(lambda a: y / a, x, name="rdiv"),
+    "__floordiv__": math.floor_divide,
+    "__mod__": math.remainder,
+    "__pow__": math.pow,
+    "__rpow__": lambda x, y: math.pow(Tensor(y), x),
+    "__matmul__": math.matmul,
+    "__eq__": logic.equal,
+    "__ne__": logic.not_equal,
+    "__lt__": logic.less_than,
+    "__le__": logic.less_equal,
+    "__gt__": logic.greater_than,
+    "__ge__": logic.greater_equal,
+    "__and__": logic.logical_and,
+    "__or__": logic.logical_or,
+    "__xor__": logic.logical_xor,
+}
+
+_METHODS = {}
+for mod in (creation, math, manipulation, logic, search, linalg, random):
+    for name in getattr(mod, "__all__", []):
+        fn = getattr(mod, name)
+        if callable(fn):
+            _METHODS[name] = fn
+
+
+def install():
+    for name, fn in _BINOPS.items():
+        setattr(Tensor, name, fn)
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = logic.logical_not
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    skip = {"to_tensor"}
+    for name, fn in _METHODS.items():
+        if name in skip or hasattr(Tensor, name):
+            continue
+        setattr(Tensor, name, fn)
+    # method-name aliases matching paddle Tensor API
+    Tensor.mm = math.matmul
+    Tensor.dim = lambda self: self.ndim
+    Tensor.rank = lambda self: Tensor(np.asarray(self.ndim, dtype=np.int32))
+    Tensor.numel = lambda self: self.size
+    Tensor.element_size = lambda self: np.dtype(np.asarray(self._value).dtype).itemsize

@@ -1,0 +1,113 @@
+"""Random ops — counter-based threefry (reference: python/paddle/tensor/random.py).
+
+Every draw consumes a key from the RNG context (`core/rng.py`): stateful in eager
+mode, functionally derived from the per-step base key under tracing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import get_default_dtype, to_jax_dtype
+from ..core.rng import next_rng_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "bernoulli", "multinomial", "randperm", "poisson",
+    "uniform_", "normal_", "exponential_",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def _dt(dtype):
+    return to_jax_dtype(dtype or get_default_dtype())
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(next_rng_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(next_rng_key(), _shape(shape), _dt(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(next_rng_key(), _shape(shape), low, high, to_jax_dtype(dtype))
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = to_jax_dtype(dtype) if dtype else x._value.dtype
+    return Tensor(jax.random.randint(next_rng_key(), x._value.shape, low, high, dt))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype), min, max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(next_rng_key(), shp) * s + m)
+    return Tensor(jax.random.normal(next_rng_key(), _shape(shape)) * std + mean)
+
+
+def bernoulli(x, name=None):
+    return Tensor(
+        jax.random.bernoulli(next_rng_key(), x._value, x._value.shape).astype(x._value.dtype)
+    )
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = next_rng_key()
+    logits = jnp.log(jnp.maximum(x._value, 1e-30))
+    if replacement:
+        out = jax.random.categorical(key, logits, axis=-1, shape=(*logits.shape[:-1], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, logits.shape)
+        out = jax.lax.top_k(logits + g, num_samples)[1]
+    return Tensor(out.astype(jnp.int64))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_rng_key(), n).astype(to_jax_dtype(dtype)))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(next_rng_key(), x._value).astype(x._value.dtype))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.key(seed) if seed else next_rng_key()
+    x._value = jax.random.uniform(key, x._value.shape, x._value.dtype, min, max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = jax.random.normal(next_rng_key(), x._value.shape, x._value.dtype) * std + mean
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = jax.random.exponential(next_rng_key(), x._value.shape, x._value.dtype) / lam
+    return x

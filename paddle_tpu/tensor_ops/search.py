@@ -1,0 +1,124 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "nonzero", "kthvalue",
+           "mode", "index_sample", "searchsorted", "median"]
+
+
+def _to_t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import to_jax_dtype
+
+    return primitive_call(
+        lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(to_jax_dtype(dtype)),
+        _to_t(x).detach(),
+    )
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    from ..core.dtype import to_jax_dtype
+
+    return primitive_call(
+        lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(to_jax_dtype(dtype)),
+        _to_t(x).detach(),
+    )
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis)
+        return jnp.flip(idx, axis=axis).astype(jnp.int64) if descending else idx.astype(jnp.int64)
+
+    return primitive_call(f, _to_t(x).detach())
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        return jnp.flip(s, axis=axis) if descending else s
+
+    return primitive_call(f, _to_t(x))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(k.item()) if isinstance(k, Tensor) else int(k)
+
+    def f(a):
+        ax = axis if axis >= 0 else axis + a.ndim
+        if ax != a.ndim - 1:
+            a_m = jnp.moveaxis(a, ax, -1)
+        else:
+            a_m = a
+        vals, idx = jax.lax.top_k(a_m if largest else -a_m, k)
+        if not largest:
+            vals = -vals
+        if ax != a.ndim - 1:
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return primitive_call(f, _to_t(x))
+
+
+def nonzero(x, as_tuple=False):
+    res = np.nonzero(np.asarray(_to_t(x)._value))
+    if as_tuple:
+        return tuple(Tensor(r.reshape(-1, 1)) for r in res)
+    return Tensor(np.stack(res, axis=1).astype(np.int64))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+
+    return primitive_call(f, _to_t(x))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    xv = np.asarray(_to_t(x)._value)
+    from scipy import stats  # pragma: no cover - scipy baked in with jax
+
+    m = stats.mode(xv, axis=axis, keepdims=keepdim)
+    return Tensor(m.mode), Tensor(m.count.astype(np.int64))
+
+
+def index_sample(x, index):
+    return primitive_call(
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+        _to_t(x),
+        _to_t(index),
+    )
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    return primitive_call(
+        lambda s, v: jnp.searchsorted(s, v, side=side).astype(
+            jnp.int32 if out_int32 else jnp.int64
+        ),
+        _to_t(sorted_sequence).detach(),
+        _to_t(values).detach(),
+    )
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return primitive_call(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), _to_t(x))
+
+
+import jax  # noqa: E402

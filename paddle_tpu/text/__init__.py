@@ -1,0 +1,5 @@
+"""paddle.text + model zoo for NLP (reference: python/paddle/text/ + the fleet GPT
+fixtures, tests/unittests/auto_parallel_gpt_model.py)."""
+from . import datasets  # noqa: F401
+from .bert import BertModel, BertForSequenceClassification, BertForPretraining  # noqa: F401
+from .gpt import GPTModel, GPTForCausalLM, GPTConfig  # noqa: F401

@@ -1,0 +1,39 @@
+"""Text datasets — synthetic LM corpora for the zero-egress environment."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+
+class SyntheticLMDataset(Dataset):
+    """Deterministic Zipf-ish token stream for LM training/benchmarks."""
+
+    def __init__(self, vocab_size=50304, seq_len=1024, size=4096, seed=0):
+        self.vocab_size = vocab_size
+        self.seq_len = seq_len
+        self.size = size
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        # zipf-distributed tokens clipped to vocab
+        toks = rng.zipf(1.3, self.seq_len + 1)
+        toks = np.minimum(toks, self.vocab_size - 1).astype(np.int64)
+        return toks[:-1], toks[1:]
+
+    def __len__(self):
+        return self.size
+
+
+class Imdb(Dataset):
+    def __init__(self, mode="train", cutoff=150, size=2048):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        self._x = rng.randint(0, 5000, (size, 128)).astype(np.int64)
+        self._y = rng.randint(0, 2, size).astype(np.int64)
+
+    def __getitem__(self, idx):
+        return self._x[idx], self._y[idx]
+
+    def __len__(self):
+        return len(self._y)

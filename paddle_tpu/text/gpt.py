@@ -1,0 +1,167 @@
+"""GPT model family — the flagship (reference fixture: python/paddle/fluid/tests/
+unittests/auto_parallel_gpt_model.py; fleet GPT entrypoints).
+
+Written with framework layers only. Distributed execution does NOT rewrite this
+model: fleet.distributed_model() attaches GSPMD PartitionSpecs to its parameters
+(qkv/ffn column-sharded, proj row-sharded on the 'mp' axis — Megatron layout) and
+pjit inserts the collectives. That is the TPU-native answer to the reference's
+ColumnParallelLinear/RowParallelLinear program surgery.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden: int = 0  # 0 -> 4*hidden
+    max_seq_len: int = 1024
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = True
+
+    def __post_init__(self):
+        if not self.ffn_hidden:
+            self.ffn_hidden = 4 * self.hidden_size
+
+
+_PRESETS = {
+    "gpt3-125m": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "gpt3-350m": dict(hidden_size=1024, num_layers=24, num_heads=16),
+    "gpt3-1.3b": dict(hidden_size=2048, num_layers=24, num_heads=16),
+    "gpt3-2.7b": dict(hidden_size=2560, num_layers=32, num_heads=32),
+    "gpt3-6.7b": dict(hidden_size=4096, num_layers=32, num_heads=32),
+    "gpt3-13b": dict(hidden_size=5120, num_layers=40, num_heads=40),
+}
+
+
+def gpt_config(preset: str, **overrides) -> GPTConfig:
+    cfg = dict(_PRESETS[preset])
+    cfg.update(overrides)
+    return GPTConfig(**cfg)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.num_heads = cfg.num_heads
+        self.head_dim = h // cfg.num_heads
+        self.qkv_proj = nn.Linear(h, 3 * h, weight_attr=attr)
+        self.out_proj = nn.Linear(h, h, weight_attr=attr)
+        self.dropout = cfg.dropout
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)
+        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3, B, H, S, D
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            is_causal=attn_mask is None, training=self.training,
+        )
+        out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
+        return self.out_proj(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        attr = nn.ParamAttr(initializer=init)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.ffn_hidden, weight_attr=attr)
+        self.fc2 = nn.Linear(cfg.ffn_hidden, cfg.hidden_size, weight_attr=attr)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.dropout(self.attn(self.ln1(x), attn_mask))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(cfg.dropout)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None):
+        import paddle_tpu as P
+
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = P.arange(s, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(position_ids)
+        x = self.drop(x)
+        for blk in self.blocks:
+            x = blk(x, attn_mask)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attn_mask=None):
+        h = self.gpt(input_ids, attn_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(h)
+        else:
+            from ..tensor_ops.math import matmul
+
+            logits = matmul(h, self.gpt.wte.weight, transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.cfg.vocab_size]), labels.reshape([-1])
+            )
+            return loss
+        return logits
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+    def flops_per_token(self) -> float:
+        """Approximate training FLOPs/token (6*N + attention), for MFU accounting."""
+        c = self.cfg
+        n = self.num_params()
+        attn = 6 * c.num_layers * c.hidden_size * c.max_seq_len  # 2*2*L*h*s fw+bw-ish
+        return 6.0 * n + attn

@@ -1,0 +1,2 @@
+from . import flags  # noqa: F401
+from .misc import try_import, unique_name  # noqa: F401

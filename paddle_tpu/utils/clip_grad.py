@@ -1,0 +1,68 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py).
+
+Clips operate functionally on (param, grad) array pairs so they compose into the
+jitted train step; the global-norm variant is the one HybridParallelOptimizer
+reduces across mesh axes (reference: fleet/utils/hybrid_parallel_util.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def apply(self, grads: list, params: list) -> list:
+        """grads/params: lists of jax arrays. Returns clipped grad arrays."""
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # paddle-style [(param, grad)] interface
+        params = [p for p, _ in params_grads]
+        grads = [g for _, g in params_grads]
+        out = self.apply(grads, params)
+        return list(zip(params, out))
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply(self, grads, params):
+        return [None if g is None else jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads, params):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            n = jnp.sqrt(jnp.sum(g.astype(jnp.float32) ** 2))
+            scale = jnp.where(n > self.clip_norm, self.clip_norm / (n + 1e-12), 1.0)
+            out.append((g * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        # set by hybrid-parallel optimizer: extra psum over mesh axes for the
+        # squared-norm (mp/pp-sharded params)
+        self._norm_reduce_fn = None
+
+    def apply(self, grads, params):
+        sq = [jnp.sum(g.astype(jnp.float32) ** 2) for g in grads if g is not None]
+        if not sq:
+            return grads
+        total = jnp.sum(jnp.stack(sq))
+        if self._norm_reduce_fn is not None:
+            total = self._norm_reduce_fn(total)
+        gnorm = jnp.sqrt(total)
+        scale = jnp.minimum(1.0, self.clip_norm / (gnorm + 1e-6))
+        return [None if g is None else (g * scale).astype(g.dtype) for g in grads]

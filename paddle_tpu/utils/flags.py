@@ -1,0 +1,51 @@
+"""Global flag registry (reference analog: paddle/fluid/platform/flags.cc gflags;
+python/paddle/fluid/framework.py set_flags/get_flags). Flags also readable from
+FLAGS_* environment variables."""
+from __future__ import annotations
+
+import os
+
+_FLAGS: dict[str, object] = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_allocator_strategy": "xla_bfc",
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_use_pallas_kernels": True,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_jit_donate_buffers": True,
+}
+
+
+def _coerce(cur, new):
+    if isinstance(cur, bool):
+        return str(new).lower() in ("1", "true", "yes") if not isinstance(new, bool) else new
+    if isinstance(cur, float):
+        return float(new)
+    if isinstance(cur, int):
+        return int(new)
+    return new
+
+
+for _k in list(_FLAGS):
+    if _k in os.environ:
+        _FLAGS[_k] = _coerce(_FLAGS[_k], os.environ[_k])
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return dict(_FLAGS)
+    if isinstance(flags, str):
+        return {flags: _FLAGS[flags]}
+    return {f: _FLAGS[f] for f in flags}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _FLAGS:
+            _FLAGS[k] = v
+        else:
+            _FLAGS[k] = _coerce(_FLAGS[k], v)
+
+
+def flag(name, default=None):
+    return _FLAGS.get(name, default)

@@ -1,0 +1,32 @@
+from __future__ import annotations
+
+import importlib
+import itertools
+import threading
+
+
+def try_import(name: str):
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(f"optional dependency {name!r} is not available") from e
+
+
+class _UniqueNameGenerator:
+    """reference: python/paddle/fluid/unique_name.py"""
+
+    def __init__(self):
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    def generate(self, prefix: str = "tmp") -> str:
+        with self._lock:
+            c = self._counters.setdefault(prefix, itertools.count())
+            return f"{prefix}_{next(c)}"
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+
+
+unique_name = _UniqueNameGenerator()

@@ -1,0 +1,6 @@
+"""Vision models (reference: python/paddle/vision/models/)."""
+from .lenet import LeNet  # noqa: F401
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+from .mobilenet import MobileNetV2, mobilenet_v2  # noqa: F401
+from .vgg import VGG, vgg16, vgg19  # noqa: F401
+from .alexnet import AlexNet, alexnet  # noqa: F401

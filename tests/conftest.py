@@ -1,0 +1,25 @@
+"""Test conftest: force an 8-device CPU mesh before jax initializes.
+
+Mirrors the reference's device-backend test strategy (survey §4): CPU-parity
+op tests + multi-device tests on a virtual mesh without real chips.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(102)
+    np.random.seed(102)
+    yield

@@ -1,0 +1,135 @@
+"""Eager autograd tape tests (reference: eager-mode grad checks; the analytic-vs-
+finite-difference method of op_test.py check_grad)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_and_accumulate():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 3).sum()
+    y.backward()
+    z = (x * 2).sum()
+    z.backward()
+    assert np.allclose(x.grad.numpy(), [5.0, 5.0])  # 3 + 2 accumulated
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_matmul_grad_matches_fd():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+    ta = paddle.to_tensor(a, stop_gradient=False)
+    tb = paddle.to_tensor(b, stop_gradient=False)
+    loss = (ta @ tb).sum()
+    loss.backward()
+    # analytic: dL/da = ones @ b.T
+    assert np.allclose(ta.grad.numpy(), np.ones((3, 2)) @ b.T, rtol=1e-5)
+    assert np.allclose(tb.grad.numpy(), a.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = (x * y).sum()
+    z.backward()
+    assert np.allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    d = (x * 2).detach()
+    assert d.stop_gradient
+    z = (x + d).sum()
+    z.backward()
+    assert np.allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y._tape_node is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    a = x * 3
+    b = x * 4
+    y = (a + b).sum()
+    y.backward()
+    assert np.allclose(x.grad.numpy(), [7.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.random.rand(4, 6).astype(np.float32), stop_gradient=False)
+    v, i = paddle.topk(x, 2, axis=1)
+    v.sum().backward()
+    g = x.grad.numpy()
+    assert g.sum() == pytest.approx(8.0)  # 2 per row * 4 rows
+    assert ((g == 0) | (g == 1)).all()
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x
+    (g,) = paddle.grad(y, x)
+    assert np.allclose(g.numpy(), [6.0])
+
+
+def test_softmax_cross_entropy_grad():
+    logits = paddle.to_tensor(np.random.rand(5, 10).astype(np.float32), stop_gradient=False)
+    labels = paddle.to_tensor(np.random.randint(0, 10, (5,)))
+    loss = paddle.nn.functional.cross_entropy(logits, labels)
+    loss.backward()
+    g = logits.grad.numpy()
+    # gradient rows sum to zero (softmax CE property)
+    assert np.allclose(g.sum(axis=1), 0.0, atol=1e-5)
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).sum()
+    y.backward(retain_graph=True)
+    y.backward(retain_graph=False)
+    assert np.allclose(x.grad.numpy(), [4.0])
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    assert np.allclose(y.numpy(), [6.0])
+    assert np.allclose(x.grad.numpy(), [2.0])
+
+
+def test_higher_shape_broadcast_grad():
+    x = paddle.to_tensor(np.random.rand(3, 1).astype(np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.random.rand(1, 4).astype(np.float32), stop_gradient=False)
+    y = (x + b).sum()
+    y.backward()
+    assert x.grad.shape == [3, 1]
+    assert np.allclose(x.grad.numpy(), 4.0)
+    assert b.grad.shape == [1, 4]
+    assert np.allclose(b.grad.numpy(), 3.0)

@@ -1,0 +1,71 @@
+"""M1 milestone: LeNet on (synthetic) MNIST via paddle.Model.fit converges.
+
+Reference config: BASELINE.json configs[0] — 'MNIST LeNet via paddle.Model.fit'.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import Model, nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+from paddle_tpu.vision.transforms import Normalize
+
+
+def test_lenet_mnist_convergence():
+    transform = Normalize(mean=[127.5], std=[127.5])
+    train = MNIST(mode="train", transform=transform, synthetic_size=512)
+    test = MNIST(mode="test", transform=transform, synthetic_size=128)
+
+    model = Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+
+    model.fit(train, epochs=2, batch_size=64, verbose=0)
+    res = model.evaluate(test, batch_size=64, verbose=0)
+    # synthetic blobs are separable: should be well above chance after 2 epochs
+    assert res["acc"] > 0.5, res
+
+
+def test_model_save_load(tmp_path):
+    model = Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    x = np.random.rand(4, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, (4, 1))
+    model.train_batch([x], [y])
+    p = str(tmp_path / "ckpt")
+    model.save(p)
+
+    model2 = Model(LeNet())
+    opt2 = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model2.parameters())
+    model2.prepare(opt2, nn.CrossEntropyLoss())
+    model2.load(p)
+    sd1 = model.network.state_dict()
+    sd2 = model2.network.state_dict()
+    for k in sd1:
+        assert np.allclose(sd1[k].numpy(), sd2[k].numpy()), k
+
+
+def test_train_batch_reduces_loss():
+    model = Model(LeNet())
+    opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
+    model.prepare(opt, nn.CrossEntropyLoss())
+    x = np.random.rand(32, 1, 28, 28).astype(np.float32)
+    y = np.random.randint(0, 10, (32, 1))
+    losses = [model.train_batch([x], [y])[0] for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_predict():
+    model = Model(LeNet())
+    model.prepare(None, None)
+    x = np.random.rand(4, 1, 28, 28).astype(np.float32)
+    out = model.predict_batch([paddle.to_tensor(x)])
+    assert out.shape == [4, 10]
+
+
+def test_summary():
+    info = paddle.summary(LeNet())
+    assert info["total_params"] > 60000

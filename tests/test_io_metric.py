@@ -1,0 +1,127 @@
+"""DataLoader / Dataset / metric / save-load tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (BatchSampler, DataLoader, Dataset, DistributedBatchSampler,
+                           TensorDataset)
+
+
+class RangeDS(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.full((3,), i, dtype=np.float32), np.asarray(i, dtype=np.int64)
+
+    def __len__(self):
+        return self.n
+
+
+def test_dataloader_basic():
+    dl = DataLoader(RangeDS(20), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == [4, 3]
+    assert y.shape == [4]
+    assert np.allclose(x.numpy()[:, 0], y.numpy())
+
+
+def test_dataloader_shuffle_drop_last():
+    dl = DataLoader(RangeDS(10), batch_size=3, shuffle=True, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    all_idx = np.concatenate([b[1].numpy() for b in batches])
+    assert len(set(all_idx.tolist())) == 9
+
+
+def test_dataloader_workers():
+    dl = DataLoader(RangeDS(32), batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 8
+    # order preserved despite threading
+    assert batches[0][1].numpy()[0] == 0
+    assert batches[7][1].numpy()[-1] == 31
+
+
+def test_tensor_dataset_and_random_split():
+    from paddle_tpu.io import random_split
+
+    x = paddle.randn([10, 4])
+    y = paddle.arange(10)
+    ds = TensorDataset([x, y])
+    assert len(ds) == 10
+    a, b = random_split(ds, [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_distributed_batch_sampler():
+    ds = RangeDS(20)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == 5 and len(i1) == 5
+    assert not set(i0) & set(i1)
+
+
+def test_accuracy_metric():
+    from paddle_tpu.metric import Accuracy
+
+    m = Accuracy()
+    pred = paddle.to_tensor([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = paddle.to_tensor([1, 0, 0])
+    correct = m.compute(pred, label)
+    m.update(correct)
+    assert m.accumulate() == pytest.approx(2 / 3)
+
+
+def test_precision_recall_auc():
+    from paddle_tpu.metric import Auc, Precision, Recall
+
+    preds = np.asarray([0.9, 0.8, 0.2, 0.1])
+    labels = np.asarray([1, 0, 1, 0])
+    p = Precision()
+    p.update(preds, labels)
+    assert p.accumulate() == pytest.approx(0.5)
+    r = Recall()
+    r.update(preds, labels)
+    assert r.accumulate() == pytest.approx(0.5)
+    a = Auc()
+    a.update(np.asarray([0.9, 0.7, 0.3, 0.1]), np.asarray([1, 1, 0, 0]))
+    assert a.accumulate() == pytest.approx(1.0, abs=0.01)
+
+
+def test_save_load_roundtrip(tmp_path):
+    from paddle_tpu import nn
+
+    m = nn.Linear(4, 3)
+    path = str(tmp_path / "model.pdparams")
+    paddle.save(m.state_dict(), path)
+    sd = paddle.load(path)
+    assert np.allclose(sd["weight"].numpy(), m.weight.numpy())
+    m2 = nn.Linear(4, 3)
+    m2.set_state_dict(sd)
+    assert np.allclose(m2.weight.numpy(), m.weight.numpy())
+
+
+def test_save_load_nested(tmp_path):
+    obj = {"a": paddle.to_tensor([1.0, 2.0]), "b": [paddle.ones([2]), {"c": 3}],
+           "scalar": 5}
+    path = str(tmp_path / "obj.pd")
+    paddle.save(obj, path)
+    back = paddle.load(path)
+    assert np.allclose(back["a"].numpy(), [1, 2])
+    assert back["b"][1]["c"] == 3
+    assert back["scalar"] == 5
+
+
+def test_bfloat16_save_load(tmp_path):
+    t = paddle.to_tensor([1.5, 2.5], dtype="bfloat16")
+    path = str(tmp_path / "bf16.pd")
+    paddle.save({"t": t}, path)
+    back = paddle.load(path)
+    assert back["t"].dtype == "bfloat16"

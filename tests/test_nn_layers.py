@@ -1,0 +1,214 @@
+"""nn layer tests (reference: per-layer unittests in tests/unittests/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear():
+    l = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    y = l(x)
+    assert y.shape == [2, 3]
+    ref = x.numpy() @ l.weight.numpy() + l.bias.numpy()
+    assert np.allclose(y.numpy(), ref, rtol=1e-5)
+
+
+def test_conv2d_shape_and_value():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = conv(x)
+    assert y.shape == [2, 8, 16, 16]
+    conv_s = nn.Conv2D(3, 8, 3, stride=2)
+    assert conv_s(x).shape == [2, 8, 7, 7]
+    # depthwise
+    dw = nn.Conv2D(8, 8, 3, padding=1, groups=8)
+    assert dw(y).shape == [2, 8, 16, 16]
+
+
+def test_conv2d_matches_manual():
+    conv = nn.Conv2D(1, 1, 2, bias_attr=False)
+    x = paddle.to_tensor(np.arange(9, dtype=np.float32).reshape(1, 1, 3, 3))
+    w = conv.weight.numpy()[0, 0]
+    y = conv(x).numpy()[0, 0]
+    a = x.numpy()[0, 0]
+    ref = np.array([[np.sum(a[i:i+2, j:j+2] * w) for j in range(2)] for i in range(2)])
+    assert np.allclose(y, ref, rtol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = paddle.randn([8, 4, 5, 5]) * 3 + 1
+    bn.train()
+    y = bn(x)
+    yv = y.numpy()
+    assert abs(yv.mean()) < 1e-4
+    assert abs(yv.std() - 1) < 1e-2
+    # running stats updated
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == [8, 4, 5, 5]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8]) * 5 + 2
+    y = ln(x).numpy()
+    assert np.allclose(y.mean(-1), 0, atol=1e-4)
+    assert np.allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 6)
+    idx = paddle.to_tensor([[1, 2], [3, 4]])
+    y = emb(idx)
+    assert y.shape == [2, 2, 6]
+    assert np.allclose(y.numpy()[0, 0], emb.weight.numpy()[1])
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    kept = (y.numpy() != 0).mean()
+    assert 0.3 < kept < 0.7
+    assert np.allclose(y.numpy()[y.numpy() != 0], 2.0)  # upscale_in_train
+    d.eval()
+    assert np.allclose(d(x).numpy(), 1.0)
+
+
+def test_pools():
+    x = paddle.randn([2, 3, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [2, 3, 1, 1]
+    ref = x.numpy().mean((2, 3), keepdims=True)
+    assert np.allclose(nn.AdaptiveAvgPool2D((1, 1))(x).numpy(), ref, rtol=1e-5)
+
+
+def test_activations():
+    x = paddle.to_tensor([-2.0, 0.0, 2.0])
+    assert np.allclose(nn.ReLU()(x).numpy(), [0, 0, 2])
+    assert np.allclose(nn.Sigmoid()(x).numpy(), 1 / (1 + np.exp([2.0, 0, -2])), rtol=1e-5)
+    assert nn.GELU()(x).shape == [3]
+    assert np.allclose(nn.LeakyReLU(0.1)(x).numpy(), [-0.2, 0, 2], rtol=1e-5)
+    sm = nn.Softmax()(paddle.randn([2, 5]))
+    assert np.allclose(sm.numpy().sum(-1), 1.0, rtol=1e-5)
+
+
+def test_sequential_and_containers():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    assert m(x).shape == [3, 2]
+    assert len(m) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll[0].parameters())) == 2
+
+
+def test_state_dict_roundtrip():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = m.state_dict()
+    assert len(sd) == 4
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m2.set_state_dict(sd)
+    for (k1, v1), (k2, v2) in zip(m.state_dict().items(), m2.state_dict().items()):
+        assert np.allclose(v1.numpy(), v2.numpy())
+
+
+def test_parameters_traversal():
+    m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["0.weight", "0.bias", "1.weight", "1.bias"]
+    assert len(m.parameters()) == 4
+
+
+def test_layer_backward_through_model():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    loss = m(x).sum()
+    loss.backward()
+    for p in m.parameters():
+        assert p.grad is not None, p.name
+        assert p.grad.shape == p.shape
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    y = mha(x, x, x)
+    assert y.shape == [2, 5, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    assert enc(x).shape == [2, 5, 16]
+    loss = enc(x).sum()
+    loss.backward()
+
+
+def test_lstm():
+    lstm = nn.LSTM(4, 8, num_layers=2)
+    x = paddle.randn([3, 6, 4])  # [batch, time, feat]
+    out, (h, c) = lstm(x)
+    assert out.shape == [3, 6, 8]
+    assert h.shape == [2, 3, 8]
+    assert c.shape == [2, 3, 8]
+    out.sum().backward()
+
+
+def test_gru_bidirectional():
+    gru = nn.GRU(4, 8, direction="bidirectional")
+    x = paddle.randn([2, 5, 4])
+    out, h = gru(x)
+    assert out.shape == [2, 5, 16]
+    assert h.shape == [2, 2, 8]
+
+
+def test_losses():
+    logits = paddle.randn([4, 10])
+    labels = paddle.to_tensor([1, 2, 3, 4])
+    loss = nn.CrossEntropyLoss()(logits, labels)
+    assert loss.shape == []
+    ref = -np.log(np.exp(logits.numpy())[np.arange(4), labels.numpy()]
+                  / np.exp(logits.numpy()).sum(1))
+    assert np.allclose(float(loss), ref.mean(), rtol=1e-5)
+    assert nn.MSELoss()(paddle.randn([3]), paddle.randn([3])).shape == []
+    x = paddle.rand([4])
+    y = paddle.to_tensor([0.0, 1.0, 0.0, 1.0])
+    assert float(nn.BCELoss()(x, y)) > 0
+
+
+def test_functional_interpolate():
+    x = paddle.randn([1, 3, 4, 4])
+    y = F.interpolate(x, scale_factor=2, mode="nearest")
+    assert y.shape == [1, 3, 8, 8]
+
+
+def test_initializers():
+    from paddle_tpu.nn import initializer as I
+
+    w = I.XavierUniform()((100, 100), "float32")
+    limit = np.sqrt(6 / 200)
+    assert abs(np.asarray(w)).max() <= limit + 1e-6
+    w = I.KaimingNormal()((64, 32), "float32")
+    assert abs(np.asarray(w).std() - np.sqrt(2 / 64)) < 0.02
+    w = I.Constant(3.0)((5,), "float32")
+    assert np.allclose(np.asarray(w), 3.0)
+
+
+def test_weight_attr_and_custom_init():
+    attr = nn.ParamAttr(initializer=nn.initializer.Constant(0.5), learning_rate=0.1)
+    l = nn.Linear(3, 3, weight_attr=attr)
+    assert np.allclose(l.weight.numpy(), 0.5)
+    assert l.weight.optimize_attr["learning_rate"] == 0.1
+    l2 = nn.Linear(3, 3, bias_attr=False)
+    assert l2.bias is None

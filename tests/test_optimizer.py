@@ -1,0 +1,158 @@
+"""Optimizer numeric tests (closed-form references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer as opt
+
+
+def _make_param(val):
+    p = nn.Parameter(np.asarray(val, dtype=np.float32))
+    return p
+
+
+def _set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, dtype=np.float32))
+
+
+def test_sgd_step():
+    p = _make_param([1.0, 2.0])
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    _set_grad(p, [1.0, 1.0])
+    o.step()
+    assert np.allclose(p.numpy(), [0.9, 1.9])
+
+
+def test_momentum():
+    p = _make_param([1.0])
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    _set_grad(p, [1.0])
+    o.step()
+    assert np.allclose(p.numpy(), [0.9])  # v=1, p-=0.1*1
+    _set_grad(p, [1.0])
+    o.step()
+    # v = 0.9*1 + 1 = 1.9; p = 0.9 - 0.19
+    assert np.allclose(p.numpy(), [0.71], atol=1e-6)
+
+
+def test_adam_first_step_is_lr():
+    p = _make_param([1.0])
+    o = opt.Adam(learning_rate=0.01, parameters=[p])
+    _set_grad(p, [0.5])
+    o.step()
+    # bias-corrected first step ≈ lr * sign(g)
+    assert np.allclose(p.numpy(), [1.0 - 0.01], atol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = _make_param([1.0])
+    o = opt.AdamW(learning_rate=0.01, weight_decay=0.1, parameters=[p])
+    _set_grad(p, [0.0])
+    o.step()
+    # grad 0: only decay 1*(1-0.01*0.1) then adam update ~0
+    assert np.allclose(p.numpy(), [0.999], atol=1e-5)
+
+
+def test_weight_decay_l2_coupled():
+    p = _make_param([1.0])
+    o = opt.SGD(learning_rate=0.1, weight_decay=0.5, parameters=[p])
+    _set_grad(p, [0.0])
+    o.step()
+    # g_eff = 0 + 0.5*1; p = 1 - 0.1*0.5
+    assert np.allclose(p.numpy(), [0.95])
+
+
+def test_grad_clip_global_norm():
+    p1, p2 = _make_param([3.0]), _make_param([4.0])
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=[p1, p2], grad_clip=clip)
+    _set_grad(p1, [3.0])
+    _set_grad(p2, [4.0])
+    o.step()
+    # gnorm=5 -> scale 0.2 -> grads 0.6, 0.8
+    assert np.allclose(p1.numpy(), [2.4], atol=1e-5)
+    assert np.allclose(p2.numpy(), [3.2], atol=1e-5)
+
+
+def test_multi_precision_master_weights():
+    p = nn.Parameter(np.asarray([1.0], dtype=np.float32))
+    p._value = p._value.astype("bfloat16")
+    o = opt.Adam(learning_rate=0.01, parameters=[p], multi_precision=True)
+    _set_grad(p, [0.5])
+    o.step()
+    slots = o._slots[id(p)]
+    assert "master_weight" in slots
+    assert str(slots["master_weight"].dtype) == "float32"
+    assert p.dtype == "bfloat16"
+
+
+def test_lr_schedulers():
+    s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(round(s(), 5))
+        s.step()
+    assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    w = opt.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    assert w() == pytest.approx(0.0)
+    for _ in range(4):
+        w.step()
+    assert w() == pytest.approx(0.1)
+
+    c = opt.lr.CosineAnnealingDecay(0.1, T_max=10)
+    c.step(10)
+    assert c() == pytest.approx(0.0, abs=1e-6)
+
+
+def test_scheduler_in_optimizer():
+    p = _make_param([1.0])
+    sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.01)
+
+
+def test_functional_update_matches_eager():
+    pv = np.random.rand(4).astype(np.float32)
+    gv = np.random.rand(4).astype(np.float32)
+    # eager
+    p = _make_param(pv.copy())
+    o = opt.Adam(learning_rate=0.01, parameters=[p])
+    _set_grad(p, gv)
+    o.step()
+    # functional
+    o2 = opt.Adam(learning_rate=0.01)
+    import jax.numpy as jnp
+
+    params = {"w": jnp.asarray(pv)}
+    st = o2.functional_init(params)
+    new_p, _ = o2.functional_update(params, {"w": jnp.asarray(gv)}, st)
+    assert np.allclose(p.numpy(), np.asarray(new_p["w"]), atol=1e-6)
+
+
+def test_state_dict_roundtrip():
+    p = _make_param([1.0, 2.0])
+    p.name = "w0"
+    o = opt.Adam(learning_rate=0.01, parameters=[p])
+    _set_grad(p, [0.1, 0.2])
+    o.step()
+    sd = o.state_dict()
+    o2 = opt.Adam(learning_rate=0.01, parameters=[p])
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    assert np.allclose(
+        np.asarray(o2._slots[id(p)]["moment1"]), np.asarray(o._slots[id(p)]["moment1"])
+    )
+
+
+def test_lamb_and_lars_run():
+    for cls in (opt.Lamb, opt.LarsMomentum, opt.RMSProp, opt.Adagrad, opt.Adadelta,
+                opt.Adamax):
+        p = _make_param(np.random.rand(3).astype(np.float32))
+        o = cls(learning_rate=0.01, parameters=[p])
+        before = p.numpy().copy()
+        _set_grad(p, [0.5, 0.5, 0.5])
+        o.step()
+        assert not np.allclose(p.numpy(), before), cls.__name__

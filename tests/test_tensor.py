@@ -1,0 +1,167 @@
+"""Core Tensor + op tests (reference pattern: OpTest numpy-reference checks,
+python/paddle/fluid/tests/unittests/op_test.py:292)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor([1, 2, 3])
+    assert t.dtype == "int64" or t.dtype == "int32"
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == "float32"
+    t = paddle.to_tensor(np.zeros((2, 3), np.float64))
+    assert t.dtype == "float64"
+    t = paddle.to_tensor([1.0], dtype="bfloat16")
+    assert t.dtype == "bfloat16"
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([4]).numpy().sum() == 4
+    assert paddle.full([2, 2], 7).numpy()[0, 0] == 7
+    assert paddle.arange(5).tolist() == [0, 1, 2, 3, 4]
+    assert paddle.eye(3).numpy().trace() == 3
+    assert paddle.linspace(0, 1, 5).shape == [5]
+    x = paddle.to_tensor([[1.0, 2], [3, 4]])
+    assert np.allclose(paddle.tril(x).numpy(), np.tril(x.numpy()))
+    assert paddle.ones_like(x).shape == [2, 2]
+
+
+def test_arithmetic_matches_numpy():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32) + 0.5
+    ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+    assert np.allclose((ta + tb).numpy(), a + b)
+    assert np.allclose((ta - tb).numpy(), a - b)
+    assert np.allclose((ta * tb).numpy(), a * b)
+    assert np.allclose((ta / tb).numpy(), a / b, rtol=1e-5)
+    assert np.allclose((ta ** 2).numpy(), a ** 2)
+    assert np.allclose((ta @ tb.t()).numpy(), a @ b.T, rtol=1e-5)
+    assert np.allclose((2.0 - ta).numpy(), 2.0 - a)
+    assert np.allclose((1.0 / tb).numpy(), 1.0 / b, rtol=1e-5)
+    # scalar ops preserve dtype
+    assert (ta + 1).dtype == "float32"
+
+
+def test_reductions():
+    a = np.random.rand(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(a)
+    assert np.allclose(paddle.sum(t).numpy(), a.sum(), rtol=1e-5)
+    assert np.allclose(paddle.mean(t, axis=1).numpy(), a.mean(1), rtol=1e-5)
+    assert np.allclose(paddle.max(t, axis=[0, 2]).numpy(), a.max((0, 2)))
+    assert np.allclose(paddle.prod(t, axis=0).numpy(), a.prod(0), rtol=1e-4)
+    assert np.allclose(t.std(unbiased=True).numpy(), a.std(ddof=1), rtol=1e-4)
+    assert np.allclose(paddle.logsumexp(t, axis=-1).numpy(),
+                       np.log(np.exp(a).sum(-1)), rtol=1e-5)
+
+
+def test_manipulation():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(a)
+    assert t.reshape([4, 6]).shape == [4, 6]
+    assert t.reshape([0, -1]).shape == [2, 12]  # 0 = copy dim
+    assert t.transpose([2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.concat([t, t], axis=1).shape == [2, 6, 4]
+    assert paddle.stack([t, t]).shape == [2, 2, 3, 4]
+    parts = paddle.split(t, 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts = paddle.split(t, [1, -1], axis=1)
+    assert parts[1].shape == [2, 2, 4]
+    assert t.unsqueeze(0).shape == [1, 2, 3, 4]
+    assert t.unsqueeze(0).squeeze(0).shape == [2, 3, 4]
+    assert t.flatten().shape == [24]
+    assert t.flatten(1).shape == [2, 12]
+    assert paddle.tile(t, [2, 1, 1]).shape == [4, 3, 4]
+    assert paddle.flip(t, axis=0).numpy()[0, 0, 0] == a[1, 0, 0]
+    assert paddle.roll(t, 1, axis=0).numpy()[0, 0, 0] == a[1, 0, 0]
+
+
+def test_indexing():
+    a = np.arange(20, dtype=np.float32).reshape(4, 5)
+    t = paddle.to_tensor(a)
+    assert np.allclose(t[1].numpy(), a[1])
+    assert np.allclose(t[1:3, 2:].numpy(), a[1:3, 2:])
+    assert np.allclose(t[paddle.to_tensor([0, 2])].numpy(), a[[0, 2]])
+    mask = t > 10
+    assert np.allclose(t[mask].numpy(), a[a > 10])
+    t2 = t.clone()
+    t2[0] = 0.0
+    assert t2.numpy()[0].sum() == 0
+
+
+def test_gather_scatter():
+    a = np.random.rand(5, 3).astype(np.float32)
+    t = paddle.to_tensor(a)
+    idx = paddle.to_tensor([0, 2, 4])
+    assert np.allclose(paddle.gather(t, idx).numpy(), a[[0, 2, 4]])
+    upd = paddle.ones([3, 3])
+    out = paddle.scatter(t, idx, upd)
+    assert np.allclose(out.numpy()[[0, 2, 4]], 1.0)
+
+
+def test_search_sort():
+    a = np.random.rand(4, 6).astype(np.float32)
+    t = paddle.to_tensor(a)
+    assert np.allclose(paddle.argmax(t, axis=1).numpy(), a.argmax(1))
+    v, i = paddle.topk(t, 3, axis=1)
+    ref = np.sort(a, 1)[:, ::-1][:, :3]
+    assert np.allclose(v.numpy(), ref, rtol=1e-6)
+    s = paddle.sort(t, axis=1, descending=True)
+    assert np.allclose(s.numpy(), np.sort(a, 1)[:, ::-1])
+
+
+def test_logic():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([3.0, 2.0, 1.0])
+    assert (a == b).tolist() == [False, True, False]
+    assert (a < b).tolist() == [True, False, False]
+    assert bool(paddle.allclose(a, a))
+    assert bool(paddle.equal_all(a, a))
+
+
+def test_linalg():
+    a = np.random.rand(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    t = paddle.to_tensor(spd)
+    L = paddle.cholesky(t)
+    assert np.allclose((L @ L.t()).numpy(), spd, atol=1e-4)
+    assert np.allclose(paddle.inv(t).numpy(), np.linalg.inv(spd), atol=1e-4)
+    assert abs(float(paddle.det(t)) - np.linalg.det(spd)) < 1e-2
+    n = paddle.norm(paddle.to_tensor(a))
+    assert abs(float(n) - np.linalg.norm(a)) < 1e-4
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    assert np.allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_random_reproducible():
+    paddle.seed(7)
+    a = paddle.randn([4, 4]).numpy()
+    paddle.seed(7)
+    b = paddle.randn([4, 4]).numpy()
+    assert np.allclose(a, b)
+    assert paddle.randint(0, 10, [100]).numpy().max() < 10
+    assert paddle.randperm(10).numpy().sum() == 45
+
+
+def test_cast_and_dtype_promo():
+    t = paddle.to_tensor([1.5, 2.5])
+    assert t.astype("int32").dtype == "int32"
+    assert t.astype("bfloat16").dtype == "bfloat16"
+    assert paddle.cast(t, "float64").dtype == "float64"
+
+
+def test_inplace_ops():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    assert t.tolist() == [2.0, 3.0]
+    t.scale_(2.0)
+    assert t.tolist() == [4.0, 6.0]
+    t.zero_()
+    assert t.tolist() == [0.0, 0.0]
