@@ -138,20 +138,20 @@ def _jit_collective(op_name, axis, mesh_key, extra=None):
         )
     if op_name == "all_gather":
         def f(x):
-            return jax.lax.all_gather(x[0], axis)
+            # local [1, ...] -> full [nranks, ...] replicated as [1, nranks, ...]
+            return jax.lax.all_gather(x[0], axis)[None]
 
         return jax.jit(
             jax.shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
         )
     if op_name == "reduce_scatter":
         def f(x):
-            # local [nranks, ...] rows; scatter-sum row i to rank i -> local [1, ...]
-            return jax.lax.psum_scatter(x, axis, scatter_dimension=1, tiled=False)[None]
+            # x local [1, nranks, ...]: row j is this rank's contribution to rank j;
+            # scatter-sum over dim 1 -> local [1, ...] (this rank's reduced row)
+            return jax.lax.psum_scatter(x[0], axis, scatter_dimension=0, tiled=False)[None]
 
         return jax.jit(
-            jax.shard_map(
-                lambda x: f(x[0]), mesh=mesh, in_specs=P(axis), out_specs=P(axis)
-            )
+            jax.shard_map(f, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
         )
     if op_name == "broadcast":
         src = extra
