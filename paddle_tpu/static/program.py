@@ -61,6 +61,13 @@ class Variable(Tensor):
             "through an Executor first"
         )
 
+    def detach(self):
+        # static graph: grads flow only into captured params, so detach is identity
+        return self
+
+    def clone(self):
+        return self
+
     def __repr__(self):
         return f"Variable(name={self.name}, shape={self.desc_shape}, dtype={self.dtype})"
 

@@ -22,18 +22,18 @@ __all__ = [
 ]
 
 
-def _wrap2(name, f):
+def _wrap2(op_name, f):
     def op(x, y, name=None):
         if not isinstance(x, Tensor):
             x = Tensor(x)
         if isinstance(y, Tensor):
-            return primitive_call(f, x, y, name=name)
+            return primitive_call(f, x, y, name=op_name)
         if isinstance(y, (np.ndarray, list, tuple)):
-            return primitive_call(f, x, Tensor(y), name=name)
+            return primitive_call(f, x, Tensor(y), name=op_name)
         # python scalar: keep it static (jax weak-type promotion preserves x dtype)
-        return primitive_call(lambda a: f(a, y), x, name=name)
+        return primitive_call(lambda a: f(a, y), x, name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -68,13 +68,13 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     return primitive_call(f, x, y, name="matmul")
 
 
-def _wrap1(name, f):
+def _wrap1(op_name, f):
     def op(x, name=None, **kw):
         if not isinstance(x, Tensor):
             x = Tensor(x)
-        return primitive_call(f, x, name=name)
+        return primitive_call(f, x, name=op_name)
 
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 

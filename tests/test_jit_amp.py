@@ -1,0 +1,147 @@
+"""jit.to_static, AMP, recompute, GradScaler tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_to_static_layer_matches_eager():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    x = paddle.randn([3, 4])
+    eager = net(x).numpy()
+    traced = paddle.jit.to_static(net)
+    out = traced.forward_traced(x)
+    assert np.allclose(out.numpy(), eager, rtol=1e-5)
+    # second call hits the jit cache
+    out2 = traced.forward_traced(x)
+    assert np.allclose(out2.numpy(), eager, rtol=1e-5)
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(a, b):
+        return a * 2 + b
+
+    x, y = paddle.randn([3]), paddle.randn([3])
+    assert np.allclose(f(x, y).numpy(), x.numpy() * 2 + y.numpy(), rtol=1e-6)
+
+
+def test_to_static_bn_buffer_update():
+    bn = nn.BatchNorm1D(4)
+    net = nn.Sequential(bn)
+    traced = paddle.jit.to_static(net)
+    x = paddle.randn([16, 4, 8])
+    traced.forward_traced(x)
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+
+
+def test_auto_cast_o1():
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        c = paddle.matmul(a, b)  # white op -> bf16
+        assert c.dtype == "bfloat16"
+        d = a + b  # not white -> stays fp32
+        assert d.dtype == "float32"
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == "float32"
+
+
+def test_auto_cast_custom_lists():
+    with paddle.amp.auto_cast(custom_white_list=["add"], level="O1"):
+        a = paddle.randn([2])
+        assert (a + a).dtype == "bfloat16"
+
+
+def test_amp_decorate_o2():
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    net, opt = paddle.amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    assert net.weight.dtype == "bfloat16"
+    assert opt._multi_precision
+
+
+def test_grad_scaler_fp16_flow():
+    net = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([8, 4])
+    loss = net(x).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled.numpy()) == pytest.approx(float(loss.numpy()) * 1024.0, rel=1e-5)
+    scaled.backward()
+    w_before = net.weight.numpy().copy()
+    scaler.step(opt)
+    assert not np.allclose(net.weight.numpy(), w_before)
+
+
+def test_grad_scaler_skips_inf():
+    net = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0, decr_every_n_nan_or_inf=1)
+    net.weight.grad = paddle.to_tensor(np.asarray([[np.inf], [1.0]], dtype=np.float32))
+    net.bias.grad = paddle.to_tensor(np.asarray([1.0], dtype=np.float32))
+    w_before = net.weight.numpy().copy()
+    scaler.step(opt)
+    assert np.allclose(net.weight.numpy(), w_before)  # skipped
+    assert scaler._scale == 2.0  # decreased
+
+
+def test_recompute_in_jit():
+    """recompute inside a jitted step gives identical grads."""
+    import jax
+
+    from paddle_tpu.distributed.fleet import recompute
+
+    net = nn.Sequential(nn.Linear(4, 16), nn.Tanh(), nn.Linear(16, 4))
+    params, _ = net.functional_state()
+    x = np.random.rand(2, 4).astype(np.float32)
+
+    def loss_plain(pv):
+        out, _ = net.functional_call(pv, {}, paddle.to_tensor(x))
+        return float(out.sum().numpy()) if False else out.sum()._value
+
+    def loss_rc(pv):
+        from paddle_tpu.core import tape
+
+        with tape.no_grad():
+            all_p = dict(pv)
+            saved = {k: t._value for k, t in params.items()}
+            for k, v in all_p.items():
+                params[k]._value = v
+            try:
+                out = recompute(net, paddle.to_tensor(x))
+            finally:
+                for k, t in params.items():
+                    t._value = saved[k]
+            return out.sum()._value
+
+    pv = {k: v._value for k, v in params.items()}
+    from paddle_tpu.core import tape
+
+    def lp(p):
+        with tape.no_grad():
+            saved = {k: t._value for k, t in params.items()}
+            for k, v in p.items():
+                params[k]._value = v
+            try:
+                out = net(paddle.to_tensor(x))
+            finally:
+                for k, t in params.items():
+                    t._value = saved[k]
+            return out.sum()._value
+
+    g1 = jax.jit(jax.grad(lp))(pv)
+    g2 = jax.jit(jax.grad(loss_rc))(pv)
+    for k in g1:
+        assert np.allclose(np.asarray(g1[k]), np.asarray(g2[k]), rtol=1e-5), k
+
+
+def test_jit_save_load(tmp_path):
+    net = nn.Linear(4, 2)
+    p = str(tmp_path / "m")
+    paddle.jit.save(net, p)
+    obj = paddle.jit.load(p)
+    assert "state_dict" in obj
+    assert np.allclose(obj["state_dict"]["weight"].numpy(), net.weight.numpy())

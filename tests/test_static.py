@@ -1,0 +1,106 @@
+"""Static-graph tests (reference: static executor stack, survey §3.1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_program_build_and_run():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 3], "float32")
+        w = paddle.to_tensor(np.random.rand(3, 2).astype(np.float32))
+        y = paddle.matmul(x, w)
+        out = paddle.sum(y)
+    assert len(main.all_ops()) == 2
+    exe = static.Executor()
+    xv = np.random.rand(4, 3).astype(np.float32)
+    res = exe.run(main, feed={"x": xv}, fetch_list=[out, y])
+    assert np.allclose(res[0], (xv @ w.numpy()).sum(), rtol=1e-5)
+    assert np.allclose(res[1], xv @ w.numpy(), rtol=1e-5)
+
+
+def test_static_layers():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 1, 28, 28], "float32")
+        from paddle_tpu.vision.models import LeNet
+
+        net = LeNet()
+        logits = net(x)
+    exe = static.Executor()
+    out = exe.run(main, feed={"x": np.random.rand(2, 1, 28, 28).astype(np.float32)},
+                  fetch_list=[logits])
+    assert out[0].shape == (2, 10)
+
+
+def test_static_minimize_trains():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [16, 8], "float32")
+        label = static.data("label", [16], "int64")
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        logits = net(x)
+        loss = nn.functional.cross_entropy(logits, label)
+        opt = paddle.optimizer.Adam(1e-2)
+        opt.minimize(loss)
+    exe = static.Executor()
+    xv = np.random.rand(16, 8).astype(np.float32)
+    yv = np.random.randint(0, 4, (16,))
+    losses = []
+    for _ in range(10):
+        res = exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])
+        losses.append(float(res[0]))
+    assert losses[-1] < losses[0]
+
+
+def test_static_dygraph_parity():
+    """Same weights -> same loss in both modes (the CPU-parity pattern §4.2)."""
+    paddle.disable_static()
+    paddle.seed(5)
+    net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 3))
+    xv = np.random.rand(4, 6).astype(np.float32)
+    dy_out = net(paddle.to_tensor(xv)).numpy()
+
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 6], "float32")
+        out = net(x)
+    exe = static.Executor()
+    st_out = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    assert np.allclose(dy_out, st_out, rtol=1e-5)
+
+
+def test_program_clone_for_test():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 4], "float32")
+        y = paddle.sum(x * 2)
+        opt = paddle.optimizer.SGD(0.1)
+        opt.minimize(y)
+    test_prog = main.clone(for_test=True)
+    assert test_prog._minimize_spec is None
+    assert main._minimize_spec is not None
+
+
+def test_static_nn_helpers():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("img", [2, 3, 8, 8], "float32")
+        c = static.nn.conv2d(x, 4, 3, padding=1, act="relu")
+        flat = c.flatten(1)
+        fc = static.nn.fc(flat, 10)
+    exe = static.Executor()
+    out = exe.run(main, feed={"img": np.random.rand(2, 3, 8, 8).astype(np.float32)},
+                  fetch_list=[fc])
+    assert out[0].shape == (2, 10)
