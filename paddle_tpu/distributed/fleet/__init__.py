@@ -23,6 +23,7 @@ from .meta_parallel import (  # noqa: F401
     RowParallelLinear,
     SharedLayerDesc,
     VocabParallelEmbedding,
+    apply_megatron_specs,
     get_rng_state_tracker,
 )
 from .hybrid_train import HybridParallelModel, hybrid_train_step  # noqa: F401

@@ -139,6 +139,33 @@ class ParallelCrossEntropy(Layer):
         return F.cross_entropy(input, label, reduction="none")
 
 
+def apply_megatron_specs(model, rules=None):
+    """Tag a transformer's params with Megatron TP PartitionSpecs by name pattern
+    — the spec-based equivalent of swapping Linear→Column/RowParallelLinear.
+
+    Default rules fit the GPT zoo (qkv/fc1 column-sharded, out/fc2 row-sharded,
+    embeddings vocab-sharded).
+    """
+    rules = rules or [
+        (r"qkv_proj\.weight$", P(None, "mp")), (r"qkv_proj\.bias$", P("mp")),
+        (r"out_proj\.weight$", P("mp", None)),
+        (r"fc1\.weight$", P(None, "mp")), (r"fc1\.bias$", P("mp")),
+        (r"fc2\.weight$", P("mp", None)),
+        (r"linear1\.weight$", P(None, "mp")), (r"linear1\.bias$", P("mp")),
+        (r"linear2\.weight$", P("mp", None)),
+        (r"(wte|word_embeddings)\.weight$", P("mp", None)),
+        (r"lm_head\.weight$", P(None, "mp")),
+    ]
+    n = 0
+    for name, p in model.named_parameters():
+        for pat, spec in rules:
+            if re.search(pat, name):
+                p._sharding_spec = spec
+                n += 1
+                break
+    return n
+
+
 class LayerDesc:
     """reference: pp_layers.py:58"""
 

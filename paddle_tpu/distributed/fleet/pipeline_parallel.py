@@ -38,6 +38,58 @@ class PipelineParallel:
         self.micro_batch_size = strategy.pipeline_configs.get("micro_batch_size", 1)
         self._stage_fns = None
         self.training = True
+        self._stage_meshes = self._build_stage_meshes()
+        self._params_placed = False
+
+    def _build_stage_meshes(self):
+        """Per-stage sub-mesh: fix the 'pp' coordinate, keep (dp, sharding, mp).
+
+        This is what maps stage s's computation onto its own devices — the analog
+        of the reference assigning each pp rank its segment (pp_layers.py:314).
+        """
+        if self._hcg is None:
+            return None
+        mesh = self._hcg.mesh
+        names = list(mesh.axis_names)
+        if "pp" not in names or dict(zip(names, mesh.devices.shape))["pp"] <= 1:
+            return None
+        import numpy as _np
+        from jax.sharding import Mesh
+
+        pp_i = names.index("pp")
+        sub_names = tuple(n for n in names if n != "pp")
+        meshes = []
+        for s in range(self.num_stages):
+            devs = _np.take(mesh.devices, s, axis=pp_i)
+            meshes.append(Mesh(devs, sub_names))
+        return meshes
+
+    def _stage_sharding(self, s, p: "Tensor | None" = None, batch=False):
+        """NamedSharding for a param/batch on stage s's sub-mesh."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self._stage_meshes[s]
+        if batch:
+            sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            axes = tuple(a for a in ("dp", "sharding") if sizes.get(a, 1) > 1)
+            spec = P(axes if len(axes) > 1 else (axes[0] if axes else None))
+            return NamedSharding(mesh, spec)
+        if p is not None and p._sharding_spec is not None:
+            spec = tuple(x if (x is None or x in mesh.axis_names) else None
+                         for x in p._sharding_spec)
+            return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    def _place_stage_params(self):
+        """Move every stage's parameters onto its sub-mesh (once)."""
+        if self._params_placed or self._stage_meshes is None:
+            return
+        import jax
+
+        for s in range(self.num_stages):
+            for _, p in self._layers.stages[s].named_parameters():
+                p._value = jax.device_put(p._value, self._stage_sharding(s, p))
+        self._params_placed = True
 
     def __call__(self, *a, **k):
         return self._layers(*a, **k)
@@ -92,10 +144,20 @@ class PipelineParallel:
                 ps[name] = p._value
         return ps
 
+    def _xfer(self, x, s):
+        """Inter-stage activation transfer (send_v2/recv_v2 analog): device_put
+        onto stage s's sub-mesh — XLA moves it over ICI."""
+        if self._stage_meshes is None:
+            return x
+        import jax
+
+        return jax.device_put(x, self._stage_sharding(s, batch=True))
+
     # ------------------------------------------------------------ 1F1B
     def forward_backward_pipeline(self, data, scaler=None):
         """reference pipeline_parallel.py:81 — returns mean loss; grads left on
         the stage parameters for the optimizer step."""
+        self._place_stage_params()
         if self._stage_fns is None:
             self._build_stage_fns()
         inputs, labels = data
@@ -123,9 +185,12 @@ class PipelineParallel:
         def do_forward(mb):
             x = xs[mb]
             for s in range(S):
+                x = self._xfer(x, s)  # p2p: ICI transfer to stage s's devices
                 acts[mb][s] = x
                 if s == S - 1 and self._stage_fns[s]["fwd_loss"] is not None:
-                    loss = self._stage_fns[s]["fwd_loss"](stage_p[s], x, ys[mb], keys[mb][s])
+                    loss = self._stage_fns[s]["fwd_loss"](
+                        stage_p[s], x, self._xfer(ys[mb], s), keys[mb][s]
+                    )
                     losses.append(loss)
                 else:
                     x = self._stage_fns[s]["fwd"](stage_p[s], x, keys[mb][s])
@@ -134,7 +199,7 @@ class PipelineParallel:
             s = S - 1
             if self._stage_fns[s]["bwd_loss"] is not None:
                 gp, gx = self._stage_fns[s]["bwd_loss"](
-                    stage_p[s], acts[mb][s], ys[mb], keys[mb][s]
+                    stage_p[s], acts[mb][s], self._xfer(ys[mb], s), keys[mb][s]
                 )
             else:
                 gp, gx = self._stage_fns[s]["bwd"](
@@ -143,6 +208,7 @@ class PipelineParallel:
                 )
             _acc(grads_acc, s, gp)
             for s in range(S - 2, -1, -1):
+                gx = self._xfer(gx, s)  # p2p backward
                 gp, gx = self._stage_fns[s]["bwd"](stage_p[s], acts[mb][s], keys[mb][s], gx)
                 _acc(grads_acc, s, gp)
             acts[mb] = [None] * S  # free
@@ -180,6 +246,7 @@ class PipelineParallel:
         return loss
 
     def eval_batch(self, data, compute_loss=True):
+        self._place_stage_params()
         if self._stage_fns is None:
             self._build_stage_fns()
         inputs, labels = data
@@ -187,10 +254,12 @@ class PipelineParallel:
         y = labels._value if isinstance(labels, Tensor) else jnp.asarray(np.asarray(labels))
         key = rng_mod.next_rng_key()
         for s in range(self.num_stages - 1):
-            x = self._stage_fns[s]["fwd"](self._stage_params(s), x, key)
+            x = self._stage_fns[s]["fwd"](self._stage_params(s), self._xfer(x, s), key)
         s = self.num_stages - 1
+        x = self._xfer(x, s)
         if compute_loss and self._stage_fns[s]["fwd_loss"] is not None:
-            return Tensor(self._stage_fns[s]["fwd_loss"](self._stage_params(s), x, y, key))
+            return Tensor(self._stage_fns[s]["fwd_loss"](
+                self._stage_params(s), x, self._xfer(y, s), key))
         return Tensor(self._stage_fns[s]["fwd"](self._stage_params(s), x, key))
 
 

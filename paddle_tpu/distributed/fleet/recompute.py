@@ -8,13 +8,33 @@ simply calls the function (the tape holds activations anyway).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 
 from ...core import tape as tape_mod
 from ...core.tensor import Tensor
 
 
-def recompute(function, *args, preserve_rng_state=True, use_reentrant=True, **kwargs):
+_POLICIES = {
+    None: None,
+    "full": None,
+    "dots": "dots_with_no_batch_dims_saveable",
+    "dots_saveable": "dots_saveable",
+    "nothing": "nothing_saveable",
+    "everything": "everything_saveable",
+}
+
+
+def _resolve_policy(policy):
+    if callable(policy):
+        return policy
+    name = _POLICIES.get(policy, policy)
+    return getattr(jax.checkpoint_policies, name) if name else None
+
+
+def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
+              policy=None, **kwargs):
     # Under trace (inside a jitted step) wrap in jax.checkpoint; detect by tracer
     leaves = [a._value for a in args if isinstance(a, Tensor)]
     tracing = any(isinstance(v, jax.core.Tracer) for v in leaves)
@@ -23,7 +43,7 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True, **kw
 
     arrs = [a._value if isinstance(a, Tensor) else a for a in args]
 
-    @jax.checkpoint
+    @functools.partial(jax.checkpoint, policy=_resolve_policy(policy))
     def inner(*arrays):
         ts = [Tensor(x) if not isinstance(x, Tensor) else x for x in arrays]
         out = function(*ts, **kwargs)

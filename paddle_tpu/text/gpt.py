@@ -29,6 +29,8 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     tie_word_embeddings: bool = True
+    recompute: bool = False  # per-block rematerialization (jax.checkpoint)
+    recompute_policy: str | None = None  # e.g. 'dots' = save MXU outputs only
 
     def __post_init__(self):
         if not self.ffn_hidden:
@@ -126,8 +128,15 @@ class GPTModel(nn.Layer):
             position_ids = P.arange(s, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
-        for blk in self.blocks:
-            x = blk(x, attn_mask)
+        if self.cfg.recompute:
+            from ..distributed.fleet.recompute import recompute
+
+            for blk in self.blocks:
+                x = (recompute(blk, x, policy=self.cfg.recompute_policy)
+                     if attn_mask is None else blk(x, attn_mask))
+        else:
+            for blk in self.blocks:
+                x = blk(x, attn_mask)
         return self.ln_f(x)
 
 
@@ -159,9 +168,66 @@ class GPTForCausalLM(nn.Layer):
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
 
+    def loss_flops_per_token(self):
+        return self.flops_per_token()
+
     def flops_per_token(self) -> float:
         """Approximate training FLOPs/token (6*N + attention), for MFU accounting."""
         c = self.cfg
         n = self.num_params()
         attn = 6 * c.num_layers * c.hidden_size * c.max_seq_len  # 2*2*L*h*s fw+bw-ish
         return 6.0 * n + attn
+
+
+# --------------------------------------------------------------- pipeline form
+class GPTEmbeddingPipe(nn.Layer):
+    """Stage-0 prologue for PipelineLayer GPT (token + position embedding)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.wpe = nn.Embedding(cfg.max_seq_len, cfg.hidden_size,
+                                weight_attr=nn.ParamAttr(initializer=init))
+        self.drop = nn.Dropout(cfg.dropout)
+
+    def forward(self, input_ids):
+        import paddle_tpu as P
+
+        s = input_ids.shape[1]
+        pos = P.arange(s, dtype="int64").unsqueeze(0)
+        return self.drop(self.wte(input_ids) + self.wpe(pos))
+
+
+class GPTHeadPipe(nn.Layer):
+    """Last-stage epilogue: final LN + LM head (untied for pipeline)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.lm_head(self.ln_f(x))
+
+
+class GPTPipeLoss(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.vocab = cfg.vocab_size
+
+    def forward(self, logits, labels):
+        return F.cross_entropy(logits.reshape([-1, self.vocab]), labels.reshape([-1]))
+
+
+def build_gpt_pipeline(cfg: GPTConfig, num_stages: int, topology=None):
+    """GPT as a PipelineLayer (reference: fleet GPT with PipelineLayer descs,
+    seg_method 'layer:GPTBlock')."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    descs = [LayerDesc(GPTEmbeddingPipe, cfg)]
+    descs += [LayerDesc(GPTBlock, cfg) for _ in range(cfg.num_layers)]
+    descs += [LayerDesc(GPTHeadPipe, cfg)]
+    return PipelineLayer(descs, num_stages=num_stages, topology=topology,
+                         loss_fn=GPTPipeLoss(cfg))
