@@ -1,0 +1,94 @@
+// Bounded MPMC blocking queue of int64 tickets.
+//
+// Reference analog: the reader blocking queues in
+// paddle/fluid/operators/reader/ (BlockingQueue<T>) backing the DataLoader.
+// Python payloads stay in a Python-side slab; the queue moves opaque tickets so
+// no serialization crosses the boundary. C ABI for ctypes binding (no pybind11
+// in this image).
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <mutex>
+
+namespace {
+
+class TicketQueue {
+ public:
+  explicit TicketQueue(int capacity) : capacity_(capacity) {}
+
+  // timeout_ms < 0 => block forever. Returns 1 on success, 0 on timeout/closed.
+  int Put(int64_t ticket, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || (int)q_.size() < capacity_; };
+    if (!Wait(lk, not_full_, pred, timeout_ms)) return 0;
+    if (closed_) return 0;
+    q_.push_back(ticket);
+    not_empty_.notify_one();
+    return 1;
+  }
+
+  // Returns ticket >= 0, or -1 on timeout, -2 when closed and drained.
+  int64_t Get(int timeout_ms) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto pred = [this] { return closed_ || !q_.empty(); };
+    if (!Wait(lk, not_empty_, pred, timeout_ms)) return -1;
+    if (q_.empty()) return closed_ ? -2 : -1;
+    int64_t t = q_.front();
+    q_.pop_front();
+    not_full_.notify_one();
+    return t;
+  }
+
+  int Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return (int)q_.size();
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  template <typename Pred>
+  bool Wait(std::unique_lock<std::mutex>& lk, std::condition_variable& cv,
+            Pred pred, int timeout_ms) {
+    if (timeout_ms < 0) {
+      cv.wait(lk, pred);
+      return true;
+    }
+    return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+  }
+
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<int64_t> q_;
+  int capacity_;
+  bool closed_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptq_queue_new(int capacity) { return new TicketQueue(capacity); }
+
+int ptq_queue_put(void* h, long ticket, int timeout_ms) {
+  return static_cast<TicketQueue*>(h)->Put(ticket, timeout_ms);
+}
+
+long ptq_queue_get(void* h, int timeout_ms) {
+  return static_cast<TicketQueue*>(h)->Get(timeout_ms);
+}
+
+int ptq_queue_size(void* h) { return static_cast<TicketQueue*>(h)->Size(); }
+
+void ptq_queue_close(void* h) { static_cast<TicketQueue*>(h)->Close(); }
+
+void ptq_queue_free(void* h) { delete static_cast<TicketQueue*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,290 @@
+// TCPStore: master/worker key-value rendezvous.
+//
+// Reference analog: paddle/fluid/distributed/store/tcp_store.h:97 (+ tcp_utils)
+// used for ProcessGroup bootstrap. On TPU pods the JAX coordination service
+// normally fills this role; this store exists for the launcher / elastic agent
+// and for API parity (paddle_tpu.distributed.TCPStore).
+//
+// Wire protocol (all little-endian):
+//   u8 op ('S' set, 'G' get, 'A' add, 'W' wait)
+//   u32 key_len, key bytes
+//   SET: u32 val_len, val bytes            -> u8 ok
+//   GET:                                   -> i32 val_len (-1 missing), bytes
+//   ADD: i64 delta                         -> i64 new_value
+//   WAIT:                                  -> u8 ok (blocks until key exists)
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+static bool ReadN(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+static bool WriteN(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+class StoreServer {
+ public:
+  explicit StoreServer(int port) : port_(port) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons((uint16_t)port);
+    ok_ = ::bind(listen_fd_, (sockaddr*)&addr, sizeof(addr)) == 0 &&
+          ::listen(listen_fd_, 128) == 0;
+    if (ok_) accept_thread_ = std::thread([this] { AcceptLoop(); });
+  }
+
+  ~StoreServer() {
+    stop_ = true;
+    cv_.notify_all();
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    {
+      std::lock_guard<std::mutex> lk(fds_mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);  // unblock ReadN
+    }
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : workers_)
+      if (t.joinable()) t.join();
+  }
+
+  bool ok() const { return ok_; }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lk(fds_mu_);
+        client_fds_.push_back(fd);
+      }
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void Serve(int fd) {
+    while (!stop_) {
+      uint8_t op;
+      if (!ReadN(fd, &op, 1)) break;
+      uint32_t klen;
+      if (!ReadN(fd, &klen, 4)) break;
+      std::string key(klen, 0);
+      if (!ReadN(fd, key.data(), klen)) break;
+      if (op == 'S') {
+        uint32_t vlen;
+        if (!ReadN(fd, &vlen, 4)) break;
+        std::string val(vlen, 0);
+        if (!ReadN(fd, val.data(), vlen)) break;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          kv_[key] = val;
+        }
+        cv_.notify_all();
+        uint8_t okb = 1;
+        if (!WriteN(fd, &okb, 1)) break;
+      } else if (op == 'G') {
+        std::string val;
+        int32_t vlen = -1;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          auto it = kv_.find(key);
+          if (it != kv_.end()) {
+            val = it->second;
+            vlen = (int32_t)val.size();
+          }
+        }
+        if (!WriteN(fd, &vlen, 4)) break;
+        if (vlen > 0 && !WriteN(fd, val.data(), (size_t)vlen)) break;
+      } else if (op == 'A') {
+        int64_t delta;
+        if (!ReadN(fd, &delta, 8)) break;
+        int64_t nv;
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end()) cur = strtoll(it->second.c_str(), nullptr, 10);
+          nv = cur + delta;
+          kv_[key] = std::to_string(nv);
+        }
+        cv_.notify_all();
+        if (!WriteN(fd, &nv, 8)) break;
+      } else if (op == 'W') {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] { return stop_ || kv_.count(key) > 0; });
+        lk.unlock();
+        uint8_t okb = 1;
+        if (!WriteN(fd, &okb, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int listen_fd_ = -1;
+  std::atomic<bool> stop_{false};
+  bool ok_ = false;
+  std::thread accept_thread_;
+  std::vector<std::thread> workers_;
+  std::mutex fds_mu_;
+  std::vector<int> client_fds_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+};
+
+class StoreClient {
+ public:
+  StoreClient(const char* host, int port) {
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    inet_pton(AF_INET, host, &addr.sin_addr);
+    // retry connect for up to ~10s (server may start later)
+    for (int i = 0; i < 100; i++) {
+      if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) == 0) {
+        ok_ = true;
+        break;
+      }
+      usleep(100000);
+    }
+    int one = 1;
+    setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  }
+
+  ~StoreClient() { ::close(fd_); }
+
+  bool ok() const { return ok_; }
+
+  int Set(const char* key, const char* val, int vlen) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader('S', key)) return 0;
+    uint32_t n = (uint32_t)vlen;
+    if (!WriteN(fd_, &n, 4) || !WriteN(fd_, val, n)) return 0;
+    uint8_t okb;
+    return ReadN(fd_, &okb, 1) ? 1 : 0;
+  }
+
+  // returns length, -1 missing, -2 error; writes into out (cap bytes)
+  int Get(const char* key, char* out, int cap) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader('G', key)) return -2;
+    int32_t vlen;
+    if (!ReadN(fd_, &vlen, 4)) return -2;
+    if (vlen < 0) return -1;
+    std::string buf(vlen, 0);
+    if (!ReadN(fd_, buf.data(), (size_t)vlen)) return -2;
+    memcpy(out, buf.data(), (size_t)std::min(vlen, cap));
+    return vlen;
+  }
+
+  int64_t Add(const char* key, int64_t delta) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader('A', key)) return INT64_MIN;
+    if (!WriteN(fd_, &delta, 8)) return INT64_MIN;
+    int64_t nv;
+    if (!ReadN(fd_, &nv, 8)) return INT64_MIN;
+    return nv;
+  }
+
+  int Wait(const char* key, int timeout_ms) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (!SendHeader('W', key)) return 0;
+    uint8_t okb;
+    return ReadN(fd_, &okb, 1) ? 1 : 0;
+  }
+
+ private:
+  bool SendHeader(uint8_t op, const char* key) {
+    uint32_t klen = (uint32_t)strlen(key);
+    return WriteN(fd_, &op, 1) && WriteN(fd_, &klen, 4) && WriteN(fd_, key, klen);
+  }
+
+  int fd_ = -1;
+  bool ok_ = false;
+  std::mutex mu_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ptq_store_server_new(int port) {
+  auto* s = new StoreServer(port);
+  if (!s->ok()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void ptq_store_server_free(void* h) { delete static_cast<StoreServer*>(h); }
+
+void* ptq_store_client_new(const char* host, int port) {
+  auto* c = new StoreClient(host, port);
+  if (!c->ok()) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void ptq_store_client_free(void* h) { delete static_cast<StoreClient*>(h); }
+
+int ptq_store_set(void* h, const char* key, const char* val, int vlen) {
+  return static_cast<StoreClient*>(h)->Set(key, val, vlen);
+}
+
+int ptq_store_get(void* h, const char* key, char* out, int cap, int timeout_ms) {
+  return static_cast<StoreClient*>(h)->Get(key, out, cap);
+}
+
+long ptq_store_add(void* h, const char* key, long delta) {
+  return (long)static_cast<StoreClient*>(h)->Add(key, delta);
+}
+
+int ptq_store_wait(void* h, const char* key, int timeout_ms) {
+  return static_cast<StoreClient*>(h)->Wait(key, timeout_ms);
+}
+
+}  // extern "C"

@@ -1,0 +1,98 @@
+"""Native C++ runtime tests: blocking queue + TCPStore (built with g++ via ctypes)."""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.runtime import build_native
+
+
+@pytest.fixture(scope="module")
+def native_lib():
+    lib = build_native()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def test_native_queue_roundtrip(native_lib):
+    from paddle_tpu.runtime.blocking_queue import BlockingQueue
+
+    q = BlockingQueue(capacity=4)
+    assert q._native is not None, "native queue should be active after build"
+    q.put({"x": 1})
+    q.put([1, 2, 3])
+    assert q.get() == {"x": 1}
+    assert q.get() == [1, 2, 3]
+    q.close()
+
+
+def test_native_queue_blocking_and_threads(native_lib):
+    from paddle_tpu.runtime.blocking_queue import BlockingQueue
+
+    q = BlockingQueue(capacity=2)
+    results = []
+
+    def consumer():
+        for _ in range(20):
+            results.append(q.get())
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    for i in range(20):
+        q.put(i)
+    t.join(timeout=10)
+    assert results == list(range(20))
+    q.close()
+
+
+def test_dataloader_uses_native_queue(native_lib):
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __getitem__(self, i):
+            return np.full((2,), i, dtype=np.float32)
+
+        def __len__(self):
+            return 16
+
+    dl = DataLoader(DS(), batch_size=4, num_workers=2)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert np.allclose(batches[0].numpy()[:, 0], [0, 1, 2, 3])
+
+
+def test_tcp_store(native_lib):
+    from paddle_tpu.runtime.tcp_store import TCPStore
+
+    port = 29731
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    worker = TCPStore("127.0.0.1", port, is_master=False)
+
+    master.set("hello", b"world")
+    assert worker.get("hello") == b"world"
+    assert worker.add("counter", 3) == 3
+    assert master.add("counter", 4) == 7
+    worker.set("barrier/0", b"1")
+    master.wait(["barrier/0"])  # returns because key exists
+
+
+def test_tcp_store_wait_blocks_until_set(native_lib):
+    from paddle_tpu.runtime.tcp_store import TCPStore
+
+    port = 29741
+    master = TCPStore("127.0.0.1", port, is_master=True)
+    worker = TCPStore("127.0.0.1", port, is_master=False)
+
+    def setter():
+        import time
+
+        time.sleep(0.2)
+        master.set("late_key", b"v")
+
+    t = threading.Thread(target=setter)
+    t.start()
+    worker.wait("late_key")
+    assert worker.get("late_key") == b"v"
+    t.join()
