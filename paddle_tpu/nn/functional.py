@@ -943,6 +943,19 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         args.append(_t(attn_mask))
 
     def f(q, k, v, *m):
+        from ..distributed.sequence_parallel import active_sp_axis, ring_attention
+
+        sp = active_sp_axis()
+        if sp is not None:
+            if m:
+                raise NotImplementedError(
+                    "explicit attn_mask is not supported under sequence "
+                    "parallelism (q/k/v are sequence shards; a local mask "
+                    "would silently drop cross-shard attention) — use "
+                    "is_causal=True or run without the sp axis"
+                )
+            # sequence-parallel scope: q/k/v are sequence shards — ring attention
+            return ring_attention(q, k, v, sp, causal=is_causal)
         return _attn.sdpa(q, k, v, m[0] if m else None, is_causal=is_causal)
 
     out = primitive_call(f, *args, name="scaled_dot_product_attention")

@@ -126,6 +126,11 @@ class GPTModel(nn.Layer):
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = P.arange(s, dtype="int64").unsqueeze(0)
+            from ..distributed.sequence_parallel import sp_local_offset
+
+            off = sp_local_offset(s)  # global positions when sequence-parallel
+            if not isinstance(off, int) or off != 0:
+                position_ids = position_ids + off
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
         if self.cfg.recompute:

@@ -1,0 +1,79 @@
+"""End-to-end context-parallel (dp x sp ring-attention) GPT training test.
+
+Validates that sequence-parallel training produces the same losses as a
+single-device run of the identical model (parity pattern: survey §4/3).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core import rng as rng_mod, tape as tape_mod
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed.sequence_parallel import build_context_parallel_step
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+VOCAB = 128
+
+
+def _cfg():
+    return GPTConfig(vocab_size=VOCAB, hidden_size=32, num_layers=2, num_heads=4,
+                     max_seq_len=64, dropout=0.0, tie_word_embeddings=False)
+
+
+def _loss_fn(logits, labels):
+    return nn.functional.cross_entropy(
+        logits.reshape([-1, VOCAB]), labels.reshape([-1])
+    )
+
+
+def _baseline_losses(model, ids, labels, steps, lr):
+    params, buffers = model.functional_state()
+    p = {k: v._value for k, v in params.items() if not v.stop_gradient}
+    opt = paddle.optimizer.SGD(lr, parameters=model.parameters())
+    state = opt.functional_init(p)
+
+    def fwd(pvals, key, x, y):
+        with tape_mod.no_grad(), rng_mod.trace_rng_scope(key):
+            out, _ = model.functional_call(pvals, {}, Tensor(x))
+        return _loss_fn(out, Tensor(y))._value.astype(jnp.float32)
+
+    losses = []
+    key = jax.random.key(7)
+    for i in range(steps):
+        loss, grads = jax.value_and_grad(fwd)(p, jax.random.fold_in(key, i),
+                                              ids, labels)
+        p, state = opt.functional_update(p, grads, state, lr)
+        losses.append(float(loss))
+    return losses
+
+
+def test_context_parallel_matches_single_device():
+    paddle.seed(11)
+    model = GPTForCausalLM(_cfg())
+    B, S, steps, lr = 4, 64, 3, 0.1
+
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, VOCAB, (B, S)).astype(np.int64)
+    labels = rng.randint(0, VOCAB, (B, S)).astype(np.int64)
+
+    ref = _baseline_losses(model, ids, labels, steps, lr)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    opt = paddle.optimizer.SGD(lr, parameters=model.parameters())
+    init_fn, step_fn, shard_batch = build_context_parallel_step(
+        model, opt, _loss_fn, mesh
+    )
+    state = init_fn()
+    xs = shard_batch([ids])
+    ys = shard_batch([labels])
+    got = []
+    key = jax.random.key(7)
+    for i in range(steps):
+        loss, state = step_fn(state, jax.random.fold_in(key, i), lr, xs, ys)
+        got.append(float(loss))
+
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+    assert got[-1] < got[0], "loss should decrease"
