@@ -24,14 +24,17 @@ import numpy as np
 
 
 class Generator:
+    # Key creation is lazy: touching jax.random at import time would initialize
+    # a backend in processes that must stay device-free (e.g. the launch CLI).
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None
         self._lock = threading.Lock()
 
     def manual_seed(self, seed: int):
-        self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        with self._lock:
+            self._seed = int(seed)
+            self._key = None  # stays device-free until the first draw
         return self
 
     @property
@@ -40,17 +43,24 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return jax.random.key_data(self._key)
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.key(self._seed)
+            return jax.random.key_data(self._key)
 
     def set_state(self, state):
-        self._key = jax.random.wrap_key_data(np.asarray(state))
+        key = jax.random.wrap_key_data(np.asarray(state))
+        with self._lock:
+            self._key = key
 
 
-_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+_default_generator = Generator(int(np.random.randint(0, 2**31 - 1)))
 
 
 class _TraceRNG:

@@ -1,0 +1,22 @@
+"""Shared host→mesh batch-sharding helper used by the hybrid (GSPMD) and
+context-parallel (shard_map) step builders."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+def make_shard_batch(mesh, spec_fn):
+    """Return shard_batch(arrays): device_put each array with the
+    `PartitionSpec` chosen by `spec_fn(ndim)` on `mesh`."""
+
+    def shard_batch(arrays):
+        out = []
+        for x in arrays:
+            arr = jnp.asarray(np.asarray(x)) if not isinstance(x, jax.Array) else x
+            out.append(jax.device_put(arr, NamedSharding(mesh, spec_fn(arr.ndim))))
+        return tuple(out)
+
+    return shard_batch

@@ -1,0 +1,77 @@
+"""Elastic training manager (reference: python/paddle/distributed/fleet/elastic/
+manager.py:130 ElasticManager; collective.py).
+
+The reference registers peers in etcd with heartbeat leases and watches the peer
+set; on scale events it rewrites endpoints and relaunches trainers with exit
+code 101. Here the registry is the launch KV master (TCPStore-backed): each node
+heartbeats a timestamped key; `watch()` classifies the alive set against the
+[np_min, np_max] elastic range. TPU note: scale units are whole hosts (a slice
+topology change also changes the device mesh, so a restart re-initializes JAX
+with the new coordinator world).
+"""
+from __future__ import annotations
+
+import time
+
+ELASTIC_EXIT_CODE = 101  # manager.py:37
+ELASTIC_TIMEOUT = 30  # manager.py:41
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"  # alive < np_min: wait for peers (within timeout)
+    RESTART = "restart"  # peer set changed but still viable: relaunch
+    EXIT = "exit"  # unrecoverable
+
+
+class ElasticManager:
+    def __init__(self, master, node_rank: int, np_min: int, np_max: int,
+                 timeout: float = ELASTIC_TIMEOUT, stale_after: float = 10.0):
+        self.master = master
+        self.node_rank = node_rank
+        self.np_min = np_min
+        self.np_max = np_max
+        self.timeout = timeout
+        self.stale_after = stale_after
+        self._last_alive = None
+        self._hold_since = None
+        self.enabled = np_max > np_min
+
+    def register(self, interval: float = 2.0):
+        self.master.start_heartbeat(self.node_rank, interval=interval)
+
+    def exit(self):
+        self.master.stop_heartbeat()
+
+    # ------------------------------------------------------------------ watch
+    def alive(self):
+        return self.master.alive_peers(self.np_max, stale_after=self.stale_after)
+
+    def watch(self) -> str:
+        """One poll of the peer set → ElasticStatus. The launcher loop calls this
+        alongside pod.poll(); RESTART means kill + re-rendezvous (ranks are
+        reassigned stably by previous rank order, reference manager.py
+        _match/_update_hosts)."""
+        alive = self.alive()
+        n = len(alive)
+        if self._last_alive is None:
+            self._last_alive = alive
+        if n < self.np_min:
+            if self._hold_since is None:
+                self._hold_since = time.time()
+            if time.time() - self._hold_since > self.timeout:
+                return ElasticStatus.EXIT
+            return ElasticStatus.HOLD
+        self._hold_since = None
+        if set(alive) != set(self._last_alive):
+            self._last_alive = alive
+            return ElasticStatus.RESTART
+        return ElasticStatus.COMPLETED
+
+    # ----------------------------------------------------- fault tolerance
+    def match(self, alive=None) -> bool:
+        """True when the current alive set can run the job (reference
+        manager.py:98 test_match_faulttolerance)."""
+        alive = self.alive() if alive is None else alive
+        return self.np_min <= len(alive) <= self.np_max

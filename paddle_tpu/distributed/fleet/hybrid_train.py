@@ -210,14 +210,10 @@ def build_hybrid_step(model, optimizer, loss_fn, mesh: Mesh, zero_stage: int = 0
         donate_argnums=(0,) if donate else (),
     )
 
-    def shard_batch(arrays):
-        out = []
-        for x in arrays:
-            arr = jnp.asarray(np.asarray(x)) if not isinstance(x, jax.Array) else x
-            out.append(jax.device_put(arr, NamedSharding(mesh, _batch_spec(arr.ndim, mesh))))
-        return tuple(out)
+    from .._sharding_utils import make_shard_batch
 
-    return init_fn, step_jit, shard_batch
+    return init_fn, step_jit, make_shard_batch(
+        mesh, lambda ndim: _batch_spec(ndim, mesh))
 
 
 class HybridParallelModel:

@@ -1,0 +1,11 @@
+"""paddle.distributed.launch — multi-node TPU job launcher.
+
+Reference: python/paddle/distributed/launch/ (D23 in SURVEY.md §2.2).
+"""
+from .context import Context
+from .controller import ELASTIC_EXIT_CODE, CollectiveController, PSController
+from .main import launch
+from .master import KVMaster
+
+__all__ = ["launch", "Context", "CollectiveController", "PSController",
+           "KVMaster", "ELASTIC_EXIT_CODE"]

@@ -249,6 +249,10 @@ def split_sequence(x, axis_name, seq_dim=1):
     """Take this device's sequence shard of a replicated tensor (in-graph)."""
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    if x.shape[seq_dim] % n != 0:
+        raise ValueError(
+            f"sequence length {x.shape[seq_dim]} not divisible by "
+            f"{axis_name!r} axis size {n}")
     sl = x.shape[seq_dim] // n
     return lax.dynamic_slice_in_dim(x, idx * sl, sl, axis=seq_dim)
 
@@ -272,13 +276,31 @@ def _shard_map(f, mesh, in_specs, out_specs):
                    check_rep=False)
 
 
+def _default_loss_weight(labels):
+    """Per-shard loss weight for the cross-shard weighted mean: the count of
+    non-ignored target tokens (ignore_index=-100, matching
+    nn.functional.cross_entropy's default) when the last labels tensor is
+    integer-typed; otherwise the shard's token count (equal across shards, so
+    it degenerates to a plain pmean)."""
+    import jax.numpy as jnp
+
+    if labels and jnp.issubdtype(jnp.asarray(labels[-1]).dtype, jnp.integer):
+        return jnp.sum(jnp.asarray(labels[-1]) != -100).astype(jnp.float32)
+    return jnp.float32(1.0)
+
+
 def build_context_parallel_step(model, optimizer, loss_fn, mesh,
                                 sp_axis: str = "sp", dp_axis: str = "dp",
-                                donate: bool = True):
+                                donate: bool = True, loss_weight_fn=None):
     """Build (init_fn, step_fn, shard_batch) for dp x sp (context-parallel)
     training: batch dim sharded over `dp_axis`, sequence dim over `sp_axis`,
     parameters replicated. The whole step runs inside one `shard_map`; attention
     inside the model dispatches to `ring_attention` via `sequence_parallel_scope`.
+
+    `loss_weight_fn(*labels) -> scalar` sets each shard's weight in the
+    cross-shard loss/grad mean (default: valid-token count, see
+    `_default_loss_weight`) so uneven ignore_index padding across shards still
+    reproduces the global mean exactly.
 
     Mirrors `fleet.hybrid_train.build_hybrid_step`'s contract:
     step_fn(state, key, lr, inputs, labels) -> (loss, new_state).
@@ -337,16 +359,28 @@ def build_context_parallel_step(model, optimizer, loss_fn, mesh,
             loss = lv._value if isinstance(lv, Tensor) else lv
             if loss.ndim > 0:
                 loss = jnp.mean(loss)
-            return loss.astype(jnp.float32), new_b
+            loss = loss.astype(jnp.float32)
+            # Weight each shard's mean by its valid-token count INSIDE the
+            # differentiated function: cross-shard activation flow (ring
+            # permutes) mixes shards' contributions into every device's grad,
+            # so the weight must scale the cotangent seed, not the result.
+            # psum of these scaled losses == the global token-weighted mean.
+            if grad_axes:
+                if loss_weight_fn is not None:
+                    w = loss_weight_fn(*[Tensor(x) for x in labels])
+                    w = jnp.asarray(w._value if isinstance(w, Tensor) else w,
+                                    dtype=jnp.float32)
+                else:
+                    w = _default_loss_weight(labels)
+                loss = loss * w / lax.psum(w, grad_axes)
+            return loss, new_b
 
-        # differentiate the LOCAL loss, then mean loss+grads across shards
-        # explicitly (equal token counts per shard => mean of means is exact)
         (loss, new_b), grads = jax.value_and_grad(
             forward, has_aux=True)(state["p"])
         if grad_axes:
-            loss = lax.pmean(loss, grad_axes)
+            loss = lax.psum(loss, grad_axes)
             grads = jax.tree_util.tree_map(
-                lambda g: lax.pmean(g, grad_axes), grads)
+                lambda g: lax.psum(g, grad_axes), grads)
         new_p, new_opt = optimizer.functional_update(
             state["p"], grads, state["opt"], lr)
         return loss, {"p": new_p, "frozen": state["frozen"], "b": new_b,
@@ -361,12 +395,6 @@ def build_context_parallel_step(model, optimizer, loss_fn, mesh,
 
     step_jit = jax.jit(step, donate_argnums=(0,) if donate else ())
 
-    def shard_batch(arrays):
-        out = []
-        for x in arrays:
-            arr = jnp.asarray(np.asarray(x)) if not isinstance(x, jax.Array) else x
-            out.append(jax.device_put(
-                arr, NamedSharding(mesh, _batch_spec(arr.ndim))))
-        return tuple(out)
+    from ._sharding_utils import make_shard_batch
 
-    return init_fn, step_jit, shard_batch
+    return init_fn, step_jit, make_shard_batch(mesh, _batch_spec)

@@ -77,3 +77,37 @@ def test_context_parallel_matches_single_device():
 
     np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
     assert got[-1] < got[0], "loss should decrease"
+
+
+def test_context_parallel_uneven_ignore_index_padding():
+    """Padding (ignore_index=-100) clustered at sequence tails gives shards
+    unequal valid-token counts; the weighted cross-shard mean must still match
+    the single-device global mean (a plain pmean of per-shard means would not)."""
+    paddle.seed(13)
+    model = GPTForCausalLM(_cfg())
+    B, S, steps, lr = 4, 64, 2, 0.1
+
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, VOCAB, (B, S)).astype(np.int64)
+    labels = rng.randint(0, VOCAB, (B, S)).astype(np.int64)
+    # last 24 of 64 tokens padded: on a 4-way sp axis the final 16-token shard
+    # is fully ignored and the third shard half ignored
+    labels[:, -24:] = -100
+
+    ref = _baseline_losses(model, ids, labels, steps, lr)
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4), ("dp", "sp"))
+    opt = paddle.optimizer.SGD(lr, parameters=model.parameters())
+    init_fn, step_fn, shard_batch = build_context_parallel_step(
+        model, opt, _loss_fn, mesh
+    )
+    state = init_fn()
+    xs = shard_batch([ids])
+    ys = shard_batch([labels])
+    got = []
+    key = jax.random.key(7)
+    for i in range(steps):
+        loss, state = step_fn(state, jax.random.fold_in(key, i), lr, xs, ys)
+        got.append(float(loss))
+
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)

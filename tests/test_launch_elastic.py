@@ -1,0 +1,171 @@
+"""Launch CLI + elastic tests (reference test model: test_fleet_launch_*.sh,
+test_fleet_elastic_manager.py — SURVEY.md §4/6,7)."""
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json, os, sys
+out = os.environ["TEST_OUT_DIR"]
+rank = os.environ.get("PADDLE_TRAINER_ID", "?")
+keep = {k: v for k, v in os.environ.items() if k.startswith("PADDLE_")}
+with open(os.path.join(out, f"rank{rank}.json"), "w") as f:
+    json.dump(keep, f)
+"""
+
+FLAKY_WORKER = """
+import os, sys
+if int(os.environ.get("PADDLE_RESTART_COUNT", "0")) == 0:
+    sys.exit(7)
+open(os.path.join(os.environ["TEST_OUT_DIR"], "ok"), "w").write("1")
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _run_launch(args, script_body, tmp_path, name, extra_env=None, timeout=60):
+    script = tmp_path / f"{name}.py"
+    script.write_text(script_body)
+    env = dict(os.environ, TEST_OUT_DIR=str(tmp_path), PYTHONPATH=REPO)
+    env.update(extra_env or {})
+    cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+           "--log_dir", str(tmp_path / "log")] + args + [str(script)]
+    return subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
+                          capture_output=True, text=True)
+
+
+def test_single_node_two_procs(tmp_path):
+    r = _run_launch(["--nnodes", "1", "--nproc_per_node", "2"], WORKER, tmp_path, "w")
+    assert r.returncode == 0, r.stdout + r.stderr
+    import json
+
+    e0 = json.load(open(tmp_path / "rank0.json"))
+    e1 = json.load(open(tmp_path / "rank1.json"))
+    assert e0["PADDLE_TRAINERS_NUM"] == "2" and e1["PADDLE_TRAINERS_NUM"] == "2"
+    assert e0["PADDLE_TRAINER_ID"] == "0" and e1["PADDLE_TRAINER_ID"] == "1"
+    eps = e0["PADDLE_TRAINER_ENDPOINTS"].split(",")
+    assert len(eps) == 2 and e1["PADDLE_CURRENT_ENDPOINT"] == eps[1]
+
+
+def test_two_node_rendezvous(tmp_path):
+    port = _free_port()
+    master = f"127.0.0.1:{port}"
+    script = tmp_path / "w.py"
+    script.write_text(WORKER)
+    env = dict(os.environ, TEST_OUT_DIR=str(tmp_path), PYTHONPATH=REPO)
+    procs = []
+    for rank in range(2):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", "2", "--master", master, "--rank", str(rank),
+               "--log_dir", str(tmp_path / "log"), str(script)]
+        procs.append(subprocess.Popen(cmd, env=env, cwd=REPO))
+        time.sleep(0.3)  # let rank 0 bind the store first
+    for p in procs:
+        assert p.wait(timeout=60) == 0
+    import json
+
+    e0 = json.load(open(tmp_path / "rank0.json"))
+    e1 = json.load(open(tmp_path / "rank1.json"))
+    assert e0["PADDLE_TRAINERS_NUM"] == "2"
+    assert {e0["PADDLE_TRAINER_ID"], e1["PADDLE_TRAINER_ID"]} == {"0", "1"}
+    assert e0["PADDLE_MASTER"] == e1["PADDLE_MASTER"]
+    assert e0["PADDLE_TRAINER_ENDPOINTS"] == e1["PADDLE_TRAINER_ENDPOINTS"]
+
+
+def test_restart_on_failure(tmp_path):
+    r = _run_launch(["--nnodes", "1", "--max_restart", "2"], FLAKY_WORKER, tmp_path, "f")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert (tmp_path / "ok").exists()
+
+
+def test_failure_exhausts_restarts(tmp_path):
+    r = _run_launch(["--nnodes", "1", "--max_restart", "1"],
+                    "import sys; sys.exit(7)", tmp_path, "bad")
+    assert r.returncode == 7
+
+
+ELASTIC_WORKER = """
+import json, os, sys, time
+out = os.environ["TEST_OUT_DIR"]
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+if world < 3:
+    time.sleep(600)  # hold until the scale-up restart kills us
+with open(os.path.join(out, f"done{os.environ['PADDLE_TRAINER_ID']}"), "w") as f:
+    f.write(os.environ["PADDLE_TRAINER_ENDPOINTS"])
+"""
+
+
+def test_elastic_scale_up(tmp_path):
+    port = _free_port()
+    master = f"127.0.0.1:{port}"
+    script = tmp_path / "w.py"
+    script.write_text(ELASTIC_WORKER)
+    env = dict(os.environ, TEST_OUT_DIR=str(tmp_path), PYTHONPATH=REPO)
+
+    def node(rank):
+        cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+               "--nnodes", "2:3", "--master", master, "--rank", str(rank),
+               "--log_dir", str(tmp_path / "log"), str(script)]
+        return subprocess.Popen(cmd, env=env, cwd=REPO)
+
+    procs = [node(0), node(1)]
+    time.sleep(8)  # let gen-0 (2-node world) deploy and start sleeping
+    procs.append(node(2))  # scale up — triggers restart into a 3-node world
+    try:
+        for p in procs:
+            assert p.wait(timeout=90) == 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    dones = sorted(f.name for f in tmp_path.glob("done*"))
+    assert dones == ["done0", "done1", "done2"]
+
+
+class _FakeMaster:
+    def __init__(self):
+        self.hb = {}
+
+    def start_heartbeat(self, rank, interval=2.0):
+        self.hb[rank] = time.time()
+
+    def stop_heartbeat(self):
+        pass
+
+    def alive_peers(self, nmax, stale_after=10.0):
+        now = time.time()
+        return [r for r, ts in sorted(self.hb.items()) if now - ts < stale_after]
+
+
+def test_elastic_manager_match_and_watch():
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+    m = _FakeMaster()
+    em = ElasticManager(m, node_rank=0, np_min=2, np_max=4, timeout=0.5,
+                        stale_after=5.0)
+    assert em.enabled
+    m.hb = {0: time.time(), 1: time.time()}
+    assert em.match()
+    assert em.watch() == ElasticStatus.COMPLETED
+    # scale up: new peer appears
+    m.hb[2] = time.time()
+    assert em.watch() == ElasticStatus.RESTART
+    assert em.watch() == ElasticStatus.COMPLETED
+    # node death below np_min: HOLD then EXIT after timeout
+    m.hb = {0: time.time()}
+    assert em.watch() == ElasticStatus.HOLD
+    time.sleep(0.6)
+    assert em.watch() == ElasticStatus.EXIT
+    assert not em.match()
