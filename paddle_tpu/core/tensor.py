@@ -33,6 +33,7 @@ class Tensor:
         "name",
         "_is_param",
         "_sharding_spec",
+        "_dist_attr",
         "trainable",
         "optimize_attr",
         "regularizer",

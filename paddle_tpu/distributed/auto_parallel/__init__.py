@@ -1,0 +1,32 @@
+"""paddle.distributed.auto_parallel — semi-automatic parallelization (D25).
+
+Reference: python/paddle/distributed/auto_parallel/ (20.2k LoC: interface,
+completion, partitioner, reshard, planner, engine). TPU-native mapping:
+
+- ProcessMesh            → named view over jax.devices() → jax.sharding.Mesh
+- shard_tensor/shard_op  → NamedSharding annotations (device_put / constraint)
+- completion.py          → GSPMD sharding propagation, read from the compiled
+                           executable (complete())
+- partitioner + reshard  → XLA SPMD partitioner; reshard() is one device_put
+- planner + cost model   → plan_mesh() with an alpha-beta ICI cost model
+- Engine                 → plan + compile one pjit train step; fit/evaluate/
+                           predict/save/load
+"""
+from .completion import complete
+from .cost_model import ClusterSpec, CommCostModel, CompCostModel
+from .engine import Engine
+from .interface import (
+    TensorDistAttr,
+    dist_attr,
+    reshard,
+    shard_op,
+    shard_tensor,
+)
+from .planner import plan_mesh
+from .process_mesh import ProcessMesh
+
+__all__ = [
+    "ProcessMesh", "shard_tensor", "shard_op", "reshard", "dist_attr",
+    "TensorDistAttr", "complete", "plan_mesh", "Engine", "ClusterSpec",
+    "CommCostModel", "CompCostModel",
+]

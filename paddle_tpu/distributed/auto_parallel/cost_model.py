@@ -1,0 +1,66 @@
+"""Alpha-beta cost model for collectives + per-op FLOPs estimates.
+
+Reference: python/paddle/distributed/auto_parallel/cost_model.py and cost/
+(comm & comp cost classes keyed on op + dist attr). TPU-native constants: ICI
+link bandwidth and MXU peak for a v5p-class chip; the planner only needs
+*relative* costs, so rough constants are fine and overridable.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ClusterSpec:
+    """One TPU slice. Defaults approximate a v5p chip."""
+
+    chips: int = 8
+    peak_flops: float = 459e12  # bf16 FLOPs/s per chip
+    hbm_bytes: float = 95e9
+    hbm_bandwidth: float = 2.7e12  # bytes/s
+    ici_bandwidth: float = 90e9  # bytes/s per link direction
+    dcn_bandwidth: float = 6.25e9  # bytes/s per host
+    ici_latency: float = 1e-6
+    dcn_latency: float = 10e-6
+
+
+class CommCostModel:
+    """Ring-based collective timing: t = alpha * steps + moved_bytes / bw."""
+
+    def __init__(self, cluster: ClusterSpec | None = None, over_dcn: bool = False):
+        self.cluster = cluster or ClusterSpec()
+        self.bw = self.cluster.dcn_bandwidth if over_dcn else self.cluster.ici_bandwidth
+        self.alpha = self.cluster.dcn_latency if over_dcn else self.cluster.ici_latency
+
+    def all_reduce(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return 2 * (n - 1) * self.alpha + 2 * (n - 1) / n * nbytes / self.bw
+
+    def all_gather(self, nbytes: float, n: int) -> float:
+        # nbytes = full (gathered) size
+        if n <= 1:
+            return 0.0
+        return (n - 1) * self.alpha + (n - 1) / n * nbytes / self.bw
+
+    reduce_scatter = all_gather
+
+    def all_to_all(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return (n - 1) * self.alpha + (n - 1) / n * nbytes / self.bw / n
+
+    def p2p(self, nbytes: float) -> float:
+        return self.alpha + nbytes / self.bw
+
+
+class CompCostModel:
+    def __init__(self, cluster: ClusterSpec | None = None, mfu: float = 0.4):
+        self.cluster = cluster or ClusterSpec()
+        self.mfu = mfu
+
+    def matmul_time(self, flops: float) -> float:
+        return flops / (self.cluster.peak_flops * self.mfu)
+
+    def hbm_time(self, nbytes: float) -> float:
+        return nbytes / self.cluster.hbm_bandwidth

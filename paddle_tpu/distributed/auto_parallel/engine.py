@@ -1,0 +1,175 @@
+"""Auto-parallel Engine — annotate, plan, compile, train.
+
+Reference: python/paddle/distributed/auto_parallel/engine.py:49 (`Engine`,
+fit:181): wraps a model + loss + optimizer, runs completion/partitioner/reshard
+over the program, then executes. TPU-native: planning picks a ProcessMesh
+(planner.py) unless the user supplies one, parameter annotations made with
+`shard_tensor` are honored via `Tensor._sharding_spec`, and the
+completion+partition step IS the GSPMD compile of one pjit'd train step
+(fleet.hybrid_train.build_hybrid_step).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ...core.tensor import Tensor
+from ..fleet.distributed_strategy import DistributedStrategy
+from ..fleet.hybrid_train import build_hybrid_step, mesh_scope
+from .planner import plan_mesh
+from .process_mesh import ProcessMesh
+
+
+def _to_numpy_batch(data):
+    if isinstance(data, (list, tuple)):
+        return [np.asarray(d.numpy() if isinstance(d, Tensor) else d) for d in data]
+    return [np.asarray(data)]
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy: DistributedStrategy | None = None,
+                 process_mesh: ProcessMesh | None = None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+        self.strategy = strategy or DistributedStrategy()
+        self.process_mesh = process_mesh
+        self._mesh = None
+        self._step_fn = None
+        self._shard_batch = None
+        self._state = None
+        self.history = {"loss": []}
+
+    # ------------------------------------------------------------- planning
+    def _plan(self):
+        if self.process_mesh is None:
+            n_params = sum(int(np.prod(p.shape)) for p in self.model.parameters())
+            self.process_mesh = plan_mesh(jax.device_count(), n_params)
+        self._mesh = self.process_mesh.jax_mesh()
+        return self._mesh
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Plan the mesh and compile the train step (completion+partition)."""
+        mesh = self._plan()
+        strat = self.strategy
+        zero = strat.sharding_configs.get("stage", 1) if strat.sharding else 0
+        amp_level = strat.amp_configs.get("level", "O1") if strat.amp else "O0"
+        init_fn, step_fn, shard_batch = build_hybrid_step(
+            self.model, self.optimizer, self._loss_fn, mesh,
+            zero_stage=zero, amp_level=amp_level,
+            recompute=strat.recompute)
+        self._state = init_fn()
+        self._step_fn = step_fn
+        self._shard_batch = shard_batch
+        return self
+
+    def _loss_fn(self, *args):
+        if self.loss is None:
+            return args[0]
+        return self.loss(*args)
+
+    # ------------------------------------------------------------- training
+    def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
+            log_freq=10, verbose=0, n_inputs=1):
+        """train_data: an iterable of batches (DataLoader or list of
+        (inputs..., labels...) tuples). n_inputs: how many leading arrays of
+        each batch are model inputs (the rest are labels)."""
+        if self._step_fn is None:
+            self.prepare()
+        lr = (self.optimizer.get_lr() if hasattr(self.optimizer, "get_lr")
+              else 1e-3)
+        key = jax.random.key(np.random.randint(0, 2**31 - 1))
+        step_idx = 0
+        loss = None
+        for epoch in range(epochs):
+            for batch in train_data:
+                arrs = _to_numpy_batch(batch)
+                inputs = self._shard_batch(arrs[:n_inputs])
+                labels = self._shard_batch(arrs[n_inputs:])
+                loss, self._state = self._step_fn(
+                    self._state, jax.random.fold_in(key, step_idx),
+                    np.float32(lr), inputs, labels)
+                step_idx += 1
+                if step_idx % log_freq == 0:
+                    self.history["loss"].append(float(loss))
+                    if verbose:
+                        print(f"epoch {epoch} step {step_idx}: "
+                              f"loss={float(loss):.5f}")
+                if steps_per_epoch and step_idx % steps_per_epoch == 0:
+                    break
+        if loss is not None and step_idx % log_freq != 0:
+            self.history["loss"].append(float(loss))
+        self._sync_params_back()
+        return self.history
+
+    # ----------------------------------------------------------- inference
+    def _eval_forward(self, arrs, n_inputs=1):
+        if self._state is None:
+            self.prepare()
+        params = {**self._state["p"], **self._state["frozen"]}
+        with mesh_scope(self._mesh):
+            out, _ = self.model.functional_call(
+                params, self._state["b"],
+                *[Tensor(a) for a in self._shard_batch(arrs[:n_inputs])])
+        return out
+
+    def evaluate(self, eval_data, batch_size=None, n_inputs=1, verbose=0):
+        results = {}
+        losses = []
+        for m in self.metrics:
+            m.reset()
+        for batch in eval_data:
+            arrs = _to_numpy_batch(batch)
+            out = self._eval_forward(arrs, n_inputs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            labels = [Tensor(a) for a in arrs[n_inputs:]]
+            if self.loss is not None:
+                losses.append(float(self._loss_fn(*(list(outs) + labels)).numpy()))
+            for m in self.metrics:
+                m.update(m.compute(outs[0], *labels))
+        if losses:
+            results["loss"] = float(np.mean(losses))
+        for m in self.metrics:
+            name = m.name() if callable(getattr(m, "name", None)) else "metric"
+            if isinstance(name, (list, tuple)):
+                name = name[0]
+            results[name] = m.accumulate()
+        return results
+
+    def predict(self, test_data, n_inputs=None):
+        preds = []
+        for batch in test_data:
+            arrs = _to_numpy_batch(batch)
+            n = len(arrs) if n_inputs is None else n_inputs
+            out = self._eval_forward(arrs, n)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            preds.append([np.asarray(o.numpy() if isinstance(o, Tensor) else o)
+                          for o in outs])
+        return preds
+
+    # ---------------------------------------------------------- checkpoint
+    def _sync_params_back(self):
+        """Write trained device values back into the model's Tensors."""
+        params, _ = self.model.functional_state()
+        for k, v in self._state["p"].items():
+            if k in params and params[k] is not None:
+                params[k]._value = v
+
+    def save(self, path):
+        from ...framework.io import save
+
+        self._sync_params_back()
+        save(self.model.state_dict(), path if path.endswith(".pdparams")
+             else path + ".pdparams")
+
+    def load(self, path):
+        from ...framework.io import load
+
+        sd = load(path if path.endswith(".pdparams") else path + ".pdparams")
+        self.model.set_state_dict(sd)
+        if self._step_fn is not None:
+            self.prepare()  # re-lay-out new weights

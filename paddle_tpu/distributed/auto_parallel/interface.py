@@ -1,0 +1,112 @@
+"""Auto-parallel annotation API: shard_tensor / shard_op / dist attributes.
+
+Reference: python/paddle/distributed/auto_parallel/interface.py (shard_tensor,
+shard_op) + dist_attribute.py (TensorDistributedAttribute: process_mesh +
+dims_mapping). TPU-native: an annotation IS a `NamedSharding`; eager tensors are
+device_put immediately, traced values get `with_sharding_constraint`, and
+parameter annotations are remembered in `Tensor._sharding_spec` so every step
+builder (hybrid, Engine) lays them out the same way.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+
+class TensorDistAttr:
+    """process_mesh + dims_mapping (reference dist_attribute.py)."""
+
+    def __init__(self, process_mesh: ProcessMesh, dims_mapping):
+        self.process_mesh = process_mesh
+        # dims_mapping[i] = mesh-dim name (or None) that tensor dim i is split over
+        self.dims_mapping = list(dims_mapping)
+
+    def partition_spec(self) -> P:
+        return P(*self.dims_mapping)
+
+    def __repr__(self):
+        return f"TensorDistAttr({self.process_mesh}, {self.dims_mapping})"
+
+
+def _normalize_spec(shard_spec, ndim, mesh: ProcessMesh):
+    if shard_spec is None:
+        shard_spec = [None] * ndim
+    if len(shard_spec) != ndim:
+        raise ValueError(f"shard_spec {shard_spec} for a {ndim}-d tensor")
+    for s in shard_spec:
+        if s is not None and s not in mesh.dim_names:
+            raise ValueError(f"unknown mesh dim {s!r}; mesh has {mesh.dim_names}")
+    return list(shard_spec)
+
+
+def shard_tensor(x, process_mesh: ProcessMesh, shard_spec=None):
+    """Annotate (and, eagerly, lay out) `x` with a mesh-dim mapping.
+
+    shard_spec: per-dim mesh-dim name or None, e.g. ["dp", None] shards dim 0
+    over mesh dim "dp". Returns the annotated tensor.
+    """
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _normalize_spec(shard_spec, t.ndim, process_mesh)
+    t._sharding_spec = tuple(spec)
+    t._dist_attr = TensorDistAttr(process_mesh, spec)
+    arr = t._value
+    if not _is_traced(arr):
+        sharding = NamedSharding(process_mesh.jax_mesh(), P(*spec))
+        t._value = jax.device_put(arr, sharding)
+    else:
+        t._value = jax.lax.with_sharding_constraint(
+            arr, NamedSharding(process_mesh.jax_mesh(), P(*spec)))
+    return t
+
+
+def shard_op(op_fn, process_mesh: ProcessMesh, in_shard_specs=None,
+             out_shard_specs=None):
+    """Wrap `op_fn` so its inputs/outputs carry sharding constraints (reference
+    interface.py shard_op). Under jit this pins GSPMD's propagation at the op
+    boundary; eagerly it device_puts."""
+
+    def wrapped(*args, **kwargs):
+        args = list(args)
+        if in_shard_specs is not None:
+            for i, spec in enumerate(in_shard_specs):
+                if spec is not None and i < len(args):
+                    args[i] = shard_tensor(args[i], process_mesh, spec)
+        out = op_fn(*args, **kwargs)
+        if out_shard_specs is not None:
+            single = not isinstance(out, (tuple, list))
+            outs = [out] if single else list(out)
+            for i, spec in enumerate(out_shard_specs):
+                if spec is not None and i < len(outs):
+                    outs[i] = shard_tensor(outs[i], process_mesh, spec)
+            out = outs[0] if single else type(out)(outs)
+        return out
+
+    return wrapped
+
+
+def reshard(x, process_mesh: ProcessMesh, shard_spec=None):
+    """Move a tensor to a (new) mesh/layout. XLA emits the collectives
+    (all-gather / all-to-all / slice) implied by the transition — the entire
+    reference reshard.py machinery collapses into one device_put."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    spec = _normalize_spec(shard_spec, t.ndim, process_mesh)
+    sharding = NamedSharding(process_mesh.jax_mesh(), P(*spec))
+    if _is_traced(t._value):
+        out = jax.lax.with_sharding_constraint(t._value, sharding)
+    else:
+        out = jax.device_put(t._value, sharding)
+    nt = Tensor(out, stop_gradient=t.stop_gradient)
+    nt._sharding_spec = tuple(spec)
+    nt._dist_attr = TensorDistAttr(process_mesh, spec)
+    return nt
+
+
+def dist_attr(x) -> "TensorDistAttr | None":
+    return getattr(x, "_dist_attr", None)
+
+
+def _is_traced(arr) -> bool:
+    return isinstance(arr, jax.core.Tracer)

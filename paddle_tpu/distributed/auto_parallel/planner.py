@@ -1,0 +1,76 @@
+"""Mesh planner — pick (dp, mp, sharding) degrees for a model + device count.
+
+Reference: python/paddle/distributed/auto_parallel/planner.py / tuner: searches
+over dist-attr assignments with the cost model. TPU-native scope: GSPMD does
+per-op partitioning; the remaining global decision is the mesh shape. The
+planner scores candidate meshes with the alpha-beta cost model: tensor
+parallelism only when a chip can't hold the params (+grads+opt), ZeRO sharding
+when replication would overflow HBM, data parallel otherwise (cheapest
+collective volume per step).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .cost_model import ClusterSpec, CommCostModel
+from .process_mesh import ProcessMesh
+
+
+def _divisors_pow2(n: int):
+    d = 1
+    while d <= n:
+        if n % d == 0:
+            yield d
+        d *= 2
+
+
+def plan_mesh(n_devices: int, n_params: int, dtype_bytes: int = 4,
+              opt_slots: int = 2, cluster: ClusterSpec | None = None,
+              batch_bytes: float = 0.0) -> ProcessMesh:
+    """Choose a [dp, sharding, mp] mesh for `n_devices` chips.
+
+    Heuristic (scaling-book recipe): keep everything data-parallel while
+    per-chip state fits; turn on ZeRO ('sharding' axis) when optimizer state
+    replication overflows; add model parallel ('mp') only when even sharded
+    params per chip exceed HBM — mp pays an allreduce per layer, the most
+    expensive option.
+    """
+    cluster = cluster or ClusterSpec()
+    comm = CommCostModel(cluster)
+    param_bytes = float(n_params) * dtype_bytes
+    state_bytes = param_bytes * (1 + 1 + opt_slots)  # params + grads + slots
+    budget = cluster.hbm_bytes * 0.6  # leave room for activations/workspace
+
+    # Minimal model-splitting that fits, preferring sharding (ZeRO) over mp:
+    # ZeRO only moves param-sized bytes per step, mp pays activation
+    # allreduces per layer. Among fitting candidates of equal total split,
+    # break ties with the cost model.
+    best = None
+    for mp in _divisors_pow2(n_devices):
+        rest = n_devices // mp
+        for sh in _divisors_pow2(rest):
+            dp = rest // sh
+            # memory per chip: params split over mp; opt state further over sh
+            per_chip = param_bytes / mp + (state_bytes - param_bytes) / (mp * sh)
+            if per_chip > budget:
+                continue
+            cost = 0.0
+            if dp > 1:
+                cost += comm.all_reduce(param_bytes / (mp * sh), dp)
+            if sh > 1:
+                cost += comm.all_gather(param_bytes / mp, sh) + \
+                    comm.reduce_scatter(param_bytes / mp, sh)
+            if mp > 1:
+                # per-step activation allreduce volume; floor it at a
+                # param-scale estimate so mp is never modeled as free
+                act = max(batch_bytes, param_bytes)
+                cost += comm.all_reduce(act, mp) * 4
+            key = (mp * sh, cost)  # minimize splitting first, then comm time
+            if best is None or key < best[0]:
+                best = (key, dp, sh, mp)
+    if best is None:  # nothing fits: max sharding
+        dp, sh, mp = 1, 1, n_devices
+    else:
+        _, dp, sh, mp = best
+    ids = np.arange(n_devices).reshape(dp, sh, mp)
+    return ProcessMesh(ids, dim_names=["dp", "sharding", "mp"])

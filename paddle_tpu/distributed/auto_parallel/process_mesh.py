@@ -1,0 +1,76 @@
+"""ProcessMesh — the auto-parallel device mesh abstraction.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py (ProcessMesh)
+and framework.proto ProcessMeshDesc:41. TPU-native: a ProcessMesh is a named
+view over `jax.devices()`; `jax_mesh()` materializes the `jax.sharding.Mesh`
+whose axis names drive every GSPMD annotation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._shape = arr.shape
+        self._process_ids = [int(i) for i in arr.flatten()]
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"{len(dim_names)} dim_names for a {arr.ndim}-d mesh")
+        self._dim_names = list(dim_names)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    # paddle alias
+    processes = process_ids
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def size(self):
+        return int(np.prod(self._shape))
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def jax_mesh(self, devices=None) -> Mesh:
+        """Materialize as jax Mesh: process ids index into the device list."""
+        devices = list(jax.devices()) if devices is None else list(devices)
+        if max(self._process_ids) >= len(devices):
+            raise ValueError(
+                f"mesh needs process id {max(self._process_ids)} but only "
+                f"{len(devices)} devices are present")
+        devs = np.asarray([devices[i] for i in self._process_ids]).reshape(self._shape)
+        return Mesh(devs, tuple(self._dim_names))
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self._shape == other._shape
+                and self._process_ids == other._process_ids
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._shape, tuple(self._process_ids), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={list(self._shape)}, "
+                f"dim_names={self._dim_names})")

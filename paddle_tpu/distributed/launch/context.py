@@ -4,8 +4,9 @@ python/paddle/distributed/launch/context/__init__.py and args_envs.py).
 TPU-native notes: a "node" is one host of a TPU slice; the default is ONE
 trainer process per host (the TPU runtime owns all local chips — JAX single
 controller per host), unlike the reference's one-proc-per-GPU. `--nproc_per_node`
-remains available for CPU-simulation runs (each proc gets JAX_PLATFORMS=cpu and
-a virtual device count).
+remains available for CPU-simulation runs (without --devices, each proc is
+pinned to JAX_PLATFORMS=cpu; with --devices, the id list is partitioned across
+local procs via TPU_VISIBLE_DEVICES).
 """
 from __future__ import annotations
 

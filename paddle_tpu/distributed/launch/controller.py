@@ -30,11 +30,11 @@ class CollectiveController:
         self.elastic = None
 
     # ------------------------------------------------------------ rendezvous
-    def _local_world(self):
+    def _make_record(self):
         node = self.ctx.node
         eps = [f"{node.ip}:{node.get_free_port()}"
                for _ in range(self.ctx.args.nproc_per_node)]
-        return [0], {0: {"ip": node.ip, "endpoints": eps}}
+        return {"ip": node.ip, "endpoints": eps}
 
     def _rendezvous(self):
         """Returns (member_ranks, {rank: record}) for this generation, or None
@@ -42,7 +42,7 @@ class CollectiveController:
         args = self.ctx.args
         if self.ctx.nnodes_max == 1 and not args.master:
             self.node_rank = 0
-            return self._local_world()
+            return [0], {0: self._make_record()}
 
         if self.master is None:
             self.master = KVMaster(args.master, args.rank, job_id=args.job_id)
@@ -51,11 +51,8 @@ class CollectiveController:
                 self.elastic = ElasticManager(
                     self.master, self.node_rank, self.ctx.nnodes_min,
                     self.ctx.nnodes_max, timeout=args.elastic_timeout)
-        node = self.ctx.node
-        eps = [f"{node.ip}:{node.get_free_port()}"
-               for _ in range(args.nproc_per_node)]
         self.master.register(self.generation, self.node_rank,
-                             {"ip": node.ip, "endpoints": eps})
+                             self._make_record())
         if self.node_rank == 0:
             self.master.publish_world(self.generation, self.ctx.nnodes_min,
                                       self.ctx.nnodes_max)
@@ -96,7 +93,17 @@ class CollectiveController:
                 "PADDLE_RESTART_COUNT": self.restart_count,
             }
             if args.devices:
-                env["PADDLE_DEVICES"] = args.devices
+                # partition the visible device ids across local procs
+                ids = args.devices.split(",")
+                per = max(1, len(ids) // args.nproc_per_node)
+                mine = ids[local_rank * per:(local_rank + 1) * per]
+                env["PADDLE_DEVICES"] = ",".join(mine)
+                env["TPU_VISIBLE_DEVICES"] = ",".join(mine)
+            elif args.nproc_per_node > 1:
+                # Multiple trainer procs on one host can't share the TPU
+                # runtime (libtpu is single-process) — this mode is for
+                # CPU-simulation runs, so pin the procs to the CPU backend.
+                env["JAX_PLATFORMS"] = "cpu"
             log = os.path.join(args.log_dir, f"workerlog.{grank}")
             self.pod.add(Container(entry, env, log))
 
@@ -172,6 +179,9 @@ class PSController(CollectiveController):
 
     def build_ps_pod(self):
         args = self.ctx.args
+        if args.server_num + args.trainer_num == 0:
+            raise ValueError(
+                "--run_mode ps needs --server_num and/or --trainer_num > 0")
         node = self.ctx.node
         server_eps = [f"{node.ip}:{node.get_free_port()}" for _ in range(args.server_num)]
         trainer_eps = [f"{node.ip}:{node.get_free_port()}" for _ in range(args.trainer_num)]

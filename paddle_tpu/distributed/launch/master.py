@@ -110,18 +110,29 @@ class KVMaster:
     def start_heartbeat(self, rank: int, interval: float = 2.0):
         if self._hb_thread is not None and self._hb_thread.is_alive():
             return
-        self._hb_stop.clear()
+        # Per-start Event (a revived heartbeat must not share the stopped
+        # thread's flag) and a dedicated store connection (no lock contention
+        # with the launcher loop's ops).
+        stop = threading.Event()
+        conn = self.store.clone()
+        key = self._k("hb", rank)
 
         def beat():
-            while not self._hb_stop.is_set():
-                self.store.set(self._k("hb", rank), str(time.time()))
-                self._hb_stop.wait(interval)
+            while not stop.is_set():
+                try:
+                    conn.set(key, str(time.time()))
+                except (OSError, ConnectionError):
+                    pass  # transient store outage; retry next tick
+                stop.wait(interval)
 
+        self._hb_stop = stop
         self._hb_thread = threading.Thread(target=beat, daemon=True)
         self._hb_thread.start()
 
     def stop_heartbeat(self):
         self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
         self._hb_thread = None
 
     def alive_peers(self, nnodes_max: int = None, stale_after: float = 10.0):

@@ -372,7 +372,9 @@ def build_context_parallel_step(model, optimizer, loss_fn, mesh,
                                     dtype=jnp.float32)
                 else:
                     w = _default_loss_weight(labels)
-                loss = loss * w / lax.psum(w, grad_axes)
+                # clamp: a batch with zero valid tokens everywhere must give
+                # loss 0, not 0/0 NaN (which would poison params via the grads)
+                loss = loss * w / jnp.maximum(lax.psum(w, grad_axes), 1e-8)
             return loss, new_b
 
         (loss, new_b), grads = jax.value_and_grad(

@@ -11,14 +11,22 @@ import time
 
 
 class TCPStore:
+    """Thread-safety: every op is a short request/response guarded by one lock
+    (`wait` polls `get` client-side rather than blocking on the socket), so a
+    single TCPStore may be shared across threads. For hot concurrent use (e.g.
+    a heartbeat thread) prefer `clone()` — a second connection to the same
+    server — to avoid serializing on the lock."""
+
     def __init__(self, host: str, port: int, world_size: int = 1,
                  is_master: bool = False, timeout: float = 30.0):
         self.host = host
         self.port = port
         self.is_master = is_master
+        self.timeout = timeout
         self._server = None
         self._client = None
         self._py_server = None
+        self._oplock = threading.Lock()
         from .native import build, lib
 
         l = lib or build()
@@ -35,43 +43,57 @@ class TCPStore:
             self._py_server = _PyServer(port)
         self._sock = _connect(host, port, timeout)
 
+    def clone(self) -> "TCPStore":
+        """New client connection to the same server (own socket, own lock)."""
+        return TCPStore(self.host, self.port, is_master=False,
+                        timeout=self.timeout)
+
     # ------------------------------------------------------------- ops
     def set(self, key: str, value):
         data = value if isinstance(value, bytes) else str(value).encode()
-        if self._lib:
-            self._lib.ptq_store_set(self._client, key.encode(), data, len(data))
-            return
-        _send(self._sock, b"S", key, data)
-        self._sock.recv(1)
+        with self._oplock:
+            if self._lib:
+                self._lib.ptq_store_set(self._client, key.encode(), data, len(data))
+                return
+            _send(self._sock, b"S", key, data)
+            self._sock.recv(1)
 
     def get(self, key: str) -> bytes:
-        if self._lib:
-            buf = ctypes.create_string_buffer(1 << 20)
-            n = self._lib.ptq_store_get(self._client, key.encode(), buf, len(buf), -1)
+        with self._oplock:
+            if self._lib:
+                buf = ctypes.create_string_buffer(1 << 20)
+                n = self._lib.ptq_store_get(self._client, key.encode(), buf, len(buf), -1)
+                if n < 0:
+                    raise KeyError(key)
+                return buf.raw[:n]
+            _send(self._sock, b"G", key)
+            (n,) = struct.unpack("<i", _recvn(self._sock, 4))
             if n < 0:
                 raise KeyError(key)
-            return buf.raw[:n]
-        _send(self._sock, b"G", key)
-        (n,) = struct.unpack("<i", _recvn(self._sock, 4))
-        if n < 0:
-            raise KeyError(key)
-        return _recvn(self._sock, n)
+            return _recvn(self._sock, n)
 
     def add(self, key: str, amount: int) -> int:
-        if self._lib:
-            return int(self._lib.ptq_store_add(self._client, key.encode(), amount))
-        _send(self._sock, b"A", key, struct.pack("<q", amount))
-        (v,) = struct.unpack("<q", _recvn(self._sock, 8))
-        return v
+        with self._oplock:
+            if self._lib:
+                return int(self._lib.ptq_store_add(self._client, key.encode(), amount))
+            _send(self._sock, b"A", key, struct.pack("<q", amount))
+            (v,) = struct.unpack("<q", _recvn(self._sock, 8))
+            return v
 
     def wait(self, keys, timeout=None):
+        """Client-side polling wait: never holds the socket/lock across a
+        blocking server call, so other threads' ops interleave cleanly."""
         keys = [keys] if isinstance(keys, str) else keys
+        deadline = None if timeout is None else time.time() + timeout
         for k in keys:
-            if self._lib:
-                self._lib.ptq_store_wait(self._client, k.encode(), -1)
-            else:
-                _send(self._sock, b"W", k)
-                _recvn(self._sock, 1)
+            while True:
+                try:
+                    self.get(k)
+                    break
+                except KeyError:
+                    if deadline is not None and time.time() > deadline:
+                        raise TimeoutError(f"timed out waiting for key {k!r}")
+                    time.sleep(0.05)
 
     def __del__(self):
         try:

@@ -1,0 +1,121 @@
+"""Auto-parallel tests (reference model: tests/unittests/auto_parallel/ —
+SURVEY.md §4/5). Runs on the 8-device CPU mesh from conftest."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel import (
+    ClusterSpec,
+    CommCostModel,
+    Engine,
+    ProcessMesh,
+    complete,
+    plan_mesh,
+    reshard,
+    shard_tensor,
+)
+
+
+def test_process_mesh_basics():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    assert pm.shape == [2, 4] and pm.ndim == 2 and pm.size == 8
+    assert pm.get_dim_size("mp") == 4
+    m = pm.jax_mesh()
+    assert m.axis_names == ("dp", "mp")
+    assert m.devices.shape == (2, 4)
+    pm2 = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["dp", "mp"])
+    assert pm == pm2
+    with pytest.raises(ValueError):
+        ProcessMesh(np.arange(4), dim_names=["a", "b"])
+
+
+def test_shard_tensor_eager_layout():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["x", "y"])
+    t = shard_tensor(np.ones((8, 16), np.float32), pm, ["x", "y"])
+    sh = t._value.sharding
+    assert isinstance(sh, NamedSharding)
+    assert tuple(sh.spec) == ("x", "y")
+    # each device holds an (4, 4) shard
+    shard = t._value.addressable_shards[0]
+    assert shard.data.shape == (4, 4)
+    assert t._sharding_spec == ("x", "y")
+    with pytest.raises(ValueError):
+        shard_tensor(np.ones((4, 4)), pm, ["nope", None])
+
+
+def test_reshard_changes_layout():
+    pm = ProcessMesh(np.arange(8), dim_names=["x"])
+    t = shard_tensor(np.arange(64, dtype=np.float32).reshape(8, 8), pm, ["x", None])
+    assert t._value.addressable_shards[0].data.shape == (1, 8)
+    r = reshard(t, pm, [None, "x"])
+    assert r._value.addressable_shards[0].data.shape == (8, 1)
+    np.testing.assert_array_equal(np.asarray(r._value),
+                                  np.arange(64, dtype=np.float32).reshape(8, 8))
+
+
+def test_completion_propagates_shardings():
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    mesh = pm.jax_mesh()
+
+    def f(x, w):
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("dp", None)))
+        w = jax.lax.with_sharding_constraint(
+            w, NamedSharding(mesh, P(None, "mp")))
+        return jnp.dot(x, w)
+
+    x = np.ones((16, 32), np.float32)
+    w = np.ones((32, 64), np.float32)
+    res = complete(f, x, w)
+    # GSPMD keeps the row-sharded x and column-sharded w; the output of
+    # (dp,·)x(·,mp) propagates to (dp, mp)
+    assert res["outputs"][0] == ("dp", "mp")
+
+
+def test_planner_regimes():
+    cl = ClusterSpec()
+    # tiny model → pure data parallel
+    pm = plan_mesh(8, n_params=10_000_000, cluster=cl)
+    sizes = dict(zip(pm.dim_names, pm.shape))
+    assert sizes["dp"] == 8 and sizes["mp"] == 1 and sizes["sharding"] == 1
+    # model whose replicated opt state overflows but params fit → ZeRO/mp split
+    pm = plan_mesh(8, n_params=30_000_000_000, cluster=cl)
+    sizes = dict(zip(pm.dim_names, pm.shape))
+    assert sizes["sharding"] * sizes["mp"] > 1
+    assert pm.size == 8
+    # comm cost model sanity: allreduce cost grows with bytes, dp=1 free
+    cm = CommCostModel(cl)
+    assert cm.all_reduce(1 << 30, 8) > cm.all_reduce(1 << 20, 8)
+    assert cm.all_reduce(1 << 30, 1) == 0.0
+
+
+def test_engine_fit_evaluate_predict(tmp_path):
+    paddle.seed(42)
+    model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=model.parameters())
+    engine = Engine(model=model, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                    metrics=paddle.metric.Accuracy())
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 16).astype(np.float32)
+    ys = (xs[:, :4].argmax(-1)).astype(np.int64)  # learnable mapping
+    batches = [(xs[i:i + 16], ys[i:i + 16]) for i in range(0, 64, 16)]
+
+    hist = engine.fit(batches, epochs=30, log_freq=10)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.7
+
+    res = engine.evaluate(batches)
+    assert res["loss"] < 1.0
+    assert res["acc"] > 0.5
+
+    preds = engine.predict([(xs[:16],)])
+    assert preds[0][0].shape == (16, 4)
+
+    engine.save(str(tmp_path / "m"))
+    engine2 = Engine(model=model, loss=nn.CrossEntropyLoss(), optimizer=opt)
+    engine2.load(str(tmp_path / "m"))
