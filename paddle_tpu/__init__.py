@@ -62,6 +62,9 @@ from . import profiler  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import utils  # noqa: E402,F401
 from . import distribution  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
 
 from .framework.io import load, save  # noqa: E402,F401
 from .framework import grad, in_dynamic_mode, LazyGuard  # noqa: E402,F401

@@ -57,6 +57,13 @@ class Engine:
         mesh = self._plan()
         strat = self.strategy
         zero = strat.sharding_configs.get("stage", 1) if strat.sharding else 0
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        # Honor the planner's memory decision: if it chose a sharding/mp degree
+        # to make the state fit, the compiled step must actually apply it.
+        if zero == 0 and sizes.get("sharding", 1) > 1:
+            zero = 1
+        if sizes.get("mp", 1) > 1:
+            self._annotate_default_mp(sizes["mp"])
         amp_level = strat.amp_configs.get("level", "O1") if strat.amp else "O0"
         init_fn, step_fn, shard_batch = build_hybrid_step(
             self.model, self.optimizer, self._loss_fn, mesh,
@@ -66,6 +73,21 @@ class Engine:
         self._step_fn = step_fn
         self._shard_batch = shard_batch
         return self
+
+    def _annotate_default_mp(self, mp: int):
+        """Give unannotated params a default tensor-parallel sharding: split
+        the largest mp-divisible dim over 'mp' (GSPMD propagates the rest).
+        User annotations made via shard_tensor always win."""
+        for p in self.model.parameters():
+            if p._sharding_spec is not None or not p.shape:
+                continue
+            dims = [(int(s), i) for i, s in enumerate(p.shape) if int(s) % mp == 0]
+            if not dims:
+                continue
+            _, axis = max(dims)
+            spec = [None] * len(p.shape)
+            spec[axis] = "mp"
+            p._sharding_spec = tuple(spec)
 
     def _loss_fn(self, *args):
         if self.loss is None:
@@ -86,6 +108,7 @@ class Engine:
         step_idx = 0
         loss = None
         for epoch in range(epochs):
+            epoch_steps = 0
             for batch in train_data:
                 arrs = _to_numpy_batch(batch)
                 inputs = self._shard_batch(arrs[:n_inputs])
@@ -94,12 +117,13 @@ class Engine:
                     self._state, jax.random.fold_in(key, step_idx),
                     np.float32(lr), inputs, labels)
                 step_idx += 1
+                epoch_steps += 1
                 if step_idx % log_freq == 0:
                     self.history["loss"].append(float(loss))
                     if verbose:
                         print(f"epoch {epoch} step {step_idx}: "
                               f"loss={float(loss):.5f}")
-                if steps_per_epoch and step_idx % steps_per_epoch == 0:
+                if steps_per_epoch and epoch_steps >= steps_per_epoch:
                     break
         if loss is not None and step_idx % log_freq != 0:
             self.history["loss"].append(float(loss))

@@ -93,10 +93,17 @@ class CollectiveController:
                 "PADDLE_RESTART_COUNT": self.restart_count,
             }
             if args.devices:
-                # partition the visible device ids across local procs
+                # partition the visible device ids across local procs; every
+                # proc gets >=1 device and every device goes to some proc
                 ids = args.devices.split(",")
-                per = max(1, len(ids) // args.nproc_per_node)
-                mine = ids[local_rank * per:(local_rank + 1) * per]
+                if args.nproc_per_node > len(ids):
+                    raise ValueError(
+                        f"nproc_per_node={args.nproc_per_node} exceeds the "
+                        f"{len(ids)} visible devices ({args.devices!r})")
+                per, extra = divmod(len(ids), args.nproc_per_node)
+                lo = local_rank * per + min(local_rank, extra)
+                hi = lo + per + (1 if local_rank < extra else 0)
+                mine = ids[lo:hi]
                 env["PADDLE_DEVICES"] = ",".join(mine)
                 env["TPU_VISIBLE_DEVICES"] = ",".join(mine)
             elif args.nproc_per_node > 1:

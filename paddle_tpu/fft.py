@@ -1,0 +1,119 @@
+"""paddle.fft — discrete Fourier transforms.
+
+Reference analog: `python/paddle/fft.py` (backed by phi kernels
+`phi/kernels/gpu/fft_kernel.cu` over cuFFT). TPU-native: XLA lowers FFTs
+directly (HLO `fft`), so every function is a thin wrapper over jnp.fft with
+Paddle's norm/axis argument conventions.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+
+
+def _norm(norm):
+    if norm is None or norm == "backward":
+        return "backward"
+    if norm not in ("forward", "ortho", "backward"):
+        raise ValueError(f"invalid norm {norm!r}")
+    return norm
+
+
+def _wrap1(fn):
+    def f(x, n=None, axis=-1, norm="backward", name=None):
+        return Tensor(fn(_v(x), n=n, axis=axis, norm=_norm(norm)))
+
+    return f
+
+
+def _wrapN(fn):
+    def f(x, s=None, axes=None, norm="backward", name=None):
+        return Tensor(fn(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+    return f
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+
+fftn = _wrapN(jnp.fft.fftn)
+ifftn = _wrapN(jnp.fft.ifftn)
+rfftn = _wrapN(jnp.fft.rfftn)
+irfftn = _wrapN(jnp.fft.irfftn)
+
+
+def _wrap2(fnN):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return fnN(x, s=s, axes=axes, norm=norm)
+
+    return f
+
+
+fft2 = _wrap2(fftn)
+ifft2 = _wrap2(ifftn)
+rfft2 = _wrap2(rfftn)
+irfft2 = _wrap2(irfftn)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    xv = _v(x)
+    axes = tuple(range(xv.ndim)) if axes is None else tuple(axes)
+    # hermitian-symmetric input → real spectrum: conj-ifftn then rfft on last axis
+    n = s[-1] if s is not None else 2 * (xv.shape[axes[-1]] - 1)
+    out = jnp.conj(xv)
+    for ax in axes[:-1]:
+        out = jnp.fft.ifft(out, n=None, axis=ax)
+    res = jnp.fft.hfft(out, n=n, axis=axes[-1], norm=_norm(norm))
+    scale = np.prod([xv.shape[a] for a in axes[:-1]]) if axes[:-1] else 1.0
+    return Tensor(res * scale if _norm(norm) == "backward" else res)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    xv = _v(x)
+    axes = tuple(range(xv.ndim)) if axes is None else tuple(axes)
+    out = jnp.fft.ihfft(xv, n=s[-1] if s else None, axis=axes[-1], norm=_norm(norm))
+    for ax in axes[:-1]:
+        out = jnp.fft.fft(out, axis=ax)
+        out = jnp.conj(out)
+    return Tensor(out)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.fftshift(_v(x), axes=axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.ifftshift(_v(x), axes=axes))

@@ -1,0 +1,164 @@
+"""paddle.inference — deployment API.
+
+Reference analog: `paddle/fluid/inference/api/analysis_predictor.cc` +
+`python/paddle/inference/__init__.py` (Config, create_predictor, Predictor with
+zero-copy input/output handles). The reference runs IR analysis passes and
+optionally offloads subgraphs to TensorRT; on TPU the entire model is already
+ONE compiled XLA computation (saved via `paddle.static.save_inference_model` as
+serialized StableHLO), so the Predictor is a thin shell: deserialize, compile
+once, keep buffers on device between runs.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "get_version", "PrecisionType", "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    XPU = 3
+
+
+class Config:
+    """reference: paddle_infer.Config (analysis_config.cc)."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        # accept either a path prefix or the explicit .pdmodel path
+        if prog_file is not None and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.prog_prefix = prog_file
+        self.params_file = params_file
+        self._mem_optim = True
+        self._glog_info = False
+        self._device = "tpu"
+        self._device_id = 0
+
+    def set_model(self, prog_file, params_file=None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.prog_prefix = prog_file
+        self.params_file = params_file
+
+    def model_dir(self):
+        return self.prog_prefix
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device, self._device_id = "tpu", device_id  # TPU stands in for GPU
+
+    def enable_xpu(self, *a, **k):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        self._mem_optim = True
+
+    def disable_glog_info(self):
+        self._glog_info = False
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA performs all graph optimization
+
+    def enable_tensorrt_engine(self, *a, **k):  # pragma: no cover - parity shim
+        pass  # no TRT on TPU; XLA fusion covers this
+
+    def summary(self):
+        return f"Config(model={self.prog_prefix}, device={self._device})"
+
+
+class Tensor:
+    """Input/output handle (reference: ZeroCopyTensor, details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name, shape=None, dtype=None):
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = dtype
+        self._value = None
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+    def copy_from_cpu(self, data):
+        a = np.asarray(data)
+        if self._dtype is not None:
+            a = a.astype(self._dtype)
+        self._value = jnp.asarray(a)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def shape(self):
+        v = self._value
+        return list(v.shape) if v is not None else list(self._shape or ())
+
+    def type(self):
+        return str(self._value.dtype) if self._value is not None else self._dtype
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..static.io import load_inference_model
+
+        self.config = config
+        prog, feed_names, fetch_names = load_inference_model(config.prog_prefix)
+        self._prog = prog
+        self._inputs = {n: Tensor(n, s, d) for n, s, d in zip(
+            feed_names, prog._meta["feed_shapes"], prog._meta["feed_dtypes"])}
+        self._outputs = {n: Tensor(n) for n in fetch_names}
+
+    def get_input_names(self):
+        return list(self._inputs)
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    def run(self, inputs=None):
+        """With `inputs` (list of numpy arrays) returns list of numpy outputs;
+        without, uses the copy_from_cpu'd input handles (reference zero-copy API)."""
+        if inputs is not None:
+            for h, a in zip(self._inputs.values(), inputs):
+                h.copy_from_cpu(a)
+        feed = {n: h._value for n, h in self._inputs.items()}
+        outs = self._prog._exported_call(feed)
+        for h, o in zip(self._outputs.values(), outs):
+            h._value = o
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return None
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version() -> str:
+    from .. import __version__
+
+    return __version__

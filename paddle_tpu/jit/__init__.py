@@ -126,20 +126,108 @@ def to_static(layer=None, input_spec=None, build_strategy=None, backend=None, **
 
 
 def save(layer, path, input_spec=None, **configs):
-    """paddle.jit.save — persists params + a traceable config.
+    """paddle.jit.save — persists params and, when `input_spec` is given, a
+    deployable compiled module.
 
-    Reference stores a serialized Program; we store state_dict + class info and
-    reconstruct via jit tracing at load (StableHLO export planned round 2).
+    Reference analog: `paddle.jit.save` serializes a pruned ProgramDesc +
+    persistables (dygraph_to_static/program_translator.py). TPU-native: the
+    artifact is the layer's forward lowered to ONE XLA computation with weights
+    baked in, serialized via jax.export (StableHLO) — loadable by
+    `paddle.jit.load` (TranslatedLayer) and `paddle.inference.Predictor`.
     """
+    import pickle
+
     from ..framework.io import save as _save
 
     state = layer.state_dict() if hasattr(layer, "state_dict") else {}
     _save({"state_dict": state, "class": layer.__class__.__name__}, path + ".pdparams")
 
+    if input_spec is None or not hasattr(layer, "functional_state"):
+        # drop any stale compiled module from an earlier save(input_spec=...) —
+        # its baked-in weights no longer match the just-saved .pdparams
+        if os.path.exists(path + ".pdmodel"):
+            os.remove(path + ".pdmodel")
+        return
+
+    from jax import export as jexport
+
+    params, buffers = layer.functional_state()
+    p_arrays = {k: v._value for k, v in params.items()}
+    b_arrays = {k: (v._value if v is not None else None) for k, v in buffers.items()}
+
+    def fwd(*xs):
+        with tape_mod.no_grad(), rng_mod.trace_rng_scope(jax.random.PRNGKey(0)):
+            out, _ = layer.functional_call(p_arrays, b_arrays, *xs)
+        return jax.tree_util.tree_map(
+            lambda t: t._value if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor),
+        )
+
+    import jax.numpy as jnp
+
+    avals = [jax.ShapeDtypeStruct(tuple(s.shape), jnp.dtype(s.dtype))
+             for s in input_spec]
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    exported = jexport.export(jax.jit(fwd))(*avals)
+    if was_training and hasattr(layer, "train"):
+        layer.train()
+    meta = {
+        "magic": "paddle_tpu.jit.v1",
+        "stablehlo": exported.serialize(),
+        "in_shapes": [tuple(s.shape) for s in input_spec],
+        "in_dtypes": [str(s.dtype) for s in input_spec],
+    }
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(meta, f, protocol=4)
+
+
+class TranslatedLayer:
+    """reference: fluid/dygraph/io.py TranslatedLayer — a loaded, compiled,
+    inference-only module."""
+
+    def __init__(self, meta):
+        from jax import export as jexport
+
+        self._meta = meta
+        self._exported = jexport.deserialize(meta["stablehlo"])
+        self.training = False
+
+    def __call__(self, *xs):
+        import jax.numpy as jnp
+
+        args = []
+        for x, dt in zip(xs, self._meta["in_dtypes"]):
+            a = x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+            args.append(a.astype(dt) if str(a.dtype) != dt else a)
+        out = self._exported.call(*args)
+        if isinstance(out, (list, tuple)):
+            outs = [Tensor(o) for o in out]
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):  # pragma: no cover - parity shim
+        raise RuntimeError("TranslatedLayer is inference-only; finetune from "
+                           "the .pdparams state_dict instead")
+
 
 def load(path, **configs):
+    import os
+    import pickle
+
     from ..framework.io import load as _load
 
+    if os.path.exists(path + ".pdmodel"):
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        if meta.get("magic") == "paddle_tpu.jit.v1":
+            return TranslatedLayer(meta)
     return _load(path + ".pdparams")
 
 

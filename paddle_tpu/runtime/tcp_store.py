@@ -63,8 +63,11 @@ class TCPStore:
             if self._lib:
                 buf = ctypes.create_string_buffer(1 << 20)
                 n = self._lib.ptq_store_get(self._client, key.encode(), buf, len(buf), -1)
-                if n < 0:
+                if n == -1:
                     raise KeyError(key)
+                if n < -1:  # native -2: broken/closed connection, not a miss
+                    raise ConnectionError(
+                        f"TCPStore connection to {self.host}:{self.port} lost")
                 return buf.raw[:n]
             _send(self._sock, b"G", key)
             (n,) = struct.unpack("<i", _recvn(self._sock, 4))

@@ -1,0 +1,106 @@
+"""paddle.signal — STFT / inverse STFT.
+
+Reference analog: `python/paddle/signal.py` (stft/istft built on frame + fft
+phi kernels `phi/kernels/cpu/frame_kernel.cc`). TPU-native: framing is a
+gather/reshape XLA fuses away; FFT is HLO fft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Split into overlapping frames along `axis` (reference: signal.py frame)."""
+    xv = _v(x)
+    if axis not in (-1, xv.ndim - 1):
+        xv = jnp.moveaxis(xv, axis, -1)
+    n = xv.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    out = xv[..., idx]  # (..., num_frames, frame_length)
+    out = jnp.swapaxes(out, -1, -2)  # paddle layout: (..., frame_length, num_frames)
+    if axis not in (-1, xv.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return Tensor(out)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference: signal.py overlap_add)."""
+    xv = _v(x)
+    # paddle layout (..., frame_length, num_frames)
+    frame_length, num_frames = xv.shape[-2], xv.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    frames = jnp.swapaxes(xv, -1, -2)  # (..., num_frames, frame_length)
+    lead = frames.shape[:-2]
+    out = jnp.zeros(lead + (out_len,), xv.dtype)
+    starts = hop_length * np.arange(num_frames)
+    idx = starts[:, None] + np.arange(frame_length)[None, :]  # static indices
+    flat_idx = jnp.asarray(idx.reshape(-1))
+    out = out.at[..., flat_idx].add(frames.reshape(lead + (-1,)))
+    return Tensor(out)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    xv = _v(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length, xv.dtype)
+    else:
+        win = _v(window).astype(xv.dtype)
+    if win_length < n_fft:  # center-pad window to n_fft
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    if center:
+        pad = n_fft // 2
+        cfg = [(0, 0)] * (xv.ndim - 1) + [(pad, pad)]
+        xv = jnp.pad(xv, cfg, mode=pad_mode)
+    frames = frame(Tensor(xv), n_fft, hop_length)._value  # (..., n_fft, num_frames)
+    frames = jnp.swapaxes(frames, -1, -2) * win  # (..., num_frames, n_fft)
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return Tensor(jnp.swapaxes(spec, -1, -2))  # (..., freq, num_frames)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    xv = _v(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones(win_length, jnp.float64)
+    else:
+        win = _v(window).astype(jnp.float64)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lp, n_fft - win_length - lp))
+    spec = jnp.swapaxes(xv, -1, -2)  # (..., num_frames, freq)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float64))
+    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+              else jnp.fft.ifft(spec, axis=-1).real)
+    frames = frames * win
+    y = overlap_add(Tensor(jnp.swapaxes(frames, -1, -2)), hop_length)._value
+    wsq = overlap_add(
+        Tensor(jnp.tile((win * win)[:, None], (1, xv.shape[-1]))), hop_length
+    )._value
+    y = y / jnp.where(wsq > 1e-11, wsq, 1.0)
+    if center:
+        pad = n_fft // 2
+        y = y[..., pad:-pad] if length is None else y[..., pad:pad + length]
+    elif length is not None:
+        y = y[..., :length]
+    return Tensor(y)

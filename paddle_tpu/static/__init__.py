@@ -18,6 +18,11 @@ from .program import (  # noqa: F401
     program_guard,
 )
 from .executor import CompiledProgram, Executor  # noqa: F401
+from .io import (  # noqa: F401
+    load_inference_model,
+    save_inference_model,
+    serialize_program,
+)
 from ..jit import InputSpec  # noqa: F401
 from . import nn  # noqa: F401
 

@@ -33,6 +33,11 @@ class Executor:
             return_numpy=True, use_program_cache=True):
         program = program or default_main_program()
         feed = feed or {}
+        if hasattr(program, "_exported_call"):
+            # loaded inference model (static/io.py): one pre-compiled computation
+            outs = program._exported_call(feed)
+            return [np.asarray(o) for o in outs] if return_numpy else \
+                [Tensor(o) for o in outs]
         fetch_list = fetch_list or []
         fetches = [f for f in fetch_list]
         key = (id(program), tuple(sorted(feed.keys())),

@@ -1,0 +1,80 @@
+"""paddle.fft / paddle.signal numeric tests vs numpy reference
+(reference test analog: tests/unittests/test_fft.py, test_signal.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+rng = np.random.RandomState(7)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_fft_ifft_roundtrip(norm):
+    x = rng.randn(3, 16).astype("float32")
+    y = paddle.fft.fft(paddle.to_tensor(x), norm=norm).numpy()
+    np.testing.assert_allclose(y, np.fft.fft(x, norm=norm), rtol=1e-4, atol=1e-5)
+    back = paddle.fft.ifft(paddle.to_tensor(y), norm=norm).numpy()
+    np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-5)
+
+
+def test_rfft_irfft():
+    x = rng.randn(4, 32).astype("float64")
+    y = paddle.fft.rfft(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(y, np.fft.rfft(x), rtol=1e-9, atol=1e-10)
+    back = paddle.fft.irfft(paddle.to_tensor(y), n=32).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-9, atol=1e-10)
+
+
+def test_fft2_fftn():
+    x = rng.randn(2, 8, 8).astype("float64")
+    np.testing.assert_allclose(
+        paddle.fft.fft2(paddle.to_tensor(x)).numpy(), np.fft.fft2(x), rtol=1e-9,
+        atol=1e-9)
+    np.testing.assert_allclose(
+        paddle.fft.fftn(paddle.to_tensor(x)).numpy(), np.fft.fftn(x), rtol=1e-9,
+        atol=1e-9)
+
+
+def test_hfft_ihfft():
+    x = rng.randn(10).astype("float64")
+    np.testing.assert_allclose(
+        paddle.fft.hfft(paddle.to_tensor(x)).numpy(), np.fft.hfft(x), rtol=1e-9,
+        atol=1e-9)
+    np.testing.assert_allclose(
+        paddle.fft.ihfft(paddle.to_tensor(x)).numpy(), np.fft.ihfft(x),
+        rtol=1e-9, atol=1e-9)
+
+
+def test_freq_shift_helpers():
+    np.testing.assert_allclose(paddle.fft.fftfreq(8, 0.5).numpy(),
+                               np.fft.fftfreq(8, 0.5).astype("float32"))
+    np.testing.assert_allclose(paddle.fft.rfftfreq(8, 0.5).numpy(),
+                               np.fft.rfftfreq(8, 0.5).astype("float32"))
+    x = rng.randn(9)
+    np.testing.assert_allclose(paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+                               np.fft.fftshift(x))
+    np.testing.assert_allclose(paddle.fft.ifftshift(paddle.to_tensor(x)).numpy(),
+                               np.fft.ifftshift(x))
+
+
+def test_frame_overlap_add_inverse():
+    x = rng.randn(2, 40).astype("float32")
+    f = paddle.signal.frame(paddle.to_tensor(x), frame_length=8, hop_length=8)
+    assert f.numpy().shape == (2, 8, 5)
+    y = paddle.signal.overlap_add(f, hop_length=8)
+    np.testing.assert_allclose(y.numpy(), x, rtol=1e-6)
+
+
+def test_stft_istft_roundtrip():
+    x = rng.randn(1, 256).astype("float64")
+    n_fft, hop = 64, 16
+    win = np.hanning(n_fft).astype("float64")
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft, hop_length=hop,
+                              window=paddle.to_tensor(win))
+    assert spec.numpy().shape == (1, n_fft // 2 + 1, (256 // hop) + 1)
+    back = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                               window=paddle.to_tensor(win), length=256)
+    # edges lose energy with a hann window; compare the interior
+    np.testing.assert_allclose(back.numpy()[0, n_fft:-n_fft],
+                               x[0, n_fft:-n_fft], rtol=1e-6, atol=1e-8)

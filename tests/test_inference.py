@@ -1,0 +1,90 @@
+"""Inference/deployment stack tests.
+
+Reference test analog: python/paddle/fluid/tests/unittests/test_inference_api.py
++ save/load_inference_model tests — save a trained static program, reload it in
+a fresh "process" (new objects), check outputs match.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn, static
+
+
+def _build_program():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        paddle.enable_static()
+        try:
+            x = static.data("x", [4, 8], "float32")
+            lin = nn.Linear(8, 3)
+            y = lin(x)
+            out = paddle.nn.functional.softmax(y)
+        finally:
+            paddle.disable_static()
+    return main, x, out
+
+
+def test_save_load_inference_model(tmp_path):
+    main, x, out = _build_program()
+    exe = static.Executor()
+    prefix = str(tmp_path / "model" / "m")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+    xv = np.random.RandomState(0).randn(4, 8).astype("float32")
+    with static.program_guard(main):
+        ref = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+
+    prog, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    got = exe.run(prog, feed={"x": xv})[0]
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_zero_copy(tmp_path):
+    main, x, out = _build_program()
+    exe = static.Executor()
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+    config = inference.Config(prefix)
+    predictor = inference.create_predictor(config)
+    names = predictor.get_input_names()
+    assert names == ["x"]
+    xv = np.random.RandomState(1).randn(4, 8).astype("float32")
+    h = predictor.get_input_handle("x")
+    h.copy_from_cpu(xv)
+    predictor.run()
+    oh = predictor.get_output_handle(predictor.get_output_names()[0])
+    got = oh.copy_to_cpu()
+    assert got.shape == (4, 3)
+    np.testing.assert_allclose(got.sum(axis=-1), np.ones(4), rtol=1e-5)
+
+    # batch API
+    outs = predictor.run([xv])
+    np.testing.assert_allclose(outs[0], got, rtol=1e-6)
+
+
+def test_jit_save_load_translated_layer(tmp_path):
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(6, 4)
+
+        def forward(self, x):
+            return paddle.tanh(self.fc(x))
+
+    net = Net()
+    net.eval()
+    xv = paddle.to_tensor(np.random.RandomState(2).randn(2, 6).astype("float32"))
+    ref = net(xv).numpy()
+
+    path = str(tmp_path / "net")
+    paddle.jit.save(net, path, input_spec=[static.InputSpec([2, 6], "float32")])
+    loaded = paddle.jit.load(path)
+    got = loaded(xv).numpy()
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+    with pytest.raises(RuntimeError):
+        loaded.train()
