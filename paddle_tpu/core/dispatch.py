@@ -93,6 +93,7 @@ def primitive_call(fn, *args, name: str = "", **kwargs):
 
     if not diff_positions:
         out = fn(*arrays)
+        _maybe_check_nan_inf(name, out)
         return _wrap_outputs(out, None)
 
     idxs = [i for i, _ in diff_positions]
@@ -104,6 +105,7 @@ def primitive_call(fn, *args, name: str = "", **kwargs):
         return fn(*full)
 
     out, vjp_fn = jax.vjp(partial_fn, *[arrays[i] for i in idxs])
+    _maybe_check_nan_inf(name, out)
     is_tuple = isinstance(out, (tuple, list))
     outs_list = list(out) if is_tuple else [out]
     out_avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in outs_list]
@@ -122,6 +124,33 @@ def primitive_call(fn, *args, name: str = "", **kwargs):
     if is_tuple:
         return tuple(out_tensors)
     return out_tensors[0]
+
+
+def _maybe_check_nan_inf(name, out):
+    """Debug hook (reference: FLAGS_check_nan_inf scanned in
+    OperatorWithKernel::RunImpl, operator.cc:1270 →
+    framework/details/nan_inf_utils_detail.cc). Costs a device sync per op —
+    only active when the flag is set."""
+    from ..utils.flags import flag
+
+    if not flag("FLAGS_check_nan_inf"):
+        return
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    if any(isinstance(o, jax.core.Tracer) for o in outs):
+        # under jit tracing values are symbolic; the eager checker would raise
+        # a TracerBoolConversionError — skip (the reference likewise only
+        # scans concrete outputs in OperatorWithKernel::RunImpl)
+        return
+    for i, o in enumerate(outs):
+        if hasattr(o, "dtype") and jax.numpy.issubdtype(o.dtype, jax.numpy.inexact):
+            if not bool(jax.numpy.isfinite(o).all()):
+                a = np.asarray(o)
+                raise FloatingPointError(
+                    f"Operator {name or '?'} output {i} contains "
+                    f"{int(np.isnan(a).sum())} nan / {int(np.isinf(a).sum())} inf "
+                    f"values (shape {a.shape}, dtype {a.dtype}); "
+                    f"first bad index {tuple(np.argwhere(~np.isfinite(a))[0])}"
+                )
 
 
 def _wrap_outputs(out, node):

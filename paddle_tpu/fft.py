@@ -72,27 +72,24 @@ rfft2 = _wrap2(rfftn)
 irfft2 = _wrap2(irfftn)
 
 
+_SWAP_NORM = {"backward": "forward", "forward": "backward", "ortho": "ortho"}
+
+
 def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """n-d FFT of a signal Hermitian-symmetric over the last axis. Uses the
+    exact identity hfftn(x) = irfftn(conj(x)) with the norm swapped (the same
+    construction numpy uses for 1-d hfft), so all norms and all axes are
+    consistent."""
     xv = _v(x)
-    axes = tuple(range(xv.ndim)) if axes is None else tuple(axes)
-    # hermitian-symmetric input → real spectrum: conj-ifftn then rfft on last axis
-    n = s[-1] if s is not None else 2 * (xv.shape[axes[-1]] - 1)
-    out = jnp.conj(xv)
-    for ax in axes[:-1]:
-        out = jnp.fft.ifft(out, n=None, axis=ax)
-    res = jnp.fft.hfft(out, n=n, axis=axes[-1], norm=_norm(norm))
-    scale = np.prod([xv.shape[a] for a in axes[:-1]]) if axes[:-1] else 1.0
-    return Tensor(res * scale if _norm(norm) == "backward" else res)
+    return Tensor(jnp.fft.irfftn(jnp.conj(xv), s=s, axes=axes,
+                                 norm=_SWAP_NORM[_norm(norm)]))
 
 
 def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn: ihfftn(x) = conj(rfftn(x)) with the norm swapped."""
     xv = _v(x)
-    axes = tuple(range(xv.ndim)) if axes is None else tuple(axes)
-    out = jnp.fft.ihfft(xv, n=s[-1] if s else None, axis=axes[-1], norm=_norm(norm))
-    for ax in axes[:-1]:
-        out = jnp.fft.fft(out, axis=ax)
-        out = jnp.conj(out)
-    return Tensor(out)
+    return Tensor(jnp.conj(jnp.fft.rfftn(xv, s=s, axes=axes,
+                                         norm=_SWAP_NORM[_norm(norm)])))
 
 
 def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):

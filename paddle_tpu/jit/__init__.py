@@ -173,11 +173,19 @@ def save(layer, path, input_spec=None, **configs):
     exported = jexport.export(jax.jit(fwd))(*avals)
     if was_training and hasattr(layer, "train"):
         layer.train()
+    n_out = len(exported.out_avals)
     meta = {
         "magic": "paddle_tpu.jit.v1",
         "stablehlo": exported.serialize(),
         "in_shapes": [tuple(s.shape) for s in input_spec],
         "in_dtypes": [str(s.dtype) for s in input_spec],
+        # feed/fetch view so inference.Predictor / load_inference_model can
+        # open jit artifacts too (same schema as static/io.py)
+        "feed_names": [getattr(s, "name", None) or f"x{i}"
+                       for i, s in enumerate(input_spec)],
+        "feed_shapes": [tuple(s.shape) for s in input_spec],
+        "feed_dtypes": [str(s.dtype) for s in input_spec],
+        "fetch_names": [f"out{i}" for i in range(n_out)],
     }
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump(meta, f, protocol=4)

@@ -344,17 +344,31 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", avg=Fal
         strides = [1, 1] + list(stride)
     if isinstance(pad, list) and not data_format.startswith("NC"):
         pad = [(0, 0)] + pad[2:] + [(0, 0)]
+    if ceil_mode and isinstance(pad, list):
+        # extra right/bottom padding so the last partial window is kept:
+        # out = ceil((n + 2p - k)/s) + 1 instead of floor (+1)
+        shape = tuple(_t(x).shape) if hasattr(x, "shape") else None
+        if shape is not None:
+            spatial_dims = ([d for d in range(2, 2 + nd)]
+                            if data_format.startswith("NC")
+                            else [d for d in range(1, 1 + nd)])
+            pad = list(pad)
+            for i, d in enumerate(spatial_dims):
+                n = int(shape[d]) + pad[d][0] + pad[d][1] - kernel[i]
+                rem = n % stride[i]
+                if rem:
+                    pad[d] = (pad[d][0], pad[d][1] + stride[i] - rem)
 
     def f(a):
         out = jax.lax.reduce_window(a, init, reducer, window, strides, pad)
         if avg:
-            if isinstance(pad, str) or all(p == (0, 0) for p in pad) or not exclusive:
-                denom = float(np.prod(kernel))
-                if exclusive and not isinstance(pad, str):
-                    return out / denom
-                ones = jnp.ones_like(a)
-                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pad)
-                return out / counts
+            if not exclusive:
+                # divide by the full kernel size, counting padded zeros
+                # (reference: pool_op exclusive=False)
+                return out / float(np.prod(kernel))
+            if not isinstance(pad, str) and all(p == (0, 0) for p in pad):
+                return out / float(np.prod(kernel))
+            # exclusive: divide by the number of real (non-pad) elements
             counts = jax.lax.reduce_window(
                 jnp.ones_like(a), 0.0, jax.lax.add, window, strides, pad
             )
@@ -366,56 +380,62 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", avg=Fal
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
-    return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format)
+    return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format,
+                 ceil_mode=ceil_mode)
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW", name=None):
     return _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, data_format,
-                 avg=True, exclusive=exclusive)
+                 avg=True, ceil_mode=ceil_mode, exclusive=exclusive)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
-    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
-    s = stride if stride is not None else k
-    s = s if isinstance(s, int) else s[0]
-    p = padding if isinstance(padding, int) else padding[0]
-
-    def f(a):
-        return jax.lax.reduce_window(
-            a, -jnp.inf, jax.lax.max, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)]
-        )
-
-    return primitive_call(f, _t(x))
+    return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, "NCL",
+                 ceil_mode=ceil_mode, nd=1)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, name=None):
-    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
-    s = stride if stride is not None else k
-    s = s if isinstance(s, int) else s[0]
-    p = padding if isinstance(padding, int) else padding[0]
+    return _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, "NCL",
+                 avg=True, ceil_mode=ceil_mode, exclusive=exclusive, nd=1)
 
-    def f(a):
-        out = jax.lax.reduce_window(
-            a, 0.0, jax.lax.add, (1, 1, k), (1, 1, s), [(0, 0), (0, 0), (p, p)]
-        )
-        return out / k
 
-    return primitive_call(f, _t(x))
+def _adaptive_bins(n, out):
+    """Torch/paddle adaptive bins: bin i = [floor(i*n/out), ceil((i+1)*n/out))."""
+    starts = [(i * n) // out for i in range(out)]
+    ends = [-(-((i + 1) * n) // out) for i in range(out)]
+    return starts, ends
+
+
+def _adaptive_avg_matrix(n, out, dtype):
+    """(out, n) averaging matrix — adaptive pooling as a matmul (MXU-friendly)."""
+    m = np.zeros((out, n), np.float64)
+    starts, ends = _adaptive_bins(n, out)
+    for i, (s, e) in enumerate(zip(starts, ends)):
+        m[i, s:e] = 1.0 / (e - s)
+    return m.astype(dtype)
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     out_hw = _pair(output_size)
 
     def f(a):
-        h, w = (a.shape[2], a.shape[3]) if data_format == "NCHW" else (a.shape[1], a.shape[2])
-        oh, ow = out_hw
-        kh, kw = h // oh, w // ow
-        window = (1, 1, kh, kw) if data_format == "NCHW" else (1, kh, kw, 1)
-        out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, window, "VALID")
-        return out / (kh * kw)
+        nchw = data_format == "NCHW"
+        h, w = (a.shape[2], a.shape[3]) if nchw else (a.shape[1], a.shape[2])
+        oh = h if out_hw[0] is None else out_hw[0]
+        ow = w if out_hw[1] is None else out_hw[1]
+        if h % oh == 0 and w % ow == 0:
+            kh, kw = h // oh, w // ow
+            window = (1, 1, kh, kw) if nchw else (1, kh, kw, 1)
+            out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, window, "VALID")
+            return out / (kh * kw)
+        mh = jnp.asarray(_adaptive_avg_matrix(h, oh, a.dtype))
+        mw = jnp.asarray(_adaptive_avg_matrix(w, ow, a.dtype))
+        if nchw:
+            return jnp.einsum("nchw,oh,pw->ncop", a, mh, mw)
+        return jnp.einsum("nhwc,oh,pw->nopc", a, mh, mw)
 
     return primitive_call(f, _t(x), name="adaptive_avg_pool2d")
 
@@ -424,9 +444,14 @@ def adaptive_avg_pool1d(x, output_size, name=None):
     o = output_size if isinstance(output_size, int) else output_size[0]
 
     def f(a):
-        k = a.shape[2] // o
-        out = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, k), "VALID")
-        return out / k
+        n = a.shape[2]
+        if n % o == 0:
+            k = n // o
+            out = jax.lax.reduce_window(a, 0.0, jax.lax.add, (1, 1, k), (1, 1, k),
+                                        "VALID")
+            return out / k
+        m = jnp.asarray(_adaptive_avg_matrix(n, o, a.dtype))
+        return jnp.einsum("ncl,ol->nco", a, m)
 
     return primitive_call(f, _t(x))
 
@@ -435,9 +460,18 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out_hw = _pair(output_size)
 
     def f(a):
-        oh, ow = out_hw
-        kh, kw = a.shape[2] // oh, a.shape[3] // ow
-        return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
+        h, w = a.shape[2], a.shape[3]
+        oh = h if out_hw[0] is None else out_hw[0]
+        ow = w if out_hw[1] is None else out_hw[1]
+        if h % oh == 0 and w % ow == 0:
+            kh, kw = h // oh, w // ow
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
+                                         (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
+        hs, he = _adaptive_bins(h, oh)
+        ws, we = _adaptive_bins(w, ow)
+        rows = [jnp.stack([jnp.max(a[:, :, hs[i]:he[i], ws[j]:we[j]], axis=(2, 3))
+                           for j in range(ow)], axis=-1) for i in range(oh)]
+        return jnp.stack(rows, axis=-2)
 
     return primitive_call(f, _t(x))
 

@@ -29,8 +29,12 @@ class _BatchNormBase(Layer):
                 (num_features,), attr=bias_attr, is_bias=True,
                 default_initializer=I.Constant(0.0),
             )
-        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
-        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+        from ..core.dtype import to_jax_dtype
+        from ..core import get_default_dtype
+
+        stat_dt = to_jax_dtype(get_default_dtype())
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,), stat_dt)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,), stat_dt)))
 
     def forward(self, x):
         return F.batch_norm(

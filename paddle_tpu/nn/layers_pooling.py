@@ -10,9 +10,10 @@ class MaxPool1D(Layer):
                  ceil_mode=False, name=None):
         super().__init__()
         self.k, self.s, self.p = kernel_size, stride, padding
+        self.ceil_mode = ceil_mode
 
     def forward(self, x):
-        return F.max_pool1d(x, self.k, self.s, self.p)
+        return F.max_pool1d(x, self.k, self.s, self.p, ceil_mode=self.ceil_mode)
 
 
 class MaxPool2D(Layer):
@@ -20,9 +21,11 @@ class MaxPool2D(Layer):
                  ceil_mode=False, data_format="NCHW", name=None):
         super().__init__()
         self.k, self.s, self.p, self.df = kernel_size, stride, padding, data_format
+        self.ceil_mode = ceil_mode
 
     def forward(self, x):
-        return F.max_pool2d(x, self.k, self.s, self.p, data_format=self.df)
+        return F.max_pool2d(x, self.k, self.s, self.p, ceil_mode=self.ceil_mode,
+                            data_format=self.df)
 
 
 class AvgPool1D(Layer):
@@ -30,9 +33,12 @@ class AvgPool1D(Layer):
                  ceil_mode=False, name=None):
         super().__init__()
         self.k, self.s, self.p = kernel_size, stride, padding
+        self.ceil_mode = ceil_mode
+        self.exclusive = exclusive
 
     def forward(self, x):
-        return F.avg_pool1d(x, self.k, self.s, self.p)
+        return F.avg_pool1d(x, self.k, self.s, self.p, ceil_mode=self.ceil_mode,
+                            exclusive=self.exclusive)
 
 
 class AvgPool2D(Layer):
@@ -40,11 +46,12 @@ class AvgPool2D(Layer):
                  exclusive=True, divisor_override=None, data_format="NCHW", name=None):
         super().__init__()
         self.k, self.s, self.p, self.df = kernel_size, stride, padding, data_format
+        self.ceil_mode = ceil_mode
         self.exclusive = exclusive
 
     def forward(self, x):
-        return F.avg_pool2d(x, self.k, self.s, self.p, exclusive=self.exclusive,
-                            data_format=self.df)
+        return F.avg_pool2d(x, self.k, self.s, self.p, ceil_mode=self.ceil_mode,
+                            exclusive=self.exclusive, data_format=self.df)
 
 
 class AdaptiveAvgPool1D(Layer):

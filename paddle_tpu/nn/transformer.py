@@ -73,8 +73,9 @@ class MultiHeadAttention(Layer):
         from ..core.tensor import Tensor
 
         b = key.shape[0]
-        k = Tensor(jnp.zeros((b, self.num_heads, 0, self.head_dim)))
-        v = Tensor(jnp.zeros((b, self.num_heads, 0, self.head_dim)))
+        dt = key._value.dtype if isinstance(key, Tensor) else jnp.float32
+        k = Tensor(jnp.zeros((b, self.num_heads, 0, self.head_dim), dt))
+        v = Tensor(jnp.zeros((b, self.num_heads, 0, self.head_dim), dt))
         return MultiHeadAttention.Cache(k, v)
 
 

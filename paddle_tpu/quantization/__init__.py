@@ -1,0 +1,265 @@
+"""paddle quantization — QAT (fake-quant training) and PTQ (post-training).
+
+Reference analog: `python/paddle/fluid/contrib/slim/quantization/` —
+`ImperativeQuantAware` (imperative_qat) swaps Linear/Conv layers for quantized
+wrappers with fake-quant ops (`fake_quantize_dequantize_moving_average_abs_max`
+etc.), `PostTrainingQuantization` calibrates activation scales from sample data
+and rewrites the inference program.
+
+TPU-native design: fake-quant is a pure-jax function with a straight-through
+estimator (`x + stop_gradient(q(x) - x)`), so the QAT forward/backward fuses
+into the same single XLA computation as the float model — no custom kernels
+needed. PTQ runs the captured program over calibration batches to collect
+abs-max scales, then bakes (int8 weight, scale) pairs into the exported model.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer import Layer
+
+__all__ = [
+    "fake_quant", "FakeQuantAbsMax", "FakeQuantMovingAverageAbsMax",
+    "QuantedLinear", "QuantedConv2D", "ImperativeQuantAware",
+    "PostTrainingQuantization", "quant_post_static", "weight_quantize",
+    "weight_dequantize",
+]
+
+
+# ------------------------------------------------------------------ primitives
+def _quant_dequant(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _fake_quant_raw(xv, sv, bits=8):
+    # straight-through estimator: forward = quant-dequant, gradient = identity
+    return xv + jax.lax.stop_gradient(_quant_dequant(xv, sv, bits) - xv)
+
+
+def fake_quant(x, scale, bits=8):
+    """Quantize-dequantize with straight-through gradient (reference op:
+    fake_quantize_dequantize_abs_max, operators/fake_quantize_op.cc)."""
+    sv = scale if isinstance(scale, Tensor) else jnp.asarray(scale)
+    return primitive_call(_fake_quant_raw, x, sv, bits=bits,
+                          name="fake_quantize_dequantize_abs_max")
+
+
+class FakeQuantAbsMax(Layer):
+    """Per-tensor (or per-channel for weights) abs-max fake quantizer."""
+
+    def __init__(self, bits=8, channel_axis=None):
+        super().__init__()
+        self.bits = bits
+        self.channel_axis = channel_axis
+
+    def forward(self, x):
+        bits, channel_axis = self.bits, self.channel_axis
+
+        def raw(xv):
+            if channel_axis is None:
+                s = jnp.max(jnp.abs(xv))
+            else:
+                axes = tuple(i for i in range(xv.ndim) if i != channel_axis)
+                shape = [1] * xv.ndim
+                shape[channel_axis] = -1
+                s = jnp.max(jnp.abs(xv), axis=axes).reshape(shape)
+            return _fake_quant_raw(xv, jax.lax.stop_gradient(s), bits)
+
+        return primitive_call(raw, x, name="fake_quantize_abs_max")
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation quantizer with EMA scale (reference:
+    fake_quantize_dequantize_moving_average_abs_max)."""
+
+    def __init__(self, bits=8, moving_rate=0.9):
+        super().__init__()
+        self.bits = bits
+        self.moving_rate = moving_rate
+        self.scale = self.create_buffer("scale", np.zeros((), np.float32))
+        self._seen = False
+
+    def create_buffer(self, name, value):
+        t = Tensor(np.asarray(value), stop_gradient=True)
+        self._buffers[name] = t
+        return t
+
+    def forward(self, x):
+        xv = x._value if isinstance(x, Tensor) else x
+        if self.training:
+            cur = jax.lax.stop_gradient(jnp.max(jnp.abs(xv))).astype(jnp.float32)
+            prev = self.scale._value
+            r = self.moving_rate
+            self.scale._value = jnp.where(prev > 0, r * prev + (1 - r) * cur, cur)
+        return primitive_call(_fake_quant_raw, x, self.scale._value,
+                              bits=self.bits,
+                              name="fake_quantize_dequantize_moving_average_abs_max")
+
+
+# ------------------------------------------------------------ quantized layers
+class QuantedLinear(Layer):
+    """reference: slim/quantization/imperative/qat.py QuantizedLinear."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._w_quant = FakeQuantAbsMax(weight_bits, channel_axis=1)
+        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits, moving_rate)
+
+    def forward(self, x):
+        x = self._a_quant(x)
+        w = self._w_quant(self.weight)
+        return F.linear(x, w, self.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9):
+        super().__init__()
+        self.weight = layer.weight
+        self.bias = layer.bias
+        self._inner = layer
+        self._w_quant = FakeQuantAbsMax(weight_bits, channel_axis=0)
+        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits, moving_rate)
+
+    def forward(self, x):
+        x = self._a_quant(x)
+        w = self._w_quant(self.weight)
+        lay = self._inner
+        return F.conv2d(x, w, self.bias, lay._stride, lay._padding,
+                        lay._dilation, lay._groups, lay._data_format)
+
+
+_QUANT_WRAPPERS = {"Linear": QuantedLinear, "Conv2D": QuantedConv2D}
+
+
+class ImperativeQuantAware:
+    """reference: slim/quantization/imperative/qat.py:80 ImperativeQuantAware."""
+
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        self.types = tuple(quantizable_layer_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+
+    def quantize(self, model: Layer):
+        """Swap quantizable sublayers in place (returns model)."""
+        for parent in [model] + [s for _, s in model.named_sublayers()]:
+            for name, sub in list(parent._sub_layers.items()):
+                cls = type(sub).__name__
+                if cls in self.types and cls in _QUANT_WRAPPERS:
+                    parent._sub_layers[name] = _QUANT_WRAPPERS[cls](
+                        sub, self.weight_bits, self.activation_bits,
+                        self.moving_rate)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        from .. import jit
+
+        model.eval()
+        jit.save(model, path, input_spec=input_spec)
+
+
+# ---------------------------------------------------------------------- PTQ
+def weight_quantize(w, bits=8, channel_axis=None):
+    """float weight -> (int8 array, float scale) per tensor/channel."""
+    wv = np.asarray(w.numpy() if isinstance(w, Tensor) else w)
+    qmax = float(2 ** (bits - 1) - 1)
+    if channel_axis is None:
+        scale = np.maximum(np.abs(wv).max(), 1e-8)
+    else:
+        axes = tuple(i for i in range(wv.ndim) if i != channel_axis)
+        shape = [1] * wv.ndim
+        shape[channel_axis] = -1
+        scale = np.maximum(np.abs(wv).max(axis=axes).reshape(shape), 1e-8)
+    q = np.clip(np.round(wv / scale * qmax), -qmax, qmax).astype(np.int8)
+    return q, scale
+
+
+def weight_dequantize(q, scale, bits=8, dtype="float32"):
+    qmax = float(2 ** (bits - 1) - 1)
+    return (np.asarray(q, dtype) * np.asarray(scale, dtype) / qmax).astype(dtype)
+
+
+class PostTrainingQuantization:
+    """reference: slim/quantization/post_training_quantization.py.
+
+    Calibrates activation abs-max scales by running the model over sample
+    batches, quantizes weights per-channel to int8, and exports a model whose
+    forward fake-quantizes activations with the calibrated (frozen) scales —
+    numerically identical to an int8 deploy with dequant-at-use.
+    """
+
+    def __init__(self, model: Layer = None, data_loader=None, batch_nums=None,
+                 algo="abs_max", weight_bits=8, activation_bits=8,
+                 quantizable_op_type=("Linear", "Conv2D"), executor=None,
+                 sample_generator=None):
+        self.model = model
+        self.data_loader = data_loader
+        self.batch_nums = batch_nums
+        self.algo = algo
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.types = tuple(quantizable_op_type)
+        self.scales = {}
+
+    def quantize(self):
+        model = self.model
+        qat = ImperativeQuantAware(self.types, self.weight_bits,
+                                   self.activation_bits, moving_rate=0.0
+                                   if self.algo == "abs_max" else 0.9)
+        qat.quantize(model)
+        # calibration: run in train() so EMA observers update, grads off
+        from ..core.tape import no_grad
+
+        model.train()
+        with no_grad():
+            for i, batch in enumerate(self.data_loader):
+                if self.batch_nums and i >= self.batch_nums:
+                    break
+                xs = batch if isinstance(batch, (list, tuple)) else [batch]
+                model(*[x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+                        for x in xs])
+        model.eval()
+        # snapshot the weight int8 codebooks + frozen activation scales
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (QuantedLinear, QuantedConv2D)):
+                ca = 1 if isinstance(sub, QuantedLinear) else 0
+                q, s = weight_quantize(sub.weight, self.weight_bits, ca)
+                self.scales[name] = {
+                    "weight_int8": q, "weight_scale": s,
+                    "act_scale": float(np.asarray(sub._a_quant.scale._value)),
+                }
+        return self.model
+
+    def save_quantized_model(self, save_model_path, input_spec=None):
+        import pickle
+
+        from .. import jit
+
+        jit.save(self.model, save_model_path, input_spec=input_spec)
+        with open(save_model_path + ".quant", "wb") as f:
+            pickle.dump({"scales": self.scales, "weight_bits": self.weight_bits,
+                         "activation_bits": self.activation_bits}, f, protocol=4)
+
+
+def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,
+                      model=None, data_loader=None, batch_nums=10, **kw):
+    """Functional wrapper (reference: paddleslim quant_post_static)."""
+    ptq = PostTrainingQuantization(model=model, data_loader=data_loader,
+                                   batch_nums=batch_nums, **kw)
+    ptq.quantize()
+    if quantize_model_path:
+        ptq.save_quantized_model(quantize_model_path)
+    return ptq

@@ -19,25 +19,34 @@ def _v(x):
 
 
 def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Split into overlapping frames along `axis` (reference: signal.py frame)."""
+    """Split into overlapping frames (reference: signal.py frame:32; axis must
+    be 0 or -1). axis=-1: (..., L) -> (..., frame_length, num_frames);
+    axis=0: (L, ...) -> (num_frames, frame_length, ...)."""
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
     xv = _v(x)
-    if axis not in (-1, xv.ndim - 1):
-        xv = jnp.moveaxis(xv, axis, -1)
+    if axis == 0:
+        out = frame(Tensor(jnp.moveaxis(xv, 0, -1)), frame_length, hop_length)._value
+        # (..., frame_length, num_frames) -> (num_frames, frame_length, ...)
+        return Tensor(jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1))
     n = xv.shape[-1]
     num_frames = 1 + (n - frame_length) // hop_length
     idx = (jnp.arange(frame_length)[None, :]
            + hop_length * jnp.arange(num_frames)[:, None])
     out = xv[..., idx]  # (..., num_frames, frame_length)
-    out = jnp.swapaxes(out, -1, -2)  # paddle layout: (..., frame_length, num_frames)
-    if axis not in (-1, xv.ndim - 1):
-        out = jnp.moveaxis(out, -1, axis)
-    return Tensor(out)
+    return Tensor(jnp.swapaxes(out, -1, -2))
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """Inverse of frame (reference: signal.py overlap_add)."""
+    """Inverse of frame (reference: signal.py overlap_add:154; axis 0 or -1)."""
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
     xv = _v(x)
-    # paddle layout (..., frame_length, num_frames)
+    if axis == 0:
+        # (num_frames, frame_length, ...) -> canonical (..., frame_length, num_frames)
+        canon = jnp.moveaxis(jnp.moveaxis(xv, 1, -1), 0, -1)
+        return Tensor(jnp.moveaxis(
+            overlap_add(Tensor(canon), hop_length)._value, -1, 0))
     frame_length, num_frames = xv.shape[-2], xv.shape[-1]
     out_len = (num_frames - 1) * hop_length + frame_length
     frames = jnp.swapaxes(xv, -1, -2)  # (..., num_frames, frame_length)

@@ -118,7 +118,8 @@ class _LoadedInferenceProgram:
             a = feed[name]
             a = a.numpy() if isinstance(a, Tensor) else np.asarray(a)
             args.append(jnp.asarray(a, dtype=dt))
-        return list(self._exported.call(*args))
+        out = self._exported.call(*args)
+        return list(out) if isinstance(out, (tuple, list)) else [out]
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
@@ -126,7 +127,7 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     Returns (program-like, feed_names, fetch_names)."""
     with open(path_prefix + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
-    if meta.get("magic") != _MAGIC:
+    if meta.get("magic") not in (_MAGIC, "paddle_tpu.jit.v1"):
         raise ValueError(f"{path_prefix}.pdmodel is not a paddle_tpu inference model")
     prog = _LoadedInferenceProgram(meta)
     return prog, prog.feed_names, prog.fetch_names

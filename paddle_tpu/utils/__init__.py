@@ -1,2 +1,3 @@
 from . import flags  # noqa: F401
+from . import monitor  # noqa: F401
 from .misc import try_import, unique_name  # noqa: F401

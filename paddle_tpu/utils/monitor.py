@@ -1,0 +1,63 @@
+"""Runtime stats monitor.
+
+Reference analog: `paddle/fluid/platform/monitor.h:34` — a process-wide
+registry of named int64 counters (STAT_ADD/STAT_RESET macros), used by the PS
+runtime and exported to python. Here: a thread-safe registry of int counters
+and float gauges, plus timing helpers.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["stat_add", "stat_set", "stat_get", "stat_reset", "all_stats",
+           "StatTimer"]
+
+_lock = threading.Lock()
+_stats: dict[str, float] = {}
+
+
+def stat_add(name: str, value=1):
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + value
+        return _stats[name]
+
+
+def stat_set(name: str, value):
+    with _lock:
+        _stats[name] = value
+
+
+def stat_get(name: str, default=0):
+    with _lock:
+        return _stats.get(name, default)
+
+
+def stat_reset(name: str | None = None):
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def all_stats() -> dict:
+    with _lock:
+        return dict(_stats)
+
+
+class StatTimer:
+    """Context manager accumulating elapsed seconds into `<name>` and hit
+    count into `<name>_count`."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        stat_add(self.name, time.perf_counter() - self._t0)
+        stat_add(self.name + "_count", 1)
+        return False

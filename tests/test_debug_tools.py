@@ -1,0 +1,62 @@
+"""NaN/Inf checker, flags, monitor tests (reference analog:
+tests/unittests/test_nan_inf.py, platform/monitor_test)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import monitor
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0]))
+        with pytest.raises(FloatingPointError) as ei:
+            paddle.log(x * 0.0 - 1.0)  # log(-1) = nan
+        assert "nan" in str(ei.value)
+        # divide by zero -> inf
+        with pytest.raises(FloatingPointError):
+            paddle.divide(paddle.to_tensor(np.array([1.0])),
+                          paddle.to_tensor(np.array([0.0])))
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    # flag off: no error
+    out = paddle.log(paddle.to_tensor(np.array([-1.0])))
+    assert np.isnan(out.numpy()).all()
+
+
+def test_flags_roundtrip_and_env_coercion():
+    paddle.set_flags({"FLAGS_eager_delete_tensor_gb": "2.5"})
+    assert paddle.get_flags("FLAGS_eager_delete_tensor_gb")[
+        "FLAGS_eager_delete_tensor_gb"] == 2.5
+    paddle.set_flags({"FLAGS_check_nan_inf": "true"})
+    assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_monitor_stats():
+    monitor.stat_reset()
+    monitor.stat_add("reader_queue_size", 5)
+    monitor.stat_add("reader_queue_size", 3)
+    assert monitor.stat_get("reader_queue_size") == 8
+    with monitor.StatTimer("step_time"):
+        pass
+    assert monitor.stat_get("step_time_count") == 1
+    assert "step_time" in monitor.all_stats()
+    monitor.stat_reset("reader_queue_size")
+    assert monitor.stat_get("reader_queue_size") == 0
+
+
+def test_check_nan_inf_safe_under_jit():
+    """The eager nan scanner must not break tracing (jit.save/to_static)."""
+    from paddle_tpu import nn, static
+
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        net = nn.Linear(3, 2)
+        traced = paddle.jit.to_static(
+            net, input_spec=[static.InputSpec([2, 3], "float32")])
+        out = traced(paddle.to_tensor(np.ones((2, 3), "float32")))
+        assert tuple(out.shape) == (2, 2)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})

@@ -78,3 +78,34 @@ def test_stft_istft_roundtrip():
     # edges lose energy with a hann window; compare the interior
     np.testing.assert_allclose(back.numpy()[0, n_fft:-n_fft],
                                x[0, n_fft:-n_fft], rtol=1e-6, atol=1e-8)
+
+
+def test_frame_axis0_matches_reference_layout():
+    # reference doc: frame(arange(8), 4, 2, axis=0) -> [[0..3],[2..5],[4..7]]
+    x = paddle.to_tensor(np.arange(8).astype("float64"))
+    y = paddle.signal.frame(x, 4, 2, axis=0).numpy()
+    np.testing.assert_array_equal(
+        y, np.array([[0, 1, 2, 3], [2, 3, 4, 5], [4, 5, 6, 7]], "float64"))
+    y1 = paddle.signal.frame(x, 4, 2, axis=-1).numpy()
+    np.testing.assert_array_equal(y1, y.T)
+    back = paddle.signal.overlap_add(paddle.to_tensor(y), 4, axis=0).numpy()
+    # non-overlapping hop=frame_length reconstructs when hop=4
+    x2 = paddle.to_tensor(np.arange(8).astype("float64"))
+    f2 = paddle.signal.frame(x2, 4, 4, axis=0)
+    np.testing.assert_array_equal(
+        paddle.signal.overlap_add(f2, 4, axis=0).numpy(), x2.numpy())
+    with pytest.raises(ValueError):
+        paddle.signal.frame(paddle.to_tensor(np.zeros((2, 8))), 4, 2, axis=1)
+
+
+@pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+def test_hfftn_ihfftn_norms(norm):
+    # real even signal -> rfftn spectrum; hfftn(ihfftn(x)) == x for every norm
+    x = rng.randn(4, 10)
+    spec = paddle.fft.ihfftn(paddle.to_tensor(x), norm=norm)
+    back = paddle.fft.hfftn(spec, s=(4, 10), norm=norm).numpy()
+    np.testing.assert_allclose(back, x, rtol=1e-9, atol=1e-10)
+    # 1d consistency: hfftn over last axis == hfft
+    h1 = paddle.fft.hfftn(paddle.to_tensor(x[0]), axes=(0,), norm=norm).numpy()
+    np.testing.assert_allclose(h1, np.fft.hfft(x[0], norm=norm), rtol=1e-9,
+                               atol=1e-9)

@@ -1,0 +1,70 @@
+"""Functional/higher-order autodiff tests
+(reference analog: tests/unittests/autograd/test_jvp_and_transpose.py etc.)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as A
+
+
+def test_jvp_matches_finite_difference():
+    def f(x):
+        return paddle.sum(paddle.tanh(x) ** 2)
+
+    x = paddle.to_tensor(np.array([0.3, -0.7, 1.2], np.float64))
+    v = paddle.to_tensor(np.array([1.0, 0.5, -0.2], np.float64))
+    _, tan = A.jvp(f, x, v)
+    eps = 1e-6
+    fd = (float(f(paddle.to_tensor(x.numpy() + eps * v.numpy())).numpy())
+          - float(f(paddle.to_tensor(x.numpy() - eps * v.numpy())).numpy())) / (2 * eps)
+    np.testing.assert_allclose(float(tan.numpy()), fd, rtol=1e-6)
+
+
+def test_vjp_matches_backward():
+    def f(x):
+        return paddle.sum(x * x * x)
+
+    xv = np.array([1.0, 2.0, 3.0], np.float64)
+    _, g = A.vjp(f, paddle.to_tensor(xv))
+    np.testing.assert_allclose(g.numpy(), 3 * xv ** 2, rtol=1e-10)
+
+
+def test_jacobian_full_matrix():
+    def f(x):
+        return paddle.matmul(paddle.to_tensor(W), x)
+
+    W = np.random.RandomState(0).randn(3, 4)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4))
+    J = A.Jacobian(f, x)
+    assert J.shape == (3, 4)
+    np.testing.assert_allclose(J.numpy(), W, rtol=1e-10)
+    np.testing.assert_allclose(J[0].numpy(), W[0], rtol=1e-10)
+
+
+def test_hessian_quadratic():
+    Q = np.array([[2.0, 1.0], [1.0, 4.0]])
+
+    def f(x):
+        return 0.5 * paddle.sum(x * paddle.matmul(paddle.to_tensor(Q), x))
+
+    x = paddle.to_tensor(np.array([0.5, -1.0]))
+    H = A.Hessian(f, x)
+    np.testing.assert_allclose(H.numpy(), Q, rtol=1e-8)
+
+
+def test_multi_input_jacobian():
+    def f(x, y):
+        return x * y
+
+    x = paddle.to_tensor(np.array([1.0, 2.0]))
+    y = paddle.to_tensor(np.array([3.0, 4.0]))
+    J = A.Jacobian(f, [x, y])
+    expect = np.block([[np.diag([3.0, 4.0]), np.diag([1.0, 2.0])]])
+    np.testing.assert_allclose(J.numpy(), expect, rtol=1e-10)
+
+
+def test_prim_toggles():
+    assert A.prim_enabled()
+    A.disable_prim()
+    assert not A.prim_enabled()
+    A.enable_prim()
+    assert A.prim_enabled()

@@ -88,3 +88,23 @@ def test_jit_save_load_translated_layer(tmp_path):
 
     with pytest.raises(RuntimeError):
         loaded.train()
+
+
+def test_predictor_opens_jit_artifact(tmp_path):
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = Net()
+    net.eval()
+    path = str(tmp_path / "jitnet")
+    paddle.jit.save(net, path, input_spec=[static.InputSpec([3, 4], "float32")])
+    pred = inference.create_predictor(inference.Config(path))
+    xv = np.random.RandomState(4).randn(3, 4).astype("float32")
+    outs = pred.run([xv])
+    ref = net(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)

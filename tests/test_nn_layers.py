@@ -212,3 +212,35 @@ def test_weight_attr_and_custom_init():
     assert l.weight.optimize_attr["learning_rate"] == 0.1
     l2 = nn.Linear(3, 3, bias_attr=False)
     assert l2.bias is None
+
+
+def test_avg_pool_exclusive_semantics():
+    import torch
+
+    x = np.random.RandomState(5).randn(1, 1, 6, 6).astype("float32")
+    # exclusive=False == torch count_include_pad=True
+    got = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                       exclusive=False).numpy()
+    ref = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, stride=2, padding=1,
+                                         count_include_pad=True).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # exclusive=True == count_include_pad=False
+    got_ex = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                          exclusive=True).numpy()
+    ref_ex = torch.nn.functional.avg_pool2d(torch.tensor(x), 3, stride=2,
+                                            padding=1,
+                                            count_include_pad=False).numpy()
+    np.testing.assert_allclose(got_ex, ref_ex, rtol=1e-6)
+    assert not np.allclose(got, got_ex)
+
+
+def test_adaptive_pool_non_divisible_matches_torch():
+    import torch
+
+    x = np.random.RandomState(6).randn(2, 3, 5, 7).astype("float32")
+    got = F.adaptive_avg_pool2d(paddle.to_tensor(x), (3, 2)).numpy()
+    ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), (3, 2)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    gotm = F.adaptive_max_pool2d(paddle.to_tensor(x), (3, 2)).numpy()
+    refm = torch.nn.functional.adaptive_max_pool2d(torch.tensor(x), (3, 2)).numpy()
+    np.testing.assert_allclose(gotm, refm, rtol=1e-5, atol=1e-6)

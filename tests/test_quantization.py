@@ -1,0 +1,103 @@
+"""QAT/PTQ tests (reference analog: slim/tests test_imperative_qat.py,
+test_post_training_quantization_*.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, quantization as Q
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 4, 3, padding=1)
+        self.fc = nn.Linear(4 * 8 * 8, 10)
+
+    def forward(self, x):
+        h = paddle.nn.functional.relu(self.conv(x))
+        return self.fc(paddle.reshape(h, [h.shape[0], -1]))
+
+
+def _data(n=4):
+    rs = np.random.RandomState(0)
+    return (rs.randn(n, 1, 8, 8).astype("float32"),
+            rs.randint(0, 10, (n,)))
+
+
+def test_fake_quant_levels_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 101).astype("float32"))
+    x.stop_gradient = False
+    y = Q.fake_quant(x, 1.0, bits=4)
+    # 4-bit symmetric: at most 2*7+1 distinct levels
+    assert len(np.unique(np.round(y.numpy(), 6))) <= 15
+    loss = paddle.sum(y * y)
+    loss.backward()
+    # straight-through: gradient flows as if identity (2*q(x) * dq/dx≈2x)
+    assert x.grad is not None and np.abs(x.grad.numpy()).max() > 0
+
+
+def test_imperative_qat_swaps_and_trains():
+    paddle.seed(11)
+    net = SmallNet()
+    qat = Q.ImperativeQuantAware()
+    qat.quantize(net)
+    assert type(net.conv).__name__ == "QuantedConv2D"
+    assert type(net.fc).__name__ == "QuantedLinear"
+
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    xv, yv = _data(8)
+    x, y = paddle.to_tensor(xv), paddle.to_tensor(yv)
+    losses = []
+    for _ in range(10):
+        loss = paddle.nn.functional.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    # activation observer saw data
+    assert float(np.asarray(net.fc._a_quant.scale._value)) > 0
+
+
+def test_qat_save_quantized_model(tmp_path):
+    paddle.seed(12)
+    net = SmallNet()
+    Q.ImperativeQuantAware().quantize(net)
+    xv, _ = _data(2)
+    net(paddle.to_tensor(xv))  # populate EMA scales
+    net.eval()
+    ref = net(paddle.to_tensor(xv)).numpy()
+    path = str(tmp_path / "qnet")
+    Q.ImperativeQuantAware().save_quantized_model(
+        net, path, input_spec=[paddle.static.InputSpec([2, 1, 8, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    got = loaded(paddle.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-5)
+
+
+def test_weight_quantize_roundtrip():
+    w = np.random.RandomState(3).randn(16, 8).astype("float32")
+    q, s = Q.weight_quantize(w, bits=8, channel_axis=1)
+    assert q.dtype == np.int8 and s.shape == (1, 8)
+    back = Q.weight_dequantize(q, s)
+    assert np.abs(back - w).max() < np.abs(w).max() / 100  # <1% of range
+
+
+def test_post_training_quantization():
+    paddle.seed(13)
+    net = SmallNet()
+    net.eval()
+    xv, _ = _data(4)
+    float_out = net(paddle.to_tensor(xv)).numpy()
+
+    loader = [(xv,)] * 3
+    ptq = Q.PostTrainingQuantization(model=net, data_loader=loader, batch_nums=3)
+    qmodel = ptq.quantize()
+    assert ptq.scales, "no scales collected"
+    for rec in ptq.scales.values():
+        assert rec["weight_int8"].dtype == np.int8
+        assert rec["act_scale"] > 0
+    qmodel.eval()
+    q_out = qmodel(paddle.to_tensor(xv)).numpy()
+    # int8 model tracks the float model closely on calibration data
+    rel = np.abs(q_out - float_out).max() / (np.abs(float_out).max() + 1e-9)
+    assert rel < 0.1, rel

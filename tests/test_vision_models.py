@@ -1,0 +1,71 @@
+"""Vision model-zoo smoke tests (reference analog:
+tests/unittests/test_vision_models.py: construct, forward, output shape)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _check(model, num_classes=10, size=64, batch=2):
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(batch, 3, size, size).astype("float32"))
+    model.eval()
+    out = model(x)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    assert tuple(out.shape) == (batch, num_classes), out.shape
+    return out
+
+
+@pytest.mark.parametrize("factory", [
+    models.mobilenet_v1, models.mobilenet_v3_small,
+    models.squeezenet1_1, models.shufflenet_v2_x0_25,
+])
+def test_small_models_forward(factory):
+    _check(factory(num_classes=10))
+
+
+def test_densenet121_forward():
+    _check(models.densenet121(num_classes=10))
+
+
+def test_googlenet_aux_heads():
+    model = models.googlenet(num_classes=10)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 96, 96).astype("float32"))
+    model.eval()
+    out, a1, a2 = model(x)
+    assert tuple(out.shape) == (2, 10)
+    assert tuple(a1.shape) == (2, 10) and tuple(a2.shape) == (2, 10)
+
+
+def test_inception_v3_forward():
+    model = models.inception_v3(num_classes=10)
+    x = paddle.to_tensor(
+        np.random.RandomState(2).randn(1, 3, 299, 299).astype("float32"))
+    model.eval()
+    assert tuple(model(x).shape) == (1, 10)
+
+
+def test_resnext_wide_variants_build():
+    m = models.resnext50_32x4d(num_classes=7)
+    _check(m, num_classes=7)
+    w = models.wide_resnet50_2(num_classes=7)
+    _check(w, num_classes=7)
+
+
+def test_mobilenet_v3_large_trains():
+    paddle.seed(3)
+    model = models.mobilenet_v3_large(num_classes=4)
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+    x = paddle.to_tensor(np.random.RandomState(3).randn(2, 3, 64, 64).astype("float32"))
+    y = paddle.to_tensor(np.array([1, 3]))
+    model.train()
+    first = None
+    for _ in range(3):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < first
