@@ -1,0 +1,187 @@
+// Parameter-server tables (native core).
+//
+// Reference analog: paddle/fluid/distributed/ps/table/ — MemoryDenseTable
+// (memory_dense_table.cc) and MemorySparseTable (memory_sparse_table.cc,
+// sharded unordered_map with rule-based optimizers applied server-side).
+// Here: a C-ABI dense table (flat float buffer) and sparse table (sharded
+// hash map id -> embedding row, lazily initialized), both thread-safe, with
+// server-side SGD / Adagrad appliers so gradient application happens in
+// native code off the Python GIL. Exposed via ctypes (no pybind11 in image).
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <random>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kSparseShards = 16;
+
+struct DenseTable {
+  std::vector<float> data;
+  std::vector<float> grad_acc;   // accumulated gradients (async merge)
+  std::vector<float> adagrad;    // per-element G sum for adagrad
+  std::mutex mu;
+};
+
+struct SparseRow {
+  std::vector<float> emb;
+  std::vector<float> adagrad;
+};
+
+struct SparseShard {
+  std::unordered_map<int64_t, SparseRow> rows;
+  std::mutex mu;
+};
+
+struct SparseTable {
+  int dim;
+  uint64_t seed;
+  float init_range;
+  SparseShard shards[kSparseShards];
+
+  SparseRow& FindOrInit(int64_t id) {
+    SparseShard& s = shards[static_cast<uint64_t>(id) % kSparseShards];
+    auto it = s.rows.find(id);
+    if (it != s.rows.end()) return it->second;
+    SparseRow row;
+    row.emb.resize(dim);
+    row.adagrad.assign(dim, 0.f);
+    // deterministic per-id init (uniform in [-range, range])
+    std::mt19937_64 gen(seed ^ static_cast<uint64_t>(id) * 0x9E3779B97F4A7C15ULL);
+    std::uniform_real_distribution<float> dist(-init_range, init_range);
+    for (int i = 0; i < dim; ++i) row.emb[i] = dist(gen);
+    return s.rows.emplace(id, std::move(row)).first->second;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------------------------------------------- dense
+void* ps_dense_new(int64_t size) {
+  auto* t = new DenseTable();
+  t->data.assign(size, 0.f);
+  t->grad_acc.assign(size, 0.f);
+  t->adagrad.assign(size, 0.f);
+  return t;
+}
+
+void ps_dense_free(void* h) { delete static_cast<DenseTable*>(h); }
+
+void ps_dense_assign(void* h, const float* v, int64_t n) {
+  auto* t = static_cast<DenseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::memcpy(t->data.data(), v, n * sizeof(float));
+}
+
+void ps_dense_read(void* h, float* out, int64_t n) {
+  auto* t = static_cast<DenseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::memcpy(out, t->data.data(), n * sizeof(float));
+}
+
+// accumulate a gradient contribution (async workers call concurrently)
+void ps_dense_push_grad(void* h, const float* g, int64_t n) {
+  auto* t = static_cast<DenseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  for (int64_t i = 0; i < n; ++i) t->grad_acc[i] += g[i];
+}
+
+// apply accumulated grads: optimizer 0 = SGD, 1 = Adagrad. Clears the
+// accumulator. Returns the L2 norm of the applied gradient.
+double ps_dense_apply(void* h, int optimizer, float lr, float epsilon) {
+  auto* t = static_cast<DenseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  double sq = 0.0;
+  const int64_t n = (int64_t)t->data.size();
+  for (int64_t i = 0; i < n; ++i) {
+    float g = t->grad_acc[i];
+    sq += (double)g * g;
+    if (optimizer == 1) {
+      t->adagrad[i] += g * g;
+      t->data[i] -= lr * g / (std::sqrt(t->adagrad[i]) + epsilon);
+    } else {
+      t->data[i] -= lr * g;
+    }
+    t->grad_acc[i] = 0.f;
+  }
+  return std::sqrt(sq);
+}
+
+// ------------------------------------------------------------------- sparse
+void* ps_sparse_new(int dim, uint64_t seed, float init_range) {
+  auto* t = new SparseTable();
+  t->dim = dim;
+  t->seed = seed;
+  t->init_range = init_range;
+  return t;
+}
+
+void ps_sparse_free(void* h) { delete static_cast<SparseTable*>(h); }
+
+int64_t ps_sparse_size(void* h) {
+  auto* t = static_cast<SparseTable*>(h);
+  int64_t n = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    n += (int64_t)s.rows.size();
+  }
+  return n;
+}
+
+// pull rows for ids (lazily initializing unseen ids): out is [n, dim]
+void ps_sparse_pull(void* h, const int64_t* ids, int64_t n, float* out) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    SparseShard& s = t->shards[static_cast<uint64_t>(ids[i]) % kSparseShards];
+    std::lock_guard<std::mutex> lk(s.mu);
+    SparseRow& row = t->FindOrInit(ids[i]);
+    std::memcpy(out + i * t->dim, row.emb.data(), t->dim * sizeof(float));
+  }
+}
+
+// push grads [n, dim] for ids and apply immediately (async-SGD style);
+// optimizer 0 = SGD, 1 = Adagrad (per-row G sums).
+void ps_sparse_push_grad(void* h, const int64_t* ids, int64_t n, const float* g,
+                         int optimizer, float lr, float epsilon) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    SparseShard& s = t->shards[static_cast<uint64_t>(ids[i]) % kSparseShards];
+    std::lock_guard<std::mutex> lk(s.mu);
+    SparseRow& row = t->FindOrInit(ids[i]);
+    const float* gi = g + i * t->dim;
+    for (int d = 0; d < t->dim; ++d) {
+      if (optimizer == 1) {
+        row.adagrad[d] += gi[d] * gi[d];
+        row.emb[d] -= lr * gi[d] / (std::sqrt(row.adagrad[d]) + epsilon);
+      } else {
+        row.emb[d] -= lr * gi[d];
+      }
+    }
+  }
+}
+
+// export all rows (for checkpointing): caller passes capacity row counts;
+// returns number of rows written. ids_out [cap], emb_out [cap, dim].
+int64_t ps_sparse_export(void* h, int64_t* ids_out, float* emb_out, int64_t cap) {
+  auto* t = static_cast<SparseTable*>(h);
+  int64_t w = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& kv : s.rows) {
+      if (w >= cap) return w;
+      ids_out[w] = kv.first;
+      std::memcpy(emb_out + w * t->dim, kv.second.emb.data(),
+                  t->dim * sizeof(float));
+      ++w;
+    }
+  }
+  return w;
+}
+
+}  // extern "C"

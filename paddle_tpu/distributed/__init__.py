@@ -42,6 +42,7 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, reshard, shard_op, shard_tensor  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import ps  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 

@@ -6,6 +6,7 @@ implementation routes everything through ONE pjit'd hybrid train step instead of
 meta-optimizer program rewriting.
 """
 from .distributed_strategy import DistributedStrategy  # noqa: F401
+from ..ps.role_maker import PaddleCloudRoleMaker  # noqa: F401
 from .fleet_base import (  # noqa: F401
     Fleet,
     distributed_model,

@@ -23,6 +23,15 @@ class Fleet:
     def init(self, role_maker=None, is_collective=True, strategy=None):
         if strategy is not None:
             self._user_defined_strategy = strategy
+        if role_maker is not None and not is_collective:
+            # parameter-server mode (reference: fleet_base.py:206 with
+            # PaddleCloudRoleMaker → TheOnePSRuntime)
+            from ..ps import runtime as ps_runtime
+
+            self._role = role_maker
+            ps_runtime.set_role(role_maker)
+            self._is_initialized = True
+            return self
         hc = self._user_defined_strategy.hybrid_configs
         degrees = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
                    hc.get("sharding_degree", 1), hc.get("mp_degree", 1)]
@@ -50,18 +59,42 @@ class Fleet:
         return max(1, env_mod.get_world_size())
 
     def is_worker(self):
-        return True
+        return self._role.is_worker() if self._role is not None else True
 
     def is_server(self):
-        return False
+        return self._role.is_server() if self._role is not None else False
+
+    # ---------------------------------------------------- PS lifecycle
+    def init_server(self, *args, **kwargs):
+        from ..ps import runtime as ps_runtime
+
+        return ps_runtime.init_server(self._role)
+
+    def run_server(self):
+        from ..ps import runtime as ps_runtime
+
+        return ps_runtime.run_server(block=True)
+
+    def init_worker(self):
+        from ..ps import runtime as ps_runtime
+
+        return ps_runtime.init_worker(self._role)
 
     def barrier_worker(self):
+        if self._role is not None and self._role.is_worker():
+            from ..ps import runtime as ps_runtime
+
+            ps_runtime.barrier_worker()
+            return
         from ..collective import barrier
 
         barrier()
 
     def stop_worker(self):
-        pass
+        if self._role is not None:
+            from ..ps import runtime as ps_runtime
+
+            ps_runtime.stop_worker()
 
     # ------------------------------------------------------------ hcg
     def get_hybrid_communicate_group(self):

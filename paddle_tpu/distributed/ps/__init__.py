@@ -1,0 +1,22 @@
+"""Parameter-server training stack.
+
+Reference analog: `paddle/fluid/distributed/ps/` (25.7k LoC) — brpc-based
+PsServer/PsClient (`service/brpc_ps_server.cc`, `brpc_ps_client.cc`), memory
+dense/sparse tables (`table/memory_sparse_table.cc`), accessors, and the
+python runtime `the_one_ps.py:816`.
+
+TPU-native design: the dense math of the model still runs through XLA on the
+chip; what the PS replaces is *parameter storage + update* for huge sparse
+embeddings that can't live in HBM. Tables are native C++ (csrc/ps_table.cc —
+sharded hash maps, server-side SGD/Adagrad appliers, off-GIL) with a numpy
+fallback; transport is a threaded length-prefixed socket protocol (the brpc
+substitute); workers pull rows / push grads asynchronously (async-SGD, the
+reference's default PS mode).
+"""
+from .tables import DenseTable, SparseTable  # noqa: F401
+from .service import PsServer, PsClient  # noqa: F401
+from .role_maker import PaddleCloudRoleMaker, Role  # noqa: F401
+from .runtime import (  # noqa: F401
+    ThePS, DistEmbedding, get_ps_client, init_server, run_server, init_worker,
+    stop_worker, barrier_worker,
+)

@@ -1,0 +1,168 @@
+"""The PS runtime: fleet-style server/worker lifecycle + DistEmbedding layer.
+
+Reference: python/paddle/distributed/fleet/runtime/the_one_ps.py:816
+(TheOnePSRuntime builds servers/workers from strategy) and the distributed
+lookup-table flow (`c_embedding` / `distributed_lookup_table` ops pulling rows
+from the PS before the dense net runs on-device).
+
+TPU-native flow per step (async-SGD):
+  1. DistEmbedding.forward pulls the rows for this batch's ids from the PS and
+     wraps them as a leaf tensor (requires grad) — the dense math then runs
+     through XLA as usual.
+  2. After loss.backward(), `ThePS.step()` pushes each DistEmbedding's row
+     grads (with its ids) and each registered dense param's grad to the
+     servers, which apply SGD/Adagrad natively; fresh dense params are pulled
+     back.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer import Layer
+from .role_maker import PaddleCloudRoleMaker
+from .service import PsClient, PsServer
+
+_client: PsClient | None = None
+_server: PsServer | None = None
+_role: PaddleCloudRoleMaker | None = None
+
+
+def get_ps_client() -> PsClient:
+    assert _client is not None, "call init_worker() first"
+    return _client
+
+
+def _get_role() -> PaddleCloudRoleMaker:
+    global _role
+    if _role is None:
+        _role = PaddleCloudRoleMaker()
+    return _role
+
+
+def set_role(role):
+    global _role
+    _role = role
+
+
+# ---------------------------------------------------------------- lifecycle
+def init_server(role=None, n_workers=None):
+    """Create this rank's PsServer on PADDLE_PORT (reference:
+    fleet.init_server)."""
+    global _server
+    role = role or _get_role()
+    _server = PsServer(port=role._port, n_workers=n_workers or role.worker_num())
+    return _server
+
+
+def run_server(block=True):
+    """Serve until a worker sends stop (reference: fleet.run_server)."""
+    assert _server is not None, "call init_server() first"
+    _server.start(block=block)
+    return _server
+
+
+def init_worker(role=None):
+    """Connect to all PS shards (reference: fleet.init_worker)."""
+    global _client
+    role = role or _get_role()
+    _client = PsClient(role.get_pserver_endpoints())
+    return _client
+
+
+def barrier_worker():
+    get_ps_client().barrier()
+
+
+def stop_worker():
+    """Last barrier, then worker 0 shuts the servers down."""
+    global _client
+    if _client is None:
+        return
+    role = _get_role()
+    _client.barrier()
+    if role.is_first_worker():
+        _client.stop_servers()
+    _client.close()
+    _client = None
+
+
+# ---------------------------------------------------------------- layers
+class DistEmbedding(Layer):
+    """Embedding whose table lives on the parameter servers.
+
+    reference: paddle.static.nn.sparse_embedding / the distributed lookup
+    table (`python/paddle/distributed/fleet/base/distributed_strategy.py`
+    sparse table configs; kernels `operators/pscore/distributed_lookup_table_op.cc`).
+    """
+
+    def __init__(self, name, num_embeddings, embedding_dim, optimizer="adagrad",
+                 lr=0.05):
+        super().__init__()
+        self.table_name = name
+        self.embedding_dim = embedding_dim
+        self._last = None  # (ids, rows_tensor) for grad push
+        get_ps_client().create_sparse(name, embedding_dim, optimizer, lr)
+
+    def forward(self, ids):
+        ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
+                            np.int64)
+        flat = ids_np.reshape(-1)
+        rows = get_ps_client().pull_sparse(self.table_name, flat)
+        t = Tensor(rows, stop_gradient=False)  # leaf: grads accumulate here
+        self._last = (flat, t)
+        from ... import reshape
+
+        return reshape(t, list(ids_np.shape) + [self.embedding_dim])
+
+    def push_grads(self):
+        if self._last is None:
+            return
+        ids, t = self._last
+        if t.grad is not None:
+            get_ps_client().push_sparse(self.table_name, ids, t.grad.numpy())
+        self._last = None
+
+
+class ThePS:
+    """Worker-side coordinator: registers dense params + DistEmbeddings,
+    runs the async pull/push cycle (reference: TheOnePSRuntime)."""
+
+    def __init__(self, model: Layer, dense_optimizer="sgd", dense_lr=0.01):
+        self.model = model
+        self.client = get_ps_client()
+        self._dense: list[tuple[str, Tensor]] = []
+        self._embeddings: list[DistEmbedding] = []
+        for name, sub in [("", model)] + list(model.named_sublayers()):
+            if isinstance(sub, DistEmbedding):
+                self._embeddings.append(sub)
+        for pname, p in model.named_parameters():
+            self._dense.append((pname, p))
+            self.client.create_dense(pname, int(np.prod(p.shape)),
+                                     dense_optimizer, dense_lr,
+                                     init=p.numpy().reshape(-1)
+                                     if self._is_owner() else None)
+        self.client.barrier()  # all tables exist before training
+        self.pull_dense()
+
+    def _is_owner(self):
+        return _get_role().is_first_worker()
+
+    def pull_dense(self):
+        """Refresh local dense params from the servers."""
+        import jax.numpy as jnp
+
+        for name, p in self._dense:
+            vals = self.client.pull_dense(name)
+            p._value = jnp.asarray(vals.reshape(p.shape))
+
+    def step(self):
+        """Push grads (sparse + dense), server applies, pull fresh dense."""
+        for emb in self._embeddings:
+            emb.push_grads()
+        for name, p in self._dense:
+            if p.grad is not None:
+                self.client.push_dense(name, p.grad.numpy().reshape(-1),
+                                       apply_now=True)
+        self.model.clear_gradients()
+        self.pull_dense()

@@ -1,0 +1,283 @@
+"""PS transport: threaded socket server + multi-server client.
+
+Reference analog: paddle/fluid/distributed/ps/service/{brpc_ps_server.cc,
+brpc_ps_client.cc} — brpc RPC replaced with a length-prefixed pickled-message
+protocol (the table math itself is native, csrc/ps_table.cc). Sharding policy
+matches the reference: dense tables live whole on one server chosen by
+name-hash; sparse rows shard across ALL servers by id modulo.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import zlib
+
+import numpy as np
+
+from .tables import DenseTable, SparseTable
+
+
+def _send_msg(sock, obj):
+    data = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock):
+    hdr = _recvn(sock, 4)
+    (n,) = struct.unpack("<I", hdr)
+    return pickle.loads(_recvn(sock, n))
+
+
+def _recvn(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        srv: PsServer = self.server.ps  # type: ignore[attr-defined]
+        sock = self.request
+        try:
+            while True:
+                op, *args = _recv_msg(sock)
+                if op == "stop":
+                    _send_msg(sock, ("ok",))
+                    srv.shutdown_async()
+                    return
+                try:
+                    out = srv.dispatch(op, args)
+                    _send_msg(sock, ("ok", out))
+                except Exception as e:  # report errors to the worker
+                    _send_msg(sock, ("err", repr(e)))
+        except (ConnectionError, OSError):
+            return
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PsServer:
+    """One PS shard. reference: brpc_ps_server.cc (service loop) +
+    table registry keyed by table name."""
+
+    def __init__(self, port=0, n_workers=1):
+        self._dense: dict[str, DenseTable] = {}
+        self._sparse: dict[str, SparseTable] = {}
+        self._n_workers = n_workers
+        self._barrier_lock = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._tcp = _TCPServer(("0.0.0.0", port), _Handler)
+        self._tcp.ps = self  # type: ignore[attr-defined]
+        self.port = self._tcp.server_address[1]
+        self._thread = None
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self, block=False):
+        if block:
+            self._tcp.serve_forever(poll_interval=0.05)
+        else:
+            self._thread = threading.Thread(target=self._tcp.serve_forever,
+                                            kwargs={"poll_interval": 0.05},
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def shutdown_async(self):
+        threading.Thread(target=self._tcp.shutdown, daemon=True).start()
+
+    def stop(self):
+        self._tcp.shutdown()
+        self._tcp.server_close()
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch(self, op, args):
+        if op == "create_dense":
+            name, size, optimizer, lr = args
+            if name not in self._dense:
+                self._dense[name] = DenseTable(size, optimizer, lr)
+            return None
+        if op == "create_sparse":
+            name, dim, optimizer, lr, seed = args
+            if name not in self._sparse:
+                self._sparse[name] = SparseTable(dim, optimizer, lr, seed=seed)
+            return None
+        if op == "assign_dense":
+            name, values = args
+            self._dense[name].assign(values)
+            return None
+        if op == "pull_dense":
+            (name,) = args
+            return self._dense[name].read()
+        if op == "push_dense":
+            name, grad, apply_now = args
+            t = self._dense[name]
+            t.push_grad(grad)
+            if apply_now:
+                t.apply()
+            return None
+        if op == "apply_dense":
+            (name,) = args
+            return self._dense[name].apply()
+        if op == "pull_sparse":
+            name, ids = args
+            return self._sparse[name].pull(ids)
+        if op == "push_sparse":
+            name, ids, grads = args
+            self._sparse[name].push_grad(ids, grads)
+            return None
+        if op == "sparse_size":
+            (name,) = args
+            return self._sparse[name].size()
+        if op == "export_sparse":
+            (name,) = args
+            return self._sparse[name].export()
+        if op == "barrier":
+            return self._barrier()
+        raise ValueError(f"unknown PS op {op!r}")
+
+    def _barrier(self):
+        """All-worker barrier (reference: PSClient barrier via brpc)."""
+        with self._barrier_lock:
+            gen = self._barrier_gen
+            self._barrier_count += 1
+            if self._barrier_count >= self._n_workers:
+                self._barrier_count = 0
+                self._barrier_gen += 1
+                self._barrier_lock.notify_all()
+                return None
+            while gen == self._barrier_gen:
+                if not self._barrier_lock.wait(timeout=60):
+                    raise TimeoutError("PS barrier timed out")
+        return None
+
+
+class PsClient:
+    """Connects to every server; shards requests (reference: brpc_ps_client.cc).
+
+    Dense table `name` lives on server hash(name) % n. Sparse table rows shard
+    by id % n across all servers.
+    """
+
+    def __init__(self, endpoints: list[str], connect_timeout=120.0):
+        import time
+
+        self._eps = list(endpoints)
+        self._socks = []
+        self._locks = []
+        for ep in self._eps:
+            host, port = ep.rsplit(":", 1)
+            deadline = time.time() + connect_timeout
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=30)
+                    break
+                except OSError:
+                    # servers may still be starting (reference: brpc client
+                    # retries until the service registers)
+                    if time.time() > deadline:
+                        raise
+                    time.sleep(0.5)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+            self._locks.append(threading.Lock())
+        self._sparse_dims: dict[str, int] = {}
+
+    @property
+    def n_servers(self):
+        return len(self._socks)
+
+    def _call(self, server_idx, *msg):
+        with self._locks[server_idx]:
+            _send_msg(self._socks[server_idx], msg)
+            resp = _recv_msg(self._socks[server_idx])
+        if resp[0] == "err":
+            raise RuntimeError(f"PS server {self._eps[server_idx]}: {resp[1]}")
+        return resp[1] if len(resp) > 1 else None
+
+    def _dense_home(self, name):
+        # deterministic across processes (python hash() is seed-randomized)
+        return zlib.crc32(name.encode()) % self.n_servers
+
+    # ------------------------------------------------------------ dense
+    def create_dense(self, name, size, optimizer="sgd", lr=0.01,
+                     init: np.ndarray | None = None):
+        i = self._dense_home(name)
+        self._call(i, "create_dense", name, int(size), optimizer, float(lr))
+        if init is not None:
+            self._call(i, "assign_dense", name, np.asarray(init, np.float32))
+
+    def pull_dense(self, name) -> np.ndarray:
+        return self._call(self._dense_home(name), "pull_dense", name)
+
+    def push_dense(self, name, grad, apply_now=True):
+        self._call(self._dense_home(name), "push_dense", name,
+                   np.asarray(grad, np.float32), bool(apply_now))
+
+    # ------------------------------------------------------------ sparse
+    def create_sparse(self, name, dim, optimizer="adagrad", lr=0.05, seed=0):
+        self._sparse_dims[name] = int(dim)
+        for i in range(self.n_servers):
+            self._call(i, "create_sparse", name, int(dim), optimizer, float(lr),
+                       int(seed) + i)
+
+    def pull_sparse(self, name, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        dim = self._sparse_dims[name]
+        out = np.empty((ids.size, dim), np.float32)
+        for i in range(self.n_servers):
+            mask = (ids % self.n_servers) == i
+            if mask.any():
+                out[mask] = self._call(i, "pull_sparse", name, ids[mask])
+        return out
+
+    def push_sparse(self, name, ids, grads):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
+        for i in range(self.n_servers):
+            mask = (ids % self.n_servers) == i
+            if mask.any():
+                self._call(i, "push_sparse", name, ids[mask], g[mask])
+
+    def sparse_size(self, name) -> int:
+        return sum(self._call(i, "sparse_size", name)
+                   for i in range(self.n_servers))
+
+    def export_sparse(self, name):
+        ids, rows = [], []
+        for i in range(self.n_servers):
+            a, b = self._call(i, "export_sparse", name)
+            ids.append(a)
+            rows.append(b)
+        return np.concatenate(ids), np.concatenate(rows)
+
+    # ------------------------------------------------------------ control
+    def barrier(self):
+        # barrier on server 0 only (single rendezvous point)
+        self._call(0, "barrier")
+
+    def stop_servers(self):
+        for i, s in enumerate(self._socks):
+            try:
+                with self._locks[i]:
+                    _send_msg(s, ("stop",))
+                    _recv_msg(s)
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass

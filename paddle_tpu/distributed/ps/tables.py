@@ -1,0 +1,182 @@
+"""PS tables: native C++ core with numpy fallback.
+
+Reference: paddle/fluid/distributed/ps/table/{memory_dense_table.cc,
+memory_sparse_table.cc} — the native tables live in csrc/ps_table.cc.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+import numpy as np
+
+from ...runtime import native
+
+SGD, ADAGRAD = 0, 1
+_OPT = {"sgd": SGD, "adagrad": ADAGRAD}
+
+
+def _lib():
+    if native.lib is None:
+        native.build()
+    return native.lib
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i64p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+class DenseTable:
+    """Flat float32 parameter block with server-side optimizer apply."""
+
+    def __init__(self, size: int, optimizer="sgd", lr=0.01, epsilon=1e-6):
+        self.size = int(size)
+        self.optimizer = _OPT[optimizer]
+        self.lr = float(lr)
+        self.epsilon = float(epsilon)
+        lib = _lib()
+        if lib is not None:
+            self._h = lib.ps_dense_new(self.size)
+            self._lib = lib
+        else:  # numpy fallback
+            self._h = None
+            self._data = np.zeros(self.size, np.float32)
+            self._acc = np.zeros(self.size, np.float32)
+            self._g2 = np.zeros(self.size, np.float32)
+            self._mu = threading.Lock()
+
+    def assign(self, values: np.ndarray):
+        v = np.ascontiguousarray(values, np.float32).reshape(-1)
+        assert v.size == self.size
+        if self._h:
+            self._lib.ps_dense_assign(self._h, _f32p(v), self.size)
+        else:
+            with self._mu:
+                self._data[:] = v
+
+    def read(self) -> np.ndarray:
+        out = np.empty(self.size, np.float32)
+        if self._h:
+            self._lib.ps_dense_read(self._h, _f32p(out), self.size)
+        else:
+            with self._mu:
+                out[:] = self._data
+        return out
+
+    def push_grad(self, grad: np.ndarray):
+        g = np.ascontiguousarray(grad, np.float32).reshape(-1)
+        assert g.size == self.size
+        if self._h:
+            self._lib.ps_dense_push_grad(self._h, _f32p(g), self.size)
+        else:
+            with self._mu:
+                self._acc += g
+
+    def apply(self) -> float:
+        """Apply accumulated grads with the table optimizer; returns |g|."""
+        if self._h:
+            return float(self._lib.ps_dense_apply(
+                self._h, self.optimizer, self.lr, self.epsilon))
+        with self._mu:
+            g = self._acc
+            norm = float(np.linalg.norm(g))
+            if self.optimizer == ADAGRAD:
+                self._g2 += g * g
+                self._data -= self.lr * g / (np.sqrt(self._g2) + self.epsilon)
+            else:
+                self._data -= self.lr * g
+            self._acc[:] = 0
+        return norm
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ps_dense_free(self._h)
+        except Exception:
+            pass
+
+
+class SparseTable:
+    """id -> embedding row, lazily initialized; async server-side updates."""
+
+    def __init__(self, dim: int, optimizer="adagrad", lr=0.05, epsilon=1e-6,
+                 seed=0, init_range=0.05):
+        self.dim = int(dim)
+        self.optimizer = _OPT[optimizer]
+        self.lr = float(lr)
+        self.epsilon = float(epsilon)
+        lib = _lib()
+        if lib is not None:
+            self._h = lib.ps_sparse_new(self.dim, seed, init_range)
+            self._lib = lib
+        else:
+            self._h = None
+            self._rows: dict[int, np.ndarray] = {}
+            self._g2: dict[int, np.ndarray] = {}
+            self._rng = np.random.RandomState(seed)
+            self._init_range = init_range
+            self._mu = threading.Lock()
+
+    def _row(self, i: int) -> np.ndarray:
+        if i not in self._rows:
+            self._rows[i] = self._rng.uniform(
+                -self._init_range, self._init_range, self.dim).astype(np.float32)
+            self._g2[i] = np.zeros(self.dim, np.float32)
+        return self._rows[i]
+
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        out = np.empty((ids.size, self.dim), np.float32)
+        if self._h:
+            self._lib.ps_sparse_pull(self._h, _i64p(ids), ids.size, _f32p(out))
+        else:
+            with self._mu:
+                for k, i in enumerate(ids):
+                    out[k] = self._row(int(i))
+        return out
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(ids.size, self.dim)
+        if self._h:
+            self._lib.ps_sparse_push_grad(self._h, _i64p(ids), ids.size, _f32p(g),
+                                          self.optimizer, self.lr, self.epsilon)
+        else:
+            with self._mu:
+                for k, i in enumerate(ids):
+                    row = self._row(int(i))
+                    if self.optimizer == ADAGRAD:
+                        self._g2[int(i)] += g[k] * g[k]
+                        row -= self.lr * g[k] / (np.sqrt(self._g2[int(i)]) + self.epsilon)
+                    else:
+                        row -= self.lr * g[k]
+
+    def size(self) -> int:
+        if self._h:
+            return int(self._lib.ps_sparse_size(self._h))
+        with self._mu:
+            return len(self._rows)
+
+    def export(self):
+        """(ids, rows) snapshot for checkpointing."""
+        if self._h:
+            cap = self.size()
+            ids = np.empty(cap, np.int64)
+            emb = np.empty((cap, self.dim), np.float32)
+            n = int(self._lib.ps_sparse_export(self._h, _i64p(ids), _f32p(emb), cap))
+            return ids[:n], emb[:n]
+        with self._mu:
+            ids = np.array(sorted(self._rows), np.int64)
+            return ids, np.stack([self._rows[int(i)] for i in ids]) if ids.size \
+                else np.zeros((0, self.dim), np.float32)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ps_sparse_free(self._h)
+        except Exception:
+            pass

@@ -77,15 +77,17 @@ class FakeQuantAbsMax(Layer):
 
 
 class FakeQuantMovingAverageAbsMax(Layer):
-    """Activation quantizer with EMA scale (reference:
-    fake_quantize_dequantize_moving_average_abs_max)."""
+    """Activation quantizer with a running scale: EMA (`algo='ema'`, QAT
+    default; reference: fake_quantize_dequantize_moving_average_abs_max) or
+    running max over all observed batches (`algo='max'`, the PTQ 'abs_max'
+    calibration rule)."""
 
-    def __init__(self, bits=8, moving_rate=0.9):
+    def __init__(self, bits=8, moving_rate=0.9, algo="ema"):
         super().__init__()
         self.bits = bits
         self.moving_rate = moving_rate
+        self.algo = algo
         self.scale = self.create_buffer("scale", np.zeros((), np.float32))
-        self._seen = False
 
     def create_buffer(self, name, value):
         t = Tensor(np.asarray(value), stop_gradient=True)
@@ -94,11 +96,17 @@ class FakeQuantMovingAverageAbsMax(Layer):
 
     def forward(self, x):
         xv = x._value if isinstance(x, Tensor) else x
-        if self.training:
+        # observer update only on concrete values: under jit tracing the
+        # update would leak a tracer into the persistent buffer
+        if self.training and not isinstance(xv, jax.core.Tracer):
             cur = jax.lax.stop_gradient(jnp.max(jnp.abs(xv))).astype(jnp.float32)
             prev = self.scale._value
-            r = self.moving_rate
-            self.scale._value = jnp.where(prev > 0, r * prev + (1 - r) * cur, cur)
+            if self.algo == "max":
+                self.scale._value = jnp.maximum(prev, cur)
+            else:
+                r = self.moving_rate
+                self.scale._value = jnp.where(prev > 0, r * prev + (1 - r) * cur,
+                                              cur)
         return primitive_call(_fake_quant_raw, x, self.scale._value,
                               bits=self.bits,
                               name="fake_quantize_dequantize_moving_average_abs_max")
@@ -108,12 +116,14 @@ class FakeQuantMovingAverageAbsMax(Layer):
 class QuantedLinear(Layer):
     """reference: slim/quantization/imperative/qat.py QuantizedLinear."""
 
-    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9):
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 act_algo="ema"):
         super().__init__()
         self.weight = layer.weight
         self.bias = layer.bias
         self._w_quant = FakeQuantAbsMax(weight_bits, channel_axis=1)
-        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits, moving_rate)
+        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits, moving_rate,
+                                                     algo=act_algo)
 
     def forward(self, x):
         x = self._a_quant(x)
@@ -122,13 +132,15 @@ class QuantedLinear(Layer):
 
 
 class QuantedConv2D(Layer):
-    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9):
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 act_algo="ema"):
         super().__init__()
         self.weight = layer.weight
         self.bias = layer.bias
         self._inner = layer
         self._w_quant = FakeQuantAbsMax(weight_bits, channel_axis=0)
-        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits, moving_rate)
+        self._a_quant = FakeQuantMovingAverageAbsMax(activation_bits, moving_rate,
+                                                     algo=act_algo)
 
     def forward(self, x):
         x = self._a_quant(x)
@@ -147,11 +159,13 @@ class ImperativeQuantAware:
     def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
                  weight_bits=8, activation_bits=8, moving_rate=0.9,
                  weight_quantize_type="channel_wise_abs_max",
-                 activation_quantize_type="moving_average_abs_max"):
+                 activation_quantize_type="moving_average_abs_max",
+                 act_algo="ema"):
         self.types = tuple(quantizable_layer_type)
         self.weight_bits = weight_bits
         self.activation_bits = activation_bits
         self.moving_rate = moving_rate
+        self.act_algo = act_algo
 
     def quantize(self, model: Layer):
         """Swap quantizable sublayers in place (returns model)."""
@@ -161,7 +175,7 @@ class ImperativeQuantAware:
                 if cls in self.types and cls in _QUANT_WRAPPERS:
                     parent._sub_layers[name] = _QUANT_WRAPPERS[cls](
                         sub, self.weight_bits, self.activation_bits,
-                        self.moving_rate)
+                        self.moving_rate, act_algo=self.act_algo)
         return model
 
     def save_quantized_model(self, model, path, input_spec=None):
@@ -216,9 +230,9 @@ class PostTrainingQuantization:
 
     def quantize(self):
         model = self.model
-        qat = ImperativeQuantAware(self.types, self.weight_bits,
-                                   self.activation_bits, moving_rate=0.0
-                                   if self.algo == "abs_max" else 0.9)
+        qat = ImperativeQuantAware(
+            self.types, self.weight_bits, self.activation_bits,
+            act_algo="max" if self.algo == "abs_max" else "ema")
         qat.quantize(model)
         # calibration: run in train() so EMA observers update, grads off
         from ..core.tape import no_grad

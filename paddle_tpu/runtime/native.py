@@ -38,14 +38,28 @@ def build(force=False):
     return _load()
 
 
+_rebuilt_once = False
+
+
 def _load():
-    global lib
+    global lib, _rebuilt_once
     try:
-        lib = ctypes.CDLL(str(_SO))
-        _declare(lib)
+        l = ctypes.CDLL(str(_SO))
+        _declare(l)
+        lib = l
         return lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: cached .so predates newly added csrc symbols —
+        # rebuild once (a bounded retry; a persistent mismatch means the
+        # sources themselves are stale and rebuilding again can't help)
         lib = None
+        if _SO.exists() and not _rebuilt_once:
+            _rebuilt_once = True
+            try:
+                _SO.unlink()
+            except OSError:
+                return None
+            return build()
         return None
 
 
@@ -75,6 +89,29 @@ def _declare(l):
     l.ptq_store_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long]
     l.ptq_store_wait.restype = ctypes.c_int
     l.ptq_store_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+    # ps tables (csrc/ps_table.cc)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    l.ps_dense_new.restype = ctypes.c_void_p
+    l.ps_dense_new.argtypes = [ctypes.c_int64]
+    l.ps_dense_free.argtypes = [ctypes.c_void_p]
+    l.ps_dense_assign.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
+    l.ps_dense_read.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
+    l.ps_dense_push_grad.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
+    l.ps_dense_apply.restype = ctypes.c_double
+    l.ps_dense_apply.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_float,
+                                 ctypes.c_float]
+    l.ps_sparse_new.restype = ctypes.c_void_p
+    l.ps_sparse_new.argtypes = [ctypes.c_int, ctypes.c_uint64, ctypes.c_float]
+    l.ps_sparse_free.argtypes = [ctypes.c_void_p]
+    l.ps_sparse_size.restype = ctypes.c_int64
+    l.ps_sparse_size.argtypes = [ctypes.c_void_p]
+    l.ps_sparse_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+    l.ps_sparse_push_grad.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
+                                      f32p, ctypes.c_int, ctypes.c_float,
+                                      ctypes.c_float]
+    l.ps_sparse_export.restype = ctypes.c_int64
+    l.ps_sparse_export.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
 
 
 # attempt load of an existing build at import (no compile at import time)

@@ -1,0 +1,148 @@
+"""Parameter-server stack tests.
+
+Reference test analog: tests/unittests/test_dist_base.py (subprocess
+pserver/trainer cluster) + table unit tests (memory_sparse_table_test.cc).
+Here servers run in-process threads (single-host substitute, same as the
+reference's local-cluster pattern).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.ps import (
+    DenseTable, PsClient, PsServer, SparseTable,
+)
+from paddle_tpu.distributed.ps import runtime as ps_runtime
+from paddle_tpu.distributed.ps.role_maker import PaddleCloudRoleMaker
+from paddle_tpu.runtime import native
+
+
+def test_dense_table_sgd_adagrad():
+    t = DenseTable(4, optimizer="sgd", lr=0.1)
+    t.assign(np.ones(4, np.float32))
+    t.push_grad(np.full(4, 2.0, np.float32))
+    t.push_grad(np.full(4, 1.0, np.float32))  # accumulates
+    norm = t.apply()
+    np.testing.assert_allclose(t.read(), 1.0 - 0.1 * 3.0, rtol=1e-6)
+    assert norm == pytest.approx(6.0)  # |(3,3,3,3)|
+    ta = DenseTable(2, optimizer="adagrad", lr=0.5)
+    ta.assign(np.zeros(2, np.float32))
+    ta.push_grad(np.array([2.0, -2.0], np.float32))
+    ta.apply()
+    np.testing.assert_allclose(ta.read(), [-0.5, 0.5], rtol=1e-4)
+
+
+def test_sparse_table_lazy_init_and_update():
+    t = SparseTable(8, optimizer="sgd", lr=0.1, seed=3)
+    rows = t.pull(np.array([5, 9, 5]))
+    assert rows.shape == (3, 8)
+    np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+    assert t.size() == 2
+    g = np.ones((2, 8), np.float32)
+    before = t.pull(np.array([5, 9]))
+    t.push_grad(np.array([5, 9]), g)
+    after = t.pull(np.array([5, 9]))
+    np.testing.assert_allclose(after, before - 0.1, rtol=1e-5)
+    ids, emb = t.export()
+    assert set(ids.tolist()) == {5, 9} and emb.shape == (2, 8)
+
+
+def test_native_tables_are_used():
+    # the C++ core should be available in this image (g++ baked in)
+    assert native.lib is not None or native.build() is not None
+
+
+@pytest.fixture
+def ps_cluster():
+    servers = [PsServer(port=0, n_workers=1).start() for _ in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    client = PsClient(eps)
+    yield servers, client, eps
+    try:
+        client.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_client_server_dense_sparse(ps_cluster):
+    _, client, _ = ps_cluster
+    client.create_dense("w", 6, optimizer="sgd", lr=0.5,
+                        init=np.arange(6, dtype=np.float32))
+    np.testing.assert_allclose(client.pull_dense("w"), np.arange(6))
+    client.push_dense("w", np.ones(6, np.float32), apply_now=True)
+    np.testing.assert_allclose(client.pull_dense("w"), np.arange(6) - 0.5)
+
+    client.create_sparse("emb", 4, optimizer="sgd", lr=1.0, seed=0)
+    ids = np.array([0, 1, 2, 3, 101, 202])  # shards across both servers
+    rows = client.pull_sparse("emb", ids)
+    assert rows.shape == (6, 4)
+    client.push_sparse("emb", ids, np.ones((6, 4), np.float32))
+    rows2 = client.pull_sparse("emb", ids)
+    np.testing.assert_allclose(rows2, rows - 1.0, rtol=1e-5)
+    assert client.sparse_size("emb") == 6
+
+
+def test_barrier_blocks_until_all_workers():
+    server = PsServer(port=0, n_workers=2).start()
+    c1 = PsClient([f"127.0.0.1:{server.port}"])
+    c2 = PsClient([f"127.0.0.1:{server.port}"])
+    order = []
+
+    def w1():
+        c1.barrier()
+        order.append("released")
+
+    th = threading.Thread(target=w1)
+    th.start()
+    th.join(timeout=0.3)
+    assert th.is_alive() and not order  # blocked until second worker arrives
+    c2.barrier()
+    th.join(timeout=5)
+    assert order == ["released"]
+    c1.close()
+    c2.close()
+    server.stop()
+
+
+def test_ps_end_to_end_embedding_regression(ps_cluster, monkeypatch):
+    """Async-SGD: DistEmbedding + dense linear head, loss decreases."""
+    servers, client, eps = ps_cluster
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", ",".join(eps))
+    ps_runtime.set_role(PaddleCloudRoleMaker())
+    monkeypatch.setattr(ps_runtime, "_client", client)
+
+    paddle.seed(31)
+
+    class SparseNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = ps_runtime.DistEmbedding("vocab", 50, 8, lr=0.2)
+            self.fc = nn.Linear(8, 1)
+
+        def forward(self, ids):
+            h = self.emb(ids)
+            return self.fc(paddle.mean(h, axis=1))
+
+    net = SparseNet()
+    the_ps = ps_runtime.ThePS(net, dense_optimizer="sgd", dense_lr=0.1)
+
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 50, (16, 3))
+    target = (ids.mean(axis=1, keepdims=True) / 25.0 - 1.0).astype("float32")
+
+    losses = []
+    for _ in range(15):
+        pred = net(paddle.to_tensor(ids))
+        loss = paddle.mean((pred - paddle.to_tensor(target)) ** 2)
+        loss.backward()
+        the_ps.step()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert client.sparse_size("vocab") <= 50

@@ -101,3 +101,34 @@ def test_post_training_quantization():
     # int8 model tracks the float model closely on calibration data
     rel = np.abs(q_out - float_out).max() / (np.abs(float_out).max() + 1e-9)
     assert rel < 0.1, rel
+
+
+def test_ptq_abs_max_uses_running_max_over_batches():
+    paddle.seed(14)
+    net = SmallNet()
+    net.eval()
+    big = np.random.RandomState(7).randn(4, 1, 8, 8).astype("float32") * 10
+    small = np.random.RandomState(8).randn(4, 1, 8, 8).astype("float32") * 0.01
+    # big batch first, tiny batch LAST: scale must keep the max, not the last
+    ptq = Q.PostTrainingQuantization(model=net, data_loader=[(big,), (small,)],
+                                     batch_nums=2)
+    ptq.quantize()
+    act_scales = [r["act_scale"] for r in ptq.scales.values()]
+    assert all(s > 0.5 for s in act_scales), act_scales
+
+
+def test_qat_trace_in_train_mode_does_not_leak_tracers():
+    paddle.seed(15)
+    net = SmallNet()
+    Q.ImperativeQuantAware().quantize(net)
+    xv = np.random.RandomState(9).randn(2, 1, 8, 8).astype("float32")
+    net(paddle.to_tensor(xv))  # seed observer scales eagerly
+    # trace while still in train() mode (supported QAT export flow)
+    traced = paddle.jit.to_static(
+        net, input_spec=[paddle.static.InputSpec([2, 1, 8, 8], "float32")])
+    traced(paddle.to_tensor(xv))
+    # buffers must still be concrete: eager forward works after tracing
+    out = net(paddle.to_tensor(xv))
+    assert np.isfinite(out.numpy()).all()
+    s = np.asarray(net.fc._a_quant.scale._value)
+    assert np.isfinite(s)
