@@ -1,0 +1,126 @@
+// Host event tracer (native core).
+//
+// Reference analog: paddle/fluid/platform/profiler/host_tracer.cc +
+// host_event_recorder.h — RecordEvent annotations written to a per-thread
+// ring buffer, merged and exported as a Chrome trace
+// (chrometracing_logger.cc). Here: a fixed-capacity global ring buffer
+// guarded by a mutex (host annotation rates are ~us-scale, far from
+// contention), with a native Chrome-trace JSON exporter.
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t start_ns;
+  uint64_t dur_ns;
+  uint64_t tid;
+};
+
+struct Tracer {
+  std::vector<Event> ring;
+  size_t head = 0;       // next write slot once full
+  size_t count = 0;      // number of valid events
+  size_t capacity;
+  uint64_t dropped = 0;
+  std::mutex mu;
+  explicit Tracer(size_t cap) : capacity(cap) { ring.reserve(cap); }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* host_tracer_new(int64_t capacity) { return new Tracer((size_t)capacity); }
+
+void host_tracer_free(void* h) { delete static_cast<Tracer*>(h); }
+
+uint64_t host_tracer_now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void host_tracer_record(void* h, const char* name, uint64_t start_ns,
+                        uint64_t dur_ns, uint64_t tid) {
+  auto* t = static_cast<Tracer*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  if (t->ring.size() < t->capacity) {
+    t->ring.push_back({name, start_ns, dur_ns, tid});
+    t->count = t->ring.size();
+  } else {  // overwrite oldest (ring semantics, like host_event_recorder)
+    t->ring[t->head] = {name, start_ns, dur_ns, tid};
+    t->head = (t->head + 1) % t->capacity;
+    t->dropped++;
+  }
+}
+
+int64_t host_tracer_count(void* h) {
+  auto* t = static_cast<Tracer*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  return (int64_t)t->count;
+}
+
+int64_t host_tracer_dropped(void* h) {
+  auto* t = static_cast<Tracer*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  return (int64_t)t->dropped;
+}
+
+void host_tracer_clear(void* h) {
+  auto* t = static_cast<Tracer*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  t->ring.clear();
+  t->head = 0;
+  t->count = 0;
+  t->dropped = 0;
+}
+
+// Export chrome://tracing JSON ("X" complete events, us timestamps).
+// Returns number of events written, or -1 on file error.
+int64_t host_tracer_export(void* h, const char* path, const char* process_name) {
+  auto* t = static_cast<Tracer*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[", f);
+  std::fprintf(f,
+               "{\"name\":\"process_name\",\"ph\":\"M\",\"pid\":1,"
+               "\"args\":{\"name\":\"%s\"}}",
+               process_name && *process_name ? process_name : "paddle_tpu host");
+  // oldest-first: ring[head..end) then ring[0..head)
+  size_t n = t->ring.size();
+  for (size_t i = 0; i < n; ++i) {
+    const Event& e = t->ring[(t->head + i) % n];
+    std::string esc;
+    esc.reserve(e.name.size());
+    for (unsigned char c : e.name) {
+      if (c == '"' || c == '\\') {
+        esc += '\\';
+        esc += (char)c;
+      } else if (c < 0x20) {  // control chars must be \u-escaped in JSON
+        char buf[8];
+        std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+        esc += buf;
+      } else {
+        esc += (char)c;
+      }
+    }
+    std::fprintf(f,
+                 ",{\"name\":\"%s\",\"ph\":\"X\",\"pid\":1,\"tid\":%llu,"
+                 "\"ts\":%.3f,\"dur\":%.3f}",
+                 esc.c_str(), (unsigned long long)e.tid, e.start_ns / 1000.0,
+                 e.dur_ns / 1000.0);
+  }
+  std::fputs("]}", f);
+  std::fclose(f);
+  return (int64_t)n;
+}
+
+}  // extern "C"

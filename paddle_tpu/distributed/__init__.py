@@ -43,6 +43,7 @@ from .auto_parallel import ProcessMesh, reshard, shard_op, shard_tensor  # noqa:
 from .parallel import DataParallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import ps  # noqa: F401
+from . import fleet_executor  # noqa: F401
 from .spawn import spawn  # noqa: F401
 
 

@@ -1,0 +1,23 @@
+"""FleetExecutor — actor-style dataflow runtime.
+
+Reference analog: `paddle/fluid/distributed/fleet_executor/` — a per-rank
+`Carrier` (carrier.h:49) running `Interceptor`s (interceptor.h; compute/
+amplifier/source/sink in compute_interceptor.h:24 etc.) connected by a brpc
+`MessageBus` (message_bus.cc), scheduled over a `TaskNode` graph
+(task_node.cc) built from the program — the engine behind static-graph 1F1B
+pipeline execution.
+
+TPU-native role: XLA already schedules *within* a compiled computation, so the
+actor runtime's job here is the *host-side* orchestration XLA can't see:
+micro-batch flow control between pipeline-stage step-functions, credit-based
+backpressure, and cross-rank messaging (in-process bus for same-host carriers;
+the native TCPStore/socket layer for multi-host). Payload execution is a
+callable — typically one jit-compiled stage step.
+"""
+from .task_node import TaskNode  # noqa: F401
+from .interceptor import (  # noqa: F401
+    AmplifierInterceptor, ComputeInterceptor, Interceptor, Message,
+    SinkInterceptor, SourceInterceptor,
+)
+from .carrier import Carrier, MessageBus  # noqa: F401
+from .fleet_executor import FleetExecutor  # noqa: F401

@@ -1,0 +1,182 @@
+"""Carrier + MessageBus.
+
+Reference: paddle/fluid/distributed/fleet_executor/{carrier.cc,
+message_bus.cc} — the carrier owns its rank's interceptors and pumps their
+mailboxes; the bus routes messages by task_id, in-process for local
+interceptors and over brpc for remote ranks. Here the remote hop is a
+length-prefixed pickle socket (same transport family as distributed.ps); the
+carrier's dispatch loop drains a mailbox guarded by the native blocking-queue
+wake tokens when available.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+
+from .interceptor import Message
+
+
+class MessageBus:
+    """Routes messages to local carriers by rank, or over TCP to remote ones."""
+
+    def __init__(self):
+        self._local: dict[int, "Carrier"] = {}
+        self._remote: dict[int, str] = {}  # rank -> host:port
+        self._socks: dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+
+    def register_carrier(self, carrier: "Carrier"):
+        self._local[carrier.rank] = carrier
+
+    def register_remote(self, rank: int, endpoint: str):
+        self._remote[rank] = endpoint
+
+    def route_to_rank(self, rank: int, msg: Message):
+        if rank in self._local:
+            self._local[rank].deliver(msg)
+            return
+        ep = self._remote[rank]
+        with self._lock:
+            s = self._socks.get(rank)
+            if s is None:
+                host, port = ep.rsplit(":", 1)
+                s = socket.create_connection((host, int(port)), timeout=30)
+                self._socks[rank] = s
+            data = pickle.dumps(msg, protocol=4)
+            s.sendall(struct.pack("<I", len(data)) + data)
+
+    def serve(self, port=0):
+        """Accept remote messages for this process's carriers."""
+        bus = self
+
+        class H(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        hdr = b""
+                        while len(hdr) < 4:
+                            c = self.request.recv(4 - len(hdr))
+                            if not c:
+                                return
+                            hdr += c
+                        (n,) = struct.unpack("<I", hdr)
+                        buf = b""
+                        while len(buf) < n:
+                            c = self.request.recv(n - len(buf))
+                            if not c:
+                                return
+                            buf += c
+                        msg = pickle.loads(buf)
+                        for carrier in bus._local.values():
+                            if msg.dst_id in carrier._interceptors:
+                                carrier.deliver(msg)
+                                break
+                except OSError:
+                    return
+
+        class S(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        srv = S(("0.0.0.0", port), H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        return srv, srv.server_address[1]
+
+    def close(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class Carrier:
+    """reference: carrier.h:49 — owns interceptors, drives their handle()."""
+
+    def __init__(self, rank: int, bus: MessageBus):
+        self.rank = rank
+        self.bus = bus
+        self._interceptors: dict[int, object] = {}
+        self._task_ranks: dict[int, int] = {}
+        self._mailbox: list[Message] = []
+        self._cv = threading.Condition()
+        self._done: set[int] = set()
+        self._stop = False
+        self._thread = None
+        bus.register_carrier(self)
+
+    def add_interceptor(self, interceptor, rank: int | None = None):
+        interceptor.carrier = self
+        self._interceptors[interceptor.task_id] = interceptor
+        self._task_ranks[interceptor.task_id] = self.rank
+        return interceptor
+
+    def set_task_rank(self, task_id: int, rank: int):
+        """Record that `task_id` lives on another rank's carrier."""
+        self._task_ranks[task_id] = rank
+
+    # ---------------------------------------------------------- routing
+    def route(self, msg: Message):
+        rank = self._task_ranks.get(msg.dst_id, self.rank)
+        if rank == self.rank and msg.dst_id in self._interceptors:
+            self.deliver(msg)
+        else:
+            self.bus.route_to_rank(rank, msg)
+
+    def deliver(self, msg: Message):
+        with self._cv:
+            self._mailbox.append(msg)
+            self._cv.notify()
+
+    def on_interceptor_done(self, task_id: int):
+        with self._cv:
+            self._done.add(task_id)
+            self._cv.notify()
+
+    # ---------------------------------------------------------- loop
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        # kick sources via their mailbox so ALL interceptor execution happens
+        # on the single carrier loop thread (no concurrent handle/_emit races)
+        for ic in self._interceptors.values():
+            if hasattr(ic, "start"):
+                self.deliver(Message("START", dst_id=ic.task_id))
+        return self
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._mailbox and not self._stop:
+                    self._cv.wait(timeout=0.1)
+                if self._stop:
+                    return
+                msg = self._mailbox.pop(0)
+            ic = self._interceptors.get(msg.dst_id)
+            if ic is not None:
+                ic.handle(msg)
+
+    def wait(self, timeout=60.0):
+        """Block until every local interceptor reports done."""
+        import time
+
+        deadline = time.time() + timeout
+        with self._cv:
+            while set(self._interceptors) - self._done:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    missing = set(self._interceptors) - self._done
+                    raise TimeoutError(
+                        f"carrier rank {self.rank}: interceptors {missing} "
+                        "did not finish")
+                self._cv.wait(timeout=min(0.1, remaining))
+
+    def stop(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -101,7 +101,7 @@ class DistEmbedding(Layer):
         super().__init__()
         self.table_name = name
         self.embedding_dim = embedding_dim
-        self._last = None  # (ids, rows_tensor) for grad push
+        self._lookups = []  # every forward's (ids, rows_tensor) this step
         get_ps_client().create_sparse(name, embedding_dim, optimizer, lr)
 
     def forward(self, ids):
@@ -110,18 +110,16 @@ class DistEmbedding(Layer):
         flat = ids_np.reshape(-1)
         rows = get_ps_client().pull_sparse(self.table_name, flat)
         t = Tensor(rows, stop_gradient=False)  # leaf: grads accumulate here
-        self._last = (flat, t)
+        self._lookups.append((flat, t))  # shared-table multi-lookup safe
         from ... import reshape
 
         return reshape(t, list(ids_np.shape) + [self.embedding_dim])
 
     def push_grads(self):
-        if self._last is None:
-            return
-        ids, t = self._last
-        if t.grad is not None:
-            get_ps_client().push_sparse(self.table_name, ids, t.grad.numpy())
-        self._last = None
+        for ids, t in self._lookups:
+            if t.grad is not None:
+                get_ps_client().push_sparse(self.table_name, ids, t.grad.numpy())
+        self._lookups.clear()
 
 
 class ThePS:

@@ -73,6 +73,7 @@ class PsServer:
     def __init__(self, port=0, n_workers=1):
         self._dense: dict[str, DenseTable] = {}
         self._sparse: dict[str, SparseTable] = {}
+        self._create_lock = threading.Lock()  # guards table creation races
         self._n_workers = n_workers
         self._barrier_lock = threading.Condition()
         self._barrier_count = 0
@@ -104,13 +105,16 @@ class PsServer:
     def dispatch(self, op, args):
         if op == "create_dense":
             name, size, optimizer, lr = args
-            if name not in self._dense:
-                self._dense[name] = DenseTable(size, optimizer, lr)
+            with self._create_lock:  # concurrent workers race to create
+                if name not in self._dense:
+                    self._dense[name] = DenseTable(size, optimizer, lr)
             return None
         if op == "create_sparse":
             name, dim, optimizer, lr, seed = args
-            if name not in self._sparse:
-                self._sparse[name] = SparseTable(dim, optimizer, lr, seed=seed)
+            with self._create_lock:
+                if name not in self._sparse:
+                    self._sparse[name] = SparseTable(dim, optimizer, lr,
+                                                     seed=seed)
             return None
         if op == "assign_dense":
             name, values = args
@@ -156,9 +160,18 @@ class PsServer:
                 self._barrier_gen += 1
                 self._barrier_lock.notify_all()
                 return None
+            deadline = 60.0
+            import time
+
+            end = time.monotonic() + deadline
             while gen == self._barrier_gen:
-                if not self._barrier_lock.wait(timeout=60):
-                    raise TimeoutError("PS barrier timed out")
+                remaining = end - time.monotonic()
+                if remaining <= 0 or not self._barrier_lock.wait(timeout=remaining):
+                    if gen == self._barrier_gen:
+                        # withdraw our arrival so a retry can't release a
+                        # barrier the missing workers never reached
+                        self._barrier_count = max(0, self._barrier_count - 1)
+                        raise TimeoutError("PS barrier timed out")
         return None
 
 
@@ -192,6 +205,12 @@ class PsClient:
             self._socks.append(s)
             self._locks.append(threading.Lock())
         self._sparse_dims: dict[str, int] = {}
+        # per-server sockets are independent: fan requests out concurrently
+        # (reference: brpc_ps_client issues async RPCs per shard)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = (ThreadPoolExecutor(max_workers=len(self._socks))
+                      if len(self._socks) > 1 else None)
 
     @property
     def n_servers(self):
@@ -208,6 +227,14 @@ class PsClient:
     def _dense_home(self, name):
         # deterministic across processes (python hash() is seed-randomized)
         return zlib.crc32(name.encode()) % self.n_servers
+
+    def _fanout(self, calls):
+        """Run [(server_idx, msg-tuple), ...] concurrently; returns results
+        in input order."""
+        if self._pool is None or len(calls) <= 1:
+            return [self._call(i, *msg) for i, msg in calls]
+        futs = [self._pool.submit(self._call, i, *msg) for i, msg in calls]
+        return [f.result() for f in futs]
 
     # ------------------------------------------------------------ dense
     def create_dense(self, name, size, optimizer="sgd", lr=0.01,
@@ -227,38 +254,40 @@ class PsClient:
     # ------------------------------------------------------------ sparse
     def create_sparse(self, name, dim, optimizer="adagrad", lr=0.05, seed=0):
         self._sparse_dims[name] = int(dim)
-        for i in range(self.n_servers):
-            self._call(i, "create_sparse", name, int(dim), optimizer, float(lr),
-                       int(seed) + i)
+        self._fanout([(i, ("create_sparse", name, int(dim), optimizer,
+                           float(lr), int(seed) + i))
+                      for i in range(self.n_servers)])
 
     def pull_sparse(self, name, ids) -> np.ndarray:
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         dim = self._sparse_dims[name]
         out = np.empty((ids.size, dim), np.float32)
-        for i in range(self.n_servers):
-            mask = (ids % self.n_servers) == i
-            if mask.any():
-                out[mask] = self._call(i, "pull_sparse", name, ids[mask])
+        masks = [(i, (ids % self.n_servers) == i) for i in range(self.n_servers)]
+        calls = [(i, ("pull_sparse", name, ids[m])) for i, m in masks if m.any()]
+        results = self._fanout(calls)
+        for (i, m), r in zip([x for x in masks if x[1].any()], results):
+            out[m] = r
         return out
 
     def push_sparse(self, name, ids, grads):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         g = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
+        calls = []
         for i in range(self.n_servers):
             mask = (ids % self.n_servers) == i
             if mask.any():
-                self._call(i, "push_sparse", name, ids[mask], g[mask])
+                calls.append((i, ("push_sparse", name, ids[mask], g[mask])))
+        self._fanout(calls)
 
     def sparse_size(self, name) -> int:
-        return sum(self._call(i, "sparse_size", name)
-                   for i in range(self.n_servers))
+        return sum(self._fanout([(i, ("sparse_size", name))
+                                 for i in range(self.n_servers)]))
 
     def export_sparse(self, name):
-        ids, rows = [], []
-        for i in range(self.n_servers):
-            a, b = self._call(i, "export_sparse", name)
-            ids.append(a)
-            rows.append(b)
+        results = self._fanout([(i, ("export_sparse", name))
+                                for i in range(self.n_servers)])
+        ids = [a for a, _ in results]
+        rows = [b for _, b in results]
         return np.concatenate(ids), np.concatenate(rows)
 
     # ------------------------------------------------------------ control
@@ -276,6 +305,8 @@ class PsClient:
                 pass
 
     def close(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
         for s in self._socks:
             try:
                 s.close()

@@ -12,7 +12,7 @@ import time
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "make_scheduler",
-           "export_chrome_tracing", "benchmark"]
+           "export_chrome_tracing", "benchmark", "host_tracer"]
 
 
 class ProfilerTarget:
@@ -72,6 +72,7 @@ class Profiler:
         self.stop()
 
     def start(self):
+        host_tracer()  # eager: keep the one-time native build out of traces
         self._last = time.perf_counter()
         if not self._timer_only:
             import tempfile
@@ -113,12 +114,86 @@ class Profiler:
                 f"max: {ts.max():.3f}ms")
 
 
+class _HostTracer:
+    """Native ring-buffer host-event recorder (csrc/host_tracer.cc; reference:
+    platform/profiler/host_event_recorder.h). Python-list fallback when the
+    native lib is unavailable."""
+
+    def __init__(self, capacity=1 << 16):
+        from ..runtime import native
+
+        self._capacity = capacity
+        if native.lib is None:
+            native.build()
+        self._lib = native.lib
+        self._h = (self._lib.host_tracer_new(capacity)
+                   if self._lib is not None else None)
+        self._events = []  # fallback store
+
+    def record(self, name, start_ns, dur_ns, tid):
+        if self._h:
+            self._lib.host_tracer_record(self._h, name.encode(), start_ns,
+                                         dur_ns, tid)
+        else:
+            self._events.append((name, start_ns, dur_ns, tid))
+            if len(self._events) > self._capacity:
+                self._events.pop(0)
+
+    def count(self):
+        if self._h:
+            return int(self._lib.host_tracer_count(self._h))
+        return len(self._events)
+
+    def clear(self):
+        if self._h:
+            self._lib.host_tracer_clear(self._h)
+        else:
+            self._events.clear()
+
+    def export_chrome_trace(self, path, process_name="paddle_tpu host"):
+        """Write chrome://tracing JSON; returns the number of events."""
+        if self._h:
+            n = int(self._lib.host_tracer_export(self._h, path.encode(),
+                                                 process_name.encode()))
+            if n < 0:
+                raise OSError(f"cannot write trace to {path}")
+            return n
+        import json as _json
+
+        evs = [{"name": nm, "ph": "X", "pid": 1, "tid": t,
+                "ts": s / 1000.0, "dur": d / 1000.0}
+               for nm, s, d, t in self._events]
+        with open(path, "w") as f:
+            _json.dump({"traceEvents": evs}, f)
+        return len(evs)
+
+
+_host_tracer = None
+
+
+def host_tracer() -> _HostTracer:
+    global _host_tracer
+    if _host_tracer is None:
+        _host_tracer = _HostTracer()
+    return _host_tracer
+
+
 @contextlib.contextmanager
 def RecordEvent(name, event_type=None):
-    """Host annotation visible in the xplane trace (reference: RecordEvent
+    """Host annotation: recorded in the native ring buffer (chrome-trace
+    exportable) and as an xplane TraceAnnotation so it also shows up inside
+    the XLA device trace (reference: RecordEvent
     platform/profiler/event_tracing.h:47)."""
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    import threading
+
+    tr = host_tracer()  # before t0: first call may build the native lib
+    t0 = time.perf_counter_ns()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        tr.record(name, t0, time.perf_counter_ns() - t0,
+                  threading.get_ident() % (1 << 31))
 
 
 class benchmark:

@@ -112,6 +112,22 @@ def _declare(l):
                                       ctypes.c_float]
     l.ps_sparse_export.restype = ctypes.c_int64
     l.ps_sparse_export.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
+    # host tracer (csrc/host_tracer.cc)
+    l.host_tracer_new.restype = ctypes.c_void_p
+    l.host_tracer_new.argtypes = [ctypes.c_int64]
+    l.host_tracer_free.argtypes = [ctypes.c_void_p]
+    l.host_tracer_now_ns.restype = ctypes.c_uint64
+    l.host_tracer_record.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_uint64,
+                                     ctypes.c_uint64]
+    l.host_tracer_count.restype = ctypes.c_int64
+    l.host_tracer_count.argtypes = [ctypes.c_void_p]
+    l.host_tracer_dropped.restype = ctypes.c_int64
+    l.host_tracer_dropped.argtypes = [ctypes.c_void_p]
+    l.host_tracer_clear.argtypes = [ctypes.c_void_p]
+    l.host_tracer_export.restype = ctypes.c_int64
+    l.host_tracer_export.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_char_p]
 
 
 # attempt load of an existing build at import (no compile at import time)

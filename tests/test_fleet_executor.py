@@ -1,0 +1,165 @@
+"""FleetExecutor actor-runtime tests (reference analog:
+fleet_executor/test/{compute_interceptor_test.cc, interceptor_pipeline_test.cc,
+source_interceptor_test.cc})."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet_executor import (
+    FleetExecutor, MessageBus, TaskNode,
+)
+
+
+def _chain(n_micro, fns, buffer_size=2, ranks=None):
+    """source -> compute... -> sink chain."""
+    nodes = [TaskNode(0, rank=0, max_run_times=n_micro, type="Source",
+                      run_fn=lambda i: i)]
+    for k, fn in enumerate(fns, start=1):
+        r = ranks[k] if ranks else 0
+        nodes.append(TaskNode(k, rank=r, max_run_times=n_micro, type="Compute",
+                              run_fn=fn))
+    nodes.append(TaskNode(len(fns) + 1, rank=ranks[-1] if ranks else 0,
+                          max_run_times=n_micro, type="Sink"))
+    for a, b in zip(nodes, nodes[1:]):
+        a.add_downstream_task(b.task_id, buffer_size)
+        b.add_upstream_task(a.task_id, buffer_size)
+    return nodes
+
+
+def test_source_compute_sink_chain():
+    nodes = _chain(6, [lambda x: x * 2, lambda x: x + 1])
+    exe = FleetExecutor(nodes)
+    results = exe.run()
+    assert results == [i * 2 + 1 for i in range(6)]
+
+
+def test_credit_backpressure_limits_inflight():
+    """With buffer_size=1 the source can never run ahead by more than one
+    micro-batch (the reference's flow-control invariant)."""
+    inflight, peak = [0], [0]
+
+    def slow_stage(x):
+        inflight[0] += 1
+        peak[0] = max(peak[0], inflight[0])
+        import time
+
+        time.sleep(0.005)
+        inflight[0] -= 1
+        return x
+
+    nodes = _chain(8, [slow_stage], buffer_size=1)
+    results = FleetExecutor(nodes).run()
+    assert results == list(range(8))
+    assert peak[0] <= 1
+
+
+def test_multi_carrier_cross_rank():
+    """Stages on different ranks (carriers) exchanging via the bus."""
+    nodes = _chain(5, [lambda x: x + 10, lambda x: x * 3],
+                   ranks={1: 0, 2: 1, -1: 1})
+    exe = FleetExecutor(nodes)
+    results = exe.run()
+    assert results == [(i + 10) * 3 for i in range(5)]
+    assert len(exe.carriers) == 2
+
+
+def test_amplifier_gradient_accumulation():
+    """Amplifier forwards downstream only every N runs (grad-accum fan-in)."""
+    from paddle_tpu.distributed.fleet_executor import AmplifierInterceptor
+
+    acc = []
+
+    def accumulate(x):
+        acc.append(x)
+        return sum(acc)
+
+    n_micro = 6
+    src = TaskNode(0, max_run_times=n_micro, type="Source", run_fn=lambda i: 1)
+    amp = TaskNode(1, max_run_times=n_micro, type="Amplifier",
+                   run_fn=accumulate, send_down_per_steps=3)
+    sink = TaskNode(2, max_run_times=n_micro // 3, type="Sink")
+    src.add_downstream_task(1, 8)
+    amp.add_upstream_task(0, 8)
+    amp.add_downstream_task(2, 8)
+    sink.add_upstream_task(1, 8)
+
+    exe = FleetExecutor([src, amp, sink])
+    assert isinstance(exe.carriers[0]._interceptors[1], AmplifierInterceptor)
+    results = exe.run()
+    assert results == [3, 6]  # partial sums after 3 and 6 accumulations
+
+
+def test_amplifier_run_per_steps_fanout():
+    """run_per_steps=2: each upstream payload is executed twice."""
+    seen = []
+
+    def record(x):
+        seen.append(x)
+        return x
+
+    src = TaskNode(0, max_run_times=3, type="Source", run_fn=lambda i: i)
+    amp = TaskNode(1, max_run_times=6, type="Amplifier", run_fn=record,
+                   run_per_steps=2)
+    sink = TaskNode(2, max_run_times=6, type="Sink")
+    src.add_downstream_task(1, 4)
+    amp.add_upstream_task(0, 4)
+    amp.add_downstream_task(2, 8)
+    sink.add_upstream_task(1, 8)
+    results = FleetExecutor([src, amp, sink]).run()
+    assert seen == [0, 0, 1, 1, 2, 2]
+    assert results == [0, 0, 1, 1, 2, 2]
+
+
+def test_pipeline_with_jit_stages():
+    """Host-driven 2-stage model pipeline: each stage is a jitted step."""
+    import jax
+    import jax.numpy as jnp
+
+    w1 = jnp.asarray(np.random.RandomState(0).randn(4, 8), jnp.float32)
+    w2 = jnp.asarray(np.random.RandomState(1).randn(8, 2), jnp.float32)
+
+    @jax.jit
+    def stage1(x):
+        return jnp.tanh(x @ w1)
+
+    @jax.jit
+    def stage2(h):
+        return h @ w2
+
+    batches = [np.random.RandomState(i).randn(3, 4).astype("float32")
+               for i in range(4)]
+    nodes = _chain(4, [lambda x: stage1(x), lambda h: stage2(h)])
+    # source feeds real data
+    nodes[0].run_fn = lambda i: jnp.asarray(batches[i])
+    results = FleetExecutor(nodes).run()
+    for i, out in enumerate(results):
+        expect = np.tanh(batches[i] @ np.asarray(w1)) @ np.asarray(w2)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+
+def test_remote_message_bus_over_tcp():
+    """Two FleetExecutors (disjoint local_ranks) exchanging over the TCP bus —
+    the multi-host path (reference: message_bus.cc brpc channel)."""
+    nodes_spec = lambda: _chain(4, [lambda x: x + 100], ranks={1: 1, -1: 1})
+
+    bus_a, bus_b = MessageBus(), MessageBus()
+    exe_a = FleetExecutor(nodes_spec(), bus=bus_a, local_ranks={0})
+    exe_b = FleetExecutor(nodes_spec(), bus=bus_b, local_ranks={1})
+    srv_a, port_a = bus_a.serve()
+    srv_b, port_b = bus_b.serve()
+    bus_a.register_remote(1, f"127.0.0.1:{port_b}")
+    bus_b.register_remote(0, f"127.0.0.1:{port_a}")
+
+    import threading
+
+    results = {}
+
+    def run_b():
+        results["b"] = exe_b.run(timeout=30)
+
+    tb = threading.Thread(target=run_b)
+    tb.start()
+    exe_a.run(timeout=30)  # rank 0 holds only the source
+    tb.join(timeout=35)
+    assert results["b"] == [i + 100 for i in range(4)]
+    srv_a.shutdown(); srv_b.shutdown()
+    bus_a.close(); bus_b.close()

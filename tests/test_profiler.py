@@ -1,0 +1,69 @@
+"""Profiler / host tracer tests (reference analog:
+tests/unittests/test_profiler.py, new_profiler tests)."""
+import json
+import time
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+
+
+def test_record_event_ring_buffer_and_chrome_export(tmp_path):
+    tr = profiler.host_tracer()
+    tr.clear()
+    with profiler.RecordEvent("step"):
+        with profiler.RecordEvent("forward"):
+            time.sleep(0.001)
+        with profiler.RecordEvent("backward"):
+            pass
+    assert tr.count() == 3
+    path = str(tmp_path / "trace.json")
+    n = tr.export_chrome_trace(path)
+    assert n == 3
+    with open(path) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"step", "forward", "backward"} <= names
+    fw = next(e for e in doc["traceEvents"] if e.get("name") == "forward")
+    assert fw["dur"] >= 1000.0  # >= 1ms in us units
+
+
+def test_ring_buffer_overwrites_oldest():
+    from paddle_tpu.profiler import _HostTracer
+
+    tr = _HostTracer(capacity=4)
+    for i in range(10):
+        tr.record(f"e{i}", i * 100, 10, 1)
+    assert tr.count() == 4
+
+
+def test_profiler_timer_summary():
+    prof = paddle.profiler.Profiler(timer_only=True)
+    prof.start()
+    for _ in range(3):
+        time.sleep(0.002)
+        prof.step()
+    prof.stop()
+    s = prof.summary()
+    assert "steps: 3" in s
+
+
+def test_benchmark_ips():
+    b = paddle.profiler.benchmark()
+    b.begin()
+    for _ in range(5):
+        time.sleep(0.001)
+        b.step(num_samples=32)
+    rep = b.report()
+    assert rep["steps"] == 5 and rep["ips"] > 0
+
+
+def test_chrome_export_escapes_control_chars(tmp_path):
+    tr = profiler.host_tracer()
+    tr.clear()
+    tr.record("step\n1\t\"x\"", 0, 100, 1)
+    path = str(tmp_path / "esc.json")
+    tr.export_chrome_trace(path)
+    with open(path) as f:
+        doc = json.load(f)  # must parse despite control chars in the name
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert names == ['step\n1\t"x"']
