@@ -7,6 +7,8 @@ meta-optimizer program rewriting.
 """
 from .distributed_strategy import DistributedStrategy  # noqa: F401
 from ..ps.role_maker import PaddleCloudRoleMaker  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .fs import HDFSClient, LocalFS  # noqa: F401
 from .fleet_base import (  # noqa: F401
     Fleet,
     distributed_model,

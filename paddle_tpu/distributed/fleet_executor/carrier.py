@@ -108,7 +108,7 @@ class Carrier:
         self._thread = None
         bus.register_carrier(self)
 
-    def add_interceptor(self, interceptor, rank: int | None = None):
+    def add_interceptor(self, interceptor):
         interceptor.carrier = self
         self._interceptors[interceptor.task_id] = interceptor
         self._task_ranks[interceptor.task_id] = self.rank

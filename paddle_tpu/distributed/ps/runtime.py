@@ -105,12 +105,18 @@ class DistEmbedding(Layer):
         get_ps_client().create_sparse(name, embedding_dim, optimizer, lr)
 
     def forward(self, ids):
+        from ...core import tape as tape_mod
+
         ids_np = np.asarray(ids.numpy() if isinstance(ids, Tensor) else ids,
                             np.int64)
         flat = ids_np.reshape(-1)
         rows = get_ps_client().pull_sparse(self.table_name, flat)
-        t = Tensor(rows, stop_gradient=False)  # leaf: grads accumulate here
-        self._lookups.append((flat, t))  # shared-table multi-lookup safe
+        track = tape_mod.is_grad_enabled() and self.training
+        t = Tensor(rows, stop_gradient=not track)
+        if track:
+            # shared-table multi-lookup safe; eval/no_grad forwards don't
+            # accumulate (nothing will ever push their grads)
+            self._lookups.append((flat, t))
         from ... import reshape
 
         return reshape(t, list(ids_np.shape) + [self.embedding_dim])

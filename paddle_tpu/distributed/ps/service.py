@@ -74,6 +74,7 @@ class PsServer:
         self._dense: dict[str, DenseTable] = {}
         self._sparse: dict[str, SparseTable] = {}
         self._create_lock = threading.Lock()  # guards table creation races
+        self._blobs: dict[str, list] = {}  # global-shuffle mailboxes
         self._n_workers = n_workers
         self._barrier_lock = threading.Condition()
         self._barrier_count = 0
@@ -148,6 +149,17 @@ class PsServer:
             return self._sparse[name].export()
         if op == "barrier":
             return self._barrier()
+        if op == "put_blob":
+            # opaque blob mailbox (dataset global_shuffle record exchange;
+            # reference: data_set.cc GlobalShuffle sends records via PS RPC)
+            key, blob = args
+            with self._create_lock:
+                self._blobs.setdefault(key, []).append(blob)
+            return None
+        if op == "take_blobs":
+            (key,) = args
+            with self._create_lock:
+                return self._blobs.pop(key, [])
         raise ValueError(f"unknown PS op {op!r}")
 
     def _barrier(self):
@@ -258,26 +270,27 @@ class PsClient:
                            float(lr), int(seed) + i))
                       for i in range(self.n_servers)])
 
+    def _shard_masks(self, ids):
+        shard = ids % self.n_servers  # one pass over ids
+        return [(i, m) for i in range(self.n_servers)
+                for m in [shard == i] if m.any()]
+
     def pull_sparse(self, name, ids) -> np.ndarray:
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         dim = self._sparse_dims[name]
         out = np.empty((ids.size, dim), np.float32)
-        masks = [(i, (ids % self.n_servers) == i) for i in range(self.n_servers)]
-        calls = [(i, ("pull_sparse", name, ids[m])) for i, m in masks if m.any()]
-        results = self._fanout(calls)
-        for (i, m), r in zip([x for x in masks if x[1].any()], results):
+        pairs = self._shard_masks(ids)
+        results = self._fanout([(i, ("pull_sparse", name, ids[m]))
+                                for i, m in pairs])
+        for (_, m), r in zip(pairs, results):
             out[m] = r
         return out
 
     def push_sparse(self, name, ids, grads):
         ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
         g = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
-        calls = []
-        for i in range(self.n_servers):
-            mask = (ids % self.n_servers) == i
-            if mask.any():
-                calls.append((i, ("push_sparse", name, ids[mask], g[mask])))
-        self._fanout(calls)
+        self._fanout([(i, ("push_sparse", name, ids[m], g[m]))
+                      for i, m in self._shard_masks(ids)])
 
     def sparse_size(self, name) -> int:
         return sum(self._fanout([(i, ("sparse_size", name))
@@ -289,6 +302,13 @@ class PsClient:
         ids = [a for a, _ in results]
         rows = [b for _, b in results]
         return np.concatenate(ids), np.concatenate(rows)
+
+    # ------------------------------------------------------------ blobs
+    def put_blob(self, key, blob, server_idx=0):
+        self._call(server_idx, "put_blob", key, blob)
+
+    def take_blobs(self, key, server_idx=0):
+        return self._call(server_idx, "take_blobs", key)
 
     # ------------------------------------------------------------ control
     def barrier(self):

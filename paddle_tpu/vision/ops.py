@@ -1,0 +1,422 @@
+"""paddle.vision.ops — detection/vision operators.
+
+Reference analog: `python/paddle/vision/ops.py` backed by phi kernels
+(`phi/kernels/gpu/roi_align_kernel.cu`, `nms_kernel.cu`,
+`yolo_box_kernel.cu`, `operators/deformable_conv_op.cu`). TPU-native: every
+op is pure-jax with static shapes — NMS is an O(N²) mask + lax.scan greedy
+sweep (no dynamic shapes, MXU/VPU friendly), RoIAlign is bilinear gather,
+deform_conv gathers offset sample grids then runs one big matmul.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["nms", "roi_align", "RoIAlign", "roi_pool", "RoIPool", "yolo_box",
+           "box_coder", "DeformConv2D", "deform_conv2d", "distribute_fpn_proposals",
+           "generate_proposals"]
+
+
+def _t(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+
+
+# ---------------------------------------------------------------------- iou
+def _box_iou(a, b):
+    """IoU matrix between boxes [N,4] and [M,4] (x1,y1,x2,y2)."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Hard NMS (reference: vision/ops.py nms → phi nms_kernel). Returns kept
+    indices sorted by score. Static-shape greedy sweep via lax.scan."""
+    bv = _t(boxes)
+    n = bv.shape[0]
+    sv = (_t(scores) if scores is not None
+          else jnp.arange(n, 0, -1, dtype=jnp.float32))
+
+    def f(bv, sv, *cat):
+        order = jnp.argsort(-sv)
+        b_sorted = bv[order]
+        iou = _box_iou(b_sorted, b_sorted)
+        if cat:  # category-aware: suppress only within the same class
+            c_sorted = cat[0][order]
+            same = c_sorted[:, None] == c_sorted[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(keep, i):
+            # suppressed if any higher-scored KEPT box overlaps > threshold
+            over = (iou[i] > iou_threshold) & keep & (jnp.arange(n) < i)
+            k = ~jnp.any(over)
+            return keep.at[i].set(k), k
+
+        keep0 = jnp.zeros(n, bool).at[0].set(True)
+        keep, _ = jax.lax.scan(body, keep0, jnp.arange(1, n)) if n > 1 else (keep0, None)
+        kept_sorted_positions = jnp.nonzero(keep, size=n, fill_value=n)[0]
+        return order, keep, kept_sorted_positions
+
+    cat_args = [] if category_idxs is None else [_t(category_idxs)]
+    order, keep, kept_pos = primitive_call(f, bv, sv, *cat_args, name="nms")
+    order_np = np.asarray(order._value if isinstance(order, Tensor) else order)
+    keep_np = np.asarray(keep._value if isinstance(keep, Tensor) else keep)
+    kept = order_np[keep_np]  # indices in score order that survived
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, jnp.int64))
+
+
+# ----------------------------------------------------------------- roi align
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference: vision/ops.py roi_align → phi roi_align_kernel. x: [N,C,H,W],
+    boxes: [R,4] (x1,y1,x2,y2 in input-image coords), boxes_num: rois per image."""
+    xv, bv = _t(x), _t(boxes)
+    nper = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                      else boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(nper)), nper)  # static metadata
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+    ratio = 2 if sampling_ratio <= 0 else sampling_ratio
+
+    def f(xv, bv):
+        off = 0.5 if aligned else 0.0
+        b = bv * spatial_scale
+        x1, y1, x2, y2 = b[:, 0] - off, b[:, 1] - off, b[:, 2] - off, b[:, 3] - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_w, bin_h = rw / ow, rh / oh
+        # sample grid: [R, oh*ratio, ow*ratio]
+        gy = (y1[:, None] + bin_h[:, None] *
+              ((jnp.arange(oh * ratio) + 0.5) / ratio)[None, :])
+        gx = (x1[:, None] + bin_w[:, None] *
+              ((jnp.arange(ow * ratio) + 0.5) / ratio)[None, :])
+
+        H, W = xv.shape[2], xv.shape[3]
+        feats = xv[batch_idx]  # [R, C, H, W]
+
+        def bilinear(img, yy, xx):
+            # img [C,H,W]; yy [Sy], xx [Sx] -> [C, Sy, Sx]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            wy1 = jnp.clip(yy - y0, 0, 1)
+            wx1 = jnp.clip(xx - x0, 0, 1)
+            wy0, wx0 = 1 - wy1, 1 - wx1
+            v00 = img[:, y0i][:, :, x0i]
+            v01 = img[:, y0i][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0i]
+            v11 = img[:, y1i][:, :, x1i]
+            return (v00 * (wy0[:, None] * wx0[None, :])
+                    + v01 * (wy0[:, None] * wx1[None, :])
+                    + v10 * (wy1[:, None] * wx0[None, :])
+                    + v11 * (wy1[:, None] * wx1[None, :]))
+
+        samples = jax.vmap(bilinear)(feats, gy, gx)  # [R, C, oh*r, ow*r]
+        R = samples.shape[0]
+        pooled = samples.reshape(R, -1, oh, ratio, ow, ratio).mean(axis=(3, 5))
+        return pooled
+
+    # pass the original tensors: keeps the grad tape connected through x
+    return primitive_call(f, x if isinstance(x, Tensor) else xv,
+                          boxes if isinstance(boxes, Tensor) else bv,
+                          name="roi_align")
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Max-pool RoI (reference: vision/ops.py roi_pool). Implemented as
+    dense-sampled max over each bin."""
+    xv, bv = _t(x), _t(boxes)
+    nper = np.asarray(boxes_num.numpy() if isinstance(boxes_num, Tensor)
+                      else boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(nper)), nper)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) else output_size
+    ratio = 4
+
+    def f(xv, bv):
+        b = bv * spatial_scale
+        x1, y1 = b[:, 0], b[:, 1]
+        rw = jnp.maximum(b[:, 2] - x1, 1.0)
+        rh = jnp.maximum(b[:, 3] - y1, 1.0)
+        H, W = xv.shape[2], xv.shape[3]
+        gy = (y1[:, None] + rh[:, None]
+              * ((jnp.arange(oh * ratio) + 0.5) / (oh * ratio)))
+        gx = (x1[:, None] + rw[:, None]
+              * ((jnp.arange(ow * ratio) + 0.5) / (ow * ratio)))
+        feats = xv[batch_idx]
+
+        def nearest(img, yy, xx):
+            yi = jnp.clip(jnp.round(yy - 0.5), 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(jnp.round(xx - 0.5), 0, W - 1).astype(jnp.int32)
+            return img[:, yi][:, :, xi]
+
+        samples = jax.vmap(nearest)(feats, gy, gx)
+        R = samples.shape[0]
+        return samples.reshape(R, -1, oh, ratio, ow, ratio).max(axis=(3, 5))
+
+    return primitive_call(f, xv, bv, name="roi_pool")
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+# ------------------------------------------------------------------ yolo box
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head output (reference: vision/ops.py yolo_box → phi
+    yolo_box_kernel). x: [N, A*(5+C), H, W]; returns (boxes [N,A*H*W,4],
+    scores [N,A*H*W,C])."""
+    xv = _t(x)
+    imgv = _t(img_size)
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+
+    def f(xv, imgv):
+        N, _, H, W = xv.shape
+        p = xv.reshape(N, A, 5 + class_num, H, W)
+        tx, ty = p[:, :, 0], p[:, :, 1]
+        tw, th = p[:, :, 2], p[:, :, 3]
+        obj = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:])
+        gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(tx) * alpha + beta + gx) / W
+        cy = (jax.nn.sigmoid(ty) * alpha + beta + gy) / H
+        aw = anchors[None, :, 0, None, None] / (downsample_ratio * W)
+        ah = anchors[None, :, 1, None, None] / (downsample_ratio * H)
+        bw = jnp.exp(tw) * aw
+        bh = jnp.exp(th) * ah
+        im_h = imgv[:, 0].astype(jnp.float32)[:, None, None, None]
+        im_w = imgv[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * im_w
+        y1 = (cy - bh / 2) * im_h
+        x2 = (cx + bw / 2) * im_w
+        y2 = (cy + bh / 2) * im_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, im_w - 1)
+            y1 = jnp.clip(y1, 0, im_h - 1)
+            x2 = jnp.clip(x2, 0, im_w - 1)
+            y2 = jnp.clip(y2, 0, im_h - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+        score = (obj[..., None] * jnp.moveaxis(cls, 2, -1)).reshape(
+            N, -1, class_num)
+        # conf_thresh zeroes low-confidence entries (static shape)
+        mask = (obj.reshape(N, -1, 1) > conf_thresh)
+        return boxes * mask, score * mask
+
+    return primitive_call(f, xv, imgv, name="yolo_box")
+
+
+# ----------------------------------------------------------------- box coder
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """reference: vision ops box_coder (phi box_coder kernel), center-size
+    codec used by SSD-style heads."""
+    pv, tv = _t(prior_box), _t(target_box)
+    var = _t(prior_box_var) if prior_box_var is not None else None
+    norm = 0.0 if box_normalized else 1.0
+
+    def f(pv, tv, *v):
+        pw = pv[:, 2] - pv[:, 0] + norm
+        ph = pv[:, 3] - pv[:, 1] + norm
+        pcx = pv[:, 0] + pw / 2
+        pcy = pv[:, 1] + ph / 2
+        vv = v[0] if v else jnp.ones_like(pv)
+        if code_type == "encode_center_size":
+            tw = tv[:, 2] - tv[:, 0] + norm
+            th = tv[:, 3] - tv[:, 1] + norm
+            tcx = tv[:, 0] + tw / 2
+            tcy = tv[:, 1] + th / 2
+            out = jnp.stack([
+                (tcx - pcx) / pw, (tcy - pcy) / ph,
+                jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+            return out / vv
+        # decode
+        d = tv * vv
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - norm, cy + h / 2 - norm], axis=1)
+
+    args = [prior_box if isinstance(prior_box, Tensor) else pv,
+            target_box if isinstance(target_box, Tensor) else tv]
+    if var is not None:
+        args.append(prior_box_var if isinstance(prior_box_var, Tensor) else var)
+    return primitive_call(f, *args, name="box_coder")
+
+
+# --------------------------------------------------------------- deform conv
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Deformable conv v1/v2 (reference: vision/ops.py deform_conv2d →
+    operators/deformable_conv_op). Gather bilinear samples at offset
+    positions, then one matmul over (C_in*kh*kw)."""
+    xv, ov, wv = _t(x), _t(offset), _t(weight)
+    mv = _t(mask) if mask is not None else None
+    s = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    p = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    d = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def f(xv, ov, wv, *rest):
+        N, C, H, W = xv.shape
+        Co, Cg, kh, kw = wv.shape
+        oh = (H + 2 * p[0] - d[0] * (kh - 1) - 1) // s[0] + 1
+        ow = (W + 2 * p[1] - d[1] * (kw - 1) - 1) // s[1] + 1
+        K = kh * kw
+        # base sampling grid [oh,ow,K] (input coords)
+        base_y = (jnp.arange(oh) * s[0] - p[0])[:, None, None] + \
+            (jnp.arange(kh) * d[0])[None, None, :].repeat(kw, -1).reshape(1, 1, K)
+        base_x = (jnp.arange(ow) * s[1] - p[1])[None, :, None] + \
+            jnp.tile(jnp.arange(kw) * d[1], kh)[None, None, :]
+        off = ov.reshape(N, deformable_groups, K, 2, oh, ow)
+        # paddle layout: offset interleaved (dy, dx) per kernel point
+        dy = off[:, :, :, 0]  # [N, dg, K, oh, ow]
+        dx = off[:, :, :, 1]
+        # per-deformable-group sample grids [N, dg, oh, ow, K]
+        yy = base_y[None, None] + jnp.moveaxis(dy, 2, -1)
+        xx = base_x[None, None] + jnp.moveaxis(dx, 2, -1)
+
+        def gather(img, yi, xi):
+            # img [C,H,W]; yi/xi [oh,ow,K] int32 -> [C,oh,ow,K]
+            valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yc = jnp.clip(yi, 0, H - 1)
+            xc = jnp.clip(xi, 0, W - 1)
+            out = img[:, yc, xc]
+            return jnp.where(valid[None], out, 0.0)
+
+        def sample_one(img, yy, xx):
+            # img [C,H,W]; yy/xx [oh,ow,K] float -> [C,oh,ow,K]
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            wy1 = (yy - y0)[None]  # broadcast over channels
+            wx1 = (xx - x0)[None]
+            y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+            v00 = gather(img, y0i, x0i)
+            v01 = gather(img, y0i, x0i + 1)
+            v10 = gather(img, y0i + 1, x0i)
+            v11 = gather(img, y0i + 1, x0i + 1)
+            wy0, wx0 = 1 - wy1, 1 - wx1
+            return (v00 * wy0 * wx0 + v01 * wy0 * wx1
+                    + v10 * wy1 * wx0 + v11 * wy1 * wx1)
+
+        # each deformable group's channel slice samples with its own grid
+        Cpg = C // deformable_groups
+        x_groups = xv.reshape(N, deformable_groups, Cpg, H, W)
+        cols = jax.vmap(jax.vmap(sample_one))(x_groups, yy, xx)
+        cols = cols.reshape(N, C, oh, ow, K)
+        # cols: [N, C, oh, ow, K]
+        if mv is not None:
+            m = rest[-1].reshape(N, 1, K, oh, ow)
+            cols = cols * jnp.moveaxis(m, 2, -1)
+        cols = cols.reshape(N, C, oh, ow, kh, kw)
+        # grouped conv as matmul: out[n,co,oh,ow] = sum_{cg,kh,kw}
+        cols_g = cols.reshape(N, groups, C // groups, oh, ow, kh, kw)
+        w_g = wv.reshape(groups, Co // groups, Cg, kh, kw)
+        out = jnp.einsum("ngchwkl,gockl->ngohw", cols_g, w_g,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(N, Co, oh, ow).astype(xv.dtype)
+        if rest and bias is not None:
+            out = out + rest[0].reshape(1, -1, 1, 1)
+        return out
+
+    extra = []
+    if bias is not None:
+        extra.append(bias if isinstance(bias, Tensor) else _t(bias))
+    if mv is not None:
+        extra.append(mask if isinstance(mask, Tensor) else mv)
+    # original tensors keep the grad tape connected (x/offset/weight/bias)
+    return primitive_call(f, x if isinstance(x, Tensor) else xv,
+                          offset if isinstance(offset, Tensor) else ov,
+                          weight if isinstance(weight, Tensor) else wv,
+                          *extra, name="deform_conv2d")
+
+
+class DeformConv2D(Layer):
+    """reference: python/paddle/vision/ops.py DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+
+        kh, kw = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+                  else kernel_size)
+        self._cfg = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0)))
+
+    def forward(self, x, offset, mask=None):
+        s, p, d, dg, g = self._cfg
+        return deform_conv2d(x, offset, self.weight, self.bias, s, p, d, dg, g,
+                             mask)
+
+
+# ------------------------------------------------- fpn distribute (metadata)
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """reference: vision/ops.py distribute_fpn_proposals — assigns each RoI to
+    an FPN level by scale. Host-side metadata op (static shapes per level via
+    numpy; runs outside jit, like the reference's CPU kernel)."""
+    rois = np.asarray(fpn_rois.numpy() if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-6))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, index = [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        outs.append(Tensor(rois[idx]))
+        index.append(idx)
+    restore = np.argsort(np.concatenate(index)) if index else np.zeros(0, np.int64)
+    return outs, [Tensor(i.astype(np.int64)) for i in index], Tensor(restore.astype(np.int64))
+
+
+def generate_proposals(*args, **kwargs):  # pragma: no cover - parity shim
+    raise NotImplementedError(
+        "generate_proposals (RPN decode) lands with the detection model zoo; "
+        "compose yolo_box/box_coder + nms for proposal generation meanwhile"
+    )

@@ -1,0 +1,72 @@
+"""FS abstraction tests (reference analog: tests/unittests/test_fs_interface.py,
+test_fleet_localfs_client.py)."""
+import os
+
+import pytest
+
+from paddle_tpu.distributed.fleet import LocalFS
+from paddle_tpu.distributed.fleet.fs import (
+    ExecuteError, FSFileExistsError, FSFileNotExistsError, _handle_errors,
+)
+
+
+def test_localfs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    with pytest.raises(FSFileExistsError):
+        fs.touch(f, exist_ok=False)
+    dirs, files = fs.ls_dir(d)
+    assert files == ["x.txt"] and dirs == []
+
+    dst = os.path.join(str(tmp_path), "y.txt")
+    fs.mv(f, dst)
+    assert fs.is_file(dst) and not fs.is_exist(f)
+    with pytest.raises(FSFileNotExistsError):
+        fs.mv(f, dst)
+
+    fs.touch(f)
+    with pytest.raises(FSFileExistsError):
+        fs.mv(dst, f, overwrite=False)
+    fs.mv(dst, f, overwrite=True)
+
+    up = str(tmp_path / "copy.txt")
+    fs.upload(f, up)
+    assert fs.is_file(up)
+    fs.delete(up)
+    assert not fs.is_exist(up)
+    fs.delete(d)
+    assert not fs.is_exist(d)
+
+
+def test_handle_errors_retries_then_raises():
+    calls = []
+
+    class Flaky:
+        _time_out = 0.5
+
+        @_handle_errors()
+        def sometimes(self, fail_times):
+            calls.append(1)
+            if len(calls) <= fail_times:
+                raise OSError("transient")
+            return "ok"
+
+    assert Flaky().sometimes(2) == "ok"
+    assert len(calls) == 3
+
+    calls.clear()
+
+    class AlwaysFail:
+        _time_out = 0.3
+
+        @_handle_errors()
+        def boom(self):
+            raise OSError("nope")
+
+    with pytest.raises(ExecuteError):
+        AlwaysFail().boom()

@@ -1,0 +1,190 @@
+"""paddle.vision.ops numeric tests vs torchvision reference
+(reference analog: tests/unittests/test_nms_op.py, test_roi_align_op.py,
+test_yolo_box_op.py, test_deformable_conv_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _iou_np(a, b):
+    ax1, ay1, ax2, ay2 = a
+    bx1, by1, bx2, by2 = b
+    ix = max(0.0, min(ax2, bx2) - max(ax1, bx1))
+    iy = max(0.0, min(ay2, by2) - max(ay1, by1))
+    inter = ix * iy
+    ua = (ax2 - ax1) * (ay2 - ay1) + (bx2 - bx1) * (by2 - by1) - inter
+    return inter / max(ua, 1e-10)
+
+
+def _nms_np(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    kept = []
+    for i in order:
+        if all(_iou_np(boxes[i], boxes[j]) <= thresh for j in kept):
+            kept.append(i)
+    return np.array(kept)
+
+
+def test_nms_matches_greedy_reference():
+    rs = np.random.RandomState(0)
+    base = rs.rand(40, 2) * 50
+    boxes = np.concatenate([base, base + 5 + rs.rand(40, 2) * 20], 1).astype("float32")
+    scores = rs.rand(40).astype("float32")
+    kept = V.nms(paddle.to_tensor(boxes), 0.4,
+                 scores=paddle.to_tensor(scores)).numpy()
+    ref = _nms_np(boxes, scores, 0.4)
+    np.testing.assert_array_equal(kept, ref)
+
+
+def test_nms_category_aware_and_topk():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]],
+                     "float32")
+    scores = np.array([0.9, 0.8, 0.7], "float32")
+    cats = np.array([0, 0, 1])
+    kept = V.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                 category_idxs=paddle.to_tensor(cats), top_k=5).numpy()
+    # box 1 suppressed by box 0 (same class); box 2 kept (other class)
+    np.testing.assert_array_equal(np.sort(kept), [0, 2])
+
+
+def _roi_align_np(x, boxes, batch_idx, out_size, ratio=2, aligned=True):
+    """Direct numpy port of the RoIAlign definition (bilinear samples
+    averaged per bin)."""
+    R = boxes.shape[0]
+    C, H, W = x.shape[1:]
+    out = np.zeros((R, C, out_size, out_size), "float64")
+    off = 0.5 if aligned else 0.0
+    for r in range(R):
+        img = x[batch_idx[r]]
+        x1, y1, x2, y2 = boxes[r] - off
+        rw = max(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = max(y2 - y1, 1e-3 if aligned else 1.0)
+        bw, bh = rw / out_size, rh / out_size
+        for oy in range(out_size):
+            for ox in range(out_size):
+                acc = np.zeros(C)
+                for sy in range(ratio):
+                    for sx in range(ratio):
+                        yy = y1 + bh * (oy + (sy + 0.5) / ratio)
+                        xx = x1 + bw * (ox + (sx + 0.5) / ratio)
+                        y0 = int(np.clip(np.floor(yy), 0, H - 1))
+                        x0 = int(np.clip(np.floor(xx), 0, W - 1))
+                        y1i = min(y0 + 1, H - 1)
+                        x1i = min(x0 + 1, W - 1)
+                        wy1 = np.clip(yy - y0, 0, 1)
+                        wx1 = np.clip(xx - x0, 0, 1)
+                        acc += ((1 - wy1) * (1 - wx1) * img[:, y0, x0]
+                                + (1 - wy1) * wx1 * img[:, y0, x1i]
+                                + wy1 * (1 - wx1) * img[:, y1i, x0]
+                                + wy1 * wx1 * img[:, y1i, x1i])
+                out[r, :, oy, ox] = acc / (ratio * ratio)
+    return out
+
+
+def test_roi_align_matches_numpy_reference():
+    rs = np.random.RandomState(1)
+    x = rs.randn(2, 3, 16, 16).astype("float32")
+    boxes = np.array([[1.0, 1.0, 9.0, 9.0], [2.0, 3.0, 12.0, 14.0],
+                      [0.0, 0.0, 15.0, 15.0]], "float32")
+    boxes_num = np.array([2, 1])
+    got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                      boxes_num, output_size=4, spatial_scale=1.0,
+                      sampling_ratio=2, aligned=True).numpy()
+    ref = _roi_align_np(x, boxes, [0, 0, 1], 4, ratio=2, aligned=True)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_roi_pool_shape_and_range():
+    rs = np.random.RandomState(2)
+    x = rs.randn(1, 2, 8, 8).astype("float32")
+    boxes = np.array([[0.0, 0.0, 7.0, 7.0]], "float32")
+    out = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes), [1],
+                     output_size=2).numpy()
+    assert out.shape == (1, 2, 2, 2)
+    assert out.max() <= x.max() + 1e-6
+
+
+def test_yolo_box_decode():
+    rs = np.random.RandomState(3)
+    N, A, C, H, W = 1, 2, 3, 4, 4
+    x = rs.randn(N, A * (5 + C), H, W).astype("float32")
+    img = np.array([[128, 128]], "int32")
+    boxes, scores = V.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                               anchors=[10, 13, 16, 30], class_num=C,
+                               conf_thresh=0.0, downsample_ratio=32)
+    b, s = boxes.numpy(), scores.numpy()
+    assert b.shape == (N, A * H * W, 4) and s.shape == (N, A * H * W, C)
+    assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+    assert b.min() >= 0 and b.max() <= 127.0 + 1e-5  # clipped to image
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_box_coder_roundtrip():
+    prior = np.array([[10, 10, 30, 40], [5, 5, 15, 25]], "float32")
+    target = np.array([[12, 11, 28, 42], [6, 7, 14, 22]], "float32")
+    var = np.ones_like(prior)
+    code = V.box_coder(paddle.to_tensor(prior), paddle.to_tensor(var),
+                       paddle.to_tensor(target), "encode_center_size").numpy()
+    back = V.box_coder(paddle.to_tensor(prior), paddle.to_tensor(var),
+                       paddle.to_tensor(code), "decode_center_size").numpy()
+    np.testing.assert_allclose(back, target, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    import paddle_tpu.nn.functional as F
+
+    rs = np.random.RandomState(4)
+    x = rs.randn(2, 3, 8, 8).astype("float32")
+    w = rs.randn(4, 3, 3, 3).astype("float32") * 0.1
+    offset = np.zeros((2, 2 * 9, 6, 6), "float32")
+    got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                          paddle.to_tensor(w)).numpy()
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv_layer_trains():
+    paddle.seed(44)
+    layer = V.DeformConv2D(2, 3, 3, padding=1)
+    x = paddle.to_tensor(np.random.RandomState(5).randn(1, 2, 6, 6).astype("float32"))
+    offset = paddle.to_tensor(
+        0.1 * np.random.RandomState(6).randn(1, 18, 6, 6).astype("float32"))
+    out = layer(x, offset)
+    assert tuple(out.shape) == (1, 3, 6, 6)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    assert layer.weight.grad is not None
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],      # small -> low level
+                     [0, 0, 224, 224],    # refer scale -> refer level
+                     [0, 0, 500, 500]],   # big -> high level
+                    "float32")
+    outs, idxs, restore = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    assert len(outs) == 4
+    sizes = [o.numpy().shape[0] for o in outs]
+    assert sum(sizes) == 3
+    assert outs[2].numpy().shape[0] >= 1  # 224-scale roi at refer level 4
+    order = np.concatenate([i.numpy() for i in idxs])
+    np.testing.assert_array_equal(order[restore.numpy()], np.arange(3))
+
+
+def test_deform_conv_deformable_groups():
+    """dg=2: each channel half must follow its own offset group."""
+    rs = np.random.RandomState(7)
+    x = rs.randn(1, 4, 6, 6).astype("float32")
+    w = np.zeros((4, 4, 1, 1), "float32")
+    for i in range(4):
+        w[i, i] = 1.0  # identity 1x1 conv
+    # group 0: zero offset; group 1: shift sampling by +1 in x
+    offset = np.zeros((1, 2 * 2 * 1, 6, 6), "float32")
+    offset[:, 3] = 1.0  # dg=1's dx
+    got = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                          paddle.to_tensor(w), deformable_groups=2).numpy()
+    np.testing.assert_allclose(got[:, :2], x[:, :2], rtol=1e-5)  # unshifted
+    np.testing.assert_allclose(got[:, 2:, :, :-1], x[:, 2:, :, 1:],
+                               rtol=1e-5)  # shifted by one pixel
