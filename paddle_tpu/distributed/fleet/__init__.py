@@ -9,6 +9,10 @@ from .distributed_strategy import DistributedStrategy  # noqa: F401
 from ..ps.role_maker import PaddleCloudRoleMaker  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .fs import HDFSClient, LocalFS  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from .meta_optimizers import (  # noqa: F401
+    DGCMomentumOptimizer, GradientMergeOptimizer, LocalSGDOptimizer,
+)
 from .fleet_base import (  # noqa: F401
     Fleet,
     distributed_model,

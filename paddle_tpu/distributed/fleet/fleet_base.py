@@ -158,7 +158,11 @@ class HybridParallelOptimizer:
     only carries API (step/clear_grad/lr) and the inner reference."""
 
     def __init__(self, optimizer: Optimizer, hcg, strategy):
-        self._inner_opt = optimizer
+        from .meta_optimizers import create_meta_optimizer
+
+        # strategy-selected meta-optimizers compose around the user optimizer
+        # (reference: _minimize_impl -> strategy_compiler, fleet_base.py:1508)
+        self._inner_opt = create_meta_optimizer(optimizer, strategy)
         self._hcg = hcg
         self._strategy = strategy
 
