@@ -10,6 +10,7 @@ wake tokens when available.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import socketserver
@@ -81,7 +82,12 @@ class MessageBus:
             allow_reuse_address = True
             daemon_threads = True
 
-        srv = S(("0.0.0.0", port), H)
+        # pickle wire format with no auth — trusted-network assumption (see
+        # ps/service.py). Default stays all-interfaces so remote carriers that
+        # registered a real NIC endpoint can connect; PADDLE_PS_BIND_HOST
+        # narrows the bind on deployments that want loopback-only.
+        host = os.environ.get("PADDLE_PS_BIND_HOST", "0.0.0.0")
+        srv = S((host, port), H)
         threading.Thread(target=srv.serve_forever, daemon=True).start()
         return srv, srv.server_address[1]
 

@@ -51,7 +51,12 @@ def init_server(role=None, n_workers=None):
     fleet.init_server)."""
     global _server
     role = role or _get_role()
-    _server = PsServer(port=role._port, n_workers=n_workers or role.worker_num())
+    eps = role.get_pserver_endpoints()
+    host = None
+    if eps and 0 <= role.server_index() < len(eps):
+        host = eps[role.server_index()].split(":")[0]  # bind the advertised NIC
+    _server = PsServer(port=role._port, n_workers=n_workers or role.worker_num(),
+                       host=host)
     return _server
 
 

@@ -8,6 +8,7 @@ name-hash; sparse rows shard across ALL servers by id modulo.
 """
 from __future__ import annotations
 
+import os
 import pickle
 import socket
 import socketserver
@@ -70,7 +71,7 @@ class PsServer:
     """One PS shard. reference: brpc_ps_server.cc (service loop) +
     table registry keyed by table name."""
 
-    def __init__(self, port=0, n_workers=1):
+    def __init__(self, port=0, n_workers=1, host=None):
         self._dense: dict[str, DenseTable] = {}
         self._sparse: dict[str, SparseTable] = {}
         self._create_lock = threading.Lock()  # guards table creation races
@@ -79,7 +80,14 @@ class PsServer:
         self._barrier_lock = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
-        self._tcp = _TCPServer(("0.0.0.0", port), _Handler)
+        # The wire format is pickle with NO auth layer (trusted-cluster
+        # assumption, same as the reference's brpc PS): callers that know
+        # their advertised endpoint pass its interface as `host` so the port
+        # is not exposed on every NIC; PADDLE_PS_BIND_HOST overrides, and the
+        # default remains all-interfaces so launcher-driven multi-host jobs
+        # (controller advertises node.ip) keep working.
+        host = host or os.environ.get("PADDLE_PS_BIND_HOST", "0.0.0.0")
+        self._tcp = _TCPServer((host, port), _Handler)
         self._tcp.ps = self  # type: ignore[attr-defined]
         self.port = self._tcp.server_address[1]
         self._thread = None

@@ -80,6 +80,24 @@ def grad(func, xs, v=None):
     return g
 
 
+def _unflatten_sample(arrays, flat_in):
+    """Split a per-sample flat vector back into per-array sample shapes and
+    re-add the leading batch dim of 1 each array expects."""
+    args, off = [], 0
+    for a in arrays:
+        shp = a.shape[1:]
+        n = int(np.prod(shp)) if shp else 1
+        args.append(flat_in[off:off + n].reshape(shp)[None])
+        off += n
+    return args
+
+
+def _flatten_batched(arrays):
+    """[B, ...] arrays -> [B, sum(per-sample sizes)] in one concatenate."""
+    return jnp.concatenate(
+        [a.reshape(a.shape[0], -1) for a in arrays], axis=1)
+
+
 class Jacobian:
     """Lazy full Jacobian (reference: incubate/autograd/functional.py Jacobian).
 
@@ -96,6 +114,18 @@ class Jacobian:
 
     def _compute(self):
         if self._mat is not None:
+            return self._mat
+
+        if self._is_batched:
+            # reference semantics: the leading dim is a batch dim excluded from
+            # differentiation — J has shape [B, out_flat/B-sample, in_flat/B-sample]
+            def sample_fn(flat_in):
+                out = self._pure(*_unflatten_sample(self._arrays, flat_in))
+                outs = out if isinstance(out, tuple) else (out,)
+                return jnp.concatenate([jnp.ravel(o) for o in outs])
+
+            self._mat = jax.vmap(jax.jacrev(sample_fn))(
+                _flatten_batched(self._arrays))
             return self._mat
 
         def flat_fn(flat_in):
@@ -129,10 +159,22 @@ class Hessian:
     def __init__(self, func, xs, is_batched=False):
         self._arrays = _to_arrays(xs)
         self._pure = _functionalize(func)
+        self._is_batched = is_batched
         self._mat = None
 
     def _compute(self):
         if self._mat is not None:
+            return self._mat
+
+        if self._is_batched:
+            # per-sample Hessian over the leading batch dim: [B, n, n]
+            def sample_fn(flat_in):
+                out = self._pure(*_unflatten_sample(self._arrays, flat_in))
+                out = out[0] if isinstance(out, tuple) else out
+                return jnp.reshape(out, ())
+
+            self._mat = jax.vmap(jax.hessian(sample_fn))(
+                _flatten_batched(self._arrays))
             return self._mat
 
         def flat_fn(flat_in):

@@ -87,6 +87,10 @@ class FakeQuantMovingAverageAbsMax(Layer):
         self.bits = bits
         self.moving_rate = moving_rate
         self.algo = algo
+        # calibration override: None -> follow self.training (QAT); True/False
+        # -> forced by PTQ so calibration can run with eval() semantics
+        # (dropout off, BN frozen) while the observer still updates
+        self._observing = None
         self.scale = self.create_buffer("scale", np.zeros((), np.float32))
 
     def create_buffer(self, name, value):
@@ -96,9 +100,10 @@ class FakeQuantMovingAverageAbsMax(Layer):
 
     def forward(self, x):
         xv = x._value if isinstance(x, Tensor) else x
+        observing = self.training if self._observing is None else self._observing
         # observer update only on concrete values: under jit tracing the
         # update would leak a tracer into the persistent buffer
-        if self.training and not isinstance(xv, jax.core.Tracer):
+        if observing and not isinstance(xv, jax.core.Tracer):
             cur = jax.lax.stop_gradient(jnp.max(jnp.abs(xv))).astype(jnp.float32)
             prev = self.scale._value
             if self.algo == "max":
@@ -234,18 +239,27 @@ class PostTrainingQuantization:
             self.types, self.weight_bits, self.activation_bits,
             act_algo="max" if self.algo == "abs_max" else "ema")
         qat.quantize(model)
-        # calibration: run in train() so EMA observers update, grads off
+        # calibration runs with INFERENCE semantics (reference PTQ executes the
+        # inference program: dropout off, BN running stats frozen) — the
+        # observers update via the explicit _observing override, not train()
         from ..core.tape import no_grad
 
-        model.train()
-        with no_grad():
-            for i, batch in enumerate(self.data_loader):
-                if self.batch_nums and i >= self.batch_nums:
-                    break
-                xs = batch if isinstance(batch, (list, tuple)) else [batch]
-                model(*[x if isinstance(x, Tensor) else Tensor(np.asarray(x))
-                        for x in xs])
         model.eval()
+        observers = [sub for _, sub in model.named_sublayers()
+                     if isinstance(sub, FakeQuantMovingAverageAbsMax)]
+        for ob in observers:
+            ob._observing = True
+        try:
+            with no_grad():
+                for i, batch in enumerate(self.data_loader):
+                    if self.batch_nums and i >= self.batch_nums:
+                        break
+                    xs = batch if isinstance(batch, (list, tuple)) else [batch]
+                    model(*[x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+                            for x in xs])
+        finally:
+            for ob in observers:
+                ob._observing = None
         # snapshot the weight int8 codebooks + frozen activation scales
         for name, sub in model.named_sublayers():
             if isinstance(sub, (QuantedLinear, QuantedConv2D)):

@@ -2,51 +2,38 @@
 
 Reference analog: `python/paddle/signal.py` (stft/istft built on frame + fft
 phi kernels `phi/kernels/cpu/frame_kernel.cc`). TPU-native: framing is a
-gather/reshape XLA fuses away; FFT is HLO fft.
+gather/reshape XLA fuses away; FFT is HLO fft. Every public function is a
+single pure-jax lowering dispatched through `primitive_call`, so gradients
+flow through the eager tape (ADVICE r1: the previous Tensor(...) wrappers
+silently stopped them) and each op records as one tape node.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 import numpy as np
 
+from .core.dispatch import primitive_call
 from .core.tensor import Tensor
 
 __all__ = ["frame", "overlap_add", "stft", "istft"]
 
 
-def _v(x):
-    return x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+def _shape(x):
+    return tuple(x._value.shape) if isinstance(x, Tensor) else np.shape(x)
 
 
-def frame(x, frame_length, hop_length, axis=-1, name=None):
-    """Split into overlapping frames (reference: signal.py frame:32; axis must
-    be 0 or -1). axis=-1: (..., L) -> (..., frame_length, num_frames);
-    axis=0: (L, ...) -> (num_frames, frame_length, ...)."""
-    if axis not in (0, -1):
-        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
-    xv = _v(x)
-    if axis == 0:
-        out = frame(Tensor(jnp.moveaxis(xv, 0, -1)), frame_length, hop_length)._value
-        # (..., frame_length, num_frames) -> (num_frames, frame_length, ...)
-        return Tensor(jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1))
+def _frame_raw(xv, frame_length, hop_length):
+    """(..., L) -> (..., frame_length, num_frames)"""
     n = xv.shape[-1]
     num_frames = 1 + (n - frame_length) // hop_length
     idx = (jnp.arange(frame_length)[None, :]
            + hop_length * jnp.arange(num_frames)[:, None])
     out = xv[..., idx]  # (..., num_frames, frame_length)
-    return Tensor(jnp.swapaxes(out, -1, -2))
+    return jnp.swapaxes(out, -1, -2)
 
 
-def overlap_add(x, hop_length, axis=-1, name=None):
-    """Inverse of frame (reference: signal.py overlap_add:154; axis 0 or -1)."""
-    if axis not in (0, -1):
-        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
-    xv = _v(x)
-    if axis == 0:
-        # (num_frames, frame_length, ...) -> canonical (..., frame_length, num_frames)
-        canon = jnp.moveaxis(jnp.moveaxis(xv, 1, -1), 0, -1)
-        return Tensor(jnp.moveaxis(
-            overlap_add(Tensor(canon), hop_length)._value, -1, 0))
+def _overlap_add_raw(xv, hop_length):
+    """(..., frame_length, num_frames) -> (..., out_len)"""
     frame_length, num_frames = xv.shape[-2], xv.shape[-1]
     out_len = (num_frames - 1) * hop_length + frame_length
     frames = jnp.swapaxes(xv, -1, -2)  # (..., num_frames, frame_length)
@@ -55,61 +42,125 @@ def overlap_add(x, hop_length, axis=-1, name=None):
     starts = hop_length * np.arange(num_frames)
     idx = starts[:, None] + np.arange(frame_length)[None, :]  # static indices
     flat_idx = jnp.asarray(idx.reshape(-1))
-    out = out.at[..., flat_idx].add(frames.reshape(lead + (-1,)))
-    return Tensor(out)
+    return out.at[..., flat_idx].add(frames.reshape(lead + (-1,)))
+
+
+def _validate_frame(n, frame_length, hop_length):
+    """reference signal.py frame:32 input checks."""
+    if hop_length <= 0:
+        raise ValueError(
+            f"Attribute hop_length should be greater than 0, but got {hop_length}."
+        )
+    if frame_length > n:
+        raise ValueError(
+            f"Attribute frame_length should be less than or equal to input "
+            f"length along the framing axis ({n}), but got {frame_length}."
+        )
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Split into overlapping frames (reference: signal.py frame:32; axis must
+    be 0 or -1). axis=-1: (..., L) -> (..., frame_length, num_frames);
+    axis=0: (L, ...) -> (num_frames, frame_length, ...)."""
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+    shape = _shape(x)
+    _validate_frame(shape[0] if axis == 0 else shape[-1], frame_length, hop_length)
+
+    def raw(xv):
+        if axis == 0:
+            out = _frame_raw(jnp.moveaxis(xv, 0, -1), frame_length, hop_length)
+            # (..., frame_length, num_frames) -> (num_frames, frame_length, ...)
+            return jnp.moveaxis(jnp.moveaxis(out, -1, 0), -1, 1)
+        return _frame_raw(xv, frame_length, hop_length)
+
+    return primitive_call(raw, x, name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (reference: signal.py overlap_add:154; axis 0 or -1)."""
+    if axis not in (0, -1):
+        raise ValueError(f"Unexpected axis: {axis}. It should be 0 or -1.")
+    if hop_length <= 0:
+        raise ValueError(
+            f"Attribute hop_length should be greater than 0, but got {hop_length}."
+        )
+
+    def raw(xv):
+        if axis == 0:
+            # (num_frames, frame_length, ...) -> canonical
+            canon = jnp.moveaxis(jnp.moveaxis(xv, 1, -1), 0, -1)
+            return jnp.moveaxis(_overlap_add_raw(canon, hop_length), -1, 0)
+        return _overlap_add_raw(xv, hop_length)
+
+    return primitive_call(raw, x, name="overlap_add")
 
 
 def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
          pad_mode="reflect", normalized=False, onesided=True, name=None):
-    xv = _v(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    n = _shape(x)[-1]
+    _validate_frame(n + (n_fft if center else 0), n_fft, hop_length)
+
+    def raw(xv, win_in):
+        if win_in is None:
+            win = jnp.ones(win_length, xv.dtype)
+        else:
+            win = win_in.astype(xv.dtype)
+        if win_length < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        if center:
+            pad = n_fft // 2
+            cfg = [(0, 0)] * (xv.ndim - 1) + [(pad, pad)]
+            xv = jnp.pad(xv, cfg, mode=pad_mode)
+        frames = _frame_raw(xv, n_fft, hop_length)  # (..., n_fft, num_frames)
+        frames = jnp.swapaxes(frames, -1, -2) * win  # (..., num_frames, n_fft)
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # (..., freq, num_frames)
+
     if window is None:
-        win = jnp.ones(win_length, xv.dtype)
-    else:
-        win = _v(window).astype(xv.dtype)
-    if win_length < n_fft:  # center-pad window to n_fft
-        lp = (n_fft - win_length) // 2
-        win = jnp.pad(win, (lp, n_fft - win_length - lp))
-    if center:
-        pad = n_fft // 2
-        cfg = [(0, 0)] * (xv.ndim - 1) + [(pad, pad)]
-        xv = jnp.pad(xv, cfg, mode=pad_mode)
-    frames = frame(Tensor(xv), n_fft, hop_length)._value  # (..., n_fft, num_frames)
-    frames = jnp.swapaxes(frames, -1, -2) * win  # (..., num_frames, n_fft)
-    spec = jnp.fft.rfft(frames, axis=-1) if onesided else jnp.fft.fft(frames, axis=-1)
-    if normalized:
-        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
-    return Tensor(jnp.swapaxes(spec, -1, -2))  # (..., freq, num_frames)
+        return primitive_call(lambda xv: raw(xv, None), x, name="stft")
+    return primitive_call(raw, x, window, name="stft")
 
 
 def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
           normalized=False, onesided=True, length=None, return_complex=False,
           name=None):
-    xv = _v(x)
     hop_length = hop_length or n_fft // 4
     win_length = win_length or n_fft
+    num_frames = _shape(x)[-1]
+
+    def raw(xv, win_in):
+        if win_in is None:
+            win = jnp.ones(win_length, jnp.float64)
+        else:
+            win = win_in.astype(jnp.float64)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        spec = jnp.swapaxes(xv, -1, -2)  # (..., num_frames, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float64))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        frames = frames * win
+        y = _overlap_add_raw(jnp.swapaxes(frames, -1, -2), hop_length)
+        wsq = _overlap_add_raw(
+            jnp.tile((win * win)[:, None], (1, num_frames)), hop_length
+        )
+        y = y / jnp.where(wsq > 1e-11, wsq, 1.0)
+        if center:
+            pad = n_fft // 2
+            y = y[..., pad:-pad] if length is None else y[..., pad:pad + length]
+        elif length is not None:
+            y = y[..., :length]
+        return y
+
     if window is None:
-        win = jnp.ones(win_length, jnp.float64)
-    else:
-        win = _v(window).astype(jnp.float64)
-    if win_length < n_fft:
-        lp = (n_fft - win_length) // 2
-        win = jnp.pad(win, (lp, n_fft - win_length - lp))
-    spec = jnp.swapaxes(xv, -1, -2)  # (..., num_frames, freq)
-    if normalized:
-        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float64))
-    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
-              else jnp.fft.ifft(spec, axis=-1).real)
-    frames = frames * win
-    y = overlap_add(Tensor(jnp.swapaxes(frames, -1, -2)), hop_length)._value
-    wsq = overlap_add(
-        Tensor(jnp.tile((win * win)[:, None], (1, xv.shape[-1]))), hop_length
-    )._value
-    y = y / jnp.where(wsq > 1e-11, wsq, 1.0)
-    if center:
-        pad = n_fft // 2
-        y = y[..., pad:-pad] if length is None else y[..., pad:pad + length]
-    elif length is not None:
-        y = y[..., :length]
-    return Tensor(y)
+        return primitive_call(lambda xv: raw(xv, None), x, name="istft")
+    return primitive_call(raw, x, window, name="istft")

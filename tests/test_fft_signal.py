@@ -109,3 +109,28 @@ def test_hfftn_ihfftn_norms(norm):
     h1 = paddle.fft.hfftn(paddle.to_tensor(x[0]), axes=(0,), norm=norm).numpy()
     np.testing.assert_allclose(h1, np.fft.hfft(x[0], norm=norm), rtol=1e-9,
                                atol=1e-9)
+
+
+def test_fft_gradients_flow():
+    """ADVICE r1: fft/signal must be differentiable (reference fft has grad
+    kernels)."""
+    x = paddle.to_tensor(np.random.rand(4, 32).astype("float32"),
+                         stop_gradient=False)
+    y = paddle.abs(paddle.fft.rfft(x)).sum()
+    y.backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_stft_gradients_flow():
+    x = paddle.to_tensor(np.random.rand(256).astype("float32"),
+                         stop_gradient=False)
+    loss = paddle.abs(paddle.signal.stft(x, n_fft=64)).sum()
+    loss.backward()
+    assert x.grad is not None and np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_frame_validates_inputs():
+    with pytest.raises(ValueError):
+        paddle.signal.frame(paddle.to_tensor(np.zeros(10, "float32")), 32, 8)
+    with pytest.raises(ValueError):
+        paddle.signal.frame(paddle.to_tensor(np.zeros(64, "float32")), 16, 0)

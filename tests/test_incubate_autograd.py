@@ -68,3 +68,26 @@ def test_prim_toggles():
     assert not A.prim_enabled()
     A.enable_prim()
     assert A.prim_enabled()
+
+
+def test_jacobian_is_batched():
+    """reference semantics: leading dim excluded from differentiation."""
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.autograd import Jacobian
+
+    x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"))
+    J = Jacobian(lambda x: x * x, x, is_batched=True)
+    assert J.shape == (3, 4, 4)
+    for b in range(3):
+        expect = np.diag(2 * x.numpy()[b])
+        np.testing.assert_allclose(J[b].numpy(), expect, rtol=1e-5)
+
+
+def test_hessian_is_batched():
+    import paddle_tpu as paddle
+    from paddle_tpu.incubate.autograd import Hessian
+
+    x = paddle.to_tensor(np.random.rand(2, 3).astype("float32"))
+    H = Hessian(lambda x: (x * x).sum(), x, is_batched=True)
+    assert H.shape == (2, 3, 3)
+    np.testing.assert_allclose(H[0].numpy(), 2 * np.eye(3), atol=1e-5)
