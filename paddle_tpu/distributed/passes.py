@@ -1,0 +1,10 @@
+"""paddle.distributed.passes — reference: python/paddle/distributed/passes/
+(pass_base.py new_pass/PassManager). The pass substrate lives in
+static/passes.py; distributed transforms register into the same registry."""
+from ..static.passes import (  # noqa: F401
+    PassBase,
+    PassContext,
+    PassManager,
+    new_pass,
+    register_pass,
+)

@@ -18,6 +18,7 @@ from .program import (  # noqa: F401
     program_guard,
 )
 from .executor import CompiledProgram, Executor  # noqa: F401
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
 from .io import (  # noqa: F401
     load_inference_model,
     save_inference_model,
@@ -25,6 +26,8 @@ from .io import (  # noqa: F401
 )
 from ..jit import InputSpec  # noqa: F401
 from . import nn  # noqa: F401
+from . import passes  # noqa: F401
+from .passes import PassBase, PassContext, PassManager, new_pass, register_pass  # noqa: F401
 
 
 def cpu_places(device_count=None):

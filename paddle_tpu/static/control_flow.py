@@ -1,0 +1,329 @@
+"""Static-graph control flow: cond / while_loop / case / switch_case.
+
+Reference analog: `paddle/fluid/operators/controlflow/while_op.cc:50` and
+`conditional_block_op.cc` — ops whose Attrs carry a sub-BlockDesc executed by a
+nested executor. TPU-native redesign: the branch/body is traced once into a
+sub-Block of the Program (the same `primitive_call` static hook records its
+ops), then ONE Operator is appended whose pure-jax lowering wraps
+`lax.cond` / `lax.while_loop` around a replay of that sub-Block. XLA sees HLO
+Conditional/While — compiler-friendly control flow with no data-dependent
+Python (survey hard-part #4).
+
+In dygraph mode the same APIs execute eagerly (python if / while), matching the
+reference's dygraph passthrough (`layers/control_flow.py` cond:1214).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..core.dtype import convert_dtype
+from ..utils.misc import unique_name
+from .mode import in_static_mode
+from .program import (
+    Block,
+    Operator,
+    Variable,
+    _flat_inputs,
+    default_main_program,
+)
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+# ------------------------------------------------------------ sub-block tracing
+def _trace_subblock(fn, formals):
+    """Record fn(*formals)'s ops into a fresh sub-Block; return (block, outs).
+
+    `formals` are fresh placeholder Variables standing for values supplied at
+    run time (loop carry / branch operands) — the analog of the sub-BlockDesc's
+    input vars in the reference's conditional_block/while ops.
+    """
+    prog = default_main_program()
+    block = Block(prog, len(prog.blocks), prog.current_block_idx)
+    prog.blocks.append(block)
+    prev = prog.current_block_idx
+    prog.current_block_idx = block.idx
+    try:
+        outs = fn(*formals)
+    finally:
+        prog.current_block_idx = prev
+    return block, outs
+
+
+def _block_externals(block, formals, extra_reads=()):
+    """Values a sub-Block reads from outside it: outer Variables and concrete
+    Tensors (captured weights). These become inputs of the combined op so the
+    Executor resolves them (substituting trained parameter values).
+    `extra_reads`: values the block returns (they count as reads — external
+    only when not produced by the block itself)."""
+    defined = {id(f) for f in formals}
+    for op in block.ops:
+        for o in op.outputs:
+            defined.add(id(o))
+    ext, seen = [], set()
+    reads = [t for op in block.ops for t in _flat_inputs(op.inputs)]
+    reads += [t for t in extra_reads]
+    for t in reads:
+        if isinstance(t, Tensor) and id(t) not in defined and id(t) not in seen:
+            seen.add(id(t))
+            ext.append(t)
+    return ext
+
+
+def _replay_block(block, env):
+    """Execute a sub-Block's op tape under `env` (id -> array). The ops' fns
+    are pure jax closures, so this composes under lax.cond/while tracing."""
+
+    def resolve(x):
+        if isinstance(x, Tensor):  # Variable is a Tensor subclass
+            if id(x) in env:
+                return env[id(x)]
+            if isinstance(x, Variable):
+                raise KeyError(
+                    f"control-flow sub-block read {x.name!r} which has no value "
+                    "in the enclosing scope"
+                )
+            return x._value  # concrete Tensor not routed as external (frozen)
+        if isinstance(x, (list, tuple)):
+            return type(x)(resolve(i) for i in x)
+        return x
+
+    for op in block.ops:
+        ins = [resolve(i) for i in op.inputs]
+        out = op.fn(*ins)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        for var, val in zip(op.outputs, outs):
+            env[id(var)] = val
+    return env
+
+
+def _aval_of(x):
+    if isinstance(x, Variable):
+        return x._value
+    if isinstance(x, Tensor):
+        return jax.ShapeDtypeStruct(tuple(x._value.shape), x._value.dtype)
+    a = jnp.asarray(np.asarray(x))
+    return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+
+def _placeholder_like(x, tag):
+    av = _aval_of(x)
+    return Variable(av.shape, convert_dtype(av.dtype),
+                    name=unique_name.generate(tag), stop_gradient=False)
+
+
+def _flatten_struct(out):
+    """branch output -> (flat list, structure tag)"""
+    if isinstance(out, (tuple, list)):
+        return list(out), ("seq", type(out), len(out))
+    return [out], ("one",)
+
+
+def _pack_struct(flat, struct):
+    if struct[0] == "one":
+        return flat[0]
+    return struct[1](flat)
+
+
+def _as_value(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def c_out_t0(c_out):
+    return c_out[0] if isinstance(c_out, (tuple, list)) else c_out
+
+
+# ---------------------------------------------------------------------- cond
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """reference: paddle.static.nn.cond (layers/control_flow.py:1214) lowering
+    to conditional_block ops; here: one Operator wrapping lax.cond."""
+    if not in_static_mode():
+        p = bool(np.asarray(pred.numpy() if isinstance(pred, Tensor) else pred))
+        return true_fn() if p else (false_fn() if false_fn is not None else None)
+
+    t_block, t_out = _trace_subblock(true_fn, ())
+    f_block, f_out = _trace_subblock(false_fn, ())
+    t_flat, t_struct = _flatten_struct(t_out)
+    f_flat, f_struct = _flatten_struct(f_out)
+    if len(t_flat) != len(f_flat):
+        raise ValueError(
+            f"cond: true_fn returned {len(t_flat)} values, false_fn {len(f_flat)}"
+        )
+
+    t_ext = _block_externals(t_block, (), extra_reads=t_flat)
+    f_ext = _block_externals(f_block, (), extra_reads=f_flat)
+    ext, seen = [], set()
+    for t in t_ext + f_ext:
+        if id(t) not in seen:
+            seen.add(id(t))
+            ext.append(t)
+
+    t_ids = [id(o) if isinstance(o, Tensor) else None for o in t_flat]
+    f_ids = [id(o) if isinstance(o, Tensor) else None for o in f_flat]
+    t_const = [None if isinstance(o, Tensor) else o for o in t_flat]
+    f_const = [None if isinstance(o, Tensor) else o for o in f_flat]
+
+    def op_fn(pred_v, *ext_vals):
+        base = {id(e): v for e, v in zip(ext, ext_vals)}
+
+        def run(block, out_ids, consts):
+            env = dict(base)
+            _replay_block(block, env)
+            return tuple(
+                jnp.asarray(env[i] if i is not None else c)
+                for i, c in zip(out_ids, consts)
+            )
+
+        return jax.lax.cond(
+            jnp.reshape(jnp.asarray(pred_v), ()).astype(bool),
+            lambda vals: run(t_block, t_ids, t_const),
+            lambda vals: run(f_block, f_ids, f_const),
+            ext_vals,
+        )
+
+    block = default_main_program().current_block()
+    out_avals = jax.eval_shape(
+        op_fn, _aval_of(pred), *[_aval_of(e) for e in ext]
+    )
+    outputs = [
+        block.create_var(o.shape, convert_dtype(o.dtype),
+                         name=unique_name.generate("cond"))
+        for o in out_avals
+    ]
+    for o in outputs:
+        o.stop_gradient = False
+    block.append_op(Operator("conditional_block", op_fn, [pred] + ext, outputs))
+    return _pack_struct(list(outputs), t_struct)
+
+
+# ----------------------------------------------------------------- while_loop
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """reference: paddle.static.nn.while_loop (layers/control_flow.py:1076) →
+    while_op (while_op.cc:50); here: one Operator wrapping lax.while_loop.
+
+    Loop-carried values must keep shape/dtype across iterations (the same
+    invariant the reference enforces on the sub-block's output vars)."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("loop_vars must be a non-empty list/tuple")
+
+    if not in_static_mode():
+        vals = list(loop_vars)
+        while bool(np.asarray(_as_value(cond_fn(*vals)))):
+            vals = list(body_fn(*vals))
+        return vals
+
+    formals = [_placeholder_like(v, "while_in") for v in loop_vars]
+    c_block, c_out = _trace_subblock(cond_fn, formals)
+    b_block, b_out = _trace_subblock(body_fn, formals)
+    b_flat, _ = _flatten_struct(b_out)
+    if len(b_flat) != len(loop_vars):
+        raise ValueError(
+            f"while_loop: body returned {len(b_flat)} values for "
+            f"{len(loop_vars)} loop_vars"
+        )
+    for v, o in zip(loop_vars, b_flat):
+        va, oa = _aval_of(v), _aval_of(o)
+        if tuple(va.shape) != tuple(oa.shape) or va.dtype != oa.dtype:
+            raise ValueError(
+                "while_loop: body output must match loop var shape/dtype, got "
+                f"{oa.shape}/{oa.dtype} vs {va.shape}/{va.dtype}"
+            )
+
+    ext, seen = [], set()
+    for t in (_block_externals(c_block, formals, extra_reads=[c_out_t0(c_out)])
+              + _block_externals(b_block, formals, extra_reads=b_flat)):
+        if id(t) not in seen:
+            seen.add(id(t))
+            ext.append(t)
+
+    n = len(loop_vars)
+    c_out_t = c_out_t0(c_out)
+    b_ids = [id(o) if isinstance(o, Tensor) else None for o in b_flat]
+    b_const = [None if isinstance(o, Tensor) else o for o in b_flat]
+    formal_ids = [id(f) for f in formals]
+
+    def op_fn(*ins):
+        init = tuple(jnp.asarray(v) for v in ins[:n])
+        ext_vals = ins[n:]
+        base = {id(e): v for e, v in zip(ext, ext_vals)}
+
+        def cond_l(carry):
+            env = dict(base)
+            env.update(zip(formal_ids, carry))
+            # formals may flow through unchanged into the predicate
+            _replay_block(c_block, env)
+            pv = env[id(c_out_t)] if isinstance(c_out_t, Tensor) else c_out_t
+            return jnp.reshape(jnp.asarray(pv), ()).astype(bool)
+
+        def body_l(carry):
+            env = dict(base)
+            env.update(zip(formal_ids, carry))
+            _replay_block(b_block, env)
+            return tuple(
+                jnp.asarray(env[i]).astype(c.dtype) if i is not None else
+                jnp.asarray(cst)
+                for i, c, cst in zip(b_ids, carry, b_const)
+            )
+
+        return jax.lax.while_loop(cond_l, body_l, init)
+
+    block = default_main_program().current_block()
+    out_avals = jax.eval_shape(op_fn, *[_aval_of(x) for x in
+                                        list(loop_vars) + ext])
+    outputs = [
+        block.create_var(o.shape, convert_dtype(o.dtype),
+                         name=unique_name.generate("while"))
+        for o in out_avals
+    ]
+    for o in outputs:
+        o.stop_gradient = False
+    block.append_op(Operator("while", op_fn, list(loop_vars) + ext, outputs))
+    return list(outputs)
+
+
+# ----------------------------------------------------------------------- case
+def case(pred_fn_pairs, default=None, name=None):
+    """reference: paddle.static.nn.case — first true predicate wins."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+
+    def build(pairs):
+        (pred, fn) = pairs[0]
+        if len(pairs) == 1:
+            if default is None:
+                return cond(pred, fn, fn)
+            return cond(pred, fn, default)
+        return cond(pred, fn, lambda: build(pairs[1:]))
+
+    return build(list(pred_fn_pairs))
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """reference: paddle.static.nn.switch_case — dispatch on an int index.
+    Lowers through lax.switch for a flat HLO Conditional."""
+    pairs = sorted(branch_fns.items()) if isinstance(branch_fns, dict) else \
+        list(enumerate(branch_fns))
+
+    if not in_static_mode():
+        idx = int(np.asarray(_as_value(branch_index)))
+        for k, fn in pairs:
+            if k == idx:
+                return fn()
+        if default is None:
+            return pairs[-1][1]()
+        return default()
+
+    def build(ps):
+        k, fn = ps[0]
+        import paddle_tpu as paddle
+
+        eq = paddle.equal(branch_index, paddle.to_tensor(np.int64(k)))
+        if len(ps) == 1:
+            fallback = default if default is not None else pairs[-1][1]
+            return cond(eq, fn, fallback)
+        return cond(eq, fn, lambda: build(ps[1:]))
+
+    return build(pairs)

@@ -95,7 +95,9 @@ def _lower(program: Program, feed_names, fetch_list):
             return x
 
         with tape_mod.no_grad(), rng_mod.trace_rng_scope(key):
-            for op in program.all_ops():
+            # top-level tape only: sub-blocks (control flow bodies) are replayed
+            # by their owning Operator's lowering (static/control_flow.py)
+            for op in program.global_block.ops:
                 ins = [resolve(i) for i in op.inputs]
                 out = op.fn(*ins)
                 outs = list(out) if isinstance(out, (tuple, list)) else [out]

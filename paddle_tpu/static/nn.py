@@ -48,3 +48,6 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-05, param_attr=None,
 def embedding(input, size, is_sparse=False, param_attr=None, dtype="float32"):
     layer = dynn.Embedding(size[0], size[1], weight_attr=param_attr)
     return layer(input)
+
+
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401,E402

@@ -1,0 +1,262 @@
+"""Program-rewrite pass framework.
+
+Reference analog: `paddle/fluid/framework/ir/pass.h:53` (C++ graph passes) +
+`python/paddle/distributed/passes/pass_base.py` (PassBase/new_pass/PassManager,
+with check/conflict semantics). The reference needs ~150 passes because every
+backend transform is a graph rewrite; here XLA owns fusion/scheduling, so
+passes exist for PROGRAM-level rewrites XLA cannot do: mixed-precision policy,
+fusion annotations the bench/profiler reads, quant export, distributed
+transforms. The substrate is the Program op tape: a pass edits `block.ops`
+(each Operator carries its own pure-jax lowering, so rewrites compose by
+function composition).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .program import Operator, Program, Variable, _flat_inputs
+
+_PASS_REGISTRY: dict[str, type] = {}
+
+
+def register_pass(name):
+    """reference: pass_base.py register_pass decorator."""
+
+    def deco(cls):
+        cls.name = name
+        _PASS_REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+def new_pass(name, attrs=None):
+    """reference: pass_base.py new_pass factory."""
+    if name not in _PASS_REGISTRY:
+        raise ValueError(
+            f"unknown pass {name!r}; registered: {sorted(_PASS_REGISTRY)}"
+        )
+    return _PASS_REGISTRY[name](attrs or {})
+
+
+class PassContext:
+    """reference: pass_base.py PassContext — cross-pass state."""
+
+    def __init__(self):
+        self.attrs = {}
+
+
+class PassBase:
+    name = "base"
+
+    def __init__(self, attrs=None):
+        self.attrs = dict(attrs or {})
+
+    def check(self, program: Program) -> bool:
+        """Applicability check (reference _check_self)."""
+        return True
+
+    def apply(self, main_program: Program, startup_program=None, context=None):
+        if not self.check(main_program):
+            raise RuntimeError(f"pass {self.name} not applicable")
+        context = context or PassContext()
+        self._apply_impl(main_program, startup_program, context)
+        main_program._lowered_cache.clear()
+        applied = context.attrs.setdefault("applied_passes", [])
+        applied.append(self.name)
+        return context
+
+    def _apply_impl(self, main_program, startup_program, context):
+        raise NotImplementedError
+
+
+class PassManager:
+    """reference: pass_base.py PassManager — ordered application."""
+
+    def __init__(self, passes):
+        self.passes = list(passes)
+        self.context = PassContext()
+
+    def apply(self, main_programs, startup_programs=None):
+        mains = main_programs if isinstance(main_programs, (list, tuple)) \
+            else [main_programs]
+        starts = startup_programs or [None] * len(mains)
+        for m, s in zip(mains, starts):
+            for p in self.passes:
+                p.apply(m, s, self.context)
+        return self.context
+
+    @property
+    def names(self):
+        return [p.name for p in self.passes]
+
+
+def _use_counts(block):
+    """How many ops read each Variable (by id) — fusion safety check."""
+    counts: dict[int, int] = {}
+    for op in block.ops:
+        for t in _flat_inputs(op.inputs):
+            if isinstance(t, Variable):
+                counts[id(t)] = counts.get(id(t), 0) + 1
+    return counts
+
+
+# -------------------------------------------------------------------- AMP O2
+_AMP_WHITELIST = {
+    "matmul", "matmul_v2", "linear", "conv2d", "conv1d", "conv3d", "einsum",
+    "mul", "bmm", "addmm", "fused_gemm_epilogue",
+}
+_AMP_BLACKLIST = {
+    "softmax", "log_softmax", "cross_entropy", "exp", "log", "mean",
+    "reduce_mean", "sum", "reduce_sum", "layer_norm", "batch_norm",
+    "logsumexp", "norm",
+}
+
+
+@register_pass("auto_mixed_precision")
+class AMPO2Pass(PassBase):
+    """Static AMP at O2 with master weights.
+
+    Reference analog: fluid/contrib/mixed_precision/fp16_utils.py
+    cast_model_to_fp16 + the master-weight machinery in the AMP optimizer.
+    TPU-native: whitelist ops compute in bfloat16 (MXU-native); the Executor's
+    parameter arrays stay fp32 — they ARE the master weights (the optimizer
+    updates fp32; weights are cast at each use inside the compiled program,
+    which XLA folds into a single cast per buffer per step).
+    """
+
+    def _apply_impl(self, main_program, startup_program, context):
+        dtype = jnp.bfloat16 if self.attrs.get("dtype", "bfloat16") == \
+            "bfloat16" else jnp.float16
+
+        def wrap(fn, mode):
+            if mode == "white":
+                def f(*ins):
+                    cast = [a.astype(dtype)
+                            if hasattr(a, "dtype") and a.dtype == jnp.float32
+                            else a for a in ins]
+                    return fn(*cast)
+                return f
+            # black: force fp32 for numerically-sensitive ops
+            def f(*ins):
+                cast = [a.astype(jnp.float32)
+                        if hasattr(a, "dtype") and a.dtype == dtype
+                        else a for a in ins]
+                return fn(*cast)
+            return f
+
+        for block in main_program.blocks:
+            for op in block.ops:
+                base = op.type.split("/")[-1]
+                if base in _AMP_WHITELIST:
+                    op.fn = wrap(op.fn, "white")
+                    op.attrs["amp"] = "bf16"
+                elif base in _AMP_BLACKLIST:
+                    op.fn = wrap(op.fn, "black")
+                    op.attrs["amp"] = "fp32"
+        context.attrs["amp_dtype"] = jnp.dtype(dtype).name
+
+
+# -------------------------------------------------------- fuse gemm epilogue
+_EPILOGUE_ACTS = {"relu", "gelu", "tanh", "sigmoid"}
+
+
+@register_pass("fuse_gemm_epilogue")
+class FuseGemmEpiloguePass(PassBase):
+    """Fuse matmul + add(bias) [+ activation] chains into one Operator.
+
+    Reference analog: fuse_gemm_epilogue_pass.cc (cublasLt epilogues). On TPU
+    XLA fuses the epilogue into the MXU matmul anyway — the value here is the
+    PROGRAM-level annotation (profiler/bench attribution, and one tape node
+    instead of three for replay/pass traversal), matching the reference's
+    graph-level contract.
+    """
+
+    def _apply_impl(self, main_program, startup_program, context):
+        n_fused = 0
+        for block in main_program.blocks:
+            counts = _use_counts(block)
+            out_of = {}
+            for op in block.ops:
+                for o in op.outputs:
+                    out_of[id(o)] = op
+            ops = block.ops
+            i = 0
+            new_ops = []
+            consumed = set()
+            while i < len(ops):
+                op = ops[i]
+                if id(op) in consumed:
+                    i += 1
+                    continue
+                chain = self._match(ops, i, counts)
+                if chain is None:
+                    new_ops.append(op)
+                    i += 1
+                    continue
+                mm, add, act = chain
+                parts = [mm, add] + ([act] if act else [])
+                mm_pos = next(
+                    j for j, t in enumerate(add.inputs)
+                    if isinstance(t, Variable) and id(t) == id(mm.outputs[0])
+                )
+                fused_fn = self._compose(mm, add, act, mm_pos)
+                fused_inputs = list(mm.inputs) + [
+                    t for j, t in enumerate(add.inputs) if j != mm_pos
+                ]
+                last = parts[-1]
+                fused = Operator(
+                    "fused_gemm_epilogue", fused_fn, fused_inputs,
+                    last.outputs,
+                    attrs={"epilogue": (act.type if act else "bias"),
+                           "fused_from": [p.type for p in parts]},
+                    op_role=mm.op_role,
+                )
+                new_ops.append(fused)
+                for p in parts[1:]:
+                    consumed.add(id(p))
+                n_fused += 1
+                i += 1
+            block.ops = [o for o in new_ops]
+        context.attrs["fused_gemm_epilogue"] = n_fused
+
+    @staticmethod
+    def _match(ops, i, counts):
+        op = ops[i]
+        if op.type.split("/")[-1] not in ("matmul", "matmul_v2", "mul"):
+            return None
+        if len(op.outputs) != 1 or counts.get(id(op.outputs[0]), 0) != 1:
+            return None
+        # the single consumer must be the next-op add with the matmul output
+        nxt = next((o for o in ops[i + 1:]
+                    if any(isinstance(t, Variable) and id(t) == id(op.outputs[0])
+                           for t in _flat_inputs(o.inputs))), None)
+        if nxt is None or nxt.type.split("/")[-1] not in ("add", "elementwise_add"):
+            return None
+        if len(nxt.outputs) != 1:
+            return None
+        act = None
+        if counts.get(id(nxt.outputs[0]), 0) == 1:
+            cand = next((o for o in ops
+                         if any(isinstance(t, Variable)
+                                and id(t) == id(nxt.outputs[0])
+                                for t in _flat_inputs(o.inputs))), None)
+            if cand is not None and cand.type.split("/")[-1] in _EPILOGUE_ACTS \
+                    and len(cand.outputs) == 1:
+                act = cand
+        return op, nxt, act
+
+    @staticmethod
+    def _compose(mm, add, act, mm_pos):
+        n_mm = len(mm.inputs)
+
+        def fused(*ins):
+            y = mm.fn(*ins[:n_mm])
+            add_args = list(ins[n_mm:])
+            add_args.insert(mm_pos, y)
+            y = add.fn(*add_args)
+            if act is not None:
+                y = act.fn(y)
+            return y
+
+        return fused

@@ -1,0 +1,132 @@
+"""Pass framework: pre/post program diff tests (reference pattern:
+dist_pass_test_base.py — apply the pass, compare program structure AND
+numerics against the un-passed program)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static.passes import PassManager, new_pass
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    static.enable_static()
+    yield
+    static.disable_static()
+
+
+def _mlp_program(seed=5):
+    paddle.seed(seed)
+    rng = np.random.RandomState(seed)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8])
+        w1 = paddle.to_tensor(rng.rand(8, 16).astype("float32"))
+        b1 = paddle.to_tensor(rng.rand(16).astype("float32"))
+        w2 = paddle.to_tensor(rng.rand(16, 4).astype("float32"))
+        b2 = paddle.to_tensor(rng.rand(4).astype("float32"))
+        h = paddle.nn.functional.relu(paddle.matmul(x, w1) + b1)
+        out = paddle.matmul(h, w2) + b2
+    return prog, out
+
+
+def test_new_pass_registry():
+    p = new_pass("fuse_gemm_epilogue")
+    assert p.name == "fuse_gemm_epilogue"
+    with pytest.raises(ValueError):
+        new_pass("no_such_pass")
+
+
+def test_fuse_gemm_epilogue_rewrites_and_matches():
+    prog, out = _mlp_program()
+    x = np.random.rand(4, 8).astype("float32")
+    exe = static.Executor()
+    (before,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+
+    types_before = [op.type for op in prog.global_block.ops]
+    assert types_before == ["matmul", "add", "relu", "matmul", "add"]
+
+    ctx = new_pass("fuse_gemm_epilogue").apply(prog)
+    types_after = [op.type for op in prog.global_block.ops]
+    # matmul+add+relu -> one op; trailing matmul+add -> one op
+    assert types_after == ["fused_gemm_epilogue", "fused_gemm_epilogue"]
+    assert ctx.attrs["fused_gemm_epilogue"] == 2
+    assert prog.global_block.ops[0].attrs["epilogue"] == "relu"
+    assert prog.global_block.ops[1].attrs["epilogue"] == "bias"
+
+    exe2 = static.Executor()
+    (after,) = exe2.run(prog, feed={"x": x}, fetch_list=[out])
+    np.testing.assert_allclose(before, after, rtol=1e-6)
+
+
+def test_fuse_skips_multi_use_outputs():
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4])
+        w = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        b = paddle.to_tensor(np.random.rand(4).astype("float32"))
+        y = paddle.matmul(x, w)
+        z1 = y + b
+        z2 = y * 2.0  # second consumer of the matmul output: fusion illegal
+    new_pass("fuse_gemm_epilogue").apply(prog)
+    assert [op.type for op in prog.global_block.ops][0] == "matmul"
+
+
+def test_amp_o2_pass_bf16_compute_fp32_master():
+    prog, out = _mlp_program()
+    x = np.random.rand(4, 8).astype("float32")
+    exe = static.Executor()
+    (before,) = exe.run(prog, feed={"x": x}, fetch_list=[out])
+
+    ctx = new_pass("auto_mixed_precision").apply(prog)
+    assert ctx.attrs["amp_dtype"] == "bfloat16"
+    mm_ops = [op for op in prog.global_block.ops if op.type == "matmul"]
+    assert all(op.attrs.get("amp") == "bf16" for op in mm_ops)
+
+    exe2 = static.Executor()
+    (after,) = exe2.run(prog, feed={"x": x}, fetch_list=[out])
+    # bf16 matmuls: close but not identical
+    np.testing.assert_allclose(before, after, rtol=2e-2, atol=2e-2)
+    assert not np.allclose(before, after, rtol=1e-7, atol=1e-7)
+    # master weights untouched (fp32 on the captured params)
+    for p in prog.captured_params():
+        assert str(p._value.dtype) == "float32"
+
+
+def test_amp_training_keeps_master_weights_fp32():
+    """One minimize step through the AMP-passed program: params update in fp32."""
+    paddle.seed(9)
+    rng = np.random.RandomState(9)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [8, 4])
+        label = static.data("label", [8], "int64")
+        w = paddle.to_tensor(rng.rand(4, 3).astype("float32"), stop_gradient=False)
+        b = paddle.to_tensor(np.zeros(3, "float32"), stop_gradient=False)
+        logits = paddle.matmul(x, w) + b
+        loss = paddle.nn.functional.cross_entropy(logits, label)
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+    new_pass("auto_mixed_precision").apply(prog)
+    w0 = np.asarray(w._value).copy()
+    exe = static.Executor()
+    (lv,) = exe.run(prog, feed={"x": rng.rand(8, 4).astype("float32"),
+                                "label": rng.randint(0, 3, (8,))},
+                    fetch_list=[loss])
+    assert np.isfinite(lv).all()
+    assert str(w._value.dtype) == "float32"
+    assert not np.allclose(np.asarray(w._value), w0)  # actually trained
+
+
+def test_pass_manager_ordering():
+    prog, out = _mlp_program()
+    pm = PassManager([new_pass("fuse_gemm_epilogue"),
+                      new_pass("auto_mixed_precision")])
+    ctx = pm.apply(prog)
+    assert ctx.attrs["applied_passes"] == ["fuse_gemm_epilogue",
+                                           "auto_mixed_precision"]
+    # fused ops picked up by the AMP whitelist
+    assert all(op.attrs.get("amp") == "bf16"
+               for op in prog.global_block.ops
+               if op.type == "fused_gemm_epilogue")
