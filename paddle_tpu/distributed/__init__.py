@@ -26,6 +26,8 @@ from .collective import (  # noqa: F401
     alltoall,
     barrier,
     broadcast,
+    irecv,
+    isend,
     new_group,
     recv,
     reduce,

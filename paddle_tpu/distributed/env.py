@@ -20,6 +20,42 @@ import jax
 
 _initialized = False
 _global_mesh = None
+_proc_store_singleton = None
+
+
+def proc_world():
+    """(process_rank, process_count) from the launch env — the per-OS-process
+    rank identity (reference: PADDLE_TRAINER_ID set per rank by
+    launch/controllers/collective.py:85-99)."""
+    return (int(os.environ.get("PADDLE_TRAINER_ID", "0")),
+            int(os.environ.get("PADDLE_TRAINERS_NUM", "1")))
+
+
+def proc_store():
+    """The rendezvous TCPStore shared by all processes of this job (reference:
+    distributed/store/tcp_store.h via collective.py:241). Lazily created; rank 0
+    hosts the server.
+
+    Endpoint: PADDLE_STORE_MASTER if set, else the PADDLE_MASTER host at
+    port+1 — PADDLE_MASTER itself is the JAX coordination-service address on
+    multi-host xla jobs and must not be double-bound."""
+    global _proc_store_singleton
+    if _proc_store_singleton is None:
+        from ..runtime.tcp_store import TCPStore
+
+        ep = os.environ.get("PADDLE_STORE_MASTER")
+        if ep:
+            host, port = ep.rsplit(":", 1)
+            port = int(port)
+        else:
+            master = (os.environ.get("PADDLE_MASTER")
+                      or os.environ.get("MASTER_ENDPOINT") or "127.0.0.1:6170")
+            host, port = master.rsplit(":", 1)
+            port = int(port) + 1
+        rank, n = proc_world()
+        _proc_store_singleton = TCPStore(host, port, world_size=n,
+                                         is_master=(rank == 0))
+    return _proc_store_singleton
 
 
 def init_parallel_env(mesh_shape=None, mesh_axes=None):
@@ -31,7 +67,12 @@ def init_parallel_env(mesh_shape=None, mesh_axes=None):
     n_hosts = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     host_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
     master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
-    if n_hosts > 1 and master:
+    backend = os.environ.get("PADDLE_DISTRIBUTED_BACKEND", "xla")
+    # backend "xla": one SPMD program across hosts (JAX coordination service —
+    # the TPU-pod path). backend "store": independent per-process runtimes that
+    # rendezvous only through the TCPStore (the reference's per-rank process
+    # model; used by the multi-process collective tests).
+    if n_hosts > 1 and master and backend == "xla":
         jax.distributed.initialize(
             coordinator_address=master, num_processes=n_hosts, process_id=host_id
         )

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import re
 
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -26,6 +27,10 @@ from ...core.rng import get_rng_tracker as _core_tracker
 from ...core.tensor import Tensor
 from ...nn import functional as F
 from ...nn.layer import Layer
+
+
+def _tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
 
 
 def get_rng_state_tracker():
@@ -128,15 +133,60 @@ class RowParallelLinear(Layer):
 
 
 class ParallelCrossEntropy(Layer):
-    """reference: mp_layers.py:249 — vocab-parallel softmax CE. With GSPMD the
-    plain cross_entropy over mp-sharded logits compiles to the same comm pattern;
-    the explicit shard_map kernel lives in distributed.ops."""
+    """reference: mp_layers.py:249 — vocab-parallel softmax CE.
 
-    def __init__(self, mp_group=None, name=None):
+    Two execution paths, both keeping logits vocab-sharded over 'mp':
+    - inside shard_map (manual axes): the explicit kernel
+      `distributed.ops.c_softmax_with_cross_entropy` (per-shard max/sum psum'd,
+      matching c_softmax_with_cross_entropy_op.cu).
+    - under GSPMD (mesh scope): constrain the class dim to 'mp' and compute the
+      logsumexp-gather form — XLA reduces the [..., 1] stats across shards and
+      never gathers the [..., vocab] logits.
+    """
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
+        self._ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none")
+        import jax as _jax
+
+        from ...core.dispatch import primitive_call
+        from .. import ops as dist_ops
+
+        ignore = self._ignore_index
+
+        try:
+            _jax.lax.axis_size("mp")
+            manual_mp = True  # tracing inside shard_map with a bound 'mp' axis
+        except Exception:  # noqa: BLE001 — NameError/KeyError depending on jax ver
+            manual_mp = False
+
+        if manual_mp:
+            def f_manual(lg, lab):
+                lab_i = lab.astype(jnp.int32)
+                safe = jnp.where(lab_i == ignore, 0, lab_i)
+                loss = dist_ops.c_softmax_with_cross_entropy(lg, safe, "mp")
+                return jnp.where(lab_i == ignore, 0.0, loss)
+
+            return primitive_call(f_manual, _tensor(input),
+                                  _tensor(label).detach(),
+                                  name="c_softmax_with_cross_entropy")
+
+        from .hybrid_train import maybe_shard
+
+        logits = maybe_shard(_tensor(input), last_dim_axis="mp")
+
+        def f(lg, lab):
+            lg32 = lg.astype(jnp.float32)
+            lab_i = lab.astype(jnp.int32)
+            safe = jnp.where(lab_i == ignore, 0, lab_i)
+            lse = _jax.scipy.special.logsumexp(lg32, axis=-1)
+            tgt = jnp.take_along_axis(lg32, safe[..., None], axis=-1)[..., 0]
+            return jnp.where(lab_i == ignore, 0.0, lse - tgt)
+
+        return primitive_call(f, logits, _tensor(label).detach(),
+                              name="parallel_cross_entropy")
 
 
 def apply_megatron_specs(model, rules=None):

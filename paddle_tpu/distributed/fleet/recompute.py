@@ -41,12 +41,24 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
     if not tracing:
         return function(*args, **kwargs)
 
-    arrs = [a._value if isinstance(a, Tensor) else a for a in args]
+    # Tensors/arrays flow through jax.checkpoint as traced operands; everything
+    # else (None, attn_mask flags, python scalars used as config) is closed over
+    # statically — Tensor(None) is not a thing.
+    def _is_arraylike(a):
+        import numpy as _onp
+
+        return isinstance(a, (Tensor, jax.Array, _onp.ndarray))
+
+    traced_idx = [i for i, a in enumerate(args) if _is_arraylike(a)]
+    arrs = [args[i]._value if isinstance(args[i], Tensor) else args[i]
+            for i in traced_idx]
 
     @functools.partial(jax.checkpoint, policy=_resolve_policy(policy))
     def inner(*arrays):
-        ts = [Tensor(x) if not isinstance(x, Tensor) else x for x in arrays]
-        out = function(*ts, **kwargs)
+        full = list(args)
+        for j, i in enumerate(traced_idx):
+            full[i] = Tensor(arrays[j]) if not isinstance(arrays[j], Tensor) else arrays[j]
+        out = function(*full, **kwargs)
         if isinstance(out, (tuple, list)):
             return tuple(o._value if isinstance(o, Tensor) else o for o in out)
         return out._value if isinstance(out, Tensor) else out
@@ -55,6 +67,45 @@ def recompute(function, *args, preserve_rng_state=True, use_reentrant=True,
     if isinstance(out, tuple):
         return tuple(Tensor(o) for o in out)
     return Tensor(out)
+
+
+def apply_recompute(model, checkpoints=None, policy=None):
+    """Rewrite sublayer forwards to rematerialize, per strategy config.
+
+    Reference analog: RecomputeOptimizer consuming
+    `strategy.recompute_configs["checkpoints"]`
+    (/root/reference/python/paddle/distributed/fleet/meta_optimizers/recompute_optimizer.py).
+
+    `checkpoints` is a list of sublayer-name regexes to wrap; when empty/None the
+    default wraps every child of every LayerList (the transformer-block
+    convention, matching PipelineLayer's recompute_interval semantics).
+    Idempotent: returns the number of targets covered, counting layers wrapped
+    by an earlier call — callers should treat 0 as a config error.
+    """
+    import re
+
+    from ...nn.container import LayerList
+
+    targets = []
+    if checkpoints:
+        pats = [re.compile(p) for p in checkpoints]
+        for name, sub in model.named_sublayers():
+            if any(p.search(name) for p in pats):
+                targets.append(sub)
+    else:
+        for _, sub in model.named_sublayers(include_self=True):
+            if isinstance(sub, LayerList):
+                targets.extend(
+                    ch for ch in sub.children() if not isinstance(ch, LayerList)
+                )
+    n = 0  # targets covered (newly wrapped OR already wrapped — idempotent)
+    for layer in targets:
+        if not getattr(layer, "_recompute_wrapped", False):
+            orig = layer.forward
+            layer.forward = functools.partial(recompute, orig, policy=policy)
+            layer._recompute_wrapped = True
+        n += 1
+    return n
 
 
 class RecomputeLayer:

@@ -26,7 +26,8 @@ __all__ = [
     "adaptive_avg_pool1d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
     "batch_norm", "layer_norm", "group_norm", "instance_norm", "local_response_norm",
     "embedding", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
-    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "cross_entropy", "softmax_with_cross_entropy", "linear_cross_entropy",
+    "mse_loss", "l1_loss",
     "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
     "smooth_l1_loss", "kl_div", "margin_ranking_loss", "hinge_embedding_loss",
     "cosine_similarity", "normalize", "label_smooth", "one_hot", "pad",
@@ -704,6 +705,67 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     if weight is not None:
         args.append(_t(weight))
     return primitive_call(f, *args, name="cross_entropy")
+
+
+def linear_cross_entropy(hidden, weight, label, transpose_y=False,
+                         chunk_size=256, ignore_index=-100, name=None):
+    """Fused LM-head projection + softmax cross-entropy, chunked over sequence.
+
+    Computes ``cross_entropy(hidden @ W, label)`` without ever materializing the
+    full ``[batch, seq, vocab]`` logits tensor: the sequence axis is scanned in
+    chunks, each chunk's logits are produced on the MXU, reduced to (logsumexp,
+    target-logit) in fp32, and rematerialized in the backward (`jax.checkpoint`)
+    so peak HBM holds one ``[batch, chunk, vocab]`` block instead of the whole
+    thing. Reference analog: the fused softmax+CE kernel
+    `/root/reference/paddle/fluid/operators/collective/c_softmax_with_cross_entropy_op.cu`
+    (which tiles vocab across ranks for the same reason — logits don't fit).
+
+    Args:
+        hidden: ``[..., seq, in_features]`` activations (the pre-head trunk).
+        weight: ``[in_features, vocab]`` or, with ``transpose_y``, ``[vocab,
+            in_features]`` (tied-embedding layout).
+        label: integer targets broadcastable to ``hidden.shape[:-1]``.
+    Returns mean loss over non-ignored positions (scalar fp32 Tensor).
+    """
+
+    def f(h, w, lab):
+        lead = h.shape[:-1]
+        hidden_dim = h.shape[-1]
+        h2 = h.reshape(-1, hidden_dim)
+        lab2 = lab.reshape(-1).astype(jnp.int32)
+        n = h2.shape[0]
+        c = min(chunk_size, n)
+        pad = (-n) % c
+        if pad:
+            h2 = jnp.pad(h2, ((0, pad), (0, 0)))
+            lab2 = jnp.pad(lab2, (0, pad), constant_values=ignore_index)
+        nchunk = h2.shape[0] // c
+        hc = h2.reshape(nchunk, c, hidden_dim)
+        lc = lab2.reshape(nchunk, c)
+
+        @jax.checkpoint
+        def chunk_stats(h_blk, l_blk):
+            logits = (jnp.matmul(h_blk, w.T) if transpose_y
+                      else jnp.matmul(h_blk, w)).astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            safe = jnp.where(l_blk == ignore_index, 0, l_blk)
+            tgt = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+            valid = l_blk != ignore_index
+            losses = jnp.where(valid, lse - tgt, 0.0)
+            return jnp.sum(losses), jnp.sum(valid, dtype=jnp.float32)
+
+        def body(carry, blk):
+            tot, cnt = carry
+            s, k = chunk_stats(*blk)
+            return (tot + s, cnt + k), None
+
+        (total, count), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)), (hc, lc)
+        )
+        return total / jnp.maximum(count, 1.0)
+
+    return primitive_call(f, _t(hidden), _t(weight), _t(label).detach(),
+                          name="linear_cross_entropy")
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,

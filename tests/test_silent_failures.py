@@ -1,0 +1,203 @@
+"""Regression tests for the round-2 'silent failure trio' (VERDICT r2 item 7):
+each test fails on the old behavior.
+
+1. maybe_shard / Tensor.to no longer swallow exceptions.
+2. build_hybrid_step(recompute=True) actually rematerializes (and rejects a
+   config that matches nothing).
+3. ParallelCrossEntropy uses the vocab-parallel kernel, verified with
+   logits-sharded parity on both the shard_map and GSPMD paths.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core.tensor import Tensor
+
+
+def test_maybe_shard_raises_on_bad_spec():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.hybrid_train import maybe_shard, mesh_scope
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ("dp", "mp"))
+    t = Tensor(np.random.rand(4, 8).astype(np.float32))
+    with mesh_scope(mesh):
+        # rank-mismatched spec: must raise, not silently return unsharded
+        with pytest.raises(Exception):
+            maybe_shard(t, spec=P(None, None, "mp"))
+        # valid spec still works
+        out = maybe_shard(t, last_dim_axis="mp")
+        assert out.shape == t.shape
+
+
+def test_tensor_to_rejects_garbage():
+    t = Tensor(np.ones((2, 2), np.float32))
+    assert "16" in str(t.to("bfloat16").dtype)
+    assert tuple(t.to("cpu").shape) == (2, 2)  # placement no-op, not an error
+    assert tuple(t.to(paddle.CPUPlace()).shape) == (2, 2)
+    with pytest.raises(Exception):
+        t.to("definitely_not_a_dtype_or_place")
+
+
+class _Blocky(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Linear(8, 16)
+        self.blocks = nn.LayerList([nn.Linear(16, 16) for _ in range(3)])
+        self.head = nn.Linear(16, 4)
+
+    def forward(self, x):
+        x = self.emb(x)
+        for b in self.blocks:
+            x = nn.functional.relu(b(x))
+        return self.head(x)
+
+
+def test_hybrid_step_recompute_applies():
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.fleet.hybrid_train import build_hybrid_step
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    model = _Blocky()
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    loss_fn = nn.CrossEntropyLoss()
+    init_fn, step_fn, shard_batch = build_hybrid_step(
+        model, opt, loss_fn, mesh, recompute=True
+    )
+    # every LayerList child got wrapped
+    assert all(getattr(b, "_recompute_wrapped", False) for b in model.blocks)
+    state = init_fn()
+    x = np.random.rand(4, 8).astype(np.float32)
+    y = np.random.randint(0, 4, (4,))
+    import jax.numpy as jnp
+
+    key = paddle.core.rng.next_rng_key() if hasattr(paddle.core, "rng") else None
+    from paddle_tpu.core import rng as rng_mod
+
+    loss, state = step_fn(state, rng_mod.next_rng_key(),
+                          jnp.float32(0.1), shard_batch([x]), shard_batch([y]))
+    assert np.isfinite(float(loss))
+
+
+def test_hybrid_step_recompute_rejects_empty_match():
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.fleet.hybrid_train import build_hybrid_step
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("dp",))
+    model = _Blocky()
+    opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+    with pytest.raises(ValueError, match="recompute"):
+        build_hybrid_step(model, opt, nn.CrossEntropyLoss(), mesh,
+                          recompute=True,
+                          recompute_configs={"checkpoints": ["no_such_layer"]})
+
+
+def test_parallel_cross_entropy_shard_map_parity():
+    """Logits-sharded CE inside shard_map == dense CE (reference pattern:
+    test_collective_base.py multi-rank numeric checks)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.meta_parallel import ParallelCrossEntropy
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("mp",))
+    b, v = 6, 32
+    logits = np.random.randn(b, v).astype(np.float32)
+    labels = np.random.randint(0, v, (b,))
+    layer = ParallelCrossEntropy()
+
+    def f(lg, lb):
+        from paddle_tpu.core import tape
+
+        with tape.no_grad():
+            return layer(Tensor(lg), Tensor(lb))._value
+
+    fm = jax.jit(jax.shard_map(f, mesh=mesh,
+                               in_specs=(P(None, "mp"), P()), out_specs=P()))
+    loss = np.asarray(fm(jnp.asarray(logits), jnp.asarray(labels)))
+    ref = -np.log(np.exp(logits)[np.arange(b), labels] / np.exp(logits).sum(-1))
+    assert np.allclose(loss, ref, rtol=1e-4)
+
+
+def test_parallel_cross_entropy_gspmd_parity():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.hybrid_train import mesh_scope
+    from paddle_tpu.distributed.fleet.meta_parallel import ParallelCrossEntropy
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("mp",))
+    b, v = 6, 32
+    logits = np.random.randn(b, v).astype(np.float32)
+    labels = np.random.randint(0, v, (b,))
+    layer = ParallelCrossEntropy()
+
+    def f(lg, lb):
+        from paddle_tpu.core import tape
+
+        with tape.no_grad(), mesh_scope(mesh):
+            return layer(Tensor(lg), Tensor(lb))._value
+
+    fj = jax.jit(f, in_shardings=(NamedSharding(mesh, P(None, "mp")),
+                                  NamedSharding(mesh, P())))
+    loss = np.asarray(fj(jnp.asarray(logits), jnp.asarray(labels)))
+    ref = -np.log(np.exp(logits)[np.arange(b), labels] / np.exp(logits).sum(-1))
+    assert np.allclose(loss, ref, rtol=1e-4)
+
+
+def test_parallel_cross_entropy_ignore_index():
+    """label == ignore_index rows contribute exactly zero loss on both paths."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.meta_parallel import ParallelCrossEntropy
+
+    b, v = 4, 32
+    logits = np.random.randn(b, v).astype(np.float32)
+    labels = np.array([3, -100, 7, -100])
+    layer = ParallelCrossEntropy()
+    from paddle_tpu.core import tape
+
+    with tape.no_grad():
+        loss = np.asarray(layer(Tensor(logits), Tensor(labels.astype(np.int32)))._value)
+    assert loss[1] == 0.0 and loss[3] == 0.0
+    assert loss[0] > 0.0 and loss[2] > 0.0
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("mp",))
+
+    def f(lg, lb):
+        with tape.no_grad():
+            return layer(Tensor(lg), Tensor(lb))._value
+
+    fm = jax.jit(jax.shard_map(f, mesh=mesh,
+                               in_specs=(P(None, "mp"), P()), out_specs=P()))
+    loss_mp = np.asarray(fm(jnp.asarray(logits), jnp.asarray(labels.astype(np.int32))))
+    assert loss_mp[1] == 0.0 and loss_mp[3] == 0.0
+    assert np.allclose(loss_mp, loss, rtol=1e-4)
+
+
+def test_linear_cross_entropy_fused_parity():
+    """The chunked head+CE kernel (bench/GPT loss path) == naive matmul+CE."""
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.tensor_ops.math as M
+
+    rng = np.random.RandomState(3)
+    h = Tensor(rng.randn(2, 9, 16).astype(np.float32))
+    w = Tensor(rng.randn(16, 33).astype(np.float32))
+    lab = rng.randint(0, 33, (2, 9))
+    lab[0, 2] = -100  # ignore_index position
+    lab_t = Tensor(lab.astype(np.int32))
+    fused = float(F.linear_cross_entropy(h, w, lab_t, chunk_size=4))
+    naive = float(F.cross_entropy(
+        M.matmul(h, w).reshape([-1, 33]), Tensor(lab.reshape(-1).astype(np.int32))
+    ))
+    assert abs(fused - naive) < 1e-5
