@@ -5,28 +5,35 @@ completion, partitioner, reshard, planner, engine). TPU-native mapping:
 
 - ProcessMesh            → named view over jax.devices() → jax.sharding.Mesh
 - shard_tensor/shard_op  → NamedSharding annotations (device_put / constraint)
-- completion.py          → GSPMD sharding propagation, read from the compiled
-                           executable (complete())
-- partitioner + reshard  → XLA SPMD partitioner; reshard() is one device_put
+- completion.py          → dims_mapping propagation over the model's jaxpr
+                           (complete_param_specs), validated against the GSPMD
+                           fixpoint read from a compiled executable (complete())
+- partitioner.py         → Partitioner: completed specs → per-mesh
+                           NamedShardings (+ per-stage splits for pipeline)
+- reshard.py             → Resharder / reshard(): one placement op; XLA emits
+                           the implied collectives (all-gather/all-to-all/ICI
+                           transfer)
 - planner + cost model   → plan_mesh() with an alpha-beta ICI cost model
-- Engine                 → plan + compile one pjit train step; fit/evaluate/
-                           predict/save/load
+- Engine                 → plan + complete + partition + compile one pjit train
+                           step; fit/evaluate/predict/save/load
 """
-from .completion import complete
+from .completion import complete, complete_param_specs
 from .cost_model import ClusterSpec, CommCostModel, CompCostModel
 from .engine import Engine
 from .interface import (
     TensorDistAttr,
     dist_attr,
-    reshard,
     shard_op,
     shard_tensor,
 )
+from .partitioner import Partitioner
 from .planner import plan_mesh
 from .process_mesh import ProcessMesh
+from .reshard import Resharder, needs_reshard, reshard
 
 __all__ = [
     "ProcessMesh", "shard_tensor", "shard_op", "reshard", "dist_attr",
-    "TensorDistAttr", "complete", "plan_mesh", "Engine", "ClusterSpec",
+    "TensorDistAttr", "complete", "complete_param_specs", "Partitioner",
+    "Resharder", "needs_reshard", "plan_mesh", "Engine", "ClusterSpec",
     "CommCostModel", "CompCostModel",
 ]

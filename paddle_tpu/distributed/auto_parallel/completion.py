@@ -1,18 +1,404 @@
-"""Sharding completion — GSPMD propagation as the completion algorithm.
+"""Dist-attr completion: propagate sharding annotations through the traced
+computation graph.
 
-Reference: python/paddle/distributed/auto_parallel/completion.py walks the
-program graph forward/backward propagating dist attrs op by op. TPU-native: the
-XLA SPMD partitioner already runs exactly that fix-point propagation from the
-annotations present in a jitted function. `complete()` exposes its result: it
-compiles the function once (AOT, no execution) and reads back the shardings the
-partitioner chose for every input and output.
+Reference analog: auto_parallel/completion.py (Completer.complete_forward_
+annotation — per-op dist-attr propagation to a fixpoint over the program) with
+the per-op rules of auto_parallel/operators/dist_{matmul,elementwise,...}.py.
+
+TPU-native, two cooperating mechanisms:
+- `propagate_jaxpr` / `complete_param_specs`: OUR propagation. The "program"
+  is the model's jaxpr; each variable gets a dims_mapping (mesh-axis name or
+  None per dim). User annotations made with `shard_tensor`
+  (Tensor._sharding_spec) seed the parameter inputs; per-primitive rules
+  propagate forward (operands -> outputs) and backward (outputs/known operands
+  -> unknown operands) until a fixpoint. Newly inferred parameter specs are
+  written back to `_sharding_spec`, where the partitioner (and
+  build_hybrid_step) turns them into GSPMD NamedShardings.
+- `complete`: the XLA-side check — compile AOT and read back the shardings the
+  GSPMD partitioner chose, to validate ours against the compiler's fixpoint.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
-from jax.sharding import NamedSharding
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core import rng as rng_mod
+from ...core import tape as tape_mod
+from ...core.tensor import Tensor
+
+__all__ = ["complete_param_specs", "propagate_jaxpr", "complete"]
 
 
+# A "mapping" is a tuple of (axis-name | None), one entry per tensor dim.
+def _none(ndim):
+    return (None,) * ndim
+
+
+def _merge_dim(a, b):
+    """Merge two dim annotations; conflicting names -> None (replicate)."""
+    if a == b:
+        return a
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return None
+
+
+def _merge(m1, m2):
+    return tuple(_merge_dim(a, b) for a, b in zip(m1, m2))
+
+
+class _SpecEnv:
+    """jaxpr var -> mapping, with change tracking for the fixpoint loop."""
+
+    def __init__(self):
+        self.specs: dict = {}
+        self.changed = False
+
+    def get(self, v):
+        if not hasattr(v, "aval"):  # Literal
+            return _none(np.ndim(getattr(v, "val", 0)))
+        return self.specs.get(id(v))
+
+    def join(self, v, mapping):
+        if not hasattr(v, "aval") or mapping is None:
+            return
+        nd = len(v.aval.shape)
+        mapping = tuple(mapping)[:nd] + (None,) * (nd - len(mapping))
+        old = self.specs.get(id(v))
+        new = mapping if old is None else _merge(old, mapping)
+        if new != old:
+            self.specs[id(v)] = new
+            self.changed = True
+
+
+def _align_broadcast(mapping, from_shape, to_shape):
+    """Right-align an operand mapping onto the (broadcast) output shape."""
+    out = [None] * len(to_shape)
+    off = len(to_shape) - len(from_shape)
+    for i, ax in enumerate(mapping):
+        if from_shape[i] == to_shape[off + i] and from_shape[i] != 1:
+            out[off + i] = ax
+    return tuple(out)
+
+
+def _unalign_broadcast(out_mapping, from_shape, to_shape):
+    """Project an output mapping back onto a broadcast operand."""
+    off = len(to_shape) - len(from_shape)
+    m = []
+    for i in range(len(from_shape)):
+        ax = out_mapping[off + i]
+        m.append(ax if from_shape[i] == to_shape[off + i] and from_shape[i] != 1
+                 else None)
+    return tuple(m)
+
+
+def _reshape_map(mapping, old_shape, new_shape):
+    """Carry a dim's annotation through reshape when the dim survives intact:
+    same size and same product of preceding dims (the common flatten/unflatten
+    cases). Anything else replicates — conservative, never wrong."""
+    out = [None] * len(new_shape)
+    for i, ax in enumerate(mapping):
+        if ax is None:
+            continue
+        pre_old = int(np.prod(old_shape[:i])) if i else 1
+        for j, s in enumerate(new_shape):
+            pre_new = int(np.prod(new_shape[:j])) if j else 1
+            if s == old_shape[i] and pre_new == pre_old:
+                out[j] = ax
+                break
+    return tuple(out)
+
+
+def _dot_out_mapping(lhs_m, rhs_m, dnums):
+    (lc, rc), (lb, rb) = dnums
+    lhs_free = [i for i in range(len(lhs_m)) if i not in lc and i not in lb]
+    rhs_free = [j for j in range(len(rhs_m)) if j not in rc and j not in rb]
+    out = []
+    for i, j in zip(lb, rb):
+        out.append(_merge_dim(lhs_m[i], rhs_m[j]))
+    out += [lhs_m[i] for i in lhs_free]
+    out += [rhs_m[j] for j in rhs_free]
+    return tuple(out)
+
+
+def _dot_operand_from(known_m, out_m, dnums, lhs_known, lhs_shape, rhs_shape):
+    """Infer the unknown dot operand's mapping from the known operand and/or
+    the output (the dist_matmul rule run in reverse)."""
+    (lc, rc), (lb, rb) = dnums
+    nb = len(lb)
+    lhs_free = [i for i in range(len(lhs_shape)) if i not in lc and i not in lb]
+    rhs_free = [j for j in range(len(rhs_shape)) if j not in rc and j not in rb]
+    if lhs_known:  # infer rhs
+        m = [None] * len(rhs_shape)
+        for i, j in zip(lb, rb):
+            m[j] = known_m[i]
+        for i, j in zip(lc, rc):  # contracting dims must match
+            m[j] = known_m[i]
+        if out_m is not None:
+            for k, j in enumerate(rhs_free):
+                m[j] = _merge_dim(m[j], out_m[nb + len(lhs_free) + k])
+        return tuple(m)
+    m = [None] * len(lhs_shape)
+    for i, j in zip(lb, rb):
+        m[i] = known_m[j]
+    for i, j in zip(lc, rc):
+        m[i] = known_m[j]
+    if out_m is not None:
+        for k, i in enumerate(lhs_free):
+            m[i] = _merge_dim(m[i], out_m[nb + k])
+    return tuple(m)
+
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "rem", "and", "or", "xor",
+    "atan2", "nextafter", "select_n", "clamp",
+}
+_UNARY = {
+    "exp", "log", "log1p", "expm1", "tanh", "logistic", "erf", "erfc", "erf_inv",
+    "sqrt", "rsqrt", "cbrt", "neg", "abs", "sign", "floor", "ceil", "round",
+    "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "asinh",
+    "acosh", "atanh", "integer_pow", "convert_element_type", "stop_gradient",
+    "copy", "real", "imag", "is_finite", "not", "reduce_precision",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "population_count", "clz", "exp2", "square",
+}
+_REDUCE = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+           "reduce_or", "argmax", "argmin"}
+_CMP = {"eq", "ne", "lt", "le", "gt", "ge"}
+
+
+def _propagate_eqn(eqn, env: _SpecEnv):
+    prim = eqn.primitive.name
+    ins, outs = eqn.invars, eqn.outvars
+
+    def shape(v):
+        return tuple(getattr(getattr(v, "aval", None), "shape", ()) or ())
+
+    # --- call-like primitives: recurse into the sub-jaxpr
+    sub = None
+    if prim in ("pjit", "closed_call", "core_call", "xla_call", "remat",
+                "remat2", "checkpoint"):
+        sub = eqn.params.get("jaxpr")
+    elif prim in ("custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr"):
+        sub = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+    if sub is not None:
+        inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        for outer, v in zip(ins, inner.invars):
+            m = env.get(outer)
+            if m is not None:
+                env.join(v, m)
+        for e in inner.eqns:
+            _propagate_eqn(e, env)
+        for outer, v in zip(outs, inner.outvars):
+            m = env.get(v)
+            if m is not None:
+                env.join(outer, m)
+            m2 = env.get(outer)
+            if m2 is not None:
+                env.join(v, m2)
+        for outer, v in zip(ins, inner.invars):  # reverse: inner -> operands
+            m = env.get(v)
+            if m is not None:
+                env.join(outer, m)
+        return
+
+    if prim == "dot_general":
+        dnums = eqn.params["dimension_numbers"]
+        lm, rm = env.get(ins[0]), env.get(ins[1])
+        om = env.get(outs[0])
+        if lm is not None and rm is not None:
+            env.join(outs[0], _dot_out_mapping(lm, rm, dnums))
+        if lm is not None and rm is None:
+            env.join(ins[1], _dot_operand_from(lm, om, dnums, True,
+                                               shape(ins[0]), shape(ins[1])))
+        if rm is not None and lm is None:
+            env.join(ins[0], _dot_operand_from(rm, om, dnums, False,
+                                               shape(ins[0]), shape(ins[1])))
+        return
+
+    if prim in _ELEMENTWISE or prim in _CMP:
+        osh = shape(outs[0])
+        known = [(v, env.get(v)) for v in ins]
+        for v, m in known:
+            if m is not None:
+                env.join(outs[0], _align_broadcast(m, shape(v), osh))
+        om = env.get(outs[0])
+        if om is not None:
+            for v, m in known:
+                if m is None and shape(v):
+                    env.join(v, _unalign_broadcast(om, shape(v), osh))
+        return
+
+    if prim in _UNARY:
+        m = env.get(ins[0])
+        if m is not None:
+            env.join(outs[0], m)
+        om = env.get(outs[0])
+        if om is not None and shape(ins[0]) == shape(outs[0]):
+            env.join(ins[0], om)
+        return
+
+    if prim == "transpose":
+        perm = eqn.params["permutation"]
+        m = env.get(ins[0])
+        if m is not None:
+            env.join(outs[0], tuple(m[p] for p in perm))
+        om = env.get(outs[0])
+        if om is not None:
+            inv = [None] * len(perm)
+            for i, p in enumerate(perm):
+                inv[p] = om[i]
+            env.join(ins[0], tuple(inv))
+        return
+
+    if prim == "reshape":
+        m = env.get(ins[0])
+        if m is not None:
+            env.join(outs[0], _reshape_map(m, shape(ins[0]), shape(outs[0])))
+        om = env.get(outs[0])
+        if om is not None:
+            env.join(ins[0], _reshape_map(om, shape(outs[0]), shape(ins[0])))
+        return
+
+    if prim == "broadcast_in_dim":
+        bdims = eqn.params["broadcast_dimensions"]
+        m = env.get(ins[0]) if ins else None
+        if m is not None:
+            out = [None] * len(shape(outs[0]))
+            for i, d in enumerate(bdims):
+                if shape(ins[0])[i] == shape(outs[0])[d]:
+                    out[d] = m[i]
+            env.join(outs[0], tuple(out))
+        om = env.get(outs[0])
+        if om is not None and ins:
+            back = []
+            for i, d in enumerate(bdims):
+                back.append(om[d] if shape(ins[0])[i] == shape(outs[0])[d] else None)
+            env.join(ins[0], tuple(back))
+        return
+
+    if prim in _REDUCE:
+        axes = eqn.params.get("axes", ())
+        m = env.get(ins[0])
+        if m is not None:
+            env.join(outs[0], tuple(ax for i, ax in enumerate(m) if i not in axes))
+        return
+
+    if prim == "squeeze":
+        dims = eqn.params["dimensions"]
+        m = env.get(ins[0])
+        if m is not None:
+            env.join(outs[0], tuple(ax for i, ax in enumerate(m) if i not in dims))
+        return
+
+    if prim == "concatenate":
+        dim = eqn.params["dimension"]
+        for v in ins:
+            m = env.get(v)
+            if m is not None:
+                env.join(outs[0],
+                         tuple(None if i == dim else ax for i, ax in enumerate(m)))
+        return
+
+    if prim in ("gather", "dynamic_slice", "slice"):
+        # conservative: keep annotations only on dims whose size is unchanged
+        m = env.get(ins[0])
+        if m is not None and shape(outs[0]):
+            ish, osh = shape(ins[0]), shape(outs[0])
+            if prim == "gather" and len(osh) >= 1:
+                # embedding-style take: trailing slice dims copy from operand
+                out = [None] * len(osh)
+                k = len(osh) - 1
+                j = len(ish) - 1
+                while k >= 0 and j >= 1 and osh[k] == ish[j]:
+                    out[k] = m[j]
+                    k -= 1
+                    j -= 1
+                env.join(outs[0], tuple(out))
+            elif len(ish) == len(osh):
+                env.join(outs[0], tuple(ax if ish[i] == osh[i] else None
+                                        for i, ax in enumerate(m)))
+        return
+
+    # default: outputs replicated (unknown rule) — never guess
+    for o in outs:
+        env.join(o, _none(len(shape(o))))
+
+
+def propagate_jaxpr(jaxpr, in_mappings, n_iters=8):
+    """Run forward/backward propagation over a (closed) jaxpr to a fixpoint.
+
+    in_mappings: list aligned with jaxpr.invars (mapping or None = unknown).
+    Returns the _SpecEnv holding every var's inferred mapping.
+    """
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    env = _SpecEnv()
+    for v, m in zip(inner.invars, in_mappings):
+        if m is not None:
+            env.join(v, m)
+    for _ in range(n_iters):
+        env.changed = False
+        for eqn in inner.eqns:
+            _propagate_eqn(eqn, env)
+        if not env.changed:
+            break
+    return env
+
+
+def complete_param_specs(model, example_inputs, input_specs=None):
+    """Complete `_sharding_spec` annotations across a model's parameters.
+
+    Traces `model.functional_call` on `example_inputs` (numpy/jax arrays),
+    seeds the jaxpr input mappings from existing annotations, propagates, and
+    writes inferred specs back onto previously-unannotated parameters.
+    Returns {param_name: PartitionSpec} for every param that ends up sharded.
+    """
+    params, _ = model.functional_state()
+    pvals = {k: v._value for k, v in params.items() if v is not None}
+
+    def fwd(pv, *inputs):
+        with tape_mod.no_grad(), rng_mod.trace_rng_scope(jax.random.key(0)):
+            out, _ = model.functional_call(pv, {}, *[Tensor(x) for x in inputs])
+        o = out[0] if isinstance(out, (tuple, list)) else out
+        return o._value if isinstance(o, Tensor) else o
+
+    closed = jax.make_jaxpr(fwd)(pvals, *example_inputs)
+
+    # align flattened invars with param names / inputs
+    paths, _ = jax.tree_util.tree_flatten_with_path(pvals)
+    names = [kp[0].key for kp, _ in paths]
+    n_params = len(names)
+
+    in_mappings = []
+    for name in names:
+        spec = params[name]._sharding_spec if params[name] is not None else None
+        in_mappings.append(tuple(spec) if spec is not None else None)
+    for i, x in enumerate(example_inputs):
+        spec = None
+        if input_specs is not None and i < len(input_specs):
+            spec = input_specs[i]
+        in_mappings.append(tuple(spec) if spec is not None else None)
+
+    env = propagate_jaxpr(closed, in_mappings)
+
+    out = {}
+    for name, var in zip(names, closed.jaxpr.invars[:n_params]):
+        m = env.specs.get(id(var))
+        p = params[name]
+        if m is not None and any(ax is not None for ax in m):
+            if p._sharding_spec is None:
+                p._sharding_spec = tuple(m)
+            out[name] = P(*p._sharding_spec)
+        elif p._sharding_spec is not None:
+            out[name] = P(*p._sharding_spec)
+    return out
+
+
+# --------------------------------------------------------- XLA-side validation
 def _spec_of(sharding):
     if isinstance(sharding, NamedSharding):
         return tuple(sharding.spec)
@@ -20,12 +406,13 @@ def _spec_of(sharding):
 
 
 def complete(fn, *example_args, mesh=None, in_shardings=None):
-    """Compile `fn` AOT and return the propagated (input, output) shardings.
+    """Compile `fn` AOT and return the shardings the GSPMD partitioner
+    propagated (the compiler's own completion fixpoint) — used to validate
+    `complete_param_specs` against XLA.
 
     in_shardings: optional per-arg shardings (None = let GSPMD decide, honoring
     any with_sharding_constraint annotations inside fn). Returns a dict with
-    'inputs'/'outputs': lists of PartitionSpec tuples (None for replicated or
-    non-named shardings) plus the raw sharding objects.
+    'inputs'/'outputs': lists of PartitionSpec tuples plus raw shardings.
     """
     kw = {}
     if in_shardings is not None:

@@ -53,7 +53,8 @@ class Engine:
         return self._mesh
 
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
-        """Plan the mesh and compile the train step (completion+partition)."""
+        """Plan the mesh, complete the user's dist-attr annotations, partition,
+        and compile the train step."""
         mesh = self._plan()
         strat = self.strategy
         zero = strat.sharding_configs.get("stage", 1) if strat.sharding else 0
@@ -62,8 +63,29 @@ class Engine:
         # to make the state fit, the compiled step must actually apply it.
         if zero == 0 and sizes.get("sharding", 1) > 1:
             zero = 1
+        annotated = any(p._sharding_spec is not None
+                        for p in self.model.parameters())
+        if annotated and inputs_spec is not None:
+            # completion: propagate the user's shard_tensor annotations through
+            # the traced graph to the unannotated params (completion.py)
+            from .completion import complete_param_specs
+
+            example = [np.zeros(s.shape, s.dtype) for s in inputs_spec]
+            complete_param_specs(self.model, example)
         if sizes.get("mp", 1) > 1:
+            # fill whatever completion (or the user) left unannotated —
+            # annotations always win over this default
             self._annotate_default_mp(sizes["mp"])
+        # partition: validate every completed spec against the mesh (axes
+        # exist, dims divide) — relaxes bad specs to replicated with a warning
+        from .partitioner import Partitioner
+
+        part = Partitioner(mesh)
+        for name, p in self.model.named_parameters():
+            if p._sharding_spec is not None:
+                spec = part.validate_spec(tuple(int(d) for d in p.shape),
+                                          p._sharding_spec, name)
+                p._sharding_spec = tuple(spec)
         amp_level = strat.amp_configs.get("level", "O1") if strat.amp else "O0"
         init_fn, step_fn, shard_batch = build_hybrid_step(
             self.model, self.optimizer, self._loss_fn, mesh,

@@ -32,14 +32,9 @@ class TensorDistAttr:
 
 
 def _normalize_spec(shard_spec, ndim, mesh: ProcessMesh):
-    if shard_spec is None:
-        shard_spec = [None] * ndim
-    if len(shard_spec) != ndim:
-        raise ValueError(f"shard_spec {shard_spec} for a {ndim}-d tensor")
-    for s in shard_spec:
-        if s is not None and s not in mesh.dim_names:
-            raise ValueError(f"unknown mesh dim {s!r}; mesh has {mesh.dim_names}")
-    return list(shard_spec)
+    from .reshard import normalize_spec
+
+    return normalize_spec(shard_spec, ndim, mesh.dim_names)
 
 
 def shard_tensor(x, process_mesh: ProcessMesh, shard_spec=None):
@@ -85,23 +80,6 @@ def shard_op(op_fn, process_mesh: ProcessMesh, in_shard_specs=None,
         return out
 
     return wrapped
-
-
-def reshard(x, process_mesh: ProcessMesh, shard_spec=None):
-    """Move a tensor to a (new) mesh/layout. XLA emits the collectives
-    (all-gather / all-to-all / slice) implied by the transition — the entire
-    reference reshard.py machinery collapses into one device_put."""
-    t = x if isinstance(x, Tensor) else Tensor(x)
-    spec = _normalize_spec(shard_spec, t.ndim, process_mesh)
-    sharding = NamedSharding(process_mesh.jax_mesh(), P(*spec))
-    if _is_traced(t._value):
-        out = jax.lax.with_sharding_constraint(t._value, sharding)
-    else:
-        out = jax.device_put(t._value, sharding)
-    nt = Tensor(out, stop_gradient=t.stop_gradient)
-    nt._sharding_spec = tuple(spec)
-    nt._dist_attr = TensorDistAttr(process_mesh, spec)
-    return nt
 
 
 def dist_attr(x) -> "TensorDistAttr | None":

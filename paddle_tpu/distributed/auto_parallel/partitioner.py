@@ -1,0 +1,104 @@
+"""Partitioner: turn completed dist-attrs into concrete per-mesh placements.
+
+Reference analog: auto_parallel/partitioner.py:1 (Partitioner.partition —
+rewrite the serial program into a per-rank dist program, sharding vars and
+swapping ops for their dist impls). TPU-native: there is no per-rank program
+surgery — GSPMD compiles ONE program. The partitioner's job here is the part
+XLA can't do by itself:
+
+- resolve every parameter/optimizer-slot/data tensor to a `NamedSharding` on
+  the target mesh, validating the completed specs (axes exist, dims divide);
+- for pipeline models, split the spec set per stage sub-mesh and compute the
+  boundary activation specs the resharder must satisfy between stages.
+
+`build_hybrid_step`/`Engine` consume the result as pjit in_shardings.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Partitioner"]
+
+
+class Partitioner:
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    # ------------------------------------------------------------- validation
+    def validate_spec(self, shape, spec, name="<tensor>"):
+        """Check a dims_mapping against the mesh; returns a (possibly relaxed)
+        spec: unknown axes and non-divisible dims are replicated with a warning
+        rather than failing the whole compile (the reference partitioner
+        asserts; GSPMD would pad silently — we split the difference)."""
+        if spec is None:
+            return P()
+        fixed = []
+        for i, ax in enumerate(tuple(spec)[: len(shape)]):
+            if ax is None:
+                fixed.append(None)
+                continue
+            size = self.axis_sizes.get(ax)
+            if size is None:
+                logger.warning("%s dim %d: mesh has no axis %r; replicating",
+                               name, i, ax)
+                fixed.append(None)
+            elif size > 1 and shape[i] % size != 0:
+                logger.warning("%s dim %d (size %d) not divisible by axis %r "
+                               "(%d); replicating", name, i, shape[i], ax, size)
+                fixed.append(None)
+            else:
+                fixed.append(ax)
+        fixed += [None] * (len(shape) - len(fixed))
+        return P(*fixed)
+
+    # ------------------------------------------------------------ parameters
+    def partition_params(self, model) -> dict:
+        """{param_name: NamedSharding} from completed `_sharding_spec`s."""
+        out = {}
+        for name, p in model.named_parameters():
+            spec = self.validate_spec(tuple(int(s) for s in p.shape),
+                                      p._sharding_spec, name)
+            out[name] = NamedSharding(self.mesh, spec)
+        return out
+
+    def partition_batch(self, ndim, axes=("dp", "sharding")) -> NamedSharding:
+        """Batch-dim sharding over the data axes present in the mesh."""
+        present = tuple(a for a in axes if self.axis_sizes.get(a, 1) > 1)
+        if not present or ndim == 0:
+            return NamedSharding(self.mesh, P())
+        lead = present if len(present) > 1 else present[0]
+        return NamedSharding(self.mesh, P(lead, *([None] * (ndim - 1))))
+
+    # -------------------------------------------------------------- pipeline
+    def partition_pipeline(self, pipe_layer, stage_meshes):
+        """Per-stage placements for a PipelineLayer.
+
+        Returns (per_stage_params, boundary_specs):
+        - per_stage_params[s]: {param_name: NamedSharding on stage s's mesh}
+        - boundary_specs[s]: PartitionSpec the stage-s output must carry when
+          entering stage s+1 (the reshard contract; reference reshard.py:1
+          computes exactly this edge set from produced/consumed dist attrs).
+        """
+        per_stage = []
+        boundary = []
+        for s, mesh in enumerate(stage_meshes):
+            sub = Partitioner(mesh)
+            specs = {}
+            for name, p in pipe_layer.stages[s].named_parameters():
+                spec = sub.validate_spec(tuple(int(d) for d in p.shape),
+                                         p._sharding_spec, name)
+                specs[name] = NamedSharding(mesh, spec)
+            per_stage.append(specs)
+            if s + 1 < len(stage_meshes):
+                nxt = stage_meshes[s + 1]
+                sizes = dict(zip(nxt.axis_names, nxt.devices.shape))
+                axes = tuple(a for a in ("dp", "sharding") if sizes.get(a, 1) > 1)
+                boundary.append(P(axes if len(axes) > 1 else (axes[0] if axes else None)))
+        return per_stage, boundary

@@ -1,0 +1,96 @@
+"""Resharder: move tensors between shardings/meshes.
+
+Reference analog: auto_parallel/reshard.py:1 (Resharder — inserts
+slice/concat/send/recv op sequences wherever a consumer op's dist attr differs
+from the producer's). TPU-native: a reshard IS one placement op —
+`device_put` eagerly (XLA picks all-gather / all-to-all / collective-permute
+over ICI), `with_sharding_constraint` under trace (GSPMD splices the same
+collectives into the compiled program). Cross-mesh (pipeline stage boundary)
+transfers are the same `device_put` with a different target mesh — the
+send_v2/recv_v2 pair of the reference collapses into it.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh
+
+__all__ = ["Resharder", "reshard", "needs_reshard"]
+
+
+def _as_sharding(mesh, spec):
+    if isinstance(mesh, ProcessMesh):
+        mesh = mesh.jax_mesh()
+    return NamedSharding(mesh, spec if isinstance(spec, P) else P(*(spec or ())))
+
+
+def needs_reshard(src, dst) -> bool:
+    """True when moving src->dst actually requires data movement."""
+    if src is None or not isinstance(src, NamedSharding):
+        return True  # unknown or single-device layout: place it
+    if src.mesh is not dst.mesh and src.mesh != dst.mesh:
+        return True
+    return tuple(src.spec) != tuple(dst.spec)
+
+
+def normalize_spec(shard_spec, ndim, dim_names):
+    """Validate/expand a shard_spec against a mesh's dim names (the one shared
+    implementation; interface._normalize_spec delegates here)."""
+    spec = list(shard_spec) if shard_spec is not None else [None] * ndim
+    if len(spec) != ndim:
+        raise ValueError(f"shard_spec {shard_spec} for a {ndim}-d tensor")
+    for s in spec:
+        if s is not None and s not in dim_names:
+            raise ValueError(f"unknown mesh dim {s!r}; mesh has {dim_names}")
+    return spec
+
+
+def reshard(x, process_mesh, shard_spec=None):
+    """Functional reshard (the public auto-parallel API, reference
+    interface.py). Returns a new annotated Tensor on the target layout."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    dim_names = (process_mesh.dim_names if isinstance(process_mesh, ProcessMesh)
+                 else process_mesh.axis_names)
+    spec = normalize_spec(shard_spec, t.ndim, dim_names)
+    sharding = _as_sharding(process_mesh, P(*spec))
+    if isinstance(t._value, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(t._value, sharding)
+    else:
+        out = jax.device_put(t._value, sharding)
+    nt = Tensor(out, stop_gradient=t.stop_gradient)
+    nt._sharding_spec = tuple(spec)
+    if isinstance(process_mesh, ProcessMesh):
+        from .interface import TensorDistAttr
+
+        nt._dist_attr = TensorDistAttr(process_mesh, spec)
+    return nt
+
+
+class Resharder:
+    """Plan + apply reshards along a producer->consumer edge list.
+
+    Each edge is (tensor, src_sharding|None, dst_sharding); apply() returns the
+    moved tensors and a log of which edges actually moved (for tests/debug —
+    the reference Resharder's inserted-op list)."""
+
+    def __init__(self):
+        self.log = []
+
+    def apply(self, x, dst: NamedSharding, src: NamedSharding | None = None):
+        arr = x._value if isinstance(x, Tensor) else x
+        cur = src if src is not None else getattr(arr, "sharding", None)
+        if cur is not None and not needs_reshard(cur, dst):
+            self.log.append(("noop", tuple(dst.spec)))
+            return x
+        if isinstance(arr, jax.core.Tracer):
+            out = jax.lax.with_sharding_constraint(arr, dst)
+            self.log.append(("constraint", tuple(dst.spec)))
+        else:
+            out = jax.device_put(arr, dst)
+            self.log.append(("device_put", tuple(dst.spec)))
+        if isinstance(x, Tensor):
+            nt = Tensor(out, stop_gradient=x.stop_gradient)
+            return nt
+        return out

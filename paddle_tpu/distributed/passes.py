@@ -1,6 +1,16 @@
-"""paddle.distributed.passes — reference: python/paddle/distributed/passes/
-(pass_base.py new_pass/PassManager). The pass substrate lives in
-static/passes.py; distributed transforms register into the same registry."""
+"""paddle.distributed.passes — distributed program-rewrite passes.
+
+Reference: python/paddle/distributed/passes/ (pass_base.py new_pass/PassManager;
+auto_parallel_sharding.py, auto_parallel_gradient_merge.py). The pass substrate
+lives in static/passes.py; the distributed transforms below register into the
+same registry and record their rewrites as PROGRAM/OP ATTRS (not opaque
+closures), which the static Executor honors at lowering time — serializable,
+inspectable by later passes, idempotent.
+"""
+from __future__ import annotations
+
+import numpy as np
+
 from ..static.passes import (  # noqa: F401
     PassBase,
     PassContext,
@@ -8,3 +18,84 @@ from ..static.passes import (  # noqa: F401
     new_pass,
     register_pass,
 )
+from ..static.program import OpRole
+
+
+@register_pass("auto_parallel_sharding")
+class ShardingPass(PassBase):
+    """ZeRO sharding as a program attribute rewrite.
+
+    Reference analog: auto_parallel_sharding.py:1 / sharding_optimizer.py:45 —
+    the reference shards param/grad/opt-state vars across the sharding ring and
+    inserts broadcast/allreduce ops. TPU-native: the pass records the layout
+    decision (mesh, axis, stage, per-param specs) on the program; the Executor
+    lays params/opt-state out with those NamedShardings and XLA GSPMD inserts
+    the all-gathers/reduce-scatters the reference spelled as ops.
+
+    attrs: mesh (jax Mesh, required), axis (default 'sharding'),
+    stage (1 = opt-state, 2 = +grads [XLA fuses into the same layout],
+    3 = +params).
+    """
+
+    def check(self, program):
+        return self.attrs.get("mesh") is not None
+
+    def _apply_impl(self, main_program, startup_program, context):
+        from .fleet.hybrid_train import _zero_spec
+
+        mesh = self.attrs["mesh"]
+        axis = self.attrs.get("axis", "sharding")
+        stage = int(self.attrs.get("stage", 1))
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if axis not in sizes:
+            raise ValueError(f"mesh has no axis {axis!r}: {mesh.axis_names}")
+
+        param_specs = {}
+        if stage >= 3:
+            for p in main_program.captured_params():
+                if p.stop_gradient:
+                    continue
+                spec = _zero_spec(tuple(int(s) for s in np.shape(p._value)),
+                                  mesh, axis)
+                if any(s is not None for s in spec):
+                    param_specs[p.name] = tuple(spec)
+
+        main_program._dist_attrs = {
+            "mesh": mesh, "axis": axis, "stage": stage,
+            "param_specs": param_specs,
+        }
+        # tag optimizer-role ops so later passes / serialization see the rewrite
+        for block in main_program.blocks:
+            for op in block.ops:
+                if op.op_role == OpRole.Optimize:
+                    op.attrs["sharding_axis"] = axis
+                    op.attrs["sharding_stage"] = stage
+        context.attrs["sharding"] = {"stage": stage, "axis": axis,
+                                     "n_param_specs": len(param_specs)}
+
+
+@register_pass("auto_parallel_gradient_merge")
+class GradientMergePass(PassBase):
+    """Gradient accumulation: apply the optimizer every k-th step.
+
+    Reference analog: auto_parallel_gradient_merge.py:1 — inserts gradient
+    accumulator vars and wraps the optimizer ops in a cond block keyed on a
+    step counter. TPU-native: the pass records {k_steps, avg} on the program;
+    the Executor's compiled step accumulates grads and runs the update under
+    `lax.cond(count >= k)` — the same conditional-block structure, inside one
+    XLA computation.
+    """
+
+    def check(self, program):
+        return int(self.attrs.get("k_steps", 1)) >= 1
+
+    def _apply_impl(self, main_program, startup_program, context):
+        k = int(self.attrs.get("k_steps", 1))
+        avg = bool(self.attrs.get("avg", True))
+        main_program._gradient_merge = {"k_steps": k, "avg": avg}
+        for block in main_program.blocks:
+            for op in block.ops:
+                if op.op_role == OpRole.Optimize:
+                    op.attrs["gradient_merge_k"] = k
+                    op.attrs["gradient_merge_avg"] = avg
+        context.attrs["gradient_merge"] = {"k_steps": k, "avg": avg}

@@ -133,6 +133,13 @@ def _lower(program: Program, feed_names, fetch_list):
     frozen = [p for p in params if p.stop_gradient]
     opt_state = {"s": None}
 
+    # Pass-recorded program attrs (distributed/passes.py): sharding layout and
+    # gradient accumulation — the executor is their single honoring point.
+    dist = getattr(program, "_dist_attrs", None)
+    gm = getattr(program, "_gradient_merge", None)
+    k_steps = int(gm["k_steps"]) if gm else 1
+    gm_avg = bool(gm.get("avg", True)) if gm else True
+
     def loss_fn(train_arrays, frozen_arrays, feed_arrays, key):
         all_arrays = _merge(params, trainable, frozen, train_arrays, frozen_arrays)
         env = replay(feed_arrays, all_arrays, key)
@@ -142,28 +149,93 @@ def _lower(program: Program, feed_names, fetch_list):
         return loss.astype(jnp.float32), env
 
     @jax.jit
-    def train_step(train_arrays, frozen_arrays, feed_arrays, key, opt_st, lr):
+    def train_step(train_arrays, frozen_arrays, feed_arrays, key, opt_st, lr,
+                   gm_state):
         (loss, env), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             train_arrays, frozen_arrays, feed_arrays, key
         )
+        if k_steps > 1:
+            # gradient merge (reference auto_parallel_gradient_merge.py:1 —
+            # cond-guarded optimizer update on accumulated grads)
+            count, acc = gm_state
+            acc = [a + g for a, g in zip(acc, grads)]
+            count = count + 1
+
+            def do_update(_):
+                eff = [a / k_steps for a in acc] if gm_avg else acc
+                pd = {str(i): a for i, a in enumerate(train_arrays)}
+                gd = {str(i): g for i, g in enumerate(eff)}
+                new_p, new_st = optimizer.functional_update(pd, gd, opt_st, lr)
+                return ([new_p[str(i)] for i in range(len(train_arrays))],
+                        new_st, jnp.zeros((), jnp.int32),
+                        [jnp.zeros_like(a) for a in acc])
+
+            def no_update(_):
+                return list(train_arrays), opt_st, count, acc
+
+            new_list, new_st, count, acc = jax.lax.cond(
+                count >= k_steps, do_update, no_update, None)
+            return loss, new_list, new_st, (count, acc), get_fetches(env)
         pd = {str(i): a for i, a in enumerate(train_arrays)}
         gd = {str(i): g for i, g in enumerate(grads)}
         new_p, new_st = optimizer.functional_update(pd, gd, opt_st, lr)
         new_list = [new_p[str(i)] for i in range(len(train_arrays))]
-        return loss, new_list, new_st, get_fetches(env)
+        return loss, new_list, new_st, gm_state, get_fetches(env)
+
+    def _place_state():
+        """Lay out params/opt-state per the sharding pass's recorded attrs."""
+        if dist is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..distributed.fleet.hybrid_train import _zero_spec
+
+        mesh = dist["mesh"]
+        axis = dist.get("axis", "sharding")
+        stage = int(dist.get("stage", 1))
+        specs = dist.get("param_specs", {})
+        for p in trainable:
+            spec = specs.get(p.name)
+            if spec is None and stage >= 3:
+                spec = _zero_spec(tuple(int(s) for s in np.shape(p._value)),
+                                  mesh, axis)
+            if spec is not None:
+                p._value = jax.device_put(
+                    p._value, NamedSharding(mesh, P(*spec) if not isinstance(
+                        spec, P) else spec))
+        if opt_state["s"] is not None and stage >= 1:
+            def place_slot(a):
+                spec = _zero_spec(tuple(np.shape(a)), mesh, axis)
+                return jax.device_put(a, NamedSharding(mesh, spec))
+
+            st = opt_state["s"]
+            st["slots"] = jax.tree_util.tree_map(place_slot, st["slots"])
+
+    gm_buf = {"s": None}
+    # introspection handles (dist-pass tests check layouts through these)
+    program._opt_state_ref = opt_state
+    program._gm_ref = gm_buf
 
     def runner(feed_arrays):
+        first = opt_state["s"] is None
+        if first:
+            opt_state["s"] = optimizer.functional_init(
+                {str(i): a for i, a in enumerate(p._value for p in trainable)}
+            )
+            _place_state()  # shard params/slots FIRST so the accumulators
+            if k_steps > 1:  # below inherit the ZeRO layout via zeros_like
+                gm_buf["s"] = (jnp.zeros((), jnp.int32),
+                               [jnp.zeros_like(p._value) for p in trainable])
         ta = [p._value for p in trainable]
         fa = [p._value for p in frozen]
-        if opt_state["s"] is None:
-            opt_state["s"] = optimizer.functional_init(
-                {str(i): a for i, a in enumerate(ta)}
-            )
         lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
-        loss, new_ta, new_st, fetches = train_step(
-            ta, fa, feed_arrays, rng_mod.next_rng_key(), opt_state["s"], lr
+        loss, new_ta, new_st, new_gm, fetches = train_step(
+            ta, fa, feed_arrays, rng_mod.next_rng_key(), opt_state["s"], lr,
+            gm_buf["s"] if k_steps > 1 else (),
         )
         opt_state["s"] = new_st
+        if k_steps > 1:
+            gm_buf["s"] = new_gm
         for p, a in zip(trainable, new_ta):
             p._value = a
         # loss fetch may be among fetch_list already; return fetches as-is

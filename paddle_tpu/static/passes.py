@@ -147,6 +147,8 @@ class AMPO2Pass(PassBase):
 
         for block in main_program.blocks:
             for op in block.ops:
+                if "amp" in op.attrs:
+                    continue  # idempotent: the attr records the applied policy
                 base = op.type.split("/")[-1]
                 if base in _AMP_WHITELIST:
                     op.fn = wrap(op.fn, "white")

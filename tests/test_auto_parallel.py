@@ -94,6 +94,102 @@ def test_planner_regimes():
     assert cm.all_reduce(1 << 30, 1) == 0.0
 
 
+def _tiny_gpt(seed=42):
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2, num_heads=2,
+                    max_seq_len=8, dropout=0.0)
+    return GPTForCausalLM(cfg), cfg
+
+
+def test_complete_param_specs_infers_megatron_layout():
+    """Annotate only the column weights + embedding; completion must infer the
+    row-parallel fc2 and the 'mp' biases through the traced graph (the
+    dist_matmul rule run in reverse — reference completion.py fixpoint)."""
+    from paddle_tpu.distributed.auto_parallel import complete_param_specs
+
+    m, cfg = _tiny_gpt()
+    for name, p in m.named_parameters():
+        if name.endswith("qkv_proj.weight") or name.endswith("fc1.weight"):
+            p._sharding_spec = (None, "mp")
+        if name.endswith("wte.weight"):
+            p._sharding_spec = ("mp", None)
+    ids = np.random.randint(0, 64, (2, 8)).astype(np.int32)
+    specs = complete_param_specs(m, [ids])
+    got = {k: tuple(v) for k, v in specs.items()}
+    for blk in (0, 1):
+        assert got[f"gpt.blocks.{blk}.mlp.fc2.weight"] == ("mp", None)
+        assert got[f"gpt.blocks.{blk}.mlp.fc1.bias"] == ("mp",)
+        assert got[f"gpt.blocks.{blk}.attn.qkv_proj.bias"] == ("mp",)
+
+
+def test_partitioner_validates_and_relaxes():
+    from paddle_tpu.distributed.auto_parallel import Partitioner
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(2, 4), ("dp", "mp"))
+    part = Partitioner(mesh)
+    # divisible: kept
+    assert tuple(part.validate_spec((8, 16), (None, "mp"))) == (None, "mp")
+    # non-divisible dim: relaxed to replicated, not an error
+    assert tuple(part.validate_spec((8, 6), (None, "mp"))) == (None, None)
+    # unknown axis: relaxed
+    assert tuple(part.validate_spec((8, 16), (None, "nope"))) == (None, None)
+
+
+def test_resharder_cross_spec_and_noop():
+    from paddle_tpu.distributed.auto_parallel import Resharder
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()), ("x",))
+    r = Resharder()
+    t = paddle.to_tensor(np.arange(64, dtype=np.float32).reshape(8, 8))
+    moved = r.apply(t, NamedSharding(mesh, P("x", None)))
+    assert r.log[-1][0] == "device_put"
+    again = r.apply(moved, NamedSharding(mesh, P("x", None)))
+    assert r.log[-1][0] == "noop"
+    np.testing.assert_array_equal(np.asarray(again._value),
+                                  np.arange(64, dtype=np.float32).reshape(8, 8))
+
+
+def test_engine_completion_matches_manual_megatron_loss():
+    """VERDICT r3 done-criterion: Engine.fit with partial annotations +
+    completion produces exactly the same losses as apply_megatron_specs."""
+    from paddle_tpu.distributed.fleet.meta_parallel import apply_megatron_specs
+
+    pm = ProcessMesh(np.arange(8).reshape(2, 4), dim_names=["dp", "mp"])
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 64, (4, 8)).astype(np.int32)
+    batches = [(ids, ids)] * 3
+
+    def lm_loss(logits, labels):
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, 64]), labels.reshape([-1]).astype("int64"))
+
+    def run(annotate):
+        m, cfg = _tiny_gpt(seed=7)
+        annotate(m)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        eng = Engine(model=m, loss=lm_loss, optimizer=opt, process_mesh=pm)
+        eng.prepare(inputs_spec=[jax.ShapeDtypeStruct((4, 8), np.int32)])
+        hist = eng.fit(batches, epochs=1, log_freq=1)
+        return hist["loss"]
+
+    losses_manual = run(lambda m: apply_megatron_specs(m))
+
+    def partial_annotations(m):
+        for name, p in m.named_parameters():
+            if name.endswith("qkv_proj.weight") or name.endswith("fc1.weight"):
+                p._sharding_spec = (None, "mp")
+            if name.endswith("wte.weight"):
+                p._sharding_spec = ("mp", None)
+
+    losses_completed = run(partial_annotations)
+    assert losses_manual == pytest.approx(losses_completed, rel=1e-6), (
+        losses_manual, losses_completed)
+
+
 def test_engine_fit_evaluate_predict(tmp_path):
     paddle.seed(42)
     model = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
