@@ -1,0 +1,80 @@
+"""SelectedRows: row-sparse gradient value type.
+
+Reference analog: `phi::SelectedRows` (/root/reference/paddle/phi/core/
+selected_rows.h:1) — a {rows, value, height} triple produced by embedding-style
+backward so a [vocab, hidden] dense gradient never materializes; optimizers
+consume it with row-wise (lazy) updates.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        self.value = jnp.asarray(value)
+        if self.value.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"SelectedRows: {self.rows.shape[0]} rows vs "
+                f"value dim0 {self.value.shape[0]}")
+        self.height = int(height)
+
+    # ------------------------------------------------------------- properties
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    @property
+    def nbytes(self):
+        return self.value.nbytes + self.rows.nbytes
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, n_rows="
+                f"{self.rows.shape[0]}, value_shape={tuple(self.value.shape)})")
+
+    # ------------------------------------------------------------- operations
+    def merged(self) -> "SelectedRows":
+        """Coalesce duplicate rows by summation (segment-sum). Eager-only:
+        uses host unique for the row set (reference MergeAdd kernel)."""
+        rows_np = np.asarray(self.rows)
+        uniq, inv = np.unique(rows_np, return_inverse=True)
+        if uniq.shape[0] == rows_np.shape[0]:
+            return self
+        import jax
+
+        merged = jax.ops.segment_sum(self.value, jnp.asarray(inv),
+                                     num_segments=uniq.shape[0])
+        return SelectedRows(uniq, merged, self.height)
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.value.dtype)
+        return dense.at[self.rows].add(self.value)
+
+    def scale(self, s) -> "SelectedRows":
+        return SelectedRows(self.rows, self.value * s, self.height)
+
+    def astype(self, dtype) -> "SelectedRows":
+        return SelectedRows(self.rows, self.value.astype(dtype), self.height)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.value, other.value]),
+                self.height,
+            )
+        # dense + sparse -> dense scatter-add
+        return jnp.asarray(other).at[self.rows].add(
+            self.value.astype(jnp.asarray(other).dtype))
+
+    __radd__ = __add__

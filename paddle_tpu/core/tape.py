@@ -139,8 +139,15 @@ def backward(tensor, grad=None, retain_graph=False):
                     if not t.stop_gradient:
                         t._accumulate_grad(ct)
                 else:
+                    from .selected_rows import SelectedRows
+
                     prev = cts.get(id(t))
-                    cts[id(t)] = ct if prev is None else prev + ct
+                    if prev is None:
+                        cts[id(t)] = ct
+                    elif isinstance(ct, SelectedRows):
+                        cts[id(t)] = ct + prev  # SR+SR concat / SR+dense dense
+                    else:
+                        cts[id(t)] = prev + ct
                     if not t.stop_gradient and t._retain_grad:
                         t._accumulate_grad(ct)
         if not retain_graph:

@@ -132,10 +132,20 @@ class Tensor:
         self._retain_grad = True
 
     def _accumulate_grad(self, ct):
+        from .selected_rows import SelectedRows
+
         if self.grad is None:
-            g = Tensor(ct, stop_gradient=True)
+            if isinstance(ct, SelectedRows):
+                # row-sparse grad (embedding sparse=True): keep it sparse —
+                # Tensor.__init__ would densify [vocab, hidden]
+                g = Tensor(np.zeros((), np.float32), stop_gradient=True)
+                g._value = ct
+            else:
+                g = Tensor(ct, stop_gradient=True)
             g.name = (self.name or "tensor") + "@GRAD"
             self.grad = g
+        elif isinstance(ct, SelectedRows):
+            self.grad._value = ct + self.grad._value
         else:
             self.grad._value = self.grad._value + ct
 

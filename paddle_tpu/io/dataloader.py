@@ -1,16 +1,25 @@
 """DataLoader (reference: python/paddle/fluid/reader.py:273 +
-dataloader/dataloader_iter.py:147).
+dataloader/dataloader_iter.py:147 single-process & :341 multiprocess).
 
-Design: N worker threads (numpy collation releases the GIL for the heavy copies)
-feed a bounded blocking queue; the C++ SPMC queue from paddle_tpu.runtime backs it
-when available. Workers produce numpy batches; conversion to device Tensors
-happens in the consumer so jax stays single-threaded per device.
+Two accelerated paths:
+- threads (use_shared_memory=False or as fallback): numpy collation releases
+  the GIL for the heavy copies; fine for IO-bound datasets.
+- processes (num_workers>0, the default like the reference's
+  _DataLoaderIterMultiProcess): fork workers that fetch+collate to numpy and
+  hand batches to the parent through POSIX shared memory — one shm block per
+  batch, (name, offsets, dtypes) over a small result queue. Python-heavy
+  augmentation pipelines scale with cores instead of serializing on the GIL.
+  Workers never touch jax; conversion to device Tensors happens in the consumer
+  so jax stays single-threaded per device.
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing as _mp
 import queue as _pyqueue
 import threading
+import traceback
+from multiprocessing import shared_memory as _shm
 
 import numpy as np
 
@@ -19,19 +28,26 @@ from .dataset import IterableDataset
 from .sampler import BatchSampler
 
 
-def default_collate_fn(batch):
+def _collate_with(batch, leaf):
+    """One collation recursion; `leaf` wraps the stacked numpy result
+    (Tensor for the consumer-side default, identity for workers)."""
     sample = batch[0]
     if isinstance(sample, (list, tuple)):
-        return [default_collate_fn([b[i] for b in batch]) for i in range(len(sample))]
+        return [_collate_with([b[i] for b in batch], leaf)
+                for i in range(len(sample))]
     if isinstance(sample, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+        return {k: _collate_with([b[k] for b in batch], leaf) for k in sample}
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(b._value) for b in batch]))
+        return leaf(np.stack([np.asarray(b._value) for b in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return leaf(np.stack(batch))
     if isinstance(sample, (int, float, np.number)):
-        return Tensor(np.asarray(batch))
+        return leaf(np.asarray(batch))
     return batch
+
+
+def default_collate_fn(batch):
+    return _collate_with(batch, Tensor)
 
 
 def _to_tensor_tree(obj):
@@ -55,6 +71,8 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = max(2, prefetch_factor)
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self.timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -81,6 +99,8 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_single()
+        if self.use_shared_memory:
+            return self._iter_multiprocess()
         return self._iter_threaded()
 
     def _fetch(self, indices):
@@ -158,3 +178,205 @@ class DataLoader:
         finally:
             stop.set()
             out_q.close()
+
+    # ----------------------------------------------------- multiprocess path
+    def _iter_multiprocess(self):
+        """Fork worker processes; batches come back through shared memory
+        (reference: dataloader_iter.py:341 _DataLoaderIterMultiProcess with its
+        shared-memory LoDTensor channel)."""
+        ctx = _mp.get_context("fork")
+        idx_q = ctx.Queue()
+        res_q = ctx.Queue()
+        batches = list(self.batch_sampler)
+        n_batches = len(batches)
+        # bounded prefetch: only num_workers*prefetch_factor index tuples are
+        # outstanding, so at most that many shm batches exist at once (the
+        # threaded path's BlockingQueue capacity, kept here for /dev/shm)
+        window = self.num_workers * self.prefetch_factor
+        feed_iter = iter(enumerate(batches))
+        outstanding = 0
+
+        def feed_one():
+            nonlocal outstanding
+            task = next(feed_iter, None)
+            if task is None:
+                idx_q.put(None)
+            else:
+                idx_q.put((task[0], list(task[1])))
+                outstanding += 1
+
+        for _ in range(min(window, n_batches) + (0 if n_batches else 1)):
+            feed_one()
+
+        collate = (None if self.collate_fn is default_collate_fn
+                   else self.collate_fn)
+        procs = [
+            ctx.Process(
+                target=_mp_worker_loop,
+                args=(self.dataset, collate, idx_q, res_q,
+                      self.worker_init_fn, wid),
+                daemon=True,
+            )
+            for wid in range(self.num_workers)
+        ]
+        for p in procs:
+            p.start()
+
+        user_timeout = self.timeout if self.timeout and self.timeout > 0 else None
+        reorder: dict[int, object] = {}
+        try:
+            next_idx = 0
+            while next_idx < n_batches:
+                while next_idx in reorder:
+                    item = reorder.pop(next_idx)
+                    feed_one()
+                    yield item
+                    next_idx += 1
+                if next_idx >= n_batches:
+                    break
+                try:
+                    # poll: keep waiting as long as workers are alive (the
+                    # reference blocks indefinitely unless the user set timeout)
+                    i, shm_name, payload = res_q.get(
+                        timeout=user_timeout if user_timeout else 5.0)
+                except _pyqueue.Empty:
+                    if user_timeout:
+                        raise RuntimeError(
+                            f"DataLoader worker(s) timed out after "
+                            f"{user_timeout}s")
+                    if not any(p.is_alive() for p in procs):
+                        raise RuntimeError(
+                            "all DataLoader workers died without producing "
+                            f"batch {next_idx}")
+                    continue
+                outstanding -= 1
+                if shm_name is None:  # worker exception: payload is traceback
+                    raise RuntimeError(f"DataLoader worker failed:\n{payload}")
+                data = _read_shm_batch(shm_name, payload)
+                if i == next_idx:
+                    feed_one()
+                    yield data
+                    next_idx += 1
+                else:
+                    reorder[i] = data
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+            # drain pending results and unlink their shm segments — workers
+            # create untracked, so nothing else would ever reclaim them
+            while True:
+                try:
+                    _, shm_name, _ = res_q.get_nowait()
+                except (_pyqueue.Empty, OSError, ValueError):
+                    break
+                if shm_name is not None:
+                    try:
+                        seg = _shm.SharedMemory(name=shm_name)
+                        seg.close()
+                        seg.unlink()
+                    except FileNotFoundError:
+                        pass
+            idx_q.close()
+            res_q.close()
+
+
+# ------------------------------------------------- multiprocess worker helpers
+def _shm_untracked(*args, **kwargs):
+    """Open a SharedMemory segment WITHOUT resource-tracker registration.
+
+    The parent explicitly unlinks every segment after reading it; letting both
+    the worker (create) and parent (attach) register with the shared tracker
+    process races its cache and spews KeyError/leak warnings at shutdown
+    (fixed upstream by track=False in 3.13; this is the 3.12 equivalent)."""
+    from multiprocessing import resource_tracker
+
+    orig = resource_tracker.register
+    resource_tracker.register = lambda *a, **k: None
+    try:
+        return _shm.SharedMemory(*args, **kwargs)
+    finally:
+        resource_tracker.register = orig
+
+
+def _np_collate(batch):
+    """Collate to numpy only — workers must never touch jax."""
+    return _collate_with(batch, lambda a: a)
+
+
+def _tree_flatten_np(obj, flat):
+    """Nested list/dict of arrays -> (structure with leaf indices, flat list)."""
+    if isinstance(obj, (list, tuple)):
+        return [_tree_flatten_np(v, flat) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _tree_flatten_np(v, flat) for k, v in obj.items()}
+    if isinstance(obj, Tensor):
+        flat.append(np.asarray(obj._value))
+        return ("__leaf__", len(flat) - 1)
+    if isinstance(obj, np.ndarray):
+        flat.append(obj)
+        return ("__leaf__", len(flat) - 1)
+    return ("__const__", obj)
+
+
+def _tree_unflatten(struct, leaves):
+    if isinstance(struct, list):
+        return [_tree_unflatten(v, leaves) for v in struct]
+    if isinstance(struct, dict):
+        return {k: _tree_unflatten(v, leaves) for k, v in struct.items()}
+    if isinstance(struct, tuple) and len(struct) == 2 and struct[0] == "__leaf__":
+        return leaves[struct[1]]
+    if isinstance(struct, tuple) and len(struct) == 2 and struct[0] == "__const__":
+        return struct[1]
+    return struct
+
+
+def _mp_worker_loop(dataset, collate, idx_q, res_q, init_fn, wid):
+    if init_fn is not None:
+        init_fn(wid)
+    while True:
+        task = idx_q.get()
+        if task is None:
+            break
+        i, indices = task
+        try:
+            batch = [dataset[j] for j in indices]
+            data = collate(batch) if collate is not None else _np_collate(batch)
+            if isinstance(data, Tensor):  # user collate returned Tensors
+                data = np.asarray(data._value)
+            flat: list = []
+            struct = _tree_flatten_np(data, flat)
+            total = sum(a.nbytes for a in flat)
+            shm = _shm_untracked(create=True, size=max(total, 1))
+            metas = []
+            off = 0
+            for a in flat:
+                a = np.ascontiguousarray(a)
+                view = np.ndarray(a.shape, a.dtype, buffer=shm.buf, offset=off)
+                view[...] = a
+                metas.append((tuple(a.shape), a.dtype.str, off))
+                off += a.nbytes
+            res_q.put((i, shm.name, (struct, metas)))
+            shm.close()  # the parent owns unlink
+        except Exception:  # noqa: BLE001 — full traceback to the parent
+            res_q.put((i, None, traceback.format_exc()))
+
+
+def _read_shm_batch(shm_name, payload):
+    struct, metas = payload
+    # tracked attach: unlink() below sends the matching unregister, so the
+    # parent's tracker stays balanced (the worker side is the untracked one)
+    shm = _shm.SharedMemory(name=shm_name)
+    try:
+        leaves = []
+        for shape, dtype, off in metas:
+            view = np.ndarray(shape, np.dtype(dtype), buffer=shm.buf, offset=off)
+            leaves.append(np.array(view))  # copy out before unlink
+    finally:
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    return _to_tensor_tree(_tree_unflatten(struct, leaves))

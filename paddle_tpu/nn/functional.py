@@ -610,7 +610,45 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             out = jnp.where(mask, 0.0, out)
         return out
 
-    return primitive_call(f, _t(x).detach(), _t(weight), name="embedding")
+    xt, wt = _t(x).detach(), _t(weight)
+    from ..core import tape as tape_mod
+
+    if (sparse and tape_mod.is_grad_enabled() and not wt.stop_gradient
+            and not isinstance(wt._value, jax.core.Tracer)):
+        return _sparse_embedding(xt, wt, padding_idx, f)
+    return primitive_call(f, xt, wt, name="embedding")
+
+
+def _sparse_embedding(xt, wt, padding_idx, fwd):
+    """Eager embedding whose backward emits a SelectedRows gradient
+    (reference: embedding op is_sparse=True -> SelectedRows W@GRAD,
+    phi/core/selected_rows.h:1) — the [vocab, hidden] dense grad never
+    materializes. Under jit tracing this path is bypassed (XLA scatter-add
+    is fused there anyway)."""
+    from ..core import tape as tape_mod
+    from ..core.selected_rows import SelectedRows
+
+    idx_arr = xt._value
+    out_val = fwd(idx_arr, wt._value)
+    vocab = int(wt._value.shape[0])
+
+    def vjp_fn(g):
+        rows = idx_arr.reshape(-1).astype(jnp.int32)
+        vals = g.reshape(-1, g.shape[-1]).astype(wt._value.dtype)
+        if padding_idx is not None:
+            keep = rows != padding_idx
+            vals = jnp.where(keep[:, None], vals, 0.0)
+        return ((SelectedRows(rows, vals, vocab),),)
+
+    out = Tensor(out_val, stop_gradient=False)
+    node = tape_mod.make_node(
+        vjp_fn, [[wt]], [out],
+        [jax.ShapeDtypeStruct(out_val.shape, out_val.dtype)],
+        is_tuple_out=False, name="embedding_sparse_grad",
+    )
+    out._tape_node = node
+    out._out_index = 0
+    return out
 
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):

@@ -102,6 +102,7 @@ class Embedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = sparse
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0),
@@ -112,7 +113,8 @@ class Embedding(Layer):
             self.weight._value = self.weight._value.at[padding_idx].set(0.0)
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
         return f"{self._num_embeddings}, {self._embedding_dim}"

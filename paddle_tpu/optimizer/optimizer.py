@@ -69,16 +69,42 @@ class Optimizer:
             self._slots[key] = slots
         return self._slots[key]
 
+    def _apply_sparse(self, p_val, sr, slots, lr, step):
+        """Row-wise update from a SelectedRows grad. Subclasses with a lazy
+        sparse rule override (reference: adam/sgd SelectedRows kernels,
+        phi/kernels/selected_rows/); the base class densifies as a correct-but-
+        memory-costly fallback."""
+        import warnings
+
+        warnings.warn(
+            f"{type(self).__name__} has no sparse update rule; densifying a "
+            f"SelectedRows grad of shape {sr.shape}", stacklevel=3)
+        return self._apply_dense(p_val, sr.to_dense().astype(p_val.dtype),
+                                 slots, lr, step)
+
     # ------------------------------------------------------------ eager step
     def step(self):
+        from ..core.selected_rows import SelectedRows
+
         self._step_count += 1
         params = [p for p in self._parameter_list if not p.stop_gradient and p.grad is not None]
-        if self._grad_clip is not None:
-            grads = self._grad_clip.apply([p.grad._value for p in params], [p._value for p in params])
-        else:
-            grads = [p.grad._value for p in params]
+        dense = [(p, p.grad._value) for p in params
+                 if not isinstance(p.grad._value, SelectedRows)]
+        sparse = [(p, p.grad._value.merged()) for p in params
+                  if isinstance(p.grad._value, SelectedRows)]
+        if self._grad_clip is not None and (dense or sparse):
+            # SelectedRows participate in the clip via their (coalesced) value
+            # block, so the global norm includes the embedding contribution
+            # and the sparse grad is scaled like every other
+            n_dense = len(dense)
+            clipped = self._grad_clip.apply(
+                [g for _, g in dense] + [sr.value for _, sr in sparse],
+                [p._value for p, _ in dense] + [p._value for p, _ in sparse])
+            dense = [(p, g) for (p, _), g in zip(dense, clipped[:n_dense])]
+            sparse = [(p, SelectedRows(sr.rows, v, sr.height))
+                      for (p, sr), v in zip(sparse, clipped[n_dense:])]
         lr = self.get_lr()
-        for p, g in zip(params, grads):
+        for p, g in dense:
             if g is None:
                 continue
             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
@@ -86,6 +112,28 @@ class Optimizer:
             g = self._apply_weight_decay_to_grad(p, g)
             target = slots.get("master_weight", p._value)
             new_p, new_slots = self._apply_dense(target, g.astype(target.dtype), slots, plr, self._step_count)
+            if "master_weight" in slots:
+                new_slots["master_weight"] = new_p
+                p._value = new_p.astype(p._value.dtype)
+            else:
+                p._value = new_p
+            self._slots[id(p)] = new_slots
+        for p, sr in sparse:
+            plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            slots = self._get_slots(p)
+            target = slots.get("master_weight", p._value)
+            wd = self._param_wd(p)
+            if wd and not self._decoupled_wd:
+                # lazy L2: fold wd*p into the touched rows only (untouched
+                # rows see no decay this step — the row-sparse analog of the
+                # dense fold; the reference skips sparse regularization
+                # entirely with a warning)
+                sr = SelectedRows(
+                    sr.rows,
+                    sr.value + wd * target[sr.rows].astype(sr.value.dtype),
+                    sr.height)
+            new_p, new_slots = self._apply_sparse(
+                target, sr, slots, plr, self._step_count)
             if "master_weight" in slots:
                 new_slots["master_weight"] = new_p
                 p._value = new_p.astype(p._value.dtype)

@@ -19,6 +19,11 @@ class SGD(Optimizer):
     def _apply_dense(self, p, g, slots, lr, step):
         return p - lr * g, {}
 
+    def _apply_sparse(self, p, sr, slots, lr, step):
+        # row-wise scatter-sub (reference: sgd SelectedRows kernel,
+        # phi/kernels/selected_rows/) — touches only the looked-up rows
+        return p.at[sr.rows].add(-lr * sr.value.astype(p.dtype)), {}
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -48,6 +53,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
 
     def _slot_init(self, v):
         f32 = jnp.float32 if v.dtype != jnp.float64 else v.dtype
@@ -66,6 +72,30 @@ class Adam(Optimizer):
         m_hat = m / bc1
         v_hat = v / bc2
         new_p = p - (lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)).astype(p.dtype)
+        return new_p, {"moment1": m, "moment2": v}
+
+    def _apply_sparse(self, p, sr, slots, lr, step):
+        """SelectedRows adam (reference: adam SelectedRows kernel). lazy_mode
+        touches only the looked-up rows; the default (non-lazy, matching dense
+        semantics exactly) decays every row's moments and updates every row —
+        the GRAD stays sparse either way, which is the memory that matters."""
+        rows = sr.rows
+        g32 = sr.value.astype(slots["moment1"].dtype)
+        step_f = jnp.asarray(step, jnp.float32)
+        bc1 = 1 - self._beta1**step_f
+        bc2 = 1 - self._beta2**step_f
+        if self._lazy_mode:
+            m_rows = self._beta1 * slots["moment1"][rows] + (1 - self._beta1) * g32
+            v_rows = self._beta2 * slots["moment2"][rows] + (1 - self._beta2) * (g32 * g32)
+            upd = lr * (m_rows / bc1) / (jnp.sqrt(v_rows / bc2) + self._epsilon)
+            new_p = p.at[rows].add(-upd.astype(p.dtype))
+            return new_p, {"moment1": slots["moment1"].at[rows].set(m_rows),
+                           "moment2": slots["moment2"].at[rows].set(v_rows)}
+        # non-lazy: identical to dense adam with a grad that is zero off-rows
+        m = (self._beta1 * slots["moment1"]).at[rows].add((1 - self._beta1) * g32)
+        v = (self._beta2 * slots["moment2"]).at[rows].add(
+            (1 - self._beta2) * (g32 * g32))
+        new_p = p - (lr * (m / bc1) / (jnp.sqrt(v / bc2) + self._epsilon)).astype(p.dtype)
         return new_p, {"moment1": m, "moment2": v}
 
 

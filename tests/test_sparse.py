@@ -1,0 +1,126 @@
+"""Sparse tensor + SelectedRows tests (VERDICT r3 item 6).
+
+Reference analogs: python/paddle/sparse/ ops, phi/core/selected_rows.h, the
+embedding is_sparse=True -> SelectedRows W@GRAD path, and the sgd/adam
+SelectedRows kernels (lazy row updates).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, sparse
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+def _coo(dense):
+    idx = np.argwhere(dense != 0)
+    vals = dense[dense != 0]
+    return sparse.sparse_coo_tensor(idx.T, vals, dense.shape)
+
+
+def test_coo_tensor_is_lazy():
+    """Construction must NOT densify (old ctor called .todense())."""
+    dense = np.zeros((1000, 1000), np.float32)
+    dense[3, 7] = 2.0
+    dense[500, 1] = -1.0
+    t = _coo(dense)
+    from jax.experimental.sparse import BCOO
+
+    assert isinstance(t._value, BCOO), "constructor densified the COO tensor"
+    assert t.nnz() == 2
+    assert t._value.data.nbytes + t._value.indices.nbytes < 100  # no 4MB dense
+    np.testing.assert_allclose(t.to_dense().numpy(), dense)
+
+
+def test_coo_matmul_and_ops():
+    rng = np.random.RandomState(0)
+    dense = np.where(rng.rand(16, 8) > 0.7, rng.randn(16, 8), 0).astype(np.float32)
+    y = rng.randn(8, 4).astype(np.float32)
+    t = _coo(dense)
+    np.testing.assert_allclose(sparse.matmul(t, y).numpy(), dense @ y, rtol=1e-5)
+    s = sparse.add(t, t)
+    np.testing.assert_allclose(s.to_dense().numpy(), 2 * dense, rtol=1e-6)
+    r = sparse.relu(_coo(-dense))
+    np.testing.assert_allclose(r.to_dense().numpy(), np.maximum(-dense, 0), rtol=1e-6)
+    m = sparse.multiply(t, t)
+    np.testing.assert_allclose(m.to_dense().numpy(), dense * dense, rtol=1e-5)
+
+
+def test_csr_roundtrip():
+    dense = np.array([[1, 0, 2], [0, 0, 3]], np.float32)
+    crows, cols, vals = [0, 2, 3], [0, 2, 2], [1.0, 2.0, 3.0]
+    t = sparse.sparse_csr_tensor(crows, cols, vals, [2, 3])
+    np.testing.assert_allclose(t.to_dense().numpy(), dense)
+
+
+def test_selected_rows_merge_and_dense():
+    sr = SelectedRows([2, 0, 2], np.array([[1., 1.], [2., 2.], [3., 3.]]), height=4)
+    m = sr.merged()
+    assert m.rows.shape[0] == 2
+    dense = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(dense[2], [4., 4.])
+    np.testing.assert_allclose(dense[0], [2., 2.])
+    np.testing.assert_allclose(dense[1], [0., 0.])
+    # SR + SR concat; SR + dense -> dense
+    both = sr + sr
+    assert isinstance(both, SelectedRows) and both.rows.shape[0] == 6
+    summed = sr + np.ones((4, 2), np.float32)
+    np.testing.assert_allclose(np.asarray(summed)[2], [5., 5.])
+
+
+def test_sparse_embedding_grad_never_dense():
+    """The VERDICT memory assertion: with sparse=True, no [vocab, hidden]
+    dense gradient materializes — W@GRAD is a SelectedRows over the looked-up
+    rows only."""
+    vocab, hidden = 50_000, 64
+    emb = nn.Embedding(vocab, hidden, sparse=True)
+    ids = paddle.to_tensor(np.array([[5, 9, 5], [100, 9, 7]], np.int64))
+    out = emb(ids)
+    loss = out.sum()
+    loss.backward()
+    g = emb.weight.grad._value
+    assert isinstance(g, SelectedRows), type(g)
+    assert g.value.shape == (6, hidden)
+    # the sparse grad is ~4 orders of magnitude smaller than the dense one
+    assert g.nbytes < vocab * hidden * 4 / 1000
+    np.testing.assert_array_equal(np.sort(np.asarray(g.rows)),
+                                  [5, 5, 7, 9, 9, 100])
+
+
+@pytest.mark.parametrize("opt_cls", ["SGD", "Adam"])
+def test_sparse_embedding_training_matches_dense(opt_cls):
+    """Lazy sparse update == dense update on the same data (small vocab)."""
+    def run(sparse_flag):
+        paddle.seed(123)
+        emb = nn.Embedding(50, 8, sparse=sparse_flag)
+        fc = nn.Linear(8, 4)
+        opt = getattr(paddle.optimizer, opt_cls)(
+            0.1, parameters=list(emb.parameters()) + list(fc.parameters()))
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 1]], np.int64))
+        lab = paddle.to_tensor(np.array([0, 3], np.int64))
+        losses = []
+        for _ in range(5):
+            logits = fc(emb(ids).mean(axis=1))
+            loss = nn.functional.cross_entropy(logits, lab)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses, emb.weight.numpy()
+
+    dense_losses, dense_w = run(False)
+    sparse_losses, sparse_w = run(True)
+    assert dense_losses == pytest.approx(sparse_losses, rel=1e-5)
+    np.testing.assert_allclose(dense_w, sparse_w, rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_grad_accumulates_across_backwards():
+    emb = nn.Embedding(20, 4, sparse=True)
+    ids = paddle.to_tensor(np.array([1, 2], np.int64))
+    emb(ids).sum().backward()
+    emb(ids).sum().backward()
+    g = emb.weight.grad._value
+    assert isinstance(g, SelectedRows)
+    dense = np.asarray(g.to_dense())
+    np.testing.assert_allclose(dense[1], 2.0)
+    np.testing.assert_allclose(dense[3], 0.0)
