@@ -84,7 +84,8 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
     cfg = GPTConfig(vocab_size=rung.get("vocab", 50304), hidden_size=rung["hidden"],
                     num_layers=rung["layers"], num_heads=rung["heads"],
                     max_seq_len=rung.get("seq", 1024), dropout=0.0,
-                    recompute=True, recompute_policy=rung["policy"])
+                    recompute=True, recompute_policy=rung["policy"],
+                    loss_chunk_size=int(os.environ.get("BENCH_LOSS_CHUNK", "512")))
     batch, seq = rung["batch"], rung.get("seq", 1024)
 
     paddle.seed(0)

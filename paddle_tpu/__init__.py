@@ -35,6 +35,7 @@ from .core import (  # noqa: F401
     to_tensor,
 )
 from .core.tape import is_grad_enabled  # noqa: F401
+from .core import memory  # noqa: F401 (allocator stats/flags surface)
 
 # ---- functional op surface (paddle.* functions)
 from .tensor_ops import *  # noqa: F401,F403

@@ -17,6 +17,6 @@ from .tables import DenseTable, SparseTable  # noqa: F401
 from .service import PsServer, PsClient  # noqa: F401
 from .role_maker import PaddleCloudRoleMaker, Role  # noqa: F401
 from .runtime import (  # noqa: F401
-    ThePS, DistEmbedding, get_ps_client, init_server, run_server, init_worker,
-    stop_worker, barrier_worker,
+    GeoSGD, ThePS, DistEmbedding, get_ps_client, init_server, run_server,
+    init_worker, stop_worker, barrier_worker,
 )

@@ -175,3 +175,55 @@ class ThePS:
                                        apply_now=True)
         self.model.clear_gradients()
         self.pull_dense()
+
+
+class GeoSGD:
+    """Geo-SGD communication mode (reference: the_one_ps.py:816 geo mode +
+    GeoCommunicator — strategy.a_sync_configs["k_steps"] > 0).
+
+    Workers train fully locally with their own optimizer; every `k_steps`
+    local steps the worker pushes the parameter DELTA (local - last-synced)
+    to the servers, which accumulate deltas from all workers, then pulls the
+    merged result back. Decouples workers for high-latency clusters at the
+    cost of bounded staleness.
+    """
+
+    def __init__(self, model: Layer, k_steps: int = 100):
+        self.model = model
+        self.k_steps = int(k_steps)
+        self.client = get_ps_client()
+        self._dense: list[tuple[str, Tensor]] = []
+        self._base: dict[str, np.ndarray] = {}
+        self._count = 0
+        for pname, p in model.named_parameters():
+            if p.stop_gradient:
+                continue
+            self._dense.append((pname, p))
+            # geo table: plain accumulation -> create with sgd lr=1.0 and push
+            # the negated delta (server does p -= lr * grad)
+            self.client.create_dense(pname, int(np.prod(p.shape)),
+                                     "sgd", 1.0,
+                                     init=p.numpy().reshape(-1)
+                                     if _get_role().is_first_worker() else None)
+        self.client.barrier()
+        self._pull_and_rebase()
+
+    def _pull_and_rebase(self):
+        import jax.numpy as jnp
+
+        for name, p in self._dense:
+            vals = self.client.pull_dense(name)
+            p._value = jnp.asarray(vals.reshape(p.shape))
+            self._base[name] = vals.copy()
+
+    def step(self):
+        """Call once per LOCAL optimizer step; syncs every k_steps."""
+        self._count += 1
+        if self._count % self.k_steps == 0:
+            self.sync()
+
+    def sync(self):
+        for name, p in self._dense:
+            delta = p.numpy().reshape(-1) - self._base[name]
+            self.client.push_dense(name, -delta, apply_now=True)
+        self._pull_and_rebase()

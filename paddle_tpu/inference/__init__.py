@@ -15,8 +15,8 @@ import pickle
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "get_version", "PrecisionType", "PlaceType"]
+__all__ = ["Config", "Predictor", "PredictorPool", "Tensor",
+           "create_predictor", "get_version", "PrecisionType", "PlaceType"]
 
 
 class PrecisionType:
@@ -135,17 +135,69 @@ class Predictor:
 
     def run(self, inputs=None):
         """With `inputs` (list of numpy arrays) returns list of numpy outputs;
-        without, uses the copy_from_cpu'd input handles (reference zero-copy API)."""
+        without, uses the copy_from_cpu'd input handles (reference zero-copy
+        API). Batch sizes other than the exported one are served by the
+        pad/chunk policy (the TPU answer to the reference's dynamic batch —
+        the compiled computation has static shapes)."""
         if inputs is not None:
             for h, a in zip(self._inputs.values(), inputs):
                 h.copy_from_cpu(a)
         feed = {n: h._value for n, h in self._inputs.items()}
-        outs = self._prog._exported_call(feed)
+        outs = self._run_dynamic_batch(feed)
         for h, o in zip(self._outputs.values(), outs):
             h._value = o
         if inputs is not None:
             return [np.asarray(o) for o in outs]
         return None
+
+    def _run_dynamic_batch(self, feed):
+        meta = self._prog._meta
+        # a feed participates in the batch dim iff its compiled dim 0 == b0 AND
+        # the caller passed a different leading size — others pass through whole
+        b0 = None
+        b_in = None
+        for name, shape in zip(meta["feed_names"], meta["feed_shapes"]):
+            if shape and int(np.shape(feed[name])[0]) != int(shape[0]):
+                b0 = int(shape[0])
+                b_in = int(np.shape(feed[name])[0])
+                break
+        if b0 is None:
+            return self._prog._exported_call(feed)
+        batched = {
+            name for name, shape in zip(meta["feed_names"], meta["feed_shapes"])
+            if shape and int(shape[0]) == b0
+            and int(np.shape(feed[name])[0]) == b_in
+        }
+        outs_parts = []
+        for lo in range(0, b_in, b0):
+            hi = min(b_in, lo + b0)
+            part = {}
+            valid = hi - lo
+            for name in meta["feed_names"]:
+                a = np.asarray(feed[name])
+                if name not in batched:
+                    part[name] = jnp.asarray(a)
+                    continue
+                chunk = a[lo:hi]
+                if valid < b0:  # pad the tail chunk up to the compiled batch
+                    pad = [(0, b0 - valid)] + [(0, 0)] * (a.ndim - 1)
+                    chunk = np.pad(chunk, pad)
+                part[name] = jnp.asarray(chunk, a.dtype)
+            part_outs = self._prog._exported_call(part)
+            outs_parts.append([np.asarray(o) for o in part_outs])
+        # an output is batched iff its dim 0 equals the compiled batch b0;
+        # others (scalars, weights echoed through) come from the first chunk
+        merged = []
+        tail_valid = b_in - (len(outs_parts) - 1) * b0
+        for i in range(len(outs_parts[0])):
+            o0 = outs_parts[0][i]
+            if np.ndim(o0) >= 1 and o0.shape[0] == b0:
+                parts = [p[i] for p in outs_parts]
+                parts[-1] = parts[-1][:tail_valid]
+                merged.append(np.concatenate(parts))
+            else:
+                merged.append(o0)
+        return merged
 
     def clear_intermediate_tensor(self):
         pass
@@ -156,6 +208,33 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class PredictorPool:
+    """reference: paddle_infer.PredictorPool (api/paddle_infer_contrib or
+    analysis_predictor Clone) — N serving handles over one loaded model.
+    Handles share the deserialized/compiled computation (cloning is cheap);
+    retrieve by index from worker threads."""
+
+    def __init__(self, config: Config, size: int = 1):
+        if size < 1:
+            raise ValueError("pool size must be >= 1")
+        first = Predictor(config)
+        self._preds = [first]
+        for _ in range(size - 1):
+            p = Predictor.__new__(Predictor)
+            p.config = config
+            p._prog = first._prog  # shared compiled computation
+            p._inputs = {n: Tensor(h.name, h._shape, h._dtype)
+                         for n, h in first._inputs.items()}
+            p._outputs = {n: Tensor(n) for n in first._outputs}
+            self._preds.append(p)
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._preds[idx]
+
+    def __len__(self):
+        return len(self._preds)
 
 
 def get_version() -> str:

@@ -24,3 +24,9 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .bucketing import (  # noqa: F401
+    LengthBucketSampler,
+    bucket_boundaries,
+    pad_sequence_batch,
+    pad_to_bucket,
+)

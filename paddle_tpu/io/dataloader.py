@@ -194,16 +194,13 @@ class DataLoader:
         # threaded path's BlockingQueue capacity, kept here for /dev/shm)
         window = self.num_workers * self.prefetch_factor
         feed_iter = iter(enumerate(batches))
-        outstanding = 0
 
         def feed_one():
-            nonlocal outstanding
             task = next(feed_iter, None)
             if task is None:
                 idx_q.put(None)
             else:
                 idx_q.put((task[0], list(task[1])))
-                outstanding += 1
 
         for _ in range(min(window, n_batches) + (0 if n_batches else 1)):
             feed_one()
@@ -222,8 +219,11 @@ class DataLoader:
         for p in procs:
             p.start()
 
+        import time as _time
+
         user_timeout = self.timeout if self.timeout and self.timeout > 0 else None
         reorder: dict[int, object] = {}
+        last_progress = _time.time()
         try:
             next_idx = 0
             while next_idx < n_batches:
@@ -244,12 +244,19 @@ class DataLoader:
                         raise RuntimeError(
                             f"DataLoader worker(s) timed out after "
                             f"{user_timeout}s")
-                    if not any(p.is_alive() for p in procs):
+                    dead = [p.pid for p in procs if not p.is_alive()]
+                    if len(dead) == len(procs):
                         raise RuntimeError(
                             "all DataLoader workers died without producing "
                             f"batch {next_idx}")
+                    if dead and _time.time() - last_progress > 30:
+                        # a dead worker may have taken this batch's index tuple
+                        # with it — without this check the loop polls forever
+                        raise RuntimeError(
+                            f"DataLoader stalled >30s waiting for batch "
+                            f"{next_idx} with dead worker(s) {dead}")
                     continue
-                outstanding -= 1
+                last_progress = _time.time()
                 if shm_name is None:  # worker exception: payload is traceback
                     raise RuntimeError(f"DataLoader worker failed:\n{payload}")
                 data = _read_shm_batch(shm_name, payload)

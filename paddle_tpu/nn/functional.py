@@ -611,10 +611,17 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         return out
 
     xt, wt = _t(x).detach(), _t(weight)
+    from ..core import dispatch as dispatch_mod
     from ..core import tape as tape_mod
 
+    static_build = (dispatch_mod._static_hook is not None
+                    and dispatch_mod._static_hook[0]((xt, wt)))
     if (sparse and tape_mod.is_grad_enabled() and not wt.stop_gradient
-            and not isinstance(wt._value, jax.core.Tracer)):
+            and not static_build  # program build records the dense op
+            and wt._tape_node is None  # leaf param: the tape can hold a
+            #   SelectedRows ct; an op-derived weight's upstream vjp cannot
+            and not isinstance(wt._value, jax.core.Tracer)
+            and not isinstance(xt._value, jax.core.Tracer)):
         return _sparse_embedding(xt, wt, padding_idx, f)
     return primitive_call(f, xt, wt, name="embedding")
 

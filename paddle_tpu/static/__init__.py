@@ -17,6 +17,7 @@ from .program import (  # noqa: F401
     name_scope,
     program_guard,
 )
+from .scope import Scope, scope_guard  # noqa: F401
 from .executor import CompiledProgram, Executor  # noqa: F401
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
 from .io import (  # noqa: F401

@@ -49,6 +49,11 @@ class Executor:
             v.numpy() if isinstance(v, Tensor) else v
         )) for k, v in feed.items()}
         outs = runner(feed_arrays)
+        if scope is not None:
+            # persist fetches into the caller's Scope (reference: executor
+            # fetch vars live in the scope, executor.py:1103 scope arg)
+            for f, o in zip(fetches, outs):
+                scope.set(getattr(f, "name", str(f)), o)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]

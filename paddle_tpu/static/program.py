@@ -271,24 +271,6 @@ def _static_active(args) -> bool:
 dispatch_mod._static_hook = (_static_active, _static_record)
 
 
-class Scope:
-    """reference: paddle/fluid/framework/scope.h:78 — name→value store."""
-
-    def __init__(self):
-        self._vars = {}
-
-    def var(self, name):
-        return self._vars.setdefault(name, None)
-
-    def find_var(self, name):
-        return self._vars.get(name)
-
-    def set(self, name, value):
-        self._vars[name] = value
-
-
-_global_scope = Scope()
-
-
-def global_scope():
-    return _global_scope
+# The hierarchical runtime Scope lives in static/scope.py
+# (reference: paddle/fluid/framework/scope.h:78).
+from .scope import Scope, global_scope, scope_guard  # noqa: E402,F401

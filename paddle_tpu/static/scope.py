@@ -1,0 +1,120 @@
+"""Hierarchical runtime Scope.
+
+Reference analog: framework::Scope (/root/reference/paddle/fluid/framework/
+scope.h:78) holding name -> Variable (variable.h:26), with parent-chain lookup
+(FindVar walks ancestors), child scopes (NewScope), and kid teardown
+(DropKids). The executors resolve every op's vars through a scope.
+
+TPU-native use: eager/jit paths don't need scopes (python closures carry
+state), but the static Executor honors one for feed/fetch persistence and the
+PS/dataset workers use child scopes per thread — same contract as the
+reference.
+"""
+from __future__ import annotations
+
+from ..core.errors import NotFoundError
+
+__all__ = ["Scope", "global_scope", "scope_guard"]
+
+
+class Scope:
+    def __init__(self, parent: "Scope | None" = None):
+        self._vars: dict[str, object] = {}
+        self._parent = parent
+        self._kids: list[Scope] = []
+
+    # ------------------------------------------------------------- variables
+    def var(self, name: str):
+        """Find-or-create in THIS scope (reference Scope::Var)."""
+        if name not in self._vars:
+            self._vars[name] = None
+        return _VarHandle(self, name)
+
+    def find_var(self, name: str):
+        """Walk up the parent chain (reference Scope::FindVar); None if absent."""
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return _VarHandle(s, name)
+            s = s._parent
+        return None
+
+    def erase(self, names):
+        for n in names if isinstance(names, (list, tuple)) else [names]:
+            self._vars.pop(n, None)
+
+    def local_var_names(self):
+        return sorted(self._vars)
+
+    def set(self, name, value):
+        self._vars[name] = value
+
+    def get(self, name):
+        s = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s._parent
+        raise NotFoundError(f"variable {name!r} not found in scope chain")
+
+    # ------------------------------------------------------------ hierarchy
+    def new_scope(self) -> "Scope":
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        for k in self._kids:
+            k.drop_kids()
+        self._kids.clear()
+
+    def parent(self):
+        return self._parent
+
+
+class _VarHandle:
+    """A named slot in a scope (reference framework::Variable): typed get/set."""
+
+    __slots__ = ("_scope", "_name")
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    @property
+    def name(self):
+        return self._name
+
+    def get_tensor(self):
+        return self._scope._vars.get(self._name)
+
+    def set_tensor(self, value):
+        self._scope._vars[self._name] = value
+
+    set_value = set_tensor
+
+    def is_initialized(self):
+        return self._scope._vars.get(self._name) is not None
+
+
+_global = Scope()
+_scope_stack = [_global]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[0] if len(_scope_stack) == 1 else _scope_stack[-1]
+
+
+class scope_guard:
+    """reference: paddle.static.scope_guard context manager."""
+
+    def __init__(self, scope: Scope):
+        self._scope = scope
+
+    def __enter__(self):
+        _scope_stack.append(self._scope)
+        return self._scope
+
+    def __exit__(self, *exc):
+        _scope_stack.pop()
+        return False

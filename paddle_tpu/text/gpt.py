@@ -31,6 +31,7 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     recompute: bool = False  # per-block rematerialization (jax.checkpoint)
     recompute_policy: str | None = None  # e.g. 'dots' = save MXU outputs only
+    loss_chunk_size: int = 256  # rows per chunk in the fused head+CE scan
 
     def __post_init__(self):
         if not self.ffn_hidden:
@@ -161,9 +162,12 @@ class GPTForCausalLM(nn.Layer):
             # Fused head+CE: scans vocab projection in sequence chunks so the
             # [b, s, vocab] logits (3.3 GB fp32 at b16/s1024/v50k) never hit HBM.
             if self.lm_head is not None:
-                return F.linear_cross_entropy(h, self.lm_head.weight, labels)
-            return F.linear_cross_entropy(h, self.gpt.wte.weight, labels,
-                                          transpose_y=True)
+                return F.linear_cross_entropy(
+                    h, self.lm_head.weight, labels,
+                    chunk_size=self.cfg.loss_chunk_size)
+            return F.linear_cross_entropy(
+                h, self.gpt.wte.weight, labels, transpose_y=True,
+                chunk_size=self.cfg.loss_chunk_size)
         if self.lm_head is not None:
             logits = self.lm_head(h)
         else:

@@ -1,0 +1,83 @@
+"""Out-of-tree custom operator registration.
+
+Reference analog: the custom-kernel plugin surface
+(/root/reference/paddle/phi/core/custom_kernel.h:25 CustomKernelMap +
+RegisterCustomKernels; python side paddle.utils.cpp_extension). There, vendors
+compile C++ kernels against the kernel registry ABI. Here the lowering language
+is pure JAX (jnp/lax/pallas), so an out-of-tree op is a pure function — this
+module gives it the same first-class treatment as built-ins: eager dispatch
+with tape recording, an optional custom VJP, static-graph capture (the op
+appears on the Program tape under its registered name), and a queryable
+registry.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.dispatch import primitive_call
+
+__all__ = ["register_op", "get_op", "registered_ops", "CustomOpError"]
+
+_REGISTRY: dict[str, object] = {}
+
+
+class CustomOpError(RuntimeError):
+    pass
+
+
+def register_op(name: str, forward=None, backward=None, override=False):
+    """Register `forward` (a pure jax function of array args) as framework op
+    `name`. Returns the dispatchable op (also usable as a decorator).
+
+    backward(residuals, *cotangents) semantics via jax.custom_vjp:
+        forward returns outputs; when `backward` is given, `forward` must also
+        be usable to recompute residuals — we save the inputs as residuals and
+        call backward(inputs_tuple, grad_out) -> tuple of input cotangents.
+    """
+
+    def _do_register(fwd):
+        if name in _REGISTRY and not override:
+            raise CustomOpError(
+                f"op {name!r} already registered; pass override=True to replace")
+        fn = fwd
+        if backward is not None:
+            wrapped = jax.custom_vjp(fwd)
+
+            def fwd_rule(*args):
+                return fwd(*args), args
+
+            def bwd_rule(residuals, g):
+                cts = backward(residuals, g)
+                if not isinstance(cts, (tuple, list)):
+                    cts = (cts,)
+                if len(cts) != len(residuals):
+                    raise CustomOpError(
+                        f"{name}: backward returned {len(cts)} cotangents for "
+                        f"{len(residuals)} inputs")
+                return tuple(cts)
+
+            wrapped.defvjp(fwd_rule, bwd_rule)
+            fn = wrapped
+
+        def op(*args, **kwargs):
+            return primitive_call(fn, *args, name=name, **kwargs)
+
+        op.__name__ = name
+        op.raw = fn
+        _REGISTRY[name] = op
+        return op
+
+    if forward is not None:
+        return _do_register(forward)
+    return _do_register  # decorator form
+
+
+def get_op(name: str):
+    if name not in _REGISTRY:
+        raise CustomOpError(
+            f"unknown custom op {name!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def registered_ops():
+    return sorted(_REGISTRY)

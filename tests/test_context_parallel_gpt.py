@@ -6,6 +6,7 @@ single-device run of the identical model (parity pattern: survey §4/3).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import Mesh
 
 import paddle_tpu as paddle
@@ -50,6 +51,7 @@ def _baseline_losses(model, ids, labels, steps, lr):
     return losses
 
 
+@pytest.mark.slow
 def test_context_parallel_matches_single_device():
     paddle.seed(11)
     model = GPTForCausalLM(_cfg())

@@ -238,6 +238,7 @@ def test_pipeline_matches_nonpipeline():
         assert np.allclose(a.numpy(), b.numpy(), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_moe_layer():
     from paddle_tpu.incubate import MoELayer
 
@@ -251,6 +252,7 @@ def test_moe_layer():
     assert moe.gate.weight.grad is not None
 
 
+@pytest.mark.slow
 def test_pipeline_dp2_pp2_mp2_gpt():
     """The full hybrid config (dp=2 x pp=2 x mp=2) on a real GPT pipeline — the
     exact dryrun path that stalled in round 1 when the platform was hijacked.

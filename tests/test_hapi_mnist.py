@@ -13,6 +13,7 @@ from paddle_tpu.vision.models import LeNet
 from paddle_tpu.vision.transforms import Normalize
 
 
+@pytest.mark.slow
 def test_lenet_mnist_convergence():
     transform = Normalize(mean=[127.5], std=[127.5])
     train = MNIST(mode="train", transform=transform, synthetic_size=512)

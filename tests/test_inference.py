@@ -66,6 +66,45 @@ def test_predictor_zero_copy(tmp_path):
     np.testing.assert_allclose(outs[0], got, rtol=1e-6)
 
 
+def test_predictor_dynamic_batch(tmp_path):
+    """Batch sizes other than the exported one are served by pad/chunk — the
+    TPU static-shape policy for dynamic serving batch."""
+    main, x, out = _build_program()  # exported at batch 4
+    exe = static.Executor()
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+    predictor = inference.create_predictor(inference.Config(prefix))
+    rng = np.random.RandomState(2)
+    for b in (1, 3, 4, 7, 10):  # smaller, exact, and multi-chunk batches
+        xv = rng.randn(b, 8).astype("float32")
+        outs = predictor.run([xv])
+        assert outs[0].shape == (b, 3), (b, outs[0].shape)
+        np.testing.assert_allclose(outs[0].sum(-1), np.ones(b), rtol=1e-5)
+        ref = predictor.run([np.pad(xv, [(0, (-b) % 4), (0, 0)])])[0][:b] \
+            if b % 4 else predictor.run([xv])[0]
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5)
+
+
+def test_predictor_pool_shares_model(tmp_path):
+    main, x, out = _build_program()
+    exe = static.Executor()
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+
+    pool = inference.PredictorPool(inference.Config(prefix), size=3)
+    assert len(pool) == 3
+    xv = np.random.RandomState(3).randn(4, 8).astype("float32")
+    outs = [pool.retrieve(i).run([xv])[0] for i in range(3)]
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+    np.testing.assert_allclose(outs[0], outs[2], rtol=1e-6)
+    # handles are independent (zero-copy state not shared across pool members)
+    pool.retrieve(0).get_input_handle("x").copy_from_cpu(xv * 2)
+    np.testing.assert_allclose(
+        np.asarray(pool.retrieve(1).get_input_handle("x")._value), xv,
+        rtol=1e-6)
+
+
 def test_jit_save_load_translated_layer(tmp_path):
     class Net(nn.Layer):
         def __init__(self):

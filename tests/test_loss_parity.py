@@ -1,0 +1,74 @@
+"""Loss-curve parity: framework GPT vs the independent numpy implementation
+(VERDICT r3 item 9; reference pattern test_dist_base.py:782 — same init, same
+data, per-step loss agreement).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+from numpy_gpt import NumpyGPT
+
+
+def _build(seed=13):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=61, hidden_size=16, num_layers=2, num_heads=2,
+                    max_seq_len=8, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    params = {k: np.asarray(v.numpy(), np.float64)
+              for k, v in model.named_parameters()}
+    return model, cfg, params
+
+
+def test_single_step_grads_match_numpy():
+    """The numpy backward is validated against the framework's autodiff on one
+    step — every parameter's gradient, not just the loss."""
+    model, cfg, params = _build()
+    ref = NumpyGPT(params, cfg.num_layers, cfg.num_heads)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 8))
+    labels = rng.randint(0, cfg.vocab_size, (2, 8))
+
+    loss_np, grads_np = ref.loss_and_grads(ids, labels)
+    loss_fw = model(paddle.to_tensor(ids.astype(np.int32)),
+                    labels=paddle.to_tensor(labels.astype(np.int32)))
+    loss_fw.backward()
+    assert float(loss_fw) == pytest.approx(loss_np, rel=1e-5)
+    for name, p in model.named_parameters():
+        gf = np.asarray(p.grad.numpy(), np.float64)
+        gn = grads_np[name]
+        np.testing.assert_allclose(
+            gf, gn, rtol=2e-4, atol=2e-6,
+            err_msg=f"grad mismatch for {name}")
+
+
+@pytest.mark.slow
+def test_loss_curve_parity_50_steps():
+    """Train 50 SGD steps from the same init on the same batches; the loss
+    sequences must agree step for step."""
+    model, cfg, params = _build(seed=4)
+    ref = NumpyGPT(params, cfg.num_layers, cfg.num_heads)
+    opt = paddle.optimizer.SGD(0.5, parameters=model.parameters())
+    rng = np.random.RandomState(7)
+
+    data = [(rng.randint(0, cfg.vocab_size, (2, 8)),
+             rng.randint(0, cfg.vocab_size, (2, 8))) for _ in range(4)]
+    fw_losses, np_losses = [], []
+    for step in range(50):
+        ids, labels = data[step % len(data)]  # memorizable: loss must fall
+        loss = model(paddle.to_tensor(ids.astype(np.int32)),
+                     labels=paddle.to_tensor(labels.astype(np.int32)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        fw_losses.append(float(loss))
+        l_np, g_np = ref.loss_and_grads(ids, labels)
+        ref.sgd_step(g_np, 0.5)
+        np_losses.append(l_np)
+
+    np.testing.assert_allclose(fw_losses, np_losses, rtol=2e-3, atol=2e-4)
+    # and training actually learned something in both
+    assert fw_losses[-1] < fw_losses[0]
+    assert np_losses[-1] < np_losses[0]

@@ -1,0 +1,136 @@
+"""Tests for the round-3 gap closures: allocator stats surface (survey #5),
+bucketing/padding dynamic-shape policy (hard-part #2 / LoD analog, #30),
+and out-of-tree custom op registration (#15).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# ------------------------------------------------------------ memory surface
+def test_memory_stats_surface():
+    from paddle_tpu.core import memory
+
+    s = memory.memory_stats()
+    assert isinstance(s, dict)  # CPU backend may report {} — shape, not values
+    assert memory.memory_allocated() >= 0
+    assert memory.max_memory_allocated() >= memory.memory_allocated() or \
+        memory.max_memory_allocated() == 0
+    with pytest.raises(ValueError):
+        memory.set_memory_fraction(1.5)
+    import os
+
+    memory.set_memory_fraction(0.5)
+    assert os.environ["XLA_PYTHON_CLIENT_MEM_FRACTION"] == "0.5"
+    memory.set_preallocate(False)
+    assert os.environ["XLA_PYTHON_CLIENT_PREALLOCATE"] == "false"
+    memory.empty_cache()  # must not raise
+
+
+# ---------------------------------------------------------------- bucketing
+def test_bucket_boundaries_and_padding():
+    from paddle_tpu.io import bucket_boundaries, pad_sequence_batch, pad_to_bucket
+
+    b = bucket_boundaries(100, scheme="pow2", min_len=16)
+    assert b == [16, 32, 64, 100]
+    arr, n = pad_to_bucket(np.arange(20), b)
+    assert arr.shape == (32,) and n == 20 and arr[20:].sum() == 0
+    with pytest.raises(ValueError):
+        pad_to_bucket(np.arange(200), b)
+
+    batch, lengths = pad_sequence_batch(
+        [np.ones(5), np.ones(9)], boundaries=b, pad_value=0)
+    assert batch.shape == (2, 16)
+    assert list(lengths) == [5, 9]
+
+
+def test_length_bucket_sampler_bounds_shapes():
+    """Every batch pads to ONE boundary; total distinct padded shapes <=
+    ladder size (the compile-count bound that replaces LoD)."""
+    from paddle_tpu.io import Dataset, LengthBucketSampler, bucket_boundaries
+
+    class Var(Dataset):
+        def __init__(self):
+            rng = np.random.RandomState(0)
+            self.lens = rng.randint(3, 90, size=40)
+
+        def __len__(self):
+            return 40
+
+        def __getitem__(self, i):
+            return np.arange(self.lens[i])
+
+    ds = Var()
+    ladder = bucket_boundaries(96, scheme="pow2", min_len=8)
+    sampler = LengthBucketSampler(ds, lambda d, i: d.lens[i], ladder,
+                                  batch_size=4)
+    seen = set()
+    count = 0
+    for batch in sampler:
+        bucket = sampler.bucket_of(batch)
+        for i in batch:
+            assert ds.lens[i] <= bucket
+        seen.add(bucket)
+        count += len(batch)
+    assert count == 40  # every sample appears exactly once
+    assert seen <= set(ladder)
+    assert len(sampler) >= len(seen)
+
+
+# ---------------------------------------------------------------- custom ops
+def test_register_custom_op_with_grad():
+    import jax.numpy as jnp
+
+    from paddle_tpu.utils.custom_op import (
+        CustomOpError, get_op, register_op, registered_ops)
+
+    def swish_beta2(x):
+        return x / (1 + jnp.exp(-2.0 * x))
+
+    def swish_bwd(inputs, g):
+        (x,) = inputs
+        s = 1 / (1 + jnp.exp(-2.0 * x))
+        return (g * (s + 2.0 * x * s * (1 - s)),)
+
+    op = register_op("swish2", swish_beta2, backward=swish_bwd)
+    assert "swish2" in registered_ops()
+    assert get_op("swish2") is op
+    with pytest.raises(CustomOpError):
+        register_op("swish2", swish_beta2)
+
+    x = paddle.to_tensor(np.linspace(-2, 2, 7).astype(np.float32),
+                         stop_gradient=False)
+    y = op(x)
+    np.testing.assert_allclose(
+        y.numpy(), x.numpy() / (1 + np.exp(-2 * x.numpy())), rtol=1e-6)
+    y.sum().backward()
+    # custom VJP matches finite differences
+    eps = 1e-3
+    num = ((x.numpy() + eps) / (1 + np.exp(-2 * (x.numpy() + eps))) -
+           (x.numpy() - eps) / (1 + np.exp(-2 * (x.numpy() - eps)))) / (2 * eps)
+    np.testing.assert_allclose(x.grad.numpy(), num, rtol=1e-3, atol=1e-4)
+
+
+def test_custom_op_in_static_program():
+    from paddle_tpu import static
+    from paddle_tpu.utils.custom_op import register_op
+
+    import jax.numpy as jnp
+
+    op = register_op("double_plus", lambda a, b: 2.0 * a + b, override=True)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [3], "float32")
+            out = op(x, x)
+        names = [o.type for o in main.all_ops()]
+        assert "double_plus" in names, names
+        exe = static.Executor()
+        res = exe.run(main, feed={"x": np.ones(3, np.float32)},
+                      fetch_list=[out])
+        np.testing.assert_allclose(res[0], 3.0)
+    finally:
+        paddle.disable_static()

@@ -108,6 +108,48 @@ def test_barrier_blocks_until_all_workers():
     server.stop()
 
 
+def test_geo_sgd_two_workers_merge_deltas(ps_cluster, monkeypatch):
+    """Geo-SGD (reference the_one_ps.py:816 geo mode): two workers train
+    locally, each sync pushes its local delta; after both sync, the server
+    holds init + delta_a + delta_b and both workers converge to it."""
+    servers, client, eps = ps_cluster
+    monkeypatch.setenv("TRAINING_ROLE", "TRAINER")
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "0")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "1")
+    monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST", ",".join(eps))
+    ps_runtime.set_role(PaddleCloudRoleMaker())
+    monkeypatch.setattr(ps_runtime, "_client", client)
+
+    import jax.numpy as jnp
+
+    def make_model(seed):
+        paddle.seed(seed)
+        return nn.Linear(4, 3)
+
+    # worker A registers (first worker initializes tables)
+    m_a = make_model(7)
+    init_w = m_a.weight.numpy().copy()
+    geo_a = ps_runtime.GeoSGD(m_a, k_steps=2)
+    # worker B shares the same tables (same client here; role still worker 0,
+    # so pass init too — create_dense is idempotent on existing tables)
+    m_b = make_model(7)
+    geo_b = ps_runtime.GeoSGD(m_b, k_steps=2)
+
+    # both trained locally: A adds +0.5 to its weight, B adds +0.25
+    m_a.weight._value = m_a.weight._value + 0.5
+    geo_a.step()  # count 1: no sync
+    assert not np.allclose(client.pull_dense(
+        [n for n, _ in geo_a._dense][0]).reshape(m_a.weight.shape),
+        init_w + 0.5)
+    geo_a.step()  # count 2: sync -> pushes +0.5 delta
+    m_b.weight._value = m_b.weight._value + 0.25
+    geo_b.sync()  # explicit sync -> pushes +0.25 delta
+    # server now holds init + 0.75; B pulled it at sync
+    np.testing.assert_allclose(m_b.weight.numpy(), init_w + 0.75, rtol=1e-5)
+    geo_a.sync()
+    np.testing.assert_allclose(m_a.weight.numpy(), m_b.weight.numpy(), rtol=1e-5)
+
+
 def test_ps_end_to_end_embedding_regression(ps_cluster, monkeypatch):
     """Async-SGD: DistEmbedding + dense linear head, loss decreases."""
     servers, client, eps = ps_cluster

@@ -1,6 +1,7 @@
 """QAT/PTQ tests (reference analog: slim/tests test_imperative_qat.py,
 test_post_training_quantization_*.py)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn, quantization as Q
@@ -35,6 +36,7 @@ def test_fake_quant_levels_and_ste():
     assert x.grad is not None and np.abs(x.grad.numpy()).max() > 0
 
 
+@pytest.mark.slow
 def test_imperative_qat_swaps_and_trains():
     paddle.seed(11)
     net = SmallNet()

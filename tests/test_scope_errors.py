@@ -1,0 +1,73 @@
+"""Hierarchical Scope (survey #17) + structured error codes (#29) tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import errors
+from paddle_tpu.static.scope import Scope, scope_guard
+
+
+def test_scope_hierarchy_lookup():
+    root = Scope()
+    root.set("w", 1.0)
+    kid = root.new_scope()
+    kid.set("b", 2.0)
+    # find_var walks ancestors (reference Scope::FindVar)
+    assert kid.get("w") == 1.0
+    assert kid.get("b") == 2.0
+    with pytest.raises(errors.NotFoundError):
+        root.get("b")  # parent does NOT see child vars
+    assert root.find_var("b") is None
+    assert kid.find_var("w").name == "w"
+    # var() creates locally; handles read/write through the scope
+    h = kid.var("x")
+    assert not h.is_initialized()
+    h.set_tensor(np.ones(3))
+    assert kid.get("x").shape == (3,)
+    kid2 = root.new_scope()
+    root.drop_kids()
+    assert root.local_var_names() == ["w"]
+
+
+def test_scope_guard_and_executor_fetch_persistence():
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 2], "float32")
+            y = paddle.sum(x)
+        sc = Scope()
+        exe = static.Executor()
+        with scope_guard(sc):
+            res = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                          fetch_list=[y], scope=sc)
+        assert float(res[0]) == 4.0
+        # the fetch persisted into the scope under the var's name
+        assert float(np.asarray(sc.get(y.name))) == 4.0
+    finally:
+        paddle.disable_static()
+
+
+def test_error_taxonomy_codes_and_builtin_compat():
+    with pytest.raises(ValueError) as ei:
+        raise errors.InvalidArgumentError("bad axis", axis=7, ndim=2)
+    assert "(INVALID_ARGUMENT)" in str(ei.value)
+    assert "axis=7" in str(ei.value)
+    assert isinstance(ei.value, errors.PaddleError)
+
+    with pytest.raises(NotImplementedError):
+        raise errors.UnimplementedError("no such kernel")
+    with pytest.raises(MemoryError):
+        raise errors.ResourceExhaustedError("HBM full", requested="1GB")
+
+    errors.enforce(True, "never")
+    with pytest.raises(errors.PreconditionNotMetError):
+        errors.enforce(False, "must init first",
+                       error=errors.PreconditionNotMetError)
+    with pytest.raises(errors.InvalidArgumentError):
+        errors.enforce_eq(1, 2)
+    assert errors.enforce_not_none(5) == 5
+    with pytest.raises(errors.NotFoundError):
+        errors.enforce_not_none(None, "missing table")

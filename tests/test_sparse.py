@@ -114,6 +114,40 @@ def test_sparse_embedding_training_matches_dense(opt_cls):
     np.testing.assert_allclose(dense_w, sparse_w, rtol=1e-5, atol=1e-6)
 
 
+def test_sparse_embedding_static_build_falls_back_dense():
+    """Under static program build the sparse path must NOT fire — the op is
+    recorded densely (regression: the gate used to crash on Variable avals)."""
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 3], "int64")
+            emb = nn.Embedding(10, 4, sparse=True)
+            out = emb(x)
+        exe = static.Executor()
+        res = exe.run(main, feed={"x": np.zeros((2, 3), np.int64)},
+                      fetch_list=[out])
+        assert res[0].shape == (2, 3, 4)
+    finally:
+        paddle.disable_static()
+
+
+def test_sparse_embedding_nonleaf_weight_falls_back_dense():
+    """An op-derived (non-leaf) weight cannot carry a SelectedRows ct through
+    an upstream vjp — the gate must fall back to the dense path."""
+    emb = nn.Embedding(12, 4, sparse=True)
+    scaled = emb.weight * 2.0  # non-leaf
+    out = nn.functional.embedding(
+        paddle.to_tensor(np.array([1, 3], np.int64)), scaled, sparse=True)
+    out.sum().backward()
+    g = emb.weight.grad._value
+    assert not isinstance(g, SelectedRows)  # dense chain-rule grad
+    dense = np.asarray(g)
+    np.testing.assert_allclose(dense[1], 2.0)
+
+
 def test_sparse_grad_accumulates_across_backwards():
     emb = nn.Embedding(20, 4, sparse=True)
     ids = paddle.to_tensor(np.array([1, 2], np.int64))

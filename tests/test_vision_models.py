@@ -6,6 +6,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision import models
 
+pytestmark = pytest.mark.slow
+
 
 def _check(model, num_classes=10, size=64, batch=2):
     x = paddle.to_tensor(
