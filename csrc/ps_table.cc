@@ -166,6 +166,19 @@ void ps_sparse_push_grad(void* h, const int64_t* ids, int64_t n, const float* g,
   }
 }
 
+// erase rows by id; returns the number actually removed (the shrink
+// primitive behind CTR-accessor eviction — memory_sparse_table.cc Shrink).
+int64_t ps_sparse_erase(void* h, const int64_t* ids, int64_t n) {
+  auto* t = static_cast<SparseTable*>(h);
+  int64_t removed = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    SparseShard& s = t->shards[static_cast<uint64_t>(ids[i]) % kSparseShards];
+    std::lock_guard<std::mutex> lk(s.mu);
+    removed += (int64_t)s.rows.erase(ids[i]);
+  }
+  return removed;
+}
+
 // export all rows (for checkpointing): caller passes capacity row counts;
 // returns number of rows written. ids_out [cap], emb_out [cap, dim].
 int64_t ps_sparse_export(void* h, int64_t* ids_out, float* emb_out, int64_t cap) {

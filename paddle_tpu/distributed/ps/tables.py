@@ -161,6 +161,19 @@ class SparseTable:
         with self._mu:
             return len(self._rows)
 
+    def erase(self, ids: np.ndarray) -> int:
+        """Remove rows by id; returns how many existed (native
+        ps_sparse_erase — the shrink primitive)."""
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if self._h:
+            return int(self._lib.ps_sparse_erase(self._h, _i64p(ids), ids.size))
+        with self._mu:
+            n = 0
+            for i in ids:
+                n += self._rows.pop(int(i), None) is not None
+                self._g2.pop(int(i), None)
+            return n
+
     def export(self):
         """(ids, rows) snapshot for checkpointing."""
         if self._h:
@@ -174,9 +187,65 @@ class SparseTable:
             return ids, np.stack([self._rows[int(i)] for i in ids]) if ids.size \
                 else np.zeros((0, self.dim), np.float32)
 
-    def __del__(self):
+    def __del__(self):  # noqa: D105
         try:
             if getattr(self, "_h", None):
                 self._lib.ps_sparse_free(self._h)
         except Exception:
             pass
+
+
+class CtrAccessor:
+    """CTR feature-value accessor over a SparseTable.
+
+    Reference analog: CtrCommonAccessor + MemorySparseTable::Shrink
+    (/root/reference/paddle/fluid/distributed/ps/table/memory_sparse_table.cc:1,
+    ctr_accessor.cc): every sparse feature carries show/click counters; a
+    feature's score = show_coeff*show + click_coeff*click decays every pass,
+    and Shrink evicts features whose score falls under a threshold — this is
+    what keeps billion-feature CTR tables bounded.
+    """
+
+    def __init__(self, table: SparseTable, show_coeff=0.25, click_coeff=9.0,
+                 decay_rate=0.98):
+        self.table = table
+        self.show_coeff = float(show_coeff)
+        self.click_coeff = float(click_coeff)
+        self.decay_rate = float(decay_rate)
+        self._show: dict[int, float] = {}
+        self._click: dict[int, float] = {}
+        self._mu = threading.Lock()
+
+    def update(self, ids, shows=None, clicks=None):
+        """Record impressions/clicks for the batch's feature ids."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        shows = np.ones(ids.size) if shows is None else np.asarray(shows).reshape(-1)
+        clicks = np.zeros(ids.size) if clicks is None else np.asarray(clicks).reshape(-1)
+        with self._mu:
+            for i, s, c in zip(ids, shows, clicks):
+                self._show[int(i)] = self._show.get(int(i), 0.0) + float(s)
+                self._click[int(i)] = self._click.get(int(i), 0.0) + float(c)
+
+    def score(self, fid: int) -> float:
+        return (self.show_coeff * self._show.get(int(fid), 0.0)
+                + self.click_coeff * self._click.get(int(fid), 0.0))
+
+    def decay(self):
+        """End-of-pass decay (reference show_click_decay_rate)."""
+        with self._mu:
+            for d in (self._show, self._click):
+                for k in d:
+                    d[k] *= self.decay_rate
+
+    def shrink(self, threshold: float) -> int:
+        """Evict every feature whose score < threshold from the table;
+        returns the eviction count (MemorySparseTable::Shrink)."""
+        with self._mu:
+            ids, _ = self.table.export()
+            evict = np.array([i for i in ids if self.score(int(i)) < threshold],
+                             np.int64)
+            removed = self.table.erase(evict) if evict.size else 0
+            for i in evict:
+                self._show.pop(int(i), None)
+                self._click.pop(int(i), None)
+        return removed

@@ -185,8 +185,10 @@ class Predictor:
                 part[name] = jnp.asarray(chunk, a.dtype)
             part_outs = self._prog._exported_call(part)
             outs_parts.append([np.asarray(o) for o in part_outs])
-        # an output is batched iff its dim 0 equals the compiled batch b0;
-        # others (scalars, weights echoed through) come from the first chunk
+        # an output is batched iff its dim 0 equals the compiled batch b0.
+        # A batch-REDUCED output (scalar loss/metric) cannot be reconstructed
+        # from chunked/padded runs — refuse rather than return a value silently
+        # computed over pad rows or one chunk only.
         merged = []
         tail_valid = b_in - (len(outs_parts) - 1) * b0
         for i in range(len(outs_parts[0])):
@@ -196,7 +198,11 @@ class Predictor:
                 parts[-1] = parts[-1][:tail_valid]
                 merged.append(np.concatenate(parts))
             else:
-                merged.append(o0)
+                raise ValueError(
+                    f"output {i} (shape {np.shape(o0)}) is reduced over the "
+                    f"batch; it cannot be served at batch {b_in} != exported "
+                    f"{b0} — re-export at the serving batch or fetch per-row "
+                    "outputs only")
         return merged
 
     def clear_intermediate_tensor(self):

@@ -244,11 +244,14 @@ class DataLoader:
                         raise RuntimeError(
                             f"DataLoader worker(s) timed out after "
                             f"{user_timeout}s")
-                    dead = [p.pid for p in procs if not p.is_alive()]
-                    if len(dead) == len(procs):
+                    # exitcode 0 = clean sentinel exit near epoch end, not death
+                    dead = [p.pid for p in procs
+                            if p.exitcode not in (None, 0)]
+                    alive = any(p.is_alive() for p in procs)
+                    if not alive and (dead or _time.time() - last_progress > 30):
                         raise RuntimeError(
-                            "all DataLoader workers died without producing "
-                            f"batch {next_idx}")
+                            f"all DataLoader workers exited (dead: {dead}) "
+                            f"without producing batch {next_idx}")
                     if dead and _time.time() - last_progress > 30:
                         # a dead worker may have taken this batch's index tuple
                         # with it — without this check the loop polls forever

@@ -112,6 +112,8 @@ def _declare(l):
                                       ctypes.c_float]
     l.ps_sparse_export.restype = ctypes.c_int64
     l.ps_sparse_export.argtypes = [ctypes.c_void_p, i64p, f32p, ctypes.c_int64]
+    l.ps_sparse_erase.restype = ctypes.c_int64
+    l.ps_sparse_erase.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64]
     # host tracer (csrc/host_tracer.cc)
     l.host_tracer_new.restype = ctypes.c_void_p
     l.host_tracer_new.argtypes = [ctypes.c_int64]

@@ -13,10 +13,12 @@ from .program import (  # noqa: F401
     data,
     default_main_program,
     default_startup_program,
+    device_guard,
     global_scope,
     name_scope,
     program_guard,
 )
+from .pipeline import PipelineCompiledProgram, split_program_by_device  # noqa: F401
 from .scope import Scope, scope_guard  # noqa: F401
 from .executor import CompiledProgram, Executor  # noqa: F401
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401

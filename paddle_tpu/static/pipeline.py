@@ -1,0 +1,245 @@
+"""Static pipeline parallelism: device_guard program splitting + a
+SectionWorker-style micro-batch schedule.
+
+Reference analog: PipelineOptimizer
+(/root/reference/python/paddle/fluid/optimizer.py:4323 — ~1.5k lines of
+program surgery cutting a static program at device_guard boundaries) executed
+by SectionWorker (/root/reference/paddle/fluid/framework/device_worker.h:620)
+per stage with micro-batch scopes.
+
+TPU-native: the op tape is already a linear program, so the splitter is a
+segmentation of `block.ops` by their `device` attr. Each stage segment becomes
+a pure jitted function (params_seg, boundary_in, feeds) -> boundary_out placed
+on its own device; the runner schedules micro-batches GPipe-style — forward
+through all stages per micro-batch (XLA async dispatch overlaps stages across
+devices), per-stage VJPs in reverse, gradient accumulation across
+micro-batches, one optimizer step. Cross-stage transfers are device_puts
+(send_v2/recv_v2 analog — same contract as fleet/pipeline_parallel._xfer).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as rng_mod
+from ..core import tape as tape_mod
+from ..core.errors import InvalidArgumentError
+from ..core.tensor import Tensor
+from .program import Program, Variable, _flat_inputs
+
+
+def split_program_by_device(program: Program):
+    """Segment the top-level op tape at device_guard boundaries.
+
+    Returns [(device_tag, [ops])] in program order. Ops without a device attr
+    join the current segment (reference: PipelineOptimizer assigns unannotated
+    ops to the previous device)."""
+    segments = []
+    cur_dev, cur_ops = None, []
+    started = False
+    for op in program.global_block.ops:
+        dev = op.attrs.get("device", None)
+        if not started:
+            cur_dev = dev
+            started = True
+        if dev is not None and dev != cur_dev:
+            segments.append((cur_dev, cur_ops))
+            cur_dev, cur_ops = dev, []
+        cur_ops.append(op)
+    if cur_ops:
+        segments.append((cur_dev, cur_ops))
+    return segments
+
+
+class PipelineCompiledProgram:
+    """Compile a device_guard-annotated program into per-stage functions and
+    run micro-batched training steps (the SectionWorker loop).
+
+    Usage:
+        pipe = PipelineCompiledProgram(main, loss, optimizer,
+                                       num_microbatches=4)
+        loss_val = pipe.run(feed={"x": ..., "label": ...})
+    """
+
+    def __init__(self, program: Program, loss: Variable, optimizer=None,
+                 num_microbatches: int = 1, devices=None):
+        self.program = program
+        self.loss_var = loss
+        self.optimizer = optimizer
+        self.num_microbatches = int(num_microbatches)
+        self.segments = split_program_by_device(program)
+        if len(self.segments) < 2:
+            raise InvalidArgumentError(
+                "pipeline needs >= 2 device_guard stages; got "
+                f"{len(self.segments)} — annotate ops with static.device_guard")
+        n = len(self.segments)
+        avail = devices if devices is not None else jax.devices()
+        self.stage_devices = [avail[min(i, len(avail) - 1)] for i in range(n)]
+        self._analyze()
+        # place each stage's params on its device once (SectionWorker scope
+        # ownership); the jitted stage fn then runs where its operands live
+        for s, params in enumerate(self.stage_params):
+            for p in params:
+                p._value = jax.device_put(p._value, self.stage_devices[s])
+        self._build_stage_fns()
+        self._opt_state = None
+
+    # ------------------------------------------------------------- analysis
+    def _analyze(self):
+        """Per segment: captured params, feed vars, boundary ins/outs."""
+        produced_by = {}
+        for s, (_, ops) in enumerate(self.segments):
+            for op in ops:
+                for o in op.outputs:
+                    produced_by[id(o)] = s
+        self.stage_params = []
+        self.stage_feeds = []
+        self.stage_bins = []  # boundary inputs: [(var, producer_stage)]
+        feed_names = {v.name for v in self.program._data_vars}
+        for s, (_, ops) in enumerate(self.segments):
+            params, feeds, bins = [], [], []
+            seen = set()
+            local = {id(o) for op in ops for o in op.outputs}
+            for op in ops:
+                for t in _flat_inputs(op.inputs):
+                    if id(t) in seen:
+                        continue
+                    seen.add(id(t))
+                    if isinstance(t, Variable):
+                        if id(t) in local:
+                            continue
+                        if t.name in feed_names:
+                            feeds.append(t)
+                        elif id(t) in produced_by and produced_by[id(t)] < s:
+                            bins.append((t, produced_by[id(t)]))
+                        else:
+                            raise InvalidArgumentError(
+                                f"stage {s} reads {t.name} produced in a LATER "
+                                "stage — device_guard order must follow "
+                                "dataflow")
+                    elif isinstance(t, Tensor) and not isinstance(t, Variable):
+                        params.append(t)
+            self.stage_params.append(params)
+            self.stage_feeds.append(feeds)
+            self.stage_bins.append(bins)
+        # boundary outputs of each stage = vars consumed by later stages + loss
+        self.stage_bouts = [[] for _ in self.segments]
+        for s, bins in enumerate(self.stage_bins):
+            for var, src in bins:
+                if var not in self.stage_bouts[src]:
+                    self.stage_bouts[src].append(var)
+        last = len(self.segments) - 1
+        if id(self.loss_var) not in {
+            id(o) for _, ops in self.segments[last:] for op in ops
+            for o in op.outputs
+        }:
+            raise InvalidArgumentError("loss must be produced by the last stage")
+        if self.loss_var not in self.stage_bouts[last]:
+            self.stage_bouts[last].append(self.loss_var)
+
+    # ----------------------------------------------------------- stage fns
+    def _build_stage_fns(self):
+        self._fwd_fns = []
+        for s, (_, ops) in enumerate(self.segments):
+            feeds = self.stage_feeds[s]
+            bins = self.stage_bins[s]
+            bouts = self.stage_bouts[s]
+            params = self.stage_params[s]
+
+            def fwd(param_arrays, bin_arrays, feed_arrays, key, _ops=ops,
+                    _feeds=feeds, _bins=bins, _bouts=bouts, _params=params):
+                env = {id(t): a for t, a in zip(_params, param_arrays)}
+                env.update({id(v): a for (v, _), a in zip(_bins, bin_arrays)})
+                env.update({id(v): a for v, a in zip(_feeds, feed_arrays)})
+
+                def resolve(x):
+                    if isinstance(x, (Variable, Tensor)):
+                        if id(x) in env:
+                            return env[id(x)]
+                        if isinstance(x, Variable):
+                            raise KeyError(f"unbound var {x.name}")
+                        return x._value
+                    if isinstance(x, (list, tuple)):
+                        return type(x)(resolve(i) for i in x)
+                    return x
+
+                with tape_mod.no_grad(), rng_mod.trace_rng_scope(key):
+                    for op in _ops:
+                        out = op.fn(*[resolve(i) for i in op.inputs])
+                        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+                        for var, val in zip(op.outputs, outs):
+                            env[id(var)] = val
+                return [env[id(v)] for v in _bouts]
+
+            self._fwd_fns.append(jax.jit(fwd))
+
+    # ------------------------------------------------------------- running
+    def run(self, feed: dict, fetch_list=None):
+        """One training step: micro-batch forward/backward over the stages,
+        grad accumulation, optimizer update. Returns the mean loss."""
+        mb = self.num_microbatches
+        feeds_split = {}
+        for k, v in feed.items():
+            a = np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+            if a.shape[0] % mb:
+                raise InvalidArgumentError(
+                    f"feed {k!r} batch {a.shape[0]} not divisible by "
+                    f"{mb} micro-batches")
+            feeds_split[k] = np.split(a, mb)
+
+        params_flat = [p for ps in self.stage_params for p in ps]
+        train_idx = [i for i, p in enumerate(params_flat) if not p.stop_gradient]
+
+        def whole(train_arrays, feed_map, key):
+            """The chained pipeline as one function of trainable params —
+            per-stage fns keep per-device placement; jax.vjp over the chain
+            gives the stage backward (SectionWorker backward sections)."""
+            arrays = [p._value for p in params_flat]
+            for i, a in zip(train_idx, train_arrays):
+                arrays[i] = a
+            off = 0
+            per_stage = []
+            for ps in self.stage_params:
+                per_stage.append(arrays[off : off + len(ps)])
+                off += len(ps)
+            bouts_env = {}
+            for s in range(len(self.segments)):
+                # inter-stage transfer: the send_v2/recv_v2 analog
+                bin_arrays = [
+                    jax.device_put(bouts_env[id(v)], self.stage_devices[s])
+                    for v, _ in self.stage_bins[s]
+                ]
+                feed_arrays = [feed_map[v.name] for v in self.stage_feeds[s]]
+                outs = self._fwd_fns[s](per_stage[s], bin_arrays, feed_arrays, key)
+                for v, a in zip(self.stage_bouts[s], outs):
+                    bouts_env[id(v)] = a
+            loss_val = bouts_env[id(self.loss_var)]
+            if hasattr(loss_val, "ndim") and loss_val.ndim > 0:
+                loss_val = jnp.mean(loss_val)
+            return loss_val.astype(jnp.float32)
+
+        accum = None
+        losses = []
+        for m in range(mb):
+            feed_arrays_map = {k: jnp.asarray(v[m]) for k, v in feeds_split.items()}
+            ta = [params_flat[i]._value for i in train_idx]
+            if self.optimizer is None:
+                losses.append(whole(ta, feed_arrays_map, rng_mod.next_rng_key()))
+                continue
+            loss_m, grads = jax.value_and_grad(whole)(
+                ta, feed_arrays_map, rng_mod.next_rng_key())
+            losses.append(loss_m)
+            accum = grads if accum is None else [a + g for a, g in zip(accum, grads)]
+        if self.optimizer is not None and accum is not None:
+            opt = self.optimizer
+            pd = {str(i): params_flat[i]._value for i in train_idx}
+            gd = {str(i): g / mb for i, g in zip(train_idx, accum)}
+            if self._opt_state is None:
+                self._opt_state = opt.functional_init(pd)
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            new_p, self._opt_state = opt.functional_update(
+                pd, gd, self._opt_state, lr)
+            for i in train_idx:
+                params_flat[i]._value = new_p[str(i)]
+        return float(np.mean([np.asarray(l) for l in losses]))

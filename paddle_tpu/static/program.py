@@ -216,6 +216,22 @@ def op_role_guard(role):
         _current_role.pop()
 
 
+_current_device: list = [None]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """reference: paddle.static.device_guard — ops recorded inside carry a
+    device/stage annotation (`op.attrs['device']`) that the static pipeline
+    splitter (static/pipeline.py, the PipelineOptimizer analog at
+    fluid/optimizer.py:4323) uses to cut stage boundaries."""
+    _current_device.append(device)
+    try:
+        yield
+    finally:
+        _current_device.pop()
+
+
 def data(name, shape, dtype="float32", lod_level=0):
     """reference: paddle.static.data — declares a feed Variable."""
     prog = default_main_program()
@@ -244,6 +260,8 @@ def _static_record(fn, args, name):
     ]
     op = Operator(name or getattr(fn, "__name__", "op"), fn, list(args), outputs,
                   op_role=_current_role[-1])
+    if _current_device[-1] is not None:
+        op.attrs["device"] = _current_device[-1]
     block.append_op(op)
     if is_tuple:
         return tuple(outputs)

@@ -102,7 +102,8 @@ _scope_stack = [_global]
 
 
 def global_scope() -> Scope:
-    return _scope_stack[0] if len(_scope_stack) == 1 else _scope_stack[-1]
+    """The current scope: the root, or the innermost active scope_guard."""
+    return _scope_stack[-1]
 
 
 class scope_guard:

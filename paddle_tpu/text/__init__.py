@@ -1,5 +1,15 @@
 """paddle.text + model zoo for NLP (reference: python/paddle/text/ + the fleet GPT
 fixtures, tests/unittests/auto_parallel_gpt_model.py)."""
 from . import datasets  # noqa: F401
+from .datasets import (  # noqa: F401
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 from .bert import BertModel, BertForSequenceClassification, BertForPretraining  # noqa: F401
 from .gpt import GPTModel, GPTForCausalLM, GPTConfig  # noqa: F401

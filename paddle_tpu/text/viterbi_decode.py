@@ -1,0 +1,92 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py:24
+viterbi_decode + :91 ViterbiDecoder — CRF decode used by sequence labeling).
+
+TPU-native: the time recursion is a `lax.scan` over [T] carrying the score
+lattice (alpha) and emitting argmax backpointers; backtracking is a second
+scan in reverse. Static shapes throughout; variable lengths are masked (the
+lattice freezes once t >= length), matching the reference's semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi_impl(pot, trans, lengths, include_bos_eos_tag):
+    b, t, n = pot.shape
+    lengths = lengths.astype(jnp.int32)
+    if include_bos_eos_tag:
+        # reference convention: tag n-2 is BOS, n-1 is EOS
+        bos, eos = n - 2, n - 1
+        alpha0 = pot[:, 0] + trans[bos][None, :]
+    else:
+        alpha0 = pot[:, 0]
+
+    def fwd(carry, xs):
+        alpha, step = carry
+        pot_t = xs  # [b, n]
+        cand = alpha[:, :, None] + trans[None, :, :]  # [b, from, to]
+        best = jnp.max(cand, axis=1) + pot_t
+        ptr = jnp.argmax(cand, axis=1).astype(jnp.int32)
+        active = (step < lengths)[:, None]  # length includes step 0
+        new_alpha = jnp.where(active, best, alpha)
+        return (new_alpha, step + 1), ptr
+
+    (alpha, _), ptrs = jax.lax.scan(fwd, (alpha0, jnp.ones((), jnp.int32)),
+                                    jnp.moveaxis(pot, 1, 0)[1:])
+    # ptrs: [t-1, b, n] backpointers for steps 1..t-1
+    final = alpha + (trans[:, eos][None, :] if include_bos_eos_tag else 0.0)
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+    def bwd(carry, xs):
+        tag, step = carry  # step counts down from t-1
+        ptr_t = xs  # [b, n] pointers INTO step-1 tags for transition step->step
+        prev = jnp.take_along_axis(ptr_t, tag[:, None], axis=1)[:, 0]
+        # only follow the pointer while inside the sequence; at/after the end
+        # keep the final tag (positions past length are masked to 0 below)
+        inside = step <= (lengths - 1)
+        new_tag = jnp.where(inside, prev, tag)
+        return (new_tag, step - 1), new_tag
+
+    (_, _), rev_path = jax.lax.scan(
+        bwd, (last_tag, jnp.asarray(t - 1, jnp.int32)), ptrs[::-1])
+    # rev_path: tags for steps t-2 .. 0 (each emitted AFTER following pointer)
+    path = jnp.concatenate([rev_path[::-1], last_tag[None, :]], axis=0)
+    path = jnp.moveaxis(path, 0, 1)  # [b, t]
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    path = jnp.where(valid, path, 0)
+    return scores, path
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Returns (scores [batch], paths [batch, seq]) — best tag sequence per
+    batch item under emission `potentials` and `transition_params`."""
+
+    def f(pot, trans, lens):
+        return _viterbi_impl(pot, trans, lens, include_bos_eos_tag)
+
+    t = lambda x: x if isinstance(x, Tensor) else Tensor(x)  # noqa: E731
+    return primitive_call(f, t(potentials), t(transition_params),
+                          t(lengths).detach(), name="viterbi_decode")
+
+
+class ViterbiDecoder(Layer):
+    """reference: text/viterbi_decode.py:91."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(transitions)
+        self._include = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self._include)

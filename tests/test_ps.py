@@ -108,6 +108,33 @@ def test_barrier_blocks_until_all_workers():
     server.stop()
 
 
+def test_ctr_accessor_decay_and_shrink():
+    """CTR accessor (reference ctr_accessor.cc + MemorySparseTable::Shrink):
+    show/click scores decay per pass; shrink evicts low-score features from
+    the native table."""
+    from paddle_tpu.distributed.ps import CtrAccessor, SparseTable
+
+    t = SparseTable(dim=4, seed=0)
+    acc = CtrAccessor(t, show_coeff=1.0, click_coeff=10.0, decay_rate=0.5)
+    hot, cold = np.array([1, 2]), np.array([100, 200, 300])
+    t.pull(np.concatenate([hot, cold]))  # materialize 5 rows
+    assert t.size() == 5
+    acc.update(hot, shows=[5, 5], clicks=[1, 2])
+    acc.update(cold, shows=[1, 1, 1])
+    assert acc.score(2) == 5 + 20
+    acc.decay()
+    assert acc.score(2) == pytest.approx((5 + 20) / 2)
+    # evict everything under score 2.0: the three cold features (score 0.5)
+    removed = acc.shrink(2.0)
+    assert removed == 3
+    assert t.size() == 2
+    ids, _ = t.export()
+    assert set(ids.tolist()) == {1, 2}
+    # erased ids re-materialize fresh on next pull (lazy init)
+    t.pull(np.array([100]))
+    assert t.size() == 3
+
+
 def test_geo_sgd_two_workers_merge_deltas(ps_cluster, monkeypatch):
     """Geo-SGD (reference the_one_ps.py:816 geo mode): two workers train
     locally, each sync pushes its local delta; after both sync, the server

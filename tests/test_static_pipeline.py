@@ -1,0 +1,86 @@
+"""Static pipeline (device_guard) tests — D15 (reference:
+PipelineOptimizer fluid/optimizer.py:4323 + SectionWorker device_worker.h:620).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.static.pipeline import (
+    PipelineCompiledProgram,
+    split_program_by_device,
+)
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _build_pipelined(seed=5):
+    paddle.seed(seed)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [8, 6], "float32")
+        label = static.data("label", [8], "int64")
+        with static.device_guard("stage:0"):
+            h = nn.functional.relu(nn.Linear(6, 16)(x))
+        with static.device_guard("stage:1"):
+            logits = nn.Linear(16, 4)(h)
+            loss = nn.functional.cross_entropy(logits, label)
+    return main, loss
+
+
+def test_split_by_device_guard():
+    main, loss = _build_pipelined()
+    segs = split_program_by_device(main)
+    assert len(segs) == 2
+    assert segs[0][0] == "stage:0" and segs[1][0] == "stage:1"
+    # the ln/relu ops landed in stage 0, CE in stage 1
+    assert any(op.type.endswith("relu") for op in segs[0][1])
+    assert any("cross_entropy" in op.type for op in segs[1][1])
+
+
+def test_pipeline_trains_and_matches_plain_executor():
+    xv = np.random.RandomState(0).rand(8, 6).astype(np.float32)
+    yv = np.random.RandomState(0).randint(0, 4, (8,)).astype(np.int64)
+
+    # plain single-program run (reference: non-pipelined baseline)
+    main_ref, loss_ref = _build_pipelined()
+    with static.program_guard(main_ref):
+        opt_r = paddle.optimizer.SGD(0.2)
+        opt_r.minimize(loss_ref)
+    exe = static.Executor()
+    ref_losses = [float(exe.run(main_ref, feed={"x": xv, "label": yv},
+                                fetch_list=[loss_ref])[0]) for _ in range(4)]
+
+    # pipelined: 2 stages x 2 micro-batches, grad accumulation
+    main_p, loss_p = _build_pipelined()
+    pipe = PipelineCompiledProgram(main_p, loss_p,
+                                   optimizer=paddle.optimizer.SGD(0.2),
+                                   num_microbatches=2)
+    pipe_losses = [pipe.run({"x": xv, "label": yv}) for _ in range(4)]
+    # same init/data: micro-batched accumulation == full-batch step for
+    # mean-CE + SGD (linear in grads), so the loss curves must match
+    assert pipe_losses == pytest.approx(ref_losses, rel=1e-4), (
+        pipe_losses, ref_losses)
+
+
+def test_pipeline_rejects_single_stage_and_bad_batch():
+    paddle.seed(1)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [4, 3], "float32")
+        y = nn.Linear(3, 2)(x)
+    with pytest.raises(Exception, match="device_guard"):
+        PipelineCompiledProgram(main, y, num_microbatches=2)
+
+    main2, loss2 = _build_pipelined()
+    pipe = PipelineCompiledProgram(main2, loss2,
+                                   optimizer=paddle.optimizer.SGD(0.1),
+                                   num_microbatches=3)
+    with pytest.raises(Exception, match="micro"):
+        pipe.run({"x": np.zeros((8, 6), np.float32),
+                  "label": np.zeros((8,), np.int64)})

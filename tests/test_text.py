@@ -1,0 +1,107 @@
+"""paddle.text tests: viterbi decode (vs brute force) + the dataset family
+(reference: python/paddle/text/ — viterbi_decode.py, datasets/)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import text
+from paddle_tpu.core.tensor import Tensor
+
+
+def _brute_viterbi(pot, trans, L, include):
+    n = pot.shape[1]
+    bos, eos = n - 2, n - 1
+    best_s, best_p = -1e30, None
+    for path in itertools.product(range(n), repeat=L):
+        s = pot[0, path[0]] + (trans[bos, path[0]] if include else 0)
+        for t in range(1, L):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include:
+            s += trans[path[-1], eos]
+        if s > best_s:
+            best_s, best_p = s, path
+    return best_s, best_p
+
+
+@pytest.mark.parametrize("include", [True, False])
+def test_viterbi_decode_matches_bruteforce(include):
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lengths = np.array([5, 3, 1])
+    scores, paths = text.viterbi_decode(
+        Tensor(pot), Tensor(trans), Tensor(lengths.astype(np.int64)),
+        include_bos_eos_tag=include)
+    scores, paths = np.asarray(scores._value), np.asarray(paths._value)
+    for b in range(B):
+        L = lengths[b]
+        bs, bp = _brute_viterbi(pot[b], trans, L, include)
+        assert abs(scores[b] - bs) < 1e-4
+        assert tuple(paths[b][:L]) == bp
+        assert (paths[b][L:] == 0).all()
+
+
+def test_viterbi_decoder_layer():
+    trans = np.random.RandomState(1).randn(5, 5).astype(np.float32)
+    dec = text.ViterbiDecoder(paddle.to_tensor(trans))
+    pot = np.random.RandomState(2).randn(2, 4, 5).astype(np.float32)
+    scores, paths = dec(paddle.to_tensor(pot),
+                        paddle.to_tensor(np.array([4, 2], np.int64)))
+    assert tuple(np.asarray(paths._value).shape) == (2, 4)
+
+
+def test_dataset_family_structures():
+    # Conll05st: 9 aligned int64 sequences
+    c = text.Conll05st(size=4, seq_len=16)
+    item = c[0]
+    assert len(item) == 9
+    assert all(a.dtype == np.int64 and a.shape == (16,) for a in item)
+    word_d, pred_d, label_d = c.get_dict()
+    assert len(label_d) == c.LABEL_DICT_LEN
+
+    # Imikolov: window_size int64 scalars
+    ng = text.Imikolov(window_size=5, size=8)[3]
+    assert len(ng) == 5
+
+    # Movielens: user/movie features + float rating
+    m = text.Movielens(size=4)[1]
+    assert m[5].shape == (8,) and m[6].shape == (3,)
+    assert m[7].dtype == np.float32
+
+    # UCIHousing: 13 features
+    uh = text.UCIHousing("train")
+    assert len(uh) == 404 and uh[0][0].shape == (13,)
+    assert text.UCIHousing("test")[0][0].shape == (13,)
+
+    # WMT: (src, trg_in, trg_next) with <s>/<e> framing
+    for ds in (text.WMT14(size=4), text.WMT16(size=4)):
+        src, trg_in, trg_next = ds[2]
+        assert trg_in[0] == 1 and trg_next[-1] == 2
+        assert len(trg_in) == len(trg_next)
+    # deterministic across constructions
+    a = text.WMT14(size=4)[2][0]
+    b = text.WMT14(size=4)[2][0]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_uci_housing_trains_regression():
+    from paddle_tpu import nn
+
+    ds = text.UCIHousing("train")
+    paddle.seed(9)
+    lin = nn.Linear(13, 1)
+    opt = paddle.optimizer.Adam(5e-2, parameters=lin.parameters())
+    xs = np.stack([ds[i][0] for i in range(64)])
+    ys = np.stack([ds[i][1] for i in range(64)])
+    losses = []
+    for _ in range(120):
+        loss = nn.functional.mse_loss(lin(paddle.to_tensor(xs)),
+                                      paddle.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5
