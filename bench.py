@@ -85,7 +85,7 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
                     num_layers=rung["layers"], num_heads=rung["heads"],
                     max_seq_len=rung.get("seq", 1024), dropout=0.0,
                     recompute=True, recompute_policy=rung["policy"],
-                    loss_chunk_size=int(os.environ.get("BENCH_LOSS_CHUNK", "512")))
+                    loss_chunk_size=int(os.environ.get("BENCH_LOSS_CHUNK", "1024")))
     batch, seq = rung["batch"], rung.get("seq", 1024)
 
     paddle.seed(0)

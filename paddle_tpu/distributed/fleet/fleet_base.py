@@ -117,6 +117,9 @@ class Fleet:
                                    loss_fn=loss_fn)
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        # HybridParallelOptimizer.__init__ runs the strategy compiler
+        # (create_meta_optimizer) — do NOT also wrap here or the meta stack
+        # applies twice
         if strategy is not None:
             self._user_defined_strategy = strategy
         return HybridParallelOptimizer(optimizer, self._hcg, self._user_defined_strategy)

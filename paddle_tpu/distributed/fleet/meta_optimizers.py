@@ -19,7 +19,7 @@ import numpy as np
 from ...core.tensor import Tensor
 
 __all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer", "DGCMomentumOptimizer",
-           "create_meta_optimizer"]
+           "StrategyCompiler", "create_meta_optimizer"]
 
 
 class _MetaOptimizerBase:
@@ -32,6 +32,15 @@ class _MetaOptimizerBase:
     @property
     def _parameter_list(self):
         return self.inner._parameter_list
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # must route through THIS wrapper's step() — delegating to the inner
+        # optimizer's minimize would silently bypass the meta behavior
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return [], []
 
 
 class GradientMergeOptimizer(_MetaOptimizerBase):
@@ -94,8 +103,11 @@ class LocalSGDOptimizer(_MetaOptimizerBase):
         from .. import env as env_mod
         from ..collective import ReduceOp, all_reduce
 
-        if env_mod.get_world_size() <= 1 and self.group is None:
-            return  # single process: averaging is identity
+        # Under the single-controller SPMD model a parameter IS the global
+        # value (one python process owns every device), so cross-rank
+        # averaging only applies with real per-process ranks.
+        if env_mod.proc_world()[1] <= 1 and self.group is None:
+            return
         for p in self.inner._parameter_list:
             all_reduce(p, op=ReduceOp.AVG, group=self.group)
 
@@ -147,7 +159,9 @@ class DGCMomentumOptimizer(_MetaOptimizerBase):
                     p.grad = Tensor(sent)
             from .. import env as env_mod
 
-            if env_mod.get_world_size() > 1 or self.group is not None:
+            # cross-rank grad averaging only with real per-process ranks
+            # (single-controller grads are already global; see LocalSGD note)
+            if env_mod.proc_world()[1] > 1 or self.group is not None:
                 from ..collective import ReduceOp, all_reduce
 
                 for p in self.inner._parameter_list:
@@ -159,21 +173,52 @@ class DGCMomentumOptimizer(_MetaOptimizerBase):
         self.inner.clear_grad()
 
 
+class StrategyCompiler:
+    """reference: base/strategy_compiler.py — pick the applicable
+    meta-optimizers for a DistributedStrategy, resolve mutual exclusions (the
+    reference's _disable_strategy protocol: the higher-priority one wins, the
+    loser is disabled with a log), and fix the composition order. The
+    resulting report lands on the returned optimizer as `_meta_report`.
+    """
+
+    # (winner, loser): when both flags are on, the loser is disabled
+    EXCLUSIONS = [("lamb", "lars"), ("dgc", "localsgd")]
+
+    def compile(self, strategy):
+        import warnings
+
+        flags = {f: bool(getattr(strategy, f, False))
+                 for f in ("lamb", "lars", "dgc", "gradient_merge", "localsgd")}
+        disabled = []
+        for winner, loser in self.EXCLUSIONS:
+            if flags.get(winner) and flags.get(loser):
+                warnings.warn(
+                    f"strategy.{loser} conflicts with strategy.{winner}; "
+                    f"disabling {loser} (strategy_compiler exclusion)",
+                    stacklevel=3)
+                flags[loser] = False
+                disabled.append(loser)
+        applied = [f for f in ("lamb", "lars", "dgc", "gradient_merge",
+                               "localsgd") if flags[f]]
+        return flags, applied, disabled
+
+
 def create_meta_optimizer(optimizer, strategy, group=None):
     """reference: meta_optimizer_factory + strategy_compiler — compose the
     applicable meta-optimizers around the user optimizer by strategy flags."""
     from ...optimizer.optimizers import Lamb, LarsMomentum
 
+    flags, applied, disabled = StrategyCompiler().compile(strategy)
     opt = optimizer
     params = getattr(optimizer, "_parameter_list", None)
     lr = optimizer.get_lr() if hasattr(optimizer, "get_lr") else 1e-3
 
-    if strategy.lamb and not isinstance(opt, Lamb):
+    if flags["lamb"] and not isinstance(opt, Lamb):
         opt = Lamb(learning_rate=lr, parameters=params)
-    elif strategy.lars and not isinstance(opt, LarsMomentum):
+    elif flags["lars"] and not isinstance(opt, LarsMomentum):
         opt = LarsMomentum(learning_rate=lr, parameters=params)
 
-    if strategy.dgc:
+    if flags["dgc"]:
         cfg = getattr(strategy, "dgc_configs", {}) or {}
         opt = DGCMomentumOptimizer(
             opt, rampup_begin_step=cfg.get("rampup_begin_step", 0),
@@ -181,13 +226,14 @@ def create_meta_optimizer(optimizer, strategy, group=None):
             if isinstance(cfg.get("sparsity"), list)
             else cfg.get("sparsity", 0.999), group=group)
 
-    if strategy.gradient_merge:
+    if flags["gradient_merge"]:
         cfg = strategy.gradient_merge_configs
         opt = GradientMergeOptimizer(opt, k_steps=cfg.get("k_steps", 1),
                                      avg=cfg.get("avg", True))
 
-    if strategy.localsgd:
+    if flags["localsgd"]:
         cfg = getattr(strategy, "localsgd_configs", {}) or {}
         opt = LocalSGDOptimizer(opt, k_steps=cfg.get("k_steps", 1), group=group)
 
+    opt._meta_report = {"applied": applied, "disabled": disabled}
     return opt
