@@ -64,3 +64,25 @@ class CompCostModel:
 
     def hbm_time(self, nbytes: float) -> float:
         return nbytes / self.cluster.hbm_bandwidth
+
+    def op_time(self, flops: float, nbytes: float) -> float:
+        """Roofline: an op takes max(MXU time, HBM time) — the standard TPU
+        performance model (reference cost/comp_cost.py per-op tables collapse
+        into this on a machine where XLA fuses elementwise into matmuls)."""
+        return max(self.matmul_time(flops), self.hbm_time(nbytes))
+
+    def analyze(self, fn, *example_args) -> dict:
+        """Ground-truth cost from XLA's own cost analysis: compile `fn` AOT
+        and read back {flops, bytes_accessed, time} — the single source the
+        planner scores candidate meshes with (no hand-maintained per-op
+        tables; the compiler already knows)."""
+        import jax
+
+        compiled = jax.jit(fn).lower(*example_args).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        nbytes = float(ca.get("bytes accessed", ca.get("bytes_accessed", 0.0)))
+        return {"flops": flops, "bytes_accessed": nbytes,
+                "time": self.op_time(flops, nbytes)}

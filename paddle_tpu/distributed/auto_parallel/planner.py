@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .cost_model import ClusterSpec, CommCostModel
+from .cost_model import ClusterSpec, CommCostModel, CompCostModel
 from .process_mesh import ProcessMesh
 
 
@@ -24,48 +24,63 @@ def _divisors_pow2(n: int):
         d *= 2
 
 
+def estimate_step_time(dp, sh, mp, param_bytes, state_bytes,
+                       step_flops, batch_bytes, cluster, comp=None):
+    """Estimated per-step wall time for one (dp, sharding, mp) candidate:
+    compute (roofline over the per-chip FLOP share) + the comm the layout
+    implies. Returns (time_seconds, per_chip_bytes) — per-chip memory is the
+    feasibility side."""
+    comm = CommCostModel(cluster)
+    comp = comp or CompCostModel(cluster)
+    per_chip = param_bytes / mp + (state_bytes - param_bytes) / (mp * sh)
+    # compute: the batch is partitioned over BOTH dp and sharding axes
+    # (partitioner.partition_batch / hybrid_train._batch_spec), mp splits
+    # each layer's FLOPs
+    t = comp.matmul_time(step_flops / (dp * sh * mp)) if step_flops else 0.0
+    if dp > 1:
+        t += comm.all_reduce(param_bytes / (mp * sh), dp)
+    if sh > 1:
+        t += comm.all_gather(param_bytes / mp, sh) + \
+            comm.reduce_scatter(param_bytes / mp, sh)
+    if mp > 1:
+        # per-step activation allreduce volume; floor it at a param-scale
+        # estimate so mp is never modeled as free
+        act = max(batch_bytes, param_bytes)
+        t += comm.all_reduce(act, mp) * 4
+    return t, per_chip
+
+
 def plan_mesh(n_devices: int, n_params: int, dtype_bytes: int = 4,
               opt_slots: int = 2, cluster: ClusterSpec | None = None,
-              batch_bytes: float = 0.0) -> ProcessMesh:
-    """Choose a [dp, sharding, mp] mesh for `n_devices` chips.
-
-    Heuristic (scaling-book recipe): keep everything data-parallel while
-    per-chip state fits; turn on ZeRO ('sharding' axis) when optimizer state
-    replication overflows; add model parallel ('mp') only when even sharded
-    params per chip exceed HBM — mp pays an allreduce per layer, the most
-    expensive option.
+              batch_bytes: float = 0.0, step_flops: float | None = None,
+              tokens_per_batch: float = 0.0) -> ProcessMesh:
+    """Choose a [dp, sharding, mp] mesh for `n_devices` chips by searching all
+    pow2 factorizations and minimizing estimated step TIME under the HBM
+    constraint (reference: planner.py + cost_model-driven tuner; scaling-book
+    recipe). When no FLOP estimate is available, step_flops defaults to the
+    6*N*tokens training rule so compute still weighs against comm.
     """
     cluster = cluster or ClusterSpec()
-    comm = CommCostModel(cluster)
     param_bytes = float(n_params) * dtype_bytes
     state_bytes = param_bytes * (1 + 1 + opt_slots)  # params + grads + slots
     budget = cluster.hbm_bytes * 0.6  # leave room for activations/workspace
+    if step_flops is None:
+        step_flops = 6.0 * float(n_params) * max(tokens_per_batch, 1.0)
 
-    # Minimal model-splitting that fits, preferring sharding (ZeRO) over mp:
-    # ZeRO only moves param-sized bytes per step, mp pays activation
-    # allreduces per layer. Among fitting candidates of equal total split,
-    # break ties with the cost model.
     best = None
     for mp in _divisors_pow2(n_devices):
         rest = n_devices // mp
         for sh in _divisors_pow2(rest):
             dp = rest // sh
-            # memory per chip: params split over mp; opt state further over sh
-            per_chip = param_bytes / mp + (state_bytes - param_bytes) / (mp * sh)
+            t, per_chip = estimate_step_time(
+                dp, sh, mp, param_bytes, state_bytes,
+                step_flops, batch_bytes, cluster)
             if per_chip > budget:
                 continue
-            cost = 0.0
-            if dp > 1:
-                cost += comm.all_reduce(param_bytes / (mp * sh), dp)
-            if sh > 1:
-                cost += comm.all_gather(param_bytes / mp, sh) + \
-                    comm.reduce_scatter(param_bytes / mp, sh)
-            if mp > 1:
-                # per-step activation allreduce volume; floor it at a
-                # param-scale estimate so mp is never modeled as free
-                act = max(batch_bytes, param_bytes)
-                cost += comm.all_reduce(act, mp) * 4
-            key = (mp * sh, cost)  # minimize splitting first, then comm time
+            # 5%-per-split-doubling penalty: near-ties (inside the cost
+            # model's noise) resolve toward the least-split layout
+            t_eff = t * (1.05 ** float(np.log2(mp * sh)))
+            key = (t_eff, mp * sh)
             if best is None or key < best[0]:
                 best = (key, dp, sh, mp)
     if best is None:  # nothing fits: max sharding

@@ -197,7 +197,11 @@ def apply_megatron_specs(model, rules=None):
     embeddings vocab-sharded).
     """
     rules = rules or [
+        # fused qkv (GPT zoo) and separate q/k/v (TransformerEncoderLayer /
+        # BERT / ERNIE naming) are both column-parallel
         (r"qkv_proj\.weight$", P(None, "mp")), (r"qkv_proj\.bias$", P("mp")),
+        (r"\b[qkv]_proj\.weight$", P(None, "mp")),
+        (r"\b[qkv]_proj\.bias$", P("mp")),
         (r"out_proj\.weight$", P("mp", None)),
         (r"fc1\.weight$", P(None, "mp")), (r"fc1\.bias$", P("mp")),
         (r"fc2\.weight$", P("mp", None)),

@@ -12,4 +12,11 @@ from .datasets import (  # noqa: F401
 )
 from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
 from .bert import BertModel, BertForSequenceClassification, BertForPretraining  # noqa: F401
+from .ernie import (  # noqa: F401
+    ErnieConfig,
+    ErnieForMaskedLM,
+    ErnieForSequenceClassification,
+    ErnieModel,
+    ernie_config,
+)
 from .gpt import GPTModel, GPTForCausalLM, GPTConfig  # noqa: F401

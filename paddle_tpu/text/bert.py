@@ -95,16 +95,17 @@ class BertForPretraining(nn.Layer):
                 masked_lm_labels=None, next_sentence_labels=None):
         seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
         h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
-        from ..tensor_ops.math import matmul
-
-        mlm_logits = matmul(h, self.bert.embeddings.word_embeddings.weight, transpose_y=True)
         nsp_logits = self.nsp(pooled)
         if masked_lm_labels is not None:
-            loss = F.cross_entropy(
-                mlm_logits.reshape([-1, self.cfg.vocab_size]),
-                masked_lm_labels.reshape([-1]), ignore_index=-1,
-            )
+            # fused chunked head+CE: [b, s, vocab] MLM logits never materialize
+            loss = F.linear_cross_entropy(
+                h, self.bert.embeddings.word_embeddings.weight,
+                masked_lm_labels, transpose_y=True, ignore_index=-1)
             if next_sentence_labels is not None:
                 loss = loss + F.cross_entropy(nsp_logits, next_sentence_labels.reshape([-1]))
             return loss
+        from ..tensor_ops.math import matmul
+
+        mlm_logits = matmul(h, self.bert.embeddings.word_embeddings.weight,
+                            transpose_y=True)
         return mlm_logits, nsp_logits

@@ -1,0 +1,130 @@
+"""ERNIE model family (the north-star workload pairing: "GPT-3 6.7B /
+ERNIE-3.0 Fleet hybrid", BASELINE.json north_star).
+
+Reference analog: ERNIE is Baidu's BERT-style encoder trained in PaddlePaddle
+(fleet's flagship NLP workload). Architecturally it extends BERT with a
+task-type embedding on top of word/position/segment embeddings — so the
+implementation REUSES the BERT encoder wiring (bert.py) and adds exactly that.
+One definition serves single-chip and hybrid-parallel runs:
+`fleet.apply_megatron_specs` tags the encoder's separate q/k/v projections,
+ffn linears, and word embeddings for GSPMD tensor parallelism by name.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from .bert import BertConfig, BertEmbeddings, BertModel
+
+
+@dataclass
+class ErnieConfig(BertConfig):
+    vocab_size: int = 18000
+    max_position_embeddings: int = 513
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+
+
+_PRESETS = {
+    "ernie-3.0-base": dict(hidden_size=768, num_layers=12, num_heads=12),
+    "ernie-3.0-medium": dict(hidden_size=768, num_layers=6, num_heads=12),
+    "ernie-3.0-xbase": dict(hidden_size=1024, num_layers=20, num_heads=16,
+                            intermediate_size=4096),
+}
+
+
+def ernie_config(preset: str, **overrides) -> ErnieConfig:
+    cfg = dict(_PRESETS[preset])
+    cfg.update(overrides)
+    return ErnieConfig(**cfg)
+
+
+class ErnieEmbeddings(BertEmbeddings):
+    """BERT embeddings + the ERNIE task-type embedding."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+        self.task_type_embeddings = (
+            nn.Embedding(cfg.task_type_vocab_size, cfg.hidden_size)
+            if cfg.use_task_id else None)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        import paddle_tpu as P
+
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = P.arange(s, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = P.zeros([b, s], dtype="int64")
+        e = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self.task_type_embeddings is not None:
+            if task_type_ids is None:
+                task_type_ids = P.zeros([b, s], dtype="int64")
+            e = e + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(e))
+
+
+class ErnieModel(BertModel):
+    """BERT encoder + pooler with ERNIE embeddings (task_type_ids threaded)."""
+
+    def __init__(self, cfg: ErnieConfig | None = None, **kwargs):
+        cfg = cfg or ErnieConfig(**kwargs)
+        super().__init__(cfg)
+        self.embeddings = ErnieEmbeddings(cfg)  # replace BERT's
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        x = self.encoder(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig | None = None, num_classes=2, **kwargs):
+        super().__init__()
+        cfg = cfg or ErnieConfig(**kwargs)
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, None,
+                               attention_mask, task_type_ids)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits
+
+
+class ErnieForMaskedLM(nn.Layer):
+    """MLM pretraining head (tied decoder, the ERNIE-3.0 objective core)."""
+
+    def __init__(self, cfg: ErnieConfig | None = None, **kwargs):
+        super().__init__()
+        cfg = cfg or ErnieConfig(**kwargs)
+        self.cfg = cfg
+        self.ernie = ErnieModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.norm = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                task_type_ids=None, masked_lm_labels=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, None, attention_mask,
+                            task_type_ids)
+        h = self.norm(F.gelu(self.transform(seq)))
+        if masked_lm_labels is not None:
+            # fused chunked head+CE: [b, s, vocab] logits never materialize
+            return F.linear_cross_entropy(
+                h, self.ernie.embeddings.word_embeddings.weight,
+                masked_lm_labels, transpose_y=True, ignore_index=-1)
+        from ..tensor_ops.math import matmul
+
+        return matmul(h, self.ernie.embeddings.word_embeddings.weight,
+                      transpose_y=True)

@@ -87,6 +87,45 @@ def test_dataset_family_structures():
     np.testing.assert_array_equal(a, b)
 
 
+def test_ernie_family_forward_and_mlm_training():
+    """ERNIE-3.0 family: task-type embeddings flow, classification head, and
+    the tied-MLM objective trains (fused chunked CE path)."""
+    from paddle_tpu.text import (ErnieConfig, ErnieForMaskedLM,
+                                 ErnieForSequenceClassification, ernie_config)
+
+    cfg = ErnieConfig(vocab_size=120, hidden_size=32, num_layers=2,
+                      num_heads=2, intermediate_size=64,
+                      max_position_embeddings=16, hidden_dropout=0.0,
+                      attn_dropout=0.0)
+    paddle.seed(6)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 120, (2, 12)).astype(np.int64))
+    task = paddle.to_tensor(np.ones((2, 12), np.int64))
+
+    clf = ErnieForSequenceClassification(cfg, num_classes=3)
+    logits = clf(ids, task_type_ids=task)
+    assert tuple(logits.shape) == (2, 3)
+    # task-type embedding actually participates
+    base = clf(ids).numpy()
+    assert not np.allclose(base, logits.numpy())
+
+    mlm = ErnieForMaskedLM(cfg)
+    labels = rng.randint(0, 120, (2, 12))
+    labels[0, :6] = -1  # unmasked positions ignored
+    opt = paddle.optimizer.Adam(5e-3, parameters=mlm.parameters())
+    losses = []
+    for _ in range(6):
+        loss = mlm(ids, masked_lm_labels=paddle.to_tensor(labels.astype(np.int64)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+    big = ernie_config("ernie-3.0-xbase")
+    assert big.hidden_size == 1024 and big.num_layers == 20
+
+
 def test_uci_housing_trains_regression():
     from paddle_tpu import nn
 
