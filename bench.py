@@ -115,7 +115,9 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
         new_p, new_st = opt.functional_update(pvals, grads, opt_st, 1e-4)
         return loss, new_p, new_st
 
-    INNER = 4  # steps fused per dispatch: amortizes host->device dispatch latency
+    # steps fused per dispatch: amortizes host->device dispatch latency (the
+    # tunnel RTT is charged once per call, so more inner steps -> less overhead)
+    INNER = int(os.environ.get("BENCH_INNER_STEPS", "8"))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_multi(pvals, opt_st, key, ids_all, labels_all):

@@ -14,7 +14,8 @@ __all__ = [
     "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min", "c_allreduce_prod",
     "c_allreduce_avg", "c_allgather", "c_reducescatter", "c_broadcast",
     "c_identity", "c_concat", "c_split", "send_next", "recv_prev", "send_prev",
-    "recv_next", "c_alltoall", "global_scatter", "global_gather",
+    "recv_next", "send_v2", "recv_v2", "p2p_exchange",
+    "c_alltoall", "global_scatter", "global_gather",
     "c_softmax_with_cross_entropy", "c_embedding", "axis_index", "axis_size",
 ]
 
@@ -128,6 +129,36 @@ def send_prev(x, axis: str):
 
 recv_prev = send_next  # receiving from prev == prev sent forward
 recv_next = send_prev
+
+
+def send_v2(x, axis: str, dst: int, src: int | None = None):
+    """Explicit (src, dst)-addressed in-graph p2p (reference: send_v2 op,
+    operators/collective/send_v2_op.cc). Lowered to a single-pair
+    collective-permute over `axis` — only the (src, dst) link carries data;
+    every other rank's output is zeros (the reference's non-participants
+    simply don't run the op; SPMD must produce a value everywhere).
+
+    src defaults to "every rank sends its own shard to dst-1 convention" —
+    pass it explicitly for one-pair semantics.
+    """
+    if src is None:
+        src = (dst - 1) % jax.lax.axis_size(axis)
+    return jax.lax.ppermute(x, axis, [(src, dst)])
+
+
+def recv_v2(x, axis: str, src: int, dst: int | None = None):
+    """Counterpart of send_v2: ranks other than dst receive zeros
+    (reference: recv_v2_op.cc)."""
+    if dst is None:
+        dst = (src + 1) % jax.lax.axis_size(axis)
+    return jax.lax.ppermute(x, axis, [(src, dst)])
+
+
+def p2p_exchange(x, axis: str, pairs):
+    """General permute over explicit (src, dst) pairs — the building block the
+    1F1B schedule's simultaneous send-forward/recv-backward maps onto
+    (reference: partial_send/partial_recv + p2p_communication.py)."""
+    return jax.lax.ppermute(x, axis, list(pairs))
 
 
 # ---------------- MoE dispatch (global_scatter/global_gather, D18)

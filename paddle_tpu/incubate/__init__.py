@@ -1,5 +1,6 @@
 """paddle.incubate (reference: python/paddle/incubate/) — MoE, ASP sparsity."""
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
+from . import auto_checkpoint  # noqa: F401
 from . import autograd  # noqa: F401
 from .distributed.models.moe import MoELayer  # noqa: F401

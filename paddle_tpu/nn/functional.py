@@ -790,8 +790,10 @@ def linear_cross_entropy(hidden, weight, label, transpose_y=False,
 
         @jax.checkpoint
         def chunk_stats(h_blk, l_blk):
-            logits = (jnp.matmul(h_blk, w.T) if transpose_y
-                      else jnp.matmul(h_blk, w)).astype(jnp.float32)
+            # fp32 MXU accumulation (not a post-hoc cast): bf16 inputs keep
+            # full-precision partial sums, the standard TPU matmul idiom
+            logits = jnp.matmul(h_blk, w.T if transpose_y else w,
+                                preferred_element_type=jnp.float32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
             safe = jnp.where(l_blk == ignore_index, 0, l_blk)
             tgt = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]

@@ -1,0 +1,81 @@
+"""Program debugging/visualization.
+
+Reference analog: ProgramDesc DebugString (proto text dump used everywhere in
+the reference's error messages), fluid/graphviz.py + ir/graph_viz_pass.cc
+(.dot dumps of the op graph).
+"""
+from __future__ import annotations
+
+from .program import OpRole, Program, Variable, _flat_inputs
+
+__all__ = ["program_to_string", "program_to_dot"]
+
+_ROLE_NAMES = {OpRole.Forward: "Forward", OpRole.Backward: "Backward",
+               OpRole.Optimize: "Optimize", OpRole.RPC: "RPC",
+               OpRole.Dist: "Dist", OpRole.LRSched: "LRSched",
+               OpRole.Loss: "Loss"}
+
+
+def _var_sig(v):
+    if isinstance(v, Variable):
+        return f"{v.name}:{v.dtype}{list(v.shape)}"
+    shape = list(getattr(v, "shape", []) or [])
+    return f"<const>:{getattr(v, 'dtype', '?')}{shape}"
+
+
+def program_to_string(program: Program) -> str:
+    """Readable dump of every block/op: types, in/out var signatures, role,
+    device/attr annotations (the DebugString analog)."""
+    lines = []
+    for bi, block in enumerate(program.blocks):
+        lines.append(f"block {bi} ({len(block.ops)} ops):")
+        for i, op in enumerate(block.ops):
+            ins = ", ".join(_var_sig(t) for t in _flat_inputs(op.inputs)
+                            if hasattr(t, "shape"))
+            outs = ", ".join(_var_sig(o) for o in op.outputs)
+            role = _ROLE_NAMES.get(op.op_role, str(op.op_role))
+            extras = ""
+            show_attrs = {k: v for k, v in op.attrs.items()
+                          if isinstance(v, (str, int, float, bool))}
+            if show_attrs:
+                extras = " " + ", ".join(f"{k}={v}" for k, v in
+                                         sorted(show_attrs.items()))
+            lines.append(f"  [{i:3d}] {op.type}({ins}) -> {outs}"
+                         f"  {{role={role}{extras}}}")
+    return "\n".join(lines)
+
+
+def program_to_dot(program: Program, name="program") -> str:
+    """Graphviz .dot of the dataflow (op nodes + var edges) — the
+    graph_viz_pass analog; render with `dot -Tsvg`."""
+    lines = [f"digraph {name} {{", "  rankdir=TB;",
+             '  node [shape=box, fontsize=10];']
+    var_nodes = {}
+
+    def var_node(v):
+        key = id(v)
+        if key not in var_nodes:
+            var_nodes[key] = f"var{len(var_nodes)}"
+            label = _var_sig(v).replace('"', "'")
+            lines.append(
+                f'  {var_nodes[key]} [label="{label}", shape=ellipse, '
+                'fontsize=9, color=gray50];')
+        return var_nodes[key]
+
+    n = 0
+    for block in program.blocks:
+        for op in block.ops:
+            op_id = f"op{n}"
+            n += 1
+            dev = op.attrs.get("device")
+            color = "lightblue" if dev is None else "palegreen"
+            label = op.type + (f"\\n@{dev}" if dev else "")
+            lines.append(f'  {op_id} [label="{label}", style=filled, '
+                         f'fillcolor={color}];')
+            for t in _flat_inputs(op.inputs):
+                if isinstance(t, Variable):
+                    lines.append(f"  {var_node(t)} -> {op_id};")
+            for o in op.outputs:
+                lines.append(f"  {op_id} -> {var_node(o)};")
+    lines.append("}")
+    return "\n".join(lines)

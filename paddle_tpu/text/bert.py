@@ -45,11 +45,13 @@ class BertEmbeddings(nn.Layer):
 
 
 class BertModel(nn.Layer):
+    embeddings_cls = BertEmbeddings  # subclasses (ERNIE) swap the embeddings
+
     def __init__(self, cfg: BertConfig | None = None, **kwargs):
         super().__init__()
         cfg = cfg or BertConfig(**kwargs)
         self.cfg = cfg
-        self.embeddings = BertEmbeddings(cfg)
+        self.embeddings = self.embeddings_cls(cfg)
         enc_layer = nn.TransformerEncoderLayer(
             cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
             dropout=cfg.hidden_dropout, activation="gelu",

@@ -69,15 +69,17 @@ class ErnieEmbeddings(BertEmbeddings):
 
 
 class ErnieModel(BertModel):
-    """BERT encoder + pooler with ERNIE embeddings (task_type_ids threaded)."""
+    """BERT encoder + pooler with ERNIE embeddings (task_type_ids threaded).
+    The positional signature stays BertModel-compatible: attention_mask keeps
+    slot 3, the ERNIE extras append after it."""
+
+    embeddings_cls = ErnieEmbeddings
 
     def __init__(self, cfg: ErnieConfig | None = None, **kwargs):
-        cfg = cfg or ErnieConfig(**kwargs)
-        super().__init__(cfg)
-        self.embeddings = ErnieEmbeddings(cfg)  # replace BERT's
+        super().__init__(cfg or ErnieConfig(**kwargs))
 
-    def forward(self, input_ids, token_type_ids=None, position_ids=None,
-                attention_mask=None, task_type_ids=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None, task_type_ids=None):
         x = self.embeddings(input_ids, token_type_ids, position_ids,
                             task_type_ids)
         x = self.encoder(x, attention_mask)
@@ -95,8 +97,8 @@ class ErnieForSequenceClassification(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None, labels=None):
-        _, pooled = self.ernie(input_ids, token_type_ids, None,
-                               attention_mask, task_type_ids)
+        _, pooled = self.ernie(input_ids, token_type_ids, attention_mask,
+                               task_type_ids=task_type_ids)
         logits = self.classifier(self.dropout(pooled))
         if labels is not None:
             return F.cross_entropy(logits, labels)
@@ -116,8 +118,8 @@ class ErnieForMaskedLM(nn.Layer):
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 task_type_ids=None, masked_lm_labels=None):
-        seq, _ = self.ernie(input_ids, token_type_ids, None, attention_mask,
-                            task_type_ids)
+        seq, _ = self.ernie(input_ids, token_type_ids, attention_mask,
+                            task_type_ids=task_type_ids)
         h = self.norm(F.gelu(self.transform(seq)))
         if masked_lm_labels is not None:
             # fused chunked head+CE: [b, s, vocab] logits never materialize

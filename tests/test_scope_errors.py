@@ -50,6 +50,29 @@ def test_scope_guard_and_executor_fetch_persistence():
         paddle.disable_static()
 
 
+def test_program_debug_string_and_dot():
+    """DebugString/graphviz analogs (reference: fluid/graphviz.py +
+    ir/graph_viz_pass.cc)."""
+    from paddle_tpu import nn, static
+
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2, 4], "float32")
+            with static.device_guard("stage:1"):
+                y = nn.functional.relu(nn.Linear(4, 3)(x))
+        s = static.program_to_string(main)
+        assert "block 0" in s and "relu" in s and "x:float32[2, 4]" in s
+        assert "device=stage:1" in s
+        dot = static.program_to_dot(main)
+        assert dot.startswith("digraph") and dot.rstrip().endswith("}")
+        assert "relu" in dot and "palegreen" in dot  # device-tagged op colored
+        assert dot.count("->") >= 4  # var->op and op->var edges present
+    finally:
+        paddle.disable_static()
+
+
 def test_error_taxonomy_codes_and_builtin_compat():
     with pytest.raises(ValueError) as ei:
         raise errors.InvalidArgumentError("bad axis", axis=7, ndim=2)
