@@ -107,9 +107,21 @@ class TracedLayer:
         return runner
 
 
-def to_static(layer=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+def to_static(layer=None, input_spec=None, build_strategy=None, backend=None,
+              convert_control_flow=False, **kwargs):
     if layer is None:
-        return functools.partial(to_static, input_spec=input_spec)
+        return functools.partial(to_static, input_spec=input_spec,
+                                 convert_control_flow=convert_control_flow)
+    if convert_control_flow:
+        # dy2static AST pass: tensor-dependent if/while survive tracing
+        from .dy2static import convert_control_flow as _convert
+
+        if hasattr(layer, "named_parameters"):
+            converted = _convert(type(layer).forward)
+            if converted is not type(layer).forward:
+                layer.forward = converted.__get__(layer)
+        else:
+            layer = _convert(layer)
     traced = TracedLayer(layer, input_spec)
     if hasattr(layer, "named_parameters"):
         # keep Layer interface: attach traced call

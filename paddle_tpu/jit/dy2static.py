@@ -1,0 +1,335 @@
+"""dy2static: AST conversion of python control flow over Tensors.
+
+Reference analog: the dygraph_to_static stack
+(/root/reference/python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:860 ProgramTranslator + ifelse_transformer.py,
+loop_transformer.py) — rewrite `if`/`while` whose predicates depend on
+Tensors into functional control-flow ops, so the traced program stays valid
+when values are symbolic.
+
+TPU-native lowering:
+- tensor-predicate `if`: both branches evaluate, results merge per-leaf with
+  `where(pred, t, f)` — under jit XLA emits selects (branches are pure; this
+  is the `cond` pattern XLA itself uses for small branches).
+- tensor-predicate `while`: a real `lax.while_loop` over the loop-carried
+  variables (reverse-mode AD through it is not supported by XLA — same as
+  training through an unbounded loop anywhere).
+- python predicates keep python semantics untouched.
+
+Subset contract (checked where possible, documented otherwise): branches must
+be side-effect-free; a variable consumed after a tensor-`if` must be assigned
+in both branches or exist beforehand; loop-carried values must keep shape and
+dtype.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["convert_control_flow", "run_if", "run_while", "MISSING"]
+
+
+class _Missing:
+    def __repr__(self):
+        return "<dy2static: variable not assigned on the taken branch>"
+
+
+MISSING = _Missing()
+
+
+def _is_symbolic(x):
+    v = x._value if isinstance(x, Tensor) else x
+    return isinstance(v, (jax.Array, jax.core.Tracer)) or hasattr(v, "dtype")
+
+
+def _pred_value(pred):
+    v = pred._value if isinstance(pred, Tensor) else pred
+    return v
+
+
+# ------------------------------------------------------------- runtime helpers
+def run_if(pred, true_fn, false_fn, env):
+    """Transformed `if` lands here. Python predicate -> one branch runs;
+    symbolic predicate -> both run, leaves merge with where(pred, ...)."""
+    p = _pred_value(pred)
+    if not _is_symbolic(p):
+        return true_fn(dict(env)) if p else false_fn(dict(env))
+    out_t = true_fn(dict(env))
+    out_f = false_fn(dict(env))
+    merged = {}
+    for k in out_t:
+        a, b = out_t[k], out_f.get(k, MISSING)
+        if a is MISSING and b is MISSING:
+            merged[k] = MISSING
+            continue
+        if a is MISSING or b is MISSING:
+            raise NameError(
+                f"dy2static: variable {k!r} is assigned in only one branch of "
+                "a tensor-dependent `if`; assign it in both branches (or "
+                "before the if)")
+        av = a._value if isinstance(a, Tensor) else a
+        bv = b._value if isinstance(b, Tensor) else b
+        if _is_symbolic(av) or _is_symbolic(bv):
+            sel = jnp.where(p, av, bv)
+            merged[k] = Tensor(sel) if isinstance(a, Tensor) or \
+                isinstance(b, Tensor) else sel
+        else:
+            if av is not bv and av != bv:
+                raise ValueError(
+                    f"dy2static: non-tensor variable {k!r} takes different "
+                    f"values ({av!r} vs {bv!r}) across a tensor-dependent "
+                    "`if` — that value cannot be selected at runtime")
+            merged[k] = a
+    return merged
+
+
+def run_while(cond_fn, body_fn, env):
+    """Transformed `while` lands here. Symbolic predicate -> lax.while_loop
+    over the carried env (Tensors are pytree leaves); python predicate ->
+    plain loop."""
+    p = cond_fn(dict(env))
+    if not _is_symbolic(_pred_value(p)):
+        env = dict(env)
+        while _pred_value(p):
+            env = body_fn(dict(env))
+            p = cond_fn(dict(env))
+        return env
+    # only pre-initialized vars are loop-carried; body-local temps (MISSING at
+    # entry) recompute each iteration and stay unbound after the loop — a
+    # functional while cannot carry a variable with no initial value
+    keys = sorted(k for k, v in env.items() if v is not MISSING)
+
+    def c(vals):
+        pv = _pred_value(cond_fn(dict(zip(keys, vals))))
+        return jnp.asarray(pv).reshape(())
+
+    def b(vals):
+        out = body_fn(dict(zip(keys, vals)))
+        return tuple(out[k] for k in keys)
+
+    vals = jax.lax.while_loop(c, b, tuple(env[k] for k in keys))
+    out = dict(env)  # MISSING entries survive so the guarded rebind skips them
+    out.update(zip(keys, vals))
+    return out
+
+
+def _snapshot(frame_locals, keys):
+    return {k: frame_locals.get(k, MISSING) for k in keys}
+
+
+# --------------------------------------------------------------- AST transform
+class _AssignedNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self.names.add(node.name)  # the def binds its name; don't descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    # synthesized helper/out names from earlier (nested) transforms are
+    # implementation detail, never loop-carried user state
+    return {n for n in v.names if not n.startswith("__jst_")}
+
+
+class _ReadNames(ast.NodeVisitor):
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Load):
+            self.names.add(node.id)
+
+
+def _reads(node_or_stmts):
+    v = _ReadNames()
+    for s in (node_or_stmts if isinstance(node_or_stmts, list)
+              else [node_or_stmts]):
+        v.visit(s)
+    return {n for n in v.names if not n.startswith("__jst")}
+
+
+def _load_prologue(keys):
+    """Guarded `k = __jst_env['k']`: a key that is absent/MISSING stays
+    unbound so reads fall through to globals/builtins (e.g. `jnp` in a loop
+    condition)."""
+    out = []
+    for k in sorted(keys):
+        out.append(ast.parse(
+            f"if not __jst.missing(__jst_env, {k!r}):\n"
+            f"    {k} = __jst_env[{k!r}]").body[0])
+    return out
+
+
+def _return_epilogue(keys):
+    # snapshot() maps still-unassigned names to MISSING instead of NameError
+    return ast.parse(f"return __jst.snapshot(locals(), {sorted(keys)!r})").body[0]
+
+
+def _rebind(keys, out_name):
+    """Guarded rebind: a MISSING result leaves the name unbound, preserving
+    python's UnboundLocalError instead of leaking the sentinel downstream."""
+    return [ast.parse(
+        f"if not __jst.missing({out_name}, {k!r}):\n"
+        f"    {k} = {out_name}[{k!r}]").body[0] for k in sorted(keys)]
+
+
+def _has_flow_escape(stmts):
+    """True if return/break/continue appears at THIS function's level —
+    nested function bodies (incl. the __jst_* helpers synthesized by earlier
+    transforms) have their own flow and must not mask conversion."""
+
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass  # don't descend
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self._top = None
+
+    def visit_FunctionDef(self, node):
+        # transform the function being converted; don't descend into nested
+        # function definitions (their control flow is theirs)
+        if self._top is None:
+            self._top = node
+            self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def _fresh(self, base):
+        self.counter += 1
+        return f"__jst_{base}_{self.counter}"
+
+    def visit_If(self, node):
+        node = self.generic_visit(node)  # transform nested ifs first
+        keys = _assigned(node.body) | _assigned(node.orelse)
+        if not keys:
+            return node  # pure side-effect if (prints etc.): leave it
+        if _has_flow_escape(node.body + node.orelse):
+            # return/break/continue in a branch: leave the python `if` as-is
+            # (correct for python predicates; a tensor predicate will surface
+            # jax's tracer-bool error — reference return_transformer territory)
+            return node
+        tname, fname, oname = (self._fresh("true"), self._fresh("false"),
+                               self._fresh("out"))
+
+        def branch(name, body):
+            fn = ast.parse(f"def {name}(__jst_env):\n    pass").body[0]
+            fn.body = (_load_prologue(keys) + (body or [ast.Pass()])
+                       + [_return_epilogue(keys)])
+            return fn
+
+        call = ast.parse(
+            f"{oname} = __jst.run_if(__jst_PRED__, {tname}, {fname}, "
+            f"__jst.snapshot(locals(), {sorted(keys)!r}))").body[0]
+        call.value.args[0] = node.test  # splice the original predicate expr
+        return ([branch(tname, node.body), branch(fname, node.orelse), call]
+                + _rebind(keys, oname))
+
+    def visit_While(self, node):
+        node = self.generic_visit(node)
+        if node.orelse:
+            return node  # while/else: out of subset, leave untouched
+        keys = _assigned(node.body) | (_reads(node.test) - {"__jst"})
+        if not keys:
+            return node
+        if _has_flow_escape(node.body):
+            return node  # python while stays; see visit_If note
+        cname, bname, oname = (self._fresh("cond"), self._fresh("body"),
+                               self._fresh("out"))
+        cond_fn = ast.parse(f"def {cname}(__jst_env):\n    pass").body[0]
+        cond_fn.body = _load_prologue(keys) + [
+            ast.fix_missing_locations(ast.Return(value=node.test))]
+        body_fn = ast.parse(f"def {bname}(__jst_env):\n    pass").body[0]
+        body_fn.body = (_load_prologue(keys) + node.body
+                        + [_return_epilogue(keys)])
+        call = ast.parse(
+            f"{oname} = __jst.run_while({cname}, {bname}, "
+            f"__jst.snapshot(locals(), {sorted(keys)!r}))").body[0]
+        return [cond_fn, body_fn, call] + _rebind(keys, oname)
+
+
+class _JstNamespace:
+    run_if = staticmethod(run_if)
+    run_while = staticmethod(run_while)
+    snapshot = staticmethod(_snapshot)
+    MISSING = MISSING
+
+    @staticmethod
+    def missing(env, key):
+        return key not in env or env[key] is MISSING
+
+
+def convert_control_flow(fn):
+    """AST-convert `fn` so tensor-dependent if/while survive tracing
+    (the ProgramTranslator entry point; compose with paddle.jit.to_static)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError):
+        return fn  # no source (builtins, lambdas from REPL): nothing to do
+    tree = ast.parse(src)
+    fdef = tree.body[0]
+    # drop decorators so applying @to_static(...) around this doesn't recurse
+    fdef.decorator_list = []
+    _ControlFlowTransformer().visit(fdef)
+    ast.fix_missing_locations(tree)
+    code = compile(tree, filename=f"<dy2static {fn.__name__}>", mode="exec")
+    glb = dict(fn.__globals__)
+    glb["__jst"] = _JstNamespace
+    # exec can't recreate closures: splice the current cell values of the
+    # original function's free variables in as globals
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            glb[name] = cell.cell_contents
+    loc: dict = {}
+    exec(code, glb, loc)  # noqa: S102 — compiling the user's own source
+    out = loc[fdef.name]
+    out = functools.wraps(fn)(out)
+    out.__wrapped_original__ = fn
+    return out
