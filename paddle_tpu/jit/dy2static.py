@@ -272,6 +272,51 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return ([branch(tname, node.body), branch(fname, node.orelse), call]
                 + _rebind(keys, oname))
 
+    def visit_For(self, node):
+        """`for i in range(...)` desugars to the while machinery (reference
+        loop_transformer.py for_loop handling). Subset: simple Name target,
+        range() with 1-3 args (a step must be a literal int so its sign is
+        static), no else/break/continue. Anything else stays python.
+
+        The loop target is a body-local of the while: after a zero-iteration
+        python range it stays unbound (python semantics); after a
+        tensor-bound loop it is not readable (functional loops don't leak
+        body temps — documented subset edge)."""
+        it = node.iter
+        if (node.orelse or _has_flow_escape(node.body)
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(it, ast.Call)
+                or not isinstance(it.func, ast.Name) or it.func.id != "range"
+                or it.keywords or not 1 <= len(it.args) <= 3):
+            return self.generic_visit(node)
+        step_val = 1
+        if len(it.args) == 3:
+            s = it.args[2]
+            if not (isinstance(s, ast.Constant) and isinstance(s.value, int)
+                    and s.value != 0):
+                return self.generic_visit(node)  # dynamic step sign: python
+            step_val = s.value
+        if len(it.args) == 1:
+            start, stop = ast.Constant(value=0), it.args[0]
+        else:
+            start, stop = it.args[0], it.args[1]
+        tgt = node.target.id
+        self.counter += 1
+        cn, sn = f"__d2s_c_{self.counter}", f"__d2s_stop_{self.counter}"
+        cmp_op = "<" if step_val > 0 else ">"
+        # range args hoisted to names: evaluated exactly once, like range()
+        pre = ast.parse(f"{cn} = __START__\n{sn} = __STOP__").body
+        pre[0].value = start
+        pre[1].value = stop
+        shell = ast.parse(
+            f"while {cn} {cmp_op} {sn}:\n"
+            f"    {tgt} = {cn}\n"
+            f"    {cn} = {cn} + ({step_val})").body[0]
+        # original (unvisited) body spliced in; visit_While transforms it once
+        shell.body = shell.body[:1] + list(node.body) + shell.body[1:]
+        converted = self.visit_While(shell)
+        return pre + (converted if isinstance(converted, list) else [converted])
+
     def visit_While(self, node):
         node = self.generic_visit(node)
         if node.orelse:

@@ -8,7 +8,9 @@ from ..core.tensor import Tensor
 
 __all__ = ["norm", "bmm", "mm", "histogram", "mv", "matrix_power", "cholesky",
            "svd", "pinv", "solve", "triangular_solve", "qr", "eig", "eigvals",
-           "matrix_rank", "det", "slogdet", "inv", "cross", "dist", "cond"]
+           "matrix_rank", "det", "slogdet", "inv", "cross", "dist", "cond",
+           "eigh", "eigvalsh", "lu", "lstsq", "cholesky_solve", "cov",
+           "corrcoef"]
 
 
 def _to_t(x):
@@ -133,3 +135,83 @@ def dist(x, y, p=2, name=None):
 
 def cond(x, p=None, name=None):
     return primitive_call(lambda a: jnp.linalg.cond(a, p=p), _to_t(x).detach())
+
+
+def eigh(x, UPLO="L", name=None):
+    """reference: python/paddle/tensor/linalg.py eigh — symmetric/hermitian
+    eigendecomposition (MXU-friendly: XLA's syevd). symmetrize_input=False:
+    UPLO selects ONE triangle (paddle/numpy semantics), it does not average."""
+    return primitive_call(
+        lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO, symmetrize_input=False)),
+        _to_t(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return primitive_call(
+        lambda a: jnp.linalg.eigh(a, UPLO=UPLO, symmetrize_input=False)[0],
+        _to_t(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """reference: tensor/linalg.py lu — returns (LU packed, pivots[, infos]).
+    Pivots follow the paddle convention (1-based row swaps)."""
+    if not pivot:
+        raise NotImplementedError(
+            "lu(pivot=False): XLA's LU is always partial-pivoted; returning "
+            "a pivoted factorization under the no-pivot contract would be "
+            "silently wrong")
+
+    def g(a):
+        import jax.scipy.linalg as jsl
+
+        lu_packed, piv = jsl.lu_factor(a)
+        out = (lu_packed, (piv + 1).astype(jnp.int32))
+        if get_infos:
+            out = out + (jnp.zeros((), jnp.int32),)
+        return out
+
+    return primitive_call(g, _to_t(x))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """reference: tensor/linalg.py lstsq — least squares; returns
+    (solution, residuals, rank, singular_values)."""
+
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int32), sv
+
+    return primitive_call(f, _to_t(x), _to_t(y))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """reference: tensor/linalg.py cholesky_solve — solve A X = B given the
+    Cholesky factor of A."""
+    import jax
+
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return primitive_call(f, _to_t(x), _to_t(y))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """reference: tensor/linalg.py cov."""
+
+    def f(a, *ws):
+        fw = ws[0] if fweights is not None else None
+        aw = (ws[1] if fweights is not None else ws[0]) if aweights is not None else None
+        return jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+
+    args = [_to_t(x)]
+    if fweights is not None:
+        args.append(_to_t(fweights).detach())
+    if aweights is not None:
+        args.append(_to_t(aweights).detach())
+    return primitive_call(f, *args)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    """reference: tensor/linalg.py corrcoef."""
+    return primitive_call(lambda a: jnp.corrcoef(a, rowvar=rowvar), _to_t(x))

@@ -21,8 +21,17 @@ def _to_t(x):
 
 def _cmp(name, f):
     def op(x, y, name=None):
+        import jax
+
         x = _to_t(x)
-        y = y if isinstance(y, Tensor) else Tensor(np.asarray(y))
+        if isinstance(y, Tensor):
+            pass
+        elif isinstance(y, (jax.Array, jax.core.Tracer, np.ndarray)):
+            y = Tensor(y)  # keeps tracers traced (no np.asarray round-trip)
+        else:
+            # python scalar: compare in x's dtype (paddle semantics — a
+            # default-dtype cast would corrupt float64 comparisons)
+            y = Tensor(jnp.asarray(y, dtype=x._value.dtype))
         return primitive_call(lambda a, b: f(a, b), x.detach(), y.detach())
 
     op.__name__ = name

@@ -98,6 +98,78 @@ def test_tensor_while_loop():
     assert float(hh(Tensor(np.zeros((), np.float32)), 3)) == 3.0
 
 
+def test_for_range_conversion_python_and_tensor_bounds():
+    def g(x, n):
+        acc = x * 0.0
+        for i in range(n):
+            acc = acc + 1.0
+        return acc
+
+    cg = convert_control_flow(g)
+    # python int bound: unchanged semantics
+    assert float(cg(Tensor(np.zeros((), np.float32)), 4)) == 4.0
+    # tensor bound under one jit trace
+    import jax
+
+    @jax.jit
+    def traced(n_arr):
+        from paddle_tpu.core import tape
+
+        with tape.no_grad():
+            return cg(Tensor(np.zeros((), np.float32)), Tensor(n_arr))._value
+
+    assert float(np.asarray(traced(np.int32(5)))) == 5.0
+    assert float(np.asarray(traced(np.int32(2)))) == 2.0  # same compiled fn
+
+    # range(start, stop) + loop-var use inside the body
+    def h(x, n):
+        s = x * 0.0
+        for i in range(1, n):
+            s = s + i
+        return s
+
+    ch = convert_control_flow(h)
+    assert float(ch(Tensor(np.zeros((), np.float32)), 4)) == 6.0  # 1+2+3
+
+    # negative literal step stays python-correct
+    def k(x):
+        s = x * 0.0
+        for i in range(3, 0, -1):
+            s = s + i
+        return s
+
+    assert float(convert_control_flow(k)(Tensor(np.zeros((), np.float32)))) == 6.0
+
+
+def test_for_range_python_edge_semantics():
+    """Zero-iteration ranges keep python semantics (loop var untouched) and
+    range args evaluate exactly once."""
+    def f(x, i):
+        for i in range(5, 5):
+            x = x + 1.0
+        return x, i
+
+    cf = convert_control_flow(f)
+    out, i = cf(Tensor(np.zeros((), np.float32)), 99)
+    assert float(out) == 0.0 and i == 99  # untaken loop leaves i alone
+
+    calls = []
+
+    def side(v):
+        calls.append(v)
+        return v
+
+    def g(x, n):
+        s = x * 0.0
+        for i in range(side(1), n):
+            s = s + i
+        return s
+
+    cg = convert_control_flow(g)
+    assert float(cg(Tensor(np.zeros((), np.float32)), 4)) == 6.0
+    assert calls == [1], calls  # start expression evaluated once
+
+
 def test_closure_and_globals_survive():
     scale = 3.0
 

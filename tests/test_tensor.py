@@ -165,3 +165,54 @@ def test_inplace_ops():
     assert t.tolist() == [4.0, 6.0]
     t.zero_()
     assert t.tolist() == [0.0, 0.0]
+
+
+def test_linalg_eigh_lu_lstsq_family():
+    """Round-3 linalg additions (reference: python/paddle/tensor/linalg.py
+    eigh/eigvalsh/lu/lstsq/cholesky_solve/cov/corrcoef)."""
+    rng = np.random.RandomState(0)
+    A = rng.randn(4, 4).astype(np.float32)
+    S = (A + A.T) / 2
+    w, v = paddle.linalg.eigh(paddle.to_tensor(S))
+    recon = np.asarray(v._value) @ np.diag(np.asarray(w._value)) @ np.asarray(v._value).T
+    np.testing.assert_allclose(recon, S, atol=1e-4)
+    np.testing.assert_allclose(
+        np.sort(np.asarray(paddle.linalg.eigvalsh(paddle.to_tensor(S))._value)),
+        np.sort(np.asarray(w._value)), rtol=1e-5)
+
+    lu_packed, piv = paddle.linalg.lu(paddle.to_tensor(A))
+    assert tuple(lu_packed.shape) == (4, 4)
+    assert int(np.asarray(piv._value).min()) >= 1  # paddle 1-based pivots
+
+    b = rng.randn(4, 2).astype(np.float32)
+    sol, _, rank, _ = paddle.linalg.lstsq(paddle.to_tensor(A), paddle.to_tensor(b))
+    np.testing.assert_allclose(np.asarray(sol._value),
+                               np.linalg.lstsq(A, b, rcond=None)[0], atol=1e-3)
+    assert int(rank) == 4
+
+    P = S @ S.T + 4 * np.eye(4, dtype=np.float32)
+    L = np.linalg.cholesky(P).astype(np.float32)
+    x = paddle.linalg.cholesky_solve(paddle.to_tensor(b), paddle.to_tensor(L))
+    np.testing.assert_allclose(P @ np.asarray(x._value), b, atol=1e-3)
+
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.cov(paddle.to_tensor(A))._value), np.cov(A),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.corrcoef(paddle.to_tensor(A))._value),
+        np.corrcoef(A), rtol=1e-4)
+
+    # UPLO selects one triangle (numpy/paddle semantics), no symmetrization
+    tri = np.array([[1.0, 100.0], [0.0, 1.0]], np.float32)
+    w_l = np.asarray(paddle.linalg.eigvalsh(paddle.to_tensor(tri), UPLO="L")._value)
+    np.testing.assert_allclose(np.sort(w_l), [1.0, 1.0], atol=1e-5)
+    with pytest.raises(NotImplementedError):
+        paddle.linalg.lu(paddle.to_tensor(A), pivot=False)
+
+
+def test_scalar_comparison_respects_tensor_dtype():
+    """Python-scalar comparisons cast to the tensor's dtype (float64 safe)."""
+    t64 = paddle.to_tensor(np.float64(0.1))
+    assert bool(paddle.equal(t64, 0.1))
+    t32 = paddle.to_tensor(np.float32(0.5))
+    assert bool(paddle.equal(t32, 0.5))
