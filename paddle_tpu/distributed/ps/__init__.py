@@ -13,7 +13,7 @@ fallback; transport is a threaded length-prefixed socket protocol (the brpc
 substitute); workers pull rows / push grads asynchronously (async-SGD, the
 reference's default PS mode).
 """
-from .tables import CtrAccessor, DenseTable, SparseTable  # noqa: F401
+from .tables import CtrAccessor, DenseTable, SparseTable, SsdSparseTable  # noqa: F401
 from .service import PsServer, PsClient  # noqa: F401
 from .role_maker import PaddleCloudRoleMaker, Role  # noqa: F401
 from .runtime import (  # noqa: F401

@@ -249,3 +249,136 @@ class CtrAccessor:
                 self._show.pop(int(i), None)
                 self._click.pop(int(i), None)
         return removed
+
+
+class SsdSparseTable:
+    """Disk-spilling sparse table: a bounded hot cache in RAM, cold rows on
+    an append-only file with an offset index.
+
+    Reference analog: SSDSparseTable
+    (/root/reference/paddle/fluid/distributed/ps/table/ssd_sparse_table.cc —
+    rocksdb-backed rows behind a memory cache) — the mechanism that lets CTR
+    tables exceed RAM. Here the store is an append-only .bin + offset dict
+    (compaction on save); eviction is LRU.
+    """
+
+    def __init__(self, dim: int, path: str, cache_rows: int = 100_000,
+                 optimizer="sgd", lr=0.05, epsilon=1e-6, seed=0,
+                 init_range=0.05):
+        import collections
+        import os
+
+        self.dim = int(dim)
+        self.optimizer = _OPT[optimizer]
+        self.lr = float(lr)
+        self.epsilon = float(epsilon)
+        self.path = path
+        self.cache_rows = int(cache_rows)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._file = open(path, "a+b")
+        self._offsets: dict[int, int] = {}  # id -> byte offset of latest row
+        self._hot: "collections.OrderedDict[int, np.ndarray]" = \
+            collections.OrderedDict()
+        self._dirty: set[int] = set()
+        self._rng = np.random.RandomState(seed)
+        self._init_range = init_range
+        self._mu = threading.Lock()
+        # adagrad co-stores its accumulator after the weights in each record
+        self._width = self.dim * (2 if self.optimizer == ADAGRAD else 1)
+        self._row_bytes = self._width * 4
+
+    # ---------------------------------------------------------------- disk io
+    def _spill(self, fid: int, row: np.ndarray):
+        if fid in self._offsets and fid not in self._dirty:
+            return  # clean row already on disk: no append (read-only safety)
+        self._file.seek(0, 2)
+        off = self._file.tell()
+        self._file.write(row.astype(np.float32).tobytes())
+        self._offsets[fid] = off
+        self._dirty.discard(fid)
+
+    def _load(self, fid: int) -> np.ndarray:
+        self._file.seek(self._offsets[fid])
+        return np.frombuffer(self._file.read(self._row_bytes),
+                             np.float32).copy()
+
+    def _evict_if_needed(self):
+        while len(self._hot) > self.cache_rows:
+            fid, row = self._hot.popitem(last=False)  # LRU
+            self._spill(fid, row)
+
+    def _row(self, fid: int) -> np.ndarray:
+        if fid in self._hot:
+            self._hot.move_to_end(fid)
+            return self._hot[fid]
+        if fid in self._offsets:
+            row = self._load(fid)
+        else:
+            row = np.zeros(self._width, np.float32)
+            row[: self.dim] = self._rng.uniform(
+                -self._init_range, self._init_range, self.dim)
+            self._dirty.add(fid)  # fresh row exists only in RAM
+        self._hot[fid] = row
+        self._evict_if_needed()
+        return row
+
+    # ------------------------------------------------------------- table API
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        if ids.size == 0:
+            return np.empty((0, self.dim), np.float32)
+        with self._mu:
+            return np.stack([self._row(int(i))[: self.dim] for i in ids])
+
+    def push_grad(self, ids: np.ndarray, grads: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        g = np.ascontiguousarray(grads, np.float32).reshape(ids.size, self.dim)
+        with self._mu:
+            for k, i in enumerate(ids):
+                row = self._row(int(i))  # mutated in place (it IS the cached obj)
+                if self.optimizer == ADAGRAD:
+                    row[self.dim:] += g[k] * g[k]
+                    row[: self.dim] -= self.lr * g[k] / (
+                        np.sqrt(row[self.dim:]) + self.epsilon)
+                else:
+                    row[: self.dim] -= self.lr * g[k]
+                self._dirty.add(int(i))
+
+    def size(self) -> int:
+        with self._mu:
+            return len(set(self._hot) | set(self._offsets))
+
+    def hot_rows(self) -> int:
+        return len(self._hot)
+
+    def save(self, path: str | None = None):
+        """No arg: compact the live store in place (dedups append history).
+        With a path: write a checkpoint COPY there — the live table keeps its
+        own backing file (a checkpoint must not move the working store)."""
+        import os
+
+        checkpoint = path is not None
+        target = path or self.path
+        tmp = target + ".compact"
+        with self._mu:
+            all_ids = sorted(set(self._hot) | set(self._offsets))
+            new_offsets = {}
+            with open(tmp, "wb") as out:
+                for fid in all_ids:
+                    row = (self._hot[fid] if fid in self._hot
+                           else self._load(fid))
+                    new_offsets[fid] = out.tell()
+                    out.write(row.astype(np.float32).tobytes())
+            os.replace(tmp, target)
+            if not checkpoint:
+                self._file.close()
+                self._file = open(target, "a+b")
+                self._offsets = new_offsets
+                self._hot.clear()
+                self._dirty.clear()
+
+    def close(self):
+        try:
+            self._file.close()
+        except Exception:
+            pass
