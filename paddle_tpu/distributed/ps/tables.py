@@ -274,9 +274,17 @@ class SsdSparseTable:
         self.epsilon = float(epsilon)
         self.path = path
         self.cache_rows = int(cache_rows)
+        if self.cache_rows < 1:
+            raise ValueError("cache_rows must be >= 1 (a 0-row cache would "
+                             "silently drop every in-place update)")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._file = open(path, "a+b")
         self._offsets: dict[int, int] = {}  # id -> byte offset of latest row
+        if os.path.exists(path + ".idx"):  # restart: recover the last save()
+            import json
+
+            with open(path + ".idx") as f:
+                self._offsets = {int(k): v for k, v in json.load(f).items()}
         self._hot: "collections.OrderedDict[int, np.ndarray]" = \
             collections.OrderedDict()
         self._dirty: set[int] = set()
@@ -357,7 +365,7 @@ class SsdSparseTable:
         own backing file (a checkpoint must not move the working store)."""
         import os
 
-        checkpoint = path is not None
+        checkpoint = path is not None and path != self.path
         target = path or self.path
         tmp = target + ".compact"
         with self._mu:
@@ -370,12 +378,46 @@ class SsdSparseTable:
                     new_offsets[fid] = out.tell()
                     out.write(row.astype(np.float32).tobytes())
             os.replace(tmp, target)
+            import json
+
+            with open(target + ".idx.tmp", "w") as f:  # restartable index
+                json.dump({str(k): v for k, v in new_offsets.items()}, f)
+            os.replace(target + ".idx.tmp", target + ".idx")
             if not checkpoint:
                 self._file.close()
                 self._file = open(target, "a+b")
                 self._offsets = new_offsets
                 self._hot.clear()
                 self._dirty.clear()
+
+    def erase(self, ids: np.ndarray) -> int:
+        """Drop rows (CtrAccessor.shrink contract); file space reclaims at the
+        next compaction."""
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        with self._mu:
+            n = 0
+            for i in ids:
+                i = int(i)
+                had = i in self._hot or i in self._offsets
+                self._hot.pop(i, None)
+                self._offsets.pop(i, None)
+                self._dirty.discard(i)
+                n += had
+            return n
+
+    def export(self):
+        """(ids, rows) snapshot — same contract as SparseTable.export, so
+        CtrAccessor composes with the disk tier too."""
+        with self._mu:
+            all_ids = np.array(sorted(set(self._hot) | set(self._offsets)),
+                               np.int64)
+            if not all_ids.size:
+                return all_ids, np.zeros((0, self.dim), np.float32)
+            rows = np.stack([
+                (self._hot[int(i)] if int(i) in self._hot
+                 else self._load(int(i)))[: self.dim]
+                for i in all_ids])
+            return all_ids, rows
 
     def close(self):
         try:

@@ -164,3 +164,18 @@ def kl_divergence(p, q):
         pp = jax.nn.softmax(p.logits)
         return Tensor(jnp.sum(pp * (jax.nn.log_softmax(p.logits) - jax.nn.log_softmax(q.logits)), axis=-1))
     raise NotImplementedError(f"kl_divergence({type(p)}, {type(q)})")
+
+
+from .transform import (  # noqa: E402,F401
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    Independent,
+    PowerTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)

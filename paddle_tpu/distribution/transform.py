@@ -1,0 +1,230 @@
+"""Distribution transforms + TransformedDistribution + Independent.
+
+Reference analog: python/paddle/distribution/transform.py (AffineTransform,
+ExpTransform, SigmoidTransform, TanhTransform, PowerTransform,
+SoftmaxTransform, StickBreakingTransform, ChainTransform),
+transformed_distribution.py, independent.py. Each transform provides
+forward/inverse and forward_log_det_jacobian; TransformedDistribution
+composes them over a base distribution with the change-of-variables formula.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import next_rng_key
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "PowerTransform", "SoftmaxTransform",
+    "StickBreakingTransform", "ChainTransform", "TransformedDistribution",
+    "Independent",
+]
+
+
+def _arr(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Bijection y = f(x) with log|det J_f(x)| (reference transform.py:70)."""
+
+    #: event dims consumed by one application (0 = elementwise)
+    _event_dim = 0
+
+    def forward(self, x):
+        return Tensor(self._forward(_arr(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_arr(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_arr(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_arr(y))))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x (reference transform.py AffineTransform)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2*(log2 - x - softplus(-2x)), the stable form
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Normalizing map (not bijective on R^n — no log-det; reference
+    SoftmaxTransform likewise only maps)."""
+
+    _event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError("SoftmaxTransform is not a bijection")
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} -> open simplex^{n+1} (reference StickBreakingTransform)."""
+
+    _event_dim = 1
+
+    def _forward(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zcum = jnp.cumprod(1 - z, axis=-1)
+        head = z * jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), zcum[..., :-1]], axis=-1)
+        return jnp.concatenate([head, zcum[..., -1:]], axis=-1)
+
+    def _inverse(self, y):
+        n = y.shape[-1] - 1
+        ycum = jnp.cumsum(y[..., :-1], axis=-1)
+        rem = 1 - jnp.concatenate(
+            [jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], axis=-1)
+        z = y[..., :-1] / rem
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _fldj(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        zcum = jnp.cumsum(jnp.log1p(-z), axis=-1)
+        pre = jnp.concatenate(
+            [jnp.zeros_like(zcum[..., :1]), zcum[..., :-1]], axis=-1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + pre, axis=-1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            ld = t._fldj(x)
+            # reduce elementwise jacobians over the widest event shape seen
+            total = total + ld
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution:
+    """base distribution pushed through transforms (reference
+    transformed_distribution.py): log_prob via change of variables."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transform = (transforms if isinstance(transforms, Transform)
+                          else ChainTransform(list(transforms)))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        y = _arr(value)
+        x = self.transform._inverse(y)
+        base_lp = _arr(self.base.log_prob(Tensor(x)))
+        return Tensor(base_lp - self.transform._fldj(x))
+
+
+class Independent:
+    """Reinterpret `reinterpreted_batch_rank` batch dims as event dims
+    (reference independent.py): log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = _arr(self.base.log_prob(value))
+        axes = tuple(range(-self.rank, 0))
+        return Tensor(jnp.sum(lp, axis=axes))
+
+    def entropy(self):
+        ent = _arr(self.base.entropy())
+        axes = tuple(range(-self.rank, 0))
+        return Tensor(jnp.sum(ent, axis=axes))

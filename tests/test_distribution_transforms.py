@@ -1,0 +1,90 @@
+"""Distribution transforms / TransformedDistribution / Independent tests
+(reference: python/paddle/distribution/{transform,transformed_distribution,
+independent}.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distribution import (
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    Independent,
+    Normal,
+    PowerTransform,
+    SigmoidTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    TransformedDistribution,
+)
+
+
+def test_lognormal_via_transformed_distribution():
+    mu, sig = 0.3, 0.7
+    ln = TransformedDistribution(Normal(mu, sig), [ExpTransform()])
+    y = np.array([0.5, 1.0, 2.5])
+    lp = np.asarray(ln.log_prob(Tensor(y))._value)
+    ref = -np.log(y * sig * np.sqrt(2 * np.pi)) - (np.log(y) - mu) ** 2 / (2 * sig**2)
+    np.testing.assert_allclose(lp, ref, rtol=1e-5)
+    s = ln.sample((1000,))
+    assert (np.asarray(s._value) > 0).all()  # support of a log-normal
+
+
+@pytest.mark.parametrize("t,x", [
+    (AffineTransform(1.5, -2.0), np.array([0.3, -0.7])),
+    (ExpTransform(), np.array([0.1, 1.2])),
+    (SigmoidTransform(), np.array([-1.0, 2.0])),
+    (TanhTransform(), np.array([0.4, -0.9])),
+    (PowerTransform(3.0), np.array([0.5, 1.4])),
+])
+def test_transform_roundtrip_and_numeric_jacobian(t, x):
+    y = np.asarray(t.forward(Tensor(x))._value)
+    np.testing.assert_allclose(np.asarray(t.inverse(Tensor(y))._value), x,
+                               rtol=1e-4)
+    eps = 1e-5
+    num = np.log(np.abs(
+        (np.asarray(t.forward(Tensor(x + eps))._value)
+         - np.asarray(t.forward(Tensor(x - eps))._value)) / (2 * eps)))
+    np.testing.assert_allclose(
+        np.asarray(t.forward_log_det_jacobian(Tensor(x))._value), num,
+        rtol=1e-3, atol=1e-5)
+    # inverse_log_det is the negation at the mapped point
+    np.testing.assert_allclose(
+        np.asarray(t.inverse_log_det_jacobian(Tensor(y))._value), -num,
+        rtol=1e-3, atol=1e-5)
+
+
+def test_chain_transform_composes():
+    ch = ChainTransform([AffineTransform(0.5, 2.0), TanhTransform()])
+    x = np.array([0.1, -0.3])
+    y = np.asarray(ch.forward(Tensor(x))._value)
+    np.testing.assert_allclose(np.asarray(ch.inverse(Tensor(y))._value), x,
+                               rtol=1e-5)
+    num = np.log(np.abs(2.0 * (1 - np.tanh(0.5 + 2 * x) ** 2)))
+    np.testing.assert_allclose(
+        np.asarray(ch.forward_log_det_jacobian(Tensor(x))._value), num,
+        rtol=1e-5)
+
+
+def test_stick_breaking_simplex():
+    sb = StickBreakingTransform()
+    x = np.random.RandomState(0).randn(5, 3)
+    simplex = np.asarray(sb.forward(Tensor(x))._value)
+    assert simplex.shape == (5, 4)
+    np.testing.assert_allclose(simplex.sum(-1), 1.0, rtol=1e-5)
+    assert (simplex > 0).all()
+    np.testing.assert_allclose(np.asarray(sb.inverse(Tensor(simplex))._value),
+                               x, rtol=1e-4)
+
+
+def test_independent_sums_event_dims():
+    base = Normal(np.zeros(4, np.float32), np.ones(4, np.float32))
+    ind = Independent(base, 1)
+    v = np.zeros(4, np.float32)
+    lp = float(np.asarray(ind.log_prob(Tensor(v))._value))
+    per = float(np.asarray(base.log_prob(Tensor(v))._value).reshape(-1)[0])
+    assert lp == pytest.approx(4 * per, rel=1e-5)
+    ent = float(np.asarray(ind.entropy()._value))
+    per_e = float(np.asarray(base.entropy()._value).reshape(-1)[0])
+    assert ent == pytest.approx(4 * per_e, rel=1e-5)

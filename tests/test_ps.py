@@ -158,6 +158,33 @@ def test_ssd_sparse_table_spills_and_compacts(tmp_path):
     ta.close()
 
 
+def test_ssd_sparse_table_restart_and_ctr_compose(tmp_path):
+    """save() must round-trip across a process restart (offset index sidecar)
+    and the table must compose with CtrAccessor (export/erase contract)."""
+    from paddle_tpu.distributed.ps import CtrAccessor, SsdSparseTable
+
+    path = str(tmp_path / "emb.bin")
+    t = SsdSparseTable(dim=4, path=path, cache_rows=4, lr=0.5, seed=1)
+    vals = t.pull(np.arange(12))
+    t.push_grad(np.array([3]), np.ones((1, 4), np.float32))
+    trained = t.pull(np.array([3]))[0].copy()
+    t.save()
+    t.close()
+
+    t2 = SsdSparseTable(dim=4, path=path, cache_rows=4, lr=0.5, seed=999)
+    assert t2.size() == 12  # restart recovered the saved rows
+    np.testing.assert_allclose(t2.pull(np.array([3]))[0], trained)
+    np.testing.assert_allclose(t2.pull(np.array([7]))[0], vals[7])
+
+    acc = CtrAccessor(t2)
+    acc.update(np.array([3, 7]), shows=[10, 10])
+    removed = acc.shrink(1.0)  # evict everything never shown
+    assert removed == 10 and t2.size() == 2
+    with pytest.raises(ValueError):
+        SsdSparseTable(dim=4, path=str(tmp_path / "z.bin"), cache_rows=0)
+    t2.close()
+
+
 def test_ctr_accessor_decay_and_shrink():
     """CTR accessor (reference ctr_accessor.cc + MemorySparseTable::Shrink):
     show/click scores decay per pass; shrink evicts low-score features from
