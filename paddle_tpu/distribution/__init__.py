@@ -15,7 +15,9 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
 
 
 def _v(x):
-    return x._value if isinstance(x, Tensor) else jnp.asarray(np.asarray(x), jnp.float32)
+    # jnp.asarray keeps tracers traced (np.asarray broke tracing) while still
+    # normalizing python/numpy/integer inputs to float32
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x, jnp.float32)
 
 
 class Distribution:
@@ -158,11 +160,42 @@ class Multinomial(Distribution):
 
 
 def kl_divergence(p, q):
+    """Closed-form KL pairs (reference: python/paddle/distribution/kl.py
+    register table — normal/categorical/uniform/bernoulli/beta/dirichlet)."""
+    from jax.scipy.special import betaln, digamma, gammaln
+
     if isinstance(p, Normal) and isinstance(q, Normal):
         return p.kl_divergence(q)
     if isinstance(p, Categorical) and isinstance(q, Categorical):
         pp = jax.nn.softmax(p.logits)
         return Tensor(jnp.sum(pp * (jax.nn.log_softmax(p.logits) - jax.nn.log_softmax(q.logits)), axis=-1))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        inside = (q.low <= p.low) & (p.high <= q.high)
+        kl = jnp.log((q.high - q.low) / (p.high - p.low))
+        return Tensor(jnp.where(inside, kl, jnp.inf))
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        a = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+        b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+        kl = (a * (jnp.log(a) - jnp.log(b))
+              + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+        # degenerate q has no support where p puts mass: true KL is +inf
+        # (consistent with the Uniform out-of-support branch above)
+        return Tensor(jnp.where((q.probs <= 0) | (q.probs >= 1), jnp.inf, kl))
+    if isinstance(p, Beta) and isinstance(q, Beta):
+        s_p = p.alpha + p.beta
+        kl = (betaln(q.alpha, q.beta) - betaln(p.alpha, p.beta)
+              + (p.alpha - q.alpha) * digamma(p.alpha)
+              + (p.beta - q.beta) * digamma(p.beta)
+              + (q.alpha - p.alpha + q.beta - p.beta) * digamma(s_p))
+        return Tensor(kl)
+    if isinstance(p, Dirichlet) and isinstance(q, Dirichlet):
+        a, b = p.concentration, q.concentration
+        a0 = jnp.sum(a, axis=-1)
+        kl = (gammaln(a0) - jnp.sum(gammaln(a), axis=-1)
+              - gammaln(jnp.sum(b, axis=-1)) + jnp.sum(gammaln(b), axis=-1)
+              + jnp.sum((a - b) * (digamma(a) - digamma(a0)[..., None]),
+                        axis=-1))
+        return Tensor(kl)
     raise NotImplementedError(f"kl_divergence({type(p)}, {type(q)})")
 
 
