@@ -11,8 +11,7 @@ Structure:
     (a SIGALRM can't interrupt a native call blocked inside the TPU tunnel),
     first on the default platform (TPU), then a forced-CPU child as fallback.
   - TPU child: walks an OOM-adaptive config ladder (batch/layers/remat policy)
-    until one fits, then — time permitting — attempts one upgrade rung and
-    keeps the better measurement. Device capacity is strategy, not a constant
+    until one fits. Device capacity is strategy, not a constant
     (reference spirit: ipu_strategy.h:32 — num_ipus/micro-batch are strategy).
 """
 from __future__ import annotations
@@ -55,19 +54,21 @@ def _is_oom(err: BaseException) -> bool:
             or "Attempting to reserve" in s)
 
 
-# Config ladder for the TPU child. `base` rungs are tried top-down until one
-# fits; after a success, `upgrade` is attempted if time remains and the better
-# measurement wins. Model: GPT-3 350M (hidden 1024 x 24 layers) like the fleet
-# GPT fixture; 125M as the last-resort rung.
+# Config ladder for the TPU child, tried top-down until one fits.
+# Model: GPT-3 350M (hidden 1024 x 24 layers) like the fleet GPT fixture;
+# 125M as the last-resort rung.
 _RUNG_350M = dict(hidden=1024, layers=24, heads=16)
 _RUNG_125M = dict(hidden=768, layers=12, heads=12)
+# Ladder measured on-chip (TPU v5e, round 3): no-remat b8 beats dots-remat b8
+# (35.5k vs 31.2k tok/s) and b16 in either policy; remat rungs remain as OOM
+# fallbacks for smaller-HBM chips.
 _BASE_RUNGS = [
+    dict(tag="350M-b8-off", batch=8, policy="off", **_RUNG_350M),
     dict(tag="350M-b8-dots", batch=8, policy="dots", **_RUNG_350M),
     dict(tag="350M-b8-full", batch=8, policy=None, **_RUNG_350M),
     dict(tag="350M-b4-full", batch=4, policy=None, **_RUNG_350M),
     dict(tag="125M-b8-full", batch=8, policy=None, **_RUNG_125M),
 ]
-_UPGRADE_RUNG = dict(tag="350M-b16-dots", batch=16, policy="dots", **_RUNG_350M)
 
 
 def _measure(rung: dict, steps: int, warmup: int) -> dict:
@@ -81,11 +82,12 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
     from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
 
     dev = jax.devices()[0]
+    policy = rung["policy"]  # None=full remat, "dots"=save MXU outputs, "off"=no remat
     cfg = GPTConfig(vocab_size=rung.get("vocab", 50304), hidden_size=rung["hidden"],
                     num_layers=rung["layers"], num_heads=rung["heads"],
                     max_seq_len=rung.get("seq", 1024), dropout=0.0,
-                    recompute=True, recompute_policy=rung["policy"],
-                    loss_chunk_size=int(os.environ.get("BENCH_LOSS_CHUNK", "1024")))
+                    recompute=policy != "off", recompute_policy=None if policy == "off" else policy,
+                    loss_chunk_size=int(os.environ.get("BENCH_LOSS_CHUNK", "2048")))
     batch, seq = rung["batch"], rung.get("seq", 1024)
 
     paddle.seed(0)
@@ -117,7 +119,7 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
 
     # steps fused per dispatch: amortizes host->device dispatch latency (the
     # tunnel RTT is charged once per call, so more inner steps -> less overhead)
-    INNER = int(os.environ.get("BENCH_INNER_STEPS", "8"))
+    INNER = int(os.environ.get("BENCH_INNER_STEPS", "16"))
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_multi(pvals, opt_st, key, ids_all, labels_all):
@@ -192,8 +194,6 @@ def run_bench(platform: str) -> dict:
 
     deadline = float(os.environ.get(_DEADLINE_ENV, time.time() + _TPU_BUDGET_S))
     remaining = lambda: deadline - time.time()  # noqa: E731
-    # one full attempt over the tunnel: compile (~60-120s) + measure (~40s)
-    ATTEMPT_EST_S = 170
 
     result = None
     for rung in _BASE_RUNGS:
@@ -212,23 +212,6 @@ def run_bench(platform: str) -> dict:
     if result is None:
         raise RuntimeError("no ladder rung fit on the device in budget")
 
-    # Bank the base measurement NOW: the parent scans for the LAST JSON line, so
-    # if the upgrade attempt blows the parent's timeout the base number survives
-    # (the parent parses partial stdout from TimeoutExpired).
-    print(json.dumps(result), flush=True)
-
-    if result["config"]["tag"] == _BASE_RUNGS[0]["tag"] and remaining() > ATTEMPT_EST_S:
-        try:
-            up = _measure(_UPGRADE_RUNG, steps=6, warmup=2)
-            if up["value"] > result["value"]:
-                up["config"]["upgraded_from"] = result["config"]["tag"]
-                result = up
-        except Exception as e:  # noqa: BLE001
-            if not _is_oom(e):
-                raise
-            print(f"[bench] upgrade {_UPGRADE_RUNG['tag']} OOM; keeping "
-                  f"{result['config']['tag']}", file=sys.stderr, flush=True)
-            gc.collect()
     return result
 
 
@@ -249,8 +232,8 @@ def _try_child(platform: str, budget_s: int) -> dict | None:
         tail = (e.stderr or b"").decode(errors="replace")[-2000:]
         print(f"[bench] {platform} child timed out after {budget_s}s\n{tail}",
               file=sys.stderr, flush=True)
-        # the child banks each successful measurement as a JSON line before
-        # attempting upgrades — salvage the last one from partial stdout
+        # the child prints its measurement as a JSON line as soon as it has
+        # one — salvage the last one from partial stdout
         for line in reversed((e.stdout or b"").decode(errors="replace").splitlines()):
             line = line.strip()
             if line.startswith("{"):

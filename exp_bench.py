@@ -1,0 +1,17 @@
+"""One-off perf experiment driver: run a single bench rung by name from argv.
+
+Usage: python exp_bench.py '{"tag":"x","hidden":1024,"layers":24,"heads":16,"batch":8,"policy":"off"}'
+"""
+import json
+import sys
+
+import bench
+
+rung = json.loads(sys.argv[1])
+steps = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+try:
+    r = bench._measure(rung, steps=steps, warmup=2)
+    print(json.dumps(r))
+except Exception as e:
+    print(f"FAILED: {type(e).__name__}: {str(e)[:500]}")
+    sys.exit(1)

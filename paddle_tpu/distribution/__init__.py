@@ -178,9 +178,12 @@ def kl_divergence(p, q):
         b = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
         kl = (a * (jnp.log(a) - jnp.log(b))
               + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
-        # degenerate q has no support where p puts mass: true KL is +inf
-        # (consistent with the Uniform out-of-support branch above)
-        return Tensor(jnp.where((q.probs <= 0) | (q.probs >= 1), jnp.inf, kl))
+        # +inf only where q assigns zero probability to an outcome p can emit
+        # (consistent with the Uniform out-of-support branch above); degenerate
+        # q with an equally-degenerate p has KL 0 through the clipped formula
+        bad = (((q.probs <= 0) & (p.probs > 0))
+               | ((q.probs >= 1) & (p.probs < 1)))
+        return Tensor(jnp.where(bad, jnp.inf, kl))
     if isinstance(p, Beta) and isinstance(q, Beta):
         s_p = p.alpha + p.beta
         kl = (betaln(q.alpha, q.beta) - betaln(p.alpha, p.beta)

@@ -59,7 +59,51 @@ def _flash_bwd(causal, sm_scale, vjp, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.lru_cache(maxsize=8)
+def _splash_kernel(num_heads: int, s_q: int, s_k: int, interpret: bool = False):
+    """Causal splash-attention kernel (skips fully-masked KV tiles — ~2x on
+    causal vs dense blocking). Cached per (heads, seq) since mask construction
+    is host-side."""
+    from jax.experimental.pallas.ops.tpu.splash_attention import (
+        splash_attention_kernel as _sak,
+        splash_attention_mask as _sam,
+    )
+
+    # offset aligns the causal diagonal bottom-right when s_q != s_k, matching
+    # sdpa_reference's jnp.tril(..., k=s_k - s_q) convention (attention.py)
+    mask = _sam.MultiHeadMask(
+        [_sam.CausalMask((s_q, s_k), offset=s_k - s_q)] * num_heads)
+    blk, bkv = min(512, s_q), min(512, s_k)
+    block_sizes = _sak.BlockSizes(
+        block_q=blk, block_kv=bkv, block_kv_compute=bkv,
+        block_q_dkv=blk, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
+        block_q_dq=blk, block_kv_dq=bkv,
+    )
+    return _sak.make_splash_mha(
+        mask=mask, head_shards=1, q_seq_shards=1, block_sizes=block_sizes,
+        interpret=interpret,
+    )
+
+
+def _splash(q, k, v, sm_scale, interpret=False):
+    kernel = _splash_kernel(q.shape[1], q.shape[2], k.shape[2], interpret)
+    q = (q * sm_scale).astype(q.dtype)
+    with jax.enable_x64(False):
+        return jax.vmap(kernel)(q, k, v)
+
+
 def flash_attention(q, k, v, causal=False, scale=None):
     """q,k,v: [batch, heads, seq, head_dim]."""
+    from ..utils.flags import flag
+
     sm_scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
+    if causal and flag("FLAGS_use_splash_attention", False):
+        try:
+            return _splash(q, k, v, sm_scale).astype(q.dtype)
+        except Exception as e:  # pragma: no cover — fall back to dense-block flash
+            import sys
+
+            print(f"[paddle_tpu] splash attention unavailable "
+                  f"({type(e).__name__}: {e}); using dense-block flash",
+                  file=sys.stderr, flush=True)
     return _flash(q, k, v, bool(causal), sm_scale).astype(q.dtype)

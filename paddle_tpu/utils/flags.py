@@ -11,6 +11,7 @@ _FLAGS: dict[str, object] = {
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_use_pallas_kernels": True,
+    "FLAGS_use_splash_attention": False,
     "FLAGS_eager_delete_tensor_gb": 0.0,
     "FLAGS_jit_donate_buffers": True,
 }

@@ -118,6 +118,14 @@ def test_kl_divergence_closed_forms_vs_monte_carlo():
     # degenerate q: true KL is infinite, not a clipped finite value
     b_inf = kl_divergence(Bernoulli(0.5), Bernoulli(0.0))
     assert np.isinf(float(np.asarray(b_inf._value)))
+    # identical degenerate distributions: KL is 0, not inf (q only lacks
+    # support where p also puts no mass)
+    for v in (0.0, 1.0):
+        b_same = kl_divergence(Bernoulli(v), Bernoulli(v))
+        assert float(np.asarray(b_same._value)) == pytest.approx(0.0, abs=1e-5)
+    # p degenerate at the outcome q still covers: finite
+    b_fin = kl_divergence(Bernoulli(0.0), Bernoulli(0.5))
+    assert np.isfinite(float(np.asarray(b_fin._value)))
 
 
 def test_independent_sums_event_dims():
