@@ -260,6 +260,19 @@ def _try_child(platform: str, budget_s: int) -> dict | None:
 
 
 def main():
+    # perf-experiment mode: `python bench.py --rung '{"tag":...,"batch":8,...}'
+    # [steps]` measures one explicit rung in-process and exits (non-zero on
+    # failure) — used for on-chip ladder exploration.
+    if len(sys.argv) > 1 and sys.argv[1] == "--rung":
+        rung = json.loads(sys.argv[2])
+        steps = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+        try:
+            print(json.dumps(_measure(rung, steps=steps, warmup=2)), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(f"FAILED: {type(e).__name__}: {str(e)[:500]}", flush=True)
+            sys.exit(1)
+        return
+
     child_platform = os.environ.get(_CHILD_ENV)
     if child_platform:
         # child mode: run the measurement, print JSON, let errors propagate

@@ -84,6 +84,7 @@ from .transformer import (  # noqa: F401
     TransformerEncoderLayer,
 )
 from .rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN  # noqa: F401
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .loss import (  # noqa: F401
     BCELoss,
     BCEWithLogitsLoss,

@@ -1,0 +1,269 @@
+"""Seq2seq decoding API: Decoder, BeamSearchDecoder, dynamic_decode.
+
+Reference analog: python/paddle/nn/decode.py (re-exporting
+fluid/layers/rnn.py BeamSearchDecoder/dynamic_decode) and the gather_tree op
+(paddle/fluid/operators/gather_tree_op.cc). TPU-native redesign: the decode
+loop is a `lax.while_loop` over PREALLOCATED [max_step, ...] output buffers
+(static shapes; XLA requires them) with an all-finished early exit — not a
+dynamic LoDTensorArray. Results are therefore max_step-padded; pair them with
+the returned sequence lengths.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _map_unwrap(tree):
+    return jax.tree_util.tree_map(
+        _unwrap, tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _map_wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if isinstance(x, jnp.ndarray) else x, tree)
+
+
+class Decoder:
+    """Abstract decoder driven by `dynamic_decode` (reference Decoder API:
+    initialize/step/finalize + tracks_own_finished)."""
+
+    def initialize(self, inits):
+        """-> (initial_inputs, initial_states, initial_finished)"""
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        """-> (outputs, next_states, next_inputs, finished)"""
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over a step cell (reference BeamSearchDecoder semantics:
+    per-beam log-prob accumulation, finished beams extend only with end_token,
+    top-k over beam*vocab, parent backtracking via gather_tree).
+
+    cell: callable (inputs [b*beam, ...], states) -> (outputs, next_states)
+    embedding_fn: token ids -> cell inputs
+    output_fn: cell outputs -> vocab logits (identity if the cell already
+    emits logits)
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] -> [batch*beam, ...] by repeating each row."""
+        v = _unwrap(x)
+        out = jnp.repeat(v, beam_size, axis=0)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+    def _merge(self, x):  # [batch, beam, ...] -> [batch*beam, ...]
+        return x.reshape((-1,) + x.shape[2:])
+
+    def _split(self, x, batch):  # [batch*beam, ...] -> [batch, beam, ...]
+        return x.reshape((batch, self.beam_size) + x.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = _map_unwrap(initial_cell_states)
+        batch = jax.tree_util.tree_leaves(states)[0].shape[0]
+        tiled = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(s, self.beam_size, axis=0), states)
+        log_probs = jnp.full((batch, self.beam_size), -jnp.inf, jnp.float32)
+        log_probs = log_probs.at[:, 0].set(0.0)  # all beams start identical
+        finished = jnp.zeros((batch, self.beam_size), bool)
+        lengths = jnp.zeros((batch, self.beam_size), jnp.int32)
+        tokens = jnp.full((batch * self.beam_size,), self.start_token, jnp.int32)
+        inputs = self.embedding_fn(Tensor(tokens)) if self.embedding_fn \
+            else Tensor(tokens)
+        state = {"cell": tiled, "log_probs": log_probs,
+                 "finished": finished, "lengths": lengths}
+        return inputs, state, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        del time
+        states = _map_unwrap(states)
+        batch = states["log_probs"].shape[0]
+        beam = self.beam_size
+        cell_out, next_cell = self.cell(inputs, _map_wrap(states["cell"]))
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = _unwrap(cell_out).astype(jnp.float32)  # [batch*beam, vocab]
+        vocab = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits, axis=-1)
+        step_lp = self._split(step_lp, batch)  # [batch, beam, vocab]
+
+        # finished beams may only extend with end_token, at no cost — the
+        # standard trick that freezes their cumulative score
+        eos_only = jnp.full((vocab,), -jnp.inf).at[self.end_token].set(0.0)
+        step_lp = jnp.where(states["finished"][..., None], eos_only, step_lp)
+
+        total = states["log_probs"][..., None] + step_lp  # [batch, beam, vocab]
+        flat = total.reshape(batch, beam * vocab)
+        top_lp, top_idx = jax.lax.top_k(flat, beam)  # [batch, beam]
+        parent = (top_idx // vocab).astype(jnp.int32)
+        token = (top_idx % vocab).astype(jnp.int32)
+
+        # reorder beam-major state by the chosen parents
+        gidx = parent + jnp.arange(batch)[:, None] * beam  # into batch*beam
+        next_cell = jax.tree_util.tree_map(
+            lambda s: _unwrap(s)[gidx.reshape(-1)], next_cell)
+        prev_finished = states["finished"][jnp.arange(batch)[:, None], parent]
+        prev_lengths = states["lengths"][jnp.arange(batch)[:, None], parent]
+        finished = prev_finished | (token == self.end_token)
+        lengths = prev_lengths + (~prev_finished).astype(jnp.int32)
+
+        outputs = {"scores": top_lp, "predicted_ids": token, "parent_ids": parent}
+        next_state = {"cell": next_cell, "log_probs": top_lp,
+                      "finished": finished, "lengths": lengths}
+        next_inputs = self.embedding_fn(Tensor(token.reshape(-1))) \
+            if self.embedding_fn else Tensor(token.reshape(-1))
+        return outputs, next_state, next_inputs, finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Backtrack parent pointers into whole sequences ([T, batch, beam])."""
+        ids = gather_tree(Tensor(outputs["predicted_ids"]),
+                          Tensor(outputs["parent_ids"]))
+        return ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def gather_tree(ids, parents):
+    """Reassemble beam-search sequences from per-step tokens + parent pointers.
+
+    ids, parents: [max_time, batch, beam]. Returns [max_time, batch, beam]
+    where column (b, k) is the full history of final beam k. Reference op:
+    gather_tree_op.cc (CPU backtracking loop) — here a reverse lax.scan.
+    """
+    iv, pv = _unwrap(ids), _unwrap(parents)
+    T, batch, beam = iv.shape
+    binit = jnp.broadcast_to(jnp.arange(beam, dtype=jnp.int32)[None, :],
+                             (batch, beam))
+    rows = jnp.arange(batch)[:, None]
+
+    def body(beams, t):
+        out_t = iv[t][rows, beams]
+        prev = pv[t][rows, beams]
+        return prev, out_t
+
+    _, rev = jax.lax.scan(body, binit, jnp.arange(T - 1, -1, -1))
+    out = rev[::-1]
+    return Tensor(out) if isinstance(ids, Tensor) else out
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive `decoder` until every sequence finishes or max_step_num steps.
+
+    Returns (final_outputs, final_states) or (+ sequence_lengths with
+    return_length=True). Outputs are [batch, max_step, ...] (time-major with
+    output_time_major=True) padded past each sequence's finish — static
+    shapes are the XLA contract, so the buffer is always max_step long.
+    """
+    del is_test
+    if max_step_num is None:
+        max_step_num = 256
+    max_step_num = int(max_step_num)
+    if impute_finished and decoder.tracks_own_finished:
+        raise ValueError(
+            "impute_finished is incompatible with decoders that reorder rows "
+            "each step (tracks_own_finished=True, e.g. BeamSearchDecoder): "
+            "the [batch, beam] finished mask cannot be aligned with the "
+            "decoder's [batch*beam, ...] internal state.")
+
+    inputs, states, finished = decoder.initialize(inits)
+    states_j = _map_unwrap(states)
+    finished_j = _unwrap(finished)
+
+    # one real step to learn the decoder's output pytree, then preallocate
+    out0, states1, inputs1, fin1 = decoder.step(0, inputs, _map_wrap(states_j),
+                                                **kwargs)
+    out0_j = _map_unwrap(out0)
+    bufs = jax.tree_util.tree_map(
+        lambda o: jnp.zeros((max_step_num,) + o.shape, o.dtype).at[0].set(o),
+        out0_j)
+    if decoder.tracks_own_finished:
+        finished_j = _unwrap(fin1)
+    else:
+        finished_j = finished_j | _unwrap(fin1)
+    lengths = jnp.where(finished_j, 1, 0).astype(jnp.int32)
+
+    def cond(carry):
+        t, _, _, _, finished, _ = carry
+        return (t < max_step_num) & ~jnp.all(finished)
+
+    def body(carry):
+        t, inputs, states, bufs, finished, lengths = carry
+        out, nstates, ninputs, nfin = decoder.step(t, _map_wrap(inputs),
+                                                   _map_wrap(states), **kwargs)
+        out_j, nstates_j = _map_unwrap(out), _map_unwrap(nstates)
+        ninputs_j, nfin_j = _map_unwrap(ninputs), _unwrap(nfin)
+        if impute_finished:  # freeze state/outputs of already-finished rows
+            nstates_j = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(
+                    _bcast(finished, new.shape), old, new), nstates_j, states)
+            out_j = jax.tree_util.tree_map(
+                lambda o: jnp.where(_bcast(finished, o.shape),
+                                    jnp.zeros_like(o), o), out_j)
+        bufs = jax.tree_util.tree_map(
+            lambda b, o: b.at[t].set(o), bufs, out_j)
+        if decoder.tracks_own_finished:
+            new_finished = nfin_j
+        else:
+            new_finished = finished | nfin_j
+        lengths = jnp.where(finished, lengths, t + 1)
+        return (t + 1, ninputs_j, nstates_j, bufs, new_finished, lengths)
+
+    carry = (jnp.asarray(1), _map_unwrap(inputs1), _map_unwrap(states1),
+             bufs, finished_j, lengths)
+    t, _, states_f, bufs, finished_f, lengths = jax.lax.while_loop(
+        cond, body, carry)
+    lengths = jnp.where(finished_f, lengths, max_step_num)
+    # decoders that reorder rows each step (beam search gathers by parent)
+    # track authoritative per-sequence lengths in their own state
+    if isinstance(states_f, dict) and "lengths" in states_f:
+        lengths = states_f["lengths"]
+
+    outputs, final_states = decoder.finalize(
+        bufs, _map_wrap(states_f), Tensor(lengths))
+    if not output_time_major:
+        outputs = jax.tree_util.tree_map(
+            lambda o: Tensor(jnp.moveaxis(_unwrap(o), 0, 1)), outputs,
+            is_leaf=lambda x: isinstance(x, (Tensor, jnp.ndarray)))
+    outputs = _map_wrap(outputs)
+    if return_length:
+        return outputs, final_states, Tensor(lengths)
+    return outputs, final_states
+
+
+def _bcast(mask, shape):
+    """Broadcast a [batch, ...] bool mask against `shape` by right-padding."""
+    m = mask
+    while m.ndim < len(shape):
+        m = m[..., None]
+    return jnp.broadcast_to(m, shape)

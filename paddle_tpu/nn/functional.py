@@ -1105,3 +1105,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     if dropout_p > 0.0 and training:
         out = dropout(out, dropout_p, training=training)
     return out
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtracking (reference op: gather_tree_op.cc); see
+    nn/decode.py for the lax.scan implementation."""
+    from .decode import gather_tree as _gt
+
+    return _gt(ids, parents)

@@ -20,3 +20,4 @@ from .ernie import (  # noqa: F401
     ernie_config,
 )
 from .gpt import GPTModel, GPTForCausalLM, GPTConfig  # noqa: F401
+from .generation import generate, sample_logits  # noqa: F401

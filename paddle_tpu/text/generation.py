@@ -1,0 +1,134 @@
+"""Autoregressive generation utilities (KV-cache decode loop + samplers).
+
+Reference analog: the fused-multi-transformer generation path
+(operators/fused/fused_multi_transformer_op.cu CacheKV) and the sampling ops
+(top_k_op / top_p_sampling). TPU-native redesign: the whole decode loop is ONE
+`lax.scan` over fixed-size KV buffers — static shapes throughout, one compile,
+no per-token dispatch; finished rows keep emitting `pad_token_id` under a
+`jnp.where` instead of dynamic early exit.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["sample_logits", "generate"]
+
+
+def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
+    """Sample token ids from [batch, vocab] logits (jnp in, jnp out).
+
+    top_k and top_p compose the standard way: restrict to the k highest
+    logits, then to the smallest nucleus whose cumulative probability
+    exceeds p, then renormalize.
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature != 1.0:
+        logits = logits / jnp.maximum(temperature, 1e-6)
+    vocab = logits.shape[-1]
+    if top_k and top_k < vocab:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the minimal prefix with cumulative mass > p (always >= 1 token)
+        cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1) - 1
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[..., None], axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def _decode_loop(model, p_arrays, ids, key, max_new_tokens, do_sample,
+                 temperature, top_k, top_p, eos_token_id, pad_token_id):
+    """Pure function of (params, prompt ids, key); generate() jits it once per
+    (shape, sampling-config) and caches the executable on the model."""
+    b, prompt_len = ids.shape
+    total = prompt_len + max_new_tokens
+    caches = model.gpt.init_cache(b, max_len=total)
+
+    def call(pvals, tok, caches, pos):
+        (logits, new_caches), _ = model.functional_call(
+            pvals, {}, Tensor(tok), caches=caches, pos=pos)
+        return logits._value, new_caches
+
+    # prefill: write the whole prompt into the cache in one pass
+    logits, caches = call(p_arrays, ids, caches, 0)
+    last = logits[:, -1, :]
+
+    def pick(logits_1, key):
+        if do_sample:
+            return sample_logits(logits_1, key, temperature, top_k, top_p)
+        return jnp.argmax(logits_1, axis=-1)
+
+    key, sub = jax.random.split(key)
+    tok = pick(last, sub).astype(ids.dtype)  # [b]
+    finished = jnp.zeros((b,), bool)
+    if eos_token_id is not None:
+        finished = tok == eos_token_id
+
+    def body(carry, key_t):
+        tok, caches, pos, finished = carry
+        logits, new_caches = call(p_arrays, tok[:, None], caches, pos)
+        nxt = pick(logits[:, -1, :], key_t).astype(tok.dtype)
+        if eos_token_id is not None:
+            nxt = jnp.where(finished, jnp.asarray(pad_token_id, tok.dtype), nxt)
+            new_finished = finished | (nxt == eos_token_id)
+        else:
+            new_finished = finished
+        return (nxt, new_caches, pos + 1, new_finished), nxt
+
+    keys = jax.random.split(key, max_new_tokens - 1) if max_new_tokens > 1 \
+        else jnp.zeros((0, 2), jnp.uint32)
+    (_, _, _, _), rest = jax.lax.scan(
+        body, (tok, caches, prompt_len, finished), keys)
+    out = jnp.concatenate([tok[:, None], rest.T], axis=1)  # [b, max_new_tokens]
+    return jnp.concatenate([ids, out], axis=1)
+
+
+def generate(model, input_ids, max_new_tokens=20, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             pad_token_id=0, seed=0):
+    """Generate completions for `input_ids` ([batch, prompt_len] Tensor).
+
+    Greedy when do_sample=False; temperature/top-k/top-p sampling otherwise.
+    Returns [batch, prompt_len + max_new_tokens] ids (finished rows padded
+    with pad_token_id after their eos).
+    """
+    ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    if int(max_new_tokens) <= 0:
+        return Tensor(ids)
+    total = ids.shape[1] + int(max_new_tokens)
+    max_pos = model.cfg.max_seq_len
+    if total > max_pos:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds max_seq_len "
+            f"{max_pos}: positions past the table would silently clamp "
+            f"(XLA out-of-bounds gather). Raise GPTConfig.max_seq_len or "
+            f"shorten the request.")
+    was_training = model.training
+    model.eval()
+    try:
+        params, _ = model.functional_state()
+        p_arrays = {k: v._value for k, v in params.items()}
+        cfg_key = (tuple(ids.shape), int(max_new_tokens), bool(do_sample),
+                   float(temperature), int(top_k), float(top_p),
+                   eos_token_id, int(pad_token_id))
+        cache = model.__dict__.setdefault("_generate_jit_cache", {})
+        if cfg_key not in cache:
+            cache[cfg_key] = jax.jit(functools.partial(
+                _decode_loop, model,
+                max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
+                temperature=float(temperature), top_k=int(top_k),
+                top_p=float(top_p), eos_token_id=eos_token_id,
+                pad_token_id=int(pad_token_id)))
+        out = cache[cfg_key](p_arrays, ids, jax.random.key(seed))
+    finally:
+        if was_training:
+            model.train()
+    return Tensor(out)

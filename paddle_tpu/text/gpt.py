@@ -66,12 +66,38 @@ class GPTAttention(nn.Layer):
         self.out_proj = nn.Linear(h, h, weight_attr=attr)
         self.dropout = cfg.dropout
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3, B, H, S, D
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if cache is not None:
+            # Fixed-size KV cache for autoregressive decode: buffers are
+            # [B, H, max_len, D] (static shapes — XLA-friendly), new keys are
+            # written at `pos` via dynamic_update_slice and masked attention
+            # covers exactly the written prefix. TPU-native answer to the
+            # reference's growing fused-attention CacheKV
+            # (operators/fused/fused_multi_transformer_op.cu concat path).
+            import jax.lax as lax
+            import jax.numpy as jnp
+
+            k_buf, v_buf = cache["k"], cache["v"]
+            p = pos._value if isinstance(pos, Tensor) else pos
+            k_all = lax.dynamic_update_slice(k_buf, k._value.astype(k_buf.dtype),
+                                             (0, 0, p, 0))
+            v_all = lax.dynamic_update_slice(v_buf, v._value.astype(v_buf.dtype),
+                                             (0, 0, p, 0))
+            max_len = k_all.shape[2]
+            j = jnp.arange(max_len)[None, :]
+            i = jnp.arange(s)[:, None] + p
+            mask = Tensor(j <= i)  # [s, max_len]: causal over the written prefix
+            out = F.scaled_dot_product_attention(
+                q, Tensor(k_all), Tensor(v_all), attn_mask=mask,
+                dropout_p=0.0, is_causal=False, training=False,
+            )
+            out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
+            return self.out_proj(out), {"k": k_all, "v": v_all}
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
             is_causal=attn_mask is None, training=self.training,
@@ -102,7 +128,12 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None, pos=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), attn_mask, cache=cache, pos=pos)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, new_cache
         x = x + self.dropout(self.attn(self.ln1(x), attn_mask))
         x = x + self.mlp(self.ln2(x))
         return x
@@ -121,19 +152,47 @@ class GPTModel(nn.Layer):
         self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None, position_ids=None):
+    def init_cache(self, batch_size: int, max_len: int | None = None, dtype=None):
+        """Per-layer fixed-size KV buffers for `forward(caches=..., pos=...)`."""
+        import jax.numpy as jnp
+
+        c = self.cfg
+        max_len = max_len or c.max_seq_len
+        head_dim = c.hidden_size // c.num_heads
+        dt = dtype or self.wte.weight._value.dtype
+        shape = (batch_size, c.num_heads, max_len, head_dim)
+        return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+                for _ in range(c.num_layers)]
+
+    def forward(self, input_ids, attn_mask=None, position_ids=None,
+                caches=None, pos=None):
         import paddle_tpu as P
 
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = P.arange(s, dtype="int64").unsqueeze(0)
-            from ..distributed.sequence_parallel import sp_local_offset
+            if caches is not None:
+                p = pos._value if isinstance(pos, Tensor) else pos
+                position_ids = Tensor(position_ids._value + p)
+            else:
+                from ..distributed.sequence_parallel import sp_local_offset
 
-            off = sp_local_offset(s)  # global positions when sequence-parallel
-            if not isinstance(off, int) or off != 0:
-                position_ids = position_ids + off
+                off = sp_local_offset(s)  # global positions when sequence-parallel
+                if not isinstance(off, int) or off != 0:
+                    position_ids = position_ids + off
         x = self.wte(input_ids) + self.wpe(position_ids)
         x = self.drop(x)
+        if caches is not None:
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "attn_mask is not supported on the KV-cache path: the "
+                    "cache builds its own causal-prefix mask. Left-padded "
+                    "batches are not yet handled — right-pad prompts instead.")
+            new_caches = []
+            for blk, cache in zip(self.blocks, caches):
+                x, nc = blk(x, None, cache=cache, pos=pos)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         if self.cfg.recompute:
             from ..distributed.fleet.recompute import recompute
 
@@ -156,7 +215,19 @@ class GPTForCausalLM(nn.Layer):
         else:
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size, bias_attr=False)
 
-    def forward(self, input_ids, labels=None, attn_mask=None):
+    def forward(self, input_ids, labels=None, attn_mask=None,
+                caches=None, pos=None):
+        if caches is not None:
+            if labels is not None:
+                raise NotImplementedError(
+                    "labels (training loss) cannot be combined with the "
+                    "KV-cache decode path")
+            h, new_caches = self.gpt(input_ids, attn_mask, caches=caches, pos=pos)
+            from ..tensor_ops.math import matmul
+
+            if self.lm_head is not None:
+                return self.lm_head(h), new_caches
+            return matmul(h, self.gpt.wte.weight, transpose_y=True), new_caches
         h = self.gpt(input_ids, attn_mask)
         if labels is not None:
             # Fused head+CE: scans vocab projection in sequence chunks so the
@@ -175,6 +246,12 @@ class GPTForCausalLM(nn.Layer):
 
             logits = matmul(h, self.gpt.wte.weight, transpose_y=True)
         return logits
+
+    def generate(self, input_ids, **kwargs):
+        """KV-cache autoregressive decoding — see text/generation.py."""
+        from .generation import generate
+
+        return generate(self, input_ids, **kwargs)
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
