@@ -28,8 +28,11 @@ def _map_unwrap(tree):
 
 
 def _map_wrap(tree):
+    # is_leaf stops tree_map from descending INTO Tensor (a registered pytree
+    # node) and double-wrapping its _value
     return jax.tree_util.tree_map(
-        lambda x: Tensor(x) if isinstance(x, jnp.ndarray) else x, tree)
+        lambda x: Tensor(x) if isinstance(x, jnp.ndarray) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
 
 
 class Decoder:
@@ -39,6 +42,11 @@ class Decoder:
     def initialize(self, inits):
         """-> (initial_inputs, initial_states, initial_finished)"""
         raise NotImplementedError
+
+    def final_sequence_lengths(self, final_states):
+        """Override to supply authoritative per-sequence lengths from decoder
+        state (returns None to keep dynamic_decode's loop-level counts)."""
+        return None
 
     def step(self, time, inputs, states, **kwargs):
         """-> (outputs, next_states, next_inputs, finished)"""
@@ -142,10 +150,28 @@ class BeamSearchDecoder(Decoder):
         return outputs, next_state, next_inputs, finished
 
     def finalize(self, outputs, final_states, sequence_lengths):
-        """Backtrack parent pointers into whole sequences ([T, batch, beam])."""
-        ids = gather_tree(Tensor(outputs["predicted_ids"]),
-                          Tensor(outputs["parent_ids"]))
+        """Backtrack parent pointers into whole sequences ([T, batch, beam]).
+
+        The output buffers are max_step-preallocated; past the loop's exit
+        step they hold zeros, and a parent id of 0 there would collapse every
+        beam onto beam 0 during backtracking. Replace parents in the unwritten
+        region with the identity so each beam column survives to the written
+        steps. The exit step is max(lengths): unfinished beams count every
+        executed step, finished ones stopped earlier."""
+        parents = outputs["parent_ids"]
+        T, batch, beam = parents.shape
+        t_exit = jnp.max(_unwrap(sequence_lengths))
+        ident = jnp.broadcast_to(
+            jnp.arange(beam, dtype=parents.dtype)[None, None, :], parents.shape)
+        parents = jnp.where(jnp.arange(T)[:, None, None] < t_exit,
+                            parents, ident)
+        ids = gather_tree(Tensor(outputs["predicted_ids"]), Tensor(parents))
         return ids, final_states
+
+    def final_sequence_lengths(self, final_states):
+        """Beam reordering makes the loop-level counts wrong; the state's
+        parent-gathered lengths are authoritative."""
+        return final_states["lengths"]
 
     @property
     def tracks_own_finished(self):
@@ -244,10 +270,9 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     t, _, states_f, bufs, finished_f, lengths = jax.lax.while_loop(
         cond, body, carry)
     lengths = jnp.where(finished_f, lengths, max_step_num)
-    # decoders that reorder rows each step (beam search gathers by parent)
-    # track authoritative per-sequence lengths in their own state
-    if isinstance(states_f, dict) and "lengths" in states_f:
-        lengths = states_f["lengths"]
+    own_lengths = decoder.final_sequence_lengths(states_f)
+    if own_lengths is not None:
+        lengths = _unwrap(own_lengths)
 
     outputs, final_states = decoder.finalize(
         bufs, _map_wrap(states_f), Tensor(lengths))

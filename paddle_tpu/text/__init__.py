@@ -21,3 +21,8 @@ from .ernie import (  # noqa: F401
 )
 from .gpt import GPTModel, GPTForCausalLM, GPTConfig  # noqa: F401
 from .generation import generate, sample_logits  # noqa: F401
+from .transformer_mt import (  # noqa: F401
+    TransformerMT,
+    TransformerMTConfig,
+    sinusoid_position_encoding,
+)

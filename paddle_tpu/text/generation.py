@@ -30,17 +30,24 @@ def sample_logits(logits, key, temperature=1.0, top_k=0, top_p=1.0):
     if temperature != 1.0:
         logits = logits / jnp.maximum(temperature, 1e-6)
     vocab = logits.shape[-1]
-    if top_k and top_k < vocab:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep the minimal prefix with cumulative mass > p (always >= 1 token)
-        cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1) - 1
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx[..., None], axis=-1)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    if (top_k and top_k < vocab) or top_p < 1.0:
+        # one descending sort serves both filters (this runs inside the
+        # per-token decode scan — avoid a second O(V log V) pass)
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        if top_k and top_k < vocab:
+            kth = sorted_desc[..., top_k - 1][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            if top_k and top_k < vocab:  # nucleus applies to the k-filtered set
+                sorted_desc = jnp.where(
+                    jnp.arange(vocab) < top_k, sorted_desc, -jnp.inf)
+            probs = jax.nn.softmax(sorted_desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep the minimal prefix with cumulative mass > p (>= 1 token)
+            cutoff_idx = jnp.sum((cum - probs) < top_p, axis=-1) - 1
+            cutoff = jnp.take_along_axis(sorted_desc, cutoff_idx[..., None],
+                                         axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1)
 
 

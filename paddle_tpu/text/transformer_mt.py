@@ -1,0 +1,200 @@
+"""Transformer machine-translation family (reference: the fluid Transformer MT
+example family — python/paddle/fluid/tests/unittests/test_transformer_api.py
+drives paddle.nn.Transformer exactly this way — plus WMT14/16 in text.datasets).
+
+TPU-native decoding: beam search reuses nn.decode.BeamSearchDecoder with a
+fixed-size token buffer in the cell state — every step re-runs the decoder
+over the static [b*beam, max_len] prefix under a causal mask (static shapes,
+one compile; the O(T^2) recompute is the standard XLA trade against dynamic
+concat caches, which cannot live in a lax.while_loop carry).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..nn import functional as F
+
+
+@dataclass
+class TransformerMTConfig:
+    src_vocab_size: int = 10000
+    tgt_vocab_size: int = 10000
+    d_model: int = 512
+    nhead: int = 8
+    num_encoder_layers: int = 6
+    num_decoder_layers: int = 6
+    dim_feedforward: int = 2048
+    dropout: float = 0.1
+    max_length: int = 256
+    bos_id: int = 0
+    eos_id: int = 1
+    pad_id: int = 2
+    label_smooth_eps: float = 0.1
+    tie_embeddings: bool = False  # share tgt embedding with the output head
+
+
+def sinusoid_position_encoding(max_len: int, d_model: int) -> jnp.ndarray:
+    """Standard fixed sin/cos table [max_len, d_model] (d_model must be even)."""
+    if d_model % 2:
+        raise ValueError(f"d_model must be even, got {d_model}")
+    pos = jnp.arange(max_len, dtype=jnp.float32)[:, None]
+    dim = jnp.arange(0, d_model, 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, dim / d_model)
+    pe = jnp.zeros((max_len, d_model), jnp.float32)
+    pe = pe.at[:, 0::2].set(jnp.sin(angle))
+    pe = pe.at[:, 1::2].set(jnp.cos(angle))
+    return pe
+
+
+class TransformerMT(nn.Layer):
+    """Encoder-decoder MT model over nn.Transformer with beam-search decode."""
+
+    def __init__(self, cfg: TransformerMTConfig):
+        super().__init__()
+        self.cfg = cfg
+        d = cfg.d_model
+        self.src_emb = nn.Embedding(cfg.src_vocab_size, d)
+        self.tgt_emb = nn.Embedding(cfg.tgt_vocab_size, d)
+        self.register_buffer(
+            "pos_table", Tensor(sinusoid_position_encoding(cfg.max_length, d)))
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.transformer = nn.Transformer(
+            d_model=d, nhead=cfg.nhead,
+            num_encoder_layers=cfg.num_encoder_layers,
+            num_decoder_layers=cfg.num_decoder_layers,
+            dim_feedforward=cfg.dim_feedforward, dropout=cfg.dropout)
+        if cfg.tie_embeddings:
+            self.head = None
+        else:
+            self.head = nn.Linear(d, cfg.tgt_vocab_size, bias_attr=False)
+
+    # --------------------------------------------------------------- helpers
+    def _embed(self, emb, ids, start: int = 0):
+        d = self.cfg.d_model
+        x = emb(ids) * math.sqrt(d)
+        s = ids.shape[1]
+        pe = self.pos_table._value[start:start + s]
+        return self.dropout(Tensor(x._value + pe[None, :, :].astype(x._value.dtype)))
+
+    def _pad_mask(self, ids):
+        """[b, s] -> additive [b, 1, 1, s] mask, -inf on pad positions."""
+        m = (ids._value == self.cfg.pad_id)
+        return Tensor(jnp.where(m[:, None, None, :], -1e9, 0.0).astype(jnp.float32))
+
+    def _project(self, h):
+        if self.head is not None:
+            return self.head(h)
+        from ..tensor_ops.math import matmul
+
+        return matmul(h, self.tgt_emb.weight, transpose_y=True)
+
+    # --------------------------------------------------------------- training
+    def forward(self, src_ids, tgt_ids, labels=None):
+        """Teacher-forced forward. With `labels`, returns the label-smoothed
+        CE loss masked over pad positions; else [b, s_tgt, tgt_vocab] logits."""
+        cfg = self.cfg
+        src_mask = self._pad_mask(src_ids)
+        s_tgt = tgt_ids.shape[1]
+        causal = jnp.where(
+            jnp.tril(jnp.ones((s_tgt, s_tgt), bool)), 0.0, -1e9)[None, None]
+        tgt_pad = (tgt_ids._value == cfg.pad_id)
+        tgt_mask = Tensor(
+            (causal + jnp.where(tgt_pad[:, None, None, :], -1e9, 0.0)
+             ).astype(jnp.float32))
+        mem = self.transformer.encoder(self._embed(self.src_emb, src_ids),
+                                       src_mask=src_mask)
+        h = self.transformer.decoder(self._embed(self.tgt_emb, tgt_ids), mem,
+                                     tgt_mask=tgt_mask, memory_mask=src_mask)
+        logits = self._project(h)
+        if labels is None:
+            return logits
+        valid = Tensor((labels._value != cfg.pad_id).astype(jnp.float32))
+        loss = F.cross_entropy(
+            logits.reshape([-1, cfg.tgt_vocab_size]), labels.reshape([-1]),
+            reduction="none", label_smoothing=cfg.label_smooth_eps)
+        loss = loss.reshape(list(labels.shape))
+        num = (loss * valid).sum()
+        den = valid.sum()
+        return num / den
+
+    # --------------------------------------------------------------- decoding
+    def encode(self, src_ids):
+        src_mask = self._pad_mask(src_ids)
+        return self.transformer.encoder(self._embed(self.src_emb, src_ids),
+                                        src_mask=src_mask), src_mask
+
+    def beam_search(self, src_ids, beam_size=4, max_len=None):
+        """Translate `src_ids` [b, s_src] -> ids [b, max_len, beam] + lengths.
+
+        The decode cell keeps a fixed [b*beam, max_len] token buffer in its
+        state (gathered by parent beam like any other state leaf) and re-runs
+        the decoder over the full prefix each step — static shapes, jit-safe.
+        """
+        cfg = self.cfg
+        was_training = self.training
+        self.eval()
+        try:
+            max_len = int(max_len or min(cfg.max_length,
+                                         src_ids.shape[1] + 50))
+            mem, src_mask = self.encode(src_ids)
+            b = src_ids.shape[0]
+            mem_t = Tensor(jnp.repeat(mem._value, beam_size, axis=0))
+            src_mask_t = Tensor(jnp.repeat(src_mask._value, beam_size, axis=0))
+
+            model = self
+
+            class _Cell:
+                def __call__(self, inputs, states):
+                    tokens, pos = states  # [B, max_len] int32, [B] int32
+                    tok = inputs._value.astype(jnp.int32)  # [B]
+                    B = tokens._value.shape[0]
+                    p = pos._value[0]  # all rows share the step index
+                    buf = jax.lax.dynamic_update_slice(
+                        tokens._value, tok[:, None],
+                        (jnp.asarray(0, p.dtype), p))
+                    s = buf.shape[1]
+                    causal = jnp.where(
+                        jnp.tril(jnp.ones((s, s), bool)), 0.0, -1e9)
+                    # positions past p are zero-padding; mask them from keys
+                    key_valid = jnp.arange(s)[None, :] <= p
+                    tgt_mask = Tensor(
+                        (causal[None, None] + jnp.where(
+                            key_valid[:, None, :], 0.0, -1e9)[:, None]
+                         ).astype(jnp.float32))
+                    h = model.transformer.decoder(
+                        model._embed(model.tgt_emb, Tensor(buf)), mem_t,
+                        tgt_mask=tgt_mask, memory_mask=src_mask_t)
+                    logits = model._project(h)
+                    step_logits = Tensor(
+                        jax.lax.dynamic_index_in_dim(
+                            logits._value, p, axis=1, keepdims=False))
+                    return step_logits, (Tensor(buf), Tensor(pos._value + 1))
+
+            tokens0 = Tensor(jnp.full((b, max_len), cfg.pad_id, jnp.int32))
+            pos0 = Tensor(jnp.zeros((b,), jnp.int32))
+            dec = nn.BeamSearchDecoder(
+                _Cell(), start_token=cfg.bos_id, end_token=cfg.eos_id,
+                beam_size=beam_size)
+            out, _, lengths = nn.dynamic_decode(
+                dec, inits=(tokens0, pos0), max_step_num=max_len,
+                return_length=True)
+            return out, lengths
+        finally:
+            if was_training:
+                self.train()
+
+    def translate(self, src_ids, beam_size=4, max_len=None):
+        """Best-beam ids [b, max_len] (pad-filled past each eos)."""
+        out, lengths = self.beam_search(src_ids, beam_size, max_len)
+        ids = out._value[:, :, 0]
+        T = ids.shape[1]
+        L = lengths._value[:, 0]
+        ids = jnp.where(jnp.arange(T)[None, :] < L[:, None], ids,
+                        self.cfg.pad_id)
+        return Tensor(ids)

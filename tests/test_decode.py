@@ -112,3 +112,7 @@ def test_dynamic_decode_time_major_and_early_exit():
     assert (ids[0, :, 0] == 2).all()
     # nothing was written past step 2 (early exit, not a 50-step crawl)
     assert (ids[2:] == 0).all()
+    # regression: non-top beams must keep their OWN history after early exit
+    # (zero-filled parent padding used to collapse them onto beam 0) — beam 1
+    # is the 0 -> eos path, not a copy of beam 0's immediate eos
+    assert ids[0, 0, 1] == 0 and ids[1, 0, 1] == 2, ids[:3, 0, :]
