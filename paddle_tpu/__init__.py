@@ -129,3 +129,87 @@ def set_flags(flags):
     from .utils import flags as _flags
 
     return _flags.set_flags(flags)
+
+
+# ---- parity batch (reference root __all__: python/paddle/__init__.py) ----
+# dtype aliases: canonical dtype strings (Tensor.dtype returns these, so
+# `x.dtype == paddle.float32` compares equal)
+bool = "bool"  # noqa: A001 — parity with paddle.bool shadowing builtins
+uint8 = "uint8"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+float32 = "float32"
+float64 = "float64"
+complex64 = "complex64"
+complex128 = "complex128"
+dtype = str  # dtypes are canonical strings in this framework
+
+from .core.place import CUDAPinnedPlace, NPUPlace  # noqa: E402,F401
+from .distributed.parallel import DataParallel  # noqa: E402,F401
+from .tensor_ops.math import bincount  # noqa: E402,F401
+from .hapi.dynamic_flops import flops  # noqa: E402,F401
+
+
+def shape(input):
+    """Runtime shape as an int32 Tensor (reference: fluid.layers.shape)."""
+    import jax.numpy as _jnp
+
+    v = input._value if isinstance(input, Tensor) else _jnp.asarray(input)
+    return Tensor(_jnp.asarray(v.shape, _jnp.int32))
+
+
+def check_shape(shape):  # noqa: A002 — parity signature
+    """Validate a shape argument (reference: fluid/layers/utils.py:376)."""
+    if isinstance(shape, Tensor):
+        if shape.dtype not in ("int32", "int64"):
+            raise TypeError(f"shape tensor must be int32/int64, got {shape.dtype}")
+        return
+    for ele in shape:
+        if isinstance(ele, Tensor):
+            continue
+        if not isinstance(ele, int):
+            raise TypeError("All elements in `shape` must be integers")
+        if ele < 0:
+            raise ValueError("All elements in `shape` must be positive")
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Numpy-backed print options (Tensors repr through numpy)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """Parity no-op: the reference unhooks its C++ fault handlers; this
+    runtime installs none."""
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (maps to the global threefry key on TPU)."""
+    from .core import rng as _rng
+
+    return [_rng.default_generator().get_state()]
+
+
+def set_cuda_rng_state(state):
+    from .core import rng as _rng
+
+    _rng.default_generator().set_state(
+        state[0] if isinstance(state, (list, tuple)) else state)

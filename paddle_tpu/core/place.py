@@ -111,3 +111,11 @@ def is_compiled_with_tpu() -> bool:
 
 def device_count() -> int:
     return jax.device_count()
+
+
+class CUDAPinnedPlace(CPUPlace):
+    """Pinned host memory (parity shim: PJRT manages host staging buffers)."""
+
+
+class NPUPlace(TPUPlace):
+    """NPU alias kept for API compat; resolves to the accelerator place."""

@@ -84,7 +84,29 @@ from .transformer import (  # noqa: F401
     TransformerEncoderLayer,
 )
 from .rnn import GRU, GRUCell, LSTM, LSTMCell, SimpleRNN  # noqa: F401
+from .rnn import RNN, BiRNN, RNNCellBase, SimpleRNNCell  # noqa: F401
 from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
+from .layers_extra import (  # noqa: F401
+    AdaptiveAvgPool3D,
+    AdaptiveMaxPool1D,
+    AdaptiveMaxPool3D,
+    AvgPool3D,
+    ChannelShuffle,
+    Conv1DTranspose,
+    Conv3DTranspose,
+    CTCLoss,
+    Fold,
+    HSigmoidLoss,
+    MaxPool3D,
+    MaxUnPool1D,
+    MaxUnPool2D,
+    MaxUnPool3D,
+    PairwiseDistance,
+    PixelUnshuffle,
+    Softmax2D,
+    ThresholdedReLU,
+    ZeroPad2D,
+)
 from .loss import (  # noqa: F401
     BCELoss,
     BCEWithLogitsLoss,

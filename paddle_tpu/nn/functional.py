@@ -291,40 +291,11 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, output_size=None, data_format="NCHW", name=None):
-    stride = _pair(stride)
-    dilation = _pair(dilation)
-    pad_cfg = padding
-
-    def f(a, w, *b):
-        # weight layout IOHW (paddle convention for transpose conv: [in, out/groups, H, W])
-        kh, kw = w.shape[2], w.shape[3]
-        if isinstance(pad_cfg, int):
-            pads = [(pad_cfg, pad_cfg), (pad_cfg, pad_cfg)]
-        elif isinstance(pad_cfg, str):
-            pads = pad_cfg.upper()
-        else:
-            pads = _conv_padding(pad_cfg, None, dilation, 2)
-        if isinstance(pads, list):
-            # lax.conv_transpose padding semantics: pad the *output*; convert
-            lax_pads = [
-                (dilation[i] * (k - 1) - p[0], dilation[i] * (k - 1) - p[1])
-                for i, (p, k) in enumerate(zip(pads, (kh, kw)))
-            ]
-        else:
-            lax_pads = pads
-        w_t = jnp.transpose(w, (1, 0, 2, 3))  # -> OIHW with O=out
-        w_t = jnp.flip(w_t, axis=(2, 3))
-        out = jax.lax.conv_general_dilated(
-            a, w_t, window_strides=(1, 1), padding=lax_pads, lhs_dilation=stride,
-            rhs_dilation=dilation, dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=groups,
-        )
-        if b:
-            out = out + b[0].reshape(1, -1, 1, 1)
-        return out.astype(a.dtype)
-
-    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
-    return primitive_call(f, *args, name="conv2d_transpose")
+    # weight layout IOHW (paddle convention: [in, out/groups, H, W]); shared
+    # N-d implementation lives in _conv_transpose_nd below
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, ("NCHW", "OIHW", "NCHW"),
+                              "conv2d_transpose")
 
 
 # ------------------------------------------------------------------ pooling
@@ -381,6 +352,10 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", avg=Fal
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
+    if return_mask:
+        return _max_pool_nd_with_indices(x, kernel_size, stride, padding, nd=2,
+                                         ceil_mode=ceil_mode,
+                                         data_format=data_format)
     return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, data_format,
                  ceil_mode=ceil_mode)
 
@@ -393,6 +368,9 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    if return_mask:
+        return _max_pool_nd_with_indices(x, kernel_size, stride, padding, nd=1,
+                                         ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf, "NCL",
                  ceil_mode=ceil_mode, nd=1)
 
@@ -1113,3 +1091,688 @@ def gather_tree(ids, parents):
     from .decode import gather_tree as _gt
 
     return _gt(ids, parents)
+
+
+# ===================================================================== parity
+# batch (reference: python/paddle/nn/functional/* __all__) — pooling-3d,
+# unpooling, shuffles, pads, losses, grids. Same primitive_call conventions.
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW", name=None):
+    if return_mask:
+        return _max_pool_nd_with_indices(x, kernel_size, stride, padding, nd=3,
+                                         ceil_mode=ceil_mode,
+                                         data_format=data_format)
+    return _pool(x, kernel_size, stride, padding, jax.lax.max, -jnp.inf,
+                 "NCDHW", ceil_mode=ceil_mode, nd=3)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, jax.lax.add, 0.0, "NCDHW",
+                 avg=True, ceil_mode=ceil_mode, exclusive=exclusive, nd=3)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    out = _pair(output_size, 3)
+
+    def f(a):
+        d, h, w = a.shape[2], a.shape[3], a.shape[4]
+        od = d if out[0] is None else out[0]
+        oh = h if out[1] is None else out[1]
+        ow = w if out[2] is None else out[2]
+        md = jnp.asarray(_adaptive_avg_matrix(d, od, a.dtype))
+        mh = jnp.asarray(_adaptive_avg_matrix(h, oh, a.dtype))
+        mw = jnp.asarray(_adaptive_avg_matrix(w, ow, a.dtype))
+        return jnp.einsum("ncdhw,od,ph,qw->ncopq", a, md, mh, mw)
+
+    return primitive_call(f, _t(x), name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+
+    def f(a):
+        n = a.shape[2]
+        ss, ee = _adaptive_bins(n, o)
+        out = jnp.stack([jnp.max(a[:, :, s:e], axis=2)
+                         for s, e in zip(ss, ee)], axis=-1)
+        if not return_mask:
+            return out
+        idx = jnp.stack(
+            [jnp.argmax(jax.lax.stop_gradient(a[:, :, s:e]), axis=2) + s
+             for s, e in zip(ss, ee)], axis=-1).astype(jnp.int32)
+        return out, idx
+
+    return primitive_call(f, _t(x), name="adaptive_max_pool1d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _pair(output_size, 3)
+
+    def f(a):
+        d, h, w = a.shape[2], a.shape[3], a.shape[4]
+        od = d if out[0] is None else out[0]
+        oh = h if out[1] is None else out[1]
+        ow = w if out[2] is None else out[2]
+        ds, de = _adaptive_bins(d, od)
+        hs, he = _adaptive_bins(h, oh)
+        ws, we = _adaptive_bins(w, ow)
+        planes, iplanes = [], []
+        for i in range(od):
+            rows, irows = [], []
+            for j in range(oh):
+                cols, icols = [], []
+                for k in range(ow):
+                    blk = a[:, :, ds[i]:de[i], hs[j]:he[j], ws[k]:we[k]]
+                    cols.append(jnp.max(blk, axis=(2, 3, 4)))
+                    if return_mask:
+                        bd, bh, bw = blk.shape[2:]
+                        flat = jax.lax.stop_gradient(blk).reshape(
+                            blk.shape[:2] + (-1,))
+                        am = jnp.argmax(flat, axis=2)
+                        li, rem = am // (bh * bw), am % (bh * bw)
+                        lj, lk = rem // bw, rem % bw
+                        icols.append(((li + ds[i]) * h + (lj + hs[j])) * w
+                                     + lk + ws[k])
+                rows.append(jnp.stack(cols, axis=-1))
+                if return_mask:
+                    irows.append(jnp.stack(icols, axis=-1))
+            planes.append(jnp.stack(rows, axis=-2))
+            if return_mask:
+                iplanes.append(jnp.stack(irows, axis=-2))
+        outv = jnp.stack(planes, axis=-3)
+        if not return_mask:
+            return outv
+        return outv, jnp.stack(iplanes, axis=-3).astype(jnp.int32)
+
+    return primitive_call(f, _t(x), name="adaptive_max_pool3d")
+
+
+def _pool_argmax(a, window, strides, pads):
+    """Flat-spatial argmax per pooling window (int32). Gradient-cut with
+    stop_gradient: the variadic reduce_window has no JVP rule, so tangents
+    must never reach it — gradients flow through the separate
+    differentiable max-pool instead."""
+    a = jax.lax.stop_gradient(a)
+    spatial = a.shape[2:]
+    n_sp = int(np.prod(spatial))
+    idx = jnp.arange(n_sp).reshape((1, 1) + spatial)
+    idx = jnp.broadcast_to(idx, a.shape)
+
+    def red(xp, yp):
+        (xv, xi), (yv, yi) = xp, yp
+        take_y = yv > xv
+        return (jnp.where(take_y, yv, xv), jnp.where(take_y, yi, xi))
+
+    _, oidx = jax.lax.reduce_window(
+        (a, idx), (jnp.asarray(-jnp.inf, a.dtype), jnp.asarray(-1)),
+        red, window, strides, pads)
+    return oidx.astype(jnp.int32)
+
+
+def _max_pool_nd_with_indices(x, kernel_size, stride, padding, nd,
+                              ceil_mode=False, data_format=None):
+    """Max pool returning (out, flat spatial indices) — feeds max_unpool."""
+    if ceil_mode:
+        raise NotImplementedError(
+            "return_mask=True with ceil_mode=True is not supported yet")
+    if data_format is not None and not data_format.startswith("NC"):
+        raise NotImplementedError(
+            f"return_mask=True requires channels-first layout, got {data_format}")
+    kernel = _pair(kernel_size, nd)
+    stride = _pair(stride if stride is not None else kernel_size, nd)
+    pad = _conv_padding(padding, None, (1,) * nd, nd)
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = "VALID" if pad == "VALID" else tuple(
+        [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * nd))
+
+    def f(a):
+        # differentiable max (reduce_window max has a grad rule); the argmax
+        # side is gradient-cut via custom_vjp
+        out = jax.lax.reduce_window(a, jnp.asarray(-jnp.inf, a.dtype),
+                                    jax.lax.max, window, strides, pads)
+        oidx = _pool_argmax(a, window, strides, pads)
+        return out, oidx
+
+    return primitive_call(f, _t(x), name="max_pool_with_index")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, nd=2)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, nd=1)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding,
+                          output_size, nd=3)
+
+
+def _max_unpool_nd(x, indices, kernel_size, stride, padding, output_size, nd):
+    """Scatter pooled values back to their argmax positions (reference
+    unpool op: zeros elsewhere)."""
+    kernel = _pair(kernel_size, nd)
+    stride = _pair(stride if stride is not None else kernel_size, nd)
+    padv = _pair(padding, nd)
+
+    def f(a, idx):
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(int(s) for s in (
+                output_size[-nd:] if len(output_size) > nd else output_size))
+        else:
+            out_sp = tuple(
+                (in_sp[i] - 1) * stride[i] - 2 * padv[i] + kernel[i]
+                for i in range(nd))
+        n, c = a.shape[0], a.shape[1]
+        n_out = int(np.prod(out_sp))
+        flat = jnp.zeros((n, c, n_out), a.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1)
+        ].set(a.reshape(n, c, -1))
+        return flat.reshape((n, c) + out_sp)
+
+    return primitive_call(f, _t(x), _t(indices), name="max_unpool")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            return a.reshape(n, groups, c // groups, h, w).swapaxes(1, 2)\
+                    .reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        return a.reshape(n, h, w, groups, c // groups).swapaxes(3, 4)\
+                .reshape(n, h, w, c)
+
+    return primitive_call(f, _t(x), name="channel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = int(downscale_factor)
+
+    def f(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            return a.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r,
+                                                         h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        return a.transpose(0, 1, 3, 5, 2, 4).reshape(n, h // r, w // r,
+                                                     c * r * r)
+
+    return primitive_call(f, _t(x), name="pixel_unshuffle")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    p = _pair(padding, 4)  # [left, right, top, bottom]
+
+    def f(a):
+        if data_format == "NCHW":
+            return jnp.pad(a, ((0, 0), (0, 0), (p[2], p[3]), (p[0], p[1])))
+        return jnp.pad(a, ((0, 0), (p[2], p[3]), (p[0], p[1]), (0, 0)))
+
+    return primitive_call(f, _t(x), name="zeropad2d")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im (reference fold op): [N, C*kh*kw, L] -> [N, C, H, W] with
+    overlapping patches summed."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        a = a.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                rows = i * dh + sh * jnp.arange(nh)
+                cols = j * dw + sw * jnp.arange(nw)
+                out = out.at[:, :, rows[:, None], cols[None, :]].add(
+                    a[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return primitive_call(f, _t(x), name="fold")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return primitive_call(lambda a: jnp.where(a > threshold, a, 0.0), _t(x),
+                          name="thresholded_relu")
+
+
+def log_sigmoid(x, name=None):
+    return primitive_call(jax.nn.log_sigmoid, _t(x), name="log_sigmoid")
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1]
+        size = n + abs(int(offset))
+        out = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        i = jnp.arange(n)
+        r = i + max(-offset, 0)
+        c = i + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        if (dim1, dim2) not in ((-2, -1), (out.ndim - 2, out.ndim - 1)):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+
+    return primitive_call(f, _t(input), name="diag_embed")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """b_k = x1^T W_k x2 (reference bilinear_tensor_product op)."""
+    def f(a, b, w, *bias_):
+        out = jnp.einsum("ni,oij,nj->no", a, w, b)
+        if bias_:
+            out = out + bias_[0]
+        return out
+
+    args = [_t(x1), _t(x2), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return primitive_call(f, *args, name="bilinear")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid [N, H, W, 2] from affine matrices [N, 2, 3]
+    (reference affine_grid op; pairs with grid_sample)."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def coords(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    def f(th):
+        ys = coords(h)
+        xs = coords(w)
+        gx, gy = jnp.meshgrid(xs, ys)  # [h, w]
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [h*w, 3]
+        grid = jnp.einsum("hk,nok->nho", base, th)  # [n, h*w, 2]
+        return grid.reshape(n, h, w, 2)
+
+    return primitive_call(f, _t(theta), name="affine_grid")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """TSM channel shift along time (reference temporal_shift_op)."""
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        a = a.reshape(n, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        back = jnp.concatenate(
+            [a[:, 1:, :fold_c], jnp.zeros_like(a[:, :1, :fold_c])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(a[:, :1, fold_c:2 * fold_c]),
+             a[:, :-1, fold_c:2 * fold_c]], axis=1)
+        keep = a[:, :, 2 * fold_c:]
+        return jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+
+    return primitive_call(f, _t(x), name="temporal_shift")
+
+
+# ------------------------------------------------------------------ in-place
+def relu_(x, name=None):
+    out = relu(x)
+    x._value = out._value
+    return x
+
+
+def elu_(x, alpha=1.0, name=None):
+    out = elu(x, alpha)
+    x._value = out._value
+    return x
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._value = out._value
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis=axis, dtype=dtype)
+    x._value = out._value
+    return x
+
+
+# ------------------------------------------------------------------- losses 2
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Negative log likelihood of Bernoulli predictions (reference log_loss
+    op: -(y log(p+eps) + (1-y) log(1-p+eps)))."""
+    return primitive_call(
+        lambda p, y: -(y * jnp.log(p + epsilon)
+                       + (1.0 - y) * jnp.log(1.0 - p + epsilon)),
+        _t(input), _t(label), name="log_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - Dice coefficient (reference dice_loss: class-prob input
+    [N, ..., C], integer label [N, ..., 1])."""
+    def f(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y1, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y1, axis=red)
+        return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+    return primitive_call(f, _t(input), _t(label), name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Improved N-pair loss (reference npair_loss): softmax CE over the
+    anchor-positive similarity matrix with same-label soft targets, plus an
+    L2 pull on the embeddings."""
+    def f(a, p, y):
+        batch = a.shape[0]
+        sim = a @ p.T  # [B, B]
+        same = (y.reshape(-1, 1) == y.reshape(1, -1)).astype(a.dtype)
+        targets = same / jnp.sum(same, axis=1, keepdims=True)
+        ce = -jnp.mean(jnp.sum(targets * jax.nn.log_softmax(sim, axis=1),
+                               axis=1))
+        l2 = jnp.sum(a * a) / batch + jnp.sum(p * p) / batch
+        return ce + l2_reg * l2 * 0.25
+
+    return primitive_call(f, _t(anchor), _t(positive), _t(labels),
+                          name="npair_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                      reduction="sum", name=None):
+    """Focal loss on logits (reference sigmoid_focal_loss)."""
+    def f(z, y, *norm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if norm:
+            loss = loss / norm[0]
+        if reduction == "sum":
+            return jnp.sum(loss)
+        if reduction == "mean":
+            return jnp.mean(loss)
+        return loss
+
+    args = [_t(logit), _t(label)] + ([_t(normalizer)] if normalizer is not None else [])
+    return primitive_call(f, *args, name="sigmoid_focal_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False, name=None):
+    """Hierarchical sigmoid over the default complete binary tree (reference
+    hierarchical_sigmoid op). Internal nodes number num_classes-1; the path
+    for class c follows the binary heap encoding of (c + num_classes) from
+    the root, matching the reference's default (non-custom-tree) layout."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom trees (path_table/path_code) are not supported yet")
+    depth = int(np.ceil(np.log2(max(num_classes, 2))))
+    # host-precomputed heap paths per class: node ids + left/right codes
+    tables = np.zeros((num_classes, depth), np.int32)
+    codes = np.full((num_classes, depth), -1, np.int32)  # -1 = unused slot
+    for c in range(num_classes):
+        node = c + num_classes  # leaf id in the implicit heap
+        path = []
+        while node > 1:
+            path.append((node // 2, node % 2))
+            node //= 2
+        for d, (nid, code) in enumerate(reversed(path)):
+            tables[c, d] = nid - 1  # internal nodes are 1-indexed heap slots
+            codes[c, d] = code
+
+    tab = jnp.asarray(tables)
+    cod = jnp.asarray(codes)
+
+    def f(x, y, w, *b):
+        nodes = tab[y]  # [B, depth]
+        code = cod[y]
+        wv = w[nodes]  # [B, depth, F]
+        logits = jnp.einsum("bdf,bf->bd", wv, x)
+        if b:
+            logits = logits + b[0][nodes]
+        valid = code >= 0
+        # BCE with target = code (1 for right branch)
+        t = jnp.where(valid, code, 0).astype(x.dtype)
+        ce = jnp.maximum(logits, 0) - logits * t + jnp.log1p(
+            jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(jnp.where(valid, ce, 0.0), axis=1, keepdims=True)
+
+    args = [_t(input), _t(label), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return primitive_call(f, *args, name="hsigmoid_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC forward loss (reference: warpctc op / F.ctc_loss).
+
+    log_probs: [T, B, C] UNNORMALIZED logits or log-softmax (normalized
+    internally like the reference's warpctc with norm_by_times=False);
+    labels: [B, L] padded with anything past label_lengths.
+
+    TPU-native: the alpha recursion is one lax.scan over time with the
+    standard blank-interleaved label row; all batch rows run masked in
+    lockstep (static shapes).
+    """
+    def f(lp, lab, in_len, lab_len):
+        T, B, C = lp.shape
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        L = lab.shape[1]
+        S = 2 * L + 1
+        neg_inf = jnp.asarray(-1e30, lp.dtype)
+        # extended label row: blank, l1, blank, l2, ..., blank
+        ext = jnp.full((B, S), blank, lab.dtype)
+        ext = ext.at[:, 1::2].set(lab)
+        s_idx = jnp.arange(S)
+        valid_s = s_idx[None, :] < (2 * lab_len[:, None] + 1)
+        # allow the s-2 skip where ext[s] != blank and ext[s] != ext[s-2]
+        ext_m2 = jnp.concatenate([jnp.full((B, 2), -1, ext.dtype),
+                                  ext[:, :-2]], axis=1)
+        can_skip = (ext != blank) & (ext != ext_m2)
+
+        emit0 = jnp.take_along_axis(lp[0], ext, axis=1)  # [B, S]
+        alpha0 = jnp.where(s_idx[None, :] < 2, emit0, neg_inf)
+        alpha0 = jnp.where(valid_s, alpha0, neg_inf)
+
+        def step(alpha, lp_t):
+            a1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(can_skip, a2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            new = jnp.where(valid_s, merged + emit, neg_inf)
+            return new, new
+
+        _, alphas = jax.lax.scan(step, alpha0, lp[1:])
+        alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+        # per-row final alpha at t = input_length - 1
+        a_final = alphas[jnp.clip(in_len - 1, 0, T - 1), jnp.arange(B)]  # [B, S]
+        end1 = 2 * lab_len  # final blank
+        end2 = jnp.maximum(2 * lab_len - 1, 0)  # final label
+        ll = jnp.logaddexp(
+            jnp.take_along_axis(a_final, end1[:, None], axis=1),
+            jnp.where((lab_len > 0)[:, None],
+                      jnp.take_along_axis(a_final, end2[:, None], axis=1),
+                      neg_inf))[:, 0]
+        loss = -ll
+        if norm_by_times:
+            loss = loss / jnp.maximum(in_len.astype(loss.dtype), 1.0)
+        if reduction == "mean":
+            # reference mean: per-sample loss / label_len, then batch mean
+            return jnp.mean(loss / jnp.maximum(
+                lab_len.astype(loss.dtype), 1.0))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return primitive_call(f, _t(log_probs), _t(labels), _t(input_lengths),
+                          _t(label_lengths), name="ctc_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean"):
+    """ArcFace-style margin softmax (reference margin_cross_entropy op):
+    target cosine -> cos(m1*theta + m2) - m3, all scaled by s. Single-shard
+    form; under GSPMD the class dim shards like ParallelCrossEntropy."""
+    def f(cos, y):
+        theta = jnp.arccos(jnp.clip(cos, -1.0, 1.0))
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        one_hot = jax.nn.one_hot(y, cos.shape[-1], dtype=cos.dtype)
+        out = scale * jnp.where(one_hot > 0, tgt, cos)
+        lse = jax.scipy.special.logsumexp(out, axis=-1)
+        tgt_logit = jnp.sum(out * one_hot, axis=-1)
+        loss = lse - tgt_logit
+        if reduction == "mean":
+            loss = jnp.mean(loss)
+        elif reduction == "sum":
+            loss = jnp.sum(loss)
+        if return_softmax:
+            return loss, jax.nn.softmax(out, axis=-1)
+        return loss
+
+    return primitive_call(f, _t(logits), _t(label), name="margin_cross_entropy")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference class_center_sample op,
+    PartialFC). Host-side: the unique-positive set is data-dependent."""
+    import numpy as np_
+
+    from ..core.rng import default_generator
+
+    y = np_.asarray(_t(label)._value if hasattr(label, "_value") else label)
+    pos = np_.unique(y)
+    rest = np_.setdiff1d(np_.arange(num_classes), pos)
+    seed = int(np_.asarray(
+        jax.random.randint(default_generator().next_key(), (), 0, 2**31 - 1)))
+    rng = np_.random.RandomState(seed)
+    n_extra = max(int(num_samples) - pos.size, 0)
+    extra = rng.choice(rest, size=min(n_extra, rest.size), replace=False) \
+        if n_extra else np_.empty((0,), pos.dtype)
+    sampled = np_.sort(np_.concatenate([pos, extra]).astype(np_.int64))
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    y_remap = np_.asarray([remap[v] for v in y.tolist()], np_.int64)
+    from ..core.tensor import Tensor as _T
+
+    return _T(jnp.asarray(y_remap)), _T(jnp.asarray(sampled))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (reference sparse_attention op, CUDA-only).
+
+    TPU fallback: computes dense attention restricted to the CSR pattern —
+    numerically identical to the sparse kernel; a Pallas block-sparse kernel
+    is the planned fast path (splash attention covers the causal case)."""
+    def f(q, k, v, off, cols):
+        b, h, s, d = q.shape
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d, q.dtype))
+        # build a dense mask from CSR, resolving each nnz's row against its
+        # OWN (batch, head) offset row — patterns may differ per head
+        max_nnz = cols.shape[-1]
+
+        def rows_for(off_row):  # [s+1] -> [max_nnz]
+            return jnp.searchsorted(off_row, jnp.arange(max_nnz),
+                                    side="right") - 1
+
+        row_of_nnz = jax.vmap(jax.vmap(rows_for))(off)  # [b, h, max_nnz]
+        mask = jnp.zeros((b, h, s, s), bool)
+        mask = mask.at[
+            jnp.arange(b)[:, None, None],
+            jnp.arange(h)[None, :, None],
+            row_of_nnz,
+            cols].set(True)
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        probs = jnp.where(mask, probs, 0.0)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+    return primitive_call(f, _t(query), _t(key), _t(value),
+                          _t(sparse_csr_offset), _t(sparse_csr_columns),
+                          name="sparse_attention")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, nd, dim_spec, name):
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    out_pad = _pair(output_padding, nd)
+    pad_cfg = padding
+
+    def f(a, w, *b):
+        ks = w.shape[2:]
+        if isinstance(pad_cfg, int):
+            pads = [(pad_cfg, pad_cfg)] * nd
+        elif isinstance(pad_cfg, str):
+            pads = pad_cfg.upper()
+        else:
+            pads = _conv_padding(pad_cfg, None, dilation, nd)
+        if isinstance(pads, list):
+            # output_padding extends the high side of the output (reference
+            # conv_transpose semantics for reaching odd sizes under stride)
+            lax_pads = [
+                (dilation[i] * (k - 1) - p[0],
+                 dilation[i] * (k - 1) - p[1] + out_pad[i])
+                for i, (p, k) in enumerate(zip(pads, ks))
+            ]
+        else:
+            if any(op != 0 for op in out_pad):
+                raise NotImplementedError(
+                    "output_padding with string padding is not supported")
+            lax_pads = pads
+        w_t = jnp.swapaxes(w, 0, 1)  # IO... -> OI...
+        w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + nd)))
+        out = jax.lax.conv_general_dilated(
+            a, w_t, window_strides=(1,) * nd, padding=lax_pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dim_spec, feature_group_count=groups,
+        )
+        if b:
+            out = out + b[0].reshape((1, -1) + (1,) * nd)
+        return out.astype(a.dtype)
+
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+    return primitive_call(f, *args, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, ("NCH", "OIH", "NCH"),
+                              "conv1d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, ("NCDHW", "OIDHW", "NCDHW"),
+                              "conv3d_transpose")

@@ -236,3 +236,158 @@ class GRUCell(Layer):
             f, inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh
         )
         return h_new, h_new
+
+
+class RNNCellBase(Layer):
+    """Base for single-step cells (reference RNNCellBase): provides
+    get_initial_states; subclasses implement forward(inputs, states) ->
+    (outputs, new_states) and state_shape."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+        dt = (batch_ref._value.dtype if isinstance(batch_ref, Tensor)
+              else jnp.float32) if dtype is None else dtype
+
+        def make(s):
+            return Tensor(jnp.full((batch,) + tuple(
+                int(e) for e in (s if isinstance(s, (list, tuple)) else [s])),
+                init_value, dt))
+
+        if isinstance(shape, (list, tuple)) and shape and \
+                isinstance(shape[0], (list, tuple)):
+            return tuple(make(s) for s in shape)
+        return make(shape)
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference SimpleRNNCell)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        std = 1.0 / math.sqrt(hidden_size)
+        self.hidden_size = hidden_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter(
+            (hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            (hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            (hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def f(x_t, hv, w_ih, w_hh, b_ih, b_hh):
+            return act(x_t @ w_ih.T + b_ih + hv @ w_hh.T + b_hh)
+
+        h_new = primitive_call(
+            f, inputs, states, self.weight_ih, self.weight_hh,
+            self.bias_ih, self.bias_hh)
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Drive a single-step cell over a sequence (reference paddle.nn.RNN).
+
+    The time loop is a Python loop over the (static) sequence length —
+    generic cells hold arbitrary Python state, so XLA sees an unrolled
+    chain; the fused-scan path lives in SimpleRNN/GRU/LSTM (_RNNBase)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        x = inputs if self.time_major else inputs.transpose(
+            [1, 0] + list(range(2, len(inputs.shape))))
+        T = x.shape[0]
+        states = initial_states
+        if states is None and hasattr(self.cell, "get_initial_states"):
+            batch_ref = x[0]
+            states = self.cell.get_initial_states(batch_ref)
+        L = None
+        if sequence_length is not None:
+            L = sequence_length._value if isinstance(sequence_length, Tensor) \
+                else jnp.asarray(sequence_length)
+
+        def freeze(new, old, valid):
+            """Keep `old` state for rows already past their length — pad
+            steps must not pollute state (reference masks updates; for the
+            reverse direction this makes the pass an exact reverse over each
+            row's valid prefix: state stays initial until t < L)."""
+            def leaf(n, o):
+                nv = n._value if isinstance(n, Tensor) else n
+                ov = o._value if isinstance(o, Tensor) else o
+                m = valid.reshape((-1,) + (1,) * (nv.ndim - 1))
+                return Tensor(jnp.where(m, nv, ov))
+
+            return jax.tree_util.tree_map(
+                leaf, new, old, is_leaf=lambda v: isinstance(v, Tensor))
+
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        outs = [None] * T
+        for t in steps:
+            out, new_states = self.cell(x[t], states, **kwargs)
+            if L is not None:
+                valid = t < L
+                states = freeze(new_states, states, valid)
+            else:
+                states = new_states
+            outs[t] = out
+        from ..tensor_ops.manipulation import stack
+
+        y = stack(outs, axis=0 if self.time_major else 1)
+        if L is not None:
+            # zero outputs past each row's length (reference masks them)
+            t_idx = jnp.arange(T)
+            mask = (t_idx[:, None] < L[None, :]) if self.time_major else \
+                (t_idx[None, :] < L[:, None])
+            mask = mask[..., None].astype(y._value.dtype)
+            y = Tensor(y._value * mask)
+        return y, states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over the same input, outputs concatenated
+    (reference paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        y_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length, **kwargs)
+        y_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length, **kwargs)
+        from ..tensor_ops.manipulation import concat
+
+        return concat([y_fw, y_bw], axis=-1), (s_fw, s_bw)

@@ -167,3 +167,154 @@ neg = _unary("negative")
 
 def is_same_shape(x, y):
     return tuple(x.shape) == tuple(y.shape)
+
+
+sin = _unary("sin")
+
+
+class ReLU:
+    """Layer form of sparse relu (reference paddle.sparse.ReLU)."""
+
+    def __call__(self, x):
+        return relu(x)
+
+    def __repr__(self):
+        return "sparse.ReLU()"
+
+
+class BatchNorm:
+    """BatchNorm over the dense feature (last) dim of a sparse NDHWC tensor
+    (reference paddle.sparse.BatchNorm: stats over non-zero elements only)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = jnp.ones((num_features,), jnp.float32)
+        self.bias = jnp.zeros((num_features,), jnp.float32)
+        self._mean = jnp.zeros((num_features,), jnp.float32)
+        self._var = jnp.ones((num_features,), jnp.float32)
+        self.training = True
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        self.training = True
+        return self
+
+    def __call__(self, x):
+        xb = _bcoo(x)
+        data = xb.data  # [nnz] — every dim is sparse, channel is indices[:, -1]
+        ch = xb.indices[:, -1]
+        C = self.num_features
+        if self.training:
+            sums = jnp.zeros((C,), data.dtype).at[ch].add(data)
+            cnts = jnp.zeros((C,), data.dtype).at[ch].add(1.0)
+            cnts = jnp.maximum(cnts, 1.0)
+            mean = sums / cnts
+            var = jnp.zeros((C,), data.dtype).at[ch].add(
+                (data - mean[ch]) ** 2) / cnts
+            self._mean = self.momentum * self._mean + (1 - self.momentum) * mean
+            self._var = self.momentum * self._var + (1 - self.momentum) * var
+        else:
+            mean, var = self._mean, self._var
+        norm = (data - mean[ch]) / jnp.sqrt(var[ch] + self.epsilon)
+        out = norm * self.weight[ch] + self.bias[ch]
+        return SparseCooTensor._wrap(
+            jsparse.BCOO((out, xb.indices), shape=xb.shape))
+
+
+class Conv3D:
+    """Sparse 3-D convolution over NDHWC COO input (reference
+    paddle.sparse.nn.Conv3D / sparse conv kernels). Computes densely through
+    XLA's conv (gather/scatter sparse gemm offers no MXU win at these
+    sizes) and re-sparsifies the output support."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, key=None):
+        from .. import nn as _nn
+
+        self._subm = subm
+        k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * 3
+        rng = np.random.RandomState(0 if key is None else key)
+        std = 1.0 / np.sqrt(in_channels * int(np.prod(k)))
+        self.weight = jnp.asarray(
+            rng.uniform(-std, std,
+                        (out_channels, in_channels) + tuple(k)).astype(np.float32))
+        self.bias = jnp.zeros((out_channels,), jnp.float32)
+        self._stride = stride if isinstance(stride, (list, tuple)) else (stride,) * 3
+        self._padding = padding
+
+    def __call__(self, x):
+        import jax as _jax
+
+        xb = _bcoo(x)
+        dense = xb.todense()  # [N, D, H, W, C]
+        a = jnp.moveaxis(dense, -1, 1)  # NCDHW
+        pad = self._padding
+        pads = [(pad, pad)] * 3 if isinstance(pad, int) else [
+            (p, p) for p in pad]
+        stride = (1, 1, 1) if self._subm else tuple(self._stride)
+        if self._subm:
+            # submanifold: keep input support -> SAME padding, stride 1
+            pads = [((k - 1) // 2, k // 2) for k in self.weight.shape[2:]]
+        out = _jax.lax.conv_general_dilated(
+            a, self.weight, window_strides=stride, padding=pads,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        out = jnp.moveaxis(out, 1, -1) + self.bias
+        if self._subm:
+            # restrict the output to the input's support pattern
+            mask = jnp.zeros(dense.shape[:-1] + (1,), out.dtype)
+            mask = mask.at[tuple(jnp.moveaxis(xb.indices, -1, 0)[:-1])].set(1.0)
+            out = out * mask
+        return _from_dense(Tensor(out))
+
+
+class SubmConv3D(Conv3D):
+    """Submanifold sparse conv: output support == input support."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, key=None):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, subm=True, key=key)
+
+
+class MaxPool3D:
+    """Sparse max pool over NDHWC COO input (reference paddle.sparse.MaxPool3D)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, name=None):
+        self._k = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * 3
+        s = stride if stride is not None else kernel_size
+        self._s = s if isinstance(s, (list, tuple)) else (s,) * 3
+        self._p = padding
+
+    def __call__(self, x):
+        import jax as _jax
+
+        xb = _bcoo(x)
+        dense = xb.todense()  # [N, D, H, W, C]
+        pad = self._p
+        pads = [(0, 0)] + ([(pad, pad)] * 3 if isinstance(pad, int)
+                           else [(p, p) for p in pad]) + [(0, 0)]
+        out = _jax.lax.reduce_window(
+            dense, -jnp.inf, _jax.lax.max,
+            (1,) + tuple(self._k) + (1,), (1,) + tuple(self._s) + (1,), pads)
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return _from_dense(Tensor(out))
+
+
+def _from_dense(t):
+    """Dense Tensor -> SparseCooTensor over the non-zero support."""
+    v = t._value if isinstance(t, Tensor) else jnp.asarray(t)
+    idx = jnp.stack(jnp.nonzero(v != 0), axis=0)  # host-side: shape dynamic
+    vals = v[tuple(idx)]
+    return SparseCooTensor(idx, Tensor(vals), v.shape)
+
+
+__all__ += ["sin", "ReLU", "BatchNorm", "Conv3D", "SubmConv3D", "MaxPool3D"]

@@ -163,3 +163,63 @@ def one_hot(x, num_classes, name=None):
     return primitive_call(
         lambda a: jnp.eye(num_classes, dtype=jnp.float32)[a.astype(jnp.int32)], x, name="one_hot"
     )
+
+
+# ---- parity batch (reference: python/paddle/tensor/creation.py) ----
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    import jax.numpy as jnp
+
+    from ..core.dtype import to_jax_dtype
+
+    def val(v):
+        return float(v.numpy()) if hasattr(v, "numpy") else float(v)
+
+    out = jnp.logspace(val(start), val(stop), int(num), base=float(base),
+                       dtype=to_jax_dtype(dtype) or jnp.float32)
+    from ..core.tensor import Tensor
+
+    return Tensor(out)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    import jax.numpy as jnp
+
+    from ..core.dtype import to_jax_dtype
+    from ..core.tensor import Tensor
+
+    col = row if col is None else col
+    r, c = jnp.tril_indices(int(row), k=int(offset), m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    import jax.numpy as jnp
+
+    from ..core.dtype import to_jax_dtype
+    from ..core.tensor import Tensor
+
+    col = row if col is None else col
+    r, c = jnp.triu_indices(int(row), k=int(offset), m=int(col))
+    return Tensor(jnp.stack([r, c]).astype(to_jax_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    from ..core.dispatch import primitive_call
+
+    return primitive_call(lambda r, i: r + 1j * i, real, imag, name="complex")
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone learnable parameter (reference: paddle.create_parameter —
+    layers/tensor.py create_parameter)."""
+    from .. import nn
+
+    helper = nn.Layer()
+    return helper.create_parameter(list(shape), attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+__all__ += ["logspace", "tril_indices", "triu_indices", "complex",
+            "create_parameter"]

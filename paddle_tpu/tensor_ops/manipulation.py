@@ -345,3 +345,117 @@ def tolist(x):
 
 
 import jax  # noqa: E402  (used by as_complex)
+
+
+# ---- parity batch (reference: python/paddle/tensor/manipulation.py) ----
+def broadcast_tensors(inputs, name=None):
+    import jax.numpy as jnp
+
+    vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in inputs]
+    shape = jnp.broadcast_shapes(*[v.shape for v in vals])
+    return [Tensor(jnp.broadcast_to(v, shape)) for v in vals]
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return primitive_call(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x, name="diagonal")
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis, name=name)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Static crop: slice `shape` starting at `offsets` (defaults to 0s)."""
+    def _ints(v, default, n):
+        if v is None:
+            return [default] * n
+        if isinstance(v, Tensor):
+            v = v.tolist()
+        return [int(e) for e in v]
+
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    n = xv.ndim
+    shp = _ints(shape, -1, n)
+    offs = _ints(offsets, 0, n)
+    shp = [xv.shape[i] - offs[i] if s == -1 else s for i, s in enumerate(shp)]
+    sl = tuple(slice(o, o + s) for o, s in zip(offs, shp))
+    return primitive_call(lambda a: a[sl], x, name="crop")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """Zeros of `shape` with `updates` added at `index` (reference scatter_nd:
+    duplicate indices accumulate)."""
+    from ..core.dtype import to_jax_dtype  # noqa: F401 (parity with creation)
+
+    def f(idx, upd):
+        z = jnp.zeros(tuple(int(s) for s in shape), upd.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+
+    return primitive_call(f, index, updates, name="scatter_nd")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference: shard_index op — used by
+    sharded embedding / parallel CE)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for {nshards} shards")
+    size = (index_num + nshards - 1) // nshards
+
+    def f(a):
+        shard = a // size
+        local = a % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+
+    return primitive_call(f, input, name="shard_index")
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    """Collapse consecutive duplicates (reference unique_consecutive op).
+
+    Host-side (NumPy) implementation: the output shape is data-dependent,
+    which XLA cannot express — same reason the reference keeps it CPU-bound.
+    """
+    import numpy as np
+
+    from ..core.dtype import to_jax_dtype
+
+    v = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if axis is None:
+        flat = v.reshape(-1)
+        keep = np.ones(flat.shape[0], bool)
+        if flat.shape[0] > 1:
+            keep[1:] = flat[1:] != flat[:-1]
+        out = flat[keep]
+        idx = np.cumsum(keep) - 1
+        counts = np.bincount(idx, minlength=out.shape[0])
+    else:
+        moved = np.moveaxis(v, axis, 0)
+        keep = np.ones(moved.shape[0], bool)
+        if moved.shape[0] > 1:
+            keep[1:] = (moved[1:] != moved[:-1]).reshape(moved.shape[0] - 1, -1).any(1)
+        out = np.moveaxis(moved[keep], 0, axis)
+        idx = np.cumsum(keep) - 1
+        counts = np.bincount(idx, minlength=int(keep.sum()))
+    res = [Tensor(jnp.asarray(out))]
+    it = to_jax_dtype(dtype)
+    if return_inverse:
+        res.append(Tensor(jnp.asarray(idx.astype(it))))
+    if return_counts:
+        res.append(Tensor(jnp.asarray(counts.astype(it))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite=overwrite, name=name)
+    if isinstance(x, Tensor):
+        x._value = out._value
+        return x
+    return out
+
+
+__all__ += ["broadcast_tensors", "diagonal", "reverse", "crop", "scatter_nd",
+            "shard_index", "unique_consecutive", "scatter_"]

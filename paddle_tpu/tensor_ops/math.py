@@ -310,3 +310,112 @@ def clip_(x, min=None, max=None, name=None):
 def scale_(x, scale=1.0, bias=0.0, name=None):
     x._value = x._value * scale + bias
     return x
+
+
+# ---- parity batch (reference: python/paddle/tensor/math.py __all__) ----
+acosh = _wrap1("acosh", jnp.arccosh)
+asinh = _wrap1("asinh", jnp.arcsinh)
+atanh = _wrap1("atanh", jnp.arctanh)
+conj = _wrap1("conj", jnp.conj)
+digamma = _wrap1("digamma", jax.scipy.special.digamma)
+lgamma = _wrap1("lgamma", jax.scipy.special.gammaln)
+erfinv = _wrap1("erfinv", jax.scipy.special.erfinv)
+real = _wrap1("real", jnp.real)
+imag = _wrap1("imag", jnp.imag)
+gcd = _wrap2("gcd", jnp.gcd)
+lcm = _wrap2("lcm", jnp.lcm)
+heaviside = _wrap2("heaviside", jnp.heaviside)
+kron = _wrap2("kron", jnp.kron)
+floor_mod = remainder
+
+
+def tanh_(x, name=None):
+    """In-place tanh (reference inplace contract: result written into x)."""
+    out = tanh(x)
+    x._value = out._value
+    return x
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return primitive_call(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        x, name="trace")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return primitive_call(
+        lambda i, a, b: beta * i + alpha * (a @ b), input, x, y, name="addmm")
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return primitive_call(
+        lambda a: jnp.quantile(a.astype(jnp.float64 if a.dtype == jnp.float64
+                                        else jnp.float32),
+                               qv, axis=_axis(axis), keepdims=keepdim),
+        x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return primitive_call(
+        lambda a: jnp.nanquantile(a.astype(jnp.float64 if a.dtype == jnp.float64
+                                           else jnp.float32),
+                                  qv, axis=_axis(axis), keepdims=keepdim),
+        x, name="nanquantile")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Scale each sub-tensor along `axis` so its p-norm is <= max_norm."""
+    def f(a):
+        red = tuple(i for i in range(a.ndim) if i != (axis % a.ndim))
+        norms = jnp.sum(jnp.abs(a) ** p, axis=red, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return a * factor
+
+    return primitive_call(f, x, name="renorm")
+
+
+def rank(input, name=None):
+    v = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(jnp.asarray(v.ndim, jnp.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype((x._value if isinstance(x, Tensor) else x).dtype,
+                          jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype((x._value if isinstance(x, Tensor) else x).dtype,
+                          jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype((x._value if isinstance(x, Tensor) else x).dtype,
+                          jnp.integer)
+
+
+__all__ += [
+    "acosh", "asinh", "atanh", "conj", "digamma", "lgamma", "erfinv", "real",
+    "imag", "gcd", "lcm", "heaviside", "kron", "floor_mod", "tanh_", "trace",
+    "addmm", "quantile", "nanquantile", "renorm", "rank", "is_complex",
+    "is_floating_point", "is_integer",
+]
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    """Histogram of non-negative ints (reference bincount op). The output
+    length is data-dependent, so it is computed host-side (same reason the
+    reference runs it on CPU for small inputs); inside jit, pass minlength
+    covering the range instead."""
+    import numpy as np_
+
+    xv = np_.asarray(x._value if isinstance(x, Tensor) else x)
+    wv = None if weights is None else np_.asarray(
+        weights._value if isinstance(weights, Tensor) else weights)
+    out = np_.bincount(xv.reshape(-1), weights=wv, minlength=int(minlength))
+    return Tensor(jnp.asarray(out))
+
+
+__all__ += ["bincount"]
