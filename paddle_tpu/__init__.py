@@ -67,6 +67,8 @@ from . import inference  # noqa: E402,F401
 from . import fft  # noqa: E402,F401
 from . import signal  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
+from . import device  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
 
 from .framework.io import load, save  # noqa: E402,F401
 from .framework import grad, in_dynamic_mode, LazyGuard  # noqa: E402,F401

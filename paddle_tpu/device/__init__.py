@@ -1,0 +1,110 @@
+"""paddle.device — device query/selection API (reference:
+python/paddle/device/__init__.py). Single first-class TPU backend: every
+accelerator alias resolves to the TPU place; `cuda`-family queries answer
+for the TPU chip so reference code paths keep working.
+"""
+from __future__ import annotations
+
+from ..core.place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    CUDAPinnedPlace,
+    NPUPlace,
+    Place,
+    TPUPlace,
+)
+
+__all__ = [
+    "get_cudnn_version", "set_device", "get_device", "XPUPlace", "IPUPlace",
+    "MLUPlace", "is_compiled_with_xpu", "is_compiled_with_ipu",
+    "is_compiled_with_cinn", "is_compiled_with_cuda", "is_compiled_with_rocm",
+    "is_compiled_with_npu", "is_compiled_with_mlu", "get_all_device_type",
+    "get_all_custom_device_type", "get_available_device",
+    "get_available_custom_device",
+]
+
+
+class XPUPlace(TPUPlace):
+    """Alias place: resolves to the accelerator (see module docstring)."""
+
+
+class IPUPlace(TPUPlace):
+    """Alias place: resolves to the accelerator (see module docstring)."""
+
+
+class MLUPlace(TPUPlace):
+    """Alias place: resolves to the accelerator (see module docstring)."""
+
+
+def set_device(device):
+    import paddle_tpu as paddle
+
+    return paddle.set_device(device)
+
+
+def get_device():
+    import paddle_tpu as paddle
+
+    return paddle.get_device()
+
+
+def get_cudnn_version():
+    """No cuDNN on TPU (reference returns None when not compiled with CUDA)."""
+    return None
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False
+
+
+def is_compiled_with_mlu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    return False
+
+
+def get_all_device_type():
+    import jax
+
+    types = ["cpu"]
+    try:
+        if any(d.platform != "cpu" for d in jax.devices()):
+            types.append("tpu")
+    except Exception:  # pragma: no cover - backend init failure
+        pass
+    return types
+
+
+def get_all_custom_device_type():
+    return []
+
+
+def get_available_device():
+    import jax
+
+    out = []
+    for d in jax.devices():
+        out.append(f"{'tpu' if d.platform != 'cpu' else 'cpu'}:{d.id}")
+    return out
+
+
+def get_available_custom_device():
+    return []

@@ -53,3 +53,49 @@ def get_group(gid=0):
     from .collective import _get_group
 
     return _get_group(gid)
+
+
+class ParallelMode:
+    """Parallelism taxonomy constants (reference:
+    fleet/base/topology.py ParallelMode)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-barrier bootstrap (reference gloo_* trio). The TCPStore plays
+    gloo's role here: the explicit args become the rank identity env the
+    store/rendezvous reads, then every rank checks in."""
+    import os
+
+    os.environ["PADDLE_TRAINER_ID"] = str(int(rank_id))
+    os.environ["PADDLE_TRAINERS_NUM"] = str(int(rank_num))
+    if server_endpoint:
+        os.environ.setdefault("PADDLE_MASTER", str(server_endpoint))
+    from .env import init_parallel_env
+
+    init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+
+    barrier()
+
+
+def gloo_release():
+    """Tear down the barrier store (no-op: the TCPStore closes with the
+    process; kept for API parity)."""
+
+
+from .collective import split  # noqa: E402,F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: E402,F401
+from . import launch  # noqa: E402,F401
+from .ps.tables import (  # noqa: E402,F401
+    CountFilterEntry,
+    ProbabilityEntry,
+    ShowClickEntry,
+)

@@ -104,8 +104,33 @@ def new_group(ranks=None, backend=None, axis=None, mesh=None) -> Group:
     return g
 
 
-def split(*args, **kwargs):  # reference has distributed.split for mp layers
-    raise NotImplementedError("use fleet.meta_parallel mp layers")
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel linear/embedding in one call (reference:
+    python/paddle/distributed/collective.py split — builds the parallel layer
+    and applies it). Delegates to the fleet mp layers, which attach GSPMD
+    shardings instead of doing program surgery."""
+    from .fleet.meta_parallel import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(f"unsupported operation {operation!r}")
+    if axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    elif axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False)
+    else:
+        raise ValueError("axis must be 0 (row) or 1 (column)")
+    return layer(x)
 
 
 # ------------------------------------------------------------------ helpers

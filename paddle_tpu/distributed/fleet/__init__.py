@@ -41,3 +41,10 @@ is_first_worker = fleet.is_first_worker
 worker_index = fleet.worker_index
 worker_num = fleet.worker_num
 barrier_worker = fleet.barrier_worker
+from ..topology import CommunicateTopology, HybridCommunicateGroup  # noqa: E402,F401
+from ..ps.role_maker import Role, UserDefinedRoleMaker  # noqa: E402,F401
+from .data_generator import (  # noqa: E402,F401
+    MultiSlotDataGenerator,
+    MultiSlotStringDataGenerator,
+)
+from .fleet_base import UtilBase  # noqa: E402,F401

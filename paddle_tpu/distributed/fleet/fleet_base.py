@@ -144,14 +144,41 @@ class Fleet:
 
 
 class _UtilBase:
-    def all_reduce(self, input, mode="sum"):
-        return input
+    """fleet.util (reference: fleet/base/util_factory.py UtilBase) —
+    all_reduce/barrier route through the collective layer; get_file_shard
+    splits a file list evenly over workers."""
 
-    def barrier(self):
-        pass
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ...core.tensor import Tensor
+        from .. import collective as C
+
+        op = {"sum": C.ReduceOp.SUM, "max": C.ReduceOp.MAX,
+              "min": C.ReduceOp.MIN}[mode]
+        t = Tensor(np.asarray(input))
+        C.all_reduce(t, op=op)
+        return np.asarray(t._value)
+
+    def barrier(self, comm_world="worker"):
+        from .. import collective as C
+
+        C.barrier()
 
     def get_file_shard(self, files):
-        return files
+        me, n = fleet.worker_index(), fleet.worker_num()
+        per = len(files) // n
+        rem = len(files) % n
+        start = per * me + min(me, rem)
+        end = start + per + (1 if me < rem else 0)
+        return list(files[start:end])
+
+    def print_on_rank(self, message, rank_id=0):
+        if fleet.worker_index() == rank_id:
+            print(message)
+
+
+UtilBase = _UtilBase
 
 
 class HybridParallelOptimizer:

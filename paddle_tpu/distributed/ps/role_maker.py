@@ -53,3 +53,17 @@ class PaddleCloudRoleMaker:
 
     def get_pserver_endpoints(self):
         return list(self._server_endpoints)
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Role assignment from explicit arguments instead of env vars
+    (reference: fleet/base/role_maker.py UserDefinedRoleMaker). Overrides the
+    instance attributes the base class's public accessors read."""
+
+    def __init__(self, is_collective=False, current_id=0, role=Role.WORKER,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+        self._role = role
+        self._current_id = int(current_id)
+        self._worker_num = int(worker_num)
+        self._server_endpoints = list(server_endpoints or [])

@@ -424,3 +424,45 @@ class SsdSparseTable:
             self._file.close()
         except Exception:
             pass
+
+
+# ---- sparse-table entry policies (reference: the_one_ps.py Entry configs:
+# show-click/probability/count-filter admission of new embedding ids) ----
+class Entry:
+    def attr(self) -> str:
+        raise NotImplementedError
+
+
+class CountFilterEntry(Entry):
+    """Admit an id into the sparse table only after `count_filter` hits."""
+
+    def __init__(self, count_filter=5):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self._count = int(count_filter)
+
+    def attr(self):
+        return f"count_filter_entry:{self._count}"
+
+
+class ProbabilityEntry(Entry):
+    """Admit new ids with the given probability."""
+
+    def __init__(self, probability=1.0):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._probability = float(probability)
+
+    def attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class ShowClickEntry(Entry):
+    """Weight admission by show/click slot statistics."""
+
+    def __init__(self, show_name, click_name):
+        self._show = str(show_name)
+        self._click = str(click_name)
+
+    def attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"

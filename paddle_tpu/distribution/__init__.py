@@ -10,7 +10,8 @@ import numpy as np
 from ..core.rng import next_rng_key
 from ..core.tensor import Tensor
 
-__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+__all__ = ["Distribution", "ExponentialFamily", "register_kl",
+           "Normal", "Uniform", "Categorical", "Bernoulli",
            "Beta", "Dirichlet", "Multinomial", "kl_divergence"]
 
 
@@ -199,7 +200,64 @@ def kl_divergence(p, q):
               + jnp.sum((a - b) * (digamma(a) - digamma(a0)[..., None]),
                         axis=-1))
         return Tensor(kl)
+    fn = _lookup_registered_kl(type(p), type(q))
+    if fn is not None:
+        return fn(p, q)
     raise NotImplementedError(f"kl_divergence({type(p)}, {type(q)})")
+
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a user KL implementation (reference:
+    distribution/kl.py register_kl). Most-derived match wins, like the
+    reference's total-ordering lookup."""
+    def decorator(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return decorator
+
+
+def _lookup_registered_kl(tp, tq):
+    best, best_score = None, None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if issubclass(tp, cp) and issubclass(tq, cq):
+            score = (tp.__mro__.index(cp), tq.__mro__.index(cq))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    return best
+
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (reference
+    distribution/exponential_family.py): entropy via the Bregman identity
+    H = F(θ) - <θ, ∇F(θ)> computed with autodiff on log_normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nat = [n._value if isinstance(n, Tensor) else jnp.asarray(n)
+               for n in self._natural_parameters]
+        # grad of the SUMMED normalizer is per-element (batch entries are
+        # independent), so entropy keeps the distribution's batch shape
+        grads = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nat))))(*nat)
+        ent = self._log_normalizer(*nat) - self._mean_carrier_measure
+        for n, g in zip(nat, grads):
+            ent = ent - n * g
+        return Tensor(ent)
 
 
 from .transform import (  # noqa: E402,F401

@@ -1,6 +1,20 @@
-"""paddle.incubate (reference: python/paddle/incubate/) — MoE, ASP sparsity."""
+"""paddle.incubate (reference: python/paddle/incubate/) — MoE, ASP sparsity,
+segment/graph ops, LookAhead/ModelAverage."""
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
 from . import auto_checkpoint  # noqa: F401
 from . import autograd  # noqa: F401
 from .distributed.models.moe import MoELayer  # noqa: F401
+from .ops import (  # noqa: F401
+    graph_khop_sampler,
+    graph_reindex,
+    graph_sample_neighbors,
+    graph_send_recv,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,171 @@
+"""Incubate ops: segment reductions, graph sampling, fused softmax masks
+(reference: python/paddle/incubate/operators/ + incubate/tensor/math.py).
+
+TPU-native notes: segment_* lower onto jax.ops.segment_* (one sorted
+scatter-reduce, XLA-fused); graph_send_recv is a gather + segment reduce;
+the neighbor samplers are host-side (their output shapes are
+data-dependent, same reason the reference runs them on CPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "graph_send_recv", "graph_khop_sampler", "graph_sample_neighbors",
+    "graph_reindex", "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+]
+
+
+def _num_segments(segment_ids):
+    ids = np.asarray(segment_ids._value if isinstance(segment_ids, Tensor)
+                     else segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(op_name, jax_fn, fill=0.0):
+    def op(data, segment_ids, name=None):
+        n = _num_segments(segment_ids)
+
+        def f(d, ids):
+            out = jax_fn(d, ids, num_segments=n)
+            if op_name in ("segment_max", "segment_min"):
+                # empty segments: reference yields 0, jax yields +-inf
+                out = jnp.where(jnp.isfinite(out), out, 0.0)
+            return out
+
+        return primitive_call(f, data, segment_ids, name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+segment_sum = _segment("segment_sum", jax.ops.segment_sum)
+segment_mean = _segment(
+    "segment_mean",
+    lambda d, ids, num_segments: jax.ops.segment_sum(d, ids, num_segments)
+    / jnp.maximum(
+        jax.ops.segment_sum(jnp.ones(d.shape[:1], d.dtype), ids, num_segments),
+        1.0).reshape((-1,) + (1,) * (d.ndim - 1)))
+segment_max = _segment("segment_max", jax.ops.segment_max)
+segment_min = _segment("segment_min", jax.ops.segment_min)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather x[src] and reduce into rows dst (reference graph_send_recv op —
+    the message-passing primitive)."""
+    n = out_size or x.shape[0]
+    red = {"sum": jax.ops.segment_sum, "mean": None, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+    if pool_type not in red:
+        raise ValueError(f"unsupported pool_type {pool_type}")
+
+    def f(xv, src, dst):
+        msgs = xv[src]
+        if pool_type == "mean":
+            s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+            c = jax.ops.segment_sum(jnp.ones(msgs.shape[:1], xv.dtype), dst,
+                                    num_segments=n)
+            return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (s.ndim - 1))
+        out = red[pool_type](msgs, dst, num_segments=n)
+        if pool_type in ("max", "min"):
+            out = jnp.where(jnp.isfinite(out), out, 0.0)
+        return out
+
+    return primitive_call(f, x, src_index, dst_index, name="graph_send_recv")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """Sample up to sample_size neighbors per input node from a CSC graph
+    (host-side: output size is data-dependent)."""
+    rowv = np.asarray(row._value if isinstance(row, Tensor) else row)
+    ptr = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
+    nodes = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
+                       else input_nodes)
+    rng = np.random.RandomState(0)
+    out_nb, out_cnt = [], []
+    for nid in nodes.reshape(-1):
+        nbrs = rowv[ptr[nid]:ptr[nid + 1]]
+        if 0 < sample_size < nbrs.size:
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_nb.append(nbrs)
+        out_cnt.append(len(nbrs))
+    nb = np.concatenate(out_nb) if out_nb else np.empty((0,), rowv.dtype)
+    return Tensor(jnp.asarray(nb)), Tensor(jnp.asarray(np.asarray(out_cnt,
+                                                                  np.int32)))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to contiguous local ids (reference
+    graph_reindex op). Host-side (hash-table build)."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x).reshape(-1)
+    nb = np.asarray(neighbors._value if isinstance(neighbors, Tensor)
+                    else neighbors).reshape(-1)
+    cnt = np.asarray(count._value if isinstance(count, Tensor) else count)
+    mapping: dict[int, int] = {}
+    for v in xv.tolist():
+        mapping.setdefault(v, len(mapping))
+    for v in nb.tolist():
+        mapping.setdefault(v, len(mapping))
+    reindex_nb = np.asarray([mapping[v] for v in nb.tolist()], np.int64)
+    # reindexed dst: input node i repeated count[i] times
+    dst = np.repeat(np.arange(xv.size), cnt)
+    nodes = np.asarray(list(mapping.keys()), xv.dtype)
+    return (Tensor(jnp.asarray(reindex_nb)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(nodes)))
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling + reindex (reference graph_khop_sampler)."""
+    frontier = np.asarray(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes).reshape(-1)
+    all_nb, all_cnt, seeds = [], [], [frontier]
+    for size in sample_sizes:
+        nb, cnt = graph_sample_neighbors(row, colptr, Tensor(jnp.asarray(
+            frontier)), sample_size=size)
+        nbv = np.asarray(nb._value)
+        all_nb.append(nbv)
+        all_cnt.append(np.asarray(cnt._value))
+        frontier = np.unique(nbv)
+        seeds.append(frontier)
+    nb_cat = np.concatenate(all_nb) if all_nb else np.empty((0,), np.int64)
+    cnt_cat = np.concatenate(all_cnt) if all_cnt else np.empty((0,), np.int32)
+    src = np.asarray(
+        input_nodes._value if isinstance(input_nodes, Tensor)
+        else input_nodes).reshape(-1)
+    hop_src = np.concatenate(
+        [s for s, c in zip(seeds[:-1], all_cnt)]) if all_cnt else src
+    reindex_nb, dst, nodes = graph_reindex(
+        Tensor(jnp.asarray(hop_src)), Tensor(jnp.asarray(nb_cat)),
+        Tensor(jnp.asarray(cnt_cat)))
+    return reindex_nb, dst, nodes, Tensor(jnp.asarray(cnt_cat))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) in one fused kernel (reference
+    fused_softmax_mask_op — XLA fuses the add into the softmax)."""
+    return primitive_call(
+        lambda a, m: jax.nn.softmax(a + m.astype(a.dtype), axis=-1),
+        x, mask, name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the upper triangle masked out (causal; reference
+    fused_softmax_mask_upper_triangle_op)."""
+    def f(a):
+        s_q, s_k = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+        return jax.nn.softmax(jnp.where(causal, a, -1e30), axis=-1)
+
+    return primitive_call(f, x, name="softmax_mask_fuse_upper_triangle")

@@ -1,0 +1,99 @@
+"""Incubate optimizers: LookAhead, ModelAverage (reference:
+python/paddle/incubate/optimizer/{lookahead,modelaverage}.py).
+
+Both wrap an inner optimizer: LookAhead interpolates slow weights toward the
+fast weights every k steps; ModelAverage maintains a running average of
+parameters applied at eval time via apply()/restore().
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """slow += alpha * (fast - slow) every k inner steps; fast <- slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = {}
+        self._steps = 0
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k:
+            return
+        for p in self._parameter_list or []:
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(id(p))
+            if slow is None:
+                slow = p._value
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running parameter average (reference ModelAverage: accumulators with
+    the same num_updates windowing, apply()/restore() around evaluation)."""
+
+    def __init__(self, average_window_rate, parameters=None, min_average_window=
+                 10000, max_average_window=10000, name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._parameter_list = list(parameters) if parameters else []
+        self._sum = {id(p): jnp.zeros_like(p._value)
+                     for p in self._parameter_list}
+        self._num = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current parameter values (call after inner step)."""
+        self._num += 1
+        window = max(self.min_w, min(self.max_w, int(self._num * self.rate)
+                                     or 1))
+        decay = (window - 1) / window
+        for p in self._parameter_list:
+            self._sum[id(p)] = self._sum[id(p)] * decay + p._value
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged weights in (context-manager friendly)."""
+        self._backup = {id(p): p._value for p in self._parameter_list}
+        window = max(self.min_w, min(self.max_w, int(self._num * self.rate)
+                                     or 1))
+        denom = sum((window - 1) ** i / window ** i
+                    for i in range(min(self._num, window))) or 1.0
+        for p in self._parameter_list:
+            p._value = (self._sum[id(p)] / denom).astype(p._value.dtype)
+        return self
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._parameter_list:
+                p._value = self._backup[id(p)]
+            self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.restore()

@@ -23,7 +23,12 @@ from .sampler import (  # noqa: F401
     SequenceSampler,
     WeightedRandomSampler,
 )
-from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    WorkerInfo,
+    default_collate_fn,
+    get_worker_info,
+)
 from .bucketing import (  # noqa: F401
     LengthBucketSampler,
     bucket_boundaries,

@@ -138,7 +138,9 @@ class DataLoader:
         lock = threading.Lock()
         stop = threading.Event()
 
-        def worker():
+        def worker(wid):
+            _worker_tls.info = WorkerInfo(wid, self.num_workers, wid,
+                                          self.dataset)
             while not stop.is_set():
                 task = idx_q.get()
                 if task is None:
@@ -150,7 +152,8 @@ class DataLoader:
                 except Exception as e:  # propagate
                     out_q.put((i, e))
 
-        threads = [threading.Thread(target=worker, daemon=True) for _ in range(self.num_workers)]
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
         for t in threads:
             t.start()
 
@@ -211,7 +214,7 @@ class DataLoader:
             ctx.Process(
                 target=_mp_worker_loop,
                 args=(self.dataset, collate, idx_q, res_q,
-                      self.worker_init_fn, wid),
+                      self.worker_init_fn, wid, self.num_workers),
                 daemon=True,
             )
             for wid in range(self.num_workers)
@@ -342,7 +345,35 @@ def _tree_unflatten(struct, leaves):
     return struct
 
 
-def _mp_worker_loop(dataset, collate, idx_q, res_q, init_fn, wid):
+class WorkerInfo:
+    """Per-worker metadata visible inside dataset code (reference:
+    fluid/dataloader/worker.py:142)."""
+
+    def __init__(self, id, num_workers, seed, dataset):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, num_workers={self.num_workers}, "
+                f"seed={self.seed})")
+
+
+_worker_info: WorkerInfo | None = None  # process-wide (fork workers)
+_worker_tls = threading.local()  # per-thread (threaded fallback workers)
+
+
+def get_worker_info():
+    """Inside a DataLoader worker: that worker's WorkerInfo; None in the
+    main process (reference: fluid/dataloader/worker.py:76)."""
+    return getattr(_worker_tls, "info", None) or _worker_info
+
+
+def _mp_worker_loop(dataset, collate, idx_q, res_q, init_fn, wid,
+                    num_workers=0):
+    global _worker_info
+    _worker_info = WorkerInfo(wid, num_workers, wid, dataset)
     if init_fn is not None:
         init_fn(wid)
     while True:

@@ -50,6 +50,9 @@ class TracedLayer:
         self._is_layer = hasattr(fn_or_layer, "named_parameters")
 
     def __call__(self, *args, **kwargs):
+        if not ProgramTranslator.get_instance().enable_to_static:
+            # global dy2static kill-switch: run the original eagerly
+            return self._target(*args, **kwargs)
         key = _sig_of(args)
         if key not in self._cache:
             self._cache[key] = self._build(args, kwargs)
@@ -256,3 +259,36 @@ def not_to_static(fn=None):
 
 
 ignore_module = lambda *a, **k: None
+
+
+# ---- parity shims (reference: jit/__init__.py ProgramTranslator + logging) --
+class ProgramTranslator:
+    """Singleton controlling dy2static globally (reference
+    dygraph_to_static/program_translator.py): enable(False) makes to_static
+    functions run eagerly."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enable_to_static = True
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        self.enable_to_static = bool(enable_to_static)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Dump transformed code at/below `level` (reference jit.set_code_level).
+    Maps onto the dy2static debug flag."""
+    os.environ["PADDLE_TPU_D2S_CODE_LEVEL"] = str(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Set dy2static logging verbosity (reference jit.set_verbosity)."""
+    os.environ["PADDLE_TPU_D2S_VERBOSITY"] = str(level)

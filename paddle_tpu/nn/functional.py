@@ -209,7 +209,10 @@ def linear(x, weight, bias=None, name=None):
 
 def _pair(v, n=2):
     if isinstance(v, (list, tuple)):
-        return tuple(int(i) for i in v)
+        # None entries survive: adaptive pools use None = keep input dim
+        return tuple(None if i is None else int(i) for i in v)
+    if v is None:
+        return (None,) * n
     return (int(v),) * n
 
 
@@ -1226,12 +1229,23 @@ def _max_pool_nd_with_indices(x, kernel_size, stride, padding, nd,
     pad = _conv_padding(padding, None, (1,) * nd, nd)
     window = (1, 1) + tuple(kernel)
     strides = (1, 1) + tuple(stride)
-    pads = "VALID" if pad == "VALID" else tuple(
-        [(0, 0), (0, 0)] + (pad if isinstance(pad, list) else [(0, 0)] * nd))
 
     def f(a):
+        if isinstance(pad, str):
+            if pad == "VALID":
+                pads = "VALID"
+            else:  # SAME: explicit per-dim pads so indices stay consistent
+                pads = [(0, 0), (0, 0)]
+                for i in range(nd):
+                    n = a.shape[2 + i]
+                    total = max((-(-n // stride[i]) - 1) * stride[i]
+                                + kernel[i] - n, 0)
+                    pads.append((total // 2, total - total // 2))
+                pads = tuple(pads)
+        else:
+            pads = tuple([(0, 0), (0, 0)] + pad)
         # differentiable max (reduce_window max has a grad rule); the argmax
-        # side is gradient-cut via custom_vjp
+        # side is gradient-cut via stop_gradient
         out = jax.lax.reduce_window(a, jnp.asarray(-jnp.inf, a.dtype),
                                     jax.lax.max, window, strides, pads)
         oidx = _pool_argmax(a, window, strides, pads)

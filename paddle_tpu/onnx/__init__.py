@@ -1,0 +1,27 @@
+"""paddle.onnx — ONNX export (reference: python/paddle/onnx/export.py, which
+delegates to the external paddle2onnx package).
+
+This environment ships no onnx runtime; the supported deployment path is
+StableHLO export (`paddle_tpu.static.io.export_stablehlo` / the inference
+Predictor). `export` is kept as an API-compatible gate that points users
+there.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "ONNX export requires the `onnx`/`paddle2onnx` packages, which "
+            "are not available in this environment. Use the StableHLO "
+            "deployment path instead: paddle_tpu.jit.save + "
+            "paddle_tpu.inference.Predictor (portable across TPU/CPU via "
+            "PJRT), or static.io.export_stablehlo for the raw artifact."
+        ) from e
+    raise NotImplementedError(
+        "onnx is importable but paddle2onnx-style conversion is not "
+        "implemented; use the StableHLO path (see module docstring).")

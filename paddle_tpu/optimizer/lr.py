@@ -252,3 +252,27 @@ class CyclicLR(LRScheduler):
         if self.mode == "triangular2":
             scale = 0.5 ** (self.last_epoch // cycle_len)
         return self.base_lr + (self.max_lr - self.base_lr) * pct * scale
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr = lr * lr_lambda(epoch) applied cumulatively (reference
+    MultiplicativeDecay: multiplies the previous lr each epoch). Factors are
+    memoized so each epoch's lambda is evaluated once, not O(T) per step."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1, verbose=False):
+        self.lr_lambda = lr_lambda
+        self._cum = {0: 1.0}  # epoch -> product of factors through epoch
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def _cum_factor(self, epoch):
+        if epoch in self._cum:
+            return self._cum[epoch]
+        top = max(self._cum)
+        prod = self._cum[top]
+        for e in range(top + 1, epoch + 1):
+            prod *= self.lr_lambda(e)
+            self._cum[e] = prod
+        return prod
+
+    def get_lr(self):
+        return self.base_lr * self._cum_factor(max(self.last_epoch, 0))

@@ -1,3 +1,9 @@
 from . import flags  # noqa: F401
 from . import monitor  # noqa: F401
-from .misc import try_import, unique_name  # noqa: F401
+from .misc import (  # noqa: F401
+    deprecated,
+    require_version,
+    run_check,
+    try_import,
+    unique_name,
+)

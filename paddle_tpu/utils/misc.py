@@ -30,3 +30,74 @@ class _UniqueNameGenerator:
 
 
 unique_name = _UniqueNameGenerator()
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference:
+    python/paddle/utils/deprecated.py — warns once per site)."""
+    import functools
+    import warnings
+
+    def wrapper(func):
+        msg = f"API '{func.__module__}.{func.__name__}' is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use '{update_to}' instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level == 2:
+            @functools.wraps(func)
+            def err(*a, **k):
+                raise RuntimeError(msg)
+
+            return err
+
+        @functools.wraps(func)
+        def inner(*a, **k):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*a, **k)
+
+        return inner
+
+    return wrapper
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version is in range (reference:
+    python/paddle/utils/install_check-adjacent version gate)."""
+    import paddle_tpu
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(getattr(paddle_tpu, "__version__", "0.0.0"))
+    if parse(min_version) > cur:
+        raise RuntimeError(
+            f"installed version {cur} < required minimum {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise RuntimeError(
+            f"installed version {cur} > allowed maximum {max_version}")
+
+
+def run_check():
+    """Smoke-check the install: one matmul on the default device + a 2-device
+    sharded matmul when a mesh is available (reference paddle.utils.run_check
+    trains a tiny layer on 1 then N GPUs)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    x = jnp.asarray(np.random.rand(4, 4).astype(np.float32))
+    y = (x @ x).block_until_ready()
+    assert y.shape == (4, 4)
+    n = len(jax.devices())
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]).reshape(2), ("dp",))
+        xs = jax.device_put(x, NamedSharding(mesh, PartitionSpec("dp", None)))
+        (xs @ xs).block_until_ready()
+    print(f"paddle_tpu is installed successfully! device={dev.device_kind if hasattr(dev, 'device_kind') else dev.platform}, "
+          f"{n} device(s) visible")

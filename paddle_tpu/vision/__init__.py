@@ -6,9 +6,34 @@ from . import transforms  # noqa: F401
 from .models import LeNet  # noqa: F401
 
 
+_image_backend = "pil"
+
+
 def set_image_backend(backend):
-    pass
+    """Select the image-decoding backend (reference vision/image.py)."""
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported backend {backend}")
+    _image_backend = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """Load an image from disk (reference vision/image.py image_load —
+    PIL backend; cv2 is not shipped in this environment)."""
+    backend = backend or _image_backend
+    if backend == "cv2":
+        raise NotImplementedError("cv2 is not available; use the pil backend")
+    from PIL import Image
+
+    img = Image.open(path)
+    if backend == "tensor":
+        import numpy as _np
+
+        from ..core.tensor import Tensor
+
+        return Tensor(_np.asarray(img))
+    return img

@@ -133,3 +133,36 @@ def _load_image(path):
         return np.asarray(Image.open(path).convert("RGB")).transpose(2, 0, 1).astype(np.float32)
     except Exception:
         return np.zeros((3, 32, 32), dtype=np.float32)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs (reference: vision/datasets/voc2012.py —
+    (image, segmentation mask) samples). Synthetic in this zero-egress
+    environment, like the other vision datasets here: blocky masks with the
+    matching color painted into the image."""
+
+    NUM_CLASSES = 21
+    IMAGE_SHAPE = (3, 64, 64)
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None, synthetic_size=None):
+        self.transform = transform
+        n = synthetic_size or (200 if mode == "train" else 50)
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        c, h, w = self.IMAGE_SHAPE
+        self._images = (rng.rand(n, c, h, w) * 255).astype(np.uint8)
+        self._masks = np.zeros((n, h, w), np.int64)
+        for i in range(n):
+            cls = rng.randint(1, self.NUM_CLASSES)
+            y0, x0 = rng.randint(0, h // 2), rng.randint(0, w // 2)
+            self._masks[i, y0:y0 + h // 2, x0:x0 + w // 2] = cls
+            self._images[i, cls % 3, y0:y0 + h // 2, x0:x0 + w // 2] = 255
+
+    def __getitem__(self, idx):
+        img = self._images[idx].astype(np.float32)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._masks[idx]
+
+    def __len__(self):
+        return len(self._images)

@@ -10,7 +10,7 @@ from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
 from .mobilenetv3 import (  # noqa: F401
     MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large, mobilenet_v3_small,
 )
-from .vgg import VGG, vgg16, vgg19  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .alexnet import AlexNet, alexnet  # noqa: F401
 from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .densenet import (  # noqa: F401

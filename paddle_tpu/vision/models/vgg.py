@@ -4,6 +4,9 @@ from __future__ import annotations
 from ... import nn
 
 _CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+          "M"],
     "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
           512, 512, 512, "M"],
     "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512,
@@ -57,3 +60,11 @@ def vgg16(pretrained=False, batch_norm=False, **kwargs):
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
     return VGG(_make_layers(_CFGS["E"], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_layers(_CFGS["A"], batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_layers(_CFGS["B"], batch_norm), **kwargs)
