@@ -28,6 +28,16 @@ def _num_segments(segment_ids):
     return int(ids.max()) + 1 if ids.size else 0
 
 
+def _zero_empty(out, ids, n):
+    """Reference convention: EMPTY segments yield 0, not the reduction's
+    identity (+-inf for float max/min, iinfo extrema for ints). Detected by
+    count so legitimate extreme values are never clobbered."""
+    counts = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids,
+                                 num_segments=n)
+    empty = (counts == 0).reshape((-1,) + (1,) * (out.ndim - 1))
+    return jnp.where(empty, jnp.zeros((), out.dtype), out)
+
+
 def _segment(op_name, jax_fn, fill=0.0):
     def op(data, segment_ids, name=None):
         n = _num_segments(segment_ids)
@@ -35,8 +45,7 @@ def _segment(op_name, jax_fn, fill=0.0):
         def f(d, ids):
             out = jax_fn(d, ids, num_segments=n)
             if op_name in ("segment_max", "segment_min"):
-                # empty segments: reference yields 0, jax yields +-inf
-                out = jnp.where(jnp.isfinite(out), out, 0.0)
+                out = _zero_empty(out, ids, n)
             return out
 
         return primitive_call(f, data, segment_ids, name=op_name)
@@ -75,7 +84,7 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
             return s / jnp.maximum(c, 1.0).reshape((-1,) + (1,) * (s.ndim - 1))
         out = red[pool_type](msgs, dst, num_segments=n)
         if pool_type in ("max", "min"):
-            out = jnp.where(jnp.isfinite(out), out, 0.0)
+            out = _zero_empty(out, dst, n)
         return out
 
     return primitive_call(f, x, src_index, dst_index, name="graph_send_recv")
@@ -90,7 +99,13 @@ def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     ptr = np.asarray(colptr._value if isinstance(colptr, Tensor) else colptr)
     nodes = np.asarray(input_nodes._value if isinstance(input_nodes, Tensor)
                        else input_nodes)
-    rng = np.random.RandomState(0)
+    # fresh draw per call from the global key stream (a fixed seed would
+    # return identical "random" neighbors every step)
+    from ..core.rng import default_generator
+
+    seed = int(np.asarray(jax.random.randint(
+        default_generator().next_key(), (), 0, 2**31 - 1)))
+    rng = np.random.RandomState(seed)
     out_nb, out_cnt = [], []
     for nid in nodes.reshape(-1):
         nbrs = rowv[ptr[nid]:ptr[nid + 1]]

@@ -63,6 +63,7 @@ class ModelAverage:
         self._parameter_list = list(parameters) if parameters else []
         self._sum = {id(p): jnp.zeros_like(p._value)
                      for p in self._parameter_list}
+        self._denom = 0.0  # exact weighted count matching the decayed sum
         self._num = 0
         self._backup = None
 
@@ -74,14 +75,12 @@ class ModelAverage:
         decay = (window - 1) / window
         for p in self._parameter_list:
             self._sum[id(p)] = self._sum[id(p)] * decay + p._value
+        self._denom = self._denom * decay + 1.0
 
     def apply(self, executor=None, need_restore=True):
         """Swap averaged weights in (context-manager friendly)."""
         self._backup = {id(p): p._value for p in self._parameter_list}
-        window = max(self.min_w, min(self.max_w, int(self._num * self.rate)
-                                     or 1))
-        denom = sum((window - 1) ** i / window ** i
-                    for i in range(min(self._num, window))) or 1.0
+        denom = self._denom or 1.0
         for p in self._parameter_list:
             p._value = (self._sum[id(p)] / denom).astype(p._value.dtype)
         return self

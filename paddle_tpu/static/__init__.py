@@ -29,6 +29,38 @@ from .io import (  # noqa: F401
     serialize_program,
 )
 from ..jit import InputSpec  # noqa: F401
+from .extras import (  # noqa: F401
+    BuildStrategy,
+    ExecutionStrategy,
+    ExponentialMovingAverage,
+    GradVariable,
+    IpuCompiledProgram,
+    IpuStrategy,
+    ParallelExecutor,
+    Print,
+    WeightNormParamAttr,
+    accuracy,
+    append_backward,
+    auc,
+    create_global_var,
+    create_parameter,
+    deserialize_persistables,
+    deserialize_program,
+    gradients,
+    ipu_shard_guard,
+    load,
+    load_from_file,
+    load_program_state,
+    mlu_places,
+    normalize_program,
+    npu_places,
+    py_func,
+    save,
+    save_to_file,
+    serialize_persistables,
+    set_ipu_shard,
+    set_program_state,
+)
 from . import nn  # noqa: F401
 from . import passes  # noqa: F401
 from .passes import PassBase, PassContext, PassManager, new_pass, register_pass  # noqa: F401

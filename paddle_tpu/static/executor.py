@@ -110,22 +110,68 @@ def _lower(program: Program, feed_names, fetch_list):
                     env[id(var)] = val
         return env
 
+    def get_fetches_one(env, f):
+        if isinstance(f, Variable):
+            return env[id(f)]
+        if isinstance(f, Tensor):
+            return env.get(id(f), f._value)
+        raise TypeError(f"bad fetch {f!r}")
+
     def get_fetches(env):
-        outs = []
-        for f in fetch_list:
-            if isinstance(f, Variable):
-                outs.append(env[id(f)])
-            elif isinstance(f, Tensor):
-                outs.append(env.get(id(f), f._value))
-            else:
-                raise TypeError(f"bad fetch {f!r}")
-        return outs
+        return [get_fetches_one(env, f) for f in fetch_list]
 
     if spec is None:
+        from .extras import GradVariable
+
+        grad_fetches = [f for f in fetch_list if isinstance(f, GradVariable)]
+
+        # append_backward/gradients contract: differentiate the replayed
+        # program as one function (extras.py module docstring). Only the
+        # REQUESTED feed leaves are differentiated — integer feeds (labels)
+        # must stay out of jax.grad's argnums.
+        req_feed_names = sorted({
+            gv.wrt.name for gv in grad_fetches
+            if isinstance(gv.wrt, Variable) and not any(
+                gv.wrt is p for p in params)})
+
         @jax.jit
         def fwd(feed_arrays, param_arrays, key):
             env = replay(feed_arrays, param_arrays, key)
-            return get_fetches(env)
+            if not grad_fetches:
+                return get_fetches(env)
+            targets = {}
+            for gv in grad_fetches:
+                targets.setdefault(id(gv.target), gv.target)
+            grads_by_target = {}
+            for tid, tvar in targets.items():
+                def tsum(sub_feeds, parrays, _tvar=tvar):
+                    feeds = dict(feed_arrays)
+                    feeds.update(sub_feeds)
+                    env2 = replay(feeds, parrays, key)
+                    return jnp.sum(env2[id(_tvar)].astype(jnp.float32))
+
+                sub = {n: feed_arrays[n] for n in req_feed_names
+                       if n in feed_arrays}
+                gfeeds, gparams = jax.grad(tsum, argnums=(0, 1))(
+                    sub, param_arrays)
+                grads_by_target[tid] = (gfeeds, gparams)
+            outs = []
+            for f in fetch_list:
+                if isinstance(f, GradVariable):
+                    gfeeds, gparams = grads_by_target[id(f.target)]
+                    wrt = f.wrt
+                    idxs = [i for i, p in enumerate(params) if p is wrt]
+                    if idxs:
+                        outs.append(gparams[idxs[0]])
+                    elif isinstance(wrt, Variable) and wrt.name in gfeeds:
+                        outs.append(gfeeds[wrt.name])
+                    else:
+                        raise KeyError(
+                            f"gradient wrt {getattr(wrt, 'name', wrt)!r}: "
+                            "not a feed or captured parameter")
+                else:
+                    outs.append(get_fetches_one(env, f))
+            return outs
 
         def runner(feed_arrays):
             pa = [p._value for p in params]

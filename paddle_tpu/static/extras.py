@@ -1,0 +1,482 @@
+"""Static-graph parity batch (reference: python/paddle/static/__init__.py —
+append_backward/gradients, program-state and serialization helpers, EMA,
+strategy/compiled-program shells, Print, py_func, IPU-strategy analogs).
+
+Gradient design: the executor compiles the WHOLE program into one XLA
+computation (survey §3.5), so grad "ops" are not appended as tape entries the
+way fluid's append_backward splices grad blocks. Instead `append_backward` /
+`gradients` register GradVariable fetches; the executor differentiates the
+replayed program with jax.grad when such a fetch is requested — same user
+contract (fetch `x@GRAD`), XLA-native mechanics.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer import ParamAttr
+from .program import Program, Variable, default_main_program
+
+__all__ = [
+    "append_backward", "gradients", "GradVariable", "py_func", "Print",
+    "create_global_var", "create_parameter", "ExponentialMovingAverage",
+    "BuildStrategy", "ExecutionStrategy", "ParallelExecutor",
+    "WeightNormParamAttr", "accuracy", "auc", "save", "load", "save_to_file",
+    "load_from_file", "serialize_persistables", "deserialize_persistables",
+    "deserialize_program", "normalize_program", "load_program_state",
+    "set_program_state", "IpuStrategy", "IpuCompiledProgram",
+    "ipu_shard_guard", "set_ipu_shard", "npu_places", "mlu_places",
+]
+
+
+class GradVariable(Variable):
+    """d(target)/d(wrt) as a fetchable symbolic var (named `wrt@GRAD`)."""
+
+    def __init__(self, target: Variable, wrt: Variable):
+        super().__init__(wrt.shape, "float32", name=f"{wrt.name}@GRAD")
+        self.target = target
+        self.wrt = wrt
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Register grads of `loss` for every trainable parameter; returns
+    [(param, grad_var), ...] like the reference (fluid/backward.py:1376)."""
+    prog = loss.block.program if getattr(loss, "block", None) else \
+        default_main_program()
+    params = parameter_list if parameter_list is not None else [
+        p for p in prog.captured_params() if not p.stop_gradient]
+    no_grad = set(id(v) for v in (no_grad_set or []))
+    pairs = []
+    for p in params:
+        if id(p) in no_grad:
+            continue
+        gv = GradVariable(loss, p)
+        prog._grad_vars = getattr(prog, "_grad_vars", {})
+        prog._grad_vars[gv.name] = gv
+        pairs.append((p, gv))
+    return pairs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Grad vars of sum(targets) w.r.t. each input (reference
+    paddle.static.gradients). Fetch them through Executor.run."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if len(targets) != 1:
+        # multiple targets sum their cotangents; represent as a fresh sum var
+        raise NotImplementedError("multiple targets: pass their sum instead")
+    out = []
+    prog = default_main_program()
+    for x in inputs:
+        gv = GradVariable(targets[0], x)
+        prog._grad_vars = getattr(prog, "_grad_vars", {})
+        prog._grad_vars[gv.name] = gv
+        out.append(gv)
+    return out
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host-python op inside the compiled program (reference py_func_op).
+
+    TPU-native: jax.pure_callback — the XLA program calls back into the host
+    at this point; `out` declares the result aval(s). With backward_func, a
+    custom VJP routes cotangents through another callback."""
+    from ..core.dispatch import primitive_call
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o._value.dtype
+                                   if hasattr(o._value, "dtype")
+                                   else jnp.float32) for o in outs]
+
+    def host(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r) for r in res)
+
+    single = len(shapes) == 1
+
+    if backward_func is None:
+        def f(*arrays):
+            res = jax.pure_callback(host, tuple(shapes), *arrays)
+            return res[0] if single else res
+
+        return primitive_call(f, *xs, name="py_func")
+
+    @jax.custom_vjp
+    def callback_op(*arrays):
+        res = jax.pure_callback(host, tuple(shapes), *arrays)
+        return res[0] if single else res
+
+    def fwd(*arrays):
+        return callback_op(*arrays), arrays
+
+    def bwd(arrays, g):
+        gs = (g,) if single else tuple(g)
+        in_shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+
+        def host_bwd(*args):
+            n = len(arrays)
+            res = backward_func(*[np.asarray(v) for v in args])
+            res = res if isinstance(res, (list, tuple)) else [res]
+            return tuple(np.asarray(r) for r in res)
+
+        return jax.pure_callback(host_bwd, tuple(in_shapes), *arrays, *gs)
+
+    callback_op.defvjp(fwd, bwd)
+
+    def f(*arrays):
+        return callback_op(*arrays)
+
+    return primitive_call(f, *xs, name="py_func")
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug-print a tensor during execution (reference print_op) via
+    jax.debug.print — works inside the compiled program."""
+    from ..core.dispatch import primitive_call
+
+    msg = message or ""
+    name = getattr(input, "name", "tensor")
+
+    def f(a):
+        jax.debug.print(msg + " {name}: {val}", name=name, val=a)
+        return a
+
+    return primitive_call(f, input, name="print")
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A non-trainable program-scope variable with an initial value
+    (reference layers/tensor.py create_global_var)."""
+    from ..core.dtype import to_jax_dtype
+
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        to_jax_dtype(dtype)), stop_gradient=True)
+    t.name = name or "global_var"
+    t.persistable = persistable
+    prog = default_main_program()
+    prog._global_vars = getattr(prog, "_global_vars", {})
+    prog._global_vars[t.name] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..tensor_ops.creation import create_parameter as _cp
+
+    return _cp(shape, dtype=dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+class ExponentialMovingAverage:
+    """EMA of trainable parameters (reference static/ema.py): update() after
+    each optimizer step; apply()/restore() swap shadow weights for eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = float(decay)
+        self._shadow: dict[int, object] = {}
+        self._backup: dict[int, object] | None = None
+        self._params: list = []
+        self._step = 0
+
+    def _ensure(self, params):
+        for p in params:
+            if id(p) not in self._shadow:
+                self._params.append(p)
+                self._shadow[id(p)] = p._value
+
+    def update(self, parameters=None):
+        from .program import default_main_program
+
+        params = parameters or [p for p in
+                                default_main_program().captured_params()
+                                if not p.stop_gradient]
+        self._ensure(params)
+        self._step += 1
+        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in self._params:
+            self._shadow[id(p)] = d * self._shadow[id(p)] + (1 - d) * p._value
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._value for p in self._params}
+        for p in self._params:
+            p._value = self._shadow[id(p)]
+        return self
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._params:
+                p._value = self._backup[id(p)]
+            self._backup = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.restore()
+
+
+class BuildStrategy:
+    """Graph-build knobs (reference BuildStrategy). XLA owns fusion and
+    scheduling on TPU, so these are accepted-and-recorded only; the compiled
+    result is already whole-graph optimized."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.reduce_strategy = 0
+        self.gradient_scale_strategy = 0
+        self.build_cinn_pass = False
+
+
+class ExecutionStrategy:
+    """Executor knobs (reference ExecutionStrategy); single-stream XLA
+    execution makes thread counts moot — recorded for API compat."""
+
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+        self.use_thread_pool = False
+
+
+class ParallelExecutor:
+    """reference: fluid/parallel_executor.py — multi-device replicated
+    execution. On TPU this is GSPMD: wrap the program in CompiledProgram and
+    run through the ordinary Executor (data parallelism comes from sharding
+    the feed, not from executor replication)."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 share_vars_from=None, exec_strategy=None, build_strategy=None,
+                 num_trainers=1, trainer_id=0, scope=None):
+        from .executor import Executor
+        from .program import default_main_program
+
+        self._program = main_program or default_main_program()
+        self._exe = Executor()
+        self._loss_name = loss_name
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy=True):
+        return self._exe.run(self._program, feed=feed or feed_dict,
+                             fetch_list=fetch_list, return_numpy=return_numpy)
+
+
+class WeightNormParamAttr(ParamAttr):
+    """Weight-normalized parameter attribute (reference
+    WeightNormParamAttr): marks a parameter for w = g * v / ||v||
+    reparameterization along `dim`."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        super().__init__(name=name, initializer=initializer,
+                         learning_rate=learning_rate, regularizer=regularizer,
+                         trainable=trainable)
+        self.dim = dim
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC as a Tensor (reference auc op). Stateless single-batch form;
+    streaming AUC lives in paddle.metric.Auc."""
+    from ..metric import Auc
+
+    m = Auc(num_thresholds=num_thresholds)
+    pred = np.asarray(input._value if isinstance(input, Tensor) else input)
+    lab = np.asarray(label._value if isinstance(label, Tensor) else label)
+    m.update(pred, lab)
+    return Tensor(jnp.asarray(np.float32(m.accumulate())))
+
+
+# ------------------------------------------------------------- serialization
+def serialize_persistables(program=None):
+    """Pickle all parameter values of `program` (reference
+    serialize_persistables -> bytes)."""
+    prog = program or default_main_program()
+    state = {p.name: np.asarray(p._value) for p in prog.captured_params()}
+    return pickle.dumps(state)
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+
+
+# deserialize_program intentionally lives in static/io.py: programs hold
+# lowering closures and serialize as compiled StableHLO (save_inference_model),
+# not as reloadable op-graph pickles — io.py raises the clear error.
+from .io import deserialize_program  # noqa: E402,F401
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    """Prune to the inference graph (reference normalize_program). The op
+    tape keeps only ops reachable from fetch_vars; params stay captured."""
+    pruned = program.clone(for_test=True)
+    pruned._feed_vars = list(feed_vars)
+    pruned._fetch_vars = list(fetch_vars)
+    return pruned
+
+
+def save(program, model_path, protocol=4):
+    """program + persistables to `<path>.pdmodel` / `<path>.pdparams`
+    (reference static.save)."""
+    with open(model_path + ".pdparams", "wb") as f:
+        f.write(serialize_persistables(program))
+    from .io import serialize_program
+
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(serialize_program(program))
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        deserialize_persistables(program, f.read())
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.loads(f.read())
+
+
+def set_program_state(program, state_dict):
+    by_name = {p.name: p for p in program.captured_params()}
+    missing = []
+    for name, val in state_dict.items():
+        p = by_name.get(name)
+        if p is None:
+            missing.append(name)
+            continue
+        p._value = jnp.asarray(val)
+    if missing:
+        import warnings
+
+        warnings.warn(f"set_program_state: no parameter for {missing}")
+
+
+# ----------------------------------------------------------------- IPU analog
+class IpuStrategy:
+    """Device-compile strategy (reference ipu_strategy.h:32 — capacity is
+    strategy, not constant). On TPU the analogs are mesh shape and
+    micro-batching; recorded here and consumed by IpuCompiledProgram."""
+
+    def __init__(self):
+        self.num_ipus = 1
+        self.is_training = True
+        self.micro_batch_size = 1
+        self.enable_manual_shard = False
+        self._options = {}
+
+    def set_graph_config(self, num_ipus=1, is_training=True,
+                         micro_batch_size=1, enable_manual_shard=False):
+        self.num_ipus = num_ipus
+        self.is_training = is_training
+        self.micro_batch_size = micro_batch_size
+        self.enable_manual_shard = enable_manual_shard
+
+    def set_options(self, options):
+        self._options.update(options)
+
+    def set_pipelining_config(self, enable_pipelining=False,
+                              batches_per_step=1, enable_gradient_accumulation=False,
+                              accumulation_factor=1):
+        self._options.update(dict(
+            enable_pipelining=enable_pipelining,
+            batches_per_step=batches_per_step,
+            enable_gradient_accumulation=enable_gradient_accumulation,
+            accumulation_factor=accumulation_factor))
+
+    def set_precision_config(self, enable_fp16=False):
+        self._options["enable_fp16"] = enable_fp16
+
+
+class IpuCompiledProgram:
+    """Whole-graph device compile (reference IpuCompiledProgram.compile).
+    On TPU every program already compiles whole-graph; this shell carries
+    the strategy and returns the program for Executor.run."""
+
+    def __init__(self, program=None, scope=None, ipu_strategy=None):
+        self.program = program or default_main_program()
+        self.ipu_strategy = ipu_strategy or IpuStrategy()
+
+    def compile(self, feed_list=None, fetch_list=None):
+        self.program._ipu_strategy = self.ipu_strategy
+        return self.program
+
+
+_ipu_shard_index = [None]
+
+
+class _IpuShardGuard:
+    def __init__(self, index, stage):
+        self._index = index
+        self._stage = stage
+        self._guard = None
+
+    def __enter__(self):
+        from .program import device_guard
+
+        # shard index maps onto the pipeline-stage device annotation the
+        # static pipeline splitter consumes (static/pipeline.py)
+        stage = self._stage if self._stage is not None else self._index
+        self._guard = device_guard(f"tpu:{stage}")
+        self._guard.__enter__()
+        _ipu_shard_index[0] = self._index
+        return self
+
+    def __exit__(self, *a):
+        _ipu_shard_index[0] = None
+        return self._guard.__exit__(*a)
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    return _IpuShardGuard(index if index >= 0 else 0,
+                          stage if stage >= 0 else None)
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    """Wrap a layer/function so its ops land on the given shard/stage."""
+    def wrapper(*args, **kwargs):
+        with ipu_shard_guard(index=index, stage=stage):
+            return call_func(*args, **kwargs)
+
+    return wrapper
+
+
+def npu_places(device_ids=None):
+    from . import tpu_places
+
+    return tpu_places(device_ids)
+
+
+def mlu_places(device_ids=None):
+    from . import tpu_places
+
+    return tpu_places(device_ids)

@@ -51,3 +51,505 @@ def embedding(input, size, is_sparse=False, param_attr=None, dtype="float32"):
 
 
 from .control_flow import case, cond, switch_case, while_loop  # noqa: F401,E402
+
+
+# ===================================================================== parity
+# batch (reference: python/paddle/static/nn/__init__.py __all__). Dygraph
+# layers carry the math; sequence_* ops follow this framework's documented
+# dynamic-shape policy (SURVEY hard-part #2): a "sequence batch" is a padded
+# dense [B, T, ...] tensor plus per-row `length` — the (LoDTensor -> padded +
+# lengths) translation the reference performs in sequence_pad.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+
+
+def _val(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _len_mask(x, length, time_axis=1, ndim=None):
+    """[B, T] validity mask; with ndim, right-padded with singleton dims so
+    it broadcasts against [B, T, ...]."""
+    T = x.shape[time_axis]
+    if length is None:
+        m = jnp.ones(tuple(int(s) for s in x.shape[:2]), bool)
+    else:
+        L = _val(length).reshape(-1)
+        m = jnp.arange(T)[None, :] < L[:, None]
+    if ndim is not None:
+        m = m.reshape(m.shape + (1,) * (ndim - 2))
+    return m
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    layer = dynn.Conv2DTranspose(int(input.shape[1]), num_filters,
+                                 filter_size, stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None, name=None,
+           data_format="NCDHW"):
+    layer = dynn.Conv3D(int(input.shape[1]), num_filters, filter_size,
+                        stride=stride, padding=padding, dilation=dilation,
+                        groups=groups, weight_attr=param_attr,
+                        bias_attr=bias_attr)
+    out = layer(input)
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None,
+                     data_format="NCDHW"):
+    layer = dynn.Conv3DTranspose(int(input.shape[1]), num_filters,
+                                 filter_size, stride=stride, padding=padding,
+                                 dilation=dilation, groups=groups,
+                                 weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-05, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    layer = dynn.GroupNorm(groups, int(input.shape[1]), epsilon=epsilon,
+                           weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(input)
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-05, param_attr=None, bias_attr=None,
+                  name=None):
+    nd = len(input.shape)
+    cls = {3: dynn.InstanceNorm1D, 4: dynn.InstanceNorm2D,
+           5: dynn.InstanceNorm3D}[nd]
+    return cls(int(input.shape[1]), epsilon=epsilon, weight_attr=param_attr,
+               bias_attr=bias_attr)(input)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-05, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = dynn.LayerNorm(shape, epsilon=epsilon,
+                           weight_attr=param_attr if scale else False,
+                           bias_attr=bias_attr if shift else False)
+    out = layer(input)
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def prelu(x, mode, param_attr=None, data_format="NCHW", name=None):
+    n = {"all": 1, "channel": int(x.shape[1]), "element":
+         int(np.prod(x.shape[1:]))}[mode]
+    layer = dynn.PReLU(num_parameters=n, weight_attr=param_attr)
+    return layer(x)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Power-iteration spectral normalization of a weight tensor (reference
+    spectral_norm op) — returns w / sigma_max."""
+    def f(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype) / np.sqrt(wm.shape[0])
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / (sigma + eps)
+
+    return primitive_call(f, weight, name="spectral_norm")
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """Normalization by accumulated batch statistics without learnable
+    affine (reference data_norm_op — the CTR-model normalizer)."""
+    def f(a):
+        mean = jnp.mean(a, axis=0, keepdims=True)
+        var = jnp.var(a, axis=0, keepdims=True)
+        return (a - mean) / jnp.sqrt(var + epsilon)
+
+    out = primitive_call(f, input, name="data_norm")
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    layer = dynn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                          weight_attr=param_attr, bias_attr=bias_attr)
+    out = layer(x, y)
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution (reference row_conv_op: DeepSpeech2's
+    causal-future smoothing): out[t] = sum_{i=0..k} w[i] * x[t+i]."""
+    k = int(future_context_size)
+    d = int(input.shape[-1])
+    from .extras import create_parameter
+
+    w = create_parameter([k + 1, d], "float32", attr=param_attr)
+
+    def f(a, wv):
+        # a: [B, T, D]; pad future, window-sum
+        pad = jnp.pad(a, ((0, 0), (0, k), (0, 0)))
+        out = jnp.zeros_like(a)
+        for i in range(k + 1):
+            out = out + pad[:, i:i + a.shape[1]] * wv[i]
+        return out
+
+    out = primitive_call(f, input, w, name="row_conv")
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as _dc
+    from .extras import create_parameter
+
+    k = filter_size if isinstance(filter_size, (list, tuple)) \
+        else (filter_size, filter_size)
+    w = create_parameter(
+        [num_filters, int(x.shape[1]) // groups, k[0], k[1]], "float32",
+        attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    return _dc(x, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def crf_decoding(input, param_attr=None, length=None, label=None,
+                 transition=None):
+    """Viterbi decode over emission scores (reference crf_decoding_op).
+    `transition` may be passed directly; otherwise a parameter is created."""
+    from ..text.viterbi_decode import viterbi_decode
+    from .extras import create_parameter
+
+    n_tags = int(input.shape[-1])
+    trans = transition if transition is not None else create_parameter(
+        [n_tags + 2, n_tags], "float32", attr=param_attr)
+    tv = _val(trans)
+    if tv.shape[0] == n_tags + 2:  # strip start/stop rows (linear-chain CRF)
+        tv = tv[2:]
+    if length is None:
+        B, T = input.shape[0], input.shape[1]
+        length = Tensor(jnp.full((B,), T, jnp.int64))
+    _, path = viterbi_decode(input, Tensor(tv), length,
+                             include_bos_eos_tag=False)
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=10, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (reference nce_op): logistic loss on
+    the true class vs `num_neg_samples` uniformly drawn noise classes."""
+    from ..core.rng import next_rng_key
+    from .extras import create_parameter
+
+    d = int(input.shape[-1])
+    w = create_parameter([num_total_classes, d], "float32", attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_total_classes], "float32", attr=bias_attr, is_bias=True)
+    key = next_rng_key()
+
+    def f(x, y, wv, *bv):
+        B = x.shape[0]
+        yv = y.reshape(-1).astype(jnp.int32)
+        neg = jax.random.randint(key, (B, num_neg_samples), 0,
+                                 num_total_classes)
+        pos_logit = jnp.sum(x * wv[yv], axis=-1)
+        neg_logit = jnp.einsum("bd,bnd->bn", x, wv[neg])
+        if bv:
+            pos_logit = pos_logit + bv[0][yv]
+            neg_logit = neg_logit + bv[0][neg]
+        # logistic: true class -> label 1, noise -> 0
+        pos_loss = jnp.log1p(jnp.exp(-pos_logit))
+        neg_loss = jnp.sum(jnp.log1p(jnp.exp(neg_logit)), axis=-1)
+        return (pos_loss + neg_loss)[:, None]
+
+    args = [input, label, w] + ([b] if b is not None else [])
+    return primitive_call(f, *args, name="nce")
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """Large-scale PS embedding lookup (reference sparse_embedding — the
+    the_one_ps distributed table). Single-process form: an Embedding whose
+    gradient stays row-sparse (SelectedRows) so the PS/SSD tables can ingest
+    it; `entry` carries the admission policy."""
+    layer = dynn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                           sparse=True, weight_attr=param_attr)
+    layer.weight.entry = entry
+    return layer(input)
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (reference multi_box_head): per-feature-map conv
+    predictors for location + confidence, plus prior boxes."""
+    from ..vision.ops import prior_box
+
+    n = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule
+        min_ratio, max_ratio = int(min_ratio), int(max_ratio)
+        step = int((max_ratio - min_ratio) / (n - 2)) if n > 2 else 0
+        min_sizes, max_sizes = [], []
+        for r in range(min_ratio, max_ratio + 1, step or 1):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + (step or 1)) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+
+    locs, confs, boxes, vars_ = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        box, var = prior_box(
+            feat, image, min_sizes=[min_sizes[i]],
+            max_sizes=[max_sizes[i]] if max_sizes else None,
+            aspect_ratios=ar, variance=list(variance), flip=flip, clip=clip,
+            steps=[steps[i], steps[i]] if steps else [0.0, 0.0],
+            offset=offset)
+        num_priors = int(np.prod(box.shape[:-1])) // (
+            int(feat.shape[2]) * int(feat.shape[3]))
+        loc = conv2d(feat, num_priors * 4, kernel_size, padding=pad,
+                     stride=stride)
+        conf = conv2d(feat, num_priors * num_classes, kernel_size,
+                      padding=pad, stride=stride)
+        B = int(feat.shape[0])
+        locs.append(loc.transpose([0, 2, 3, 1]).reshape([B, -1, 4]))
+        confs.append(conf.transpose([0, 2, 3, 1]).reshape(
+            [B, -1, num_classes]))
+        boxes.append(Tensor(_val(box).reshape(-1, 4)))
+        vars_.append(Tensor(_val(var).reshape(-1, 4)))
+    from ..tensor_ops.manipulation import concat
+
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes, axis=0), concat(vars_, axis=0))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from .extras import py_func as _pf
+
+    return _pf(func, x, out, backward_func=backward_func)
+
+
+# --------------------------------------------------------------- sequence ops
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """List-of-rows -> (padded [B, T, ...], lengths [B]) (reference
+    sequence_pad_op). Accepts a python list of arrays (the LoD analog)."""
+    if isinstance(x, Tensor):
+        return x, Tensor(jnp.full((x.shape[0],), x.shape[1], jnp.int64))
+    seqs = [_val(s) for s in x]
+    T = maxlen or max(s.shape[0] for s in seqs)
+    pv = float(pad_value if not isinstance(pad_value, Tensor)
+               else np.asarray(pad_value._value))
+    out = jnp.stack([
+        jnp.pad(s, [(0, T - s.shape[0])] + [(0, 0)] * (s.ndim - 1),
+                constant_values=pv) for s in seqs])
+    lens = jnp.asarray([s.shape[0] for s in seqs], jnp.int64)
+    return Tensor(out), Tensor(lens)
+
+
+def sequence_unpad(x, length, name=None):
+    """(padded, lengths) -> list of per-row arrays (host-side: row shapes are
+    data-dependent, the same reason the reference keeps LoD on CPU)."""
+    xv = np.asarray(_val(x))
+    L = np.asarray(_val(length)).reshape(-1)
+    return [Tensor(jnp.asarray(xv[i, :int(L[i])])) for i in range(len(L))]
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0,
+                  length=None):
+    """Masked pool over the time dim (reference sequence_pool_op)."""
+    def f(a):
+        mask = _len_mask(a, length, ndim=a.ndim)
+        m = mask.astype(a.dtype)
+        pt = pool_type.lower()
+        if pt == "sum":
+            return jnp.sum(a * m, axis=1)
+        if pt == "average":
+            return jnp.sum(a * m, axis=1) / jnp.maximum(
+                jnp.sum(m, axis=1), 1.0)
+        if pt == "sqrt":
+            return jnp.sum(a * m, axis=1) / jnp.sqrt(jnp.maximum(
+                jnp.sum(m, axis=1), 1.0))
+        if pt == "max":
+            return jnp.max(jnp.where(mask, a, -1e30), axis=1)
+        if pt == "first":
+            return a[:, 0]
+        if pt == "last":
+            if length is None:
+                return a[:, -1]
+            L = _val(length).reshape(-1).astype(jnp.int32)
+            return a[jnp.arange(a.shape[0]), jnp.maximum(L - 1, 0)]
+        raise ValueError(f"unsupported pool_type {pool_type}")
+
+    return primitive_call(f, input, name="sequence_pool")
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, length=None):
+    def f(a):
+        mask = _len_mask(a, length, ndim=a.ndim)
+        z = jnp.where(mask, a, -1e30)
+        return jnp.where(mask, jax.nn.softmax(z, axis=1), 0.0)
+
+    return primitive_call(f, input, name="sequence_softmax")
+
+
+def sequence_reverse(x, name=None, length=None):
+    """Reverse each row over its valid prefix (reference sequence_reverse)."""
+    def f(a):
+        T = a.shape[1]
+        if length is None:
+            return a[:, ::-1]
+        L = _val(length).reshape(-1).astype(jnp.int32)
+        idx = jnp.arange(T)[None, :]
+        rev = jnp.where(idx < L[:, None], L[:, None] - 1 - idx, idx)
+        return jnp.take_along_axis(
+            a, rev.reshape(rev.shape + (1,) * (a.ndim - 2)), axis=1)
+
+    return primitive_call(f, x, name="sequence_reverse")
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length=length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length=length)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window conv over time (reference sequence_conv_op): each step
+    sees `filter_size` neighboring steps centered per padding_start."""
+    d = int(input.shape[-1])
+    from .extras import create_parameter
+
+    w = create_parameter([filter_size * d, num_filters], "float32",
+                         attr=param_attr)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], "float32", attr=bias_attr, is_bias=True)
+    start = -int((filter_size - 1) // 2) if padding_start is None \
+        else int(padding_start)
+
+    def f(a, wv, *bv):
+        B, T, D = a.shape
+        cols = []
+        for i in range(filter_size):
+            off = start + i
+            if off < 0:
+                sl = jnp.pad(a[:, :T + off], ((0, 0), (-off, 0), (0, 0)))
+            elif off > 0:
+                sl = jnp.pad(a[:, off:], ((0, 0), (0, off), (0, 0)))
+            else:
+                sl = a
+            cols.append(sl)
+        col = jnp.concatenate(cols, axis=-1)  # [B, T, k*D]
+        out = col @ wv
+        if bv:
+            out = out + bv[0]
+        return out
+
+    out = primitive_call(f, input, w, *([b] if b is not None else []),
+                         name="sequence_conv")
+    return getattr(dynn.functional, act)(out) if act else out
+
+
+def sequence_concat(input, name=None):
+    """Concat sequences row-wise along time (reference sequence_concat)."""
+    from ..tensor_ops.manipulation import concat
+
+    return concat(list(input), axis=1)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each row of x to match y's batch grouping. Padded-batch form:
+    x [B, ...], y [B*r, ...] -> tile x rows r times (uniform expansion)."""
+    def f(a, b):
+        r = b.shape[0] // a.shape[0]
+        return jnp.repeat(a, r, axis=0)
+
+    return primitive_call(f, x, y, name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_reshape(input, new_dim):
+    """Reshape the feature dim, redistributing time steps (reference
+    sequence_reshape_op)."""
+    def f(a):
+        B = a.shape[0]
+        return a.reshape(B, -1, new_dim)
+
+    return primitive_call(f, input, name="sequence_reshape")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    """All win_size-grams per row (reference sequence_enumerate_op)."""
+    def f(a):
+        T = a.shape[1]
+        cols = []
+        for i in range(win_size):
+            sl = a[:, i:]
+            pad = [(0, 0), (0, i)] + [(0, 0)] * (a.ndim - 2)
+            cols.append(jnp.pad(sl, pad, constant_values=pad_value))
+        return jnp.stack(cols, axis=-1)
+
+    return primitive_call(f, input, name="sequence_enumerate")
+
+
+def sequence_slice(input, offset, length, name=None):
+    """Per-row slice [offset, offset+length) over time (reference
+    sequence_slice_op). `length` must be uniform (static shapes)."""
+    def f(a, off, ln):
+        if isinstance(ln, jax.core.Tracer):
+            raise ValueError("sequence_slice needs concrete lengths "
+                             "(static output shapes)")
+        l0 = int(np.asarray(ln).reshape(-1)[0])
+        offs = off.reshape(-1).astype(jnp.int32)
+        rows = [jax.lax.dynamic_slice_in_dim(a[i], offs[i], l0, axis=0)
+                for i in range(a.shape[0])]
+        return jnp.stack(rows)
+
+    return primitive_call(f, input, offset, length, name="sequence_slice")
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """Scatter updates into per-row time positions (reference
+    sequence_scatter_op)."""
+    def f(a, idx, upd):
+        B = a.shape[0]
+        rows = jnp.repeat(jnp.arange(B)[:, None], idx.shape[1], axis=1)
+        return a.at[rows, idx.astype(jnp.int32)].add(upd)
+
+    return primitive_call(f, input, index, updates, name="sequence_scatter")

@@ -450,8 +450,17 @@ class RandomAffine(BaseTransform):
             tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
             ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
         sc = np.random.uniform(*self.scale_rng) if self.scale_rng else 1.0
-        sh = np.random.uniform(*self.shear) if self.shear else 0.0
-        return affine(img, angle, (tx, ty), sc, (sh, 0.0), fill=self.fill)
+        shx = shy = 0.0
+        if self.shear is not None:
+            s = self.shear
+            if np.isscalar(s):  # number -> x-shear in (-s, s)
+                shx = np.random.uniform(-s, s)
+            elif len(s) == 2:  # (min, max) x-shear range
+                shx = np.random.uniform(s[0], s[1])
+            else:  # (xmin, xmax, ymin, ymax)
+                shx = np.random.uniform(s[0], s[1])
+                shy = np.random.uniform(s[2], s[3])
+        return affine(img, angle, (tx, ty), sc, (shx, shy), fill=self.fill)
 
 
 class RandomPerspective(BaseTransform):
