@@ -7,6 +7,9 @@ import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.text import TransformerMT, TransformerMTConfig
 
+# the copy-task fixture trains ~120 eager steps; round-gate tier only
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def copy_task_model():
