@@ -11,10 +11,19 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
 import jax as _jax
 
 # paddle dtype parity: int64 default for ints, float64 representable
 _jax.config.update("jax_enable_x64", True)
+
+# Honor JAX_PLATFORMS=cpu through the config API as well: the env var alone
+# can lose to an eagerly-registered accelerator plugin (the axon TPU tunnel
+# blocks backend discovery when its endpoint is down — worker subprocesses
+# must never hang on it when the caller asked for CPU).
+if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    _jax.config.update("jax_platforms", "cpu")
 
 # ---- core
 from .core import (  # noqa: F401

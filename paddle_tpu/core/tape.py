@@ -160,3 +160,39 @@ def backward(tensor, grad=None, retain_graph=False):
 
 def make_node(vjp_fn, input_structs, outputs, out_avals, is_tuple_out, name=""):
     return TapeNode(vjp_fn, input_structs, outputs, out_avals, name, is_tuple_out)
+
+
+def graft_inplace(x, out):
+    """Give `x` the value AND autograd identity of `out` — the semantics of a
+    paddle `op_` in-place op (reference: inplace version registry,
+    eager/api/manual: inplace ops share the buffer but still record a grad
+    node). Without this, rebinding `x._value` alone makes the tape treat the
+    op as identity and silently skip its VJP.
+
+    The recorded node's input reference to `x` is rewired onto a detached
+    alias carrying x's PRE-op value and node, so chains of in-place ops
+    backprop through every step."""
+    from .tensor import Tensor  # circular-safe
+
+    node = getattr(out, "_tape_node", None)
+    if node is not None:
+        orig = Tensor(np.zeros((), np.float32))
+        orig._value = x._value
+        orig._stop_gradient = x._stop_gradient
+        orig._tape_node = x._tape_node
+        orig._out_index = x._out_index
+        orig._retain_grad = False
+        orig._grad_alias = x  # leaf grads belong to the visible tensor
+        if orig._tape_node is not None:
+            # x was itself a recorded output (e.g. a previous in-place op):
+            # the alias takes over that output slot so cotangents route to it
+            orig._tape_node.outputs = [
+                orig if o is x else o for o in orig._tape_node.outputs]
+        for si, struct in enumerate(node.input_structs):
+            if any(t is x for t in struct):
+                node.input_structs[si] = [orig if t is x else t for t in struct]
+        node.outputs = [x if o is out else o for o in node.outputs]
+        x._tape_node = node
+        x._out_index = out._out_index
+    x._value = out._value
+    return x

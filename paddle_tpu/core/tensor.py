@@ -38,6 +38,7 @@ class Tensor:
         "optimize_attr",
         "regularizer",
         "is_distributed",
+        "_grad_alias",
         "__weakref__",
     )
 
@@ -132,6 +133,11 @@ class Tensor:
         self._retain_grad = True
 
     def _accumulate_grad(self, ct):
+        # in-place grafting (tape.graft_inplace) detaches the pre-op tensor
+        # into an alias; its leaf gradient belongs to the user-visible tensor
+        alias = getattr(self, "_grad_alias", None)
+        if alias is not None:
+            return alias._accumulate_grad(ct)
         from .selected_rows import SelectedRows
 
         if self.grad is None:

@@ -1450,27 +1450,27 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 
 # ------------------------------------------------------------------ in-place
 def relu_(x, name=None):
-    out = relu(x)
-    x._value = out._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, relu(x))
 
 
 def elu_(x, alpha=1.0, name=None):
-    out = elu(x, alpha)
-    x._value = out._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, elu(x, alpha))
 
 
 def tanh_(x, name=None):
-    out = tanh(x)
-    x._value = out._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, tanh(x))
 
 
 def softmax_(x, axis=-1, dtype=None, name=None):
-    out = softmax(x, axis=axis, dtype=dtype)
-    x._value = out._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, softmax(x, axis=axis, dtype=dtype))
 
 
 # ------------------------------------------------------------------- losses 2

@@ -1,6 +1,7 @@
 """Linear algebra ops (reference: python/paddle/tensor/linalg.py)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..core.dispatch import primitive_call
@@ -215,3 +216,62 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 def corrcoef(x, rowvar=True, name=None):
     """reference: tensor/linalg.py corrcoef."""
     return primitive_call(lambda a: jnp.corrcoef(a, rowvar=rowvar), _to_t(x))
+
+
+def inverse(x, name=None):
+    """Alias of inv (reference keeps both names)."""
+    return inv(x, name=name)
+
+
+def multi_dot(tensors, name=None):
+    """Chain matmul with optimal ordering (reference multi_dot op); jnp
+    implements the dynamic-programming order selection."""
+    return primitive_call(lambda *ts: jnp.linalg.multi_dot(list(ts)),
+                          *tensors, name="multi_dot")
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack combined LU factors + pivots into (P, L, U) (reference
+    lu_unpack op); batched like lu(). Disabled unpack flags return None in
+    the corresponding slots (reference contract)."""
+    def unpack(a, piv):
+        m, n = a.shape[-2], a.shape[-1]
+        L = U = P = None
+        if unpack_ludata:
+            k = min(m, n)
+            L = jnp.tril(a[..., :, :k], -1) + jnp.eye(m, k, dtype=a.dtype)
+            U = jnp.triu(a[..., :k, :])
+        if unpack_pivots:
+            # pivots (1-indexed sequential swaps) -> permutation matrix
+            def one(pv):
+                perm = jnp.arange(m)
+                for i in range(pv.shape[-1]):
+                    j = pv[i] - 1
+                    pi, pj = perm[i], perm[j]
+                    perm = perm.at[i].set(pj).at[j].set(pi)
+                return jnp.eye(m, dtype=a.dtype)[perm].T
+
+            batch = piv.shape[:-1]
+            if batch:
+                P = jax.vmap(one)(piv.reshape((-1, piv.shape[-1])))
+                P = P.reshape(batch + (m, m))
+            else:
+                P = one(piv)
+        return P, L, U
+
+    if unpack_ludata and unpack_pivots:
+        return primitive_call(lambda a, p: unpack(a, p), lu_data, lu_pivots,
+                              name="lu_unpack")
+    # partial unpack: compute eagerly on the raw arrays (None slots are not
+    # expressible through the traced multi-output op)
+    from ..core.tensor import Tensor as _T
+
+    a = lu_data._value if isinstance(lu_data, _T) else jnp.asarray(lu_data)
+    piv = lu_pivots._value if isinstance(lu_pivots, _T) else jnp.asarray(lu_pivots)
+    P, L, U = unpack(a, piv)
+    wrap = lambda v: None if v is None else _T(v)  # noqa: E731
+    return wrap(P), wrap(L), wrap(U)
+
+
+__all__ += ["inverse", "multi_dot", "lu_unpack"]

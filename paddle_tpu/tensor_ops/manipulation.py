@@ -115,9 +115,9 @@ def squeeze(x, axis=None, name=None):
 
 
 def squeeze_(x, axis=None, name=None):
-    y = squeeze(x, axis)
-    x._value = y._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, squeeze(x, axis))
 
 
 def unsqueeze(x, axis, name=None):
@@ -133,9 +133,9 @@ def unsqueeze(x, axis, name=None):
 
 
 def unsqueeze_(x, axis, name=None):
-    y = unsqueeze(x, axis)
-    x._value = y._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, unsqueeze(x, axis))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -150,9 +150,9 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
 
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):
-    y = flatten(x, start_axis, stop_axis)
-    x._value = y._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, flatten(x, start_axis, stop_axis))
 
 
 def expand(x, shape, name=None):
@@ -452,10 +452,21 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
 def scatter_(x, index, updates, overwrite=True, name=None):
     out = scatter(x, index, updates, overwrite=overwrite, name=name)
     if isinstance(x, Tensor):
-        x._value = out._value
-        return x
+        from ..core.tape import graft_inplace
+
+        return graft_inplace(x, out)
     return out
 
 
 __all__ += ["broadcast_tensors", "diagonal", "reverse", "crop", "scatter_nd",
             "shard_index", "unique_consecutive", "scatter_"]
+
+
+def put_along_axis_(arr, indices, values, axis, reduce="assign", name=None):
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(arr, put_along_axis(arr, indices, values, axis,
+                                             reduce=reduce))
+
+
+__all__ += ["put_along_axis_"]

@@ -286,30 +286,31 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
     return primitive_call(lambda a: jnp.diff(a, n=n, axis=axis), x, name="diff")
 
 
-# -------- in-place variants (swap underlying buffer; paddle `op_` convention)
+# -------- in-place variants (paddle `op_` convention). Each computes through
+# the traced op and GRAFTS the result's autograd node onto x — rebinding the
+# buffer alone would make the tape treat the op as identity and skip its VJP
+# (core/tape.py graft_inplace).
+from ..core.tape import graft_inplace as _graft
+
+
 def add_(x, y, name=None):
-    x._value = x._value + (y._value if isinstance(y, Tensor) else y)
-    return x
+    return _graft(x, add(x, y))
 
 
 def subtract_(x, y, name=None):
-    x._value = x._value - (y._value if isinstance(y, Tensor) else y)
-    return x
+    return _graft(x, subtract(x, y))
 
 
 def multiply_(x, y, name=None):
-    x._value = x._value * (y._value if isinstance(y, Tensor) else y)
-    return x
+    return _graft(x, multiply(x, y))
 
 
 def clip_(x, min=None, max=None, name=None):
-    x._value = jnp.clip(x._value, min, max)
-    return x
+    return _graft(x, clip(x, min, max))
 
 
 def scale_(x, scale=1.0, bias=0.0, name=None):
-    x._value = x._value * scale + bias
-    return x
+    return _graft(x, globals()["scale"](x, scale=scale, bias=bias))
 
 
 # ---- parity batch (reference: python/paddle/tensor/math.py __all__) ----
@@ -331,9 +332,9 @@ floor_mod = remainder
 
 def tanh_(x, name=None):
     """In-place tanh (reference inplace contract: result written into x)."""
-    out = tanh(x)
-    x._value = out._value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, tanh(x))
 
 
 def trace(x, offset=0, axis1=0, axis2=1, name=None):
@@ -419,3 +420,25 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 
 __all__ += ["bincount"]
+
+
+def _inplace(fn, fn_name):
+    def op(x, *args, name=None, **kw):
+        return _graft(x, fn(x, *args, **kw))
+
+    op.__name__ = fn_name
+    return op
+
+
+exp_ = _inplace(exp, "exp_")
+ceil_ = _inplace(ceil, "ceil_")
+floor_ = _inplace(floor, "floor_")
+round_ = _inplace(round, "round_")
+sqrt_ = _inplace(sqrt, "sqrt_")
+rsqrt_ = _inplace(rsqrt, "rsqrt_")
+reciprocal_ = _inplace(reciprocal, "reciprocal_")
+erfinv_ = _inplace(erfinv, "erfinv_")
+lerp_ = _inplace(lerp, "lerp_")
+
+__all__ += ["exp_", "ceil_", "floor_", "round_", "sqrt_", "rsqrt_",
+            "reciprocal_", "erfinv_", "lerp_"]

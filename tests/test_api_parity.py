@@ -363,3 +363,48 @@ def test_inplace_ops_mutate():
     r = paddle.tanh_(t)
     assert r is t
     np.testing.assert_allclose(t.numpy(), np.tanh(0.5), rtol=1e-6)
+
+
+def test_inplace_ops_have_correct_gradients():
+    """Regression: in-place ops must graft the op's autograd node, not just
+    rebind the buffer (which silently made them identity in backward)."""
+    import paddle_tpu.tensor_ops.math as M
+
+    x = _t(np.array([1., 4.], np.float32))
+    x.stop_gradient = False
+    paddle.sqrt_(x)
+    paddle.exp_(x)
+    x.sum().backward()
+    ref = np.exp(np.sqrt([1., 4.])) * 0.5 / np.sqrt([1., 4.])
+    np.testing.assert_allclose(np.asarray(x.grad._value), ref, rtol=1e-5)
+
+    a = _t(np.array([1., 2.], np.float32))
+    a.stop_gradient = False
+    b = _t(np.array([3., 4.], np.float32))
+    b.stop_gradient = False
+    c = a * 2
+    M.add_(c, b)
+    c.sum().backward()
+    np.testing.assert_allclose(np.asarray(a.grad._value), [2., 2.])
+    np.testing.assert_allclose(np.asarray(b.grad._value), [1., 1.])
+
+    w = _t(np.array([0.5], np.float32))
+    w.stop_gradient = False
+    h = w * 3
+    paddle.tanh_(h)
+    (h * 5).backward()
+    ref = 5 * (1 - np.tanh(1.5) ** 2) * 3
+    np.testing.assert_allclose(np.asarray(w.grad._value), [ref], rtol=1e-5)
+
+
+def test_lu_unpack_batched_and_flags():
+    rng = np.random.RandomState(15)
+    x = _t(rng.randn(3, 4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32))
+    lu_d, piv = paddle.lu(x)
+    P, L, U = paddle.linalg.lu_unpack(lu_d, piv)
+    rec = np.asarray(P._value) @ np.asarray(L._value) @ np.asarray(U._value)
+    np.testing.assert_allclose(rec, np.asarray(x._value), rtol=1e-4, atol=1e-5)
+    P2, _, _ = paddle.linalg.lu_unpack(lu_d, piv, unpack_pivots=False)
+    assert P2 is None
+    P3, L3, _ = paddle.linalg.lu_unpack(lu_d, piv, unpack_ludata=False)
+    assert L3 is None and P3 is not None
