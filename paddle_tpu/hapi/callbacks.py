@@ -220,3 +220,76 @@ def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
         "verbose": verbose, "metrics": metrics or ["loss"],
     })
     return cl
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce the optimizer lr when a monitored metric stops improving
+    (reference hapi/callbacks.py ReduceLROnPlateau — the callback form of
+    optimizer.lr.ReduceOnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.verbose = verbose
+        self.min_delta = float(min_delta)
+        self.cooldown = int(cooldown)
+        self.min_lr = float(min_lr)
+        if mode == "min" or (mode == "auto" and "acc" not in monitor):
+            self._cmp = lambda cur, best: cur < best - self.min_delta
+            self._best = float("inf")
+        else:
+            self._cmp = lambda cur, best: cur > best + self.min_delta
+            self._best = -float("inf")
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def _get_metric(self, logs):
+        v = (logs or {}).get(self.monitor)
+        if isinstance(v, (list, tuple)):
+            v = v[0]
+        return None if v is None else float(v)
+
+    def on_eval_end(self, logs=None):
+        self._step(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._step(logs)
+
+    def _step(self, logs):
+        cur = self._get_metric(logs)
+        if cur is None:
+            return
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            self._wait = 0
+            return  # hold: no comparisons while cooling down
+        if self._cmp(cur, self._best):
+            self._best = cur
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is None:
+                return
+            lr = opt.get_lr()
+            new_lr = max(lr * self.factor, self.min_lr)
+            if new_lr < lr:
+                try:
+                    opt.set_lr(new_lr)
+                except RuntimeError:
+                    # LRScheduler-driven optimizer: scale the schedule's base
+                    # and refresh its cached last_lr at the current epoch
+                    sched = opt._learning_rate
+                    if hasattr(sched, "base_lr"):
+                        sched.base_lr *= self.factor
+                        sched.step(sched.last_epoch)
+                    else:  # pragma: no cover - schedulers all carry base_lr
+                        raise
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {lr:.3g} -> {new_lr:.3g}")
+            self._wait = 0
+            self._cooldown_left = self.cooldown

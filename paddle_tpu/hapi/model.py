@@ -217,10 +217,12 @@ class Model:
             logs = self._run_one_epoch(train_loader, cbks, "train", num_iters)
             if self._optimizer is not None and self._optimizer._lr_scheduler is not None:
                 self._optimizer._lr_scheduler.step()
-            cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
                 eval_logs = self.evaluate(eval_loader, verbose=0, _invoke_cbks=False)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            # epoch-end fires AFTER eval so monitors (EarlyStopping,
+            # ReduceLROnPlateau) can read eval_* metrics
+            cbks.on_epoch_end(epoch, logs)
         cbks.on_end("train", logs)
         return self
 

@@ -1,37 +1,221 @@
-"""ASP — 2:4 structured sparsity (reference: python/paddle/incubate/asp/,
-fleet asp_optimizer). Mask computation + optimizer decoration."""
+"""ASP — n:m structured sparsity (reference: python/paddle/incubate/asp/ →
+fluid/contrib/sparsity/{utils,asp}.py: MaskAlgo/CheckMethod, 1-D and 2-D mask
+algorithms, prune_model + optimizer decoration keeping masks applied).
+
+TPU note: n:m sparse matmuls have no MXU speedup (no sparse tensor cores);
+ASP here serves model-compression parity — masks are exact per the
+reference's algorithms, training keeps them applied after every step.
+"""
 from __future__ import annotations
+
+import itertools
+from enum import Enum
 
 import numpy as np
 
 from ..core.tensor import Tensor
 
+
+class MaskAlgo(Enum):
+    MASK_1D = "mask_1d"
+    MASK_2D_GREEDY = "mask_2d_greedy"
+    MASK_2D_BEST = "mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_1d"
+    CHECK_2D = "check_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        if mask_algo == MaskAlgo.MASK_1D:
+            return CheckMethod.CHECK_1D
+        return CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _reshape_1d(mat, m):
+    """Pad the last dim to a multiple of m and view as rows of m."""
+    w = mat.reshape(-1)
+    pad = (-w.size) % m
+    if pad:
+        w = np.concatenate([w, np.zeros(pad, mat.dtype)])
+    return w.reshape(-1, m), pad
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest-|w| of every m consecutive weights."""
+    mat = np.asarray(mat)
+    flat, pad = _reshape_1d(mat, m)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=1)
+    mask = mask.reshape(-1)
+    if pad:
+        mask = mask[:-pad]
+    return mask.reshape(mat.shape)
+
+
+def check_mask_1d(mat, n, m):
+    flat, pad = _reshape_1d(np.asarray(mat) != 0, m)
+    if pad:
+        flat[-1, m - pad:] = False
+    return bool((flat.sum(axis=1) <= n).all())
+
+
+def _blocks_2d(mat, m):
+    """View an [r, c] matrix (padded to multiples of m) as m x m blocks."""
+    mat = np.asarray(mat)
+    r, c = mat.shape
+    pr, pc = (-r) % m, (-c) % m
+    if pr or pc:
+        mat = np.pad(mat, ((0, pr), (0, pc)))
+    R, C = mat.shape
+    blocks = mat.reshape(R // m, m, C // m, m).transpose(0, 2, 1, 3)
+    return blocks, (r, c), (R, C)
+
+
+def _unblocks_2d(blocks, orig, padded):
+    R, C = padded
+    m = blocks.shape[-1]
+    out = blocks.transpose(0, 2, 1, 3).reshape(R, C)
+    return out[: orig[0], : orig[1]]
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Per m x m block: greedily keep the largest-|w| entries subject to at
+    most n nonzeros per row AND per column (reference get_mask_2d_greedy)."""
+    blocks, orig, padded = _blocks_2d(np.abs(np.asarray(mat)), m)
+    # reshape of the transposed block view copies — accumulate into a flat
+    # buffer and restore the block shape explicitly
+    nb = np.ascontiguousarray(blocks).reshape(-1, m, m)
+    mb = np.zeros_like(nb, dtype=bool)
+    for b in range(nb.shape[0]):
+        block = nb[b]
+        order = np.argsort(-block, axis=None)
+        row_cnt = np.zeros(m, np.int32)
+        col_cnt = np.zeros(m, np.int32)
+        for flat_idx in order:
+            i, j = divmod(int(flat_idx), m)
+            if row_cnt[i] < n and col_cnt[j] < n:
+                mb[b, i, j] = True
+                row_cnt[i] += 1
+                col_cnt[j] += 1
+    return _unblocks_2d(mb.reshape(blocks.shape), orig, padded)
+
+
+def _compute_valid_2d_patterns(n, m):
+    """All m x m boolean patterns with exactly n per row and n per column."""
+    row_patterns = [np.asarray([i in comb for i in range(m)], bool)
+                    for comb in itertools.combinations(range(m), n)]
+    valid = []
+    for rows in itertools.product(row_patterns, repeat=m):
+        pat = np.stack(rows)
+        if (pat.sum(axis=0) == n).all():
+            valid.append(pat)
+    return np.stack(valid)  # [P, m, m]
+
+
+_PATTERN_CACHE: dict = {}
+
+
+def get_mask_2d_best(mat, n, m):
+    """Per block, pick the valid n-per-row-and-column pattern with maximal
+    retained magnitude (reference get_mask_2d_best)."""
+    key = (n, m)
+    if key not in _PATTERN_CACHE:
+        _PATTERN_CACHE[key] = _compute_valid_2d_patterns(n, m)
+    patterns = _PATTERN_CACHE[key]  # [P, m, m]
+    blocks, orig, padded = _blocks_2d(np.abs(np.asarray(mat)), m)
+    nb = blocks.reshape(-1, m, m)
+    scores = np.einsum("bij,pij->bp", nb, patterns.astype(nb.dtype))
+    best = np.argmax(scores, axis=1)
+    masks = patterns[best].reshape(blocks.shape).astype(bool)
+    return _unblocks_2d(masks, orig, padded)
+
+
+def check_mask_2d(mat, n, m):
+    blocks, _, _ = _blocks_2d(np.asarray(mat) != 0, m)
+    nb = blocks.reshape(-1, m, m)
+    return bool((nb.sum(axis=1) <= n).all() and (nb.sum(axis=2) <= n).all())
+
+
+_MASK_FUNCS = {
+    MaskAlgo.MASK_1D: get_mask_1d,
+    MaskAlgo.MASK_2D_GREEDY: get_mask_2d_greedy,
+    MaskAlgo.MASK_2D_BEST: get_mask_2d_best,
+}
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
+    if isinstance(func_name, str):
+        func_name = MaskAlgo(func_name)
+    if arr.ndim < 2 and func_name != MaskAlgo.MASK_1D:
+        raise ValueError("2-D mask algorithms need a matrix-shaped weight")
+    return _MASK_FUNCS[func_name](arr, n, m)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
+    if isinstance(func_name, str):
+        func_name = CheckMethod(func_name)
+    if func_name == CheckMethod.CHECK_1D:
+        return check_mask_1d(arr, n, m)
+    return check_mask_2d(arr, n, m)
+
+
+# -------------------------------------------------------------- training flow
 _masks: dict[int, np.ndarray] = {}
+_excluded: set[int] = set()
+_excluded_names: set[str] = set()
+
+
+def set_excluded_layers(main_program=None, param_names=None, model=None):
+    """Exclude parameters (by name) from pruning (reference
+    set_excluded_layers). Names are remembered and matched again inside
+    prune_model, so the names-only (program-style) call works too."""
+    names = set(param_names or [])
+    _excluded_names.update(names)
+    if model is not None:
+        for pname, p in model.named_parameters():
+            if pname in names or getattr(p, "name", None) in names:
+                _excluded.add(id(p))
 
 
 def compute_mask_2_4(w: np.ndarray) -> np.ndarray:
-    """Keep the 2 largest-|w| of every 4 along the last dim."""
-    orig = w.shape
-    flat = w.reshape(-1, 4) if w.size % 4 == 0 else None
-    if flat is None:
-        return np.ones_like(w, dtype=bool)
-    idx = np.argsort(-np.abs(flat), axis=1)[:, :2]
-    mask = np.zeros_like(flat, dtype=bool)
-    np.put_along_axis(mask, idx, True, axis=1)
-    return mask.reshape(orig)
+    """Back-compat helper: 2:4 1-D mask."""
+    return get_mask_1d(w, 2, 4)
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune every 2-D parameter to n:m sparsity and remember the masks so
+    `decorate`d optimizers re-apply them after each step."""
+    algo = MaskAlgo(mask_algo) if isinstance(mask_algo, str) else mask_algo
+    named = {id(p): pname for pname, p in model.named_parameters()} \
+        if hasattr(model, "named_parameters") else {}
     for p in model.parameters():
-        if p.ndim == 2 and p.size % 4 == 0:
+        if id(p) in _excluded or named.get(id(p)) in _excluded_names \
+                or getattr(p, "name", None) in _excluded_names:
+            continue
+        if p.ndim == 2 and p.size % m == 0:
             w = p.numpy()
-            mask = compute_mask_2_4(w)
-            _masks[id(p)] = mask
-            p.set_value(w * mask)
+            mask = _MASK_FUNCS[algo](w, n, m)
+            p.set_value(w * mask)  # weights are ALWAYS pruned (reference)
+            if with_mask:
+                # with_mask gates only mask retention for sparse TRAINING;
+                # False = one-shot inference pruning, optimizer untouched
+                _masks[id(p)] = mask
     return _masks
 
 
 def decorate(optimizer):
+    """Re-apply the pruning masks after every optimizer step (reference
+    ASPOptimizer/OptimizerWithSparsityGuarantee)."""
     orig_step = optimizer.step
 
     def step():
@@ -47,3 +231,5 @@ def decorate(optimizer):
 
 def reset_excluded_layers(model=None):
     _masks.clear()
+    _excluded.clear()
+    _excluded_names.clear()

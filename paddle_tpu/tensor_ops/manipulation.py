@@ -36,9 +36,9 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    shp = _shape_list(shape)
-    x._value = jnp.reshape(x._value, shp)
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, reshape(x, shape))
 
 
 def transpose(x, perm, name=None):

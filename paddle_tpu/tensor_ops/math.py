@@ -248,8 +248,9 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 
 def increment(x, value=1.0, name=None):
-    x._value = x._value + value
-    return x
+    from ..core.tape import graft_inplace
+
+    return graft_inplace(x, add(x, value))
 
 
 def dot(x, y, name=None):

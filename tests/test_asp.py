@@ -60,3 +60,43 @@ def test_asp_training_loop_preserves_sparsity():
                 nz = (np.abs(p.numpy().reshape(-1, 4)) > 0).sum(axis=1)
                 assert nz.max() <= 2, f"step {step}: mask violated"
     assert losses[-1] < losses[0], losses
+
+
+def test_mask_2d_algorithms_satisfy_row_and_col_constraints():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 12).astype(np.float32)
+    for algo in (asp.MaskAlgo.MASK_2D_GREEDY, asp.MaskAlgo.MASK_2D_BEST):
+        mask = asp.create_mask(w, func_name=algo, n=2, m=4)
+        assert asp.check_sparsity(w * mask, asp.CheckMethod.CHECK_2D, 2, 4)
+        assert asp.calculate_density(w * mask) == pytest.approx(0.5, abs=1e-6)
+    # best >= greedy in retained magnitude (its defining property)
+    g = asp.create_mask(w, asp.MaskAlgo.MASK_2D_GREEDY, 2, 4)
+    b = asp.create_mask(w, asp.MaskAlgo.MASK_2D_BEST, 2, 4)
+    assert np.abs(w * b).sum() >= np.abs(w * g).sum() - 1e-6
+
+
+def test_general_n_m_and_check_methods():
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 16).astype(np.float32)
+    mask = asp.get_mask_1d(w, 1, 4)  # 1:4
+    assert asp.check_mask_1d(w * mask, 1, 4)
+    assert not asp.check_mask_1d(w, 1, 4)  # dense fails
+    assert asp.CheckMethod.get_checking_method(
+        asp.MaskAlgo.MASK_2D_BEST) == asp.CheckMethod.CHECK_2D
+
+
+def test_excluded_layers_skip_pruning():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(8, 8), nn.Linear(8, 8))
+    names = [n for n, _ in m.named_parameters()]
+    asp.set_excluded_layers(param_names=[names[0]], model=m)
+    asp.prune_model(m)
+    w0 = m.sublayers()[0].weight.numpy() if hasattr(m.sublayers()[0], "weight") else None
+    p0 = dict(m.named_parameters())[names[0]]
+    assert asp.calculate_density(p0.numpy()) == 1.0  # untouched
+    p2 = dict(m.named_parameters())[names[2]]
+    assert asp.calculate_density(p2.numpy()) == pytest.approx(0.5, abs=0.01)
+    asp.reset_excluded_layers()
