@@ -279,7 +279,24 @@ def main():
         print(json.dumps(run_bench(child_platform)), flush=True)
         return
 
-    result = _try_child("tpu", _TPU_BUDGET_S)
+    # cheap tunnel probe: a dead accelerator plugin blocks jax.devices()
+    # FOREVER inside the child (observed with the axon tunnel down) — don't
+    # spend the whole TPU budget discovering that
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
+            timeout=75, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            check=False,
+        )
+        tunnel_ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        tunnel_ok = False
+
+    if not tunnel_ok:
+        print("[bench] accelerator probe failed/hung; skipping TPU child",
+              file=sys.stderr, flush=True)
+    result = _try_child("tpu", _TPU_BUDGET_S) if tunnel_ok else None
     if result is None:
         result = _try_child("cpu", _CPU_BUDGET_S)
     if result is None:

@@ -285,7 +285,8 @@ class ReduceLROnPlateau(Callback):
                     # and refresh its cached last_lr at the current epoch
                     sched = opt._learning_rate
                     if hasattr(sched, "base_lr"):
-                        sched.base_lr *= self.factor
+                        # scale by the clamped ratio so min_lr is honored
+                        sched.base_lr *= new_lr / lr
                         sched.step(sched.last_epoch)
                     else:  # pragma: no cover - schedulers all carry base_lr
                         raise

@@ -109,14 +109,29 @@ def get_mask_2d_greedy(mat, n, m):
 
 
 def _compute_valid_2d_patterns(n, m):
-    """All m x m boolean patterns with exactly n per row and n per column."""
+    """All m x m boolean patterns with exactly n per row and n per column.
+    Column counts are pruned during the row-by-row recursion, so the search
+    visits only viable prefixes (C(4,2)^4 brute force explodes by m=8)."""
     row_patterns = [np.asarray([i in comb for i in range(m)], bool)
                     for comb in itertools.combinations(range(m), n)]
     valid = []
-    for rows in itertools.product(row_patterns, repeat=m):
-        pat = np.stack(rows)
-        if (pat.sum(axis=0) == n).all():
-            valid.append(pat)
+
+    def rec(rows, col_cnt):
+        depth = len(rows)
+        if depth == m:
+            valid.append(np.stack(rows))
+            return
+        remaining = m - depth
+        for rp in row_patterns:
+            nc = col_cnt + rp
+            # prune: no column may exceed n, and every column must still be
+            # able to reach n with the rows left
+            if (nc <= n).all() and (nc + (remaining - 1) >= n).all():
+                rows.append(rp)
+                rec(rows, nc)
+                rows.pop()
+
+    rec([], np.zeros(m, np.int64))
     return np.stack(valid)  # [P, m, m]
 
 
@@ -126,6 +141,13 @@ _PATTERN_CACHE: dict = {}
 def get_mask_2d_best(mat, n, m):
     """Per block, pick the valid n-per-row-and-column pattern with maximal
     retained magnitude (reference get_mask_2d_best)."""
+    if m > 4:
+        # the number of valid patterns explodes combinatorially (4:8 already
+        # has ~1.2e11 doubly-stochastic 0/1 matrices — the reference's
+        # enumeration would also never return); greedy handles large m
+        raise ValueError(
+            f"MASK_2D_BEST enumerates all valid patterns and is tractable "
+            f"only for m <= 4 (got m={m}); use MASK_2D_GREEDY instead")
     key = (n, m)
     if key not in _PATTERN_CACHE:
         _PATTERN_CACHE[key] = _compute_valid_2d_patterns(n, m)
@@ -155,8 +177,15 @@ def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
     arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor) else tensor)
     if isinstance(func_name, str):
         func_name = MaskAlgo(func_name)
-    if arr.ndim < 2 and func_name != MaskAlgo.MASK_1D:
+    if func_name == MaskAlgo.MASK_1D:
+        return _MASK_FUNCS[func_name](arr, n, m)
+    if arr.ndim < 2:
         raise ValueError("2-D mask algorithms need a matrix-shaped weight")
+    if arr.ndim > 2:
+        # conv-style weights: flatten trailing dims (reference reshapes to
+        # 2-D before masking), mask, restore
+        flat = arr.reshape(arr.shape[0], -1)
+        return _MASK_FUNCS[func_name](flat, n, m).reshape(arr.shape)
     return _MASK_FUNCS[func_name](arr, n, m)
 
 

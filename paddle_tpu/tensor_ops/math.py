@@ -248,9 +248,7 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 
 
 def increment(x, value=1.0, name=None):
-    from ..core.tape import graft_inplace
-
-    return graft_inplace(x, add(x, value))
+    return _graft(x, add(x, value))
 
 
 def dot(x, y, name=None):
