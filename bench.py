@@ -30,6 +30,61 @@ _CHILD_ENV = "PADDLE_TPU_BENCH_CHILD"  # "tpu" | "cpu"
 _DEADLINE_ENV = "PADDLE_TPU_BENCH_DEADLINE"  # unix time the child must respect
 _TPU_BUDGET_S = int(os.environ.get("BENCH_TPU_BUDGET_S", "540"))
 _CPU_BUDGET_S = int(os.environ.get("BENCH_CPU_BUDGET_S", "150"))
+# Every successful on-chip measurement is appended here (timestamp + git sha
+# + device kind), so one dead-tunnel moment at capture time cannot erase the
+# perf record (VERDICT r3 weak #1). The file is committed; on CPU fallback the
+# emitted JSON carries the newest entry as `last_known_tpu`, provenance-labeled.
+_HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_TPU_HISTORY.jsonl")
+
+
+def _git_sha() -> str:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=10,
+        )
+        return out.stdout.decode().strip() or "?"
+    except Exception:  # noqa: BLE001
+        return "?"
+
+
+def _bank_tpu_result(result: dict) -> None:
+    """Append an on-chip measurement to the committed history artifact."""
+    if result.get("platform") in (None, "cpu", "none"):
+        return
+    rec = dict(result)
+    rec["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rec["git_sha"] = _git_sha()
+    try:
+        with open(_HISTORY_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError as e:
+        print(f"[bench] WARNING: could not bank TPU result: {e}",
+              file=sys.stderr, flush=True)
+
+
+def _last_known_tpu() -> dict | None:
+    """Newest banked on-chip measurement, or None if history is empty."""
+    try:
+        with open(_HISTORY_PATH) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if rec.get("platform") in (None, "cpu", "none"):
+            continue
+        # ad-hoc --rung experiments (BENCH_BANK=1) are banked for the record
+        # but must not shadow the ladder's winning number
+        if str(rec.get("provenance", "")).startswith("rung-experiment"):
+            continue
+        return rec
+    return None
 
 
 def _peak_flops(device) -> float | None:
@@ -212,6 +267,9 @@ def run_bench(platform: str) -> dict:
     if result is None:
         raise RuntimeError("no ladder rung fit on the device in budget")
 
+    # bank only the ladder's winning measurement — ad-hoc --rung experiments
+    # must not shadow it as "last known TPU perf"
+    _bank_tpu_result(result)
     return result
 
 
@@ -267,7 +325,11 @@ def main():
         rung = json.loads(sys.argv[2])
         steps = int(sys.argv[3]) if len(sys.argv) > 3 else 4
         try:
-            print(json.dumps(_measure(rung, steps=steps, warmup=2)), flush=True)
+            r = _measure(rung, steps=steps, warmup=2)
+            if os.environ.get("BENCH_BANK") == "1":  # opt-in: bank an experiment
+                r["provenance"] = "rung-experiment (BENCH_BANK=1)"
+                _bank_tpu_result(r)
+            print(json.dumps(r), flush=True)
         except Exception as e:  # noqa: BLE001
             print(f"FAILED: {type(e).__name__}: {str(e)[:500]}", flush=True)
             sys.exit(1)
@@ -281,20 +343,37 @@ def main():
 
     # cheap tunnel probe: a dead accelerator plugin blocks jax.devices()
     # FOREVER inside the child (observed with the axon tunnel down) — don't
-    # spend the whole TPU budget discovering that
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
-            timeout=75, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            check=False,
-        )
-        tunnel_ok = probe.returncode == 0
-    except subprocess.TimeoutExpired:
-        tunnel_ok = False
+    # spend the whole TPU budget discovering that. Probe up to 3 times with
+    # backoff (a tunnel can be momentarily wedged, VERDICT r3 item 1a) —
+    # one 75 s shot is not evidence the chip is gone.
+    tunnel_ok = False
+    for attempt, (probe_timeout, backoff) in enumerate(
+        [(60, 20), (60, 40), (75, 0)], start=1
+    ):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                env={k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"},
+                timeout=probe_timeout,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                check=False,
+            )
+            if probe.returncode == 0:
+                tunnel_ok = True
+                break
+            # fast non-zero exit = deterministic failure (no plugin/broken
+            # jax), not a wedged tunnel — retrying the same probe is futile
+            print(f"[bench] accelerator probe exited rc={probe.returncode}; "
+                  "not retrying", file=sys.stderr, flush=True)
+            break
+        except subprocess.TimeoutExpired:
+            print(f"[bench] accelerator probe {attempt}/3 hung"
+                  + (f"; retrying in {backoff}s" if backoff else ""),
+                  file=sys.stderr, flush=True)
+            time.sleep(backoff)
 
     if not tunnel_ok:
-        print("[bench] accelerator probe failed/hung; skipping TPU child",
+        print("[bench] accelerator unreachable after 3 probes; skipping TPU child",
               file=sys.stderr, flush=True)
     result = _try_child("tpu", _TPU_BUDGET_S) if tunnel_ok else None
     if result is None:
@@ -308,6 +387,18 @@ def main():
             "platform": "none",
             "error": "both TPU and CPU bench children failed; see stderr",
         }
+    if result.get("platform") in (None, "cpu", "none"):
+        # CPU fallback: attach the newest banked on-chip measurement so the
+        # driver's record keeps a provenance-labeled TPU number. NOT current —
+        # its `ts`/`git_sha` say exactly when/what it measured.
+        last = _last_known_tpu()
+        if last is not None:
+            result["last_known_tpu"] = last
+            result["note"] = (
+                "current run fell back to CPU (tunnel down); last_known_tpu is "
+                f"the newest banked on-chip measurement (ts={last.get('ts')}, "
+                f"git_sha={last.get('git_sha')}) from BENCH_TPU_HISTORY.jsonl"
+            )
     print(json.dumps(result), flush=True)
 
 
