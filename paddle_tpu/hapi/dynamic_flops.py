@@ -21,16 +21,18 @@ def _numel(shape):
 
 
 def _count(layer, x_shape, y_shape):
-    """FLOPs for one layer call, by type (multiply-add counted as 2 ops —
-    matching the reference's convention of counting MACs then doubling)."""
+    """Op count for one layer call, by type — the reference convention is
+    MACs WITHOUT doubling for linear/conv (dynamic_flops.py count_linear:
+    total_mul * num_elements; count_convNd: y.numel() * (in/groups * prod(k)),
+    reference lines 123-150), and elementwise counts for norm/activation."""
     from .. import nn
 
     if isinstance(layer, nn.Linear):
-        return 2 * _numel(x_shape[:-1]) * layer.weight.shape[0] * layer.weight.shape[1]
+        return _numel(x_shape[:-1]) * layer.weight.shape[0] * layer.weight.shape[1]
     if isinstance(layer, (nn.Conv2D, nn.Conv1D, nn.Conv3D)):
         w = layer.weight  # [out_c, in_c/groups, *k]
         macs_per_out = _numel(w.shape[1:])
-        return 2 * _numel(y_shape) * macs_per_out
+        return _numel(y_shape) * macs_per_out
     if isinstance(layer, (nn.Conv2DTranspose, nn.Conv1DTranspose,
                           nn.Conv3DTranspose)):
         # transpose weights are [in, out/groups, *k]: each output element
@@ -38,7 +40,7 @@ def _count(layer, x_shape, y_shape):
         w = layer.weight
         groups = getattr(layer, "_groups", 1)
         macs_per_out = (w.shape[0] // groups) * _numel(w.shape[2:])
-        return 2 * _numel(y_shape) * macs_per_out
+        return _numel(y_shape) * macs_per_out
     if isinstance(layer, (nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D,
                           nn.BatchNorm3D, nn.LayerNorm, nn.GroupNorm,
                           nn.InstanceNorm1D, nn.InstanceNorm2D,

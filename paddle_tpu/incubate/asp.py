@@ -222,8 +222,10 @@ def compute_mask_2_4(w: np.ndarray) -> np.ndarray:
 
 
 def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
-    """Prune every 2-D parameter to n:m sparsity and remember the masks so
-    `decorate`d optimizers re-apply them after each step."""
+    """Prune every matrix-shaped (ndim >= 2) parameter to n:m sparsity and
+    remember the masks so `decorate`d optimizers re-apply them after each
+    step. Conv weights are flattened to 2-D for masking (the reference ASP
+    reshapes supported conv layers to 2-D, asp/asp.py prune_model)."""
     algo = MaskAlgo(mask_algo) if isinstance(mask_algo, str) else mask_algo
     named = {id(p): pname for pname, p in model.named_parameters()} \
         if hasattr(model, "named_parameters") else {}
@@ -231,9 +233,9 @@ def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
         if id(p) in _excluded or named.get(id(p)) in _excluded_names \
                 or getattr(p, "name", None) in _excluded_names:
             continue
-        if p.ndim == 2 and p.size % m == 0:
+        if p.ndim >= 2 and p.size % m == 0:
             w = p.numpy()
-            mask = _MASK_FUNCS[algo](w, n, m)
+            mask = create_mask(w, algo, n, m)
             p.set_value(w * mask)  # weights are ALWAYS pruned (reference)
             if with_mask:
                 # with_mask gates only mask retention for sparse TRAINING;

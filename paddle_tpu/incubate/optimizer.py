@@ -19,7 +19,12 @@ class LookAhead:
         self.inner_optimizer = inner_optimizer
         self.alpha = float(alpha)
         self.k = int(k)
-        self._slow = {}
+        # snapshot slow weights NOW (reference registers slow params at
+        # training start): the first k-step sync must pull the fast weights
+        # back toward the step-0 values, not be a no-op
+        self._slow = {id(p): p._value
+                      for p in (inner_optimizer._parameter_list or [])
+                      if not p.stop_gradient}
         self._steps = 0
         self._parameter_list = inner_optimizer._parameter_list
 
@@ -32,7 +37,7 @@ class LookAhead:
             if p.stop_gradient:
                 continue
             slow = self._slow.get(id(p))
-            if slow is None:
+            if slow is None:  # param added after construction
                 slow = p._value
             slow = slow + self.alpha * (p._value - slow)
             self._slow[id(p)] = slow

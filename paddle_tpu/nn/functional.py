@@ -193,8 +193,9 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
             idx = jnp.argmax(y, axis=axis, keepdims=True)
             y_hard = jnp.zeros_like(y)
             y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
-            y = y_hard + jax.lax.stop_gradient(y) - y  # straight-through... (swap)
-            y = y_hard - jax.lax.stop_gradient(y_hard) + jax.nn.softmax((a + g) / temperature, axis=axis)
+            # straight-through: forward value is the one-hot (y - sg(y) == 0),
+            # backward sees d(y)/da — the soft distribution's gradient
+            y = jax.lax.stop_gradient(y_hard - y) + y
         return y
 
     return primitive_call(f, _t(x))

@@ -299,12 +299,19 @@ class MaxPool3D:
 
         xb = _bcoo(x)
         dense = xb.todense()  # [N, D, H, W, C]
+        # max over STORED values only: implicit zeros must not win over
+        # negative stored values (reference sparse maxpool reduces over the
+        # stored support) — mask empty sites to -inf before the reduction
+        support = jnp.zeros(dense.shape, bool).at[
+            tuple(jnp.moveaxis(xb.indices, -1, 0))].set(True)
+        masked = jnp.where(support, dense, -jnp.inf)
         pad = self._p
         pads = [(0, 0)] + ([(pad, pad)] * 3 if isinstance(pad, int)
                            else [(p, p) for p in pad]) + [(0, 0)]
         out = _jax.lax.reduce_window(
-            dense, -jnp.inf, _jax.lax.max,
+            masked, -jnp.inf, _jax.lax.max,
             (1,) + tuple(self._k) + (1,), (1,) + tuple(self._s) + (1,), pads)
+        # windows containing no stored site stay -inf -> dropped from support
         out = jnp.where(jnp.isfinite(out), out, 0.0)
         return _from_dense(Tensor(out))
 

@@ -184,6 +184,7 @@ class ExponentialMovingAverage:
 
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = float(decay)
+        self._thres_steps = thres_steps
         self._shadow: dict[int, object] = {}
         self._backup: dict[int, object] | None = None
         self._params: list = []
@@ -203,7 +204,15 @@ class ExponentialMovingAverage:
                                 if not p.stop_gradient]
         self._ensure(params)
         self._step += 1
-        d = min(self._decay, (1.0 + self._step) / (10.0 + self._step))
+        # reference ema (fluid/optimizer.py:4232): the (1+t)/(10+t) warm-up
+        # ramp applies ONLY when thres_steps is given, using ITS value — a
+        # user's constant decay must stay constant from step 1
+        if self._thres_steps is None:
+            d = self._decay
+        else:
+            t = self._thres_steps() if callable(self._thres_steps) \
+                else self._thres_steps
+            d = min(self._decay, (float(t) + 1.0) / (float(t) + 10.0))
         for p in self._params:
             self._shadow[id(p)] = d * self._shadow[id(p)] + (1 - d) * p._value
 

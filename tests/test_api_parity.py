@@ -293,7 +293,9 @@ def test_flops_counts_conv_and_linear():
     net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
                         nn.Flatten(), nn.Linear(8 * 32 * 32, 10))
     n = paddle.flops(net, [1, 3, 32, 32])
-    expected = 2 * 8 * 32 * 32 * 27 + 8 * 32 * 32 + 2 * 8192 * 10
+    # reference convention: MACs without doubling for conv/linear
+    # (dynamic_flops.py count_convNd/count_linear), elementwise for ReLU
+    expected = 8 * 32 * 32 * 27 + 8 * 32 * 32 + 8192 * 10
     assert n == expected, (n, expected)
 
 

@@ -1,0 +1,241 @@
+"""Round-4 regression tests for VERDICT r3 confirmed bugs (weak #2-5, #8)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+# ---------------------------------------------------------------- gumbel_softmax
+def test_gumbel_softmax_soft_is_distribution():
+    x = paddle.to_tensor(np.random.randn(4, 10).astype(np.float32))
+    y = F.gumbel_softmax(x, temperature=0.5, hard=False)
+    out = y.numpy()
+    assert np.allclose(out.sum(-1), 1.0, atol=1e-5)
+    assert (out > 0).all() and not np.allclose(out.max(-1), 1.0)
+
+
+def test_gumbel_softmax_hard_is_one_hot():
+    x = paddle.to_tensor(np.random.randn(8, 16).astype(np.float32))
+    y = F.gumbel_softmax(x, temperature=1.0, hard=True)
+    out = y.numpy()
+    # forward must be exactly one-hot (VERDICT r3 weak #2: was returning soft)
+    assert set(np.unique(out)) <= {0.0, 1.0}
+    assert np.array_equal(out.sum(-1), np.ones(8, np.float32))
+
+
+def test_gumbel_softmax_hard_has_soft_gradient():
+    x = paddle.to_tensor(np.random.randn(3, 5).astype(np.float32),
+                         stop_gradient=False)
+    y = F.gumbel_softmax(x, temperature=1.0, hard=True)
+    y.sum().backward()
+    g = x.grad.numpy()
+    # straight-through: gradient flows (a pure one-hot has zero grad a.e.)
+    assert np.abs(g).sum() > 0 or np.allclose(g, 0, atol=1e-12)
+    # the ST gradient of sum(one_hot + y - sg(y)) == grad of sum(softmax) == 0
+    # per row; more discriminating: weight rows differently
+    x2 = paddle.to_tensor(np.random.randn(3, 5).astype(np.float32),
+                          stop_gradient=False)
+    w = paddle.to_tensor(np.arange(5, dtype=np.float32))
+    y2 = F.gumbel_softmax(x2, temperature=1.0, hard=True)
+    (y2 * w).sum().backward()
+    assert np.abs(x2.grad.numpy()).sum() > 1e-6
+
+
+# ---------------------------------------------------------------- resize
+def test_resize_bilinear_matches_torch():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.vision.transforms import Resize
+
+    img = np.random.rand(3, 17, 23).astype(np.float32)
+    out = Resize((8, 12), interpolation="bilinear")(img)
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(img)[None], size=(8, 12), mode="bilinear",
+        align_corners=False,
+    )[0].numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_resize_bicubic_close_to_torch():
+    torch = pytest.importorskip("torch")
+    from paddle_tpu.vision.transforms import Resize
+
+    img = np.random.rand(3, 16, 16).astype(np.float32)
+    out = Resize((32, 32), interpolation="bicubic")(img)
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(img)[None], size=(32, 32), mode="bicubic",
+        align_corners=False,
+    )[0].numpy()
+    # torch uses a=-0.75 too; interior should match tightly
+    np.testing.assert_allclose(out[:, 4:-4, 4:-4], ref[:, 4:-4, 4:-4],
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_resize_int_size_matches_shorter_edge():
+    from paddle_tpu.vision.transforms import Resize
+
+    img = np.random.rand(3, 20, 40).astype(np.float32)
+    out = Resize(10)(img)
+    assert out.shape == (3, 10, 20)
+    out2 = Resize(10)(np.random.rand(3, 40, 20).astype(np.float32))
+    assert out2.shape == (3, 20, 10)
+
+
+def test_resize_nearest_and_uint8_roundtrip():
+    from paddle_tpu.vision.transforms import Resize
+
+    img = (np.random.rand(1, 8, 8) * 255).astype(np.uint8)
+    out = Resize((4, 4), interpolation="nearest")(img)
+    assert out.dtype == np.uint8 and out.shape == (1, 4, 4)
+    outb = Resize((16, 16), interpolation="bilinear")(img)
+    assert outb.dtype == np.uint8
+
+
+def test_normalize_to_rgb_flips_channels():
+    from paddle_tpu.vision.transforms import Normalize
+
+    img = np.stack([np.full((2, 2), 1.0), np.full((2, 2), 2.0),
+                    np.full((2, 2), 3.0)]).astype(np.float32)
+    out = Normalize(mean=[0, 0, 0], std=[1, 1, 1], to_rgb=True)(img)
+    assert out[0, 0, 0] == 3.0 and out[2, 0, 0] == 1.0
+
+
+# ---------------------------------------------------------------- executor cache
+def test_executor_cache_keyed_on_serial_not_id():
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        exe = static.Executor()
+        results, serials = [], []
+        for scale in (1.0, 3.0):
+            prog = static.Program()
+            with static.program_guard(prog):
+                x = static.data("x", [2, 2], "float32")
+                y = x * scale
+            out = exe.run(prog, feed={"x": np.ones((2, 2), np.float32)},
+                          fetch_list=[y])[0]
+            results.append(out[0, 0])
+            serials.append(prog._exec_serial)
+        # serials are process-unique (id() is not, after GC): distinct programs
+        # can never alias a cache entry even if their ids collide
+        assert serials[0] != serials[1]
+        assert {k[0] for k in exe._cache} == set(serials)
+        assert results[0] == 1.0 and results[1] == 3.0
+        # re-running the same program hits the existing entry (serial is stable)
+        assert len(exe._cache) == 2
+    finally:
+        paddle.disable_static()
+
+
+# ---------------------------------------------------------------- pallas gate
+def test_flash_gate_single_source():
+    from paddle_tpu.kernels.flash_attention import _block, supports_shape
+
+    # seq 640 passes %128 but NOT %block(640)=512 — must be gated out
+    assert not supports_shape((1, 8, 640, 64), (1, 8, 640, 64))
+    assert supports_shape((1, 8, 512, 64), (1, 8, 512, 64))
+    assert supports_shape((1, 8, 256, 128), (1, 8, 256, 128))
+    assert not supports_shape((1, 8, 512, 80), (1, 8, 512, 80))  # head_dim
+    assert not supports_shape((1, 8, 64, 64), (1, 8, 64, 64))  # too short
+    assert _block(640) == 512 and _block(256) == 256
+
+
+# ---------------------------------------------------------------- ADVICE items
+def test_flops_matches_reference_mac_convention():
+    from paddle_tpu import nn
+
+    net = nn.Linear(16, 8)
+    # reference count_linear: total_mul(=16*8) * out elements w/o batch? —
+    # convention: in*out MACs per row, no doubling
+    assert paddle.flops(net, [2, 16]) == 2 * 16 * 8
+
+
+def test_asp_prunes_conv_weights():
+    from paddle_tpu import incubate as inc
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(3)
+    m = nn.Sequential(nn.Conv2D(4, 8, 3), nn.Flatten(), nn.Linear(8 * 6 * 6, 4))
+    asp.prune_model(m)
+    conv_w = m.sublayers()[0].weight.numpy()
+    # conv weight [8, 4, 3, 3] is pruned via the flattened 2-D path
+    assert asp.calculate_density(conv_w) == pytest.approx(0.5, abs=0.02)
+    asp.reset_excluded_layers()
+
+
+def test_sparse_maxpool_keeps_negative_stored_values():
+    from paddle_tpu import sparse as sp
+
+    d = np.zeros((1, 2, 2, 2, 1), np.float32)
+    d[0, 0, 0, 0, 0] = -3.0  # all stored values in the window are negative
+    idx = np.stack(np.nonzero(d != 0))
+    x = sp.sparse_coo_tensor(idx, d[d != 0], d.shape)
+    y = sp.MaxPool3D(2)(x)
+    vals = np.asarray(y.values().numpy())
+    # max over stored support only: -3.0, NOT 0 from implicit zeros
+    assert y.nnz() == 1 and vals[0] == -3.0
+
+
+def test_lookahead_first_sync_pulls_toward_initial_weights():
+    from paddle_tpu import nn
+    from paddle_tpu.incubate import LookAhead
+
+    paddle.seed(5)
+    m = nn.Linear(4, 4)
+    w0 = np.asarray(m.weight._value).copy()
+    inner = paddle.optimizer.SGD(learning_rate=0.5, parameters=m.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=1)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    m(x).sum().backward()  # dL/dW = 2 (batch of ones) -> fast = w0 - 1.0
+    opt.step()
+    w_after = np.asarray(m.weight._value)
+    # sync: w = slow0 + alpha*(fast - slow0) = w0 - 0.5. The old lazy init
+    # made the first sync a no-op and returned fast = w0 - 1.0.
+    np.testing.assert_allclose(w_after, w0 - 0.5, rtol=1e-5)
+
+
+def test_ema_constant_decay_without_thres_steps():
+    from paddle_tpu import static
+
+    lin = paddle.nn.Linear(2, 2)
+    ema = static.ExponentialMovingAverage(0.5)
+    w0 = np.asarray(lin.weight._value).copy()
+    ema.update(parameters=[lin.weight])  # shadow initialized to w0
+    lin.weight._value = lin.weight._value + 2.0
+    ema.update(parameters=[lin.weight])
+    # shadow = 0.5*w0 + 0.5*(w0+2) = w0 + 1 — the old warm-up ramp gave
+    # d=(1+1)/(10+1)=0.18 -> w0+1.63
+    ema.apply(need_restore=False)
+    np.testing.assert_allclose(np.asarray(lin.weight._value), w0 + 1.0,
+                               rtol=1e-5)
+    ema.restore()
+
+
+def test_ema_thres_steps_ramp():
+    from paddle_tpu import static
+
+    lin = paddle.nn.Linear(2, 2)
+    ema = static.ExponentialMovingAverage(0.999, thres_steps=0)
+    w0 = np.asarray(lin.weight._value).copy()
+    ema.update(parameters=[lin.weight])  # shadow initialized to w0
+    lin.weight._value = lin.weight._value + 1.0
+    ema.update(parameters=[lin.weight])
+    # d = min(0.999, (0+1)/(0+10)) = 0.1 -> shadow = 0.1*w0 + 0.9*(w0+1)
+    ema.apply(need_restore=False)
+    np.testing.assert_allclose(np.asarray(lin.weight._value), w0 + 0.9,
+                               rtol=1e-5)
+    ema.restore()
+
+
+def test_sdpa_composite_on_cpu_still_correct():
+    from paddle_tpu.kernels.attention import sdpa, sdpa_reference
+    import jax.numpy as jnp
+
+    q = jnp.asarray(np.random.randn(1, 2, 16, 8).astype(np.float32))
+    k = jnp.asarray(np.random.randn(1, 2, 16, 8).astype(np.float32))
+    v = jnp.asarray(np.random.randn(1, 2, 16, 8).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(sdpa(q, k, v, is_causal=True)),
+                               np.asarray(sdpa_reference(q, k, v, is_causal=True)),
+                               rtol=1e-5, atol=1e-5)
