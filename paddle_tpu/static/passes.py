@@ -101,6 +101,20 @@ def _use_counts(block):
     return counts
 
 
+def _cast_wrap(fn, src_dtype, dst_dtype):
+    """Wrap an op lowering so floating inputs of `src_dtype` are cast to
+    `dst_dtype` before the op runs — the one cast-policy closure shared by
+    every mixed-precision pass (static AMP O2, auto_parallel_amp/fp16)."""
+
+    def f(*ins):
+        cast = [a.astype(dst_dtype)
+                if hasattr(a, "dtype") and a.dtype == src_dtype else a
+                for a in ins]
+        return fn(*cast)
+
+    return f
+
+
 # -------------------------------------------------------------------- AMP O2
 _AMP_WHITELIST = {
     "matmul", "matmul_v2", "linear", "conv2d", "conv1d", "conv3d", "einsum",
@@ -129,32 +143,17 @@ class AMPO2Pass(PassBase):
         dtype = jnp.bfloat16 if self.attrs.get("dtype", "bfloat16") == \
             "bfloat16" else jnp.float16
 
-        def wrap(fn, mode):
-            if mode == "white":
-                def f(*ins):
-                    cast = [a.astype(dtype)
-                            if hasattr(a, "dtype") and a.dtype == jnp.float32
-                            else a for a in ins]
-                    return fn(*cast)
-                return f
-            # black: force fp32 for numerically-sensitive ops
-            def f(*ins):
-                cast = [a.astype(jnp.float32)
-                        if hasattr(a, "dtype") and a.dtype == dtype
-                        else a for a in ins]
-                return fn(*cast)
-            return f
-
         for block in main_program.blocks:
             for op in block.ops:
                 if "amp" in op.attrs:
                     continue  # idempotent: the attr records the applied policy
                 base = op.type.split("/")[-1]
                 if base in _AMP_WHITELIST:
-                    op.fn = wrap(op.fn, "white")
+                    op.fn = _cast_wrap(op.fn, jnp.float32, dtype)
                     op.attrs["amp"] = "bf16"
                 elif base in _AMP_BLACKLIST:
-                    op.fn = wrap(op.fn, "black")
+                    # force fp32 for numerically-sensitive ops
+                    op.fn = _cast_wrap(op.fn, dtype, jnp.float32)
                     op.attrs["amp"] = "fp32"
         context.attrs["amp_dtype"] = jnp.dtype(dtype).name
 

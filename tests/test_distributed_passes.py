@@ -118,6 +118,188 @@ def test_sharding_pass_stage3_params():
     assert np.isfinite(l1) and l1 < l0
 
 
+def test_recompute_pass_tags_and_parity():
+    """Recompute must not change numerics — only the remat schedule."""
+    xv, yv = _data()
+    main_ref, loss_ref = _build_train_program()
+    exe = static.Executor()
+    ref = [float(exe.run(main_ref, feed={"x": xv, "label": yv},
+                         fetch_list=[loss_ref])[0]) for _ in range(3)]
+
+    main_rc, loss_rc = _build_train_program()
+    ctx = new_pass("auto_parallel_recompute", {"policy": "dots"}).apply(main_rc)
+    assert ctx.attrs["recompute"]["policy"] == "dots"
+    assert ctx.attrs["recompute"]["n_forward_ops"] > 0
+    fwd_ops = [op for b in main_rc.blocks for op in b.ops
+               if op.attrs.get("recompute")]
+    assert fwd_ops, "forward ops must be tagged"
+    exe2 = static.Executor()
+    rc = [float(exe2.run(main_rc, feed={"x": xv, "label": yv},
+                         fetch_list=[loss_rc])[0]) for _ in range(3)]
+    assert rc == pytest.approx(ref, rel=1e-6)
+
+
+def test_amp_o1_pass_program_diff_and_numerics():
+    xv, yv = _data()
+    main, loss = _build_train_program()
+    ref_main, ref_loss = _build_train_program()
+    ctx = new_pass("auto_parallel_amp").apply(main)
+    assert ctx.attrs["amp"] == {"level": "O1", "dtype": "bfloat16",
+                                "n_ops": ctx.attrs["amp"]["n_ops"]}
+    assert ctx.attrs["amp"]["n_ops"] > 0
+    tagged = [op.attrs["amp"] for b in main.blocks for op in b.ops
+              if "amp" in op.attrs]
+    assert "bfloat16" in tagged  # linear ops compute in bf16
+    exe, exe_ref = static.Executor(), static.Executor()
+    for _ in range(3):
+        l_amp = float(exe.run(main, feed={"x": xv, "label": yv},
+                              fetch_list=[loss])[0])
+        l_ref = float(exe_ref.run(ref_main, feed={"x": xv, "label": yv},
+                                  fetch_list=[ref_loss])[0])
+    # bf16 matmuls: close to fp32 but not bit-identical
+    assert l_amp == pytest.approx(l_ref, rel=0.05)
+    assert np.isfinite(l_amp)
+
+
+def test_fp16_pass_loss_scaling_protocol():
+    """fp16 O2: scale applied, update skipped on overflow, scale shrinks."""
+    xv, yv = _data()
+    main, loss = _build_train_program(opt_cls=paddle.optimizer.Adam)
+    new_pass("auto_parallel_fp16", {
+        "dtype": "float16", "init_loss_scaling": 1024.0,
+        "incr_every_n_steps": 2, "decr_every_n_nan_or_inf": 1,
+    }).apply(main)
+    assert main._loss_scaling["enabled"]
+    exe = static.Executor()
+    l0 = float(exe.run(main, feed={"x": xv, "label": yv},
+                       fetch_list=[loss])[0])
+    assert np.isfinite(l0)
+    scale0 = float(np.asarray(main._ls_ref["s"][0]))
+    assert scale0 == 1024.0  # one good step: not yet grown (incr_every=2)
+    exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])
+    assert float(np.asarray(main._ls_ref["s"][0])) == 2048.0  # grew after 2
+
+    # poison batch -> inf loss: update must be SKIPPED and scale halved
+    params_before = [np.asarray(p._value).copy()
+                     for p in main.captured_params() if not p.stop_gradient]
+    bad_x = np.full((8, 6), 1e30, np.float32)
+    l_bad = exe.run(main, feed={"x": bad_x, "label": yv},
+                    fetch_list=[loss])[0]
+    params_after = [np.asarray(p._value)
+                    for p in main.captured_params() if not p.stop_gradient]
+    for b, a in zip(params_before, params_after):
+        np.testing.assert_array_equal(b, a)
+    assert float(np.asarray(main._ls_ref["s"][0])) == 1024.0  # halved
+
+
+def test_bf16_fp16_pass_disables_scaling():
+    main, loss = _build_train_program()
+    new_pass("auto_parallel_fp16", {"dtype": "bfloat16"}).apply(main)
+    assert not main._loss_scaling["enabled"]  # bf16 needs no overflow guard
+    exe = static.Executor()
+    xv, yv = _data()
+    l = float(exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])[0])
+    assert np.isfinite(l)
+
+
+def test_fuse_all_reduce_pass_numeric_parity():
+    """Flat-bucket fused update must be numerically identical (Adam)."""
+    xv, yv = _data()
+    main_ref, loss_ref = _build_train_program(opt_cls=paddle.optimizer.Adam)
+    exe = static.Executor()
+    ref = [float(exe.run(main_ref, feed={"x": xv, "label": yv},
+                         fetch_list=[loss_ref])[0]) for _ in range(4)]
+
+    main_f, loss_f = _build_train_program(opt_cls=paddle.optimizer.Adam)
+    ctx = new_pass("fuse_all_reduce", {"size_mb": 32}).apply(main_f)
+    assert ctx.attrs["fuse_all_reduce"]["size_mb"] == 32
+    exe2 = static.Executor()
+    fused = [float(exe2.run(main_f, feed={"x": xv, "label": yv},
+                            fetch_list=[loss_f])[0]) for _ in range(4)]
+    assert fused == pytest.approx(ref, rel=1e-5)
+    # the optimizer state must actually live on flat buckets
+    assert main_f._fuse_plan is not None
+    slot_keys = list(main_f._opt_state_ref["s"]["slots"].keys())
+    assert all(k.startswith("bucket") for k in slot_keys), slot_keys
+    # 4 params (2 layers x w,b) packed into one 32MB bucket
+    assert len(main_f._fuse_plan["buckets"]) == 1
+
+
+def test_fuse_all_reduce_composes_with_gradient_merge():
+    xv, yv = _data()
+    main_ref, loss_ref = _build_train_program(opt_cls=paddle.optimizer.Adam)
+    new_pass("auto_parallel_gradient_merge", {"k_steps": 2}).apply(main_ref)
+    exe = static.Executor()
+    ref = [float(exe.run(main_ref, feed={"x": xv, "label": yv},
+                         fetch_list=[loss_ref])[0]) for _ in range(4)]
+
+    main_f, loss_f = _build_train_program(opt_cls=paddle.optimizer.Adam)
+    PassManager([
+        new_pass("auto_parallel_gradient_merge", {"k_steps": 2}),
+        new_pass("fuse_all_reduce", {"size_mb": 32}),
+    ]).apply(main_f)
+    exe2 = static.Executor()
+    fused = [float(exe2.run(main_f, feed={"x": xv, "label": yv},
+                            fetch_list=[loss_f])[0]) for _ in range(4)]
+    assert fused == pytest.approx(ref, rel=1e-5)
+
+
+def test_fuse_all_reduce_skips_non_elementwise_opt():
+    import warnings as _w
+
+    main, loss = _build_train_program(
+        opt_cls=lambda lr: paddle.optimizer.Lamb(learning_rate=lr))
+    new_pass("fuse_all_reduce", {"size_mb": 32}).apply(main)
+    exe = static.Executor()
+    xv, yv = _data()
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        l = float(exe.run(main, feed={"x": xv, "label": yv},
+                          fetch_list=[loss])[0])
+    assert np.isfinite(l)
+    assert main._fuse_plan is None  # Lamb trust ratio is per-param: unfused
+    assert any("not elementwise" in str(w.message) for w in rec)
+
+
+def test_apply_strategy_passes_routes_flags():
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.passes import apply_strategy_passes
+
+    strategy = DistributedStrategy()
+    strategy.amp = True
+    strategy.amp_configs = {"level": "O1", "dtype": "bfloat16"}
+    strategy.recompute = True
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    main, loss = _build_train_program()
+    ctx = apply_strategy_passes(main, strategy)
+    assert set(ctx.attrs["applied_passes"]) >= {
+        "auto_parallel_amp", "auto_parallel_recompute",
+        "auto_parallel_gradient_merge", "fuse_all_reduce"}
+    exe = static.Executor()
+    xv, yv = _data()
+    l = float(exe.run(main, feed={"x": xv, "label": yv}, fetch_list=[loss])[0])
+    assert np.isfinite(l)
+
+
+def test_strategy_compiler_warns_on_unwired_flags():
+    import warnings as _w
+
+    from paddle_tpu.distributed.fleet import DistributedStrategy
+    from paddle_tpu.distributed.fleet.meta_optimizers import StrategyCompiler
+
+    strategy = DistributedStrategy()
+    strategy.fp16_allreduce = True
+    strategy.heter_ccl_mode = True
+    with _w.catch_warnings(record=True) as rec:
+        _w.simplefilter("always")
+        _, applied, disabled = StrategyCompiler().compile(strategy)
+    msgs = [str(w.message) for w in rec]
+    assert any("fp16_allreduce" in m for m in msgs)
+    assert any("heter_ccl_mode" in m for m in msgs)
+    assert "fp16_allreduce" in disabled and "heter_ccl_mode" in disabled
+
+
 def test_pass_manager_chains_and_amp_idempotent():
     mesh = Mesh(np.asarray(jax.devices()), ("sharding",))
     main, loss = _build_train_program()
