@@ -121,7 +121,15 @@ def test_save_load_nested(tmp_path):
 
 def test_bfloat16_save_load(tmp_path):
     t = paddle.to_tensor([1.5, 2.5], dtype="bfloat16")
-    path = str(tmp_path / "bf16.pd")
+    # state-dict path: bf16 upcasts to PORTABLE fp32 (real Paddle has no
+    # ml_dtypes; set_state_dict casts back to the param dtype on load)
+    path = str(tmp_path / "bf16.pdparams")
     paddle.save({"t": t}, path)
     back = paddle.load(path)
-    assert back["t"].dtype == "bfloat16"
+    assert back["t"].dtype == "float32"
+    np.testing.assert_array_equal(back["t"].numpy(), [1.5, 2.5])
+    # nested (private) path: exact dtype round-trip
+    path2 = str(tmp_path / "bf16.pd")
+    paddle.save([t], path2)
+    back2 = paddle.load(path2)
+    assert back2[0].dtype == "bfloat16"
