@@ -19,6 +19,16 @@ class Fleet:
         self._user_defined_strategy = DistributedStrategy()
         self._role = None
 
+    def reset(self):
+        """Drop all singleton state so init() can build a fresh topology —
+        the ONE reset used by tests/benches/dryruns (re-initialization with a
+        different hybrid config in the same process)."""
+        self._is_initialized = False
+        self._hcg = None
+        self._user_defined_strategy = DistributedStrategy()
+        self._role = None
+        return self
+
     # ------------------------------------------------------------ init
     def init(self, role_maker=None, is_collective=True, strategy=None):
         if strategy is not None:

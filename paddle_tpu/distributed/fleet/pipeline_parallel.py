@@ -10,10 +10,15 @@ jitted computations in 1F1B order and XLA's async dispatch overlaps stages acros
 device groups — explicit send/recv becomes a device_put between stage meshes
 (ICI transfer), exactly replacing send_v2/recv_v2.
 
-Backward uses per-stage VJP-with-recompute: the backward jit re-runs the stage
-forward from the saved input activation (activation recompute, reference D20
-semantics) — only boundary activations are kept live, giving 1F1B's memory
-profile without storing intermediate tensors.
+Backward modes (reference offers recompute as policy, not destiny — D20 +
+pp_utils/p2p_communication.py):
+- recompute=True (default): the backward jit re-runs the stage forward from
+  the saved input activation — only boundary activations stay live, 1F1B's
+  memory profile.
+- recompute=False (pipeline_configs["recompute"]): the forward runs under
+  jax.vjp and the residuals (intermediate activations) are stashed on device;
+  backward applies the stored vjp directly — no forward recompute, at the
+  cost of holding up to S in-flight microbatches' activations.
 """
 from __future__ import annotations
 
@@ -36,6 +41,11 @@ class PipelineParallel:
         self.num_stages = layers.num_stages
         self.accumulate_steps = strategy.pipeline_configs.get("accumulate_steps", 1)
         self.micro_batch_size = strategy.pipeline_configs.get("micro_batch_size", 1)
+        self.recompute = bool(strategy.pipeline_configs.get("recompute", True))
+        # ZeRO inside each pipeline stage: stage-3 shards the stage's params
+        # over the sub-mesh's 'sharding' axis (reference: pp + sharding hybrid)
+        self.zero_stage = int(strategy.sharding_configs.get("stage", 1)) \
+            if getattr(strategy, "sharding", False) else 0
         self._stage_fns = None
         self.training = True
         self._stage_meshes = self._build_stage_meshes()
@@ -78,6 +88,12 @@ class PipelineParallel:
             spec = tuple(x if (x is None or x in mesh.axis_names) else None
                          for x in p._sharding_spec)
             return NamedSharding(mesh, P(*spec))
+        if p is not None and self.zero_stage >= 3 \
+                and "sharding" in mesh.axis_names:
+            from .hybrid_train import _zero_spec
+
+            return NamedSharding(
+                mesh, _zero_spec(tuple(int(d) for d in p.shape), mesh))
         return NamedSharding(mesh, P())
 
     def _place_stage_params(self):
@@ -174,6 +190,7 @@ class PipelineParallel:
 
         # forward through stages, saving only boundary activations
         acts = [[None] * S for _ in range(m)]  # input activation per (mb, stage)
+        last_out = [None] * m  # last-stage OUTPUT (cotangent seed w/o loss_fn)
         losses = []
 
         # 1F1B ordering: warmup forwards then alternate; with host-issued async
@@ -186,32 +203,66 @@ class PipelineParallel:
             x = xs[mb]
             for s in range(S):
                 x = self._xfer(x, s)  # p2p: ICI transfer to stage s's devices
-                acts[mb][s] = x
-                if s == S - 1 and self._stage_fns[s]["fwd_loss"] is not None:
-                    loss = self._stage_fns[s]["fwd_loss"](
-                        stage_p[s], x, self._xfer(ys[mb], s), keys[mb][s]
-                    )
+                is_loss = s == S - 1 and self._stage_fns[s]["fwd_loss"] is not None
+                if self.recompute:
+                    acts[mb][s] = x
+                    if is_loss:
+                        losses.append(self._stage_fns[s]["fwd_loss"](
+                            stage_p[s], x, self._xfer(ys[mb], s), keys[mb][s]))
+                    else:
+                        x = self._stage_fns[s]["fwd"](stage_p[s], x, keys[mb][s])
+                        if s == S - 1:  # no loss_fn: backward seeds from the
+                            last_out[mb] = x  # OUTPUT's shape, not the input's
+                    continue
+                # non-recompute: run the forward under jax.vjp and stash the
+                # residuals (the stage's activations stay on device); backward
+                # applies the stored vjp with no forward re-run
+                if is_loss:
+                    y_s, k_s = self._xfer(ys[mb], s), keys[mb][s]
+                    loss, vjp = jax.vjp(
+                        lambda p, xx: self._stage_fns[s]["fwd_loss"](
+                            p, xx, y_s, k_s), stage_p[s], x)
                     losses.append(loss)
+                    acts[mb][s] = vjp
                 else:
-                    x = self._stage_fns[s]["fwd"](stage_p[s], x, keys[mb][s])
+                    k_s = keys[mb][s]
+                    x, vjp = jax.vjp(
+                        lambda p, xx, _s=s, _k=k_s: self._stage_fns[_s]["fwd"](
+                            p, xx, _k), stage_p[s], x)
+                    # last stage w/o loss_fn: keep the output so backward can
+                    # seed the cotangent with its shape
+                    acts[mb][s] = (vjp, x) if s == S - 1 else vjp
 
         def do_backward(mb):
             s = S - 1
-            if self._stage_fns[s]["bwd_loss"] is not None:
-                gp, gx = self._stage_fns[s]["bwd_loss"](
-                    stage_p[s], acts[mb][s], self._xfer(ys[mb], s), keys[mb][s]
-                )
-            else:
-                gp, gx = self._stage_fns[s]["bwd"](
-                    stage_p[s], acts[mb][s], keys[mb][s],
-                    jnp.ones_like(acts[mb][s])
-                )
-            _acc(grads_acc, s, gp)
-            for s in range(S - 2, -1, -1):
-                gx = self._xfer(gx, s)  # p2p backward
-                gp, gx = self._stage_fns[s]["bwd"](stage_p[s], acts[mb][s], keys[mb][s], gx)
+            if self.recompute:
+                if self._stage_fns[s]["bwd_loss"] is not None:
+                    gp, gx = self._stage_fns[s]["bwd_loss"](
+                        stage_p[s], acts[mb][s], self._xfer(ys[mb], s),
+                        keys[mb][s])
+                else:
+                    gp, gx = self._stage_fns[s]["bwd"](
+                        stage_p[s], acts[mb][s], keys[mb][s],
+                        jnp.ones_like(last_out[mb]))
                 _acc(grads_acc, s, gp)
+                for s in range(S - 2, -1, -1):
+                    gx = self._xfer(gx, s)  # p2p backward
+                    gp, gx = self._stage_fns[s]["bwd"](
+                        stage_p[s], acts[mb][s], keys[mb][s], gx)
+                    _acc(grads_acc, s, gp)
+            else:
+                if self._stage_fns[s]["fwd_loss"] is not None:
+                    gp, gx = acts[mb][s](jnp.ones((), jnp.float32))
+                else:
+                    vjp, out = acts[mb][s]
+                    gp, gx = vjp(jnp.ones_like(out))
+                _acc(grads_acc, s, gp)
+                for s in range(S - 2, -1, -1):
+                    gx = self._xfer(gx, s)
+                    gp, gx = acts[mb][s](gx)
+                    _acc(grads_acc, s, gp)
             acts[mb] = [None] * S  # free
+            last_out[mb] = None
 
         warmup = min(S - 1, m)
         for mb in range(warmup):

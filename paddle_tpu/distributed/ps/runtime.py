@@ -135,11 +135,21 @@ class DistEmbedding(Layer):
 
 class ThePS:
     """Worker-side coordinator: registers dense params + DistEmbeddings,
-    runs the async pull/push cycle (reference: TheOnePSRuntime)."""
+    runs the pull/push cycle (reference: TheOnePSRuntime).
 
-    def __init__(self, model: Layer, dense_optimizer="sgd", dense_lr=0.01):
+    mode="sync": step() pushes and pulls inline (a_sync off).
+    mode="async": step() only ENQUEUES grads into an AsyncCommunicator
+    (reference communicator.h) — a send thread merges and ships them, a
+    recv thread refreshes dense params; the trainer never blocks on the PS.
+    `barrier=False` lets a restarted worker (fault recovery) rejoin without
+    a rendezvous the surviving workers would never re-enter.
+    """
+
+    def __init__(self, model: Layer, dense_optimizer="sgd", dense_lr=0.01,
+                 mode="sync", barrier=True):
         self.model = model
         self.client = get_ps_client()
+        self.mode = mode
         self._dense: list[tuple[str, Tensor]] = []
         self._embeddings: list[DistEmbedding] = []
         for name, sub in [("", model)] + list(model.named_sublayers()):
@@ -151,8 +161,17 @@ class ThePS:
                                      dense_optimizer, dense_lr,
                                      init=p.numpy().reshape(-1)
                                      if self._is_owner() else None)
-        self.client.barrier()  # all tables exist before training
+        if barrier:
+            self.client.barrier()  # all tables exist before training
         self.pull_dense()
+        self._comm = None
+        if mode == "async":
+            from .communicator import AsyncCommunicator
+
+            self._comm = AsyncCommunicator(self.client)
+            for name, p in self._dense:
+                self._comm.register_dense(name, p)
+            self._comm.start()
 
     def _is_owner(self):
         return _get_role().is_first_worker()
@@ -166,7 +185,20 @@ class ThePS:
             p._value = jnp.asarray(vals.reshape(p.shape))
 
     def step(self):
-        """Push grads (sparse + dense), server applies, pull fresh dense."""
+        """Push grads (sparse + dense). sync: server applies + fresh pull
+        inline; async: enqueue only (communicator threads do the rest)."""
+        if self._comm is not None:
+            for emb in self._embeddings:
+                for ids, t in emb._lookups:
+                    if t.grad is not None:
+                        self._comm.push_sparse(emb.table_name, ids,
+                                               t.grad.numpy())
+                emb._lookups.clear()
+            for name, p in self._dense:
+                if p.grad is not None:
+                    self._comm.push_dense(name, p.grad.numpy().reshape(-1))
+            self.model.clear_gradients()
+            return
         for emb in self._embeddings:
             emb.push_grads()
         for name, p in self._dense:
@@ -175,6 +207,17 @@ class ThePS:
                                        apply_now=True)
         self.model.clear_gradients()
         self.pull_dense()
+
+    def flush(self):
+        """Drain the async send queue (no-op in sync mode)."""
+        if self._comm is not None:
+            self._comm.flush()
+            self.pull_dense()
+
+    def stop(self):
+        if self._comm is not None:
+            self._comm.stop()
+            self._comm = None
 
 
 class GeoSGD:

@@ -115,12 +115,26 @@ def _splash(q, k, v, sm_scale, interpret=False):
         return jax.vmap(kernel)(q, k, v)
 
 
-def flash_attention(q, k, v, causal=False, scale=None):
-    """q,k,v: [batch, heads, seq, head_dim]."""
+# auto-select threshold: causal tile-skipping halves attention work, but the
+# splash kernel's mask bookkeeping only wins once attention is a large FLOP
+# share — on-chip r3 A/B showed parity at seq 1024; the crossover sits at
+# longer context
+_SPLASH_AUTO_MIN_SEQ = 2048
+
+
+def _want_splash(causal: bool, s_q: int, s_k: int) -> bool:
     from ..utils.flags import flag
 
+    policy = flag("FLAGS_use_splash_attention", "auto")
+    if policy in (True, False):
+        return causal and policy is True
+    return causal and s_q == s_k and s_q >= _SPLASH_AUTO_MIN_SEQ
+
+
+def flash_attention(q, k, v, causal=False, scale=None):
+    """q,k,v: [batch, heads, seq, head_dim]."""
     sm_scale = float(scale) if scale is not None else 1.0 / (q.shape[-1] ** 0.5)
-    if causal and flag("FLAGS_use_splash_attention", False):
+    if _want_splash(causal, q.shape[2], k.shape[2]):
         try:
             return _splash(q, k, v, sm_scale).astype(q.dtype)
         except Exception as e:  # pragma: no cover — fall back to dense-block flash

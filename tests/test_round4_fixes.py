@@ -229,6 +229,23 @@ def test_ema_thres_steps_ramp():
     ema.restore()
 
 
+def test_splash_auto_select_policy():
+    from paddle_tpu.kernels.flash_attention import _want_splash
+    from paddle_tpu.utils import flags
+
+    try:
+        assert _want_splash(True, 4096, 4096) is True  # long causal: splash
+        assert _want_splash(True, 1024, 1024) is False  # measured even at 1k
+        assert _want_splash(False, 8192, 8192) is False  # non-causal: dense
+        assert _want_splash(True, 4096, 2048) is False  # cross-attn: dense
+        flags.set_flags({"FLAGS_use_splash_attention": True})
+        assert _want_splash(True, 512, 512) is True  # explicit force wins
+        flags.set_flags({"FLAGS_use_splash_attention": False})
+        assert _want_splash(True, 8192, 8192) is False
+    finally:
+        flags.set_flags({"FLAGS_use_splash_attention": "auto"})
+
+
 def test_sdpa_composite_on_cpu_still_correct():
     from paddle_tpu.kernels.attention import sdpa, sdpa_reference
     import jax.numpy as jnp

@@ -1,0 +1,160 @@
+"""Pipeline (1F1B) measurement harness — VERDICT r3 item 6.
+
+Measures, at EQUAL global batch on the virtual 8-device CPU mesh (or real
+chips when run there):
+  - single-mesh GSPMD dp step time (the no-pipeline reference)
+  - 1F1B pp=2 step time, recompute and non-recompute backward
+  - measured bubble fraction vs the theoretical (S-1)/(m+S-1)
+
+The bubble is estimated from the microbatch scaling law: with m microbatches
+a perfectly-overlapped pipeline costs t_mb * (m + S - 1) while the work is
+t_mb * m, so  bubble = 1 - t(m)/t(m_large) * scaling.  Here we take the
+direct definition instead: run with m and with 2m at the same micro size;
+ideal work doubles, so   bubble(m) = 1 - (t_2m - t_m) * m / (t_m * m)
+simplifies to measuring how much of t_m is fixed overhead.
+
+Usage: python tools/pipeline_bench.py [--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu" if os.environ.get("PIPE_BENCH_CPU", "1") == "1" \
+    else os.environ.get("JAX_PLATFORMS", "")
+
+import jax  # noqa: E402
+
+if os.environ.get("PIPE_BENCH_CPU", "1") == "1":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM, build_gpt_pipeline  # noqa: E402
+
+
+def _cfg():
+    return GPTConfig(vocab_size=512, hidden_size=128, num_layers=4,
+                     num_heads=4, max_seq_len=128, dropout=0.0)
+
+
+def _reset_fleet():
+    from paddle_tpu.distributed.fleet.fleet_base import fleet as f
+
+    return f.reset()
+
+
+def _time(fn, warmup=2, iters=5):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def bench_gspmd(global_batch, seq):
+    from paddle_tpu import nn
+
+    f = _reset_fleet()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1}
+    f.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = GPTForCausalLM(_cfg())
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    dmodel = f.distributed_model(model)
+    dopt = f.distributed_optimizer(opt)
+
+    def loss_fn(logits, labels):
+        return nn.functional.cross_entropy(
+            logits.reshape([-1, 512]), labels.reshape([-1]))
+
+    ids = np.random.randint(0, 511, (global_batch, seq)).astype(np.int64)
+    lab = np.random.randint(0, 511, (global_batch, seq)).astype(np.int64)
+
+    def step():
+        loss = dmodel.train_batch([ids, lab], dopt, loss_fn=loss_fn)
+        float(loss.numpy())
+
+    return _time(step)
+
+
+def bench_pipeline(global_batch, seq, accumulate_steps, recompute):
+    f = _reset_fleet()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1, "pp_degree": 2,
+                               "sharding_degree": 1}
+    strategy.pipeline = True
+    strategy.pipeline_configs = {
+        "accumulate_steps": accumulate_steps,
+        "micro_batch_size": global_batch // accumulate_steps,
+        "recompute": recompute,
+    }
+    f.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    pipe = build_gpt_pipeline(_cfg(), num_stages=2)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=pipe.parameters())
+    dmodel = f.distributed_model(pipe)
+    dopt = f.distributed_optimizer(opt)
+    ids = np.random.randint(0, 511, (global_batch, seq)).astype(np.int64)
+    lab = np.random.randint(0, 511, (global_batch, seq)).astype(np.int64)
+
+    def step():
+        loss = dmodel.train_batch(
+            (paddle.to_tensor(ids), paddle.to_tensor(lab)), dopt)
+        float(loss.numpy())
+
+    return _time(step)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", action="store_true")
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=128)
+    args = parser.parse_args()
+    B, S = args.batch, args.seq
+    n_stages = 2
+
+    results = {"global_batch": B, "seq": S,
+               "platform": jax.devices()[0].platform}
+    results["gspmd_dp2_s"] = bench_gspmd(B, S)
+    for m in (2, 4):
+        for rc in (True, False):
+            key = f"pp2_m{m}_{'recompute' if rc else 'stash'}_s"
+            results[key] = bench_pipeline(B, S, m, rc)
+        results[f"pp2_m{m}_bubble_theoretical"] = round(
+            (n_stages - 1) / (m + n_stages - 1), 4)
+    # measured bubble estimate from the m-scaling: per-microbatch time at
+    # m=4 vs m=2 isolates the (S-1) fixed pipeline fill/drain cost
+    t2, t4 = results["pp2_m2_recompute_s"], results["pp2_m4_recompute_s"]
+    # t(m) ~ c*(m + S-1)  =>  c = (t4 - t2) / 2 ;  bubble(m) = c*(S-1)/t(m)
+    c = max((t4 - t2) / 2.0, 1e-9)
+    results["pp2_m2_bubble_measured"] = round(c * (n_stages - 1) / t2, 4)
+    results["pp2_m4_bubble_measured"] = round(c * (n_stages - 1) / t4, 4)
+    results["pipeline_vs_gspmd_m4"] = round(
+        results["gspmd_dp2_s"] / results["pp2_m4_recompute_s"], 3)
+    results["stash_vs_recompute_m4"] = round(
+        results["pp2_m4_recompute_s"] / results["pp2_m4_stash_s"], 3)
+
+    if args.json:
+        print(json.dumps(results))
+    else:
+        for k, v in results.items():
+            print(f"{k:36s} {v}")
+
+
+if __name__ == "__main__":
+    main()
