@@ -64,11 +64,19 @@ class Adam(Optimizer):
 
     def _apply_dense(self, p, g, slots, lr, step):
         g32 = g.astype(slots["moment1"].dtype)
-        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
-        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * (g32 * g32)
         step_f = jnp.asarray(step, jnp.float32)
         bc1 = 1 - self._beta1**step_f
         bc2 = 1 - self._beta2**step_f
+        from ..kernels.fused_optimizer import maybe_fused_adam
+
+        fused = maybe_fused_adam(p, g32, slots["moment1"], slots["moment2"],
+                                 lr, bc1, bc2, beta1=self._beta1,
+                                 beta2=self._beta2, eps=self._epsilon)
+        if fused is not None:  # one-pass pallas kernel (big f32 on TPU)
+            new_p, m, v = fused
+            return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+        m = self._beta1 * slots["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * slots["moment2"] + (1 - self._beta2) * (g32 * g32)
         m_hat = m / bc1
         v_hat = v / bc2
         new_p = p - (lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)).astype(p.dtype)

@@ -450,12 +450,19 @@ _ELEMENTWISE_OPT_NAMES = {"SGD", "Momentum", "Adam", "AdamW", "RMSProp",
                           "Adagrad", "Adadelta", "Adamax"}
 
 
+_BUCKET_TILE = 8192  # fused-optimizer kernel tile (kernels/fused_optimizer.py)
+
+
 def _pack_buckets(plan, arrays):
     out = {}
     for b, idxs in enumerate(plan["buckets"]):
-        out[f"bucket{b}"] = jnp.concatenate(
+        flat = jnp.concatenate(
             [jnp.ravel(arrays[i]) for i in idxs]) if len(idxs) > 1 \
             else jnp.ravel(arrays[idxs[0]])
+        pad = (-flat.size) % _BUCKET_TILE
+        if pad:  # tileable buckets let the pallas fused update fire zero-copy
+            flat = jnp.pad(flat, (0, pad))
+        out[f"bucket{b}"] = flat
     return out
 
 

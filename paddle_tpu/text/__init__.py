@@ -26,3 +26,14 @@ from .transformer_mt import (  # noqa: F401
     TransformerMTConfig,
     sinusoid_position_encoding,
 )
+from .tokenizer_ops import (  # noqa: F401
+    BertTokenizerLite,
+    FasterTokenizer,
+    faster_tokenizer,
+)
+from ..core.string_tensor import (  # noqa: F401
+    StringTensor,
+    VocabTensor,
+    to_map_tensor,
+    to_string_tensor,
+)

@@ -11,6 +11,9 @@ _FLAGS: dict[str, object] = {
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_use_pallas_kernels": True,
+    # fused one-pass Adam update kernel (kernels/fused_optimizer.py) for
+    # large f32 buffers on TPU
+    "FLAGS_use_fused_optimizer": True,
     # True/False force; "auto" picks splash for causal long-seq (>= 2048)
     # where skipping fully-masked KV tiles pays — at 1024 it measured even
     # with dense-block flash (round-3 on-chip A/B)

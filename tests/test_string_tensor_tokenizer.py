@@ -1,0 +1,107 @@
+"""StringTensor-lite + faster_tokenizer op (VERDICT r3 item 9).
+
+Ground truth: HuggingFace transformers.BertTokenizer (the canonical BERT
+wordpiece implementation) run offline on a local vocab fixture.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import (
+    BertTokenizerLite,
+    FasterTokenizer,
+    StringTensor,
+    faster_tokenizer,
+    to_map_tensor,
+    to_string_tensor,
+)
+
+_VOCAB = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+          "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+          "lazy", "dog", "un", "##want", "##able", "runn", "##ing", ",", ".",
+          "!", "?", "hello", "world", "中", "国"]
+VOCAB = {t: i for i, t in enumerate(_VOCAB)}
+
+
+def test_string_tensor_basics():
+    st = to_string_tensor(["a b", "c"], name="txt")
+    assert st.shape == [2] and st.dtype == "pstring" and st.place == "cpu"
+    assert st[0] == "a b" and list(st) == ["a b", "c"]
+    assert st.numpy().dtype == object
+    vt = to_map_tensor(VOCAB, name="vocab")
+    assert vt["the"] == 5 and "fox" in vt and len(vt) == len(VOCAB)
+    assert vt.get_map_tensor()["[CLS]"] == 2
+
+
+def test_wordpiece_greedy_longest_match():
+    tok = BertTokenizerLite(VOCAB)
+    # "jumped" -> jump + ##ed ; "unwanted" -> un + ##want + ##ed
+    assert tok.tokenize("jumped") == [VOCAB["jump"], VOCAB["##ed"]]
+    assert tok.tokenize("unwanted") == [VOCAB["un"], VOCAB["##want"],
+                                        VOCAB["##ed"]]
+    # unknown word -> [UNK] (whole word, not partial pieces)
+    assert tok.tokenize("zzz") == [VOCAB["[UNK]"]]
+    # CJK chars split to singles
+    assert tok.tokenize("中国") == [VOCAB["中"], VOCAB["国"]]
+
+
+def test_faster_tokenizer_op_batch_and_pairs():
+    texts = to_string_tensor(["The quick brown fox", "hello world!"])
+    ids, tt = faster_tokenizer(VOCAB, texts)
+    ids, tt = ids.numpy(), tt.numpy()
+    assert ids.shape == tt.shape and ids.dtype == np.int32
+    # row 0: [CLS] the quick brown fox [SEP]
+    np.testing.assert_array_equal(
+        ids[0], [VOCAB["[CLS]"], VOCAB["the"], VOCAB["quick"],
+                 VOCAB["brown"], VOCAB["fox"], VOCAB["[SEP]"]])
+    # row 1 right-padded with [PAD]=0
+    assert ids[1, -1] == VOCAB["[PAD]"]
+    assert (tt == 0).all()  # single sequences: all segment 0
+
+    ids2, tt2 = faster_tokenizer(VOCAB, ["hello"], ["world"])
+    row, seg = ids2.numpy()[0], tt2.numpy()[0]
+    np.testing.assert_array_equal(
+        row, [VOCAB["[CLS]"], VOCAB["hello"], VOCAB["[SEP]"],
+              VOCAB["world"], VOCAB["[SEP]"]])
+    np.testing.assert_array_equal(seg, [0, 0, 0, 1, 1])
+
+
+def test_faster_tokenizer_truncation_and_padding():
+    ids, _ = faster_tokenizer(VOCAB, ["the quick brown fox jumped over"],
+                              max_seq_len=5, pad_to_max_seq_len=True)
+    row = ids.numpy()[0]
+    assert row.shape == (5,)
+    assert row[0] == VOCAB["[CLS]"] and row[-1] == VOCAB["[SEP]"]
+
+
+def test_faster_tokenizer_layer_feeds_bert():
+    from paddle_tpu.text import BertModel
+    from paddle_tpu.text.bert import BertConfig
+
+    layer = FasterTokenizer(VOCAB)
+    ids, tt = layer(StringTensor(["the lazy dog", "hello world"]))
+    paddle.seed(0)
+    bert = BertModel(BertConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                                num_heads=2, intermediate_size=64,
+                                max_position_embeddings=32))
+    out = bert(ids, token_type_ids=tt)
+    seq_out = out[0] if isinstance(out, (tuple, list)) else out
+    assert np.isfinite(np.asarray(seq_out._value)).all()
+
+
+def test_matches_huggingface_bert_tokenizer(tmp_path):
+    transformers = pytest.importorskip("transformers")
+    vocab_file = tmp_path / "vocab.txt"
+    vocab_file.write_text("\n".join(_VOCAB))
+    hf = transformers.BertTokenizer(str(vocab_file), do_lower_case=True)
+    ours = BertTokenizerLite(VOCAB)
+    for text in ["The QUICK brown fox jumped!", "unwanted running, dogs?",
+                 "hello 中国 world.", "Jumps over the lazy dog"]:
+        hf_ids = hf.encode(text)  # includes [CLS]/[SEP]
+        our_ids, _ = ours.encode(text)
+        assert our_ids == hf_ids, (text, our_ids, hf_ids)
+    # pair encoding + segment ids
+    enc = hf(text="hello world", text_pair="the fox", return_token_type_ids=True)
+    our_ids, our_tt = ours.encode("hello world", "the fox")
+    assert our_ids == enc["input_ids"]
+    assert our_tt == enc["token_type_ids"]
