@@ -45,6 +45,11 @@ from .core import (  # noqa: F401
 )
 from .core.tape import is_grad_enabled  # noqa: F401
 from .core import memory  # noqa: F401 (allocator stats/flags surface)
+from .core.ragged import (  # noqa: F401
+    LoDTensor,
+    RaggedTensor,
+    create_lod_tensor,
+)
 
 # ---- functional op surface (paddle.* functions)
 from .tensor_ops import *  # noqa: F401,F403

@@ -1,0 +1,148 @@
+"""LoDTensor-lite — a ragged batch type bridging LoD metadata and padding.
+
+Reference analog: paddle/fluid/lod_tensor (LoD offsets riding on a dense
+buffer; python surface fluid.create_lod_tensor, Tensor.lod()/
+recursive_sequence_lengths()). TPU-native stance (SURVEY §3.3): XLA wants
+STATIC shapes, so variable-length data ultimately runs as padding + masks
+(io/bucketing.py). This type carries the raggedness EXPLICITLY — values
+concatenated along dim 0 plus per-level lengths — and converts losslessly
+to/from the padded form the compiled graphs consume, closing the LoD
+round-trip the reference expresses as offsets on every tensor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .tensor import Tensor
+
+__all__ = ["LoDTensor", "RaggedTensor", "create_lod_tensor"]
+
+
+def _lengths_to_offsets(lengths):
+    off = [0]
+    for n in lengths:
+        off.append(off[-1] + int(n))
+    return off
+
+
+class LoDTensor:
+    """Concatenated values + recursive sequence lengths (1 or 2 levels)."""
+
+    def __init__(self, values, recursive_seq_lens):
+        self._values = values if isinstance(values, Tensor) else Tensor(
+            np.asarray(values))
+        lens = [list(map(int, lvl)) for lvl in recursive_seq_lens]
+        if not 1 <= len(lens) <= 2:
+            raise ValueError(
+                f"supported LoD depth is 1 or 2, got {len(lens)} levels")
+        for lvl in lens:
+            if any(n < 0 for n in lvl):
+                raise ValueError(
+                    f"sequence lengths must be non-negative, got {lvl} "
+                    "(non-monotonic offsets passed to set_lod?)")
+        total = sum(lens[-1])
+        if total != self._values.shape[0]:
+            raise ValueError(
+                f"sum of innermost lengths {total} != values dim0 "
+                f"{self._values.shape[0]}")
+        if len(lens) == 2 and sum(lens[0]) != len(lens[1]):
+            raise ValueError(
+                f"level-0 lengths sum {sum(lens[0])} != number of level-1 "
+                f"sequences {len(lens[1])}")
+        self._lens = lens
+
+    # ------------------------------------------------------- reference API
+    def recursive_sequence_lengths(self):
+        return [list(lvl) for lvl in self._lens]
+
+    def lod(self):
+        """Offset form (reference Tensor.lod()): per level, cumulative."""
+        return [_lengths_to_offsets(lvl) for lvl in self._lens]
+
+    def set_lod(self, lod):
+        lens = [[lvl[i + 1] - lvl[i] for i in range(len(lvl) - 1)]
+                for lvl in lod]
+        self.__init__(self._values, lens)
+
+    def value(self):
+        return self._values
+
+    def numpy(self):
+        return self._values.numpy()
+
+    @property
+    def shape(self):
+        return self._values.shape
+
+    def __len__(self):
+        return len(self._lens[0])
+
+    def __getitem__(self, i):
+        """Sequence i at the OUTERMOST level, as a dense Tensor (or an
+        inner LoDTensor when 2-level)."""
+        n = len(self)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(f"sequence index {i - n if i < 0 else i} out of "
+                             f"range for {n} sequences")
+        if len(self._lens) == 1:
+            off = _lengths_to_offsets(self._lens[0])
+            return Tensor(self._values._value[off[i]:off[i + 1]])
+        outer = _lengths_to_offsets(self._lens[0])
+        inner_lens = self._lens[1][outer[i]:outer[i + 1]]
+        inner_off = _lengths_to_offsets(self._lens[1])
+        lo, hi = inner_off[outer[i]], inner_off[outer[i + 1]]
+        return LoDTensor(Tensor(self._values._value[lo:hi]), [inner_lens])
+
+    # ------------------------------------------------------- padding bridge
+    def to_padded(self, pad_value=0.0, maxlen=None):
+        """-> (padded [batch, maxlen, ...] Tensor, lengths int64 Tensor):
+        the static-shape form compiled graphs consume. Sibling converters
+        for other input layouts: static.nn.sequence_pad (list of rows),
+        io.bucketing.pad_to_bucket (batch ladders) — this one owns the
+        concatenated-values+LoD layout."""
+        lens = self._lens[-1]
+        if len(self._lens) == 2:
+            raise ValueError(
+                "to_padded flattens one level; index the outer level first")
+        vals = np.asarray(self._values.numpy())
+        width = int(maxlen) if maxlen is not None else \
+            (max(lens) if lens else 0)
+        out = np.full((len(lens), width) + vals.shape[1:], pad_value,
+                      vals.dtype)
+        off = _lengths_to_offsets(lens)
+        clamped = [min(n, width) for n in lens]  # a shorter maxlen TRUNCATES:
+        for i, n in enumerate(clamped):  # returned lengths must agree with
+            out[i, :n] = vals[off[i]:off[i] + n]  # what survived the pad
+        return Tensor(out), Tensor(np.asarray(clamped, np.int64))
+
+    @staticmethod
+    def from_padded(padded, lengths):
+        """Inverse of to_padded (reference sequence_unpad)."""
+        arr = np.asarray(padded.numpy() if isinstance(padded, Tensor)
+                         else padded)
+        lens = [int(x) for x in np.asarray(
+            lengths.numpy() if isinstance(lengths, Tensor) else lengths)]
+        parts = [arr[i, :n] for i, n in enumerate(lens)]
+        vals = np.concatenate(parts) if parts else \
+            np.zeros((0,) + arr.shape[2:], arr.dtype)
+        return LoDTensor(Tensor(vals), [lens])
+
+    def __repr__(self):
+        return (f"LoDTensor(shape={self.shape}, "
+                f"recursive_seq_lens={self._lens})")
+
+
+RaggedTensor = LoDTensor  # the TPU-native name
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference fluid.create_lod_tensor: data is a list of sequences, a
+    numpy array, or an existing LoDTensor."""
+    if isinstance(data, LoDTensor):
+        return LoDTensor(data.value(), recursive_seq_lens)
+    if isinstance(data, list) and data and not np.isscalar(data[0]):
+        flat = np.concatenate([np.asarray(d) for d in data])
+        return LoDTensor(Tensor(flat), recursive_seq_lens)
+    return LoDTensor(Tensor(np.asarray(data)), recursive_seq_lens)

@@ -366,7 +366,7 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
     if isinstance(x, Tensor):
         return x, Tensor(jnp.full((x.shape[0],), x.shape[1], jnp.int64))
     seqs = [_val(s) for s in x]
-    T = maxlen or max(s.shape[0] for s in seqs)
+    T = maxlen if maxlen is not None else max(s.shape[0] for s in seqs)
     pv = float(pad_value if not isinstance(pad_value, Tensor)
                else np.asarray(pad_value._value))
     out = jnp.stack([

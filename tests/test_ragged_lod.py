@@ -1,0 +1,104 @@
+"""LoDTensor-lite / RaggedTensor (SURVEY §2.1 #30 — the ragged type that
+closes the LoD round-trip; reference fluid/lod_tensor)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import LoDTensor, RaggedTensor, create_lod_tensor
+
+
+def test_create_and_reference_accessors():
+    # 3 sequences of lengths 2, 1, 3 over 2-d features
+    data = [np.full((2, 4), 1.0, np.float32),
+            np.full((1, 4), 2.0, np.float32),
+            np.full((3, 4), 3.0, np.float32)]
+    t = create_lod_tensor(data, [[2, 1, 3]])
+    assert t.shape == [6, 4] and len(t) == 3
+    assert t.recursive_sequence_lengths() == [[2, 1, 3]]
+    assert t.lod() == [[0, 2, 3, 6]]  # offset form, reference Tensor.lod()
+    np.testing.assert_array_equal(t[1].numpy(), np.full((1, 4), 2.0))
+    np.testing.assert_array_equal(t[2].numpy(), np.full((3, 4), 3.0))
+    assert RaggedTensor is LoDTensor
+
+
+def test_padded_round_trip():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(6, 3).astype(np.float32)
+    t = LoDTensor(paddle.to_tensor(vals), [[2, 1, 3]])
+    padded, lengths = t.to_padded(pad_value=-1.0)
+    assert padded.shape == [3, 3, 3]
+    np.testing.assert_array_equal(lengths.numpy(), [2, 1, 3])
+    p = padded.numpy()
+    np.testing.assert_array_equal(p[0, :2], vals[:2])
+    assert (p[0, 2] == -1.0).all() and (p[1, 1:] == -1.0).all()
+    back = LoDTensor.from_padded(padded, lengths)
+    np.testing.assert_array_equal(back.numpy(), vals)
+    assert back.recursive_sequence_lengths() == [[2, 1, 3]]
+
+
+def test_two_level_lod():
+    # 2 docs: doc0 has 2 sentences (lens 2,1), doc1 has 1 sentence (len 3)
+    vals = np.arange(6, dtype=np.float32).reshape(6, 1)
+    t = LoDTensor(paddle.to_tensor(vals), [[2, 1], [2, 1, 3]])
+    assert t.lod() == [[0, 2, 3], [0, 2, 3, 6]]
+    doc0 = t[0]
+    assert isinstance(doc0, LoDTensor)
+    assert doc0.recursive_sequence_lengths() == [[2, 1]]
+    np.testing.assert_array_equal(doc0.numpy(), vals[:3])
+    doc1 = t[1]
+    np.testing.assert_array_equal(doc1.numpy(), vals[3:])
+
+
+def test_set_lod_and_validation():
+    vals = np.zeros((6, 2), np.float32)
+    t = LoDTensor(paddle.to_tensor(vals), [[3, 3]])
+    t.set_lod([[0, 2, 6]])
+    assert t.recursive_sequence_lengths() == [[2, 4]]
+    with pytest.raises(ValueError, match="dim0"):
+        LoDTensor(paddle.to_tensor(vals), [[2, 2]])  # sums to 4 != 6
+    with pytest.raises(ValueError, match="level-0"):
+        LoDTensor(paddle.to_tensor(vals), [[3], [3, 3]])  # 3 != 2 seqs
+    with pytest.raises(ValueError, match="depth"):
+        LoDTensor(paddle.to_tensor(vals), [[6], [6], [6]])
+
+
+def test_negative_index_and_bounds():
+    vals = np.arange(12, dtype=np.float32).reshape(6, 2)
+    t = LoDTensor(paddle.to_tensor(vals), [[2, 1, 3]])
+    np.testing.assert_array_equal(t[-1].numpy(), vals[3:])  # last sequence
+    np.testing.assert_array_equal(t[-3].numpy(), t[0].numpy())
+    with pytest.raises(IndexError):
+        t[3]
+    with pytest.raises(IndexError):
+        t[-4]
+
+
+def test_negative_lengths_rejected():
+    vals = np.zeros((6, 2), np.float32)
+    with pytest.raises(ValueError, match="non-negative"):
+        LoDTensor(paddle.to_tensor(vals), [[-1, 7]])
+    t = LoDTensor(paddle.to_tensor(vals), [[3, 3]])
+    with pytest.raises(ValueError, match="non-negative"):
+        t.set_lod([[0, 4, 2, 6]])  # non-monotonic offsets
+
+
+def test_truncating_maxlen_returns_consistent_pair():
+    vals = np.arange(6, dtype=np.float32).reshape(6, 1)
+    t = LoDTensor(paddle.to_tensor(vals), [[2, 1, 3]])
+    padded, lengths = t.to_padded(maxlen=2)
+    assert padded.shape == [3, 2, 1]
+    np.testing.assert_array_equal(lengths.numpy(), [2, 1, 2])  # clamped
+    back = LoDTensor.from_padded(padded, lengths)  # must not raise
+    assert back.recursive_sequence_lengths() == [[2, 1, 2]]
+
+
+def test_padded_feeds_sequence_mask_pipeline():
+    """The intended TPU flow: ragged -> padded + lengths -> masked compute."""
+    import paddle_tpu.nn.functional as F
+
+    t = create_lod_tensor([np.ones((2, 4), np.float32),
+                           np.ones((5, 4), np.float32)], [[2, 5]])
+    padded, lengths = t.to_padded()
+    mask = F.sequence_mask(lengths, maxlen=5, dtype="float32")
+    s = (padded * paddle.unsqueeze(mask, -1)).sum()
+    assert float(s.numpy()) == pytest.approx(7 * 4)
