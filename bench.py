@@ -126,24 +126,26 @@ _BASE_RUNGS = [
 ]
 
 
-def _measure(rung: dict, steps: int, warmup: int) -> dict:
-    """Build the model per `rung`, run the timed loop, return the raw result."""
+def build_train_step(rung: dict):
+    """The exact per-step computation the bench times — model + AMP-O2
+    AdamW + fused chunked CE loss. Shared with tools/profile_bench.py so
+    the profiled computation can never drift from the benched one.
+
+    Returns dict(train_step, p_arrays, opt_state, cfg, n_params, model, opt).
+    """
     import jax
-    import jax.numpy as jnp
 
     import paddle_tpu as paddle
     from paddle_tpu.core import rng as rng_mod, tape as tape_mod
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
 
-    dev = jax.devices()[0]
     policy = rung["policy"]  # None=full remat, "dots"=save MXU outputs, "off"=no remat
     cfg = GPTConfig(vocab_size=rung.get("vocab", 50304), hidden_size=rung["hidden"],
                     num_layers=rung["layers"], num_heads=rung["heads"],
                     max_seq_len=rung.get("seq", 1024), dropout=0.0,
                     recompute=policy != "off", recompute_policy=None if policy == "off" else policy,
                     loss_chunk_size=int(os.environ.get("BENCH_LOSS_CHUNK", "2048")))
-    batch, seq = rung["batch"], rung.get("seq", 1024)
 
     paddle.seed(0)
     model = GPTForCausalLM(cfg)
@@ -171,6 +173,26 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
         loss, grads = jax.value_and_grad(loss_fn)(pvals, key, ids, labels)
         new_p, new_st = opt.functional_update(pvals, grads, opt_st, 1e-4)
         return loss, new_p, new_st
+
+    return dict(train_step=train_step, p_arrays=p_arrays, opt_state=opt_state,
+                cfg=cfg, n_params=n_params, model=model, opt=opt)
+
+
+def _measure(rung: dict, steps: int, warmup: int) -> dict:
+    """Build the model per `rung`, run the timed loop, return the raw result."""
+    import jax
+    import jax.numpy as jnp
+
+    # build FIRST: importing paddle_tpu applies the jax_platforms override
+    # (JAX_PLATFORMS=cpu children would otherwise hang in jax.devices() on a
+    # dead tunnel — the sitecustomize re-adds the axon plugin)
+    built = build_train_step(rung)
+    dev = jax.devices()[0]
+    train_step, cfg, n_params = (built["train_step"], built["cfg"],
+                                 built["n_params"])
+    p_arrays, opt_state = built["p_arrays"], built["opt_state"]
+    model, opt = built["model"], built["opt"]
+    batch, seq = rung["batch"], rung.get("seq", 1024)
 
     # steps fused per dispatch: amortizes host->device dispatch latency (the
     # tunnel RTT is charged once per call, so more inner steps -> less overhead)
@@ -225,7 +247,7 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
                    "remat": rung["policy"] or "full", "tag": rung["tag"]},
     }
     # free donated/current buffers before any subsequent attempt
-    del p_arrays, opt_state, model, opt, params, train_multi
+    del p_arrays, opt_state, model, opt, built, train_multi
     gc.collect()
     return result
 

@@ -1,0 +1,50 @@
+"""paddle.fluid compat namespace — the 1.x/fluid-style API real Paddle 2.3
+still ships and much ecosystem code still imports.
+
+Reference analog: python/paddle/fluid/__init__.py. Everything here is a
+THIN alias onto the first-class modules (static/, nn/, optimizer/, core/):
+no behavior lives in this package, so fluid-style scripts run against the
+same TPU execution paths as 2.x-style code. Coverage targets the surface
+migration guides lean on (fluid.data, fluid.layers.fc/embedding/...,
+fluid.optimizer.*Optimizer, fluid.dygraph, initializer/regularizer/io);
+exotic fluid corners raise AttributeError rather than pretending.
+"""
+from __future__ import annotations
+
+from .. import nn as _nn
+from ..core.place import CPUPlace, CUDAPinnedPlace, CUDAPlace  # noqa: F401
+from ..core.ragged import LoDTensor, create_lod_tensor  # noqa: F401
+from ..framework.io import load as _load, save as _save  # noqa: F401
+from ..static import (  # noqa: F401
+    CompiledProgram,
+    Executor,
+    Program,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    global_scope,
+    program_guard,
+    scope_guard,
+)
+
+ParamAttr = _nn.ParamAttr
+
+from . import dygraph  # noqa: E402,F401
+from . import initializer  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import layers  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def cuda_places(device_ids=None):
+    return []
+
+
+def cpu_places(device_count=1):
+    return [CPUPlace() for _ in range(device_count)]

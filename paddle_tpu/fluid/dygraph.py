@@ -1,0 +1,38 @@
+"""fluid.dygraph — 1.x imperative-mode aliases (reference fluid/dygraph/).
+
+Dygraph is this framework's default mode, so `guard()` only ensures static
+mode is off for its scope.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import paddle_tpu as paddle
+from ..nn import Layer  # noqa: F401
+from ..nn.layer import Layer as Layer_  # noqa: F401
+from ..distributed.parallel import DataParallel  # noqa: F401
+from ..jit import to_static as _to_static  # noqa: F401
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    return paddle.to_tensor(value, dtype=dtype)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    was_static = paddle.in_static_mode() if hasattr(
+        paddle, "in_static_mode") else False
+    if was_static:
+        paddle.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            paddle.enable_static()
+
+
+def enabled():
+    return True
+
+
+no_grad = paddle.no_grad

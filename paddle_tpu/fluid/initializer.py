@@ -1,0 +1,15 @@
+"""fluid.initializer — 1.x initializer aliases (reference
+fluid/initializer.py spellings over nn.initializer classes)."""
+from __future__ import annotations
+
+from ..nn import initializer as _init
+
+Constant = ConstantInitializer = _init.Constant
+Normal = NormalInitializer = _init.Normal
+TruncatedNormal = TruncatedNormalInitializer = _init.TruncatedNormal
+Uniform = UniformInitializer = _init.Uniform
+Xavier = XavierInitializer = _init.XavierNormal
+XavierUniform = _init.XavierUniform
+MSRA = MSRAInitializer = _init.KaimingNormal
+Bilinear = getattr(_init, "Bilinear", None)
+NumpyArrayInitializer = _init.Assign

@@ -1,0 +1,54 @@
+"""fluid.io — 1.x save/load surface (reference fluid/io.py) over the
+interop-capable framework.io and the StableHLO inference exporter."""
+from __future__ import annotations
+
+from ..framework.io import (  # noqa: F401
+    load,
+    load_binary_tensor,
+    load_binary_vars,
+    save,
+    save_binary_tensor,
+)
+from ..io import DataLoader  # noqa: F401
+from ..static.io import (  # noqa: F401
+    load_inference_model,
+    save_inference_model,
+)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """One combined binary file of every parameter (reference
+    fluid.io.save_params with `filename` -> the __params__ layout)."""
+    import os
+
+    from ..static.program import default_main_program
+
+    prog = main_program or default_main_program()
+    params = [p for p in prog.captured_params()]
+    os.makedirs(dirname, exist_ok=True)
+    if filename:
+        with open(os.path.join(dirname, filename), "wb") as f:
+            for p in params:
+                save_binary_tensor(f, p)
+    else:
+        for p in params:
+            save_binary_tensor(os.path.join(dirname, p.name or "param"), p)
+    return [p.name for p in params]
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    import os
+
+    from ..static.program import default_main_program
+
+    prog = main_program or default_main_program()
+    params = [p for p in prog.captured_params()]
+    if filename:
+        names = [p.name for p in params]
+        vals = load_binary_vars(os.path.join(dirname, filename), names)
+        for p in params:
+            p.set_value(vals[p.name])
+    else:
+        for p in params:
+            p.set_value(load_binary_tensor(
+                os.path.join(dirname, p.name or "param")))

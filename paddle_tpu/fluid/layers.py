@@ -1,0 +1,220 @@
+"""fluid.layers — 1.x layer-function aliases (reference fluid/layers/*).
+
+Ops keep their fluid argument spellings (dim/keep_dim, pool_type, act=...)
+and delegate to the 2.x lowerings.
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from .. import nn as _nn
+from ..nn import functional as F
+from ..static import data as _static_data
+from ..static.nn import (  # noqa: F401
+    batch_norm,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    crf_decoding,
+    embedding,
+    fc as _fc,
+    group_norm,
+    instance_norm,
+    layer_norm,
+    nce,
+    prelu,
+    row_conv,
+    sequence_concat,
+    sequence_conv,
+    sequence_enumerate,
+    sequence_expand,
+    sequence_expand_as,
+    sequence_first_step,
+    sequence_last_step,
+    sequence_pad,
+    sequence_pool,
+    sequence_reshape,
+    sequence_reverse,
+    sequence_slice,
+    sequence_softmax,
+    sequence_unpad,
+    sparse_embedding,
+)
+
+# direct re-exports where 1.x and 2.x agree
+concat = paddle.concat
+reshape = paddle.reshape
+transpose = paddle.transpose
+cast = paddle.cast
+assign = paddle.assign
+shape = paddle.shape
+zeros = paddle.zeros
+ones = paddle.ones
+relu = F.relu
+sigmoid = F.sigmoid
+tanh = paddle.tanh
+softmax = F.softmax
+softmax_with_cross_entropy = F.softmax_with_cross_entropy
+square = paddle.square
+sqrt = paddle.sqrt
+abs = paddle.abs  # noqa: A001 — fluid spelling
+log = paddle.log
+exp = paddle.exp
+clip = paddle.clip
+stack = paddle.stack
+gather = paddle.gather
+scatter = paddle.scatter
+one_hot = F.one_hot
+label_smooth = F.label_smooth
+sequence_mask = F.sequence_mask
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """fluid semantics: `input` is PROBABILITIES (softmax already applied)
+    and the result is the PER-EXAMPLE loss [N, 1] — not 2.x's
+    logits+mean-reduce (fluid/layers/loss.py cross_entropy)."""
+    out = F.cross_entropy(input, label, soft_label=soft_label,
+                          ignore_index=ignore_index, use_softmax=False,
+                          reduction="none")
+    return paddle.unsqueeze(out, -1) if len(out.shape) == 1 else out
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    """fluid semantics: default downgrade_in_infer — kept values UNSCALED
+    at train time, activations scaled by (1-p) at inference."""
+    if is_test:
+        if dropout_implementation == "downgrade_in_infer":
+            return x * (1.0 - dropout_prob)
+        return x
+    return F.dropout(x, p=dropout_prob, training=True,
+                     mode="upscale_in_train"
+                     if dropout_implementation == "upscale_in_train"
+                     else "downgrade_in_infer")
+
+
+def expand(x, expand_times, name=None):
+    """fluid expand == TILE by repeat counts (2.x renamed it paddle.tile;
+    paddle.expand broadcasts to a target shape — different op)."""
+    return paddle.tile(x, expand_times)
+
+
+def split(input, num_or_sections, dim=-1, name=None):
+    """fluid default splits the LAST dim and spells the axis `dim`."""
+    return paddle.split(input, num_or_sections, axis=dim)
+
+
+def data(name, shape, dtype="float32", append_batch_size=True, lod_level=0,
+         type=None, stop_gradient=True):
+    """fluid.layers.data: 1.x semantics prepend an implicit -1 batch dim
+    (fluid.data / 2.x static.data do NOT — that alias lives at the fluid
+    package root)."""
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    return _static_data(name, shape, dtype)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """fluid spelling (act=/param_attr=) over static.nn.fc."""
+    return _fc(input, size, num_flatten_dims=num_flatten_dims,
+               weight_attr=param_attr, bias_attr=bias_attr, activation=act,
+               name=name)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """reference mul_op: flatten x to 2-D at x_num_col_dims and y at
+    y_num_col_dims, matmul, restore x.shape[:xd] + y.shape[yd:]."""
+    import numpy as np
+
+    xs, ys = list(x.shape), list(y.shape)
+    xm = paddle.reshape(x, [int(np.prod(xs[:x_num_col_dims]) or 1),
+                            int(np.prod(xs[x_num_col_dims:]) or 1)])
+    ym = paddle.reshape(y, [int(np.prod(ys[:y_num_col_dims]) or 1),
+                            int(np.prod(ys[y_num_col_dims:]) or 1)])
+    out = paddle.matmul(xm, ym)
+    return paddle.reshape(out, xs[:x_num_col_dims] + ys[y_num_col_dims:])
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    out = paddle.matmul(x, y, transpose_x=transpose_x,
+                        transpose_y=transpose_y)
+    return out * alpha if alpha != 1.0 else out
+
+
+def mean(x, name=None):
+    return paddle.mean(x)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):
+    return paddle.mean(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):
+    return paddle.sum(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):
+    return paddle.max(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):
+    return paddle.min(input, axis=dim, keepdim=keep_dim)
+
+
+def _align_y(x, y, axis):
+    """fluid mid-axis broadcasting: y's dims align with x STARTING AT
+    `axis` (elementwise_op semantics) — append trailing 1-dims so numpy
+    broadcasting reproduces it."""
+    if axis == -1 or not hasattr(y, "shape"):
+        return y
+    trailing = len(x.shape) - axis - len(y.shape)
+    if trailing <= 0:
+        return y
+    return paddle.reshape(y, list(y.shape) + [1] * trailing)
+
+
+def elementwise_add(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.add(x, _align_y(x, y, axis)), act)
+
+
+def elementwise_sub(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.subtract(x, _align_y(x, y, axis)), act)
+
+
+def elementwise_mul(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.multiply(x, _align_y(x, y, axis)), act)
+
+
+def elementwise_div(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.divide(x, _align_y(x, y, axis)), act)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    return paddle.full(shape, value, dtype=dtype)
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None, data_format="NCHW"):
+    if global_pooling:
+        if pool_type == "max":
+            return F.adaptive_max_pool2d(input, 1)
+        return F.adaptive_avg_pool2d(input, 1)
+    if pool_type == "max":
+        return F.max_pool2d(input, pool_size, stride=pool_stride,
+                            padding=pool_padding, ceil_mode=ceil_mode)
+    return F.avg_pool2d(input, pool_size, stride=pool_stride,
+                        padding=pool_padding, ceil_mode=ceil_mode)
+
+
+def _maybe_act(out, act):
+    if act is None:
+        return out
+    return getattr(F, act)(out)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)

@@ -1,0 +1,112 @@
+"""fluid.optimizer — 1.x optimizer classes with their EXACT positional
+signatures (reference fluid/optimizer.py). 1.x code passes hyperparameters
+positionally (MomentumOptimizer(0.1, 0.9)), so each wrapper spells out its
+own parameter order; `regularization=` maps to weight_decay and
+`parameter_list=` to parameters."""
+from __future__ import annotations
+
+from ..optimizer.optimizers import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    RMSProp,
+)
+from ..static.extras import ExponentialMovingAverage  # noqa: F401
+
+
+def _wd(regularization):
+    if regularization is None:
+        return None
+    return getattr(regularization, "coeff", regularization)
+
+
+class SGDOptimizer(SGD):
+    def __init__(self, learning_rate=0.001, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters=parameter_list,
+                         weight_decay=_wd(regularization),
+                         grad_clip=grad_clip, name=name)
+
+
+class MomentumOptimizer(Momentum):
+    def __init__(self, learning_rate, momentum, parameter_list=None,
+                 use_nesterov=False, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, momentum=momentum,
+                         parameters=parameter_list,
+                         use_nesterov=use_nesterov,
+                         weight_decay=_wd(regularization),
+                         grad_clip=grad_clip, name=name)
+
+
+class AdamOptimizer(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameter_list=None, regularization=None,
+                 grad_clip=None, name=None, lazy_mode=False):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, parameters=parameter_list,
+                         weight_decay=_wd(regularization),
+                         grad_clip=grad_clip, lazy_mode=lazy_mode, name=name)
+
+
+class AdamaxOptimizer(Adamax):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameter_list=None, regularization=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, beta1=beta1, beta2=beta2,
+                         epsilon=epsilon, parameters=parameter_list,
+                         weight_decay=_wd(regularization),
+                         grad_clip=grad_clip, name=name)
+
+
+class AdagradOptimizer(Adagrad):
+    def __init__(self, learning_rate, epsilon=1e-6, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, epsilon=epsilon,
+                         parameters=parameter_list,
+                         weight_decay=_wd(regularization),
+                         grad_clip=grad_clip,
+                         initial_accumulator_value=initial_accumulator_value,
+                         name=name)
+
+
+class AdadeltaOptimizer(Adadelta):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 parameter_list=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, epsilon=epsilon, rho=rho,
+                         parameters=parameter_list,
+                         weight_decay=_wd(regularization),
+                         grad_clip=grad_clip, name=name)
+
+
+class RMSPropOptimizer(RMSProp):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameter_list=None, regularization=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, rho=rho, epsilon=epsilon,
+                         momentum=momentum, centered=centered,
+                         parameters=parameter_list,
+                         weight_decay=_wd(regularization),
+                         grad_clip=grad_clip, name=name)
+
+
+class LambOptimizer(Lamb):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None):
+        super().__init__(learning_rate,
+                         lamb_weight_decay=lamb_weight_decay, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon,
+                         parameters=parameter_list, grad_clip=grad_clip,
+                         name=name)
+        # reference Lamb applies `regularization` as L2-into-grad SEPARATELY
+        # from the decoupled lamb_weight_decay term
+        if regularization is not None:
+            self._weight_decay = _wd(regularization)

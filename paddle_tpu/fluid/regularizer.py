@@ -1,0 +1,7 @@
+"""fluid.regularizer — 1.x spellings (reference fluid/regularizer.py)."""
+from __future__ import annotations
+
+from ..regularizer import L1Decay, L2Decay  # noqa: F401
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

@@ -364,11 +364,23 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
     """List-of-rows -> (padded [B, T, ...], lengths [B]) (reference
     sequence_pad_op). Accepts a python list of arrays (the LoD analog)."""
     if isinstance(x, Tensor):
-        return x, Tensor(jnp.full((x.shape[0],), x.shape[1], jnp.int64))
+        xv, lens = x, x.shape[1]
+        if maxlen is not None and maxlen < x.shape[1]:
+            xv, lens = Tensor(x._value[:, :maxlen]), maxlen
+        elif maxlen is not None and maxlen > x.shape[1]:
+            pv = float(pad_value if not isinstance(pad_value, Tensor)
+                       else np.asarray(pad_value._value))
+            pads = [(0, 0), (0, maxlen - x.shape[1])] + \
+                [(0, 0)] * (len(x.shape) - 2)
+            xv = Tensor(jnp.pad(x._value, pads, constant_values=pv))
+        return xv, Tensor(jnp.full((x.shape[0],), lens, jnp.int64))
     seqs = [_val(s) for s in x]
     T = maxlen if maxlen is not None else max(s.shape[0] for s in seqs)
     pv = float(pad_value if not isinstance(pad_value, Tensor)
                else np.asarray(pad_value._value))
+    # a shorter maxlen TRUNCATES, and the returned lengths agree with what
+    # survived (same contract as core/ragged.LoDTensor.to_padded)
+    seqs = [s[:T] for s in seqs]
     out = jnp.stack([
         jnp.pad(s, [(0, T - s.shape[0])] + [(0, 0)] * (s.ndim - 1),
                 constant_values=pv) for s in seqs])

@@ -76,6 +76,7 @@ def unstack(x, axis=0, num=None, name=None):
 
 def split(x, num_or_sections, axis=0, name=None):
     axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
+    axis = axis % len(x.shape)  # negative axis: (slice,)*axis below needs >= 0
     dim = x.shape[axis]
     if isinstance(num_or_sections, int):
         sections = [dim // num_or_sections] * num_or_sections

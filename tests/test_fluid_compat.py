@@ -1,0 +1,169 @@
+"""paddle.fluid compat namespace (SURVEY §2.1 #12) — 1.x-style code runs
+against the TPU execution paths unchanged."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fluid
+
+
+@pytest.fixture()
+def _static():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_fluid_style_training_program(_static):
+    paddle.seed(3)
+    prog, sprog = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sprog):
+        x = fluid.data("x", [4, 8], "float32")
+        y = fluid.data("y", [4, 1], "int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        pred = fluid.layers.fc(h, 3)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.softmax_with_cross_entropy(pred, y))
+        fluid.optimizer.AdamOptimizer(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rs = np.random.RandomState(0)
+    feed = {"x": rs.rand(4, 8).astype(np.float32),
+            "y": rs.randint(0, 3, (4, 1))}
+    losses = [float(exe.run(prog, feed=feed, fetch_list=[loss])[0])
+              for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_fluid_layer_spellings():
+    a = paddle.to_tensor(np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    b = paddle.ones([2, 2])
+    np.testing.assert_allclose(
+        np.asarray(fluid.layers.elementwise_add(a, b, act="relu")._value),
+        np.asarray(a._value) + 1.0)
+    np.testing.assert_allclose(
+        float(fluid.layers.reduce_mean(a).numpy()), 2.5)
+    np.testing.assert_allclose(
+        np.asarray(fluid.layers.reduce_sum(a, dim=1, keep_dim=True)._value),
+        [[3.0], [7.0]])
+    img = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    np.testing.assert_allclose(
+        np.asarray(fluid.layers.pool2d(img, 2, "max", 2)._value),
+        [[[[5.0, 7.0], [13.0, 15.0]]]])
+    np.testing.assert_allclose(
+        float(fluid.layers.pool2d(img, global_pooling=True,
+                                  pool_type="avg").numpy().ravel()[0]), 7.5)
+    fc_out = fluid.layers.fill_constant([2, 2], "float32", 3.0)
+    np.testing.assert_allclose(np.asarray(fc_out._value), 3.0)
+
+
+def test_fluid_optimizer_regularization_maps_to_weight_decay():
+    m = paddle.nn.Linear(4, 4)
+    opt = fluid.optimizer.MomentumOptimizer(
+        learning_rate=0.1, momentum=0.9,
+        regularization=fluid.regularizer.L2DecayRegularizer(0.01),
+        parameter_list=m.parameters())
+    assert opt._weight_decay == pytest.approx(0.01)
+    x = paddle.ones([2, 4])
+    m(x).sum().backward()
+    opt.step()
+    opt.clear_grad()
+
+
+def test_fluid_initializer_aliases():
+    assert fluid.initializer.Xavier is fluid.initializer.XavierInitializer
+    w = paddle.nn.Linear(
+        4, 4, weight_attr=paddle.ParamAttr(
+            initializer=fluid.initializer.Constant(0.5)))
+    np.testing.assert_allclose(np.asarray(w.weight._value), 0.5)
+
+
+def test_fluid_io_save_load_params_combined(tmp_path, _static):
+    paddle.seed(5)
+    prog = fluid.Program()
+    with fluid.program_guard(prog):
+        x = fluid.data("x", [2, 4], "float32")
+        out = fluid.layers.fc(x, 3)
+    exe = fluid.Executor()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    before = exe.run(prog, feed=feed, fetch_list=[out])[0]
+    names = fluid.io.save_params(exe, str(tmp_path), main_program=prog,
+                                 filename="__params__")
+    assert (tmp_path / "__params__").exists() and names
+    # clobber, then reload
+    for p in prog.captured_params():
+        p.set_value(np.zeros(p.shape, np.float32))
+    fluid.io.load_params(exe, str(tmp_path), main_program=prog,
+                         filename="__params__")
+    after = exe.run(prog, feed=feed, fetch_list=[out])[0]
+    np.testing.assert_allclose(after, before, rtol=1e-6)
+
+
+def test_fluid_positional_optimizer_args():
+    """1.x code passes hyperparameters POSITIONALLY — they must land on the
+    right parameters, not on regularization/grad_clip."""
+    m = paddle.nn.Linear(2, 2)
+    opt = fluid.optimizer.MomentumOptimizer(0.1, 0.9,
+                                            parameter_list=m.parameters())
+    assert opt._momentum == pytest.approx(0.9)
+    assert opt._weight_decay in (None, 0.0)
+    opt2 = fluid.optimizer.AdamOptimizer(0.001, 0.9, 0.999, 1e-8,
+                                         parameter_list=m.parameters())
+    assert opt2._beta1 == 0.9 and opt2._beta2 == 0.999
+    assert opt2._weight_decay in (None, 0.0) and opt2._grad_clip is None
+
+
+def test_fluid_cross_entropy_takes_probabilities():
+    probs = paddle.to_tensor(np.asarray([[0.7, 0.2, 0.1],
+                                         [0.1, 0.8, 0.1]], np.float32))
+    label = paddle.to_tensor(np.asarray([[0], [1]], np.int64))
+    out = fluid.layers.cross_entropy(probs, label)
+    assert list(out.shape) == [2, 1]  # per-example, not reduced
+    np.testing.assert_allclose(out.numpy().ravel(),
+                               [-np.log(0.7), -np.log(0.8)], rtol=1e-5)
+
+
+def test_fluid_expand_is_tile_and_split_last_dim():
+    x = paddle.to_tensor(np.asarray([[1.0, 2.0, 3.0]], np.float32))
+    tiled = fluid.layers.expand(x, [2, 2])
+    assert list(tiled.shape) == [2, 6]  # tile, NOT broadcast-to-shape
+    a, b = fluid.layers.split(paddle.ones([4, 8]), 2)
+    assert list(a.shape) == [4, 4]  # fluid splits the LAST dim by default
+    c, d = fluid.layers.split(paddle.ones([4, 8]), 2, dim=0)
+    assert list(c.shape) == [2, 8]
+
+
+def test_fluid_dropout_downgrade_in_infer():
+    x = paddle.ones([1000])
+    # train: kept values stay UNSCALED (downgrade_in_infer default)
+    y = fluid.layers.dropout(x, 0.5)
+    vals = np.unique(np.asarray(y._value))
+    assert set(np.round(vals, 6)).issubset({0.0, 1.0})
+    # infer: activations scaled by (1-p)
+    z = fluid.layers.dropout(x, 0.5, is_test=True)
+    np.testing.assert_allclose(np.asarray(z._value), 0.5)
+
+
+def test_fluid_elementwise_mid_axis_broadcast():
+    x = paddle.ones([2, 3, 4, 5])
+    bias = paddle.to_tensor(np.arange(3, dtype=np.float32))
+    out = fluid.layers.elementwise_add(x, bias, axis=1)
+    assert list(out.shape) == [2, 3, 4, 5]
+    np.testing.assert_allclose(np.asarray(out._value)[0, 2], 3.0)
+
+
+def test_sequence_pad_truncating_maxlen(_static):
+    from paddle_tpu.static.nn import sequence_pad
+
+    padded, lens = sequence_pad(
+        [np.ones((5, 2), np.float32), np.ones((2, 2), np.float32)],
+        0.0, maxlen=3)
+    assert list(padded.shape) == [2, 3, 2]
+    np.testing.assert_array_equal(np.asarray(lens._value), [3, 2])
+
+
+def test_fluid_dygraph_guard_and_to_variable():
+    with fluid.dygraph.guard():
+        v = fluid.dygraph.to_variable(np.arange(4, dtype=np.float32))
+        assert v.shape == [4]
+        lin = paddle.nn.Linear(4, 2)
+        assert np.isfinite(np.asarray(lin(v)._value)).all()
