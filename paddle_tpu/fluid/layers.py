@@ -208,6 +208,61 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
                         padding=pool_padding, ceil_mode=ceil_mode)
 
 
+def flatten(x, axis=1, name=None):
+    """fluid flatten: ALWAYS 2-D — [prod(shape[:axis]), prod(shape[axis:])]
+    (2.x flatten(start_axis, stop_axis) is a different op)."""
+    import numpy as np
+
+    xs = list(x.shape)
+    # np.prod([]) == 1.0, and zero-size dims must stay 0 — no `or 1` fixups
+    return paddle.reshape(x, [int(np.prod(xs[:axis])),
+                              int(np.prod(xs[axis:]))])
+
+
+def topk(input, k, name=None):
+    return paddle.topk(input, k)  # last dim, values+indices (same in 1.x)
+
+
+def argmax(x, axis=0, name=None):
+    return paddle.argmax(x, axis=axis)  # 1.x default axis=0 (2.x flattens)
+
+
+def argmin(x, axis=0, name=None):
+    return paddle.argmin(x, axis=axis)
+
+
+def squeeze(input, axes, name=None):
+    # fluid: empty axes means squeeze EVERY size-1 dim
+    return paddle.squeeze(input, axis=axes if axes else None)
+
+
+def unsqueeze(input, axes, name=None):
+    return paddle.unsqueeze(input, axis=axes)
+
+
+def pad(x, paddings, pad_value=0.0, name=None):
+    """fluid pad: flat [before0, after0, before1, after1, ...] list."""
+    return paddle.nn.functional.pad(
+        x, paddings, value=pad_value, mode="constant")
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,  # noqa: A002
+                   name=None):
+    return paddle.uniform(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    if seed:  # seeded draws must be reproducible (paddle.normal has no seed)
+        import jax
+        import jax.numpy as jnp
+
+        arr = mean + std * jax.random.normal(
+            jax.random.key(seed), tuple(int(s) for s in shape))
+        return paddle.to_tensor(arr.astype(jnp.dtype(dtype)))
+    return paddle.normal(mean=mean, std=std, shape=shape).astype(dtype)
+
+
 def _maybe_act(out, act):
     if act is None:
         return out

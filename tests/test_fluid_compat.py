@@ -161,6 +161,23 @@ def test_sequence_pad_truncating_maxlen(_static):
     np.testing.assert_array_equal(np.asarray(lens._value), [3, 2])
 
 
+def test_fluid_layers_batch2_semantics():
+    x = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    # 1.x flatten is ALWAYS 2-D at `axis`
+    assert fluid.layers.flatten(x, 2).shape == [6, 4]
+    assert fluid.layers.flatten(x).shape == [2, 12]
+    v, i = fluid.layers.topk(x, 2)
+    assert v.shape == [2, 3, 2]
+    assert fluid.layers.argmax(x).shape == [3, 4]  # 1.x default axis=0
+    assert fluid.layers.squeeze(paddle.ones([1, 3, 1]), [0, 2]).shape == [3]
+    assert fluid.layers.unsqueeze(paddle.ones([3]), [0, 2]).shape == [1, 3, 1]
+    p = fluid.layers.pad(paddle.ones([2, 2]), [1, 1, 0, 0], 9.0)
+    assert p.shape == [4, 2]
+    assert float(np.asarray(p._value)[0, 0]) == 9.0
+    assert fluid.layers.uniform_random([2, 3]).shape == [2, 3]
+    assert fluid.layers.gaussian_random([4]).shape == [4]
+
+
 def test_fluid_dygraph_guard_and_to_variable():
     with fluid.dygraph.guard():
         v = fluid.dygraph.to_variable(np.arange(4, dtype=np.float32))
