@@ -166,6 +166,68 @@ void ps_sparse_push_grad(void* h, const int64_t* ids, int64_t n, const float* g,
   }
 }
 
+// assign exact row values [n, dim] for ids — snapshot restore
+// (brpc_ps_server Load analog): overwrites embeddings, resets accumulators
+void ps_sparse_assign(void* h, const int64_t* ids, int64_t n,
+                      const float* v) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    SparseShard& s = t->shards[static_cast<uint64_t>(ids[i]) % kSparseShards];
+    std::lock_guard<std::mutex> lk(s.mu);
+    SparseRow& row = t->FindOrInit(ids[i]);
+    std::memcpy(row.emb.data(), v + i * t->dim, t->dim * sizeof(float));
+    std::fill(row.adagrad.begin(), row.adagrad.end(), 0.0f);
+  }
+}
+
+// full-state restore: embeddings AND adagrad accumulators (checkpoint load
+// must resume the optimizer trajectory, not restart it)
+void ps_sparse_assign_state(void* h, const int64_t* ids, int64_t n,
+                            const float* emb, const float* acc) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    SparseShard& s = t->shards[static_cast<uint64_t>(ids[i]) % kSparseShards];
+    std::lock_guard<std::mutex> lk(s.mu);
+    SparseRow& row = t->FindOrInit(ids[i]);
+    std::memcpy(row.emb.data(), emb + i * t->dim, t->dim * sizeof(float));
+    std::memcpy(row.adagrad.data(), acc + i * t->dim,
+                t->dim * sizeof(float));
+  }
+}
+
+// full-state export: ids + embeddings + adagrad accumulators
+int64_t ps_sparse_export_state(void* h, int64_t* ids_out, float* emb_out,
+                               float* acc_out, int64_t cap) {
+  auto* t = static_cast<SparseTable*>(h);
+  int64_t w = 0;
+  for (auto& s : t->shards) {
+    std::lock_guard<std::mutex> lk(s.mu);
+    for (auto& kv : s.rows) {
+      if (w >= cap) return w;
+      ids_out[w] = kv.first;
+      std::memcpy(emb_out + w * t->dim, kv.second.emb.data(),
+                  t->dim * sizeof(float));
+      std::memcpy(acc_out + w * t->dim, kv.second.adagrad.data(),
+                  t->dim * sizeof(float));
+      ++w;
+    }
+  }
+  return w;
+}
+
+// dense accumulator state access (adagrad G sums) for checkpointing
+void ps_dense_read_acc(void* h, float* out, int64_t n) {
+  auto* t = static_cast<DenseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::memcpy(out, t->adagrad.data(), n * sizeof(float));
+}
+
+void ps_dense_assign_acc(void* h, const float* v, int64_t n) {
+  auto* t = static_cast<DenseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu);
+  std::memcpy(t->adagrad.data(), v, n * sizeof(float));
+}
+
 // erase rows by id; returns the number actually removed (the shrink
 // primitive behind CTR-accessor eviction — memory_sparse_table.cc Shrink).
 int64_t ps_sparse_erase(void* h, const int64_t* ids, int64_t n) {

@@ -142,8 +142,25 @@ class Fleet:
         return [], []
 
     # ------------------------------------------------------------ io
-    def save_persistables(self, executor=None, dirname=None, main_program=None, mode=0):
-        pass
+    def save_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        """PS mode: every server shard snapshots its tables to `dirname`
+        (reference fleet.save_persistables -> brpc Save RPC). A restarted
+        server recovers with load_persistables. Collective mode: use
+        paddle.save on the model's state_dict instead."""
+        from ..ps import runtime as ps_runtime
+
+        if dirname and ps_runtime._client is not None:
+            return ps_runtime.get_ps_client().save_tables(dirname)
+        return None
+
+    def load_persistables(self, executor=None, dirname=None,
+                          main_program=None, mode=0):
+        from ..ps import runtime as ps_runtime
+
+        if dirname and ps_runtime._client is not None:
+            return ps_runtime.get_ps_client().load_tables(dirname)
+        return None
 
     def save_inference_model(self, *a, **k):
         pass

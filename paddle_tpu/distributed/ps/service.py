@@ -18,7 +18,15 @@ import zlib
 
 import numpy as np
 
-from .tables import DenseTable, SparseTable
+from .tables import _OPT, DenseTable, SparseTable
+
+
+def _opt_name(code) -> str:
+    """optimizer int code -> registry name (snapshot restore re-creation)."""
+    for name, c in _OPT.items():
+        if c == code:
+            return name
+    return "sgd"
 
 
 def _send_msg(sock, obj):
@@ -115,9 +123,13 @@ class PsServer:
         if op == "create_dense":
             name, size, optimizer, lr = args
             with self._create_lock:  # concurrent workers race to create
-                if name not in self._dense:
+                created = name not in self._dense
+                if created:
                     self._dense[name] = DenseTable(size, optimizer, lr)
-            return None
+            # whether THIS call created it: a (re)joining worker must only
+            # write its init into a table that didn't exist — never clobber
+            # live/restored state (fault-recovery contract)
+            return created
         if op == "create_sparse":
             name, dim, optimizer, lr, seed = args
             with self._create_lock:
@@ -155,6 +167,71 @@ class PsServer:
         if op == "export_sparse":
             (name,) = args
             return self._sparse[name].export()
+        if op == "assign_sparse":
+            name, ids, values = args
+            self._sparse[name].assign_rows(ids, values)
+            return None
+        if op == "save_tables":
+            # snapshot EVERY table this shard owns to one file (reference
+            # brpc_ps_server Save RPC -> table->Save(dirname)). FULL state:
+            # weights AND optimizer accumulators AND init seeds, plus the
+            # sharding layout so a mismatched restore fails loudly.
+            path, shard_idx, n_shards = args
+            snap = {
+                "shard_idx": shard_idx, "n_shards": n_shards,
+                "dense": {n: {"values": t.read(), "acc": t.read_acc(),
+                              "optimizer": _opt_name(t.optimizer),
+                              "lr": t.lr, "epsilon": t.epsilon}
+                          for n, t in self._dense.items()},
+                "sparse": {},
+            }
+            for n, t in self._sparse.items():
+                ids, rows, acc = t.export_state()
+                snap["sparse"][n] = {
+                    "ids": ids, "rows": rows, "acc": acc, "dim": t.dim,
+                    "optimizer": _opt_name(t.optimizer), "lr": t.lr,
+                    "epsilon": t.epsilon, "seed": t.seed,
+                    "init_range": t.init_range,
+                }
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "wb") as f:
+                pickle.dump(snap, f, protocol=4)
+            return len(snap["dense"]) + len(snap["sparse"])
+        if op == "load_tables":
+            # restore (re-creating tables as needed): the Load RPC — a
+            # RESTARTED server recovers its authoritative state from disk
+            path, shard_idx, n_shards = args
+            with open(path, "rb") as f:
+                snap = pickle.load(f)
+            if snap.get("n_shards") != n_shards or \
+                    snap.get("shard_idx") != shard_idx:
+                # sparse rows are partitioned id % n_shards at SAVE time; a
+                # different cluster size would silently strand rows on
+                # servers the client never queries
+                raise ValueError(
+                    f"snapshot was saved as shard {snap.get('shard_idx')} of "
+                    f"{snap.get('n_shards')} but is being loaded as shard "
+                    f"{shard_idx} of {n_shards}; restore onto the same "
+                    "server count/order")
+            with self._create_lock:
+                for n, d in snap["dense"].items():
+                    if n not in self._dense:
+                        self._dense[n] = DenseTable(
+                            d["values"].size, d["optimizer"], d["lr"],
+                            epsilon=d.get("epsilon", 1e-6))
+                    self._dense[n].assign(d["values"])
+                    self._dense[n].assign_acc(d["acc"])
+                for n, d in snap["sparse"].items():
+                    if n not in self._sparse:
+                        self._sparse[n] = SparseTable(
+                            d["dim"], d["optimizer"], d["lr"],
+                            epsilon=d.get("epsilon", 1e-6),
+                            seed=d.get("seed", 0),
+                            init_range=d.get("init_range", 0.05))
+                    if d["ids"].size:
+                        self._sparse[n].assign_state(d["ids"], d["rows"],
+                                                     d["acc"])
+            return len(snap["dense"]) + len(snap["sparse"])
         if op == "barrier":
             return self._barrier()
         if op == "put_blob":
@@ -260,8 +337,9 @@ class PsClient:
     def create_dense(self, name, size, optimizer="sgd", lr=0.01,
                      init: np.ndarray | None = None):
         i = self._dense_home(name)
-        self._call(i, "create_dense", name, int(size), optimizer, float(lr))
-        if init is not None:
+        created = self._call(i, "create_dense", name, int(size), optimizer,
+                             float(lr))
+        if init is not None and created:
             self._call(i, "assign_dense", name, np.asarray(init, np.float32))
 
     def pull_dense(self, name) -> np.ndarray:
@@ -317,6 +395,30 @@ class PsClient:
 
     def take_blobs(self, key, server_idx=0):
         return self._call(server_idx, "take_blobs", key)
+
+    # ------------------------------------------------------------ snapshot
+    def save_tables(self, dirname: str) -> int:
+        """Each shard snapshots its FULL table state (weights + optimizer
+        accumulators + init seeds) to dirname/shard_<i>.snap (reference
+        fleet.save_persistables in PS mode)."""
+        results = self._fanout([
+            (i, ("save_tables", os.path.join(dirname, f"shard_{i}.snap"),
+                 i, self.n_servers))
+            for i in range(self.n_servers)])
+        return sum(results)
+
+    def load_tables(self, dirname: str) -> int:
+        results = self._fanout([
+            (i, ("load_tables", os.path.join(dirname, f"shard_{i}.snap"),
+                 i, self.n_servers))
+            for i in range(self.n_servers)])
+        return sum(results)
+
+    def assign_sparse(self, name, ids, values):
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        v = np.ascontiguousarray(values, np.float32).reshape(ids.size, -1)
+        self._fanout([(i, ("assign_sparse", name, ids[m], v[m]))
+                      for i, m in self._shard_masks(ids)])
 
     # ------------------------------------------------------------ control
     def barrier(self):

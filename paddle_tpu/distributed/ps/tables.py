@@ -58,6 +58,24 @@ class DenseTable:
             with self._mu:
                 self._data[:] = v
 
+    def read_acc(self) -> np.ndarray:
+        """Adagrad accumulator state (checkpointing)."""
+        if self._h:
+            out = np.empty(self.size, np.float32)
+            self._lib.ps_dense_read_acc(self._h, _f32p(out), self.size)
+            return out
+        with self._mu:
+            return self._g2.copy()
+
+    def assign_acc(self, values: np.ndarray):
+        v = np.ascontiguousarray(values, np.float32).reshape(-1)
+        assert v.size == self.size
+        if self._h:
+            self._lib.ps_dense_assign_acc(self._h, _f32p(v), self.size)
+        else:
+            with self._mu:
+                self._g2[:] = v
+
     def read(self) -> np.ndarray:
         out = np.empty(self.size, np.float32)
         if self._h:
@@ -109,6 +127,9 @@ class SparseTable:
         self.optimizer = _OPT[optimizer]
         self.lr = float(lr)
         self.epsilon = float(epsilon)
+        self.seed = int(seed)  # persisted in snapshots: lazy init of ids
+        self.init_range = float(init_range)  # first pulled AFTER a restore
+        # must match what the original table would have produced
         lib = _lib()
         if lib is not None:
             self._h = lib.ps_sparse_new(self.dim, seed, init_range)
@@ -161,6 +182,21 @@ class SparseTable:
         with self._mu:
             return len(self._rows)
 
+    def assign_rows(self, ids: np.ndarray, values: np.ndarray):
+        """Overwrite exact row values (snapshot restore — the Load side of
+        export(); accumulators reset)."""
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        values = np.ascontiguousarray(values, np.float32).reshape(
+            ids.size, self.dim)
+        if self._h:
+            self._lib.ps_sparse_assign(self._h, _i64p(ids), ids.size,
+                                       _f32p(values))
+            return
+        with self._mu:
+            for j, i in enumerate(ids):
+                self._rows[int(i)] = values[j].copy()
+                self._g2[int(i)] = np.zeros(self.dim, np.float32)
+
     def erase(self, ids: np.ndarray) -> int:
         """Remove rows by id; returns how many existed (native
         ps_sparse_erase — the shrink primitive)."""
@@ -175,17 +211,61 @@ class SparseTable:
             return n
 
     def export(self):
-        """(ids, rows) snapshot for checkpointing."""
+        """(ids, rows) snapshot for checkpointing. Retries while concurrent
+        pushes grow the table so a live-training snapshot is not silently
+        truncated (size() and the shard walk are not atomic)."""
         if self._h:
-            cap = self.size()
-            ids = np.empty(cap, np.int64)
-            emb = np.empty((cap, self.dim), np.float32)
-            n = int(self._lib.ps_sparse_export(self._h, _i64p(ids), _f32p(emb), cap))
-            return ids[:n], emb[:n]
+            for _ in range(5):
+                cap = self.size()
+                ids = np.empty(cap, np.int64)
+                emb = np.empty((max(cap, 1), self.dim), np.float32)
+                n = int(self._lib.ps_sparse_export(self._h, _i64p(ids),
+                                                   _f32p(emb), cap))
+                if self.size() == n:
+                    return ids[:n], emb[:n]
+            return ids[:n], emb[:n]  # table still growing: best effort
         with self._mu:
             ids = np.array(sorted(self._rows), np.int64)
             return ids, np.stack([self._rows[int(i)] for i in ids]) if ids.size \
                 else np.zeros((0, self.dim), np.float32)
+
+    def export_state(self):
+        """(ids, rows, accumulators): the FULL per-row state — checkpoint
+        restore resumes the optimizer trajectory instead of resetting it."""
+        if self._h:
+            for _ in range(5):
+                cap = self.size()
+                ids = np.empty(cap, np.int64)
+                emb = np.empty((max(cap, 1), self.dim), np.float32)
+                acc = np.empty((max(cap, 1), self.dim), np.float32)
+                n = int(self._lib.ps_sparse_export_state(
+                    self._h, _i64p(ids), _f32p(emb), _f32p(acc), cap))
+                if self.size() == n:
+                    break
+            return ids[:n], emb[:n], acc[:n]
+        with self._mu:
+            ids = np.array(sorted(self._rows), np.int64)
+            if not ids.size:
+                z = np.zeros((0, self.dim), np.float32)
+                return ids, z, z.copy()
+            return (ids, np.stack([self._rows[int(i)] for i in ids]),
+                    np.stack([self._g2[int(i)] for i in ids]))
+
+    def assign_state(self, ids, rows, acc):
+        """Inverse of export_state: exact embeddings AND accumulators."""
+        ids = np.ascontiguousarray(ids, np.int64).reshape(-1)
+        rows = np.ascontiguousarray(rows, np.float32).reshape(ids.size,
+                                                              self.dim)
+        acc = np.ascontiguousarray(acc, np.float32).reshape(ids.size,
+                                                            self.dim)
+        if self._h:
+            self._lib.ps_sparse_assign_state(self._h, _i64p(ids), ids.size,
+                                             _f32p(rows), _f32p(acc))
+            return
+        with self._mu:
+            for j, i in enumerate(ids):
+                self._rows[int(i)] = rows[j].copy()
+                self._g2[int(i)] = acc[j].copy()
 
     def __del__(self):  # noqa: D105
         try:

@@ -107,6 +107,14 @@ def _declare(l):
     l.ps_sparse_size.restype = ctypes.c_int64
     l.ps_sparse_size.argtypes = [ctypes.c_void_p]
     l.ps_sparse_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+    l.ps_sparse_assign.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64, f32p]
+    l.ps_sparse_assign_state.argtypes = [ctypes.c_void_p, i64p,
+                                         ctypes.c_int64, f32p, f32p]
+    l.ps_sparse_export_state.restype = ctypes.c_int64
+    l.ps_sparse_export_state.argtypes = [ctypes.c_void_p, i64p, f32p, f32p,
+                                         ctypes.c_int64]
+    l.ps_dense_read_acc.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
+    l.ps_dense_assign_acc.argtypes = [ctypes.c_void_p, f32p, ctypes.c_int64]
     l.ps_sparse_push_grad.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64,
                                       f32p, ctypes.c_int, ctypes.c_float,
                                       ctypes.c_float]

@@ -157,6 +157,108 @@ def test_the_ps_async_mode_converges(monkeypatch):
             s.stop()
 
 
+def test_rejoining_worker_init_cannot_clobber_live_tables():
+    """create_dense with init on an EXISTING table must not overwrite it —
+    a restarted first worker would otherwise reset trained state."""
+    servers, client, _ = _cluster()
+    try:
+        client.create_dense("w", 4, "sgd", 1.0, init=np.zeros(4, np.float32))
+        client.push_dense("w", np.ones(4, np.float32))  # w = -1 (trained)
+        # the same worker restarts and re-registers with a FRESH init
+        client.create_dense("w", 4, "sgd", 1.0, init=np.full(4, 7.0, np.float32))
+        np.testing.assert_allclose(client.pull_dense("w"), -1.0, rtol=1e-6)
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_server_snapshot_and_restart_recovery(tmp_path):
+    """Kill a SERVER, start a fresh one, load the snapshot: weights AND
+    optimizer accumulators AND lazy-init seeds recover — the
+    save_persistables/load_persistables fault path (reference brpc
+    Save/Load RPC). A parallel 'survivor' cluster that never died provides
+    the ground-truth trajectory."""
+    servers, client, eps = _cluster(n_servers=2)
+    ref_servers, ref_client, _ = _cluster(n_servers=2)  # never killed
+    snap_dir = str(tmp_path / "snap")
+    ids = np.asarray([1, 5, 9, 12])
+    g = np.tile(np.asarray([0.5, -1.0, 0.25, 2.0], np.float32), (4, 1))
+    try:
+        for c in (client, ref_client):
+            c.create_dense("w", 6, "adagrad", 0.1,
+                           init=np.arange(6, dtype=np.float32))
+            c.create_sparse("emb", 4, "adagrad", 0.05, seed=3)
+            c.pull_sparse("emb", ids)
+            c.push_sparse("emb", ids, g)  # builds adagrad G sums
+            c.push_dense("w", np.ones(6, np.float32))
+        n = client.save_tables(snap_dir)
+        assert n >= 3
+    finally:
+        client.stop_servers()
+        client.close()
+        for s in servers:
+            s.stop()
+
+    # fresh servers on NEW ports — nothing in memory
+    servers2 = [PsServer(port=0, n_workers=1, host="127.0.0.1").start()
+                for _ in range(2)]
+    client2 = PsClient([f"127.0.0.1:{s.port}" for s in servers2])
+    try:
+        client2.load_tables(snap_dir)
+        client2._sparse_dims["emb"] = 4  # client-side dim registry
+        np.testing.assert_array_equal(client2.pull_dense("w"),
+                                      ref_client.pull_dense("w"))
+        np.testing.assert_array_equal(client2.pull_sparse("emb", ids),
+                                      ref_client.pull_sparse("emb", ids))
+        # optimizer ACCUMULATORS recovered: the next adagrad step on the
+        # restored cluster matches the survivor exactly (G sums persisted —
+        # a reset would take a far larger step)
+        for c in (client2, ref_client):
+            c.push_sparse("emb", ids, g)
+            c.push_dense("w", np.ones(6, np.float32))
+        np.testing.assert_allclose(client2.pull_sparse("emb", ids),
+                                   ref_client.pull_sparse("emb", ids),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(client2.pull_dense("w"),
+                                   ref_client.pull_dense("w"), rtol=1e-6)
+        # lazy-init SEED recovered: an id never materialized before the
+        # snapshot initializes identically on both clusters
+        fresh = np.asarray([77])
+        np.testing.assert_array_equal(client2.pull_sparse("emb", fresh),
+                                      ref_client.pull_sparse("emb", fresh))
+    finally:
+        client2.stop_servers()
+        client2.close()
+        ref_client.stop_servers()
+        ref_client.close()
+        for s in servers2 + ref_servers:
+            s.stop()
+
+
+def test_snapshot_rejects_mismatched_server_count(tmp_path):
+    servers, client, _ = _cluster(n_servers=2)
+    snap_dir = str(tmp_path / "snap2")
+    try:
+        client.create_dense("w", 2, "sgd", 0.1, init=np.zeros(2, np.float32))
+        client.save_tables(snap_dir)
+    finally:
+        client.stop_servers()
+        client.close()
+        for s in servers:
+            s.stop()
+    one = [PsServer(port=0, n_workers=1, host="127.0.0.1").start()]
+    c1 = PsClient([f"127.0.0.1:{one[0].port}"])
+    try:
+        with pytest.raises(RuntimeError, match="shard"):
+            c1.load_tables(snap_dir)  # saved as 2 shards; loud, not silent
+    finally:
+        c1.stop_servers()
+        c1.close()
+        for s in one:
+            s.stop()
+
+
 # ------------------------------------------------------------ fault test
 _FAULT_WORKER = textwrap.dedent("""
     import json, os, sys
