@@ -115,7 +115,8 @@ class Predictor:
         from ..static.io import load_inference_model
 
         self.config = config
-        prog, feed_names, fetch_names = load_inference_model(config.prog_prefix)
+        prog, feed_names, fetch_names = load_inference_model(
+            config.prog_prefix, params_file=config.params_file)
         self._prog = prog
         self._inputs = {n: Tensor(n, s, d) for n, s, d in zip(
             feed_names, prog._meta["feed_shapes"], prog._meta["feed_dtypes"])}
@@ -157,7 +158,9 @@ class Predictor:
         b0 = None
         b_in = None
         for name, shape in zip(meta["feed_names"], meta["feed_shapes"]):
-            if shape and int(np.shape(feed[name])[0]) != int(shape[0]):
+            # dim0 < 0 (real pdmodel "-1" batch): any size runs directly
+            if shape and int(shape[0]) > 0 \
+                    and int(np.shape(feed[name])[0]) != int(shape[0]):
                 b0 = int(shape[0])
                 b_in = int(np.shape(feed[name])[0])
                 break

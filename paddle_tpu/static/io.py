@@ -122,9 +122,44 @@ class _LoadedInferenceProgram:
         return list(out) if isinstance(out, (tuple, list)) else [out]
 
 
+class _LoadedPdModelProgram:
+    """Executor-compatible view of a REAL Paddle ProgramDesc model."""
+
+    def __init__(self, prog):
+        self._prog = prog
+        self.feed_names = prog.feed_names
+        self.fetch_names = prog.fetch_names
+        # Predictor reads feed specs through _meta (same shape as the
+        # StableHLO loader's)
+        self._meta = {"feed_names": prog.feed_names,
+                      "feed_shapes": prog.feed_shapes,
+                      "feed_dtypes": prog.feed_dtypes,
+                      "fetch_names": prog.fetch_names}
+
+    def _exported_call(self, feed: dict):
+        clean = {}
+        for name in self.feed_names:
+            if name not in feed:
+                raise KeyError(f"missing feed {name!r}")
+            a = feed[name]
+            clean[name] = a.numpy() if isinstance(a, Tensor) else \
+                np.asarray(a)
+        return self._prog.run(clean)
+
+
 def load_inference_model(path_prefix, executor=None, **kwargs):
     """reference: python/paddle/static/io.py load_inference_model.
-    Returns (program-like, feed_names, fetch_names)."""
+    Returns (program-like, feed_names, fetch_names). Accepts BOTH this
+    framework's StableHLO export and a REAL PaddlePaddle
+    .pdmodel/.pdiparams pair (ProgramDesc protobuf — inference/pdmodel.py)."""
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        head = f.read(2)
+    if head[:1] != b"\x80":  # not a pickle: real ProgramDesc protobuf
+        from ..inference.pdmodel import load_pdmodel
+
+        prog = _LoadedPdModelProgram(load_pdmodel(
+            path_prefix, params_file=kwargs.get("params_file")))
+        return prog, prog.feed_names, prog.fetch_names
     with open(path_prefix + ".pdmodel", "rb") as f:
         meta = pickle.load(f)
     if meta.get("magic") not in (_MAGIC, "paddle_tpu.jit.v1"):
