@@ -248,10 +248,46 @@ def load(path, **configs):
 
     if os.path.exists(path + ".pdmodel"):
         with open(path + ".pdmodel", "rb") as f:
+            head = f.read(1)
+        if head != b"\x80":  # REAL Paddle ProgramDesc protobuf
+            from ..inference.pdmodel import load_pdmodel
+
+            return _PdModelLayer(load_pdmodel(
+                path, params_file=configs.get("params_filename")))
+        with open(path + ".pdmodel", "rb") as f:
             meta = pickle.load(f)
         if meta.get("magic") == "paddle_tpu.jit.v1":
             return TranslatedLayer(meta)
     return _load(path + ".pdparams")
+
+
+class _PdModelLayer:
+    """TranslatedLayer-shaped callable over a real .pdmodel (jit.load on a
+    model exported by real paddle.jit.save)."""
+
+    def __init__(self, prog):
+        self._prog = prog
+        self.training = False
+
+    def __call__(self, *inputs):
+        from ..core.tensor import Tensor
+
+        feed = {}
+        for name, x in zip(self._prog.feed_names, inputs):
+            feed[name] = x.numpy() if isinstance(x, Tensor) else x
+        outs = [Tensor(o) for o in self._prog.run(feed)]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    forward = __call__
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "a loaded .pdmodel is an inference program; training requires "
+            "the dygraph model + .pdparams (paddle.load)")
 
 
 def not_to_static(fn=None):

@@ -283,6 +283,20 @@ def test_predictor_serves_real_pdmodel(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_jit_load_serves_real_pdmodel(tmp_path):
+    prefix, p = _mlp_fixture(tmp_path)
+    layer = paddle.jit.load(prefix)
+    x = np.random.RandomState(6).rand(2, 8).astype(np.float32)
+    out = layer(paddle.to_tensor(x))
+    h = np.maximum(x @ p["fc1.w"] + p["fc1.b"], 0.0)
+    logits = h @ p["fc2.w"] + p["fc2.b"]
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    np.testing.assert_allclose(out.numpy(), e / e.sum(-1, keepdims=True),
+                               rtol=1e-5, atol=1e-6)
+    with pytest.raises(RuntimeError, match="inference program"):
+        layer.train()
+
+
 def test_static_io_load_inference_model_sniffs_pdmodel(tmp_path):
     """paddle.static.load_inference_model on a REAL-format model."""
     prefix, p = _mlp_fixture(tmp_path)
