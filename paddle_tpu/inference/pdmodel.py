@@ -503,7 +503,68 @@ def _make_op_map():
                                 if op["attrs"].get("dtype") is not None
                                 else 5))},
         "assign": _act(lambda x, a: x),
+        "elementwise_max": _elementwise(jnp.maximum),
+        "elementwise_min": _elementwise(jnp.minimum),
+        "pow": _act(lambda x, a: x ** _attr_or(a, "factor", 1.0)),
+        "clip": _act(lambda x, a: jnp.clip(x, a.get("min"), a.get("max"))),
+        "expand_v2": _act(lambda x, a: jnp.broadcast_to(
+            x, tuple(x.shape[i] if s == -1 else s
+                     for i, s in enumerate(a.get("shape"))))),
+        "tile": _act(lambda x, a: jnp.tile(x, tuple(a.get("repeat_times")))),
+        "fill_constant_batch_size_like": lambda env, op: {"Out": jnp.full(
+            (env[op["inputs"]["Input"][0]].shape[
+                _attr_or(op["attrs"], "input_dim_idx", 0)],)
+            + tuple(op["attrs"].get("shape")[1:]),
+            _attr_or(op["attrs"], "value", 0.0),
+            _np_dtype_for_proto(_attr_or(op["attrs"], "dtype", 5)))},
+        "nearest_interp_v2": _interp("nearest"),
+        "bilinear_interp_v2": _interp("linear"),
+        "equal": _elementwise(lambda x, y: x == y),
+        "not_equal": _elementwise(lambda x, y: x != y),
+        "greater_than": _elementwise(lambda x, y: x > y),
+        "less_than": _elementwise(lambda x, y: x < y),
+        "where": lambda env, op: {"Out": jnp.where(
+            env[op["inputs"]["Condition"][0]],
+            env[op["inputs"]["X"][0]], env[op["inputs"]["Y"][0]])},
+        "split": _split,
     }
+
+
+def _split(env, op):
+    import jax.numpy as jnp
+
+    x = env[op["inputs"]["X"][0]]
+    a = op["attrs"]
+    axis = _attr_or(a, "axis", 0)
+    n_out = len(op["outputs"]["Out"])
+    sections = a.get("sections") or []
+    if sections:
+        points = np.cumsum(sections[:-1]).tolist()
+        parts = jnp.split(x, points, axis=axis)
+    else:
+        parts = jnp.split(x, _attr_or(a, "num", n_out), axis=axis)
+    return {"Out": list(parts)}
+
+
+def _interp(method):
+    def run(env, op):
+        import jax
+
+        x = env[op["inputs"]["X"][0]]  # NCHW
+        a = op["attrs"]
+        if a.get("out_h") and a.get("out_h") > 0:
+            oh, ow = a["out_h"], a["out_w"]
+        else:
+            scale = a.get("scale") or []
+            s = scale[0] if isinstance(scale, (list, tuple)) and scale \
+                else (scale or 1.0)
+            oh, ow = int(x.shape[2] * s), int(x.shape[3] * s)
+        out = jax.image.resize(
+            x, (x.shape[0], x.shape[1], oh, ow),
+            method="nearest" if method == "nearest" else "linear")
+        return {"Out": out.astype(x.dtype)}
+
+    return run
 
 
 class PdModelProgram:
@@ -562,7 +623,12 @@ class PdModelProgram:
             outs = fn(env, op)
             for param, val in outs.items():
                 names = op["outputs"].get(param) or []
-                if names:
+                if not names:
+                    continue
+                if isinstance(val, list):  # multi-output params (split)
+                    for name, v in zip(names, val):
+                        env[name] = v
+                else:
                     env[names[0]] = val
         return [env[n] for n in self.fetch_names]
 

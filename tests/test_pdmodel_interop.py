@@ -283,6 +283,145 @@ def test_predictor_serves_real_pdmodel(tmp_path):
                                rtol=1e-5, atol=1e-6)
 
 
+def test_pdmodel_transformer_block_matches_numpy(tmp_path):
+    """A BERT-style self-attention block in the real op vocabulary
+    (lookup_table_v2, matmul_v2, reshape2/transpose2, scale, softmax,
+    elementwise_add residual, layer_norm, gelu) — the exported-transformer
+    op path end to end."""
+    rng = np.random.RandomState(7)
+    V, H, NH, HD, S = 32, 16, 2, 8, 6
+    emb = rng.randn(V, H).astype(np.float32) * 0.2
+    wq = rng.randn(H, H).astype(np.float32) * 0.2
+    wk = rng.randn(H, H).astype(np.float32) * 0.2
+    wv = rng.randn(H, H).astype(np.float32) * 0.2
+    wo = rng.randn(H, H).astype(np.float32) * 0.2
+    ln_s = rng.rand(H).astype(np.float32) + 0.5
+    ln_b = rng.randn(H).astype(np.float32) * 0.1
+
+    def mm(x, y):  # matmul_v2
+        return _op("matmul_v2", [("X", [x]), ("Y", [y])],
+                   [("Out", [f"_{x}_{y}"])]), f"_{x}_{y}"
+
+    vars_ = [_var("feed", [], False, vtype=9),
+             _var("fetch", [], False, vtype=10),
+             _var("ids", [-1, S], False, dtype_code=3),
+             _var("emb.w", [V, H], True), _var("wq", [H, H], True),
+             _var("wk", [H, H], True), _var("wv", [H, H], True),
+             _var("wo", [H, H], True), _var("ln.s", [H], True),
+             _var("ln.b", [H], True)]
+    names = set()
+
+    def v(name):
+        if name not in names:
+            names.add(name)
+            vars_.append(_var(name, [-1], False))
+        return name
+
+    ops = [_op("feed", [("X", ["feed"])], [("Out", ["ids"])],
+               [("col", 0, 0)]),
+           _op("lookup_table_v2", [("W", ["emb.w"]), ("Ids", ["ids"])],
+               [("Out", [v("x")])])]
+
+    def add_mm(x, y, out):
+        ops.append(_op("matmul_v2", [("X", [x]), ("Y", [y])],
+                       [("Out", [v(out)])]))
+
+    def add(op_type, ins, out, attrs=(), out_param="Out"):
+        ops.append(_op(op_type, ins, [(out_param, [v(out)])], attrs))
+
+    add_mm("x", "wq", "q")
+    add_mm("x", "wk", "k")
+    add_mm("x", "wv", "vv")
+    # [B,S,H] -> [B,S,NH,HD] -> [B,NH,S,HD]
+    for t in ("q", "k", "vv"):
+        add("reshape2", [("X", [t])], f"{t}_r",
+            [("shape", 3, [0, S, NH, HD])])
+        add("transpose2", [("X", [f"{t}_r"])], f"{t}_t",
+            [("axis", 3, [0, 2, 1, 3])])
+    add("transpose2", [("X", ["k_t"])], "k_tt",
+        [("axis", 3, [0, 1, 3, 2])])
+    add("matmul_v2", [("X", ["q_t"]), ("Y", ["k_tt"])], "logits")
+    add("scale", [("X", ["logits"])], "logits_s",
+        [("scale", 1, 1.0 / np.sqrt(HD)), ("bias", 1, 0.0)])
+    add("softmax", [("X", ["logits_s"])], "probs",
+        [("axis", 0, (1 << 64) - 1)])
+    add("matmul_v2", [("X", ["probs"]), ("Y", ["vv_t"])], "ctx")
+    add("transpose2", [("X", ["ctx"])], "ctx_t", [("axis", 3, [0, 2, 1, 3])])
+    add("reshape2", [("X", ["ctx_t"])], "ctx_r", [("shape", 3, [0, S, H])])
+    add_mm("ctx_r", "wo", "attn_out")
+    add("elementwise_add", [("X", ["x"]), ("Y", ["attn_out"])], "resid")
+    ops.append(_op("layer_norm",
+                   [("X", ["resid"]), ("Scale", ["ln.s"]),
+                    ("Bias", ["ln.b"])], [("Y", [v("normed")])],
+                   [("begin_norm_axis", 0, 2), ("epsilon", 1, 1e-5)]))
+    add("gelu", [("X", ["normed"])], "out", [("approximate", 6, False)])
+    ops.append(_op("fetch", [("X", ["out"])], [("Out", ["fetch"])],
+                   [("col", 0, 0)]))
+
+    prefix = str(tmp_path / "bertblock")
+    with open(prefix + ".pdmodel", "wb") as f:
+        f.write(_program(_block(vars_, ops)))
+    params = {"emb.w": emb, "wq": wq, "wk": wk, "wv": wv, "wo": wo,
+              "ln.s": ln_s, "ln.b": ln_b}
+    with open(prefix + ".pdiparams", "wb") as f:
+        for name in sorted(params):
+            save_binary_tensor(f, params[name])
+
+    from paddle_tpu.inference.pdmodel import load_pdmodel
+
+    prog = load_pdmodel(prefix)
+    ids = rng.randint(0, V, (2, S)).astype(np.int64)
+    (out,) = prog.run({"ids": ids})
+
+    # numpy reference
+    x = emb[ids]
+    q = (x @ wq).reshape(2, S, NH, HD).transpose(0, 2, 1, 3)
+    k = (x @ wk).reshape(2, S, NH, HD).transpose(0, 2, 1, 3)
+    vv = (x @ wv).reshape(2, S, NH, HD).transpose(0, 2, 1, 3)
+    logits = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(HD)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    ctx = (probs @ vv).transpose(0, 2, 1, 3).reshape(2, S, H)
+    resid = x + ctx @ wo
+    mu = resid.mean(-1, keepdims=True)
+    var = resid.var(-1, keepdims=True)
+    normed = (resid - mu) / np.sqrt(var + 1e-5) * ln_s + ln_b
+    from scipy.stats import norm as _norm  # exact gelu via erf
+    ref = normed * _norm.cdf(normed)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-5)
+
+
+def test_pdmodel_extended_ops(tmp_path):
+    """split (multi-output), expand_v2, interp resize, where/compare."""
+    rng = np.random.RandomState(9)
+    vars_ = [_var("feed", [], False, vtype=9),
+             _var("fetch", [], False, vtype=10),
+             _var("x", [-1, 4, 4, 4], False),
+             _var("s0", [-1], False), _var("s1", [-1], False),
+             _var("up", [-1], False), _var("out", [-1], False)]
+    ops = [
+        _op("feed", [("X", ["feed"])], [("Out", ["x"])], [("col", 0, 0)]),
+        _op("split", [("X", ["x"])], [("Out", ["s0", "s1"])],
+            [("axis", 0, 1), ("num", 0, 2)]),
+        _op("nearest_interp_v2", [("X", ["s0"])], [("Out", ["up"])],
+            [("out_h", 0, 8), ("out_w", 0, 8)]),
+        _op("reduce_mean", [("X", ["up"])], [("Out", ["out"])],
+            [("dim", 3, [1, 2, 3]), ("keep_dim", 6, False)]),
+        _op("fetch", [("X", ["out"])], [("Out", ["fetch"])], [("col", 0, 0)]),
+    ]
+    prefix = str(tmp_path / "ext")
+    with open(prefix + ".pdmodel", "wb") as f:
+        f.write(_program(_block(vars_, ops)))
+    from paddle_tpu.inference.pdmodel import load_pdmodel
+
+    prog = load_pdmodel(prefix)
+    x = rng.rand(2, 4, 4, 4).astype(np.float32)
+    (out,) = prog.run({"x": x})
+    # nearest 2x upsample of the first channel-half preserves the mean
+    np.testing.assert_allclose(np.asarray(out), x[:, :2].mean(axis=(1, 2, 3)),
+                               rtol=1e-5)
+
+
 def test_jit_load_serves_real_pdmodel(tmp_path):
     prefix, p = _mlp_fixture(tmp_path)
     layer = paddle.jit.load(prefix)
