@@ -18,3 +18,4 @@ from .ops import (  # noqa: F401
     softmax_mask_fuse_upper_triangle,
 )
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+from . import nn  # noqa: F401

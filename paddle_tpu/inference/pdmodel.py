@@ -507,16 +507,14 @@ def _make_op_map():
         "elementwise_min": _elementwise(jnp.minimum),
         "pow": _act(lambda x, a: x ** _attr_or(a, "factor", 1.0)),
         "clip": _act(lambda x, a: jnp.clip(x, a.get("min"), a.get("max"))),
+        # -1 entries copy from x, TRAILING-aligned (paddle broadcast rule)
         "expand_v2": _act(lambda x, a: jnp.broadcast_to(
-            x, tuple(x.shape[i] if s == -1 else s
-                     for i, s in enumerate(a.get("shape"))))),
+            x, tuple(
+                (x.shape[i - (len(a.get("shape")) - x.ndim)]
+                 if s == -1 else s)
+                for i, s in enumerate(a.get("shape"))))),
         "tile": _act(lambda x, a: jnp.tile(x, tuple(a.get("repeat_times")))),
-        "fill_constant_batch_size_like": lambda env, op: {"Out": jnp.full(
-            (env[op["inputs"]["Input"][0]].shape[
-                _attr_or(op["attrs"], "input_dim_idx", 0)],)
-            + tuple(op["attrs"].get("shape")[1:]),
-            _attr_or(op["attrs"], "value", 0.0),
-            _np_dtype_for_proto(_attr_or(op["attrs"], "dtype", 5)))},
+        "fill_constant_batch_size_like": _fill_constant_bsl,
         "nearest_interp_v2": _interp("nearest"),
         "bilinear_interp_v2": _interp("linear"),
         "equal": _elementwise(lambda x, y: x == y),
@@ -530,6 +528,19 @@ def _make_op_map():
     }
 
 
+def _fill_constant_bsl(env, op):
+    import jax.numpy as jnp
+
+    a = op["attrs"]
+    shape = list(a.get("shape"))
+    batch = env[op["inputs"]["Input"][0]].shape[
+        _attr_or(a, "input_dim_idx", 0)]
+    shape[_attr_or(a, "output_dim_idx", 0)] = batch
+    return {"Out": jnp.full(
+        tuple(shape), _attr_or(a, "value", 0.0),
+        _np_dtype_for_proto(_attr_or(a, "dtype", 5)))}
+
+
 def _split(env, op):
     import jax.numpy as jnp
 
@@ -537,8 +548,11 @@ def _split(env, op):
     a = op["attrs"]
     axis = _attr_or(a, "axis", 0)
     n_out = len(op["outputs"]["Out"])
-    sections = a.get("sections") or []
+    sections = list(a.get("sections") or [])
     if sections:
+        if -1 in sections:  # infer-remainder marker, any position
+            known = sum(s for s in sections if s >= 0)
+            sections[sections.index(-1)] = x.shape[axis] - known
         points = np.cumsum(sections[:-1]).tolist()
         parts = jnp.split(x, points, axis=axis)
     else:
@@ -555,10 +569,13 @@ def _interp(method):
         if a.get("out_h") and a.get("out_h") > 0:
             oh, ow = a["out_h"], a["out_w"]
         else:
-            scale = a.get("scale") or []
-            s = scale[0] if isinstance(scale, (list, tuple)) and scale \
-                else (scale or 1.0)
-            oh, ow = int(x.shape[2] * s), int(x.shape[3] * s)
+            scale = a.get("scale")
+            if isinstance(scale, (list, tuple)) and scale:
+                sh = scale[0]
+                sw = scale[1] if len(scale) > 1 else scale[0]
+            else:
+                sh = sw = scale or 1.0
+            oh, ow = int(x.shape[2] * sh), int(x.shape[3] * sw)
         out = jax.image.resize(
             x, (x.shape[0], x.shape[1], oh, ow),
             method="nearest" if method == "nearest" else "linear")

@@ -1,0 +1,152 @@
+"""incubate.nn fused transformer layers (reference incubate/nn/layer/
+fused_transformer.py) — validated against an INDEPENDENT composition of
+standard ops."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate
+from paddle_tpu.incubate.nn import (
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+from paddle_tpu.incubate.nn.functional import (
+    fused_feedforward,
+    fused_multi_head_attention,
+)
+
+
+def _ln(x, scale, bias, eps=1e-5):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + eps) * scale + bias
+
+
+def _ref_mha(x, wqkv, bqkv, wlin, blin, ln_s, ln_b, pre_s, pre_b,
+             pre_layer_norm, mask=None):
+    b, s, d = x.shape
+    _, n, h, _ = wqkv.shape
+    src = _ln(x, pre_s, pre_b) if pre_layer_norm else x
+    qkv = np.einsum("bsd,tnhd->tbnsh", src, wqkv) + bqkv[:, None, :, None, :]
+    q, k, v = qkv
+    logits = np.einsum("bnsh,bnth->bnst", q, k) / np.sqrt(h)
+    if mask is not None:
+        logits = logits + mask
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    ctx = np.einsum("bnst,bnth->bnsh", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(b, s, n * h)
+    out = x + (ctx @ wlin + blin)
+    return out if pre_layer_norm else _ln(out, ln_s, ln_b)
+
+
+@pytest.mark.parametrize("pre", [False, True])
+def test_fused_mha_matches_reference_composition(pre):
+    rng = np.random.RandomState(0)
+    d, n = 16, 2
+    x = rng.randn(2, 5, d).astype(np.float32)
+    wqkv = (rng.randn(3, n, d // n, d) * 0.2).astype(np.float32)
+    bqkv = (rng.randn(3, n, d // n) * 0.1).astype(np.float32)
+    wlin = (rng.randn(d, d) * 0.2).astype(np.float32)
+    blin = (rng.randn(d) * 0.1).astype(np.float32)
+    ln_s = rng.rand(d).astype(np.float32) + 0.5
+    ln_b = (rng.randn(d) * 0.1).astype(np.float32)
+    pre_s = rng.rand(d).astype(np.float32) + 0.5
+    pre_b = (rng.randn(d) * 0.1).astype(np.float32)
+
+    out = fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(wqkv), paddle.to_tensor(wlin),
+        pre_layer_norm=pre, pre_ln_scale=paddle.to_tensor(pre_s),
+        pre_ln_bias=paddle.to_tensor(pre_b), ln_scale=paddle.to_tensor(ln_s),
+        ln_bias=paddle.to_tensor(ln_b), qkv_bias=paddle.to_tensor(bqkv),
+        linear_bias=paddle.to_tensor(blin), dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False)
+    ref = _ref_mha(x, wqkv, bqkv, wlin, blin, ln_s, ln_b, pre_s, pre_b, pre)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_fused_mha_attn_mask():
+    rng = np.random.RandomState(1)
+    d, n, s = 8, 2, 4
+    x = rng.randn(1, s, d).astype(np.float32)
+    wqkv = (rng.randn(3, n, d // n, d) * 0.3).astype(np.float32)
+    wlin = np.eye(d, dtype=np.float32)
+    ln_s, ln_b = np.ones(d, np.float32), np.zeros(d, np.float32)
+    # causal additive mask [1, n, s, s]
+    mask = np.triu(np.full((s, s), -1e9, np.float32), 1)[None, None]
+    out = fused_multi_head_attention(
+        paddle.to_tensor(x), paddle.to_tensor(wqkv), paddle.to_tensor(wlin),
+        ln_scale=paddle.to_tensor(ln_s), ln_bias=paddle.to_tensor(ln_b),
+        attn_mask=paddle.to_tensor(mask), dropout_rate=0.0,
+        attn_dropout_rate=0.0, training=False)
+    ref = _ref_mha(x, wqkv, np.zeros((3, n, d // n), np.float32), wlin,
+                   np.zeros(d, np.float32), ln_s, ln_b, ln_s, ln_b, False,
+                   mask=mask)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=2e-4,
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("pre", [False, True])
+def test_fused_feedforward_matches_composition(pre):
+    rng = np.random.RandomState(2)
+    d, dff = 12, 24
+    x = rng.randn(2, 3, d).astype(np.float32)
+    w1 = (rng.randn(d, dff) * 0.3).astype(np.float32)
+    b1 = (rng.randn(dff) * 0.1).astype(np.float32)
+    w2 = (rng.randn(dff, d) * 0.3).astype(np.float32)
+    b2 = (rng.randn(d) * 0.1).astype(np.float32)
+    s1 = rng.rand(d).astype(np.float32) + 0.5
+    c1 = (rng.randn(d) * 0.1).astype(np.float32)
+    s2 = rng.rand(d).astype(np.float32) + 0.5
+    c2 = (rng.randn(d) * 0.1).astype(np.float32)
+    out = fused_feedforward(
+        paddle.to_tensor(x), paddle.to_tensor(w1), paddle.to_tensor(w2),
+        linear1_bias=paddle.to_tensor(b1), linear2_bias=paddle.to_tensor(b2),
+        ln1_scale=paddle.to_tensor(s1), ln1_bias=paddle.to_tensor(c1),
+        ln2_scale=paddle.to_tensor(s2), ln2_bias=paddle.to_tensor(c2),
+        dropout1_rate=0.0, dropout2_rate=0.0, activation="relu",
+        pre_layer_norm=pre, training=False)
+    src = _ln(x, s1, c1) if pre else x
+    mid = np.maximum(src @ w1 + b1, 0.0) @ w2 + b2
+    ref = x + mid if pre else _ln(x + mid, s2, c2)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_fused_encoder_layer_trains():
+    paddle.seed(4)
+    enc = FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=enc.parameters())
+    x = paddle.to_tensor(np.random.RandomState(3).randn(2, 6, 16)
+                         .astype(np.float32))
+    tgt = paddle.to_tensor(np.random.RandomState(4).randn(2, 6, 16)
+                           .astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = ((enc(x) - tgt) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+    assert len(list(enc.parameters())) == 16  # 8 MHA + 8 FFN
+
+
+def test_fused_multi_transformer_stack():
+    mt = FusedMultiTransformer(16, 2, 32, num_layers=3)
+    mt.eval()
+    x = paddle.to_tensor(np.random.RandomState(5).randn(2, 4, 16)
+                         .astype(np.float32))
+    out = mt(x)
+    assert out.shape == [2, 4, 16]
+    assert np.isfinite(np.asarray(out._value)).all()
+    assert len(list(mt.parameters())) == 36  # 12 groups x 3 layers
+
+
+def test_incubate_nn_all_matches_reference():
+    ref_all = {"FusedMultiHeadAttention", "FusedFeedForward",
+               "FusedTransformerEncoderLayer", "FusedMultiTransformer"}
+    assert ref_all <= set(dir(incubate.nn))
