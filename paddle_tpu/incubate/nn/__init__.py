@@ -63,14 +63,16 @@ class FusedMultiHeadAttention(Layer):
 
     def forward(self, query, key=None, value=None, attn_mask=None,
                 cache=None):
+        """With `cache` ([2, b, n, t, h] prefix k/v) returns
+        (out, new_cache) — generation decode, reference ditto."""
         return fused_multi_head_attention(
             query, self.qkv_weight, self.linear_weight,
             pre_layer_norm=self.normalize_before,
             pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
             ln_scale=self.ln_scale, ln_bias=self.ln_bias,
             pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
-            linear_bias=self.linear_bias, attn_mask=attn_mask,
-            dropout_rate=self.dropout_rate,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
             attn_dropout_rate=self.attn_dropout_rate,
             ln_epsilon=self._epsilon, training=self.training)
 
@@ -188,11 +190,15 @@ class FusedMultiTransformer(Layer):
                 setattr(self, f"_{group}_{i}", getattr(self, group)[i])
 
     def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        """caches: list of per-layer [2, b, n, t, h] tensors (pass [None]*L
+        or [] for prefill) -> returns (out, new_caches); None -> out only
+        (reference FusedMultiTransformer.forward)."""
         return fused_multi_transformer(
             src, self.ln_scales, self.ln_biases, self.qkv_weights,
             self.qkv_biases, self.linear_weights, self.linear_biases,
             self.ffn_ln_scales, self.ffn_ln_biases, self.ffn1_weights,
             self.ffn1_biases, self.ffn2_weights, self.ffn2_biases,
             pre_layer_norm=True, epsilon=self._epsilon, attn_mask=attn_mask,
+            cache_kvs=caches, time_step=time_step,
             dropout_rate=self._dropout_rate if self.training else 0.0,
             activation=self._activation, training=self.training)

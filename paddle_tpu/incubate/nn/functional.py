@@ -79,11 +79,6 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
     from ...kernels.attention import sdpa, sdpa_reference
 
-    if cache_kv is not None:
-        raise NotImplementedError(
-            "fused_multi_head_attention cache_kv (incremental decoding) is "
-            "not wired yet — use text.generation's KV-cache path; silently "
-            "recomputing without the cache would decode wrong tokens")
     x = _t(x)
     residual = x
     src = _maybe_ln(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon) \
@@ -91,7 +86,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
 
     def attn(xv, wqkv, *rest):
         i = 0
-        bqkv = wlin = blin = maskv = None
+        bqkv = wlin = blin = maskv = cachev = None
         if qkv_bias is not None:
             bqkv = rest[i]; i += 1  # noqa: E702
         wlin = rest[i]; i += 1  # noqa: E702
@@ -99,6 +94,8 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             blin = rest[i]; i += 1  # noqa: E702
         if attn_mask is not None:
             maskv = rest[i]; i += 1  # noqa: E702
+        if cache_kv is not None:
+            cachev = rest[i]; i += 1  # noqa: E702
         b, s, d = xv.shape
         three, n, h, _ = wqkv.shape
         # [b,s,d] x [3,n,h,d] -> [3,b,n,s,h]
@@ -106,6 +103,16 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         if bqkv is not None:
             qkv = qkv + bqkv[:, None, :, None, :]
         q, k, v = qkv[0], qkv[1], qkv[2]
+        if cachev is not None:
+            # generation: new tokens' k/v append to the cache [2,b,n,t,h];
+            # q attends over the whole prefix (reference cache_kv_out)
+            k = jnp.concatenate([cachev[0], k], axis=2)
+            v = jnp.concatenate([cachev[1], v], axis=2)
+        new_cache = jnp.stack([k, v]) if cachev is not None else None
+        # cached decode with no explicit mask: causality over prefix+new is
+        # bottom-right-aligned causal (sdpa's k = s_k - s_q offset) — a
+        # multi-token chunk must not attend forward within itself
+        causal = cachev is not None and maskv is None
         if attn_dropout_rate and training:
             # dropout INSIDE attention breaks the flash kernel's fusion:
             # run the composite core with explicit probs dropout
@@ -113,6 +120,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
             logits = jnp.einsum("bnsh,bnth->bnst", q, k) * scale
             if maskv is not None:
                 logits = logits + maskv.astype(logits.dtype)
+            if causal:
+                s_q, s_k = logits.shape[-2], logits.shape[-1]
+                tri = jnp.tril(jnp.ones((s_q, s_k), bool), k=s_k - s_q)
+                logits = jnp.where(tri, logits, -1e30)
             probs = jnp.asarray(
                 _dropout(Tensor(jnp.asarray(
                     jnp.exp(logits - jnp.max(logits, -1, keepdims=True))
@@ -122,12 +133,14 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
                     attn_dropout_rate, training, mode)._value)
             ctx = jnp.einsum("bnst,bnth->bnsh", probs, v)
         else:
-            ctx = sdpa(q, k, v, mask=maskv, is_causal=False) \
+            ctx = sdpa(q, k, v, mask=maskv, is_causal=causal) \
                 if maskv is None else sdpa_reference(q, k, v, mask=maskv)
         ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(b, s, n * h)
         out = ctx @ wlin
         if blin is not None:
             out = out + blin
+        if new_cache is not None:
+            return out, new_cache
         return out
 
     args = [src, _t(qkv_weight)]
@@ -138,11 +151,21 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         args.append(_t(linear_bias))
     if attn_mask is not None:
         args.append(_t(attn_mask))
+    if cache_kv is not None:
+        args.append(_t(cache_kv))
     out = primitive_call(attn, *args, name="fused_multi_head_attention")
+    cache_out = None
+    if cache_kv is not None:
+        out, cache_out = out
+        # detach the cache: gradients through a growing KV cache are not
+        # supported, and keeping its tape node would chain every decode
+        # step's vjp closure into one ever-growing graph
+        cache_out = Tensor(cache_out._value)
     out = residual + _dropout(out, dropout_rate, training, mode)
     if not pre_layer_norm:
         out = _maybe_ln(out, ln_scale, ln_bias, ln_epsilon)
-    return out
+    # reference returns (out, cache_kv_out) when a cache is passed
+    return (out, cache_out) if cache_out is not None else out
 
 
 def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
@@ -155,24 +178,59 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
                             mode="upscale_in_train", ring_id=-1, name=None):
     """Stacked pre-LN transformer blocks (reference fused_multi_transformer:
     the generation-serving op). Per layer: MHA block then FFN block, both
-    with residuals; dropout_rate defaults 0 (inference)."""
-    if cache_kvs is not None or time_step is not None:
-        raise NotImplementedError(
-            "fused_multi_transformer cache_kvs/time_step (incremental "
-            "decoding) is not wired yet — recomputing without the cache "
-            "would silently decode wrong tokens")
+    with residuals; dropout_rate defaults 0 (inference).
+
+    Generation: pass cache_kvs (list of per-layer [2, b, n, t, h] tensors —
+    [] or Nones for the prefill step) and the per-step output grows each
+    cache by the new tokens' k/v; returns (out, new_cache_kvs). The decode
+    step attends causally over prefix+new (`time_step` is implied by the
+    cache length, matching the reference's growing-cache semantics)."""
+    import jax.numpy as jnp
+
     out = _t(x)
     n_layers = len(qkv_weights)
+    use_cache = cache_kvs is not None
+    new_caches = [] if use_cache else None
+    b = int(out.shape[0])
+    if time_step is not None:
+        # growing-cache semantics: the write position IS the cache length;
+        # a mismatched reference-style preallocated cache would silently
+        # attend over max_len stale rows
+        t = int(np.asarray(time_step._value if isinstance(time_step, Tensor)
+                           else time_step))
+        for c in (cache_kvs or []):
+            if c is not None and int(c.shape[3]) != t:
+                raise ValueError(
+                    f"time_step={t} does not match the cache length "
+                    f"{int(c.shape[3])}; this implementation grows caches "
+                    "by concatenation (preallocated max_len caches are not "
+                    "supported — pass the prefix-length cache)")
     for i in range(n_layers):
-        out = fused_multi_head_attention(
+        cache_i = cache_kvs[i] if use_cache and len(cache_kvs) > i and \
+            cache_kvs[i] is not None else None
+        if use_cache and cache_i is None:
+            # prefill: an EMPTY cache (t=0) makes the step uniform — concat
+            # is a no-op and the returned cache holds the full prefix k/v
+            w = qkv_weights[i]
+            _, n, h, _ = (w.shape if not isinstance(w, Tensor)
+                          else tuple(int(s) for s in w.shape))
+            cache_i = Tensor(jnp.zeros((2, b, int(n), 0, int(h)),
+                                       out._value.dtype))
+        r = fused_multi_head_attention(
             out, qkv_weights[i], linear_weights[i],
             pre_layer_norm=pre_layer_norm, pre_ln_scale=ln_scales[i],
             pre_ln_bias=ln_biases[i] if ln_biases else None,
             qkv_bias=qkv_biases[i] if qkv_biases else None,
             linear_bias=linear_biases[i] if linear_biases else None,
+            cache_kv=cache_i,
             attn_mask=attn_mask, dropout_rate=dropout_rate,
             attn_dropout_rate=dropout_rate, pre_ln_epsilon=epsilon,
             ln_epsilon=epsilon, training=training, mode=mode)
+        if use_cache:
+            out, cache_out = r
+            new_caches.append(cache_out)
+        else:
+            out = r
         out = fused_feedforward(
             out, ffn1_weights[i], ffn2_weights[i],
             linear1_bias=ffn1_biases[i] if ffn1_biases else None,
@@ -182,4 +240,4 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
             dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
             activation=activation, ln1_epsilon=epsilon,
             pre_layer_norm=pre_layer_norm, training=training, mode=mode)
-    return out
+    return (out, new_caches) if use_cache else out

@@ -146,6 +146,47 @@ def test_fused_multi_transformer_stack():
     assert len(list(mt.parameters())) == 36  # 12 groups x 3 layers
 
 
+def test_fused_multi_transformer_kv_cache_decode_parity():
+    """Incremental decoding with caches must reproduce the full causal
+    forward position for position (the generation-serving contract)."""
+    paddle.seed(13)
+    mt = FusedMultiTransformer(16, 2, 32, num_layers=2)
+    mt.eval()
+    rng = np.random.RandomState(11)
+    S = 5
+    x = rng.randn(1, S, 16).astype(np.float32)
+    causal = np.triu(np.full((S, S), -1e9, np.float32), 1)[None, None]
+    full = np.asarray(mt(paddle.to_tensor(x),
+                         attn_mask=paddle.to_tensor(causal))._value)
+
+    # prefill on the first 2 tokens (NO mask: cached path is causal by
+    # default, incl. within the chunk), then decode 3 tokens one at a time
+    out, caches = mt(paddle.to_tensor(x[:, :2]), caches=[])
+    steps = [np.asarray(out._value)]
+    assert caches[0].shape[3] == 2  # prefix length cached per layer
+    assert caches[0].stop_gradient  # detached: no vjp chain across steps
+    for t in range(2, S):
+        out, caches = mt(paddle.to_tensor(x[:, t:t + 1]), caches=caches)
+        steps.append(np.asarray(out._value))
+    incremental = np.concatenate(steps, axis=1)
+    np.testing.assert_allclose(incremental, full, rtol=2e-4, atol=2e-5)
+    assert caches[0].shape[3] == S
+
+    # multi-token CHUNK decode (s_new=3 after a 2-token prefix) must stay
+    # intra-chunk causal too
+    out2, caches2 = mt(paddle.to_tensor(x[:, :2]), caches=[])
+    chunk, caches2 = mt(paddle.to_tensor(x[:, 2:]), caches=caches2)
+    np.testing.assert_allclose(np.asarray(chunk._value), full[:, 2:],
+                               rtol=2e-4, atol=2e-5)
+
+    # reference-style preallocated cache + mismatched time_step: loud error
+    import jax.numpy as jnp
+    bad = [paddle.Tensor(jnp.zeros((2, 1, 2, 64, 8), jnp.float32))
+           for _ in range(2)]
+    with pytest.raises(ValueError, match="time_step"):
+        mt(paddle.to_tensor(x[:, :1]), caches=bad, time_step=3)
+
+
 def test_incubate_nn_all_matches_reference():
     ref_all = {"FusedMultiHeadAttention", "FusedFeedForward",
                "FusedTransformerEncoderLayer", "FusedMultiTransformer"}
