@@ -1,0 +1,30 @@
+"""Shared kernel-dispatch helpers: one backend probe, one fallback logger."""
+from __future__ import annotations
+
+import functools
+import sys
+
+import jax
+
+# TPU PJRT backends this build knows: native "tpu" and the tunneled "axon"
+# plugin. One predicate — every pallas gate must agree on what a TPU is.
+_TPU_BACKENDS = ("tpu", "axon")
+
+
+@functools.lru_cache(maxsize=1)
+def on_tpu_backend() -> bool:
+    try:
+        return jax.default_backend() in _TPU_BACKENDS
+    except Exception:  # pragma: no cover
+        return False
+
+
+_logged: set[str] = set()
+
+
+def log_once(key: str, msg: str) -> None:
+    """stderr-log a kernel fallback once per (key) — silent fallbacks cost
+    MFU invisibly (VERDICT r3 weak #3)."""
+    if key not in _logged:
+        _logged.add(key)
+        print(msg, file=sys.stderr, flush=True)

@@ -13,12 +13,10 @@ import jax
 import jax.numpy as jnp
 
 
-@functools.lru_cache(maxsize=1)
 def _on_tpu() -> bool:
-    try:
-        return jax.default_backend() not in ("cpu",)
-    except Exception:  # pragma: no cover
-        return False
+    from ._common import on_tpu_backend
+
+    return on_tpu_backend()
 
 
 def _use_pallas(q, k) -> bool:

@@ -93,16 +93,13 @@ def maybe_fused_adam(p, g, m, v, lr, bc1, bc2, *, beta1, beta2, eps):
     plain XLA path should run (CPU, small tensors, flag off, non-f32)."""
     from ..utils.flags import flag
 
+    from ._common import on_tpu_backend
+
     if not flag("FLAGS_use_fused_optimizer", True):
         return None
-    try:
-        # TPU backends only ("axon" = the tunneled TPU plugin): pltpu
-        # lowering fails on GPU, and jit does not cache the failure — a
-        # loose gate would re-trace and re-raise every step
-        on_tpu = jax.default_backend() in ("tpu", "axon")
-    except Exception:  # pragma: no cover
-        return None
-    if not on_tpu or p.size < _MIN_FUSED_SIZE:
+    # TPU backends only: pltpu lowering fails elsewhere, and jit does not
+    # cache the failure — a loose gate would re-trace and re-raise per step
+    if not on_tpu_backend() or p.size < _MIN_FUSED_SIZE:
         return None
     if m.dtype != jnp.float32 or p.dtype != jnp.float32:
         return None
@@ -118,11 +115,9 @@ def maybe_fused_adam(p, g, m, v, lr, bc1, bc2, *, beta1, beta2, eps):
                                  beta1=float(beta1), beta2=float(beta2),
                                  eps=float(eps))
     except Exception as e:  # noqa: BLE001 — log once, fall back to XLA path
-        if not getattr(maybe_fused_adam, "_logged", False):
-            maybe_fused_adam._logged = True
-            import sys
+        from ._common import log_once
 
-            print(f"[paddle_tpu] fused adam pallas kernel failed "
-                  f"({type(e).__name__}: {str(e)[:200]}); using XLA path",
-                  file=sys.stderr, flush=True)
+        log_once("fused_adam",
+                 f"[paddle_tpu] fused adam pallas kernel failed "
+                 f"({type(e).__name__}: {str(e)[:200]}); using XLA path")
         return None

@@ -506,6 +506,14 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     nd = len(tuple(normalized_shape))
 
     def f(a, *wb):
+        if nd == 1 and wb:
+            # common trailing-dim case: one-pass pallas kernel on TPU
+            # (kernels/fused_layernorm.py); None -> the XLA chain below
+            from ..kernels.fused_layernorm import maybe_fused_layer_norm
+
+            fused = maybe_fused_layer_norm(a, wb[0], wb[1], epsilon)
+            if fused is not None:
+                return fused
         axes = tuple(range(a.ndim - nd, a.ndim))
         mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
         var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)

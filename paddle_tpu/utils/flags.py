@@ -14,6 +14,9 @@ _FLAGS: dict[str, object] = {
     # fused one-pass Adam update kernel (kernels/fused_optimizer.py) for
     # large f32 buffers on TPU
     "FLAGS_use_fused_optimizer": True,
+    # fused one-pass LayerNorm kernel (kernels/fused_layernorm.py), TPU +
+    # lane-tileable trailing dim
+    "FLAGS_use_fused_layernorm": True,
     # True/False force; "auto" picks splash for causal long-seq (>= 2048)
     # where skipping fully-masked KV tiles pays — at 1024 it measured even
     # with dense-block flash (round-3 on-chip A/B)
