@@ -1,0 +1,256 @@
+"""Low-level op-call compat layer (reference: python/paddle/_C_ops.py — the
+generated pybind op table, `paddle/fluid/pybind/op_function_generator.cc`).
+
+User code and downstream libraries call `paddle._C_ops.<op>(...)` directly.
+The legacy convention passes attributes as a trailing alternating
+('attr_name', value, ...) list; the `final_state_*` variants take plain
+positional/keyword args. Here each supported op is an adapter onto the
+framework's functional API, so both spellings hit the same XLA lowerings.
+Unsupported names raise AttributeError with a pointer to the functional op.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+__all__ = []
+
+
+def _split_attrs(args):
+    """Split (tensors..., 'name', val, 'name', val ...) at the first str."""
+    for i, a in enumerate(args):
+        if isinstance(a, str):
+            tail = args[i:]
+            if len(tail) % 2 != 0:
+                raise ValueError(f"unpaired op attributes: {tail}")
+            return args[:i], {tail[j]: tail[j + 1] for j in range(0, len(tail), 2)}
+    return args, {}
+
+
+def _F():
+    from .nn import functional
+
+    return functional
+
+
+def _T():
+    import paddle_tpu
+
+    return paddle_tpu
+
+
+def matmul_v2(x, y, *attrs):
+    ins, a = _split_attrs((x, y) + attrs)
+    return _T().matmul(ins[0], ins[1], transpose_x=a.get("trans_x", False),
+                       transpose_y=a.get("trans_y", False))
+
+
+def matmul(x, y, *attrs):
+    ins, a = _split_attrs((x, y) + attrs)
+    return _T().matmul(ins[0], ins[1],
+                       transpose_x=a.get("transpose_X", a.get("trans_x", False)),
+                       transpose_y=a.get("transpose_Y", a.get("trans_y", False)))
+
+
+def elementwise_add(x, y, *attrs):
+    return _T().add(x, y)
+
+
+def elementwise_sub(x, y, *attrs):
+    return _T().subtract(x, y)
+
+
+def elementwise_mul(x, y, *attrs):
+    return _T().multiply(x, y)
+
+
+def elementwise_div(x, y, *attrs):
+    return _T().divide(x, y)
+
+
+def elementwise_pow(x, y, *attrs):
+    return _T().pow(x, y)
+
+
+def elementwise_max(x, y, *attrs):
+    return _T().maximum(x, y)
+
+
+def elementwise_min(x, y, *attrs):
+    return _T().minimum(x, y)
+
+
+def relu(x, *attrs):
+    return _F().relu(x)
+
+
+def gelu(x, *attrs):
+    _, a = _split_attrs(attrs)
+    return _F().gelu(x, approximate=a.get("approximate", False))
+
+
+def sigmoid(x, *attrs):
+    return _F().sigmoid(x)
+
+
+def tanh(x, *attrs):
+    return _T().tanh(x)
+
+
+def sqrt(x, *attrs):
+    return _T().sqrt(x)
+
+
+def exp(x, *attrs):
+    return _T().exp(x)
+
+
+def log(x, *attrs):
+    return _T().log(x)
+
+
+def softmax(x, *attrs):
+    _, a = _split_attrs(attrs)
+    return _F().softmax(x, axis=a.get("axis", -1))
+
+
+def log_softmax(x, *attrs):
+    _, a = _split_attrs(attrs)
+    return _F().log_softmax(x, axis=a.get("axis", -1))
+
+
+def mean(x, *attrs):
+    return _T().mean(x)
+
+
+def scale(x, *attrs):
+    _, a = _split_attrs(attrs)
+    return _T().scale(x, scale=a.get("scale", 1.0), bias=a.get("bias", 0.0),
+                      bias_after_scale=a.get("bias_after_scale", True))
+
+
+def reshape2(x, *args):
+    ins, a = _split_attrs((x,) + args)
+    shape = a.get("shape")
+    if shape is None and len(ins) > 1:
+        shape = ins[1]
+    out = _T().reshape(ins[0], shape)
+    return out, None  # (out, xshape) pair like the reference op
+
+
+def reshape(x, *args):
+    return reshape2(x, *args)[0]
+
+
+def transpose2(x, *attrs):
+    _, a = _split_attrs(attrs)
+    out = _T().transpose(x, a.get("axis"))
+    return out, None
+
+
+def concat(inputs, *attrs):
+    _, a = _split_attrs(attrs)
+    return _T().concat(inputs, axis=a.get("axis", 0))
+
+
+def split(x, *attrs):
+    _, a = _split_attrs(attrs)
+    num = a.get("num", 0)
+    sections = a.get("sections")
+    axis = a.get("axis", 0)
+    return _T().split(x, sections if sections else num, axis=axis)
+
+
+def cast(x, *attrs):
+    _, a = _split_attrs(attrs)
+    dt = a.get("out_dtype", a.get("dtype"))
+    return _T().cast(x, dt)
+
+
+def dropout(x, *attrs):
+    _, a = _split_attrs(attrs)
+    p = a.get("dropout_prob", 0.5)
+    training = not a.get("is_test", False)
+    mode = a.get("dropout_implementation", "downgrade_in_infer")
+    return _F().dropout(x, p=p, training=training, mode=mode), None
+
+
+def layer_norm(x, scale_t, bias_t, *attrs):
+    _, a = _split_attrs(attrs)
+    eps = a.get("epsilon", 1e-5)
+    out = _F().layer_norm(x, x.shape[a.get("begin_norm_axis", 1):],
+                          weight=scale_t, bias=bias_t, epsilon=eps)
+    return out, None, None
+
+
+def lookup_table_v2(w, ids, *attrs):
+    _, a = _split_attrs(attrs)
+    return _F().embedding(ids, w, padding_idx=a.get("padding_idx", -1)
+                          if a.get("padding_idx", -1) >= 0 else None)
+
+
+def one_hot_v2(x, *attrs):
+    _, a = _split_attrs(attrs)
+    return _F().one_hot(x, a.get("depth"))
+
+
+def softmax_with_cross_entropy(logits, label, *attrs):
+    _, a = _split_attrs(attrs)
+    loss = _F().cross_entropy(
+        logits, label, soft_label=a.get("soft_label", False),
+        ignore_index=a.get("ignore_index", -100), reduction="none",
+        axis=a.get("axis", -1),
+    )
+    return _F().softmax(logits, axis=a.get("axis", -1)), loss
+
+
+def reduce_sum(x, *attrs):
+    _, a = _split_attrs(attrs)
+    dim = a.get("dim")
+    keep = a.get("keep_dim", False)
+    if a.get("reduce_all", False):
+        dim = None
+    return _T().sum(x, axis=dim, keepdim=keep)
+
+
+def reduce_mean(x, *attrs):
+    _, a = _split_attrs(attrs)
+    dim = a.get("dim")
+    keep = a.get("keep_dim", False)
+    if a.get("reduce_all", False):
+        dim = None
+    return _T().mean(x, axis=dim, keepdim=keep)
+
+
+def fill_constant(*attrs):
+    _, a = _split_attrs(attrs)
+    return _T().full(a.get("shape"), a.get("value", 0.0),
+                     dtype=a.get("dtype", "float32"))
+
+
+def _final_state(name):
+    """final_state_<op> → the plain functional op (positional args)."""
+    F, T = _F(), _T()
+    direct = {
+        "matmul": T.matmul, "add": T.add, "subtract": T.subtract,
+        "multiply": T.multiply, "divide": T.divide, "relu": F.relu,
+        "gelu": F.gelu, "softmax": F.softmax, "sigmoid": F.sigmoid,
+        "tanh": T.tanh, "exp": T.exp, "log": T.log, "sqrt": T.sqrt,
+        "mean": T.mean, "sum": T.sum, "reshape": T.reshape,
+        "transpose": T.transpose, "concat": T.concat, "split": T.split,
+        "cast": T.cast, "abs": T.abs, "maximum": T.maximum,
+        "minimum": T.minimum, "embedding": F.embedding,
+        "one_hot": F.one_hot, "full": T.full,
+    }
+    return direct.get(name)
+
+
+def __getattr__(name):
+    if name.startswith("final_state_"):
+        fn = _final_state(name[len("final_state_"):])
+        if fn is not None:
+            return fn
+    raise AttributeError(
+        f"paddle_tpu._C_ops.{name} is not bound; call the functional API "
+        f"(paddle.nn.functional / paddle tensor methods) instead — every "
+        "lowering lives there (core/dispatch.py replaces the pybind op table)"
+    )

@@ -83,6 +83,14 @@ from . import signal  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
+from . import compat  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import callbacks  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
+from . import reader  # noqa: E402,F401
+from . import dataset  # noqa: E402,F401
+from . import cost_model  # noqa: E402,F401
+from . import _C_ops  # noqa: E402,F401
 
 from .framework.io import load, save  # noqa: E402,F401
 from .framework import grad, in_dynamic_mode, LazyGuard  # noqa: E402,F401

@@ -1,0 +1,110 @@
+"""py2/py3 compatibility helpers (reference: python/paddle/compat.py:25-261).
+
+The reference keeps these for user code migrated from the python-2 era:
+text/bytes coercion over nested containers, banker's-rounding-free round,
+C-style floor division, and exception message extraction.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = []
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert bytes (possibly nested in list/set/dict) to str.
+
+    reference: compat.py:25 — same container semantics: lists/sets convert
+    element-wise (optionally in place), dicts convert values in place only.
+    """
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _to_text(obj[i], encoding)
+            return obj
+        return [_to_text(item, encoding) for item in obj]
+    if isinstance(obj, set):
+        if inplace:
+            for item in list(obj):
+                obj.remove(item)
+                obj.add(_to_text(item, encoding))
+            return obj
+        return {_to_text(item, encoding) for item in obj}
+    if isinstance(obj, dict):
+        if inplace:
+            new_obj = {}
+            for key, value in obj.items():
+                new_obj[_to_text(key, encoding)] = _to_text(value, encoding)
+            obj.update(new_obj)
+            return obj
+        new_obj = {}
+        for key, value in obj.items():
+            new_obj[_to_text(key, encoding)] = _to_text(value, encoding)
+        return new_obj
+    return _to_text(obj, encoding)
+
+
+def _to_text(obj, encoding):
+    if obj is None:
+        return obj
+    if isinstance(obj, (bytes, bytearray)):
+        return obj.decode(encoding)
+    if isinstance(obj, str):
+        return obj
+    return str(obj)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert str (possibly nested in list/set) to bytes (compat.py:121)."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            for i in range(len(obj)):
+                obj[i] = _to_bytes(obj[i], encoding)
+            return obj
+        return [_to_bytes(item, encoding) for item in obj]
+    if isinstance(obj, set):
+        if inplace:
+            for item in list(obj):
+                obj.remove(item)
+                obj.add(_to_bytes(item, encoding))
+            return obj
+        return {_to_bytes(item, encoding) for item in obj}
+    return _to_bytes(obj, encoding)
+
+
+def _to_bytes(obj, encoding):
+    if obj is None:
+        return obj
+    assert encoding is not None
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    if isinstance(obj, bytes):
+        return obj
+    return str(obj).encode(encoding)
+
+
+def round(x, d=0):
+    """Round half away from zero (reference compat.py:206 — avoids python 3's
+    banker's rounding)."""
+    if x > 0.0:
+        p = 10 ** d
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0.0:
+        p = 10 ** d
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    """reference: compat.py:232 — floor(x / y)."""
+    return x // y
+
+
+def get_exception_message(exc):
+    """reference: compat.py:249 — message string of an exception object."""
+    assert exc is not None
+    return str(exc)

@@ -1,0 +1,4 @@
+"""Cost model namespace (reference: python/paddle/cost_model/__init__.py)."""
+from .cost_model import CostModel  # noqa: F401
+
+__all__ = ["CostModel"]

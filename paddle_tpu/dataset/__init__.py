@@ -1,0 +1,24 @@
+"""Legacy reader-protocol dataset package (reference: python/paddle/dataset/).
+
+Each submodule exposes `train()`/`test()` returning a *reader creator* — a
+zero-arg callable yielding samples — the protocol `paddle.batch` and the
+static feed loops consume. The reference deprecated these in favour of
+`paddle.vision.datasets`/`paddle.text.datasets` (io.DataLoader-style); here
+each submodule is a thin reader adapter over those map-style datasets, so
+both protocols share one data source (synthetic-capable in zero-egress
+environments).
+"""
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import movielens  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+
+__all__ = []

@@ -1,0 +1,4 @@
+"""Top-level hub namespace (reference: python/paddle/hub.py:15-21)."""
+from .hapi.hub import help, list, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

@@ -1,0 +1,20 @@
+"""Reader-composition library (reference: python/paddle/reader/__init__.py).
+
+A *reader creator* is a zero-arg callable returning an iterable of samples;
+these decorators compose creators. Kept for parity with code that feeds
+static programs / `paddle.batch` pipelines.
+"""
+from .decorator import (  # noqa: F401
+    ComposeNotAligned,
+    buffered,
+    cache,
+    chain,
+    compose,
+    firstn,
+    map_readers,
+    multiprocess_reader,
+    shuffle,
+    xmap_readers,
+)
+
+__all__ = []

@@ -1,0 +1,224 @@
+"""Top-level module parity: compat, sysconfig, callbacks, hub, reader,
+dataset, cost_model, _C_ops (reference: python/paddle/{compat,sysconfig,
+callbacks,hub}.py, reader/decorator.py, dataset/, cost_model/, _C_ops.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------- compat ----------
+
+def test_compat_to_text_and_bytes_nested():
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_text([b"a", b"b"]) == ["a", "b"]
+    assert paddle.compat.to_text({b"k": b"v"}) == {"k": "v"}
+    s = {b"x", b"y"}
+    out = paddle.compat.to_text(s, inplace=True)
+    assert out is s and s == {"x", "y"}
+    assert paddle.compat.to_bytes("abc") == b"abc"
+    assert paddle.compat.to_bytes(["a", "b"]) == [b"a", b"b"]
+
+
+def test_compat_round_half_away_from_zero():
+    assert paddle.compat.round(0.5) == 1.0
+    assert paddle.compat.round(-0.5) == -1.0
+    assert paddle.compat.round(2.675, 2) == 2.68
+    assert paddle.compat.round(0.0) == 0.0
+    assert paddle.compat.floor_division(7, 2) == 3
+    assert paddle.compat.get_exception_message(ValueError("boom")) == "boom"
+
+
+# ---------- sysconfig ----------
+
+def test_sysconfig_paths():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.isdir(inc) and any(
+        f.endswith(".cc") for f in os.listdir(inc)
+    )
+    lib = paddle.sysconfig.get_lib()
+    assert os.path.isdir(lib)
+
+
+# ---------- callbacks / hub ----------
+
+def test_callbacks_reexports():
+    for name in ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+                 "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]:
+        assert hasattr(paddle.callbacks, name)
+
+
+def test_hub_local_roundtrip(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['numpy']\n"
+        "def tiny_model(scale=2):\n"
+        "    'build a tiny model'\n"
+        "    return {'scale': scale}\n"
+    )
+    names = paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny_model" in names
+    assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model", source="local")
+    out = paddle.hub.load(str(tmp_path), "tiny_model", source="local", scale=5)
+    assert out == {"scale": 5}
+
+
+def test_hub_network_sources_gated(tmp_path):
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.load("owner/repo:main", "m", source="github")
+    with pytest.raises(ValueError):
+        paddle.hub.list("x", source="ftp")
+
+
+# ---------- reader ----------
+
+def _ten():
+    def r():
+        for i in range(10):
+            yield i
+
+    return r
+
+
+def test_reader_basic_decorators():
+    assert list(paddle.reader.cache(_ten())()) == list(range(10))
+    assert list(paddle.reader.firstn(_ten(), 3)()) == [0, 1, 2]
+    assert sorted(paddle.reader.shuffle(_ten(), 4)()) == list(range(10))
+    assert list(paddle.reader.chain(_ten(), _ten())()) == list(range(10)) * 2
+    assert list(paddle.reader.map_readers(lambda a, b: a + b, _ten(), _ten())()) \
+        == [2 * i for i in range(10)]
+    assert list(paddle.reader.buffered(_ten(), 2)()) == list(range(10))
+
+
+def test_reader_compose_alignment():
+    composed = paddle.reader.compose(_ten(), _ten())
+    assert list(composed()) == [(i, i) for i in range(10)]
+
+    def five():
+        for i in range(5):
+            yield i
+
+    with pytest.raises(paddle.reader.ComposeNotAligned):
+        list(paddle.reader.compose(_ten(), five)())
+    # check_alignment=False truncates to the shortest reader
+    out = list(paddle.reader.compose(_ten(), five, check_alignment=False)())
+    assert len(out) == 5
+
+
+def test_reader_xmap_ordered_and_unordered():
+    mapped = paddle.reader.xmap_readers(lambda x: x * 2, _ten(), 4, 8, order=True)
+    assert list(mapped()) == [2 * i for i in range(10)]
+    mapped = paddle.reader.xmap_readers(lambda x: x * 2, _ten(), 4, 8)
+    assert sorted(mapped()) == [2 * i for i in range(10)]
+
+
+@pytest.mark.slow
+def test_reader_multiprocess():
+    out = sorted(paddle.reader.multiprocess_reader(
+        [_ten(), _ten()], use_pipe=False)())
+    assert out == sorted(list(range(10)) * 2)
+
+
+# ---------- dataset ----------
+
+def test_dataset_mnist_reader_protocol():
+    r = paddle.dataset.mnist.train()
+    img, label = next(iter(r()))
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0
+    assert isinstance(label, int)
+
+
+def test_dataset_uci_and_cifar_and_imdb():
+    feat, price = next(iter(paddle.dataset.uci_housing.train()()))
+    assert feat.shape == (13,) and price.shape == (1,)
+    img, label = next(iter(paddle.dataset.cifar.train10()()))
+    assert img.shape == (3072,) and 0 <= label < 10
+    doc, sentiment = next(iter(paddle.dataset.imdb.train(
+        paddle.dataset.imdb.word_dict())()))
+    assert isinstance(doc, list) and sentiment in (0, 1)
+
+
+def test_dataset_imikolov_ngram_and_seq():
+    w = paddle.dataset.imikolov.build_dict()
+    gram = next(iter(paddle.dataset.imikolov.train(w, 4)()))
+    assert len(gram) == 4
+    src, trg = next(iter(paddle.dataset.imikolov.train(
+        w, 4, paddle.dataset.imikolov.DataType.SEQ)()))
+    assert src[1:] == trg[:-1]
+
+
+def test_dataset_wmt_and_movielens_and_batch():
+    src, tin, tout = next(iter(paddle.dataset.wmt14.train(1000)()))
+    assert tin[1:] == tout[:-1]
+    item = next(iter(paddle.dataset.movielens.train()()))
+    assert len(item) == 8 and paddle.dataset.movielens.max_user_id() == 6040
+    # reader protocol composes with paddle.batch
+    batched = paddle.batch(paddle.dataset.mnist.train(), batch_size=4)
+    first = next(iter(batched()))
+    assert len(first) == 4
+
+
+def test_dataset_download_gated(tmp_path):
+    with pytest.raises(RuntimeError, match="egress"):
+        paddle.dataset.common.download("http://x/y.tar", "mod", None)
+    p = os.path.join(paddle.dataset.common.DATA_HOME, "mod2")
+    os.makedirs(p, exist_ok=True)
+    fn = os.path.join(p, "y.tar")
+    with open(fn, "wb") as f:
+        f.write(b"data")
+    try:
+        assert paddle.dataset.common.download("http://x/y.tar", "mod2",
+                                              paddle.dataset.common.md5file(fn)) == fn
+    finally:
+        os.remove(fn)
+
+
+# ---------- cost_model ----------
+
+def test_cost_model_static_table_and_estimate():
+    cm = paddle.cost_model.CostModel()
+    data = cm.static_cost_data()
+    assert len(data) >= 15
+    t = cm.get_static_op_time("matmul")
+    assert t["op_time"] > 0
+    t_bwd = cm.get_static_op_time("softmax", forward=False)
+    assert t_bwd["op_time"] > 0
+    est = paddle.cost_model.CostModel.estimate_time_s(1e12, 1e9)
+    assert est > 0
+
+
+def test_cost_model_profile_measure():
+    cm = paddle.cost_model.CostModel()
+    startup, main = cm.build_program()
+    cost = cm.profile_measure(startup, main)
+    paddle.disable_static()
+    assert cost["wall_time_s"] > 0
+    assert cost.get("flops", 0) > 0  # XLA cost analysis reached
+
+
+# ---------- _C_ops ----------
+
+def test_c_ops_legacy_attr_convention():
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+    out = paddle._C_ops.matmul_v2(x, y, "trans_x", False, "trans_y", False)
+    np.testing.assert_allclose(out.numpy(), x.numpy() @ y.numpy(), rtol=1e-5)
+    out_t = paddle._C_ops.matmul_v2(x, y, "trans_x", True, "trans_y", True)
+    np.testing.assert_allclose(out_t.numpy(), x.numpy().T @ y.numpy().T,
+                               rtol=1e-5)
+    s = paddle._C_ops.scale(x, "scale", 2.0, "bias", 1.0)
+    np.testing.assert_allclose(s.numpy(), x.numpy() * 2 + 1, rtol=1e-5)
+    r, _ = paddle._C_ops.reshape2(x, "shape", [8, 4])
+    assert tuple(r.shape) == (8, 4)
+    sm = paddle._C_ops.softmax(x, "axis", -1)
+    np.testing.assert_allclose(sm.numpy().sum(-1), np.ones(4), rtol=1e-5)
+
+
+def test_c_ops_final_state_and_missing():
+    x = paddle.to_tensor(np.random.rand(3, 3).astype(np.float32))
+    out = paddle._C_ops.final_state_relu(x)
+    assert out.numpy().min() >= 0
+    with pytest.raises(AttributeError, match="functional"):
+        paddle._C_ops.definitely_not_an_op(x)
