@@ -1,5 +1,6 @@
 from . import flags  # noqa: F401
 from . import monitor  # noqa: F401
+from . import op_test  # noqa: F401
 from .misc import (  # noqa: F401
     deprecated,
     require_version,

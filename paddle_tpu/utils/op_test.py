@@ -1,0 +1,183 @@
+"""Per-op numeric test harness (reference:
+python/paddle/fluid/tests/unittests/op_test.py:292 `OpTest`,
+`check_output_with_place`:1334, `check_grad_with_place`:1844,
+`get_numeric_gradient`:123).
+
+TPU-native translation of the reference's op-test protocol: a test declares
+inputs/attrs and a numpy reference; `check_output` runs the op through BOTH
+the eager path and a whole-program static build (the two execution engines
+of this framework) and asserts allclose against the reference;
+`check_grad` compares tape-autograd gradients against central finite
+differences of the actual forward. Dtype sweeps use per-dtype tolerances
+like the reference (fp32 tight, bf16 loose).
+"""
+from __future__ import annotations
+
+import unittest
+
+import numpy as np
+
+__all__ = ["OpTest", "get_numeric_gradient"]
+
+# reference op_test.py uses 1e-7-ish fp32 and relaxed fp16/bf16 tolerances
+DEFAULT_RTOL = {"float32": 1e-5, "float64": 1e-12, "bfloat16": 2e-2,
+                "float16": 1e-3}
+
+
+def get_numeric_gradient(fn, inputs, wrt, delta=5e-3, loss_weights=None):
+    """Central-difference gradient of sum(fn(inputs) * w) wrt inputs[wrt]
+    (reference: op_test.py:123 — same scalar-projection trick: a fixed
+    random weighting makes the Jacobian check a single backward)."""
+    import paddle_tpu as paddle
+
+    def scalar_loss(arrs):
+        outs = fn(**{k: paddle.to_tensor(v) for k, v in arrs.items()})
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        total = 0.0
+        for o, w in zip(outs, loss_weights):
+            total = total + float(np.sum(np.asarray(o.numpy(), np.float64) * w))
+        return total
+
+    base = {k: np.asarray(v, np.float64) for k, v in inputs.items()}
+    x = base[wrt]
+    grad = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = scalar_loss({k: v.astype(inputs[k].dtype) for k, v in base.items()})
+        flat[i] = orig - delta
+        lo = scalar_loss({k: v.astype(inputs[k].dtype) for k, v in base.items()})
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return grad
+
+
+class OpTest(unittest.TestCase):
+    """Subclass protocol (mirrors the reference):
+
+        class TestGelu(OpTest):
+            def setUp(self):
+                self.op = paddle.nn.functional.gelu       # the op under test
+                self.inputs = {"x": np.random.rand(4, 8).astype("float32")}
+                self.attrs = {}                           # kwargs to the op
+                self.ref = lambda x: scipy_gelu(x)        # numpy reference
+
+            def test_output(self):
+                self.check_output()
+
+            def test_grad(self):
+                self.check_grad(["x"])
+    """
+
+    op = None
+    inputs: dict = {}
+    attrs: dict = {}
+    ref = None
+
+    @classmethod
+    def setUpClass(cls):
+        # fixed seeds, like op_test.py:292 setUpClass
+        cls._np_state = np.random.get_state()
+        np.random.seed(123)
+
+    @classmethod
+    def tearDownClass(cls):
+        np.random.set_state(cls._np_state)
+
+    # -- execution paths -------------------------------------------------
+
+    def _run_eager(self):
+        import paddle_tpu as paddle
+
+        tensors = {k: paddle.to_tensor(v) for k, v in self.inputs.items()}
+        outs = self.op(**tensors, **self.attrs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [np.asarray(o.numpy()) for o in outs if o is not None]
+
+    def _run_static(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        paddle.enable_static()
+        try:
+            main, startup = static.Program(), static.Program()
+            with static.program_guard(main, startup):
+                feeds = {
+                    k: static.data(k, list(v.shape), str(v.dtype))
+                    for k, v in self.inputs.items()
+                }
+                outs = self.op(**feeds, **self.attrs)
+                outs = outs if isinstance(outs, (list, tuple)) else [outs]
+                outs = [o for o in outs if o is not None]
+            exe = static.Executor()
+            exe.run(startup)
+            vals = exe.run(main, feed=dict(self.inputs), fetch_list=list(outs))
+            return [np.asarray(v) for v in vals]
+        finally:
+            paddle.disable_static()
+
+    def _ref_outputs(self):
+        outs = self.ref(**self.inputs, **self.attrs) if callable(self.ref) \
+            else self.ref
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [np.asarray(o) for o in outs]
+
+    # -- checks ----------------------------------------------------------
+
+    def check_output(self, rtol=None, atol=1e-6, check_static=True):
+        """Run eager + static, compare each to the numpy reference
+        (reference: check_output_with_place op_test.py:1334 runs both the
+        static executor and dygraph)."""
+        dtype = str(next(iter(self.inputs.values())).dtype)
+        rtol = rtol if rtol is not None else DEFAULT_RTOL.get(dtype, 1e-5)
+        refs = self._ref_outputs()
+        eager = self._run_eager()
+        self.assertEqual(len(eager), len(refs), "eager arity vs reference")
+        for e, r in zip(eager, refs):
+            np.testing.assert_allclose(
+                np.asarray(e, np.float64), np.asarray(r, np.float64),
+                rtol=rtol, atol=atol, err_msg="eager path mismatch")
+        if check_static:
+            stat = self._run_static()
+            for s, r in zip(stat, refs):
+                np.testing.assert_allclose(
+                    np.asarray(s, np.float64), np.asarray(r, np.float64),
+                    rtol=rtol, atol=atol, err_msg="static path mismatch")
+
+    def check_grad(self, inputs_to_check, rtol=1e-2, atol=1e-4, delta=5e-3,
+                   max_relative_error=None):
+        """Tape-autograd grads vs central finite differences
+        (reference: check_grad_with_place op_test.py:1844)."""
+        import paddle_tpu as paddle
+
+        if max_relative_error is not None:
+            rtol = max_relative_error
+        tensors = {}
+        for k, v in self.inputs.items():
+            t = paddle.to_tensor(v)
+            if np.issubdtype(v.dtype, np.floating):
+                t.stop_gradient = False
+            tensors[k] = t
+        outs = self.op(**tensors, **self.attrs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        outs = [o for o in outs if o is not None]
+        rng = np.random.RandomState(7)
+        weights = [rng.uniform(0.1, 1.0, tuple(o.shape)) for o in outs]
+        loss = None
+        for o, w in zip(outs, weights):
+            term = paddle.sum(paddle.multiply(
+                paddle.cast(o, "float32"),
+                paddle.to_tensor(w.astype("float32"))))
+            loss = term if loss is None else paddle.add(loss, term)
+        loss.backward()
+
+        fn = lambda **kw: self.op(**kw, **self.attrs)
+        for name in inputs_to_check:
+            analytic = np.asarray(tensors[name].grad.numpy(), np.float64)
+            numeric = get_numeric_gradient(
+                fn, self.inputs, name, delta=delta, loss_weights=weights)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=rtol, atol=atol,
+                err_msg=f"gradient mismatch for input '{name}'")
