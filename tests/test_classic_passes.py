@@ -1,0 +1,192 @@
+"""Constant folding / DCE / CSE program passes (reference: ir pass family +
+Executor prune, executor.py:1358)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static.passes import new_pass, PassManager
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _build_constexpr_program():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4], "float32")
+        a = paddle.full([4], 2.0, "float32")
+        b = paddle.full([4], 3.0, "float32")
+        c = paddle.add(a, b)            # foldable: 5
+        d = paddle.multiply(c, a)       # foldable: 10
+        y = paddle.add(x, d)            # not foldable (feed input)
+    return main, startup, y
+
+
+def test_constant_folding_folds_transitively(static_mode):
+    main, startup, y = _build_constexpr_program()
+    n_before = len(main.global_block.ops)
+    ctx = new_pass("constant_folding").apply(main)
+    # full() evaluates at trace time; the recorded add and multiply fold
+    assert ctx.attrs["constant_folding.n_folded"] == 2
+    folded_types = [op.type for op in main.global_block.ops]
+    assert "folded_constant" in folded_types
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.ones(4, np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(out[0], np.full(4, 11.0), rtol=1e-6)
+
+
+def test_constant_folding_skips_params_and_stochastic(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 8], "float32")
+        h = static.nn.fc(x, 8)  # parameter inputs — must NOT fold
+        h2 = paddle.nn.functional.dropout(h, 0.5)  # stochastic — must NOT fold
+    ctx = new_pass("constant_folding").apply(main)
+    types = [op.type for op in main.global_block.ops]
+    assert not any(t == "folded_constant" and "fc" in a.get("folded_from", "")
+                   for t, a in [(op.type, op.attrs)
+                                for op in main.global_block.ops])
+    # program still runs and params still train-able (not frozen to consts)
+    exe = static.Executor()
+    exe.run(startup)
+    exe.run(main, feed={"x": np.ones((4, 8), np.float32)}, fetch_list=[h2])
+
+
+def test_dce_prunes_to_targets(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4], "float32")
+        kept = paddle.add(x, x)
+        dead = paddle.multiply(kept, kept)      # not on the path to target
+        dead2 = paddle.exp(dead)                # noqa: F841 dead chain
+        target = paddle.subtract(kept, x)
+    n_before = len(main.global_block.ops)
+    ctx = new_pass("dead_code_elimination",
+                   {"targets": [target]}).apply(main)
+    assert ctx.attrs["dead_code_elimination.n_removed"] == 2
+    assert len(main.global_block.ops) == n_before - 2
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.full(4, 2.0, np.float32)},
+                  fetch_list=[target])
+    np.testing.assert_allclose(out[0], np.full(4, 2.0), rtol=1e-6)
+
+
+def test_dce_requires_targets(static_mode):
+    main, _ = static.Program(), static.Program()
+    with pytest.raises(RuntimeError, match="not applicable"):
+        new_pass("dead_code_elimination").apply(main)
+
+
+def test_cse_dedupes_and_preserves_fetches(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4], "float32")
+        a = paddle.exp(x)
+        b = paddle.exp(x)       # duplicate of a
+        y = paddle.add(a, b)
+    ctx = new_pass("common_subexpression_elimination").apply(main)
+    assert ctx.attrs["cse.n_deduped"] == 1
+    assert any(op.type == "share" for op in main.global_block.ops)
+    exe = static.Executor()
+    exe.run(startup)
+    # both the combined output AND the deduped variable fetch correctly
+    out = exe.run(main, feed={"x": np.zeros(4, np.float32)},
+                  fetch_list=[y, b])
+    np.testing.assert_allclose(out[0], np.full(4, 2.0), rtol=1e-6)
+    np.testing.assert_allclose(out[1], np.ones(4), rtol=1e-6)
+
+
+def test_cse_keeps_stochastic_ops(static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [1000], "float32")
+        d1 = paddle.nn.functional.dropout(x, 0.5)
+        d2 = paddle.nn.functional.dropout(x, 0.5)  # must NOT be deduped
+        y = paddle.add(d1, d2)  # noqa: F841
+    ctx = new_pass("common_subexpression_elimination").apply(main)
+    assert ctx.attrs["cse.n_deduped"] == 0
+
+
+def test_pass_manager_composition(static_mode):
+    main, startup, y = _build_constexpr_program()
+    pm = PassManager([
+        new_pass("constant_folding"),
+        new_pass("common_subexpression_elimination"),
+        new_pass("dead_code_elimination", {"targets": [y]}),
+    ])
+    ctx = pm.apply(main)
+    assert "constant_folding" in ctx.attrs["applied_passes"]
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.ones(4, np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(out[0], np.full(4, 11.0), rtol=1e-6)
+
+
+def test_cse_distinguishes_closure_config(static_mode):
+    """Confirmed-bug regression (code review r4): sum(x, axis=0) and
+    sum(x, axis=1) record identical (type, inputs, attrs) — the closure
+    fingerprint must keep them distinct."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 3], "float32")
+        a = paddle.sum(x, axis=0)
+        b = paddle.sum(x, axis=1)
+    ctx = new_pass("common_subexpression_elimination").apply(main)
+    assert ctx.attrs["cse.n_deduped"] == 0
+    exe = static.Executor()
+    exe.run(startup)
+    feed = {"x": np.array([[1, 2, 3], [2, 3, 4]], np.float32)}
+    out = exe.run(main, feed=feed, fetch_list=[a, b])
+    np.testing.assert_allclose(out[0], [3, 5, 7])
+    np.testing.assert_allclose(out[1], [6, 9])
+    # identical config across distinct closures still dedupes
+    main2, startup2 = static.Program(), static.Program()
+    with static.program_guard(main2, startup2):
+        x = static.data("x", [2, 3], "float32")
+        c = paddle.sum(x, axis=0)
+        d = paddle.sum(x, axis=0)  # noqa: F841
+    ctx = new_pass("common_subexpression_elimination").apply(main2)
+    assert ctx.attrs["cse.n_deduped"] == 1
+
+
+def test_cse_distinguishes_folded_constants(static_mode):
+    """Confirmed-miscompile regression (code review r4, round 2): two
+    different folded constants carry their values in lambda DEFAULT args —
+    the fingerprint must hash defaults (by array content), not just cells."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2], "float32")
+        w1 = paddle.full([2], 1.0, "float32")
+        w2 = paddle.full([2], 3.0, "float32")
+        c1 = paddle.multiply(w1, w1)   # folds to 1
+        c2 = paddle.multiply(w2, w2)   # folds to 9
+        y = paddle.add(paddle.add(x, c1), c2)
+    from paddle_tpu.static.passes import PassManager
+    pm = PassManager([new_pass("constant_folding"),
+                      new_pass("common_subexpression_elimination")])
+    ctx = pm.apply(main)
+    assert ctx.attrs["cse.n_deduped"] == 0  # distinct constants NOT merged
+    exe = static.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+    np.testing.assert_allclose(out[0], np.full(2, 10.0), rtol=1e-6)
+
+
+def test_static_save_falls_back_on_unexportable_program(static_mode, tmp_path):
+    """Code-review r4: static.save must never crash on programs outside the
+    pdmodel emitter set (scalar-operand add records a 1-input op)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4], "float32")
+        y = paddle.add(x, paddle.to_tensor(np.float32(2.0)))  # noqa: F841
+    path = str(tmp_path / "m")
+    static.save(main, path)  # must not raise
+    assert (tmp_path / "m.pdparams").exists()
+    assert (tmp_path / "m.pdmodel").exists()
