@@ -59,12 +59,15 @@ def _requires_grad(t: Tensor) -> bool:
     )
 
 
-def primitive_call(fn, *args, name: str = "", **kwargs):
+def primitive_call(fn, *args, name: str = "", attrs=None, **kwargs):
     """Run `fn(*arrays, **kwargs)` eagerly, recording a tape node if needed.
 
     `fn` must be a pure jax function of the positional array arguments; kwargs are
     static. Positional args may be Tensors, nested lists/tuples of Tensors, arrays,
-    or python scalars.
+    or python scalars. `attrs` is an optional dict of reference-convention op
+    attributes (strides/paddings/axis/...) recorded onto the static-mode
+    Operator so program exporters (static/pdmodel_export.py) can emit real
+    OpDescs; eager execution ignores it.
     """
     if kwargs:
         fn = functools.partial(fn, **kwargs)
@@ -73,7 +76,7 @@ def primitive_call(fn, *args, name: str = "", **kwargs):
     # of executing (hook installed by paddle_tpu.static.program)
     hook = _static_hook
     if hook is not None and hook[0](args):
-        return hook[1](fn, args, name)
+        return hook[1](fn, args, name, attrs)
 
     arrays = [_unwrap(a) for a in args]
 

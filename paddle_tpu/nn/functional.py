@@ -51,7 +51,7 @@ def relu6(x, name=None):
 
 
 def gelu(x, approximate=False, name=None):
-    return primitive_call(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x), name="gelu")
+    return primitive_call(lambda a: jax.nn.gelu(a, approximate=approximate), _t(x), name="gelu", attrs={"approximate": bool(approximate)})
 
 
 def sigmoid(x, name=None):
@@ -74,7 +74,10 @@ def softmax(x, axis=-1, dtype=None, name=None):
             a = a.astype(to_jax_dtype(dtype))
         return jax.nn.softmax(a, axis=axis)
 
-    return primitive_call(f, _t(x), name="softmax")
+    attrs = {"axis": axis}
+    if dtype is not None:
+        attrs["cast_dtype"] = str(dtype)  # exporter must not drop the cast
+    return primitive_call(f, _t(x), name="softmax", attrs=attrs)
 
 
 def temperature_scaled_softmax(x, temperature=1.0, axis=-1, name=None):
@@ -252,7 +255,10 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         return out.astype(a.dtype)
 
     args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
-    return primitive_call(f, *args, name="conv2d")
+    return primitive_call(f, *args, name="conv2d", attrs={
+        "strides": list(stride), "paddings_raw": padding,
+        "dilations": list(dilation), "groups": groups,
+        "data_format": data_format})
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
@@ -351,7 +357,11 @@ def _pool(x, kernel, stride, padding, reducer, init, data_format="NCHW", avg=Fal
             return out / counts
         return out
 
-    return primitive_call(f, _t(x), name="pool")
+    return primitive_call(f, _t(x), name="pool", attrs={
+        "ksize": list(kernel), "strides_attr": list(stride),
+        "paddings_raw": padding, "pooling_type": "avg" if avg else "max",
+        "ceil_mode": ceil_mode, "exclusive": exclusive,
+        "data_format": data_format})
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
@@ -483,7 +493,9 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     args = [_t(x), _t(running_mean).detach(), _t(running_var).detach()]
     if weight is not None:
         args += [_t(weight), _t(bias)]
-    out = primitive_call(f, *args, name="batch_norm")
+    out = primitive_call(f, *args, name="batch_norm", attrs={
+        "epsilon": epsilon, "momentum": momentum,
+        "data_layout": data_format, "use_batch_stats": use_batch_stats})
 
     if use_batch_stats and isinstance(running_mean, Tensor):
         # update running stats in-place (buffer semantics, excluded from autograd)
@@ -526,7 +538,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=
     args = [_t(x)]
     if weight is not None:
         args += [_t(weight), _t(bias)]
-    return primitive_call(f, *args, name="layer_norm")
+    return primitive_call(f, *args, name="layer_norm", attrs={
+        "epsilon": epsilon, "norm_nd": nd})
 
 
 def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
@@ -593,6 +606,14 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 
 # ------------------------------------------------------------------ embedding / dropout
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    # reference semantics (nn/functional/input.py embedding): a negative
+    # padding_idx counts from the end of the vocab; -1 internally is the
+    # kNoPadding sentinel, so normalize BEFORE recording/masking
+    if padding_idx is not None:
+        padding_idx = int(padding_idx)
+        if padding_idx < 0:
+            padding_idx += int(_t(weight).shape[0])
+
     def f(idx, w):
         out = jnp.take(w, idx.astype(jnp.int32), axis=0)
         if padding_idx is not None:
@@ -613,7 +634,8 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             and not isinstance(wt._value, jax.core.Tracer)
             and not isinstance(xt._value, jax.core.Tracer)):
         return _sparse_embedding(xt, wt, padding_idx, f)
-    return primitive_call(f, xt, wt, name="embedding")
+    return primitive_call(f, xt, wt, name="embedding", attrs={
+        "padding_idx": -1 if padding_idx is None else int(padding_idx)})
 
 
 def _sparse_embedding(xt, wt, padding_idx, fwd):

@@ -58,6 +58,16 @@ class Executor:
             weakref.finalize(program, _evict_serial, weakref.ref(self), serial)
         return serial
 
+    def _cache_key(self, program, feed, fetches):
+        return (self._program_serial(program), tuple(sorted(feed.keys())),
+                tuple(getattr(f, "name", str(f)) for f in fetches))
+
+    @staticmethod
+    def _feed_arrays(feed):
+        return {k: jnp.asarray(np.asarray(
+            v.numpy() if isinstance(v, Tensor) else v
+        )) for k, v in feed.items()}
+
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True):
         program = program or default_main_program()
@@ -69,14 +79,11 @@ class Executor:
                 [Tensor(o) for o in outs]
         fetch_list = fetch_list or []
         fetches = [f for f in fetch_list]
-        key = (self._program_serial(program), tuple(sorted(feed.keys())),
-               tuple(getattr(f, "name", str(f)) for f in fetches))
+        key = self._cache_key(program, feed, fetches)
         if key not in self._cache:
             self._cache[key] = _lower(program, sorted(feed.keys()), fetches)
         runner = self._cache[key]
-        feed_arrays = {k: jnp.asarray(np.asarray(
-            v.numpy() if isinstance(v, Tensor) else v
-        )) for k, v in feed.items()}
+        feed_arrays = self._feed_arrays(feed)
         outs = runner(feed_arrays)
         if scope is not None:
             # persist fetches into the caller's Scope (reference: executor
@@ -86,6 +93,39 @@ class Executor:
         if return_numpy:
             return [np.asarray(o) for o in outs]
         return [Tensor(o) for o in outs]
+
+    def cost_analysis(self, program=None, feed=None, fetch_list=None):
+        """XLA cost analysis of this program's compiled whole-program
+        computation: {flops, bytes_accessed} straight from the compiler
+        (reference analog: core.CostModel.ProfileMeasure,
+        cost_model/cost_model.py:44 — there a GPU profiler replay; here the
+        compiler's own cost model of the single XLA computation).
+
+        Side effect: executes the program ONCE (the compiled runner and any
+        optimizer/scaler state must exist before AOT lowering) — for a
+        training program that is one real optimizer step. Don't interleave
+        with a run whose trajectory must be bit-reproducible."""
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if hasattr(program, "_exported_call"):
+            raise ValueError(
+                "cost_analysis needs a traced Program; inference artifacts "
+                "loaded via load_inference_model are already compiled — "
+                "use CompCostModel.analyze on the callable instead "
+                "(distributed/auto_parallel/cost_model.py)")
+        # run once so the compiled runner (and any optimizer state) exists
+        self.run(program, feed=feed, fetch_list=fetch_list)
+        runner = self._cache[self._cache_key(program, feed, fetch_list)]
+        feed_arrays = self._feed_arrays(feed)
+        ca = runner._aot_lower(feed_arrays).compile().cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return {
+            "flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed",
+                                           ca.get("bytes_accessed", 0.0))),
+        }
 
     def close(self):
         self._cache.clear()
@@ -206,6 +246,11 @@ def _lower(program: Program, feed_names, fetch_list):
             pa = [p._value for p in params]
             return fwd(feed_arrays, pa, rng_mod.next_rng_key())
 
+        # lowering only traces — a fixed key keeps the global RNG stream
+        # untouched (cost_analysis must not perturb training reproducibility)
+        runner._aot_lower = lambda feed_arrays: fwd.lower(
+            feed_arrays, [p._value for p in params], jax.random.PRNGKey(0)
+        )
         return runner
 
     optimizer, loss_var = spec
@@ -400,6 +445,18 @@ def _lower(program: Program, feed_names, fetch_list):
         # loss fetch may be among fetch_list already; return fetches as-is
         return fetches
 
+    def _aot_lower(feed_arrays):
+        # requires one prior runner() call so optimizer/gm/ls state exists;
+        # fixed key: lowering only traces, and must not advance the RNG
+        return train_step.lower(
+            [p._value for p in trainable], [p._value for p in frozen],
+            feed_arrays, jax.random.PRNGKey(0), opt_state["s"],
+            jnp.asarray(optimizer.get_lr(), jnp.float32),
+            gm_buf["s"] if k_steps > 1 else (),
+            ls_buf["s"] if ls_enabled else (),
+        )
+
+    runner._aot_lower = _aot_lower
     return runner
 
 

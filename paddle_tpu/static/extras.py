@@ -346,13 +346,23 @@ def normalize_program(program, feed_vars, fetch_vars):
 
 def save(program, model_path, protocol=4):
     """program + persistables to `<path>.pdmodel` / `<path>.pdparams`
-    (reference static.save)."""
+    (reference static.save). load() reads only the .pdparams side; the
+    .pdmodel here is a real ProgramDesc protobuf when every op has a
+    pdmodel emitter, else a debug text dump (training programs contain
+    ops with no OpDesc mapping — grads/optimizer updates)."""
     with open(model_path + ".pdparams", "wb") as f:
         f.write(serialize_persistables(program))
     from .io import serialize_program
 
+    try:
+        blob = serialize_program(program)
+    except NotImplementedError:
+        # emitter gap (unmapped op, scalar-operand arity) → load() never
+        # reads this file, keep the debug dump. Other exception types are
+        # real exporter bugs and must stay loud.
+        blob = repr(program).encode()
     with open(model_path + ".pdmodel", "wb") as f:
-        f.write(serialize_program(program))
+        f.write(blob)
 
 
 def load(program, model_path, executor=None, var_list=None):

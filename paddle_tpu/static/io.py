@@ -64,8 +64,18 @@ def _lower_forward(program: Program, feed_vars, fetch_vars):
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
-                         program=None, **kwargs):
-    """reference: python/paddle/static/io.py save_inference_model."""
+                         program=None, program_format="stablehlo", **kwargs):
+    """reference: python/paddle/static/io.py save_inference_model.
+
+    program_format="stablehlo" (default) writes the TPU-native compiled
+    artifact; "pdmodel" writes a REAL ProgramDesc protobuf + LoDTensor
+    params pair consumable by actual Paddle inference stacks
+    (static/pdmodel_export.py)."""
+    if program_format == "pdmodel":
+        from .pdmodel_export import save_inference_model_pdmodel
+
+        return save_inference_model_pdmodel(
+            path_prefix, feed_vars, fetch_vars, program=program)
     program = program or default_main_program()
     feed_vars = list(feed_vars)
     fetch_vars = list(fetch_vars)
@@ -168,9 +178,17 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
     return prog, prog.feed_names, prog.fetch_names
 
 
-def serialize_program(program=None):
+def serialize_program(program=None, feed_vars=(), fetch_vars=()):
+    """ProgramDesc protobuf bytes (reference: static/io.py
+    serialize_program). Ops must be in the pdmodel emitter set
+    (static/pdmodel_export.py); params are not included (use
+    save_inference_model for the full artifact pair)."""
+    from .pdmodel_export import serialize_program_desc
+
     program = program or default_main_program()
-    return repr(program).encode()
+    blob, _ = serialize_program_desc(program, list(feed_vars),
+                                     list(fetch_vars))
+    return blob
 
 
 def deserialize_program(data):  # pragma: no cover - parity shim

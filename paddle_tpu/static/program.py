@@ -242,7 +242,7 @@ def data(name, shape, dtype="float32", lod_level=0):
 
 
 # --------------------------------------------------------------- static tracer
-def _static_record(fn, args, name):
+def _static_record(fn, args, name, attrs=None):
     """Called from core.dispatch when static mode is active: append an Operator."""
     prog = default_main_program()
     block = prog.current_block()
@@ -260,6 +260,8 @@ def _static_record(fn, args, name):
     ]
     op = Operator(name or getattr(fn, "__name__", "op"), fn, list(args), outputs,
                   op_role=_current_role[-1])
+    if attrs:
+        op.attrs.update(attrs)
     if _current_device[-1] is not None:
         op.attrs["device"] = _current_device[-1]
     block.append_op(op)

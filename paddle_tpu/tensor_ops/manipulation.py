@@ -32,7 +32,8 @@ def reshape(x, shape, name=None):
     shp = _shape_list(shape)
     # paddle semantics: 0 means copy the corresponding input dim
     shp = tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shp))
-    return primitive_call(lambda a: jnp.reshape(a, shp), _to_t(x), name="reshape")
+    return primitive_call(lambda a: jnp.reshape(a, shp), _to_t(x), name="reshape",
+                          attrs={"shape": [int(v) for v in shp]})
 
 
 def reshape_(x, shape, name=None):
@@ -42,7 +43,8 @@ def reshape_(x, shape, name=None):
 
 
 def transpose(x, perm, name=None):
-    return primitive_call(lambda a: jnp.transpose(a, tuple(perm)), _to_t(x), name="transpose")
+    return primitive_call(lambda a: jnp.transpose(a, tuple(perm)), _to_t(x), name="transpose",
+                          attrs={"axis": [int(v) for v in perm]})
 
 
 def t(x, name=None):
@@ -56,7 +58,8 @@ def moveaxis(x, source, destination, name=None):
 def concat(x, axis=0, name=None):
     axis = int(axis.item()) if isinstance(axis, Tensor) else int(axis)
     ts = [_to_t(v) for v in x]
-    return primitive_call(lambda xs: jnp.concatenate(list(xs), axis=axis), ts, name="concat")
+    return primitive_call(lambda xs: jnp.concatenate(list(xs), axis=axis), ts, name="concat",
+                          attrs={"axis": axis})
 
 
 def stack(x, axis=0, name=None):
@@ -147,7 +150,8 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
         new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
         return jnp.reshape(a, new_shape)
 
-    return primitive_call(f, _to_t(x), name="flatten")
+    return primitive_call(f, _to_t(x), name="flatten",
+                          attrs={"start_axis": start_axis, "stop_axis": stop_axis})
 
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):

@@ -65,7 +65,8 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
             b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
         return jnp.matmul(a, b)
 
-    return primitive_call(f, x, y, name="matmul")
+    return primitive_call(f, x, y, name="matmul",
+                          attrs={"trans_x": bool(transpose_x), "trans_y": bool(transpose_y)})
 
 
 def _wrap1(op_name, f):
@@ -244,7 +245,9 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
         out = a * s + bias if bias_after_scale else (a + bias) * s
         return out
 
-    return primitive_call(f, x, name="scale")
+    return primitive_call(f, x, name="scale", attrs={
+        "scale": float(s), "bias": float(bias),
+        "bias_after_scale": bool(bias_after_scale)})
 
 
 def increment(x, value=1.0, name=None):

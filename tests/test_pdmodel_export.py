@@ -1,0 +1,159 @@
+"""Real-format .pdmodel/.pdiparams EXPORT (static/pdmodel_export.py) —
+round-tripped through the independent ProgramDesc wire parser + executor in
+inference/pdmodel.py (itself validated against genuine Paddle fixtures in
+test_pdmodel_interop.py). Closes the artifact-interop loop both directions:
+reference → paddle_tpu (load) and paddle_tpu → reference (export)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _export_and_reload(tmp_path, main, startup, feeds, fetches, feed_dict):
+    exe = static.Executor()
+    exe.run(startup)
+    want = exe.run(main, feed=feed_dict, fetch_list=fetches)
+
+    prefix = str(tmp_path / "model")
+    out = static.save_inference_model(prefix, feeds, fetches,
+                                      program=main, program_format="pdmodel")
+    assert out.endswith(".pdmodel")
+    # file must be raw protobuf, not pickle
+    with open(prefix + ".pdmodel", "rb") as f:
+        head = f.read(1)
+    assert head == b"\x0a"  # field 1 LEN — ProgramDesc.blocks
+
+    prog, feed_names, fetch_names = static.load_inference_model(prefix)
+    got = prog._exported_call(feed_dict)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-5, atol=1e-5)
+    return prog
+
+
+def test_export_lenet_style_conv_net(tmp_path, static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 1, 28, 28], "float32")
+        net_out = paddle.nn.functional.conv2d(
+            x, paddle.to_tensor(
+                np.random.randn(6, 1, 5, 5).astype("float32") * 0.1),
+            bias=paddle.to_tensor(np.zeros(6, "float32")), padding=2)
+        net_out = paddle.nn.functional.relu(net_out)
+        net_out = paddle.nn.functional.max_pool2d(net_out, 2, 2)
+        net_out = paddle.flatten(net_out, 1)
+        w = paddle.to_tensor(
+            np.random.randn(6 * 14 * 14, 10).astype("float32") * 0.05)
+        b = paddle.to_tensor(np.zeros(10, "float32"))
+        logits = paddle.nn.functional.linear(net_out, w, b)
+        probs = paddle.nn.functional.softmax(logits, axis=-1)
+    feed = {"x": np.random.rand(2, 1, 28, 28).astype("float32")}
+    prog = _export_and_reload(tmp_path, main, startup, [x], [probs], feed)
+    # persistable params made it into the .pdiparams stream
+    assert len(prog._prog.param_names) == 4
+
+
+def test_export_transformer_style_block(tmp_path, static_mode):
+    d = 16
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = static.data("ids", [2, 8], "int64")
+        table = paddle.to_tensor(np.random.randn(50, d).astype("float32") * 0.1)
+        h = paddle.nn.functional.embedding(ids, table)
+        g = paddle.to_tensor(np.ones(d, "float32"))
+        beta = paddle.to_tensor(np.zeros(d, "float32"))
+        h = paddle.nn.functional.layer_norm(h, [d], weight=g, bias=beta)
+        wq = paddle.to_tensor(np.random.randn(d, d).astype("float32") * 0.1)
+        q = paddle.matmul(h, wq)
+        att = paddle.matmul(q, q, transpose_y=True)
+        att = paddle.nn.functional.softmax(
+            paddle.scale(att, scale=1.0 / np.sqrt(d)), axis=-1)
+        ctxv = paddle.matmul(att, h)
+        out = paddle.add(h, ctxv)
+        out = paddle.nn.functional.gelu(out)
+    feed = {"ids": np.random.randint(0, 50, (2, 8)).astype("int64")}
+    _export_and_reload(tmp_path, main, startup, [ids], [out], feed)
+
+
+def test_export_batch_norm_and_transpose(tmp_path, static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 3, 8, 8], "float32")
+        rm = paddle.to_tensor(np.zeros(3, "float32"))
+        rv = paddle.to_tensor(np.ones(3, "float32"))
+        sc = paddle.to_tensor(np.random.rand(3).astype("float32") + 0.5)
+        bi = paddle.to_tensor(np.random.randn(3).astype("float32"))
+        h = paddle.nn.functional.batch_norm(x, rm, rv, sc, bi, training=False)
+        h = paddle.transpose(h, [0, 2, 3, 1])
+        h = paddle.reshape(h, [2, 8 * 8 * 3])
+    feed = {"x": np.random.rand(2, 3, 8, 8).astype("float32")}
+    _export_and_reload(tmp_path, main, startup, [x], [h], feed)
+
+
+def test_export_unmapped_op_raises(tmp_path, static_mode):
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4], "float32")
+        y = paddle.erf(x)  # no pdmodel emitter
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        static.save_inference_model(str(tmp_path / "m"), [x], [y],
+                                    program=main, program_format="pdmodel")
+
+
+def test_serialize_program_is_parseable(static_mode):
+    from paddle_tpu.inference.pdmodel import parse_program_desc
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4, 4], "float32")
+        y = paddle.nn.functional.relu(paddle.matmul(x, x))
+    blob = static.serialize_program(main, feed_vars=[x], fetch_vars=[y])
+    desc = parse_program_desc(blob)
+    ops = [op["type"] for op in desc["blocks"][0]["ops"]]
+    assert ops == ["feed", "matmul_v2", "relu", "fetch"]
+    # attrs survive the wire round-trip
+    mm = desc["blocks"][0]["ops"][1]
+    assert mm["attrs"]["trans_x"] is False or mm["attrs"]["trans_x"] == 0
+
+
+def test_export_negative_padding_idx_and_pair_paddings(tmp_path, static_mode):
+    """Code-review r4 regressions: padding_idx=-1 must mean 'last vocab row'
+    (not the kNoPadding sentinel) after export, and pair-list conv paddings
+    must flatten instead of crashing."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        ids = static.data("ids", [2, 4], "int64")
+        table = paddle.to_tensor(np.random.randn(10, 8).astype("float32"))
+        emb = paddle.nn.functional.embedding(ids, table, padding_idx=-1)
+        x = static.data("x", [1, 2, 8, 8], "float32")
+        w = paddle.to_tensor(np.random.randn(2, 2, 3, 3).astype("float32"))
+        conv = paddle.nn.functional.conv2d(x, w, padding=[(1, 2), (0, 1)])
+    feed = {"ids": np.array([[0, 9, 3, 9], [9, 1, 2, 4]], np.int64),
+            "x": np.random.rand(1, 2, 8, 8).astype("float32")}
+    exe = static.Executor()
+    exe.run(startup)
+    want_emb = exe.run(main, feed=feed, fetch_list=[emb])[0]
+    # rows with id 9 (== vocab-1 == normalized -1) are zeroed in-framework
+    assert np.allclose(want_emb[0, 1], 0) and np.allclose(want_emb[1, 0], 0)
+    # recorded attr is the normalized non-negative index
+    emb_ops = [op for op in main.global_block.ops if op.type == "embedding"]
+    assert emb_ops[0].attrs["padding_idx"] == 9
+    # pair paddings export without crashing and conv op carries 4-int form
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [ids, x], [conv],
+                                program=main, program_format="pdmodel")
+    from paddle_tpu.inference.pdmodel import parse_program_desc
+
+    with open(prefix + ".pdmodel", "rb") as f:
+        desc = parse_program_desc(f.read())
+    conv_descs = [o for o in desc["blocks"][0]["ops"] if o["type"] == "conv2d"]
+    assert conv_descs[0]["attrs"]["paddings"] == [1, 2, 0, 1]
