@@ -273,3 +273,1005 @@ def accuracy(input, label, k=1, correct=None, total=None):
     from ..metric import accuracy as _acc
 
     return _acc(input, label, k=k)
+
+
+# --------------------------------------------------------------- batch 3
+# (reference fluid/layers/{nn,tensor,ops,loss,control_flow,detection,
+# learning_rate_scheduler,sequence_lod,rnn}.py — the long tail of 1.x
+# names, each keeping its fluid spelling and delegating to 2.x lowerings)
+
+# ---- activations / simple math
+def leaky_relu(x, alpha=0.02, name=None):
+    return F.leaky_relu(x, negative_slope=alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return F.elu(x, alpha=alpha)
+
+
+def relu6(x, threshold=6.0, name=None):
+    # fluid's threshold arg is honored (2.x relu6 hardcodes 6)
+    return paddle.clip(x, 0.0, threshold)
+
+
+def selu(x, scale=None, alpha=None, name=None):
+    kw = {}
+    if scale is not None:
+        kw["scale"] = scale
+    if alpha is not None:
+        kw["alpha"] = alpha
+    return F.selu(x, **kw)
+
+
+def mish(x, threshold=20, name=None):
+    # softplus with the fluid threshold cutoff: x > threshold passes through
+    sp = paddle.where(
+        paddle.greater_than(x, paddle.full([], float(threshold), "float32")),
+        x, F.softplus(x))
+    return paddle.multiply(x, paddle.tanh(sp))
+
+
+def swish(x, beta=1.0, name=None):
+    return paddle.multiply(x, F.sigmoid(paddle.scale(x, scale=beta)))
+
+
+def hard_swish(x, threshold=6.0, scale=6.0, offset=3.0, name=None):
+    # honor fluid's threshold/scale/offset (2.x hardswish fixes 6/6/3)
+    return paddle.multiply(
+        x, paddle.scale(paddle.clip(paddle.scale(x, bias=offset),
+                                    0.0, threshold), scale=1.0 / scale))
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return F.hardsigmoid(x, slope=slope, offset=offset)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return paddle.clip(x, t_min, t_max)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    return paddle.log(paddle.scale(paddle.exp(paddle.clip(
+        x, -threshold, threshold)), bias=1.0))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return paddle.stanh(x, scale_a=scale_a, scale_b=scale_b)
+
+
+def maxout(x, groups, name=None, axis=1):
+    return F.maxout(x, groups, axis=axis)
+
+
+def pow(x, factor=1.0, name=None):  # noqa: A001
+    return paddle.pow(x, factor)
+
+
+def elementwise_max(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.maximum(x, y), act)
+
+
+def elementwise_min(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.minimum(x, y), act)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.mod(x, y), act)
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.floor_divide(x, y), act)
+
+
+def elementwise_pow(x, y, axis=-1, act=None, name=None):
+    return _maybe_act(paddle.pow(x, y), act)
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    return F.normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def cos_sim(X, Y):
+    out = F.cosine_similarity(X, Y, axis=1)
+    return paddle.reshape(out, [-1, 1])
+
+
+def clip_by_norm(x, max_norm, name=None):
+    norm = paddle.sqrt(paddle.sum(paddle.multiply(x, x)))
+    factor = paddle.minimum(
+        paddle.full([], 1.0, "float32"),
+        paddle.divide(paddle.full([], float(max_norm), "float32"),
+                      paddle.maximum(norm, paddle.full([], 1e-12, "float32"))))
+    return paddle.multiply(x, factor)
+
+
+def sign(x, name=None):
+    return paddle.sign(x)
+
+
+# ---- reductions / logic / comparison
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    return paddle.all(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return paddle.any(input, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):
+    return paddle.prod(input, axis=dim, keepdim=keep_dim)
+
+
+def equal(x, y, cond=None, name=None):
+    return paddle.equal(x, y)
+
+
+def not_equal(x, y, cond=None, name=None):
+    return paddle.not_equal(x, y)
+
+
+def greater_than(x, y, cond=None, name=None):
+    return paddle.greater_than(x, y)
+
+
+def greater_equal(x, y, cond=None, name=None):
+    return paddle.greater_equal(x, y)
+
+
+def less_than(x, y, force_cpu=None, cond=None, name=None):
+    return paddle.less_than(x, y)
+
+
+def less_equal(x, y, cond=None, name=None):
+    return paddle.less_equal(x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return paddle.logical_and(x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return paddle.logical_or(x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return paddle.logical_xor(x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return paddle.logical_not(x)
+
+
+def is_empty(x, name=None):
+    return paddle.to_tensor(bool(int(paddle.numel(x).numpy()) == 0)) \
+        if not paddle.in_dynamic_mode() is False else \
+        paddle.equal(paddle.numel(x), paddle.full([], 0, "int64"))
+
+
+def isfinite(x, name=None):
+    return paddle.all(paddle.isfinite(x))
+
+
+def has_inf(x):
+    return paddle.any(paddle.isinf(x))
+
+
+def has_nan(x):
+    return paddle.any(paddle.isnan(x))
+
+
+# ---- tensor creation / manipulation
+def create_tensor(dtype, name=None, persistable=False):
+    return paddle.to_tensor(__import__("numpy").zeros((), dtype))
+
+
+def argsort(input, axis=-1, descending=False, name=None):
+    ids = paddle.argsort(input, axis=axis, descending=descending)
+    vals = paddle.sort(input, axis=axis, descending=descending)
+    return vals, ids
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return paddle.linspace(start, stop, num, dtype=dtype)
+
+
+def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32",
+        name=None):
+    out = paddle.eye(num_rows, num_columns, dtype=dtype)
+    if batch_shape:
+        for _ in batch_shape:
+            out = paddle.unsqueeze(out, 0)
+        out = paddle.expand(out, list(batch_shape) + list(out.shape[-2:]))
+    return out
+
+
+def ones_like(x, out=None, name=None):
+    return paddle.ones_like(x)
+
+
+def zeros_like(x, out=None, name=None):
+    return paddle.zeros_like(x)
+
+
+def diag(diagonal, name=None):
+    return paddle.diag(diagonal)
+
+
+def triu(input, diagonal=0, name=None):
+    return paddle.triu(input, diagonal)
+
+
+def range(start, end, step, dtype, name=None):  # noqa: A001
+    return paddle.arange(start, end, step, dtype)
+
+
+def reverse(x, axis, name=None):
+    return paddle.flip(x, axis if isinstance(axis, (list, tuple)) else [axis])
+
+
+def multiplex(inputs, index, name=None):
+    return paddle.multiplex(inputs, index)
+
+
+def strided_slice(input, axes, starts, ends, strides, name=None):
+    return paddle.strided_slice(input, axes, starts, ends, strides)
+
+
+def slice(input, axes, starts, ends, name=None):  # noqa: A001
+    return paddle.slice(input, axes, starts, ends)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    return paddle.crop(x, shape=shape, offsets=offsets)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    return paddle.crop(x, shape=shape, offsets=offsets)
+
+
+def expand_as(x, target_tensor, name=None):
+    return paddle.expand_as(x, target_tensor)
+
+
+def gather_nd(input, index, name=None):
+    return paddle.gather_nd(input, index)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return paddle.scatter_nd(index, updates, shape)
+
+
+def scatter_nd_add(ref, index, updates, name=None):
+    return paddle.scatter_nd_add(ref, index, updates)
+
+
+def unstack(x, axis=0, num=None):
+    return paddle.unstack(x, axis=axis, num=num)
+
+
+def unbind(input, axis=0):
+    return paddle.unbind(input, axis=axis)
+
+
+def unique(x, dtype="int32"):
+    out, index = paddle.unique(x, return_index=True)
+    return out, paddle.cast(index, dtype)
+
+
+def unique_with_counts(x, dtype="int32"):
+    out, index, counts = paddle.unique(x, return_index=True,
+                                       return_counts=True)
+    return out, paddle.cast(index, dtype), paddle.cast(counts, dtype)
+
+
+def increment(x, value=1.0, in_place=True):
+    out = paddle.scale(x, bias=float(value))
+    if in_place and hasattr(x, "_value"):
+        x._value = out._value
+        return x
+    return out
+
+
+def rank(input):
+    return paddle.rank(input)
+
+
+def size(input):
+    return paddle.numel(input)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    return paddle.shard_index(input, index_num, nshards, shard_id,
+                              ignore_value)
+
+
+def sums(input, out=None):
+    total = input[0]
+    for t in input[1:]:
+        total = paddle.add(total, t)
+    return total
+
+
+def sum(x):  # noqa: A001
+    if isinstance(x, (list, tuple)):
+        return sums(x)
+    return paddle.sum(x)
+
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    return F.pad(input, list(paddings), mode=mode.replace("edge", "replicate"),
+                 value=pad_value, data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    pads = []
+    for xs, ys in zip(x.shape, y.shape):
+        pads += [0, int(xs) - int(ys)]
+    return F.pad(y, pads, value=pad_value)
+
+
+def space_to_depth(x, blocksize, name=None):
+    return F.pixel_unshuffle(x, blocksize)
+
+
+def shuffle_channel(x, group, name=None):
+    return F.channel_shuffle(x, group)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return F.pixel_shuffle(x, upscale_factor)
+
+
+def fsp_matrix(x, y):
+    b, cx = x.shape[0], x.shape[1]
+    cy = y.shape[1]
+    h, w = x.shape[2], x.shape[3]
+    xf = paddle.reshape(x, [b, cx, -1])
+    yf = paddle.reshape(y, [b, cy, -1])
+    return paddle.scale(paddle.matmul(xf, paddle.transpose(yf, [0, 2, 1])),
+                        scale=1.0 / float(int(h) * int(w)))
+
+
+def add_position_encoding(input, alpha, beta, name=None):
+    import numpy as _np
+
+    b, s, d = (int(v) for v in input.shape)
+    pos = _np.arange(s, dtype="float32")[:, None]
+    half = d // 2
+    div = _np.power(10000.0, -_np.arange(half, dtype="float32") / half)
+    enc = _np.zeros((s, d), "float32")
+    enc[:, :half] = _np.sin(pos * div)
+    enc[:, half:2 * half] = _np.cos(pos * div)
+    return paddle.add(paddle.scale(input, scale=alpha),
+                      paddle.scale(paddle.to_tensor(enc), scale=beta))
+
+
+# ---- losses
+def mse_loss(input, label):
+    return F.mse_loss(input, label)
+
+
+def square_error_cost(input, label):
+    return F.square_error_cost(input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return F.log_loss(input, label, epsilon)
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    return F.kl_div(x, target, reduction=reduction)
+
+
+def huber_loss(input, label, delta):
+    diff = paddle.subtract(input, label)
+    abs_diff = paddle.abs(diff)
+    quad = paddle.scale(paddle.multiply(diff, diff), scale=0.5)
+    lin = paddle.scale(paddle.subtract(abs_diff,
+                                       paddle.full([], delta / 2.0,
+                                                   "float32")), scale=delta)
+    return paddle.where(paddle.less_equal(
+        abs_diff, paddle.full([], float(delta), "float32")), quad, lin)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    diff = paddle.subtract(x, y)
+    if inside_weight is not None:
+        diff = paddle.multiply(diff, inside_weight)
+    sigma2 = (sigma if sigma is not None else 1.0) ** 2
+    abs_diff = paddle.abs(diff)
+    thresh = paddle.full([], 1.0 / sigma2, "float32")
+    quad = paddle.scale(paddle.multiply(diff, diff), scale=0.5 * sigma2)
+    lin = paddle.subtract(abs_diff, paddle.full([], 0.5 / sigma2, "float32"))
+    out = paddle.where(paddle.less_than(abs_diff, thresh), quad, lin)
+    if outside_weight is not None:
+        out = paddle.multiply(out, outside_weight)
+    return paddle.sum(out, axis=-1, keepdim=True)
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    loss = F.binary_cross_entropy_with_logits(x, label, reduction="none")
+    mask = paddle.cast(paddle.not_equal(
+        label, paddle.full([], float(ignore_index), label.dtype)), x.dtype)
+    loss = paddle.multiply(loss, mask)
+    if normalize:
+        loss = paddle.divide(loss, paddle.maximum(
+            paddle.sum(mask), paddle.full([], 1.0, x.dtype)))
+    return loss
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian pairwise ranking (reference: fluid/layers/loss.py bpr_loss):
+    mean over the C-1 NEGATIVE classes of -log(sigmoid(pos - neg))."""
+    n_class = int(input.shape[-1])
+    onehot = F.one_hot(paddle.reshape(label, [-1]), n_class)
+    pos = paddle.sum(paddle.multiply(input, onehot), axis=-1, keepdim=True)
+    diff = paddle.subtract(input, pos)
+    loss = paddle.scale(paddle.log(paddle.scale(
+        F.sigmoid(paddle.scale(diff, scale=-1.0)), bias=1e-8)), scale=-1.0)
+    # exclude the positive column from the average (divisor C-1)
+    neg_mask = paddle.scale(onehot, scale=-1.0, bias=1.0)
+    total = paddle.sum(paddle.multiply(loss, neg_mask), axis=-1, keepdim=True)
+    return paddle.scale(total, scale=1.0 / max(n_class - 1, 1))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    return F.npair_loss(anchor, positive, labels, l2_reg)
+
+
+def rank_loss(label, left, right, name=None):
+    out = paddle.subtract(left, right)
+    return paddle.add(
+        paddle.subtract(F.softplus(out), paddle.multiply(label, out)),
+        paddle.zeros_like(out))
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    return F.margin_ranking_loss(left, right, label, margin=margin,
+                                 reduction="none")
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: fluid/layers/loss.py teacher_student_sigmoid_loss —
+    z = clip(x); loss = log(1+exp(-|z|)) + max(z,0) - z*label."""
+    z = paddle.clip(input, soft_max_lower_bound, soft_max_up_bound)
+    return paddle.subtract(
+        paddle.add(F.softplus(paddle.scale(paddle.abs(z), scale=-1.0)),
+                   paddle.maximum(z, paddle.zeros_like(z))),
+        paddle.multiply(z, label))
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    return F.dice_loss(input, label, epsilon)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return F.sigmoid_focal_loss(x, label, normalizer=fg_num, alpha=alpha,
+                                gamma=gamma, reduction="none")
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """reference: fluid/layers/loss.py center_loss — distance to a running
+    class-center table (the table updates eagerly like BN stats)."""
+    import numpy as _np
+
+    key = "_center_loss_centers_%d_%d" % (num_classes, int(input.shape[-1]))
+    store = center_loss.__dict__.setdefault("tables", {})
+    if key not in store:
+        store[key] = paddle.to_tensor(
+            _np.zeros((num_classes, int(input.shape[-1])), "float32"))
+    centers = store[key]
+    picked = F.embedding(paddle.reshape(label, [-1]), centers)
+    diff = paddle.subtract(input, picked)
+    loss = paddle.scale(paddle.sum(paddle.multiply(diff, diff),
+                                   axis=-1, keepdim=True), scale=0.5)
+    if update_center and paddle.in_dynamic_mode():
+        import jax.numpy as _jnp
+
+        lv = _np.asarray(paddle.reshape(label, [-1]).numpy())
+        dv = _np.asarray(diff.numpy())
+        counts = _np.bincount(lv, minlength=num_classes)[:, None] + 1.0
+        upd = _np.zeros(centers.shape, "float32")
+        _np.add.at(upd, lv, dv)
+        centers._value = centers._value + _jnp.asarray(
+            alpha * upd / counts)
+    return loss
+
+
+# ---- resize family
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1, data_format="NCHW"):
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "LINEAR": "linear",
+            "BICUBIC": "bicubic"}[resample.upper()]
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=mode, align_corners=align_corners,
+                         data_format=data_format)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1,
+                    data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True, data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners, 1, data_format)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format="NCW"):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="linear", align_corners=align_corners,
+                         data_format=data_format)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     actual_shape=None, align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode="trilinear", align_corners=align_corners,
+                         data_format=data_format)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short = min(h, w)
+    ratio = out_short_len / float(short)
+    return image_resize(input, [int(round(h * ratio)), int(round(w * ratio))],
+                        resample=resample)
+
+
+# ---- vision extras
+def grid_sampler(x, grid, name=None):
+    return F.grid_sample(x, grid)
+
+
+def affine_grid(theta, out_shape, name=None):
+    return F.affine_grid(theta, out_shape)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None,
+                   act=None):
+    shape = [1, -1, 1, 1] if data_layout == "NCHW" else [1, 1, 1, -1]
+    out = x
+    if scale is not None:
+        out = paddle.multiply(out, paddle.reshape(scale, shape))
+    if bias is not None:
+        out = paddle.add(out, paddle.reshape(bias, shape))
+    return _maybe_act(out, act)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    return F.temporal_shift(x, seg_num, shift_ratio)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return F.unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    cols = F.unfold(input, filter_size, stride, padding)
+    return paddle.transpose(cols, [0, 2, 1])
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    return F.local_response_norm(input, size=n, alpha=alpha * n, beta=beta,
+                                 k=k, data_format=data_format)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if pool_type == "max":
+        return F.adaptive_max_pool2d(input, pool_size,
+                                     return_mask=require_index)
+    return F.adaptive_avg_pool2d(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    if pool_type == "max":
+        return F.adaptive_max_pool3d(input, pool_size,
+                                     return_mask=require_index)
+    return F.adaptive_avg_pool3d(input, pool_size)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True, data_format="NCDHW"):
+    if global_pooling:
+        pool_size = [int(s) for s in input.shape[2:]]
+        pool_padding = 0
+    if pool_type == "max":
+        return F.max_pool3d(input, pool_size, pool_stride, pool_padding,
+                            ceil_mode=ceil_mode, data_format=data_format)
+    return F.avg_pool3d(input, pool_size, pool_stride, pool_padding,
+                        ceil_mode=ceil_mode, exclusive=exclusive,
+                        data_format=data_format)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    layer = _nn.Conv3DTranspose(
+        int(input.shape[1]), num_filters, filter_size, stride=stride,
+        padding=padding, dilation=dilation, groups=groups,
+        weight_attr=param_attr, bias_attr=bias_attr, data_format=data_format)
+    return _maybe_act(layer(input), act)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    layer = _nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size)
+    return _maybe_act(layer(x, y), act)
+
+
+# ---- detection (vision/ops lowerings)
+def iou_similarity(x, y, box_normalized=True, name=None):
+    from ..vision.ops import iou_similarity as _impl
+
+    return _impl(x, y, box_normalized)
+
+
+def box_clip(input, im_info, name=None):
+    from ..vision.ops import box_clip as _impl
+
+    return _impl(input, im_info)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    from ..vision.ops import prior_box as _impl
+
+    return _impl(input, image, min_sizes, max_sizes, aspect_ratios, variance,
+                 flip, clip, steps, offset, min_max_aspect_ratios_order)
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    from ..vision.ops import anchor_generator as _impl
+
+    return _impl(input, anchor_sizes, aspect_ratios, variance, stride, offset)
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    from ..vision.ops import bipartite_match as _impl
+
+    return _impl(dist_matrix, match_type, dist_threshold)
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    from ..vision.ops import multiclass_nms as _impl
+
+    return _impl(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                 nms_threshold, normalized, nms_eta, background_label)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0):
+    from ..vision.ops import yolo_box as _impl
+
+    return _impl(x, img_size, anchors, class_num, conf_thresh,
+                 downsample_ratio, clip_bbox, scale_x_y=scale_x_y)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    from ..vision.ops import box_coder as _impl
+
+    return _impl(prior_box, prior_box_var, target_box, code_type,
+                 box_normalized, axis=axis)
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, name=None,
+              rois_num=None):
+    from ..vision.ops import roi_align as _impl
+
+    return _impl(input, rois, rois_num, (pooled_height, pooled_width),
+                 spatial_scale, sampling_ratio)
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             rois_num=None, name=None):
+    from ..vision.ops import roi_pool as _impl
+
+    return _impl(input, rois, rois_num, (pooled_height, pooled_width),
+                 spatial_scale)
+
+
+# ---- learning-rate decay (fluid functions → 2.x LRScheduler objects; the
+# reference migration guide maps them the same way)
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    return paddle.optimizer.lr.NoamDecay(d_model, warmup_steps, learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    if staircase:
+        return paddle.optimizer.lr.StepDecay(learning_rate, decay_steps,
+                                             decay_rate)
+    return paddle.optimizer.lr.ExponentialDecay(
+        learning_rate, decay_rate ** (1.0 / decay_steps))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    import math as _math
+
+    if staircase:
+        return paddle.optimizer.lr.StepDecay(
+            learning_rate, decay_steps, _math.exp(-decay_rate))
+    return paddle.optimizer.lr.ExponentialDecay(
+        learning_rate, _math.exp(-decay_rate / decay_steps))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    return paddle.optimizer.lr.InverseTimeDecay(
+        learning_rate, decay_rate / decay_steps)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    return paddle.optimizer.lr.PolynomialDecay(
+        learning_rate, decay_steps, end_learning_rate, power, cycle)
+
+
+def piecewise_decay(boundaries, values):
+    return paddle.optimizer.lr.PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    return paddle.optimizer.lr.CosineAnnealingDecay(
+        learning_rate, step_each_epoch * epochs)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    return paddle.optimizer.lr.LinearWarmup(learning_rate, warmup_steps,
+                                            start_lr, end_lr)
+
+
+# ---- control flow / arrays / misc
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    from ..static import while_loop as _impl
+
+    return _impl(cond, body, loop_vars)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    from ..static import cond as _impl
+
+    return _impl(pred, true_fn, false_fn)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    from ..static import case as _impl
+
+    return _impl(pred_fn_pairs, default)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    from ..static import switch_case as _impl
+
+    return _impl(branch_index, branch_fns, default)
+
+
+def create_array(dtype):
+    return []
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = []
+    idx = int(i.numpy()) if hasattr(i, "numpy") else int(i)
+    while len(array) <= idx:
+        array.append(None)
+    array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    idx = int(i.numpy()) if hasattr(i, "numpy") else int(i)
+    return array[idx]
+
+
+def array_length(array):
+    return paddle.to_tensor(__import__("numpy").int64(len(array)))
+
+
+def tensor_array_to_tensor(input, axis=1, name=None, use_stack=False):
+    items = [t for t in input if t is not None]
+    out = paddle.stack(items, axis=axis) if use_stack \
+        else paddle.concat(items, axis=axis)
+    sizes = paddle.to_tensor(__import__("numpy").asarray(
+        [int(t.shape[axis]) if not use_stack else 1 for t in items], "int32"))
+    return out, sizes
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    store = autoincreased_step_counter.__dict__.setdefault("counters", {})
+    key = counter_name or "@STEP_COUNTER@"
+    val = store.get(key, begin - step) + step
+    store[key] = val
+    return paddle.to_tensor(__import__("numpy").int64(val))
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):  # noqa: A002
+    import numpy as _np
+
+    probs = _np.asarray(x.numpy(), "float64")
+    rng = _np.random.RandomState(seed if seed else None)
+    ids = [rng.choice(probs.shape[1], p=row / row.sum()) for row in probs]
+    return paddle.to_tensor(_np.asarray(ids, "int64"))
+
+
+def Assert(cond, data=None, summarize=20, name=None):
+    import numpy as _np
+
+    ok = bool(_np.all(_np.asarray(cond.numpy()))) if hasattr(cond, "numpy") \
+        else bool(cond)
+    if not ok:
+        raise ValueError(
+            f"Assert failed: {[_np.asarray(d.numpy())[:summarize] for d in (data or [])]}")
+    return cond
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    from ..static.extras import py_func as _impl
+
+    return _impl(func, x, out, backward_func)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """Levenshtein distance per pair (reference:
+    fluid/layers/nn.py edit_distance → edit_distance_op). Host computation —
+    the op is inherently data-dependent-loop shaped."""
+    import numpy as _np
+    from builtins import range as _range  # module-level `range` shadows it
+
+    a = _np.asarray(input.numpy())
+    b = _np.asarray(label.numpy())
+    n = a.shape[0]
+    dists = _np.zeros((n, 1), "float32")
+    seq_num = paddle.to_tensor(_np.int64(n))
+    for k in _range(n):
+        s = a[k][: int(input_length.numpy()[k])] if input_length is not None \
+            else a[k]
+        t = b[k][: int(label_length.numpy()[k])] if label_length is not None \
+            else b[k]
+        if ignored_tokens:
+            s = [v for v in s if v not in ignored_tokens]
+            t = [v for v in t if v not in ignored_tokens]
+        m, l = len(s), len(t)
+        dp = _np.arange(l + 1, dtype="float32")
+        for i in _range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in _range(1, l + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (s[i - 1] != t[j - 1]))
+        d = dp[l]
+        dists[k, 0] = d / max(l, 1) if normalized else d
+    return paddle.to_tensor(dists), seq_num
+
+
+def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
+            label_length=None):
+    return F.ctc_loss(input, label, input_length, label_length, blank=blank,
+                      reduction="none")
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    import numpy as _np
+
+    probs = _np.asarray(input.numpy())
+    ids = probs.argmax(-1)  # [B, T] or [T, B]? fluid uses [T*B, C] LoD; take batch-major
+    if ids.ndim == 1:
+        ids = ids[None]
+    outs = []
+    lens = []
+    for row in ids:
+        dedup = [int(v) for i, v in enumerate(row)
+                 if v != blank and (i == 0 or v != row[i - 1])]
+        outs.append(dedup)
+        lens.append(len(dedup))
+    width = max(1, max(lens))
+    canvas = _np.full((len(outs), width), padding_value, "int64")
+    for i, o in enumerate(outs):
+        canvas[i, : len(o)] = o
+    return paddle.to_tensor(canvas), paddle.to_tensor(
+        _np.asarray(lens, "int64"))
+
+
+# ---- rnn api (2.x cells/layers back the 1.x names)
+RNNCell = _nn.SimpleRNNCell
+GRUCell = _nn.GRUCell
+LSTMCell = _nn.LSTMCell
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    layer = _nn.RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return layer(inputs, initial_states, sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    layer = _nn.BiRNN(cell_fw, cell_bw, time_major=time_major)
+    return layer(inputs, initial_states, sequence_length)
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    hidden = size // 4
+    layer = _nn.LSTM(int(input.shape[-1]), hidden,
+                     direction="backward" if is_reverse else "forward")
+    init = None
+    if h_0 is not None:
+        init = (paddle.unsqueeze(h_0, 0), paddle.unsqueeze(c_0, 0))
+    out, (h, c) = layer(input, init)
+    return out, c
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False):
+    layer = _nn.GRU(int(input.shape[-1]), size,
+                    direction="backward" if is_reverse else "forward")
+    init = paddle.unsqueeze(h_0, 0) if h_0 is not None else None
+    out, h = layer(input, init)
+    return out
+
+
+def dynamic_lstmp(input, size, proj_size, **kwargs):
+    out, c = dynamic_lstm(input, size, **{k: v for k, v in kwargs.items()
+                                          if k in ("h_0", "c_0", "is_reverse")})
+    proj = _nn.Linear(size // 4, proj_size)
+    return proj(out), c
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    layer = _nn.LSTM(int(input.shape[-1]), hidden_size, num_layers=num_layers,
+                     direction="bidirect" if is_bidirec else "forward",
+                     dropout=dropout_prob, time_major=True)
+    out, (h, c) = layer(input, (init_h, init_c))
+    return out, h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    cell = _nn.GRUCell(int(input.shape[-1]), size // 3)
+    h = cell(input, hidden)
+    return h[0], h[1], h[0]
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    cell = _nn.LSTMCell(int(x_t.shape[-1]), int(hidden_t_prev.shape[-1]))
+    h, (hh, cc) = cell(x_t, (hidden_t_prev, cell_t_prev))
+    return hh, cc

@@ -420,3 +420,240 @@ def generate_proposals(*args, **kwargs):  # pragma: no cover - parity shim
         "generate_proposals (RPN decode) lands with the detection model zoo; "
         "compose yolo_box/box_coder + nms for proposal generation meanwhile"
     )
+
+
+# ------------------------------------------------- legacy detection op set
+# (reference: paddle/fluid/operators/detection/*; exposed via
+# fluid.layers.{prior_box,anchor_generator,iou_similarity,box_clip,
+# multiclass_nms,bipartite_match}. Static-shape jnp formulations.)
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """Pairwise IoU matrix [N,M] (reference:
+    detection/iou_similarity_op.cc)."""
+
+    def f(a, b):
+        if not box_normalized:
+            # pixel coords: +1 on widths/heights, matching the reference
+            area = lambda v: (v[..., 2] - v[..., 0] + 1) * (v[..., 3] - v[..., 1] + 1)
+            lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+            rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+            wh = jnp.clip(rb - lt + 1, 0)
+            inter = wh[..., 0] * wh[..., 1]
+            return inter / (area(a)[:, None] + area(b)[None, :] - inter)
+        return _box_iou(a, b)
+
+    return primitive_call(f, _t(x), _t(y), name="iou_similarity")
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to their image's boundaries (reference:
+    detection/box_clip_op.cc). im_info rows: [height, width, scale].
+    Batched form: boxes [B, M, 4] with im_info [B, 3] clips per image; flat
+    [N, 4] boxes require a single im_info row (the reference's LoD carries
+    the box→image map, which flat static shapes cannot)."""
+    bt = _t(input)
+    it = _t(im_info)
+    if bt.ndim == 2 and int(np.prod(it.shape)) > 3:
+        raise ValueError(
+            "flat [N,4] boxes with multi-image im_info are ambiguous without "
+            "LoD; pass boxes as [B, M, 4] aligned with im_info rows")
+
+    def f(boxes, info):
+        info2 = jnp.reshape(info, (-1, 3))
+        h = info2[:, 0] / info2[:, 2] - 1.0  # [B]
+        w = info2[:, 1] / info2[:, 2] - 1.0
+        if boxes.ndim == 3:  # [B, M, 4] — per-image bounds
+            h = h[:, None]
+            w = w[:, None]
+        else:
+            h = h[0]
+            w = w[0]
+        x1 = jnp.clip(boxes[..., 0], 0, w)
+        y1 = jnp.clip(boxes[..., 1], 0, h)
+        x2 = jnp.clip(boxes[..., 2], 0, w)
+        y2 = jnp.clip(boxes[..., 3], 0, h)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    return primitive_call(f, bt, it, name="box_clip")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior boxes (reference: detection/prior_box_op.cc). Returns
+    (boxes [H,W,P,4] normalized xyxy, variances [H,W,P,4])."""
+    feat = _t(input)
+    img = _t(image)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []  # (w, h) per prior, in pixels; max_sizes pairs POSITIONALLY
+    for mi, ms in enumerate(min_sizes):
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[mi]
+                whs.append((float(np.sqrt(ms * mx)),) * 2)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * float(np.sqrt(ar)), ms / float(np.sqrt(ar))))
+        else:
+            for ar in ars:
+                whs.append((ms * float(np.sqrt(ar)), ms / float(np.sqrt(ar))))
+            if max_sizes:
+                mx = max_sizes[mi]
+                whs.append((float(np.sqrt(ms * mx)),) * 2)
+
+    def f(_feat, _img):
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+        cxg, cyg = jnp.meshgrid(cx, cy)  # [fh, fw]
+        wh = jnp.asarray(whs, jnp.float32)  # [P, 2]
+        half_w = wh[:, 0] / 2.0
+        half_h = wh[:, 1] / 2.0
+        x1 = (cxg[..., None] - half_w) / iw
+        y1 = (cyg[..., None] - half_h) / ih
+        x2 = (cxg[..., None] + half_w) / iw
+        y2 = (cyg[..., None] + half_h) / ih
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [fh, fw, P, 4]
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    return primitive_call(f, feat, img, name="prior_box")
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variance,
+                     stride, offset=0.5, name=None):
+    """RPN anchors (reference: detection/anchor_generator_op.cc). Returns
+    (anchors [H,W,A,4] in pixels, variances same shape)."""
+    feat = _t(input)
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+
+    whs = []
+    for ar in aspect_ratios:
+        for s in anchor_sizes:
+            w = s / float(np.sqrt(ar))
+            h = s * float(np.sqrt(ar))
+            whs.append((w, h))
+
+    def f(_feat):
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * stride[1]
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * stride[0]
+        cxg, cyg = jnp.meshgrid(cx, cy)
+        wh = jnp.asarray(whs, jnp.float32)
+        x1 = cxg[..., None] - wh[:, 0] / 2
+        y1 = cyg[..., None] - wh[:, 1] / 2
+        x2 = cxg[..., None] + wh[:, 0] / 2
+        y2 = cyg[..., None] + wh[:, 1] / 2
+        anchors = jnp.stack([x1, y1, x2, y2], axis=-1)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               anchors.shape)
+        return anchors, var
+
+    return primitive_call(f, feat, name="anchor_generator")
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (reference:
+    detection/bipartite_match_op.cc). Returns (match_indices [N], the col→row
+    assignment with -1 for unmatched, match_distance [N])."""
+
+    def f(dist):
+        n, m = dist.shape
+
+        def body(carry, _):
+            d, row_idx, row_val = carry
+            flat = jnp.argmax(d)
+            i = (flat // m).astype(jnp.int32)
+            j = (flat % m).astype(jnp.int32)
+            v = d[i, j]
+            valid = v > -jnp.inf
+            row_idx = jnp.where(valid, row_idx.at[j].set(i), row_idx)
+            row_val = jnp.where(valid, row_val.at[j].set(v), row_val)
+            d = jnp.where(valid, d.at[i, :].set(-jnp.inf), d)
+            d = jnp.where(valid, d.at[:, j].set(-jnp.inf), d)
+            return (d, row_idx, row_val), None
+
+        init = (dist, jnp.full((m,), -1, jnp.int32), jnp.zeros((m,)))
+        (d, row_idx, row_val), _ = jax.lax.scan(
+            body, init, None, length=min(n, m))
+        if match_type == "per_prediction" and dist_threshold is not None:
+            # additionally match any unmatched column whose best row exceeds
+            # the threshold
+            best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+            best_val = jnp.max(dist, axis=0)
+            extra = (row_idx < 0) & (best_val >= dist_threshold)
+            row_idx = jnp.where(extra, best_row, row_idx)
+            row_val = jnp.where(extra, best_val, row_val)
+        return row_idx, row_val
+
+    return primitive_call(f, _t(dist_matrix), name="bipartite_match")
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None):
+    """Per-class NMS + global top-k (reference:
+    detection/multiclass_nms_op.cc). bboxes [N,4], scores [C,N]. Returns
+    [keep_top_k, 6] rows (class, score, x1, y1, x2, y2), score==-1 rows are
+    padding (the static-shape stand-in for the reference's LoD output)."""
+
+    def f(boxes, sc):
+        c, n = sc.shape
+        k = n if nms_top_k < 0 else min(nms_top_k, n)
+        if normalized:
+            iou = _box_iou(boxes, boxes)
+        else:
+            # pixel coords: +1 on widths/heights (reference multiclass_nms
+            # normalized=false path; same formula as iou_similarity above)
+            area = (boxes[:, 2] - boxes[:, 0] + 1) * \
+                   (boxes[:, 3] - boxes[:, 1] + 1)
+            lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+            rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+            wh = jnp.clip(rb - lt + 1, 0)
+            inter = wh[..., 0] * wh[..., 1]
+            iou = inter / (area[:, None] + area[None, :] - inter)
+
+        def per_class(ci):
+            s = sc[ci]
+            order = jnp.argsort(-s)[:k]
+            s_k = s[order]
+            iou_k = iou[order][:, order]
+
+            def body(keep, i):
+                over = (iou_k[i] > nms_threshold) & keep & (jnp.arange(k) < i)
+                good = ~jnp.any(over)
+                return keep.at[i].set(good), None
+
+            keep0 = jnp.zeros(k, bool).at[0].set(True)
+            keep, _ = jax.lax.scan(body, keep0, jnp.arange(1, k)) \
+                if k > 1 else (keep0, None)
+            keep &= s_k > score_threshold
+            keep &= ci != background_label
+            cls = jnp.full((k,), ci, jnp.float32)
+            return jnp.concatenate(
+                [cls[:, None], jnp.where(keep, s_k, -1.0)[:, None],
+                 boxes[order]], axis=1)  # [k, 6]
+
+        rows = jnp.concatenate([per_class(ci) for ci in range(c)], axis=0)
+        top = min(keep_top_k, rows.shape[0]) if keep_top_k > 0 \
+            else rows.shape[0]
+        sel = jnp.argsort(-rows[:, 1])[:top]
+        return rows[sel]
+
+    return primitive_call(f, _t(bboxes), _t(scores), name="multiclass_nms")

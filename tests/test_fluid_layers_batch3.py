@@ -1,0 +1,216 @@
+"""fluid.layers batch 3: the 1.x long tail (reference fluid/layers/*) —
+activations, reductions, losses, resize, detection, LR decay, arrays, RNN."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+
+L = fluid.layers
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, "float32"))
+
+
+def test_activation_tail():
+    x = _t([-2.0, -0.5, 0.5, 2.0])
+    np.testing.assert_allclose(L.brelu(x, 0.0, 1.0).numpy(), [0, 0, 0.5, 1])
+    assert L.leaky_relu(x, alpha=0.1).numpy()[0] == pytest.approx(-0.2)
+    np.testing.assert_allclose(L.relu6(_t([7.0])).numpy(), [6.0])
+    assert L.hard_sigmoid(x).numpy().min() >= 0
+    assert L.soft_relu(x).numpy().min() > 0
+    np.testing.assert_allclose(
+        L.swish(x, beta=1.0).numpy(),
+        (x.numpy() / (1 + np.exp(-x.numpy()))), rtol=1e-5)
+    m = L.maxout(paddle.to_tensor(np.random.rand(1, 4, 2, 2).astype("float32")), 2)
+    assert tuple(m.shape) == (1, 2, 2, 2)
+    np.testing.assert_allclose(L.pow(_t([2.0]), 3).numpy(), [8.0])
+
+
+def test_elementwise_and_reduce_tail():
+    x, y = _t([4.0, 7.0]), _t([3.0, 2.0])
+    np.testing.assert_allclose(L.elementwise_mod(x, y).numpy(), [1, 1])
+    np.testing.assert_allclose(L.elementwise_floordiv(x, y).numpy(), [1, 3])
+    np.testing.assert_allclose(L.elementwise_max(x, y).numpy(), [4, 7])
+    np.testing.assert_allclose(L.elementwise_pow(x, y).numpy(), [64, 49])
+    b = paddle.to_tensor(np.array([[True, False], [True, True]]))
+    assert L.reduce_all(b).numpy() == False  # noqa: E712
+    assert L.reduce_any(b).numpy() == True  # noqa: E712
+    np.testing.assert_allclose(
+        L.reduce_prod(_t([[2, 3], [4, 5]]), dim=1).numpy(), [6, 20])
+    assert bool(L.has_nan(_t([np.nan, 1.0])).numpy())
+    assert bool(L.has_inf(_t([np.inf])).numpy())
+    assert not bool(L.isfinite(_t([np.inf])).numpy())
+
+
+def test_comparison_and_logic():
+    x, y = _t([1.0, 2.0]), _t([2.0, 2.0])
+    assert list(L.less_than(x, y).numpy()) == [True, False]
+    assert list(L.equal(x, y).numpy()) == [False, True]
+    a = paddle.to_tensor(np.array([True, False]))
+    b = paddle.to_tensor(np.array([True, True]))
+    assert list(L.logical_xor(a, b).numpy()) == [False, True]
+
+
+def test_tensor_tail():
+    vals, ids = L.argsort(_t([3.0, 1.0, 2.0]))
+    np.testing.assert_allclose(vals.numpy(), [1, 2, 3])
+    assert list(ids.numpy()) == [1, 2, 0]
+    assert L.eye(3).numpy().trace() == 3
+    assert tuple(L.eye(2, 2, batch_shape=[4]).shape) == (4, 2, 2)
+    np.testing.assert_allclose(L.reverse(_t([1, 2, 3]), 0).numpy(), [3, 2, 1])
+    out = L.multiplex([_t([[1, 2]]), _t([[3, 4]])],
+                      paddle.to_tensor(np.array([[1]], "int32")))
+    np.testing.assert_allclose(out.numpy(), [[3, 4]])
+    assert int(L.size(_t([[1, 2], [3, 4]])).numpy()) == 4
+    assert int(L.rank(_t([[1.0]])).numpy()) == 2
+    np.testing.assert_allclose(L.range(0, 6, 2, "int64").numpy(), [0, 2, 4])
+    u, idx = L.unique(paddle.to_tensor(np.array([2, 3, 2], "int64")))
+    assert sorted(u.numpy().tolist()) == [2, 3]
+    padded = L.pad_constant_like(_t(np.zeros((3, 4))), _t(np.ones((2, 2))))
+    assert tuple(padded.shape) == (3, 4)
+    s = L.sums([_t([1.0]), _t([2.0]), _t([3.0])])
+    np.testing.assert_allclose(s.numpy(), [6.0])
+
+
+def test_loss_tail():
+    pred = _t([[0.7, 0.3], [0.2, 0.8]])
+    lbl = _t([[1.0, 0.0], [0.0, 1.0]])
+    assert L.mse_loss(pred, lbl).numpy() >= 0
+    assert L.square_error_cost(pred, lbl).numpy().shape == (2, 2)
+    h = L.huber_loss(_t([0.1, 3.0]), _t([0.0, 0.0]), delta=1.0)
+    np.testing.assert_allclose(h.numpy(), [0.005, 2.5], rtol=1e-5)
+    sl = L.smooth_l1(_t([[0.1, 3.0]]), _t([[0.0, 0.0]]))
+    assert sl.shape[-1] == 1
+    ce = L.sigmoid_cross_entropy_with_logits(_t([[0.0, 2.0]]), lbl[:1])
+    assert ce.numpy().shape == (1, 2)
+    b = L.bpr_loss(_t([[0.5, 0.1, 0.4]]),
+                   paddle.to_tensor(np.array([[0]], "int64")))
+    assert b.numpy().shape == (1, 1)
+    ts = L.teacher_student_sigmoid_loss(_t([[1.0]]), _t([[0.5]]))
+    assert np.isfinite(ts.numpy()).all()
+    rk = L.rank_loss(_t([[1.0]]), _t([[0.3]]), _t([[0.1]]))
+    assert np.isfinite(rk.numpy()).all()
+    cl = L.center_loss(_t(np.random.rand(4, 8)),
+                       paddle.to_tensor(np.array([0, 1, 0, 2], "int64")),
+                       num_classes=3, alpha=0.1)
+    assert cl.numpy().shape == (4, 1)
+
+
+def test_norm_and_similarity():
+    x = _t(np.random.rand(2, 8))
+    n = L.l2_normalize(x, axis=1)
+    np.testing.assert_allclose(np.linalg.norm(n.numpy(), axis=1), [1, 1],
+                               rtol=1e-5)
+    c = L.cos_sim(x, x)
+    np.testing.assert_allclose(c.numpy(), np.ones((2, 1)), rtol=1e-5)
+    clipped = L.clip_by_norm(_t([3.0, 4.0]), 1.0)
+    np.testing.assert_allclose(np.linalg.norm(clipped.numpy()), 1.0,
+                               rtol=1e-5)
+
+
+def test_resize_family():
+    x = paddle.to_tensor(np.random.rand(1, 3, 8, 8).astype("float32"))
+    assert tuple(L.resize_bilinear(x, [16, 16]).shape) == (1, 3, 16, 16)
+    assert tuple(L.resize_nearest(x, [4, 4]).shape) == (1, 3, 4, 4)
+    assert tuple(L.image_resize_short(x, 16).shape) == (1, 3, 16, 16)
+
+
+def test_vision_tail():
+    x = paddle.to_tensor(np.random.rand(2, 4, 4, 4).astype("float32"))
+    assert tuple(L.shuffle_channel(x, 2).shape) == (2, 4, 4, 4)
+    assert tuple(L.space_to_depth(x, 2).shape) == (2, 16, 2, 2)
+    sc = _t(np.random.rand(4))
+    out = L.affine_channel(x, scale=sc, bias=sc)
+    assert tuple(out.shape) == (2, 4, 4, 4)
+    cols = L.im2sequence(x, filter_size=2, stride=2)
+    assert cols.shape[1] == 4  # (4/2)*(4/2) patches
+    assert tuple(L.adaptive_pool2d(x, 2, "avg").shape) == (2, 4, 2, 2)
+
+
+def test_detection_ops():
+    boxes = _t([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30]])
+    iou = L.iou_similarity(boxes, boxes)
+    np.testing.assert_allclose(np.asarray(iou.numpy()).diagonal(),
+                               [1, 1, 1], rtol=1e-5)
+    im_info = _t([[12.0, 12.0, 1.0]])
+    clipped = L.box_clip(boxes, im_info)
+    assert clipped.numpy().max() <= 11.0
+    feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 64, 64), "float32"))
+    pb, pv = L.prior_box(feat, img, min_sizes=[16], aspect_ratios=[1.0, 2.0],
+                         flip=True)
+    assert pb.shape[-1] == 4 and pv.shape == pb.shape
+    an, av = L.anchor_generator(feat, [32, 64], [0.5, 1.0, 2.0],
+                                [0.1, 0.1, 0.2, 0.2], [16.0, 16.0])
+    assert an.shape[2] == 6
+    d = _t([[0.9, 0.1], [0.2, 0.8], [0.3, 0.3]])
+    mi, mv = L.bipartite_match(d)
+    assert list(mi.numpy()) == [0, 1]
+    scores = _t([[0.1, 0.2, 0.1], [0.9, 0.85, 0.05]])
+    nmsd = L.multiclass_nms(boxes, scores, 0.3, 10, 5)
+    assert nmsd.shape[-1] == 6
+
+
+def test_lr_decay_constructors():
+    import paddle_tpu.optimizer.lr as lr
+
+    assert isinstance(L.noam_decay(64, 100), lr.NoamDecay)
+    assert isinstance(L.exponential_decay(0.1, 100, 0.9), lr.ExponentialDecay)
+    assert isinstance(L.exponential_decay(0.1, 100, 0.9, staircase=True),
+                      lr.StepDecay)
+    assert isinstance(L.piecewise_decay([100], [0.1, 0.01]),
+                      lr.PiecewiseDecay)
+    assert isinstance(L.cosine_decay(0.1, 10, 3), lr.CosineAnnealingDecay)
+    assert isinstance(L.polynomial_decay(0.1, 100), lr.PolynomialDecay)
+    w = L.linear_lr_warmup(0.1, 10, 0.0, 0.1)
+    assert isinstance(w, lr.LinearWarmup)
+
+
+def test_array_ops_and_counters():
+    arr = L.create_array("float32")
+    i0 = paddle.to_tensor(np.int64(0))
+    L.array_write(_t([1.0, 2.0]), i0, arr)
+    L.array_write(_t([3.0, 4.0]), paddle.to_tensor(np.int64(1)), arr)
+    assert int(L.array_length(arr).numpy()) == 2
+    np.testing.assert_allclose(L.array_read(arr, i0).numpy(), [1, 2])
+    merged, sizes = L.tensor_array_to_tensor(arr, axis=0)
+    assert tuple(merged.shape) == (4,)
+    c1 = L.autoincreased_step_counter("t")
+    c2 = L.autoincreased_step_counter("t")
+    assert int(c2.numpy()) == int(c1.numpy()) + 1
+
+
+def test_edit_distance_and_ctc_decode():
+    a = paddle.to_tensor(np.array([[1, 2, 3, 0]], "int64"))
+    b = paddle.to_tensor(np.array([[1, 3, 3, 0]], "int64"))
+    d, n = L.edit_distance(a, b, normalized=False)
+    assert d.numpy()[0, 0] == 1.0 and int(n.numpy()) == 1
+    probs = _t(np.eye(4)[[1, 1, 0, 2]][None])  # blank=0: "1 1 _ 2" -> [1, 2]
+    ids, lens = L.ctc_greedy_decoder(probs, blank=0)
+    assert ids.numpy()[0, :2].tolist() == [1, 2]
+    assert int(lens.numpy()[0]) == 2
+
+
+def test_rnn_api_tail():
+    x = paddle.to_tensor(np.random.rand(2, 5, 8).astype("float32"))
+    out = L.dynamic_gru(x, 16)
+    assert tuple(out.shape) == (2, 5, 16)
+    out, c = L.dynamic_lstm(x, 64)  # size = 4*hidden
+    assert tuple(out.shape) == (2, 5, 16)
+    cell = L.GRUCell(8, 16)
+    o, h = L.rnn(cell, x)
+    assert tuple(o.shape) == (2, 5, 16)
+    hh, cc = L.lstm_unit(_t(np.random.rand(2, 8)),
+                         _t(np.random.rand(2, 16)),
+                         _t(np.random.rand(2, 16)))
+    assert tuple(hh.shape) == (2, 16)
+
+
+def test_assert_and_sampling():
+    L.Assert(paddle.to_tensor(np.array([True])))
+    with pytest.raises(ValueError, match="Assert"):
+        L.Assert(paddle.to_tensor(np.array([False])), data=[_t([1.0])])
+    ids = L.sampling_id(_t([[0.0, 1.0, 0.0]]), seed=3)
+    assert int(ids.numpy()[0]) == 1
