@@ -2,19 +2,17 @@
 # If the tunnel revives: run the bench ladder once (banks to
 # BENCH_TPU_HISTORY.jsonl), commit the history artifact, run the long-seq
 # A/B banked, commit again. One shot, then exit.
-cd /root/repo
+cd /root/repo || exit 1
 for i in $(seq 1 40); do
   if timeout 50 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) TUNNEL ALIVE - benching" >> /tmp/tpu_autobank.log
     timeout 700 python bench.py >> /tmp/tpu_autobank.log 2>&1
     if ! git diff --quiet BENCH_TPU_HISTORY.jsonl 2>/dev/null; then
-      git add BENCH_TPU_HISTORY.jsonl
-      git commit -q -m "Bank on-chip bench measurement (auto, tunnel revived)"
+      git commit -q -m "Bank on-chip bench measurement (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl
     fi
     BENCH_BANK=1 timeout 600 python tools/longseq_ab.py >> /tmp/tpu_autobank.log 2>&1
     if ! git diff --quiet BENCH_TPU_HISTORY.jsonl 2>/dev/null; then
-      git add BENCH_TPU_HISTORY.jsonl
-      git commit -q -m "Bank long-seq splash/flash A/B (auto, tunnel revived)"
+      git commit -q -m "Bank long-seq splash/flash A/B (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl
     fi
     echo "$(date -u +%H:%M:%S) autobank done" >> /tmp/tpu_autobank.log
     exit 0
