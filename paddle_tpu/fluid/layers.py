@@ -1275,3 +1275,1442 @@ def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
     cell = _nn.LSTMCell(int(x_t.shape[-1]), int(hidden_t_prev.shape[-1]))
     h, (hh, cc) = cell(x_t, (hidden_t_prev, cell_t_prev))
     return hh, cc
+
+
+# --------------------------------------------------------------- batch 4
+# decode family, distributions, legacy control-flow classes, detection tail,
+# selected-rows/LoD utilities (reference fluid/layers/{rnn,distributions,
+# control_flow,detection,nn,tensor}.py)
+
+# ---- decode family (nn.decode backs the 1.x names)
+from ..nn.decode import (  # noqa: F401,E402
+    BeamSearchDecoder,
+    Decoder,
+    dynamic_decode,
+    gather_tree,
+)
+
+
+class DecodeHelper:
+    """Sampling-strategy protocol for BasicDecoder (reference:
+    fluid/layers/rnn.py DecodeHelper): initialize/sample/next_inputs."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: read the next ground-truth step (rnn.py
+    TrainingHelper). Trace-safe: dynamic_decode drives steps inside
+    lax.while_loop, so time indexing uses dynamic_index_in_dim."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        self.inputs = inputs
+        self.sequence_length = sequence_length
+        self.time_major = time_major
+        self._axis = 0 if time_major else 1
+        self._steps = int(inputs.shape[self._axis])
+
+    def _step_input(self, time):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor as _T
+
+        x = self.inputs._value if hasattr(self.inputs, "_value") \
+            else jnp.asarray(self.inputs)
+        t = jnp.clip(jnp.asarray(time), 0, self._steps - 1)
+        return _T(jax.lax.dynamic_index_in_dim(x, t, self._axis,
+                                               keepdims=False))
+
+    def initialize(self):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor as _T
+
+        sl = self.sequence_length._value if hasattr(
+            self.sequence_length, "_value") else jnp.asarray(
+            self.sequence_length)
+        return self._step_input(0), _T(jnp.zeros(sl.shape, bool))
+
+    def sample(self, time, outputs, states):
+        return paddle.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor as _T
+
+        sl = self.sequence_length._value if hasattr(
+            self.sequence_length, "_value") else jnp.asarray(
+            self.sequence_length)
+        next_t = jnp.asarray(time) + 1
+        finished = _T(next_t >= sl.astype(next_t.dtype))
+        return finished, self._step_input(next_t), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Feed back argmax through an embedding fn (rnn.py
+    GreedyEmbeddingHelper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = start_tokens
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor as _T
+
+        st = self.start_tokens._value if hasattr(self.start_tokens, "_value") \
+            else jnp.asarray(self.start_tokens)
+        return self.embedding_fn(self.start_tokens), _T(
+            jnp.zeros(st.shape, bool))
+
+    def sample(self, time, outputs, states):
+        return paddle.argmax(outputs, axis=-1)
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor as _T
+
+        ids = sample_ids._value if hasattr(sample_ids, "_value") \
+            else jnp.asarray(sample_ids)
+        finished = _T(ids.astype(jnp.int64) == self.end_token)
+        return finished, self.embedding_fn(sample_ids), states
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Multinomial sampling feedback (rnn.py SampleEmbeddingHelper) —
+    jax.random.categorical with a time-folded key, trace-safe."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self.temperature = softmax_temperature
+        self.seed = seed if seed is not None else 0
+
+    def sample(self, time, outputs, states):
+        import jax
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor as _T
+
+        logits = outputs._value if hasattr(outputs, "_value") \
+            else jnp.asarray(outputs)
+        if self.temperature is not None:
+            logits = logits / self.temperature
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed),
+                                 jnp.asarray(time))
+        return _T(jax.random.categorical(key, logits, axis=-1))
+
+
+class BasicDecoder(Decoder):
+    """cell + helper + output layer (reference: rnn.py BasicDecoder).
+    step returns ((cell_outputs, sample_ids), next_states, next_inputs,
+    finished) like the reference's BasicDecoder.OutputWrapper."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, initial_cell_states):
+        (initial_inputs, initial_finished) = self.helper.initialize()
+        return initial_inputs, initial_cell_states, initial_finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_outputs, cell_states = self.cell(inputs, states, **kwargs)
+        if self.output_fn is not None:
+            cell_outputs = self.output_fn(cell_outputs)
+        sample_ids = self.helper.sample(time, cell_outputs, cell_states)
+        finished, next_inputs, next_states = self.helper.next_inputs(
+            time, cell_outputs, cell_states, sample_ids)
+        return (cell_outputs, sample_ids), next_states, next_inputs, finished
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One beam-search step (reference: fluid/layers/rnn.py beam_search →
+    beam_search_op): flat candidate top-k over beam_size*V accumulated
+    scores. Finished beams (pre_ids last token == end_id) are HELD: all
+    their candidates are masked to -inf except re-emitting end_id at the
+    frozen pre_score, like the reference op. Static-shape form over
+    [batch*beam, V] scores."""
+    import numpy as _np
+
+    sc = scores if is_accumulated else paddle.add(
+        paddle.log(scores), paddle.reshape(pre_scores, [-1, 1]))
+    b_times_k = int(sc.shape[0])
+    v = int(sc.shape[1])
+    batch = b_times_k // beam_size
+    if pre_ids is not None:
+        fin = paddle.equal(
+            paddle.reshape(paddle.cast(pre_ids, "int64"), [-1, 1]),
+            paddle.full([b_times_k, 1], float(end_id), "int64"))
+        end_col = paddle.cast(F.one_hot(
+            paddle.full([b_times_k], float(end_id), "int64"), v), "bool")
+        hold = paddle.where(
+            end_col,
+            paddle.expand_as(paddle.reshape(pre_scores, [-1, 1]), sc),
+            paddle.full(sc.shape, -1e9, "float32"))
+        sc = paddle.where(paddle.expand_as(fin, sc), hold, sc)
+    flat = paddle.reshape(sc, [batch, beam_size * v])
+    top_scores, top_idx = paddle.topk(flat, beam_size)
+    parent = paddle.floor_divide(
+        top_idx, paddle.full(top_idx.shape, v, top_idx.dtype))
+    token = paddle.mod(top_idx, paddle.full(top_idx.shape, v, top_idx.dtype))
+    selected_ids = paddle.reshape(token, [-1, 1])
+    selected_scores = paddle.reshape(top_scores, [-1, 1])
+    offsets = paddle.to_tensor(
+        (_np.arange(batch, dtype="int64") * beam_size)[:, None])
+    parent_flat = paddle.reshape(
+        paddle.add(paddle.cast(parent, "int64"), offsets), [-1])
+    if return_parent_idx:
+        return selected_ids, selected_scores, parent_flat
+    return selected_ids, selected_scores
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """Backtrace beam parents into full sequences (reference:
+    beam_search_decode_op): ids/scores are per-step lists of
+    (token [batch*beam, 1], parent_flat [batch*beam]) as produced by
+    beam_search(return_parent_idx=True). gather_tree runs on the
+    [T, batch, beam] view with WITHIN-BATCH parent indices
+    (parent_flat mod beam_size)."""
+    toks = paddle.cast(paddle.stack(
+        [paddle.reshape(t, [-1]) for t, _ in ids], axis=0), "int64")
+    parents = paddle.cast(paddle.stack(
+        [paddle.reshape(p, [-1]) for _, p in ids], axis=0), "int64")
+    t_steps = int(toks.shape[0])
+    batch = int(toks.shape[1]) // beam_size
+    toks3 = paddle.reshape(toks, [t_steps, batch, beam_size])
+    par3 = paddle.mod(
+        paddle.reshape(parents, [t_steps, batch, beam_size]),
+        paddle.full([t_steps, batch, beam_size], beam_size, "int64"))
+    from ..nn.decode import gather_tree as _gather
+
+    seqs = _gather(toks3, par3)
+    sc = paddle.stack([paddle.reshape(v, [-1]) for v in scores], axis=0)
+    return paddle.reshape(seqs, [t_steps, -1]), sc
+
+
+# ---- distributions (fluid.layers.distributions → paddle.distribution)
+from ..distribution import Categorical, Normal, Uniform  # noqa: F401,E402
+
+
+class MultivariateNormalDiag:
+    """reference: fluid/layers/distributions.py MultivariateNormalDiag —
+    diagonal-covariance Gaussian over the last axis."""
+
+    def __init__(self, loc, scale):
+        self.loc = loc
+        self.scale = scale  # diagonal COVARIANCE matrix per the reference
+
+    def _diag(self):
+        import numpy as _np
+
+        return paddle.to_tensor(_np.diagonal(
+            _np.asarray(self.scale.numpy()), axis1=-2, axis2=-1).copy())
+
+    def entropy(self):
+        import numpy as _np
+
+        d = self._diag()
+        k = int(d.shape[-1])
+        return paddle.scale(paddle.sum(paddle.log(d), axis=-1), scale=0.5,
+                            bias=0.5 * k * float(_np.log(2 * _np.pi * _np.e)))
+
+    def kl_divergence(self, other):
+        d0, d1 = self._diag(), other._diag()
+        delta = paddle.subtract(self.loc, other.loc)
+        term = paddle.sum(paddle.divide(
+            paddle.add(d0, paddle.multiply(delta, delta)), d1), axis=-1)
+        k = float(d0.shape[-1])
+        logdet = paddle.subtract(paddle.sum(paddle.log(d1), axis=-1),
+                                 paddle.sum(paddle.log(d0), axis=-1))
+        return paddle.scale(paddle.add(paddle.subtract(
+            term, paddle.full(term.shape, k, "float32")), logdet), scale=0.5)
+
+
+# ---- direct aliases / trivial
+scale = paddle.scale
+where = paddle.where
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    from ..static import auc as _impl
+
+    return _impl(input, label, curve=curve, num_thresholds=num_thresholds)
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..static import create_parameter as _impl
+
+    return _impl(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+                 default_initializer=default_initializer)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..static import create_global_var as _impl
+
+    return _impl(shape, value, dtype, persistable=persistable, name=name)
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    from ..static import Print as _impl
+
+    return _impl(input, first_n=first_n, message=message, summarize=summarize)
+
+
+def load(out, file_path, load_as_fp16=None):
+    from ..framework.io import load_binary_tensor
+
+    arr, _lod = load_binary_tensor(file_path)
+    out._value = paddle.to_tensor(arr)._value
+    return out
+
+
+def identity_loss(x, reduction="none"):
+    """reference: identity_loss op (the IPU loss-marker primitive)."""
+    if reduction in (0, "sum"):
+        return paddle.sum(x)
+    if reduction in (1, "mean"):
+        return paddle.mean(x)
+    return x
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    w = create_parameter([num_classes - 1, int(input.shape[-1])], "float32")
+    b = create_parameter([num_classes - 1], "float32", is_bias=True)
+    return F.hsigmoid_loss(input, label, num_classes, w, b,
+                           path_table=path_table, path_code=path_code)
+
+
+def mean_iou(input, label, num_classes):
+    """reference: mean_iou_op — per-class IoU from a confusion count."""
+    import numpy as _np
+
+    p = _np.asarray(input.numpy()).reshape(-1)
+    g = _np.asarray(label.numpy()).reshape(-1)
+    ious = []
+    out_wrong = _np.zeros(num_classes, "int32")
+    out_correct = _np.zeros(num_classes, "int32")
+    for c in __import__("builtins").range(num_classes):
+        inter = int(((p == c) & (g == c)).sum())
+        union = int(((p == c) | (g == c)).sum())
+        out_correct[c] = inter
+        out_wrong[c] = union - inter
+        if union:
+            ious.append(inter / union)
+    miou = float(_np.mean(ious)) if ious else 0.0
+    return (paddle.to_tensor(_np.float32(miou)),
+            paddle.to_tensor(out_wrong), paddle.to_tensor(out_correct))
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A001
+    """reference: hash_op (xxhash rows into buckets) — here a deterministic
+    polynomial row-hash with num_hash independent salts."""
+    import numpy as _np
+
+    x = _np.asarray(input.numpy(), "int64")
+    outs = []
+    for h in __import__("builtins").range(num_hash):
+        salt = 1000003 + 7919 * h
+        acc = _np.zeros(x.shape[0], "int64")
+        for col in __import__("builtins").range(x.shape[1]):
+            acc = acc * salt + x[:, col]
+        outs.append(_np.abs(acc) % hash_size)
+    return paddle.to_tensor(_np.stack(outs, -1).astype("int64"))
+
+
+def random_crop(x, shape, seed=None):
+    """reference: random_crop_op — crop `shape` from the TRAILING dims;
+    leading dims (batch/channels) pass through."""
+    import numpy as _np
+
+    xv = _np.asarray(x.numpy())
+    rng = _np.random.RandomState(seed)
+    off = xv.ndim - len(shape)
+    starts = [rng.randint(0, xv.shape[off + i] - shape[i] + 1)
+              for i in __import__("builtins").range(len(shape))]
+    sl = tuple(_np.s_[s:s + l] for s, l in zip(starts, shape))
+    return paddle.to_tensor(xv[(Ellipsis,) + sl])
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference: cvm_op — keep (use_cvm) or drop the leading show/click
+    columns of CTR embeddings."""
+    if use_cvm:
+        return input
+    return paddle.slice(input, [1], [2], [int(input.shape[1])])
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    import numpy as _np
+
+    dense = _np.zeros((x.height, *x.value.shape[1:]), x.value.dtype)
+    dense[_np.asarray(x.rows)] = _np.asarray(x.value)
+    return paddle.to_tensor(dense)
+
+
+def merge_selected_rows(x, name=None):
+    from ..core.selected_rows import SelectedRows
+    import numpy as _np
+
+    rows = _np.asarray(x.rows)
+    vals = _np.asarray(x.value)
+    uniq = _np.unique(rows)
+    merged = _np.zeros((len(uniq), *vals.shape[1:]), vals.dtype)
+    _np.add.at(merged, _np.searchsorted(uniq, rows), vals)
+    return SelectedRows(rows=uniq.tolist(), value=merged, height=x.height)
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference: lod_reset_op — re-segment x with new level-0 sequence
+    LENGTHS (from y's lod, y's int values, or target_lod)."""
+    import numpy as _np
+
+    from ..core.ragged import LoDTensor
+
+    values = x.value() if isinstance(x, LoDTensor) else x
+    if y is not None:
+        if isinstance(y, LoDTensor):
+            lens = y.recursive_sequence_lengths()[-1]
+        else:
+            lens = [int(v) for v in _np.asarray(y.numpy()).reshape(-1)]
+        return LoDTensor(values, [lens])
+    return LoDTensor(values, [list(map(int, target_lod))])
+
+
+def lod_append(x, level):
+    """reference: lod_append — add an inner LoD level (lengths)."""
+    from ..core.ragged import LoDTensor
+
+    values = x.value() if isinstance(x, LoDTensor) else x
+    lens = x.recursive_sequence_lengths() if isinstance(x, LoDTensor) else []
+    return LoDTensor(values, lens + [list(map(int, level))])
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return paddle.full(shape, value, dtype=dtype)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):  # noqa: A002
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return uniform_random(shape, dtype=dtype, min=min, max=max, seed=seed)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    shape = list(shape)
+    shape[output_dim_idx] = int(input.shape[input_dim_idx])
+    return gaussian_random(shape, mean=mean, std=std, seed=seed, dtype=dtype)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None, moving_mean_name=None,
+              moving_variance_name=None, do_model_average_for_mean_and_var=True,
+              slot_dim=-1, sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """reference: data_norm_op — normalization by accumulated batch
+    statistics (size/sum/square-sum counters). Parameter sharing follows
+    fluid's name-scoped reuse: a `name` keys one persistent accumulator;
+    anonymous calls normalize by the CURRENT batch only (no cross-call
+    state, since distinct call sites must not share counters)."""
+    import numpy as _np
+
+    d = int(input.shape[-1])
+    if name is None:
+        mean = paddle.mean(input, axis=0, keepdim=True)
+        centered = paddle.subtract(input, mean)
+        var = paddle.mean(paddle.multiply(centered, centered), axis=0,
+                          keepdim=True)
+        out = paddle.divide(centered, paddle.sqrt(paddle.add(
+            var, paddle.full(var.shape, epsilon, "float32"))))
+        return _maybe_act(out, act)
+    key = (str(name), d)
+    store = data_norm.__dict__.setdefault("stats", {})
+    if key not in store:
+        store[key] = {
+            "size": _np.full(d, 1e4, "float32"),
+            "sum": _np.zeros(d, "float32"),
+            "sqsum": _np.full(d, 1e4, "float32"),
+        }
+    st = store[key]
+    mean = paddle.to_tensor(st["sum"] / st["size"])
+    scale_v = paddle.to_tensor(_np.sqrt(st["size"] / st["sqsum"]))
+    out = paddle.multiply(paddle.subtract(input, mean), scale_v)
+    if paddle.in_dynamic_mode():
+        xv = _np.asarray(input.numpy()).reshape(-1, d)
+        st["size"] += xv.shape[0]
+        st["sum"] += xv.sum(0)
+        st["sqsum"] += (xv ** 2).sum(0)
+    return _maybe_act(out, act)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference: sample_logits_op — softmax CE over the true class plus
+    num_samples uniformly sampled negatives."""
+    import numpy as _np
+
+    n, v = int(logits.shape[0]), int(logits.shape[1])
+    rng = _np.random.RandomState(seed if seed else None)
+    neg = rng.randint(0, v, (n, num_samples))
+    lbl = _np.asarray(label.numpy()).reshape(n, num_true)
+    if remove_accidental_hits:
+        hit = neg == lbl[:, :1]
+        neg = _np.where(hit, (neg + 1) % v, neg)
+    idx = _np.concatenate([lbl[:, :1], neg], axis=1)  # [n, 1+S]
+    gathered = paddle.index_sample(
+        logits, paddle.to_tensor(idx.astype("int64")))
+    sampled_label = paddle.to_tensor(_np.zeros(n, "int64"))
+    return F.cross_entropy(gathered, sampled_label, reduction="none")
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """reference: linear_chain_crf_op — CRF negative log-likelihood via the
+    forward algorithm. Returns (alpha, transition_exps?, emission_exps?,
+    log_likelihood) in the reference; here (log_likelihood, transition)."""
+    import jax
+    import jax.numpy as jnp
+
+    n_tags = int(input.shape[-1])
+    trans = create_parameter([n_tags + 2, n_tags], "float32")
+
+    from ..core.dispatch import primitive_call as _pc
+
+    def f(emis, lbl, tr):
+        start, stop, body = tr[0], tr[1], tr[2:]
+        if emis.ndim == 2:
+            emis = emis[None]
+            lbl = lbl[None]
+        b, t, k = emis.shape
+
+        def fwd_one(e):
+            def step(alpha, e_t):
+                nxt = jax.scipy.special.logsumexp(
+                    alpha[:, None] + body, axis=0) + e_t
+                return nxt, None
+
+            alpha0 = start + e[0]
+            alphaT, _ = jax.lax.scan(step, alpha0, e[1:])
+            return jax.scipy.special.logsumexp(alphaT + stop)
+
+        logZ = jax.vmap(fwd_one)(emis)
+
+        def score_one(e, y):
+            em = jnp.take_along_axis(e, y[:, None], 1)[:, 0].sum()
+            tr_sc = body[y[:-1], y[1:]].sum()
+            return em + tr_sc + start[y[0]] + stop[y[-1]]
+
+        gold = jax.vmap(score_one)(emis, lbl)
+        return logZ - gold  # negative log-likelihood per sequence
+
+    nll = _pc(f, input, paddle.cast(label, "int64").detach(), trans,
+              name="linear_chain_crf")
+    return nll, trans
+
+
+# ---- detection tail
+def density_prior_box(input, image, densities=None, fixed_sizes=None,
+                      fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
+                      clip=False, steps=(0.0, 0.0), offset=0.5,
+                      flatten_to_2d=False, name=None):
+    """reference: density_prior_box_op — dense grid of fixed-size boxes per
+    cell (each density d contributes d*d shifted centers)."""
+    import numpy as _np
+
+    feat, img = input, image
+    fh, fw = int(feat.shape[2]), int(feat.shape[3])
+    ih, iw = int(img.shape[2]), int(img.shape[3])
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+    boxes = []
+    for y in __import__("builtins").range(fh):
+        for x in __import__("builtins").range(fw):
+            cx = (x + offset) * step_w
+            cy = (y + offset) * step_h
+            for size, density in zip(fixed_sizes, densities):
+                shift = size / density
+                for ratio in fixed_ratios:
+                    w = size * float(_np.sqrt(ratio))
+                    h = size / float(_np.sqrt(ratio))
+                    for r in __import__("builtins").range(density):
+                        for c in __import__("builtins").range(density):
+                            ccx = cx - size / 2 + shift / 2 + c * shift
+                            ccy = cy - size / 2 + shift / 2 + r * shift
+                            boxes.append([(ccx - w / 2) / iw,
+                                          (ccy - h / 2) / ih,
+                                          (ccx + w / 2) / iw,
+                                          (ccy + h / 2) / ih])
+    arr = _np.asarray(boxes, "float32").reshape(fh, fw, -1, 4)
+    if clip:
+        arr = arr.clip(0, 1)
+    var = _np.broadcast_to(_np.asarray(variance, "float32"),
+                           arr.shape).copy()
+    if flatten_to_2d:
+        arr = arr.reshape(-1, 4)
+        var = var.reshape(-1, 4)
+    return paddle.to_tensor(arr), paddle.to_tensor(var)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """reference: matrix_nms_op (SOLOv2) — parallel soft-suppression via the
+    pairwise IoU matrix instead of a sequential sweep."""
+    import numpy as _np
+
+    from ..vision.ops import _box_iou as _iou
+
+    out = []
+    b = _np.asarray(bboxes.numpy())
+    sc = _np.asarray(scores.numpy())
+    c, n = sc.shape
+    k = n if nms_top_k < 0 else min(nms_top_k, n)
+    for ci in __import__("builtins").range(c):
+        if ci == background_label:
+            continue
+        s = sc[ci]
+        order = _np.argsort(-s)[:k]
+        s_k = s[order]
+        keepable = s_k > score_threshold
+        boxes_k = b[order]
+        import jax.numpy as jnp
+
+        iou = _np.asarray(_iou(jnp.asarray(boxes_k), jnp.asarray(boxes_k)))
+        iou = _np.triu(iou, 1)
+        iou_cmax = iou.max(0)  # per-box max overlap with a higher-scored box
+        if use_gaussian:
+            decay = _np.exp(-(iou ** 2 - iou_cmax[None, :] ** 2)
+                            / gaussian_sigma).min(0)
+        else:
+            decay = ((1 - iou) / _np.maximum(1 - iou_cmax[None, :],
+                                             1e-10)).min(0)
+        dec_s = s_k * decay
+        for j in _np.nonzero(keepable & (dec_s > post_threshold))[0]:
+            out.append([ci, dec_s[j], *boxes_k[j]])
+    out.sort(key=lambda r: -r[1])
+    if keep_top_k > 0:
+        out = out[:keep_top_k]
+    arr = _np.asarray(out, "float32") if out else _np.zeros((0, 6), "float32")
+    res = paddle.to_tensor(arr)
+    if return_rois_num:
+        return res, paddle.to_tensor(_np.asarray([len(out)], "int32"))
+    return res
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """SSD post-process (reference: detection.py detection_output):
+    box_coder decode + multiclass_nms."""
+    if (len(scores.shape) == 3 and int(scores.shape[0]) > 1) or \
+            (len(loc.shape) == 3 and int(loc.shape[0]) > 1):
+        raise NotImplementedError(
+            "detection_output: batch > 1 needs per-image LoD output; run "
+            "per image (static shapes carry no box->image map)")
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size")
+    if len(decoded.shape) == 3:
+        decoded = paddle.squeeze(decoded, [0]) if int(decoded.shape[0]) == 1 \
+            else decoded
+    sc = scores
+    if len(sc.shape) == 3:  # [1, P, C] -> [C, P]
+        sc = paddle.transpose(paddle.squeeze(sc, [0]), [1, 0])
+    return multiclass_nms(decoded, sc, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold,
+                          background_label=background_label)
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """reference: target_assign_op — gather rows by match index, filling
+    mismatches (index < 0) with mismatch_value."""
+    import numpy as _np
+
+    x = _np.asarray(input.numpy())
+    mi = _np.asarray(matched_indices.numpy())
+    if x.ndim == 2:
+        x = x[None]
+    out = _np.full((mi.shape[0], mi.shape[1], x.shape[-1]),
+                   mismatch_value if mismatch_value is not None else 0,
+                   x.dtype)
+    wt = _np.zeros((mi.shape[0], mi.shape[1], 1), "float32")
+    for bidx in __import__("builtins").range(mi.shape[0]):
+        pos = mi[bidx] >= 0
+        out[bidx, pos] = x[min(bidx, x.shape[0] - 1)][mi[bidx, pos]]
+        wt[bidx, pos] = 1.0
+    return paddle.to_tensor(out), paddle.to_tensor(wt)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    decoded = box_coder(prior_box, prior_box_var, target_box,
+                        code_type="decode_center_size")
+    import numpy as _np
+
+    sc = _np.asarray(box_score.numpy())
+    best = sc.argmax(-1)
+    d = _np.asarray(decoded.numpy())
+    if d.ndim == 2:  # single-class decode
+        assigned = d
+    else:
+        assigned = d[_np.arange(d.shape[0]), best]
+    return decoded, paddle.to_tensor(assigned)
+
+
+def polygon_box_transform(input, name=None):
+    """reference: polygon_box_transform_op — EAST-style geometry maps:
+    offset channels become absolute quad coordinates."""
+    import numpy as _np
+
+    x = _np.asarray(input.numpy())
+    n, c, h, w = x.shape
+    out = x.copy()
+    xs = _np.arange(w)[None, None, None, :] * 4.0
+    ys = _np.arange(h)[None, None, :, None] * 4.0
+    out[:, 0::2] = xs - x[:, 0::2]
+    out[:, 1::2] = ys - x[:, 1::2]
+    return paddle.to_tensor(out.astype(x.dtype))
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=1, deformable_groups=1,
+                    im2col_step=1, param_attr=None, bias_attr=None,
+                    modulated=True, name=None):
+    from ..vision.ops import deform_conv2d as _impl
+
+    w = create_parameter(
+        [num_filters, int(input.shape[1]) // groups,
+         filter_size if isinstance(filter_size, int) else filter_size[0],
+         filter_size if isinstance(filter_size, int) else filter_size[1]],
+        "float32")
+    return _impl(input, offset, w, stride=stride, padding=padding,
+                 dilation=dilation, deformable_groups=deformable_groups,
+                 groups=groups, mask=mask if modulated else None)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    from ..vision.ops import distribute_fpn_proposals as _impl
+
+    return _impl(fpn_rois, min_level, max_level, refer_level, refer_scale,
+                 rois_num=rois_num)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None, name=None):
+    """reference: collect_fpn_proposals_op — concat per-level RoIs and keep
+    the global top-n by score."""
+    rois = paddle.concat(multi_rois, axis=0)
+    sc = paddle.reshape(paddle.concat(multi_scores, axis=0), [-1])
+    k = min(post_nms_top_n, int(sc.shape[0]))
+    _, idx = paddle.topk(sc, k)
+    return paddle.gather(rois, idx)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       return_rois_num=False, name=None):
+    from ..vision.ops import generate_proposals as _impl
+
+    return _impl(scores, bbox_deltas, im_info, anchors, variances,
+                 pre_nms_top_n, post_nms_top_n, nms_thresh, min_size, eta,
+                 return_rois_num=return_rois_num)
+
+
+# ---- legacy control-flow classes
+class While:
+    """reference: control_flow.py While — block-style while. The body
+    appends ops under `with while.block()`; here the modern while_loop is
+    the engine and this wrapper keeps 1.x source compiling."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond_var = cond
+        self._entered = False
+
+    def block(self):
+        raise NotImplementedError(
+            "While.block() builds LoD-era blocks; port to "
+            "fluid.layers.while_loop(cond_fn, body_fn, loop_vars) — same "
+            "semantics, functional form (static/control_flow.py)")
+
+
+class Switch:
+    """reference: control_flow.py Switch — case/default context managers
+    over switch_case."""
+
+    def __init__(self, name=None):
+        self._cases = []
+        self._default = None
+
+    def case(self, condition):
+        raise NotImplementedError(
+            "Switch.case blocks are LoD-era program surgery; port to "
+            "fluid.layers.case(pred_fn_pairs, default) "
+            "(static/control_flow.py)")
+
+    def default(self):
+        raise NotImplementedError(
+            "Switch.default: port to fluid.layers.case(..., default=fn)")
+
+
+class IfElse:
+    """reference: control_flow.py IfElse — port to cond()."""
+
+    def __init__(self, cond, name=None):
+        raise NotImplementedError(
+            "IfElse is LoD-era block surgery; port to "
+            "fluid.layers.cond(pred, true_fn, false_fn)")
+
+
+class StaticRNN:
+    """reference: control_flow.py StaticRNN — fixed-length RNN unrolled at
+    build time. step_input/memory/update_memory/step_output/() protocol."""
+
+    def __init__(self, name=None):
+        self._inputs = []
+        self._memories = []
+        self._outputs = []
+        self._built = False
+
+    def step(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            yield self
+
+        return ctx()
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        self._seq_len = int(x.shape[0])
+        return ("input", len(self._inputs) - 1)
+
+    def memory(self, init=None, shape=None, batch_ref=None, init_value=0.0,
+               init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        if init is None:
+            import numpy as _np
+
+            batch = int(batch_ref.shape[ref_batch_dim_idx]) if batch_ref is not None else 1
+            init = paddle.full([batch] + list(shape)[1:], init_value,
+                               "float32")
+        self._memories.append({"init": init, "update": None})
+        return ("mem", len(self._memories) - 1)
+
+    def update_memory(self, mem, var):
+        self._memories[mem[1]]["update"] = var
+
+    def step_output(self, o):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self):
+        raise NotImplementedError(
+            "StaticRNN's deferred-block build is LoD-era; port to "
+            "fluid.layers.rnn(cell, inputs) or paddle.nn.RNN — the cell "
+            "closure replaces step_input/memory bookkeeping")
+
+
+class DynamicRNN:
+    """reference: control_flow.py DynamicRNN — LoD-driven variable-length
+    RNN. Port to padded batches + paddle.nn.RNN with sequence_length."""
+
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "DynamicRNN consumes LoD tensors; port to padded batches with "
+            "fluid.layers.rnn(cell, inputs, sequence_length=...) — "
+            "sequence_mask covers the ragged tail")
+
+
+# ---- doc/codegen utilities (reference layers/layer_function_generator.py)
+def generate_activation_fn(op_type):
+    return getattr(F, op_type, None) or getattr(paddle, op_type)
+
+
+def generate_inplace_fn(op_type):
+    base = generate_activation_fn(op_type.rstrip("_"))
+
+    def inplace_fn(x, name=None):
+        from ..core.tape import graft_inplace
+
+        return graft_inplace(x, base(x))
+
+    return inplace_fn
+
+
+def generate_layer_fn(op_type):
+    return generate_activation_fn(op_type)
+
+
+def templatedoc(op_type=None):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+def autodoc(comment=""):
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+# ---- legacy reader plumbing: the modern path is io.DataLoader
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    raise NotImplementedError(
+        "py_reader/double_buffer are the deprecated 1.x feeding pipeline; "
+        "use paddle.io.DataLoader (io/dataloader.py — multiprocess workers "
+        "+ shared-memory channel) or paddle.batch readers")
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    return py_reader(capacity, None, None)
+
+
+def double_buffer(reader, place=None, name=None):
+    return reader  # prefetch is the DataLoader's job on this runtime
+
+
+def read_file(reader, file_obj=None):
+    raise NotImplementedError(
+        "file readers are the deprecated 1.x pipeline; use "
+        "paddle.io.DataLoader or paddle.reader decorators")
+
+
+# ------------------------------------------------------------- batch 4b
+def inplace_abn(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+                param_attr=None, bias_attr=None, data_layout="NCHW",
+                name=None, moving_mean_name=None, moving_variance_name=None,
+                do_model_average_for_mean_and_var=True, use_global_stats=False,
+                act_alpha=1.0):
+    """reference: inplace_abn_op — batch norm + activation fused in place;
+    XLA fuses the chain anyway, so this is bn→act composition."""
+    out = batch_norm(input, act=None, is_test=is_test, momentum=momentum,
+                     epsilon=epsilon, param_attr=param_attr,
+                     bias_attr=bias_attr, data_layout=data_layout,
+                     use_global_stats=use_global_stats)
+    if act == "leaky_relu":
+        return leaky_relu(out, alpha=act_alpha)
+    if act == "elu":
+        return elu(out, alpha=act_alpha)
+    return _maybe_act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: spectral_norm_op — weight / sigma_max via power
+    iteration on the [dim, -1] matricization."""
+    import numpy as _np
+
+    from ..core.dispatch import primitive_call as _pc
+
+    d = int(dim)
+
+    def f(w):
+        import jax.numpy as jnp
+
+        perm = [d] + [i for i in __import__("builtins").range(w.ndim)
+                      if i != d]
+        mat = jnp.transpose(w, perm).reshape(w.shape[d], -1)
+        u = jnp.ones((mat.shape[0],), w.dtype)
+        v = jnp.ones((mat.shape[1],), w.dtype)
+        for _ in __import__("builtins").range(power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        return w / sigma
+
+    return _pc(f, weight, name="spectral_norm")
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference: chunk_eval_op — chunk-level precision/recall/F1 for
+    IOB/IOE/IOBES tagging."""
+    import numpy as _np
+
+    def extract(tags):
+        # tag id layout (reference): tag = chunk_type * n + pos
+        n = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}[chunk_scheme]
+        chunks = []
+        start = None
+        ctype = None
+        for i, t in enumerate(list(tags) + [-1]):
+            pos = t % n if t >= 0 else -1
+            ct = t // n if t >= 0 else -1
+            begin = (t >= 0 and (
+                (chunk_scheme == "IOB" and pos == 0)
+                or (chunk_scheme == "IOBES" and pos in (0, 3))
+                or chunk_scheme == "plain"
+                or (chunk_scheme == "IOE" and (start is None or ct != ctype))))
+            if start is not None and (t < 0 or begin or ct != ctype):
+                chunks.append((start, i - 1, ctype))
+                start = None
+            if t >= 0 and begin:
+                start, ctype = i, ct
+        return {c for c in chunks
+                if not excluded_chunk_types or c[2] not in excluded_chunk_types}
+
+    inf = _np.asarray(input.numpy()).reshape(-1)
+    lab = _np.asarray(label.numpy()).reshape(-1)
+    if seq_length is not None:
+        lens = _np.asarray(seq_length.numpy()).reshape(-1)
+        off, inf_chunks, lab_chunks = 0, set(), set()
+        for i, ln in enumerate(lens):
+            inf_chunks |= {(i, *c) for c in extract(inf[off:off + ln])}
+            lab_chunks |= {(i, *c) for c in extract(lab[off:off + ln])}
+            off += ln
+    else:
+        inf_chunks = extract(inf)
+        lab_chunks = extract(lab)
+    correct = len(inf_chunks & lab_chunks)
+    p = correct / len(inf_chunks) if inf_chunks else 0.0
+    r = correct / len(lab_chunks) if lab_chunks else 0.0
+    f1 = 2 * p * r / (p + r) if p + r else 0.0
+    t = lambda v, dt="float32": paddle.to_tensor(_np.asarray(v, dt))
+    return (t(p), t(r), t(f1), t(len(inf_chunks), "int64"),
+            t(len(lab_chunks), "int64"), t(correct, "int64"))
+
+
+def sequence_scatter(input, index, updates, name=None):
+    """reference: sequence_scatter_op — per-sequence scatter-add of update
+    rows into `input` at the LoD-segmented indices."""
+    import numpy as _np
+
+    from ..core.ragged import LoDTensor
+
+    if not isinstance(index, LoDTensor):
+        raise TypeError("sequence_scatter needs a LoDTensor index "
+                        "(core/ragged.py) — the LoD maps updates to rows")
+    x = _np.asarray(input.numpy()).copy()
+    idx = _np.asarray(index.numpy()).reshape(-1)
+    upd = _np.asarray(updates.numpy()).reshape(-1)
+    offs = index.lod()[0]
+    for row in __import__("builtins").range(len(offs) - 1):
+        for k in __import__("builtins").range(offs[row], offs[row + 1]):
+            x[row, idx[k]] += upd[k]
+    return paddle.to_tensor(x)
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """reference: psroi_pool_op — position-sensitive RoI average pooling:
+    input channel block (i,j) feeds only output bin (i,j)."""
+    import numpy as _np
+
+    x = _np.asarray(input.numpy())
+    r = _np.asarray(rois.numpy())
+    n, c, h, w = x.shape
+    ph, pw = pooled_height, pooled_width
+    assert c == output_channels * ph * pw, \
+        "input channels must equal output_channels * ph * pw"
+    out = _np.zeros((r.shape[0], output_channels, ph, pw), "float32")
+    # map each roi to its batch image: rois_num gives per-image counts
+    if rois_num is not None:
+        counts = _np.asarray(rois_num.numpy()
+                             if hasattr(rois_num, "numpy") else rois_num,
+                             "int64")
+        img_of = _np.repeat(_np.arange(len(counts)), counts)
+    else:
+        img_of = _np.zeros(r.shape[0], "int64")
+    for ri, roi in enumerate(r):
+        bi = int(img_of[ri])
+        x1, y1, x2, y2 = [v * spatial_scale for v in roi]
+        rh = max(y2 - y1, 0.1) / ph
+        rw = max(x2 - x1, 0.1) / pw
+        for i in __import__("builtins").range(ph):
+            for j in __import__("builtins").range(pw):
+                ys = int(_np.floor(y1 + i * rh))
+                ye = max(int(_np.ceil(y1 + (i + 1) * rh)), ys + 1)
+                xs = int(_np.floor(x1 + j * rw))
+                xe = max(int(_np.ceil(x1 + (j + 1) * rw)), xs + 1)
+                ys, ye = _np.clip([ys, ye], 0, h)
+                xs, xe = _np.clip([xs, xe], 0, w)
+                if ye <= ys or xe <= xs:
+                    continue
+                for oc in __import__("builtins").range(output_channels):
+                    ch = oc * ph * pw + i * pw + j
+                    out[ri, oc, i, j] = x[bi, ch, ys:ye, xs:xe].mean()
+    return paddle.to_tensor(out)
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """reference: prroi_pool_op (precise RoI pooling — exact bilinear
+    integral). roi_align with a dense sampling grid converges to the same
+    integral; lowered that way here."""
+    from ..vision.ops import roi_align as _impl
+
+    if batch_roi_nums is None:  # single image: all rois belong to it
+        batch_roi_nums = paddle.to_tensor(
+            __import__("numpy").asarray([int(rois.shape[0])], "int32"))
+    return _impl(input, rois, batch_roi_nums,
+                 (pooled_height, pooled_width), spatial_scale,
+                 sampling_ratio=4)
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True, out_val_if_empty=0):
+    """reference: filter_by_instag_op — keep rows whose tag intersects
+    filter_tag."""
+    import numpy as _np
+
+    x = _np.asarray(ins.numpy() if not hasattr(ins, "data") else
+                    ins.data.numpy())
+    tags = _np.asarray(ins_tag.numpy()).reshape(-1)
+    want = set(_np.asarray(filter_tag.numpy()).reshape(-1).tolist())
+    keep = _np.asarray([t in want for t in tags])
+    idx = _np.nonzero(keep)[0]
+    if idx.size == 0:
+        out = _np.full((1, *x.shape[1:]), out_val_if_empty, x.dtype)
+        return (paddle.to_tensor(out),
+                paddle.to_tensor(_np.zeros(0, "int64")),
+                paddle.to_tensor(_np.zeros(1, "int64")))
+    return (paddle.to_tensor(x[idx]), paddle.to_tensor(idx.astype("int64")),
+            paddle.to_tensor(_np.ones(len(idx), "int64")))
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multi-box head (reference: detection.py multi_box_head):
+    per-feature-map loc/conf convs + prior boxes, concatenated."""
+    import numpy as _np
+
+    n_in = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule
+        step = int(_np.floor((max_ratio - min_ratio) / (n_in - 2)))
+        min_sizes, max_sizes = [], []
+        for ratio in __import__("builtins").range(min_ratio, max_ratio + 1,
+                                                  step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = [min_sizes[i]] if not isinstance(min_sizes[i], list) \
+            else min_sizes[i]
+        maxs = [max_sizes[i]] if max_sizes else None
+        ars = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                             (list, tuple)) \
+            else [aspect_ratios[i]]
+        st = steps[i] if steps else ((step_w[i] if step_w else 0.0),
+                                     (step_h[i] if step_h else 0.0))
+        box, var = prior_box(feat, image, mins, maxs, ars, list(variance),
+                             flip, clip, st if isinstance(st, (list, tuple))
+                             else (st, st), offset,
+                             min_max_aspect_ratios_order=
+                             min_max_aspect_ratios_order)
+        n_priors_cell = int(box.shape[2])
+        loc = conv2d(feat, n_priors_cell * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(feat, n_priors_cell * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        b = int(feat.shape[0])
+        locs.append(paddle.reshape(
+            paddle.transpose(loc, [0, 2, 3, 1]), [b, -1, 4]))
+        confs.append(paddle.reshape(
+            paddle.transpose(conf, [0, 2, 3, 1]), [b, -1, num_classes]))
+        boxes_all.append(paddle.reshape(box, [-1, 4]))
+        vars_all.append(paddle.reshape(var, [-1, 4]))
+    return (paddle.concat(locs, 1), paddle.concat(confs, 1),
+            paddle.concat(boxes_all, 0), paddle.concat(vars_all, 0))
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None):
+    """reference: detection.py ssd_loss — match priors to gt, smooth-l1 loc
+    loss on positives + softmax conf loss with hard negative mining."""
+    import numpy as _np
+
+    iou = iou_similarity(gt_box, prior_box)  # [n_gt, n_prior]
+    match_idx, _ = bipartite_match(iou, match_type, overlap_threshold)
+    mi = _np.asarray(match_idx.numpy())  # per-prior gt index or -1
+    pos = mi >= 0
+    n_pos = max(int(pos.sum()), 1)
+
+    gt_b = _np.asarray(gt_box.numpy())
+    gt_l = _np.asarray(gt_label.numpy()).reshape(-1)
+    pb = _np.asarray(prior_box.numpy())
+    loc_np = _np.asarray(location.numpy())[0] if location.ndim == 3 \
+        else _np.asarray(location.numpy())
+    conf_np = confidence
+
+    # encode matched gt against priors (center-size, like box_coder encode)
+    target = _np.zeros_like(loc_np)
+    pw = pb[:, 2] - pb[:, 0]
+    ph = pb[:, 3] - pb[:, 1]
+    px = (pb[:, 0] + pb[:, 2]) / 2
+    py = (pb[:, 1] + pb[:, 3]) / 2
+    var = _np.asarray(prior_box_var.numpy()) if prior_box_var is not None \
+        else _np.ones_like(pb)
+    for p in _np.nonzero(pos)[0]:
+        g = gt_b[mi[p]]
+        gx, gy = (g[0] + g[2]) / 2, (g[1] + g[3]) / 2
+        gw, gh = max(g[2] - g[0], 1e-6), max(g[3] - g[1], 1e-6)
+        target[p] = [(gx - px[p]) / pw[p] / var[p, 0],
+                     (gy - py[p]) / ph[p] / var[p, 1],
+                     _np.log(gw / pw[p]) / var[p, 2],
+                     _np.log(gh / ph[p]) / var[p, 3]]
+
+    loc_t = paddle.to_tensor(target.astype("float32"))
+    loc_p = paddle.to_tensor(loc_np.astype("float32"))
+    loc_l = paddle.sum(smooth_l1(loc_p, loc_t), axis=-1)
+    pos_t = paddle.to_tensor(pos.astype("float32"))
+    loc_loss = paddle.sum(paddle.multiply(loc_l, pos_t))
+
+    # conf target: matched gt label on positives, background elsewhere
+    conf_target = _np.full(mi.shape, background_label, "int64")
+    conf_target[pos] = gt_l[mi[pos]]
+    cf = conf_np if conf_np.ndim == 2 else paddle.squeeze(conf_np, [0])
+    ce = F.cross_entropy(cf, paddle.to_tensor(conf_target),
+                         reduction="none")
+    ce_np = _np.asarray(ce.numpy())
+    # hard negative mining: top neg_pos_ratio * n_pos negatives by loss
+    neg_cand = _np.nonzero(~pos)[0]
+    order = neg_cand[_np.argsort(-ce_np[neg_cand])]
+    n_neg = min(int(neg_pos_ratio * n_pos), len(order))
+    sel = _np.zeros_like(pos)
+    sel[order[:n_neg]] = True
+    conf_mask = paddle.to_tensor((pos | sel).astype("float32"))
+    conf_loss = paddle.sum(paddle.multiply(ce, conf_mask))
+
+    total = paddle.add(paddle.scale(loc_loss, scale=loc_loss_weight),
+                       paddle.scale(conf_loss, scale=conf_loss_weight))
+    if normalize:
+        total = paddle.scale(total, scale=1.0 / n_pos)
+    return total
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: yolov3_loss_op — per-cell objectness + box + class loss
+    against assigned ground truths (compact dense formulation)."""
+    import numpy as _np
+
+    xv = _np.asarray(x.numpy())
+    n, c, h, w = xv.shape
+    na = len(anchor_mask)
+    xv = xv.reshape(n, na, 5 + class_num, h, w)
+    gt_b = _np.asarray(gt_box.numpy())      # [n, B, 4] cx,cy,w,h (normalized)
+    gt_l = _np.asarray(gt_label.numpy())    # [n, B]
+    masked_anchors = [(anchors[2 * m] / (downsample_ratio * w),
+                       anchors[2 * m + 1] / (downsample_ratio * h))
+                      for m in anchor_mask]
+
+    obj_mask = _np.zeros((n, na, h, w), "float32")
+    t_xywh = _np.zeros((n, na, 4, h, w), "float32")
+    t_cls = _np.zeros((n, na, class_num, h, w), "float32")
+    for b in __import__("builtins").range(n):
+        for g in __import__("builtins").range(gt_b.shape[1]):
+            gw, gh = gt_b[b, g, 2], gt_b[b, g, 3]
+            if gw <= 0 or gh <= 0:
+                continue
+            gi = min(int(gt_b[b, g, 0] * w), w - 1)
+            gj = min(int(gt_b[b, g, 1] * h), h - 1)
+            # best anchor by shape IoU
+            best, best_iou = 0, 0.0
+            for ai, (aw, ah) in enumerate(masked_anchors):
+                inter = min(gw * w, aw * w) * min(gh * h, ah * h)
+                union = gw * w * gh * h + aw * w * ah * h - inter
+                if inter / union > best_iou:
+                    best, best_iou = ai, inter / union
+            obj_mask[b, best, gj, gi] = 1.0
+            aw, ah = masked_anchors[best]
+            t_xywh[b, best, :, gj, gi] = [
+                gt_b[b, g, 0] * w - gi, gt_b[b, g, 1] * h - gj,
+                _np.log(max(gw / aw, 1e-9)), _np.log(max(gh / ah, 1e-9))]
+            t_cls[b, best, int(gt_l[b, g]), gj, gi] = 1.0
+
+    pred = paddle.to_tensor(xv.astype("float32"))
+    om = paddle.to_tensor(obj_mask)
+    txy = paddle.to_tensor(t_xywh[:, :, :2])
+    twh = paddle.to_tensor(t_xywh[:, :, 2:])
+    tc = paddle.to_tensor(t_cls)
+
+    pxy = paddle.slice(pred, [2], [0], [2])
+    pwh = paddle.slice(pred, [2], [2], [4])
+    pobj = paddle.squeeze(paddle.slice(pred, [2], [4], [5]), [2])
+    pcls = paddle.slice(pred, [2], [5], [5 + class_num])
+
+    om4 = paddle.unsqueeze(om, 2)
+    xy_l = paddle.sum(paddle.multiply(F.binary_cross_entropy_with_logits(
+        pxy, txy, reduction="none"), om4))
+    wh_l = paddle.sum(paddle.multiply(paddle.abs(
+        paddle.subtract(pwh, twh)), om4))
+    obj_l = paddle.sum(F.binary_cross_entropy_with_logits(
+        pobj, om, reduction="none"))
+    cls_l = paddle.sum(paddle.multiply(F.binary_cross_entropy_with_logits(
+        pcls, tc, reduction="none"), om4))
+    return paddle.add(paddle.add(xy_l, wh_l), paddle.add(obj_l, cls_l))
+
+
+def retinanet_detection_output(bboxes, scores, im_info, score_threshold=0.05,
+                               nms_top_k=1000, keep_top_k=100,
+                               nms_threshold=0.3, nms_eta=1.0):
+    """reference: retinanet_detection_output_op — concat FPN levels, then
+    standard multiclass NMS."""
+    all_boxes = paddle.concat(bboxes, axis=0) if isinstance(bboxes, (list, tuple)) else bboxes
+    sc = paddle.concat(scores, axis=0) if isinstance(scores, (list, tuple)) else scores
+    return multiclass_nms(all_boxes, paddle.transpose(sc, [1, 0]),
+                          score_threshold, nms_top_k, keep_top_k,
+                          nms_threshold, background_label=-1)
+
+
+def _lod_era_gate(op_name, modern):
+    raise NotImplementedError(
+        f"{op_name} consumes LoD-era detection-training structures; "
+        f"{modern}")
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd, im_info, **kwargs):
+    _lod_era_gate("rpn_target_assign",
+                  "compose iou_similarity + bipartite_match + target_assign "
+                  "for anchor labeling on padded batches")
+
+
+def retinanet_target_assign(*args, **kwargs):
+    _lod_era_gate("retinanet_target_assign",
+                  "compose iou_similarity + bipartite_match + target_assign")
+
+
+def generate_proposal_labels(*args, **kwargs):
+    _lod_era_gate("generate_proposal_labels",
+                  "sample proposals hostside from generate_proposals output")
+
+
+def generate_mask_labels(*args, **kwargs):
+    _lod_era_gate("generate_mask_labels",
+                  "crop gt masks hostside against sampled rois")
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, name=None):
+    """reference: locality_aware_nms_op (EAST text) — row-adjacent weighted
+    merge, then standard multiclass NMS."""
+    import numpy as _np
+
+    b = _np.asarray(bboxes.numpy())
+    s = _np.asarray(scores.numpy())
+    merged_b, merged_s = [], []
+    for i in __import__("builtins").range(b.shape[0]):
+        if merged_b:
+            last = merged_b[-1]
+            xx1 = max(last[0], b[i, 0]); yy1 = max(last[1], b[i, 1])
+            xx2 = min(last[2], b[i, 2]); yy2 = min(last[3], b[i, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            a1 = (last[2] - last[0]) * (last[3] - last[1])
+            a2 = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+            if inter / max(a1 + a2 - inter, 1e-9) > nms_threshold:
+                w1, w2 = merged_s[-1], s[..., i].max()
+                tot = max(w1 + w2, 1e-9)
+                merged_b[-1] = (last * w1 + b[i] * w2) / tot
+                merged_s[-1] = max(w1, w2)
+                continue
+        merged_b.append(b[i].astype("float64"))
+        merged_s.append(float(s[..., i].max()))
+    mb = paddle.to_tensor(_np.asarray(merged_b, "float32"))
+    ms = paddle.to_tensor(
+        _np.broadcast_to(_np.asarray(merged_s, "float32"),
+                         (s.shape[0], len(merged_s))).copy())
+    return multiclass_nms(mb, ms, score_threshold, nms_top_k, keep_top_k,
+                          nms_threshold, background_label=-1)
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    _lod_era_gate("roi_perspective_transform",
+                  "use grid_sampler with a perspective grid computed from "
+                  "the quad rois")
+
+
+def deformable_roi_pooling(input, rois, trans, **kwargs):
+    _lod_era_gate("deformable_roi_pooling",
+                  "use vision.ops.deform_conv2d + roi_align")
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference: similarity_focus_op — binary focus mask marking, per
+    (batch, selected channel), the argmax positions across the remaining
+    axes."""
+    import numpy as _np
+
+    x = _np.asarray(input.numpy())
+    out = _np.zeros_like(x)
+    n = x.shape[0]
+    for b in __import__("builtins").range(n):
+        for ch in indexes:
+            m = x[b, ch] if axis == 1 else _np.take(x[b], ch, axis=axis - 1)
+            # mark row/col argmax pattern (reference: per-row and per-col max)
+            rows = m.argmax(1)
+            cols = m.argmax(0)
+            mask = _np.zeros_like(m, dtype=bool)
+            mask[_np.arange(m.shape[0]), rows] = True
+            mask[cols, _np.arange(m.shape[1])] = True
+            if axis == 1:
+                out[b, :, mask] = 1.0
+            else:
+                out[b][..., mask] = 1.0
+    return paddle.to_tensor(out)
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    _lod_era_gate("reorder_lod_tensor_by_rank",
+                  "sort padded batches by length hostside "
+                  "(io/batch.py bucketing)")

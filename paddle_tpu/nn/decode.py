@@ -193,7 +193,9 @@ def gather_tree(ids, parents):
 
     def body(beams, t):
         out_t = iv[t][rows, beams]
-        prev = pv[t][rows, beams]
+        # int32 carry regardless of the caller's parent dtype (int64 parents
+        # would flip the scan carry dtype mid-loop)
+        prev = pv[t][rows, beams].astype(jnp.int32)
         return prev, out_t
 
     _, rev = jax.lax.scan(body, binit, jnp.arange(T - 1, -1, -1))
