@@ -1,2 +1,4 @@
 from .model import Model, summary  # noqa: F401
 from . import callbacks  # noqa: F401
+from . import hub  # noqa: F401
+from .static_flops import static_flops  # noqa: F401

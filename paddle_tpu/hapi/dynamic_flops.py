@@ -59,8 +59,16 @@ def _count(layer, x_shape, y_shape):
     return 0
 
 
-def flops(net, input_size, custom_ops=None, print_detail=False):
-    """Total forward FLOPs of `net` on `input_size` (list incl. batch dim)."""
+def flops(net, input_size=None, custom_ops=None, print_detail=False):
+    """Total forward FLOPs of `net` on `input_size` (list incl. batch dim).
+    A static Program counts through hapi.static_flops (reference
+    hapi/dynamic_flops.py flops() dispatches the same way)."""
+    from ..static.program import Program
+
+    if isinstance(net, Program):
+        from .static_flops import static_flops
+
+        return static_flops(net, print_detail=print_detail)
     from .. import nn
 
     rows = []

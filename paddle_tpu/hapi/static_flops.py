@@ -1,0 +1,102 @@
+"""FLOPs of a static Program (reference: python/paddle/hapi/static_flops.py
+— VarWrapper/OpWrapper/GraphWrapper over a Program + count_element_op /
+count_convNd / count_linear). The tape Operator already carries typed
+inputs/outputs with static shapes, so counting walks block.ops directly.
+
+Counting convention matches dynamic_flops (and the reference): MACs for
+conv/linear/matmul (no x2), element counts for activations/norms, zero for
+shape-only ops.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..static.program import Variable
+
+__all__ = ["static_flops"]
+
+
+def _numel(shape):
+    return int(np.prod([s for s in shape if s and s > 0])) if shape else 0
+
+
+def _out_shape(op, i=0):
+    try:
+        return tuple(op.outputs[i]._value.shape)
+    except Exception:
+        return ()
+
+
+def _in_shape(op, i=0):
+    t = op.inputs[i]
+    try:
+        return tuple(t._value.shape)
+    except Exception:
+        return ()
+
+
+_ELEMENT_OPS = {
+    "relu", "relu6", "sigmoid", "tanh", "gelu", "exp", "sqrt", "log", "silu",
+    "leaky_relu", "elu", "selu", "mish", "swish", "softplus", "add",
+    "subtract", "multiply", "divide", "maximum", "minimum", "scale", "pow",
+    "dropout", "softmax", "log_softmax", "abs", "square",
+}
+_ZERO_OPS = {
+    "reshape", "transpose", "flatten", "concat", "split", "cast", "share",
+    "folded_constant", "embedding", "one_hot", "pad", "slice", "gather",
+    "stack", "unsqueeze", "squeeze", "full", "t", "assign",
+}
+
+
+def _count_op(op):
+    t = op.type.split("/")[-1]
+    out = _out_shape(op)
+    if t in ("conv2d", "conv1d", "conv3d", "depthwise_conv2d"):
+        # y.numel() * (in_c/groups * prod(kernel)) MACs (reference
+        # static_flops count_convNd)
+        w = _in_shape(op, 1)  # [out_c, in_c/groups, *k]
+        if not w or not out:
+            return 0
+        return _numel(out) * _numel(w[1:])
+    if t in ("linear", "matmul", "mul", "fc"):
+        # out.numel() * reduced_dim MACs (count_linear / count_mul)
+        x = _in_shape(op, 0)
+        w = _in_shape(op, 1)
+        if not out or not w:
+            return 0
+        if t == "linear" or t == "fc":
+            k = w[0]  # weight [in, out]
+        else:
+            # matmul: reduction dim = x's last (or second-to-last when
+            # trans_x) — attrs carry the flags since the export work
+            k = x[-2] if op.attrs.get("trans_x") else (x[-1] if x else 0)
+        return _numel(out) * int(k or 0)
+    if t in ("batch_norm", "layer_norm", "group_norm", "instance_norm"):
+        return 2 * _numel(out)  # normalize + affine (reference count_bn)
+    if t in ("pool", "pool2d", "avg_pool2d", "max_pool2d",
+             "adaptive_avg_pool2d", "adaptive_max_pool2d"):
+        return _numel(out)
+    if t in _ELEMENT_OPS:
+        return _numel(out)
+    if t in _ZERO_OPS:
+        return 0
+    # default: one op per output element (reference counts unknown ops 0;
+    # element-cost is the safer floor for fused jax lowerings)
+    return _numel(out)
+
+
+def static_flops(program, print_detail=False):
+    """Total forward FLOPs (MAC convention) of `program`'s global block
+    (reference: hapi/static_flops.py static_flops(program))."""
+    rows = []
+    total = 0
+    for op in program.global_block.ops:
+        n = _count_op(op)
+        total += n
+        if print_detail:
+            rows.append((op.type, _out_shape(op), n))
+    if print_detail:
+        for t, shape, n in rows:
+            print(f"{t:28s} {str(shape):24s} {n:>14,}")
+        print(f"Total FLOPs: {total}")
+    return total

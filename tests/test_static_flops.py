@@ -1,0 +1,38 @@
+"""hapi.static_flops: Program-based FLOP counting (reference:
+hapi/static_flops.py); paddle.flops dispatches Programs to it."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def test_static_flops_counts_program():
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [2, 1, 28, 28], "float32")
+            w = paddle.to_tensor(
+                np.random.randn(6, 1, 5, 5).astype("float32"))
+            h = paddle.nn.functional.conv2d(x, w, padding=2)   # 2*6*28*28 out
+            h = paddle.nn.functional.relu(h)
+            h = paddle.flatten(h, 1)
+            w2 = paddle.to_tensor(
+                np.random.randn(6 * 28 * 28, 10).astype("float32") * 0.01)
+            y = paddle.nn.functional.linear(h, w2)  # noqa: F841
+        total = paddle.flops(main)
+        conv_macs = (2 * 6 * 28 * 28) * (1 * 5 * 5)
+        lin_macs = (2 * 10) * (6 * 28 * 28)
+        relu = 2 * 6 * 28 * 28
+        assert total == conv_macs + lin_macs + relu
+        # print_detail path works
+        assert paddle.flops(main, print_detail=True) == total
+    finally:
+        paddle.disable_static()
+
+
+def test_flops_dynamic_still_works():
+    from paddle_tpu.vision.models import LeNet
+
+    n = paddle.flops(LeNet(), [1, 1, 28, 28])
+    assert n > 0
