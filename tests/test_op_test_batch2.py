@@ -1,0 +1,239 @@
+"""OpTest batch 2: conv/pool/norm/embedding/elementwise/reduce coverage
+(reference test strategy SURVEY §4.1 — numpy-reference per-op tests)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.utils.op_test import OpTest
+
+
+def _np_conv2d(x, w, pad=0):
+    n, cin, h, ww = x.shape
+    cout, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh, ow = xp.shape[2] - kh + 1, xp.shape[3] - kw + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    for b in range(n):
+        for co in range(cout):
+            for i in range(oh):
+                for j in range(ow):
+                    out[b, co, i, j] = np.sum(
+                        xp[b, :, i:i + kh, j:j + kw] * w[co])
+    return out.astype("float32")
+
+
+class TestConv2dOp(OpTest):
+    def setUp(self):
+        self.op = F.conv2d
+        self.inputs = {
+            "x": np.random.rand(2, 3, 6, 6).astype("float32"),
+            "weight": np.random.rand(4, 3, 3, 3).astype("float32"),
+        }
+        self.attrs = {"padding": 1}
+        self.ref = lambda x, weight, padding: _np_conv2d(x, weight, padding)
+
+    def test_output(self):
+        self.check_output(rtol=1e-4, atol=1e-4)
+
+    def test_grad(self):
+        self.check_grad(["x", "weight"], rtol=2e-2, atol=1e-2, delta=1e-2)
+
+
+class TestMaxPool2dOp(OpTest):
+    def setUp(self):
+        self.op = F.max_pool2d
+        self.inputs = {"x": np.random.rand(2, 3, 8, 8).astype("float32")}
+        self.attrs = {"kernel_size": 2, "stride": 2}
+
+        def ref(x, kernel_size, stride):
+            n, c, h, w = x.shape
+            return x.reshape(n, c, h // 2, 2, w // 2, 2).max((3, 5))
+
+        self.ref = ref
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestAvgPool2dOp(OpTest):
+    def setUp(self):
+        self.op = F.avg_pool2d
+        self.inputs = {"x": np.random.rand(2, 3, 8, 8).astype("float32")}
+        self.attrs = {"kernel_size": 2, "stride": 2}
+
+        def ref(x, kernel_size, stride):
+            n, c, h, w = x.shape
+            return x.reshape(n, c, h // 2, 2, w // 2, 2).mean((3, 5))
+
+        self.ref = ref
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestBatchNormInference(OpTest):
+    def setUp(self):
+        c = 4
+        self.op = F.batch_norm
+        self.inputs = {
+            "x": np.random.rand(2, c, 5, 5).astype("float32"),
+            "running_mean": np.random.rand(c).astype("float32"),
+            "running_var": (np.random.rand(c) + 0.5).astype("float32"),
+            "weight": np.random.rand(c).astype("float32"),
+            "bias": np.random.rand(c).astype("float32"),
+        }
+        self.attrs = {"training": False, "epsilon": 1e-5}
+
+        def ref(x, running_mean, running_var, weight, bias, training,
+                epsilon):
+            sh = (1, -1, 1, 1)
+            return (x - running_mean.reshape(sh)) / np.sqrt(
+                running_var.reshape(sh) + epsilon) * weight.reshape(sh) \
+                + bias.reshape(sh)
+
+        self.ref = ref
+
+    def test_output(self):
+        self.check_output(rtol=1e-4, atol=1e-5, check_static=False)
+
+
+class TestEmbeddingOp(OpTest):
+    def setUp(self):
+        self.op = F.embedding
+        self.inputs = {
+            "x": np.random.randint(0, 10, (3, 4)).astype("int64"),
+            "weight": np.random.rand(10, 6).astype("float32"),
+        }
+        self.attrs = {}
+        self.ref = lambda x, weight: weight[x]
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["weight"])
+
+
+class TestElementwiseFamily(OpTest):
+    def setUp(self):
+        self.op = paddle.divide
+        self.inputs = {
+            "x": np.random.rand(3, 4).astype("float32") + 1,
+            "y": np.random.rand(3, 4).astype("float32") + 1,
+        }
+        self.attrs = {}
+        self.ref = lambda x, y: x / y
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"])
+
+
+class TestBroadcastAdd(OpTest):
+    def setUp(self):
+        self.op = paddle.add
+        self.inputs = {
+            "x": np.random.rand(3, 4).astype("float32"),
+            "y": np.random.rand(4).astype("float32"),
+        }
+        self.attrs = {}
+        self.ref = lambda x, y: x + y
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"])
+
+
+class TestReduceSumKeepdim(OpTest):
+    def setUp(self):
+        self.op = paddle.sum
+        self.inputs = {"x": np.random.rand(2, 3, 4).astype("float32")}
+        self.attrs = {"axis": [0, 2], "keepdim": True}
+        self.ref = lambda x, axis, keepdim: x.sum(tuple(axis), keepdims=True)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestTransposeReshapeChain(OpTest):
+    def setUp(self):
+        def chain(x):
+            return paddle.reshape(paddle.transpose(x, [0, 2, 1]), [2, -1])
+
+        self.op = chain
+        self.inputs = {"x": np.random.rand(2, 3, 4).astype("float32")}
+        self.attrs = {}
+        self.ref = lambda x: x.transpose(0, 2, 1).reshape(2, -1)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestCrossEntropyOp(OpTest):
+    def setUp(self):
+        n, c = 6, 5
+        logits = np.random.rand(n, c).astype("float32")
+        labels = np.random.randint(0, c, n).astype("int64")
+        self.op = F.cross_entropy
+        self.inputs = {"input": logits, "label": labels}
+        self.attrs = {"reduction": "mean"}
+
+        def ref(input, label, reduction):
+            e = np.exp(input - input.max(-1, keepdims=True))
+            p = e / e.sum(-1, keepdims=True)
+            return -np.log(p[np.arange(len(label)), label]).mean()
+
+        self.ref = ref
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["input"])
+
+
+class TestLogSumExp(OpTest):
+    def setUp(self):
+        self.op = paddle.logsumexp
+        self.inputs = {"x": np.random.rand(3, 5).astype("float32")}
+        self.attrs = {"axis": 1}
+
+        def ref(x, axis):
+            m = x.max(axis, keepdims=True)
+            return (np.log(np.exp(x - m).sum(axis)) + m.squeeze(axis))
+
+        self.ref = ref
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestSquaredL2AndClipChain(OpTest):
+    def setUp(self):
+        def f(x):
+            return paddle.sum(paddle.multiply(paddle.clip(x, 0.2, 0.8),
+                                              paddle.clip(x, 0.2, 0.8)))
+
+        self.op = f
+        self.inputs = {"x": np.random.rand(20).astype("float32")}
+        self.attrs = {}
+        self.ref = lambda x: (np.clip(x, 0.2, 0.8) ** 2).sum()
+
+    def test_output(self):
+        self.check_output()
