@@ -11,7 +11,16 @@ import time
 
 import jax
 
+from .statistic import (  # noqa: F401
+    ProfilerResult,
+    SortedKeys,
+    export_protobuf,
+    load_profiler_result,
+    summary,
+)
+
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "make_scheduler",
+           "export_protobuf", "load_profiler_result", "SortedKeys",
            "export_chrome_tracing", "benchmark", "host_tracer"]
 
 

@@ -67,3 +67,28 @@ def test_chrome_export_escapes_control_chars(tmp_path):
         doc = json.load(f)  # must parse despite control chars in the name
     names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
     assert names == ['step\n1\t"x"']
+
+
+def test_profiler_statistics_and_result_roundtrip(tmp_path):
+    """SortedKeys / export_protobuf / load_profiler_result / summary
+    (reference: profiler_statistic.py:35, profiler.py:209, utils.py:128)."""
+    import time as _time
+
+    import paddle_tpu.profiler as profiler
+
+    profiler.host_tracer().clear()
+    for _ in range(3):
+        with profiler.RecordEvent("stat_op_a"):
+            _time.sleep(0.002)
+    with profiler.RecordEvent("stat_op_b"):
+        _time.sleep(0.001)
+    handler = profiler.export_protobuf(str(tmp_path), worker_name="w0")
+    path = handler()
+    assert path.endswith("w0.paddle_trace.pb")
+    res = profiler.load_profiler_result(path)
+    stats = res.per_name_stats()
+    assert stats["stat_op_a"]["calls"] == 3
+    assert stats["stat_op_a"]["total_ns"] > stats["stat_op_b"]["total_ns"]
+    table = profiler.summary(res, sorted_by=profiler.SortedKeys.CPUTotal)
+    first_data_row = table.splitlines()[1]
+    assert "stat_op_a" in first_data_row  # sorted by total desc
