@@ -79,9 +79,11 @@ def _last_known_tpu() -> dict | None:
             continue
         if rec.get("platform") in (None, "cpu", "none"):
             continue
-        # ad-hoc --rung experiments (BENCH_BANK=1) are banked for the record
-        # but must not shadow the ladder's winning number
-        if str(rec.get("provenance", "")).startswith("rung-experiment"):
+        # ad-hoc --rung experiments (BENCH_BANK=1) and non-GPT benches
+        # (resnet50-bench, longseq A/B) are banked for the record but must
+        # not shadow the GPT ladder's winning number in last_known_tpu
+        prov = str(rec.get("provenance", ""))
+        if prov.startswith(("rung-experiment", "resnet50-bench", "longseq")):
             continue
         return rec
     return None

@@ -14,6 +14,10 @@ for i in $(seq 1 40); do
     if ! git diff --quiet BENCH_TPU_HISTORY.jsonl 2>/dev/null; then
       git commit -q -m "Bank long-seq splash/flash A/B (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl
     fi
+    timeout 700 python tools/resnet_bench.py >> /tmp/tpu_autobank.log 2>&1
+    if ! git diff --quiet BENCH_TPU_HISTORY.jsonl 2>/dev/null; then
+      git commit -q -m "Bank ResNet50 images/sec (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl
+    fi
     echo "$(date -u +%H:%M:%S) autobank done" >> /tmp/tpu_autobank.log
     exit 0
   fi
