@@ -311,3 +311,23 @@ def test_prroi_default_and_data_norm_isolation():
     c1 = L.data_norm(_t(np.random.rand(4, 3)), name="dn_test")
     from paddle_tpu.fluid.layers import data_norm as _dn
     assert ("dn_test", 3) in _dn.stats
+
+
+def test_host_ops_fail_loudly_in_static_mode():
+    """Host-computed legacy ops must not silently compute on placeholder
+    zeros under static build (the silent-failure class from VERDICT r2/r3)."""
+    paddle.enable_static()
+    try:
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("xh", [3, 4], "float32")
+            with pytest.raises(NotImplementedError, match="dygraph"):
+                L.hash(x, 100)
+            with pytest.raises(NotImplementedError, match="dygraph"):
+                L.mean_iou(x, x, 4)
+            with pytest.raises(NotImplementedError, match="dygraph"):
+                L.random_crop(x, [2, 2])
+    finally:
+        paddle.disable_static()
