@@ -657,3 +657,72 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
         return rows[sel]
 
     return primitive_call(f, _t(bboxes), _t(scores), name="multiclass_nms")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference: vision/ops.py psroi_pool →
+    phi psroi_pool kernel). output_size int or (h, w); input channels must
+    be C = output_channels * h * w."""
+    from ..fluid.layers import psroi_pool as _impl
+
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    c = int(x.shape[1])
+    assert c % (oh * ow) == 0, "channels must divide output_size^2"
+    return _impl(x, boxes, c // (oh * ow), spatial_scale, oh, ow,
+                 rois_num=boxes_num)
+
+
+class PSRoIPool(Layer):
+    """reference: vision/ops.py PSRoIPool layer."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """reference: vision/ops.py yolo_loss (same op as fluid yolov3_loss)."""
+    from ..fluid.layers import yolov3_loss as _impl
+
+    return _impl(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                 ignore_thresh, downsample_ratio, gt_score,
+                 use_label_smooth, name, scale_x_y)
+
+
+def read_file(filename, name=None):
+    """reference: vision/ops.py read_file — file bytes as a uint8 tensor."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(np.frombuffer(data, dtype=np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference: vision/ops.py decode_jpeg (phi decode_jpeg kernel, GPU
+    nvjpeg). Host decode via PIL; raises a clear error when PIL is absent
+    (zero-egress images ship no libjpeg binding otherwise)."""
+    import io as _io
+
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise NotImplementedError(
+            "decode_jpeg needs PIL for host-side decode in this build"
+        ) from e
+    raw = bytes(np.asarray(x.numpy(), np.uint8))
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+        arr = np.asarray(img)[None]
+    else:
+        img = img.convert("RGB")
+        arr = np.asarray(img).transpose(2, 0, 1)
+    return Tensor(np.ascontiguousarray(arr))
