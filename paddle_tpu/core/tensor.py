@@ -39,6 +39,8 @@ class Tensor:
         "regularizer",
         "is_distributed",
         "_grad_alias",
+        "_grad_hooks",
+        "_next_hook_key",
         "__weakref__",
     )
 
@@ -132,6 +134,27 @@ class Tensor:
     def retain_grads(self):
         self._retain_grad = True
 
+    def register_hook(self, hook):
+        """Run `hook(grad)` on this tensor's incoming gradient during
+        backward; a non-None return replaces the gradient (reference:
+        Tensor.register_hook, fluid/dygraph/varbase_patch_methods.py —
+        backed by C++ GradNode hooks). Returns a removable handle."""
+        hooks = getattr(self, "_grad_hooks", None)
+        if hooks is None:
+            hooks = self._grad_hooks = {}
+        key = getattr(self, "_next_hook_key", 0)
+        self._next_hook_key = key + 1
+        hooks[key] = hook
+
+        class RemovableHandle:
+            def __init__(self, store, k):
+                self._store, self._k = store, k
+
+            def remove(self):
+                self._store.pop(self._k, None)
+
+        return RemovableHandle(hooks, key)
+
     def _accumulate_grad(self, ct):
         # in-place grafting (tape.graft_inplace) detaches the pre-op tensor
         # into an alias; its leaf gradient belongs to the user-visible tensor
@@ -139,6 +162,13 @@ class Tensor:
         if alias is not None:
             return alias._accumulate_grad(ct)
         from .selected_rows import SelectedRows
+
+        if not isinstance(ct, SelectedRows):  # hooks see dense grads only
+            for hook in list(getattr(self, "_grad_hooks", {}).values()):
+                out = hook(Tensor(ct, stop_gradient=True))
+                if out is not None:
+                    ct = out._value if isinstance(out, Tensor) \
+                        else ct * 0 + out
 
         if self.grad is None:
             if isinstance(ct, SelectedRows):

@@ -100,3 +100,22 @@ def install():
     Tensor.rank = lambda self: Tensor(np.asarray(self.ndim, dtype=np.int32))
     Tensor.numel = lambda self: self.size
     Tensor.element_size = lambda self: np.dtype(np.asarray(self._value).dtype).itemsize
+    # activation methods (reference Tensor patch: sigmoid/softmax live in
+    # nn.functional but are also tensor methods)
+    def _sigmoid(self, name=None):
+        from ..nn import functional as F
+
+        return F.sigmoid(self)
+
+    def _softmax(self, axis=-1, name=None):
+        from ..nn import functional as F
+
+        return F.softmax(self, axis=axis)
+
+    def _gradient(self):
+        # legacy dygraph API: grad as numpy (varbase_patch_methods.gradient)
+        return None if self.grad is None else np.asarray(self.grad.numpy())
+
+    Tensor.sigmoid = _sigmoid
+    Tensor.softmax = _softmax
+    Tensor.gradient = _gradient

@@ -216,3 +216,26 @@ def test_scalar_comparison_respects_tensor_dtype():
     assert bool(paddle.equal(t64, 0.1))
     t32 = paddle.to_tensor(np.float32(0.5))
     assert bool(paddle.equal(t32, 0.5))
+
+
+def test_register_hook_and_activation_methods():
+    """Tensor.register_hook observes/replaces incoming grads (reference:
+    varbase_patch_methods register_hook); sigmoid/softmax/gradient methods."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.ones(3, "float32"))
+    t.stop_gradient = False
+    seen = []
+    handle = t.register_hook(lambda g: seen.append(g.numpy().copy()))
+    paddle.sum(t.softmax().sigmoid()).backward()
+    assert len(seen) == 1 and seen[0].shape == (3,)
+    np.testing.assert_allclose(t.gradient(), t.grad.numpy())
+    handle.remove()
+    t.clear_grad()
+    # replacing hook doubles the grad; removed observer no longer fires
+    t.register_hook(lambda g: g * 2)
+    paddle.sum(t * 3).backward()
+    np.testing.assert_allclose(t.grad.numpy(), [6, 6, 6])
+    assert len(seen) == 1
