@@ -2706,9 +2706,75 @@ def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
 
 def roi_perspective_transform(input, rois, transformed_height,
                               transformed_width, spatial_scale=1.0):
-    _lod_era_gate("roi_perspective_transform",
-                  "use grid_sampler with a perspective grid computed from "
-                  "the quad rois")
+    """reference: roi_perspective_transform_op (OCR/EAST) — warp each quad
+    ROI (8 coords x1..y4, clockwise from top-left) to a transformed_height x
+    transformed_width rectangle. Per-ROI homography by 4-point DLT solve
+    (jnp.linalg.solve, differentiable), then bilinear sampling; single
+    feature-map batch (the LoD roi->image map has no static-shape analog).
+    Returns (out, mask, transform_matrix) like the reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.dispatch import primitive_call as _pc
+
+    th, tw = int(transformed_height), int(transformed_width)
+
+    def f(x, quads):
+        if x.shape[0] != 1:
+            raise ValueError(
+                "roi_perspective_transform: batch>1 needs the LoD roi->image "
+                "map; run per image")
+        H, W = x.shape[2], x.shape[3]
+        q = quads.reshape(-1, 4, 2) * spatial_scale  # [R, 4, (x,y)]
+        # destination rectangle corners (same order as the reference op)
+        dst = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                           [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+
+        def homography(src4):
+            # DLT: solve the 8x8 system for H mapping dst -> src
+            rows = []
+            for k in __import__("builtins").range(4):
+                X, Y = dst[k, 0], dst[k, 1]
+                u, v = src4[k, 0], src4[k, 1]
+                rows.append(jnp.stack([X, Y, 1.0, 0.0, 0.0, 0.0,
+                                       -u * X, -u * Y]))
+                rows.append(jnp.stack([0.0, 0.0, 0.0, X, Y, 1.0,
+                                       -v * X, -v * Y]))
+            A = jnp.stack(rows)
+            b = src4.reshape(-1)
+            h8 = jnp.linalg.solve(A, b)
+            return jnp.concatenate([h8, jnp.ones(1)]).reshape(3, 3)
+
+        Hs = jax.vmap(homography)(q)  # [R, 3, 3]
+        yy, xx = jnp.meshgrid(jnp.arange(th, dtype=jnp.float32),
+                              jnp.arange(tw, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(xx)
+        grid = jnp.stack([xx, yy, ones], axis=-1).reshape(-1, 3)  # [th*tw, 3]
+
+        def warp_one(Hm):
+            src = grid @ Hm.T  # [th*tw, 3]
+            sx = src[:, 0] / src[:, 2]
+            sy = src[:, 1] / src[:, 2]
+            inb = (sx >= 0) & (sx <= W - 1) & (sy >= 0) & (sy <= H - 1)
+            x0 = jnp.clip(jnp.floor(sx), 0, W - 1)
+            y0 = jnp.clip(jnp.floor(sy), 0, H - 1)
+            x1 = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            y1 = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x0i, y0i = x0.astype(jnp.int32), y0.astype(jnp.int32)
+            wx, wy = sx - x0, sy - y0
+            img = x[0]  # [C, H, W]
+            v = (img[:, y0i, x0i] * (1 - wy) * (1 - wx)
+                 + img[:, y0i, x1] * (1 - wy) * wx
+                 + img[:, y1, x0i] * wy * (1 - wx)
+                 + img[:, y1, x1] * wy * wx)
+            v = jnp.where(inb[None, :], v, 0.0)
+            return v.reshape(-1, th, tw), inb.reshape(th, tw)
+
+        out, mask = jax.vmap(warp_one)(Hs)
+        return out, mask.astype(jnp.int32)[:, None], Hs
+
+    return _pc(f, input, rois, name="roi_perspective_transform")
 
 
 def deformable_roi_pooling(input, rois, trans, **kwargs):

@@ -331,3 +331,19 @@ def test_host_ops_fail_loudly_in_static_mode():
                 L.random_crop(x, [2, 2])
     finally:
         paddle.disable_static()
+
+
+def test_roi_perspective_transform_identity_and_crop():
+    """Homography warp: identity quad reproduces the image; half-width quad
+    samples the left half (reference roi_perspective_transform_op)."""
+    x = paddle.to_tensor(
+        np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    quad = paddle.to_tensor(np.array([[0, 0, 3, 0, 3, 3, 0, 3]], "float32"))
+    out, mask, hs = L.roi_perspective_transform(x, quad, 4, 4)
+    np.testing.assert_allclose(out.numpy()[0, 0], x.numpy()[0, 0], atol=1e-4)
+    assert int(mask.numpy().sum()) == 16
+    half = paddle.to_tensor(
+        np.array([[0, 0, 1.5, 0, 1.5, 3, 0, 3]], "float32"))
+    out2, _, _ = L.roi_perspective_transform(x, half, 4, 4)
+    np.testing.assert_allclose(out2.numpy()[0, 0, 0, :2], [0.0, 0.5],
+                               atol=1e-4)
