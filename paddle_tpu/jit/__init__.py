@@ -111,20 +111,37 @@ class TracedLayer:
 
 
 def to_static(layer=None, input_spec=None, build_strategy=None, backend=None,
-              convert_control_flow=False, **kwargs):
+              convert_control_flow=True, **kwargs):
     if layer is None:
         return functools.partial(to_static, input_spec=input_spec,
                                  convert_control_flow=convert_control_flow)
     if convert_control_flow:
-        # dy2static AST pass: tensor-dependent if/while survive tracing
+        # dy2static AST pass, always-on like the reference ProgramTranslator
+        # (program_translator.py:860): tensor-dependent if/while/for and
+        # break/continue survive tracing. Source beyond the conversion
+        # subset falls back to the unconverted function (python control
+        # flow still works; tensor-dependent flow surfaces jax's
+        # tracer-bool error like before).
         from .dy2static import convert_control_flow as _convert
 
+        def _safe_convert(fn):
+            try:
+                return _convert(fn)
+            except Exception as e:  # noqa: BLE001 — conversion must not
+                import sys          # break functions it cannot parse
+
+                print(f"[paddle_tpu] dy2static conversion of "
+                      f"{getattr(fn, '__name__', fn)!r} failed "
+                      f"({type(e).__name__}: {e}); running unconverted",
+                      file=sys.stderr)
+                return fn
+
         if hasattr(layer, "named_parameters"):
-            converted = _convert(type(layer).forward)
+            converted = _safe_convert(type(layer).forward)
             if converted is not type(layer).forward:
                 layer.forward = converted.__get__(layer)
         else:
-            layer = _convert(layer)
+            layer = _safe_convert(layer)
     traced = TracedLayer(layer, input_spec)
     if hasattr(layer, "named_parameters"):
         # keep Layer interface: attach traced call

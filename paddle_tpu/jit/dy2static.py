@@ -80,27 +80,35 @@ def run_if(pred, true_fn, false_fn, env):
             sel = jnp.where(p, av, bv)
             merged[k] = Tensor(sel) if isinstance(a, Tensor) or \
                 isinstance(b, Tensor) else sel
-        else:
-            if av is not bv and av != bv:
-                raise ValueError(
-                    f"dy2static: non-tensor variable {k!r} takes different "
-                    f"values ({av!r} vs {bv!r}) across a tensor-dependent "
-                    "`if` — that value cannot be selected at runtime")
+        elif av is bv or av == bv:
             merged[k] = a
+        elif isinstance(av, (bool, int, float)) and \
+                isinstance(bv, (bool, int, float)):
+            # python scalars diverging across a tensor `if` promote to a 0-d
+            # tensor select — the reference converts such variables to
+            # tensors the same way (break/continue flags rely on this)
+            merged[k] = jnp.where(p, av, bv)
+        else:
+            raise ValueError(
+                f"dy2static: non-tensor variable {k!r} takes different "
+                f"values ({av!r} vs {bv!r}) across a tensor-dependent "
+                "`if` — that value cannot be selected at runtime")
     return merged
 
 
 def run_while(cond_fn, body_fn, env):
     """Transformed `while` lands here. Symbolic predicate -> lax.while_loop
     over the carried env (Tensors are pytree leaves); python predicate ->
-    plain loop."""
+    plain loop. A predicate that BECOMES symbolic mid-loop (a tensor
+    break/continue flag set on iteration 1) switches to lax.while_loop with
+    the current env as the carry."""
+    env = dict(env)
     p = cond_fn(dict(env))
-    if not _is_symbolic(_pred_value(p)):
-        env = dict(env)
-        while _pred_value(p):
-            env = body_fn(dict(env))
-            p = cond_fn(dict(env))
-        return env
+    while not _is_symbolic(_pred_value(p)):
+        if not _pred_value(p):
+            return env
+        env = body_fn(dict(env))
+        p = cond_fn(dict(env))
     # only pre-initialized vars are loop-carried; body-local temps (MISSING at
     # entry) recompute each iteration and stay unbound after the loop — a
     # functional while cannot carry a variable with no initial value
@@ -222,6 +230,135 @@ def _has_flow_escape(stmts):
     for s in stmts:
         v.visit(s)
     return v.found
+
+
+def _contains_break_continue(stmts):
+    """Break/Continue belonging to THIS loop level: descend into If bodies
+    but not into nested loops or function definitions."""
+    for s in stmts:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, ast.If):
+            if _contains_break_continue(s.body) or \
+                    _contains_break_continue(s.orelse):
+                return True
+        elif isinstance(s, (ast.With,)):
+            if _contains_break_continue(s.body):
+                return True
+    return False
+
+
+class _BreakContinueTransformer(ast.NodeTransformer):
+    """Rewrite loops containing break/continue into flag-guarded form
+    (reference: dygraph_to_static/break_continue_transformer.py):
+
+        while test:                 __brk = False
+            ...                     while __jst.loop_cond(test, __brk):
+            if p: break       =>        __cont = False
+            rest                        ...
+                                        if p: __brk = True; __cont = True
+                                        if __jst.not_(__cont): rest
+
+    A python predicate keeps the flags python bools (plain loop, original
+    semantics); a tensor predicate turns them into bool tensors that the
+    main transformer's run_if/run_while carry functionally."""
+
+    def __init__(self):
+        self.n = 0
+        self._top = None
+
+    def visit_FunctionDef(self, node):
+        if self._top is None:
+            self._top = node
+            self.generic_visit(node)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+    def _rewrite_body(self, stmts, brk, cont, allow_break=True):
+        out = []
+        for i, st in enumerate(stmts):
+            if isinstance(st, ast.Break) and allow_break:
+                out += ast.parse(f"{brk} = True\n{cont} = True").body
+                break  # anything after an unconditional break is dead
+            if isinstance(st, ast.Continue):
+                out.append(ast.parse(f"{cont} = True").body[0])
+                break
+            if isinstance(st, ast.If) and (
+                    _contains_break_continue(st.body)
+                    or _contains_break_continue(st.orelse)):
+                new_if = ast.If(
+                    test=st.test,
+                    body=self._rewrite_body(st.body, brk, cont) or [ast.Pass()],
+                    orelse=self._rewrite_body(st.orelse, brk, cont),
+                )
+                out.append(new_if)
+                rest = self._rewrite_body(stmts[i + 1:], brk, cont)
+                if rest:
+                    guard = ast.parse(f"if __jst.not_({cont}):\n    pass"
+                                      ).body[0]
+                    guard.body = rest
+                    out.append(guard)
+                return out
+            out.append(st)
+        return out
+
+    def _flagged_while(self, test_expr, body, brk, cont):
+        shell = ast.parse(
+            f"{brk} = False\n"
+            f"while __jst.loop_cond(__TEST__, {brk}):\n"
+            f"    {cont} = False").body
+        loop = shell[1]
+        loop.test.args[0] = test_expr
+        loop.body = loop.body + self._rewrite_body(body, brk, cont)
+        return shell
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # inner loops first (their own flags)
+        if node.orelse or not _contains_break_continue(node.body):
+            return node
+        self.n += 1
+        brk, cont = f"__bc_brk_{self.n}", f"__bc_cont_{self.n}"
+        return self._flagged_while(node.test, node.body, brk, cont)
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or not _contains_break_continue(node.body):
+            return node
+        # same range() subset as visit_For below; others stay python
+        it = node.iter
+        if (not isinstance(node.target, ast.Name)
+                or not isinstance(it, ast.Call)
+                or not isinstance(it.func, ast.Name) or it.func.id != "range"
+                or it.keywords or not 1 <= len(it.args) <= 3):
+            return node
+        step_val = 1
+        if len(it.args) == 3:
+            s = it.args[2]
+            if not (isinstance(s, ast.Constant) and isinstance(s.value, int)
+                    and s.value != 0):
+                return node
+            step_val = s.value
+        if len(it.args) == 1:
+            start, stop = ast.Constant(value=0), it.args[0]
+        else:
+            start, stop = it.args[0], it.args[1]
+        self.n += 1
+        brk, cont = f"__bc_brk_{self.n}", f"__bc_cont_{self.n}"
+        cn, sn = f"__bc_i_{self.n}", f"__bc_stop_{self.n}"
+        tgt = node.target.id
+        pre = ast.parse(f"{cn} = __START__\n{sn} = __STOP__").body
+        pre[0].value = start
+        pre[1].value = stop
+        cmp_op = "<" if step_val > 0 else ">"
+        test = ast.parse(f"{cn} {cmp_op} {sn}", mode="eval").body
+        # counter increments BEFORE the guarded body so continue can't skip it
+        body = ast.parse(f"{tgt} = {cn}\n{cn} = {cn} + ({step_val})").body \
+            + list(node.body)
+        return pre + self._flagged_while(test, body, brk, cont)
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -350,6 +487,24 @@ class _JstNamespace:
     def missing(env, key):
         return key not in env or env[key] is MISSING
 
+    @staticmethod
+    def loop_cond(test, brk):
+        """`test and not brk`, tensor-aware (break/continue flag loops)."""
+        tv = test._value if isinstance(test, Tensor) else test
+        bv = brk._value if isinstance(brk, Tensor) else brk
+        if _is_symbolic(tv) or _is_symbolic(bv):
+            return Tensor(jnp.logical_and(
+                jnp.asarray(tv).reshape(()),
+                jnp.logical_not(jnp.asarray(bv).reshape(()))))
+        return bool(tv) and not bool(bv)
+
+    @staticmethod
+    def not_(x):
+        xv = x._value if isinstance(x, Tensor) else x
+        if _is_symbolic(xv):
+            return Tensor(jnp.logical_not(xv))
+        return not xv
+
 
 def convert_control_flow(fn):
     """AST-convert `fn` so tensor-dependent if/while survive tracing
@@ -362,6 +517,8 @@ def convert_control_flow(fn):
     fdef = tree.body[0]
     # drop decorators so applying @to_static(...) around this doesn't recurse
     fdef.decorator_list = []
+    _BreakContinueTransformer().visit(fdef)
+    ast.fix_missing_locations(tree)
     _ControlFlowTransformer().visit(fdef)
     ast.fix_missing_locations(tree)
     code = compile(tree, filename=f"<dy2static {fn.__name__}>", mode="exec")

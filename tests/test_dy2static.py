@@ -323,3 +323,110 @@ def test_return_inside_branch_left_as_python_if():
 
     gh = convert_control_flow(h)  # conversion succeeds; if left in place
     np.testing.assert_allclose(gh(x).numpy(), 6.0)  # eager concrete bool ok
+
+# --- tensor-dependent break/continue (reference:
+# dygraph_to_static/break_continue_transformer.py) -------------------------
+
+def _src_fn(code, name):
+    """Compile from a real file so inspect.getsource works."""
+    import tempfile, importlib.util, os, sys
+
+    f = tempfile.NamedTemporaryFile("w", suffix=".py", delete=False)
+    f.write(code)
+    f.close()
+    spec = importlib.util.spec_from_file_location("d2s_bc_mod_" + name, f.name)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return getattr(mod, name), f.name
+
+
+_BC_CODE = """
+import paddle_tpu as paddle
+
+
+def f_break(x):
+    s = paddle.zeros([], 'float32')
+    for i in range(5):
+        if s > 2.5:
+            break
+        s = s + paddle.sum(x)
+    return s
+
+
+def f_continue(x):
+    s = paddle.zeros([], 'float32')
+    for i in range(4):
+        if paddle.sum(x) * float(i) == 3.0:
+            continue
+        s = s + 1.0
+    return s
+
+
+def f_while_break(x):
+    s = paddle.zeros([], 'float32')
+    n = paddle.zeros([], 'int32')
+    while n < 100:
+        s = s + paddle.sum(x)
+        n = n + 1
+        if s > 7.0:
+            break
+    return s, n
+
+
+def f_python_break(x):
+    s = 0.0
+    for i in range(10):
+        if i == 3:
+            break
+        s = s + 1.0
+    return paddle.to_tensor(__import__('numpy').float32(s)) + paddle.sum(x) * 0
+"""
+
+
+def test_tensor_break_in_for_range():
+    import os
+
+    fn, path = _src_fn(_BC_CODE, "f_break")
+    try:
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        out = paddle.jit.to_static(fn)(x)
+        assert float(out.numpy()) == 3.0  # stops once s > 2.5
+    finally:
+        os.unlink(path)
+
+
+def test_tensor_continue_in_for_range():
+    import os
+
+    fn, path = _src_fn(_BC_CODE, "f_continue")
+    try:
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        out = paddle.jit.to_static(fn)(x)
+        assert float(out.numpy()) == 3.0  # i==1 skipped
+    finally:
+        os.unlink(path)
+
+
+def test_tensor_break_in_while():
+    import os
+
+    fn, path = _src_fn(_BC_CODE, "f_while_break")
+    try:
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        s, n = paddle.jit.to_static(fn)(x)
+        assert float(s.numpy()) == 9.0 and int(n.numpy()) == 3
+    finally:
+        os.unlink(path)
+
+
+def test_python_break_semantics_preserved():
+    import os
+
+    fn, path = _src_fn(_BC_CODE, "f_python_break")
+    try:
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        out = paddle.jit.to_static(fn)(x)
+        assert float(out.numpy()) == 3.0
+    finally:
+        os.unlink(path)
