@@ -48,3 +48,270 @@ def cuda_places(device_ids=None):
 
 def cpu_places(device_count=1):
     return [CPUPlace() for _ in range(device_count)]
+
+
+# ---------------------------------------------------------------- batch 2
+# (reference fluid/__init__.py exports the long 1.x surface: submodule
+# aliases, flag/env helpers, legacy metric classes, profiler shims)
+from ..static import (  # noqa: E402,F401
+    BuildStrategy,
+    ExecutionStrategy,
+    WeightNormParamAttr,
+    device_guard,
+    gradients,
+)
+from ..static import nn as _static_nn  # noqa: E402
+from ..static.extras import load as load, save as save  # noqa: E402,F401
+from ..utils import unique_name  # noqa: E402,F401
+from ..utils.flags import get_flags, set_flags  # noqa: E402,F401
+
+embedding = layers.embedding
+one_hot = layers.one_hot
+
+
+class _BackwardModule:
+    """fluid.backward.append_backward / gradients (reference
+    fluid/backward.py)."""
+
+    @staticmethod
+    def append_backward(loss, parameter_list=None, no_grad_set=None,
+                        callbacks=None, checkpoints=None):
+        from ..static import append_backward as _impl
+
+        return _impl(loss, parameter_list=parameter_list)
+
+    @staticmethod
+    def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+        from ..static import gradients as _impl
+
+        return _impl(targets, inputs, target_gradients)
+
+
+backward = _BackwardModule()
+
+
+class _ClipModule:
+    """fluid.clip — 1.x gradient-clip class names (reference fluid/clip.py)."""
+
+    def __getattr__(self, name):
+        from .. import nn as _nn2
+
+        mapping = {
+            "GradientClipByGlobalNorm": _nn2.ClipGradByGlobalNorm,
+            "GradientClipByNorm": _nn2.ClipGradByNorm,
+            "GradientClipByValue": _nn2.ClipGradByValue,
+            "set_gradient_clip": lambda clip, param_list=None, program=None:
+                None,  # 2.x: pass grad_clip to the optimizer instead
+        }
+        if name in mapping:
+            return mapping[name]
+        raise AttributeError(name)
+
+
+clip = _ClipModule()
+
+
+def name_scope(prefix=None):
+    from ..utils.unique_name import name_scope as _impl
+
+    return _impl(prefix)
+
+
+def in_dygraph_mode():
+    import paddle_tpu as _p
+
+    return _p.in_dynamic_mode()
+
+
+_dygraph_enable = dygraph.enable_dygraph
+_dygraph_disable = dygraph.disable_dygraph
+enable_dygraph = _dygraph_enable
+disable_dygraph = _dygraph_disable
+
+
+def load_op_library(lib_filename):
+    """reference: fluid/framework.py load_op_library — out-of-tree op .so.
+    Custom ops register through utils.custom_op in this build."""
+    raise NotImplementedError(
+        "load_op_library loads CUDA op libraries; register TPU custom ops "
+        "with paddle.utils.custom_op.register_op (utils/custom_op.py)")
+
+
+def require_version(min_version, max_version=None):
+    from ..utils import require_version as _impl
+
+    return _impl(min_version, max_version)
+
+
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=True):
+    """reference: deprecated no-op since 1.6 (buffer reuse is the runtime's
+    job — here XLA's)."""
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """reference: deprecated no-op (see memory_optimize)."""
+
+
+def install_check():
+    """fluid.install_check.run_check analog."""
+
+    class _M:
+        @staticmethod
+        def run_check():
+            import paddle_tpu as _p
+
+            _p.utils.run_check()
+
+    return _M()
+
+
+class DataFeeder:
+    """reference: fluid/data_feeder.py DataFeeder — turn reader rows into
+    executor feed dicts."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.names = [getattr(v, "name", str(v)) for v in feed_list]
+
+    def feed(self, iterable):
+        import numpy as _np
+
+        rows = list(iterable)
+        cols = list(zip(*rows))
+        return {n: _np.asarray(c) for n, c in zip(self.names, cols)}
+
+
+class _Metrics:
+    """fluid.metrics legacy classes (reference fluid/metrics.py):
+    update()-protocol wrappers over paddle.metric."""
+
+    class Accuracy:
+        def __init__(self, name=None):
+            self._correct = 0.0
+            self._total = 0.0
+
+        def update(self, value, weight):
+            self._correct += float(value) * float(weight)
+            self._total += float(weight)
+
+        def eval(self):
+            return self._correct / max(self._total, 1e-12)
+
+        def reset(self):
+            self._correct = self._total = 0.0
+
+    class Auc:
+        def __init__(self, name=None, curve="ROC", num_thresholds=4095):
+            from ..metric import Auc as _Auc2
+
+            self._m = _Auc2(curve=curve, num_thresholds=num_thresholds)
+
+        def update(self, preds, labels):
+            self._m.update(preds, labels)
+
+        def eval(self):
+            return self._m.accumulate()
+
+        def reset(self):
+            self._m.reset()
+
+    class Precision:
+        def __init__(self, name=None):
+            self.tp = 0
+            self.fp = 0
+
+        def update(self, preds, labels):
+            import numpy as _np
+
+            p = (_np.asarray(preds).reshape(-1) > 0.5).astype(int)
+            l = _np.asarray(labels).reshape(-1)
+            self.tp += int(((p == 1) & (l == 1)).sum())
+            self.fp += int(((p == 1) & (l == 0)).sum())
+
+        def eval(self):
+            return self.tp / max(self.tp + self.fp, 1e-12)
+
+        def reset(self):
+            self.tp = self.fp = 0
+
+    class Recall:
+        def __init__(self, name=None):
+            self.tp = 0
+            self.fn = 0
+
+        def update(self, preds, labels):
+            import numpy as _np
+
+            p = (_np.asarray(preds).reshape(-1) > 0.5).astype(int)
+            l = _np.asarray(labels).reshape(-1)
+            self.tp += int(((p == 1) & (l == 1)).sum())
+            self.fn += int(((p == 0) & (l == 1)).sum())
+
+        def eval(self):
+            return self.tp / max(self.tp + self.fn, 1e-12)
+
+        def reset(self):
+            self.tp = self.fn = 0
+
+
+metrics = _Metrics()
+
+
+class _FluidProfiler:
+    """fluid.profiler legacy API (reference fluid/profiler.py) over the
+    host tracer."""
+
+    @staticmethod
+    def start_profiler(state="All", tracer_option="Default"):
+        from ..profiler import host_tracer
+
+        host_tracer().clear()
+
+    @staticmethod
+    def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+        from ..profiler import summary
+
+        summary()
+
+    @staticmethod
+    @__import__("contextlib").contextmanager
+    def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+                 tracer_option="Default"):
+        _FluidProfiler.start_profiler(state)
+        yield
+        _FluidProfiler.stop_profiler(sorted_key, profile_path)
+
+
+profiler = _FluidProfiler()
+
+
+class _Contrib:
+    """fluid.contrib subset: the pieces migration guides reference."""
+
+    class mixed_precision:  # noqa: N801 — module-style alias
+        @staticmethod
+        def decorate(optimizer, init_loss_scaling=2 ** 15,
+                     use_dynamic_loss_scaling=True, **kw):
+            from ..amp import decorate as _impl
+
+            return _impl(optimizer=optimizer,
+                         init_loss_scaling=init_loss_scaling)
+
+    class sparsity:  # noqa: N801
+        @staticmethod
+        def decorate(optimizer):
+            from ..incubate import asp
+
+            return asp.decorate(optimizer)
+
+        @staticmethod
+        def prune_model(model, **kw):
+            from ..incubate import asp
+
+            return asp.prune_model(model, **kw)
+
+
+contrib = _Contrib()
+
+# submodule-style aliases 1.x scripts import through fluid
+from ..static import executor as _noop_exec  # noqa: E402,F401 — if absent, skip

@@ -36,3 +36,90 @@ def enabled():
 
 
 no_grad = paddle.no_grad
+
+
+class Linear(Layer):
+    """1.x dygraph.Linear(input_dim, output_dim, act=...) — pre-2.0
+    signature over nn.Linear (reference fluid/dygraph/nn.py Linear)."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        from ..nn import Linear as _Linear2
+
+        self._fc = _Linear2(input_dim, output_dim, weight_attr=param_attr,
+                            bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = self._fc(x)
+        if self._act:
+            from ..nn import functional as F
+
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class Embedding(Layer):
+    """1.x dygraph.Embedding(size=[vocab, dim]) (reference
+    fluid/dygraph/nn.py Embedding)."""
+
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32"):
+        super().__init__()
+        from ..nn import Embedding as _Emb2
+
+        self._emb = _Emb2(size[0], size[1], padding_idx=padding_idx,
+                          sparse=is_sparse, weight_attr=param_attr)
+
+    def forward(self, x):
+        return self._emb(x)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    import paddle_tpu as _p
+
+    return _p.grad(outputs, inputs, grad_outputs=grad_outputs,
+                   retain_graph=retain_graph, create_graph=create_graph,
+                   allow_unused=allow_unused)
+
+
+def save_dygraph(state_dict, model_path):
+    """reference: fluid/dygraph/checkpoint.py save_dygraph — suffix chosen
+    by content (.pdparams for params, .pdopt for optimizer state)."""
+    import paddle_tpu as _p
+
+    is_opt = any(not hasattr(v, "numpy") for v in state_dict.values()) and \
+        any(k in ("LR_Scheduler", "global_step") or "_moment" in k or
+            "beta" in k for k in state_dict)
+    _p.save(state_dict, model_path + (".pdopt" if is_opt else ".pdparams"))
+
+
+def load_dygraph(model_path):
+    """reference: load_dygraph — returns (param_dict, opt_dict)."""
+    import os
+
+    import paddle_tpu as _p
+
+    params = _p.load(model_path + ".pdparams") if os.path.exists(
+        model_path + ".pdparams") else None
+    opt = _p.load(model_path + ".pdopt") if os.path.exists(
+        model_path + ".pdopt") else None
+    return params, opt
+
+
+def enable_dygraph(place=None):
+    import paddle_tpu as _p
+
+    _p.disable_static()
+
+
+def disable_dygraph():
+    import paddle_tpu as _p
+
+    _p.enable_static()
+
+
+disabled_dygraph = disable_dygraph  # 1.x spelling seen in the wild

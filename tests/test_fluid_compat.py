@@ -184,3 +184,57 @@ def test_fluid_dygraph_guard_and_to_variable():
         assert v.shape == [4]
         lin = paddle.nn.Linear(4, 2)
         assert np.isfinite(np.asarray(lin(v)._value)).all()
+
+
+def test_fluid_namespace_batch2():
+    """fluid.{backward,clip,metrics,DataFeeder,dygraph.Linear/Embedding,
+    save_dygraph} — the 1.x surface migration guides lean on."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+
+    lin = fluid.dygraph.Linear(4, 3, act="relu")
+    out = lin(paddle.to_tensor(np.random.rand(2, 4).astype("float32")))
+    assert tuple(out.shape) == (2, 3) and float(out.numpy().min()) >= 0
+
+    emb = fluid.dygraph.Embedding([10, 5], padding_idx=0)
+    e = emb(paddle.to_tensor(np.array([0, 3], "int64")))
+    assert np.allclose(e.numpy()[0], 0)  # padding row zeroed
+
+    m = fluid.metrics.Precision()
+    m.update(np.array([0.9, 0.2, 0.8]), np.array([1, 0, 0]))
+    assert m.eval() == 0.5
+    r = fluid.metrics.Recall()
+    r.update(np.array([0.9, 0.2]), np.array([1, 1]))
+    assert r.eval() == 0.5
+
+    fd = fluid.DataFeeder(
+        feed_list=[type("V", (object,), {"name": "x"})()])
+    feed = fd.feed([(np.ones(3),), (np.zeros(3),)])
+    assert feed["x"].shape == (2, 3)
+
+    assert isinstance(fluid.clip.GradientClipByGlobalNorm(1.0),
+                      paddle.nn.ClipGradByGlobalNorm)
+    assert fluid.in_dygraph_mode()
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError, match="custom_op"):
+        fluid.load_op_library("x.so")
+
+
+def test_fluid_save_load_dygraph(tmp_path):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.fluid as fluid
+
+    net = paddle.nn.Linear(3, 2)
+    path = str(tmp_path / "m")
+    fluid.dygraph.save_dygraph(net.state_dict(), path)
+    params, opt = fluid.dygraph.load_dygraph(path)
+    assert opt is None
+    np.testing.assert_allclose(
+        np.asarray(params["weight"] if "weight" in params
+                   else list(params.values())[0]),
+        net.state_dict()[list(net.state_dict().keys())[0]].numpy())
