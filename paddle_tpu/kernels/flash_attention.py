@@ -17,9 +17,33 @@ from jax.experimental.pallas.ops.tpu.flash_attention import (
 )
 
 
-def _block(s: int) -> int:
-    """q/k block edge used by both the dense-block and splash kernels."""
-    return min(512, s)
+_TUNED = None
+
+
+def _tuned_table() -> dict:
+    """kernels/flash_tuned.json: on-chip autotuned block edges keyed
+    "seq,head_dim" (written by tools/flash_autotune.py; absent = defaults)."""
+    global _TUNED
+    if _TUNED is None:
+        import json
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "flash_tuned.json")
+        try:
+            with open(path) as f:
+                _TUNED = {k: int(v) for k, v in json.load(f).items()}
+        except (OSError, ValueError):
+            _TUNED = {}
+    return _TUNED
+
+
+def _block(s: int, d: int | None = None) -> int:
+    """q/k block edge used by both the dense-block and splash kernels.
+    Tuned table wins when it has this (seq, head_dim); 512 default else."""
+    tuned = _tuned_table().get(f"{s},{d}") if d is not None else None
+    b = tuned if tuned else 512
+    b = min(b, s)
+    return b if s % b == 0 else min(512, s)  # table entry must tile s
 
 
 def supports_shape(q_shape, k_shape) -> bool:
@@ -37,12 +61,12 @@ def supports_shape(q_shape, k_shape) -> bool:
     return (d % 64 == 0
             and s_q >= 128 and s_k >= 128
             and s_q % 128 == 0 and s_k % 128 == 0
-            and s_q % _block(s_q) == 0 and s_k % _block(s_k) == 0)
+            and s_q % _block(s_q, d) == 0 and s_k % _block(s_k, d) == 0)
 
 
-def _block_sizes(s_q, s_k):
-    b = _block(s_q)
-    bk = _block(s_k)
+def _block_sizes(s_q, s_k, d=None):
+    b = _block(s_q, d)
+    bk = _block(s_k, d)
     return BlockSizes(
         block_q=b, block_k_major=bk, block_k=bk, block_b=1,
         block_q_major_dkv=b, block_k_major_dkv=bk, block_k_dkv=bk, block_q_dkv=b,
@@ -58,7 +82,7 @@ def _flash(q, k, v, causal, sm_scale):
     with jax.enable_x64(False):  # kernel index math assumes int32 defaults
         return _pallas_flash(
             q, k, v, causal=causal, sm_scale=sm_scale,
-            block_sizes=_block_sizes(q.shape[2], k.shape[2]),
+            block_sizes=_block_sizes(q.shape[2], k.shape[2], q.shape[3]),
         )
 
 
@@ -67,7 +91,7 @@ def _flash_fwd(q, k, v, causal, sm_scale):
         out, vjp = jax.vjp(
             lambda q, k, v: _pallas_flash(
                 q, k, v, causal=causal, sm_scale=sm_scale,
-                block_sizes=_block_sizes(q.shape[2], k.shape[2]),
+                block_sizes=_block_sizes(q.shape[2], k.shape[2], q.shape[3]),
             ),
             q, k, v,
         )
@@ -83,7 +107,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 @functools.lru_cache(maxsize=8)
-def _splash_kernel(num_heads: int, s_q: int, s_k: int, interpret: bool = False):
+def _splash_kernel(num_heads: int, s_q: int, s_k: int, d: int | None = None,
+                   interpret: bool = False):
     """Causal splash-attention kernel (skips fully-masked KV tiles — ~2x on
     causal vs dense blocking). Cached per (heads, seq) since mask construction
     is host-side."""
@@ -96,7 +121,7 @@ def _splash_kernel(num_heads: int, s_q: int, s_k: int, interpret: bool = False):
     # sdpa_reference's jnp.tril(..., k=s_k - s_q) convention (attention.py)
     mask = _sam.MultiHeadMask(
         [_sam.CausalMask((s_q, s_k), offset=s_k - s_q)] * num_heads)
-    blk, bkv = _block(s_q), _block(s_k)
+    blk, bkv = _block(s_q, d), _block(s_k, d)
     block_sizes = _sak.BlockSizes(
         block_q=blk, block_kv=bkv, block_kv_compute=bkv,
         block_q_dkv=blk, block_kv_dkv=bkv, block_kv_dkv_compute=bkv,
@@ -109,7 +134,8 @@ def _splash_kernel(num_heads: int, s_q: int, s_k: int, interpret: bool = False):
 
 
 def _splash(q, k, v, sm_scale, interpret=False):
-    kernel = _splash_kernel(q.shape[1], q.shape[2], k.shape[2], interpret)
+    kernel = _splash_kernel(q.shape[1], q.shape[2], k.shape[2], q.shape[3],
+                            interpret)
     q = (q * sm_scale).astype(q.dtype)
     with jax.enable_x64(False):
         return jax.vmap(kernel)(q, k, v)

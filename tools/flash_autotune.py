@@ -1,0 +1,124 @@
+"""On-chip flash-attention block-size autotune (VERDICT r3 weak #8's second
+half: the 512 default was never swept). For each (seq, head_dim) in the
+bench-relevant set, times fwd+bwd of the Pallas dense-block kernel across
+candidate block edges and writes the winners to
+paddle_tpu/kernels/flash_tuned.json — the single `_block` source consults it,
+so the dispatch gate and launch config stay consistent automatically.
+
+TPU only (pallas kernels don't run on the CPU backend); prints a skip note
+otherwise. Results also bank to BENCH_TPU_HISTORY.jsonl as rung-experiments.
+
+Usage: python tools/flash_autotune.py
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import paddle_tpu  # noqa: F401 — applies the jax_platforms=cpu override
+import numpy as np
+
+SHAPES = [  # (batch, heads, seq, head_dim) — bench rungs + long-context
+    (8, 16, 1024, 64),
+    (4, 16, 2048, 64),
+    (2, 16, 4096, 64),
+    (1, 16, 8192, 64),
+    (8, 8, 1024, 128),
+]
+CANDIDATES = [128, 256, 512, 1024]
+
+
+def _time_config(q, k, v, block):
+    import jax
+
+    from paddle_tpu.kernels import flash_attention as fa
+
+    fa._TUNED = {f"{q.shape[2]},{q.shape[3]}": block}
+
+    def loss(q, k, v):
+        import jax.numpy as jnp
+
+        return jnp.sum(fa._flash(q, k, v, True, 0.125).astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+    out = g(q, k, v)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = g(q, k, v)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    import jax
+
+    # decide from config, NOT jax.devices(): the axon register hook forces
+    # TPU-client init inside devices() even under jax_platforms=cpu, and a
+    # dead/contended tunnel then hangs this process (see bench.py's
+    # child-probe dance for the same reason)
+    if (jax.config.jax_platforms or "").strip().lower() == "cpu":
+        print("[flash_autotune] CPU backend: pallas kernels unavailable; "
+              "run on TPU", file=sys.stderr)
+        return
+    dev = jax.devices()[0]
+    table = {}
+    records = []
+    for b, h, s, d in SHAPES:
+        rng = np.random.RandomState(0)
+        import jax.numpy as jnp
+
+        q = jnp.asarray(rng.rand(b, h, s, d), jnp.bfloat16)
+        k = jnp.asarray(rng.rand(b, h, s, d), jnp.bfloat16)
+        v = jnp.asarray(rng.rand(b, h, s, d), jnp.bfloat16)
+        results = {}
+        for blk in CANDIDATES:
+            if blk > s or s % blk:
+                continue
+            try:
+                results[blk] = _time_config(q, k, v, blk)
+                print(f"[flash_autotune] s={s} d={d} block={blk}: "
+                      f"{results[blk] * 1e3:.2f} ms", file=sys.stderr,
+                      flush=True)
+            except Exception as e:  # noqa: BLE001 — OOM/unsupported config
+                print(f"[flash_autotune] s={s} d={d} block={blk}: "
+                      f"{type(e).__name__}", file=sys.stderr, flush=True)
+        if not results:
+            continue
+        best = min(results, key=results.get)
+        default_t = results.get(min(512, s))
+        table[f"{s},{d}"] = best
+        records.append({
+            "metric": "flash_attention_fwdbwd_ms",
+            "value": round(results[best] * 1e3, 3),
+            "unit": "ms",
+            "vs_baseline": round(default_t / results[best], 3)
+            if default_t else None,
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", "?"),
+            "config": {"batch": b, "heads": h, "seq": s, "head_dim": d,
+                       "best_block": best,
+                       "sweep_ms": {str(kk): round(vv * 1e3, 3)
+                                    for kk, vv in results.items()}},
+            "provenance": "rung-experiment (flash_autotune)",
+        })
+
+    out_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "paddle_tpu", "kernels", "flash_tuned.json")
+    with open(out_path, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+    print(f"[flash_autotune] wrote {os.path.abspath(out_path)}: {table}",
+          file=sys.stderr)
+    import bench
+
+    for rec in records:
+        bench._bank_tpu_result(rec)
+    print(json.dumps({"tuned": table}))
+
+
+if __name__ == "__main__":
+    main()

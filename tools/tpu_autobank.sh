@@ -18,6 +18,11 @@ for i in $(seq 1 40); do
     if ! git diff --quiet BENCH_TPU_HISTORY.jsonl 2>/dev/null; then
       git commit -q -m "Bank ResNet50 images/sec (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl
     fi
+    timeout 900 python tools/flash_autotune.py >> /tmp/tpu_autobank.log 2>&1
+    if ! git diff --quiet BENCH_TPU_HISTORY.jsonl paddle_tpu/kernels/flash_tuned.json 2>/dev/null; then
+      git add paddle_tpu/kernels/flash_tuned.json 2>/dev/null
+      git commit -q -m "Bank flash block-size autotune table (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl paddle_tpu/kernels/flash_tuned.json
+    fi
     echo "$(date -u +%H:%M:%S) autobank done" >> /tmp/tpu_autobank.log
     exit 0
   fi
