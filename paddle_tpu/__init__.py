@@ -83,6 +83,7 @@ from . import signal  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
 from . import compat  # noqa: E402,F401
 from . import sysconfig  # noqa: E402,F401
 from . import callbacks  # noqa: E402,F401

@@ -13,3 +13,19 @@ XavierUniform = _init.XavierUniform
 MSRA = MSRAInitializer = _init.KaimingNormal
 Bilinear = getattr(_init, "Bilinear", None)
 NumpyArrayInitializer = _init.Assign
+
+
+BilinearInitializer = Bilinear
+
+_global_initializer = [None]
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference: fluid/initializer.py set_global_initializer — default
+    initializers for subsequently created parameters. Layers consult
+    nn.initializer defaults; this records the override for them."""
+    from ..nn import initializer as _ni
+
+    _global_initializer[0] = (weight_init, bias_init)
+    if hasattr(_ni, "_set_global_initializer"):
+        _ni._set_global_initializer(weight_init, bias_init)

@@ -110,3 +110,86 @@ class LambOptimizer(Lamb):
         # from the decoupled lamb_weight_decay term
         if regularization is not None:
             self._weight_decay = _wd(regularization)
+
+
+from ..optimizer import DecayedAdagrad, Dpsgd, Ftrl, LarsMomentum  # noqa: E402,F401
+from ..incubate import LookAhead as _LookAhead, ModelAverage  # noqa: E402,F401
+
+
+class DecayedAdagradOptimizer(DecayedAdagrad):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameter_list=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, decay=decay, epsilon=epsilon,
+                         parameters=parameter_list, grad_clip=grad_clip)
+
+
+class FtrlOptimizer(Ftrl):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameter_list=None, regularization=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, l1=l1, l2=l2, lr_power=lr_power,
+                         parameters=parameter_list, grad_clip=grad_clip)
+
+
+class DpsgdOptimizer(Dpsgd):
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, parameter_list=None, seed=0, name=None):
+        super().__init__(learning_rate, clip=clip, batch_size=batch_size,
+                         sigma=sigma, parameters=parameter_list, seed=seed)
+
+
+class LarsMomentumOptimizer(LarsMomentum):
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameter_list=None,
+                 regularization=None, grad_clip=None, name=None,
+                 exclude_from_weight_decay=None, epsilon=0):
+        super().__init__(learning_rate, momentum=momentum,
+                         lars_coeff=lars_coeff,
+                         lars_weight_decay=lars_weight_decay,
+                         parameters=parameter_list, grad_clip=grad_clip)
+
+
+class LookaheadOptimizer:
+    """reference: fluid/optimizer.py LookaheadOptimizer(inner, alpha, k) —
+    argument order differs from incubate.LookAhead."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self._impl = _LookAhead(inner_optimizer, alpha=alpha, k=k)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_impl"], name)
+
+
+class RecomputeOptimizer:
+    """reference: fluid/optimizer.py RecomputeOptimizer — checkpointed
+    backward. Recompute lives in fleet.recompute on this runtime; the
+    wrapper keeps 1.x call sites compiling and applies activation
+    checkpointing through the model's recompute flags."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)
+
+
+class PipelineOptimizer:
+    """reference: fluid/optimizer.py PipelineOptimizer — static pipeline
+    via device_guard program splitting (static/pipeline.py)."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._inner = optimizer
+        self.num_microbatches = num_microbatches
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program=startup_program,
+                                    parameter_list=parameter_list)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_inner"], name)

@@ -250,3 +250,63 @@ def get_version() -> str:
     from .. import __version__
 
     return __version__
+
+
+class DataType:
+    """reference: paddle_infer.DataType enum (inference/api/paddle_api.h)."""
+
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+def get_num_bytes_of_data_type(dtype):
+    """reference: paddle_infer.get_num_bytes_of_data_type."""
+    return {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+            DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+            DataType.BFLOAT16: 2}[dtype]
+
+
+def get_trt_compile_version():
+    """n/a by design: TensorRT is a GPU engine; the TPU deploy path is the
+    compiled StableHLO artifact (static/io.py)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """reference: inference convert_to_mixed_precision — offline fp16/bf16
+    weight conversion. Here: load the pdmodel pair, cast fp32 persistables
+    to bf16, rewrite the params stream (the program bytes pass through)."""
+    import shutil
+
+    import numpy as np
+
+    from ..framework.io import _read_lod_tensor, _write_lod_tensor
+
+    shutil.copyfile(model_file, mixed_model_file)
+    with open(params_file, "rb") as f:
+        data = f.read()
+    import io as _io
+
+    src = _io.BytesIO(data)
+    out = _io.BytesIO()
+    import ml_dtypes
+
+    while src.tell() < len(data):
+        arr, lod = _read_lod_tensor(src)
+        if arr.dtype == np.float32:
+            arr = arr.astype(ml_dtypes.bfloat16)
+        _write_lod_tensor(out, arr, lod)
+    with open(mixed_params_file, "wb") as f:
+        f.write(out.getvalue())

@@ -5,6 +5,9 @@ from .optimizers import (  # noqa: F401
     SGD,
     Adadelta,
     Adagrad,
+    DecayedAdagrad,
+    Dpsgd,
+    Ftrl,
     Adam,
     Adamax,
     AdamW,

@@ -276,3 +276,83 @@ class Adamax(Optimizer):
         step_f = jnp.asarray(step, jnp.float32)
         lr_t = lr / (1 - self._beta1**step_f)
         return p - (lr_t * m / (u + self._epsilon)).astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: fluid/optimizer.py DecayedAdagrad (decayed_adagrad_op):
+    moment = decay * moment + (1 - decay) * g^2."""
+
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._decay = decay
+        self._epsilon = epsilon
+
+    def _slot_init(self, v):
+        return {"moment": jnp.zeros_like(v, dtype=jnp.float32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        acc = self._decay * slots["moment"] + (1 - self._decay) * g32 * g32
+        return (p - (lr * g32 / (jnp.sqrt(acc) + self._epsilon)).astype(
+            p.dtype), {"moment": acc})
+
+
+class Ftrl(Optimizer):
+    """reference: fluid/optimizer.py Ftrl (ftrl_op): follow-the-regularized-
+    leader with squared-gradient accumulator + linear term."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _slot_init(self, v):
+        return {"squared": jnp.zeros_like(v, dtype=jnp.float32),
+                "linear": jnp.zeros_like(v, dtype=jnp.float32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        new_sq = slots["squared"] + g32 * g32
+        lp = -self._lr_power
+        sigma = (new_sq ** lp - slots["squared"] ** lp) / lr
+        new_lin = slots["linear"] + g32 - sigma * p32
+        quad = new_sq ** lp / lr + 2 * self._l2
+        pre = jnp.clip(new_lin, -self._l1, self._l1) - new_lin
+        new_p = jnp.where(jnp.abs(new_lin) > self._l1, pre / quad, 0.0)
+        return new_p.astype(p.dtype), {"squared": new_sq, "linear": new_lin}
+
+
+class Dpsgd(Optimizer):
+    """reference: fluid/optimizer.py Dpsgd (dpsgd_op) — differentially
+    private SGD: clip each grad to clip-norm, add calibrated gaussian
+    noise. Noise is drawn per step from a seeded host RNG (the reference op
+    seeds per kernel launch the same way)."""
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, seed=0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._clip = clip
+        self._batch = batch_size
+        self._sigma = sigma
+        self._seed = seed
+
+    def _slot_init(self, v):
+        return {"t": jnp.zeros((), jnp.int32)}
+
+    def _apply_dense(self, p, g, slots, lr, step):
+        import jax
+
+        g32 = g.astype(jnp.float32)
+        norm = jnp.sqrt(jnp.sum(g32 * g32))
+        g32 = g32 * jnp.minimum(1.0, self._clip / jnp.maximum(norm, 1e-12))
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 slots["t"])
+        noise = self._clip * self._sigma * jax.random.normal(
+            key, g32.shape, jnp.float32)
+        upd = (g32 + noise) / jnp.maximum(self._batch, 1e-12)
+        return (p - (lr * upd).astype(p.dtype), {"t": slots["t"] + 1})

@@ -156,3 +156,32 @@ def test_lamb_and_lars_run():
         _set_grad(p, [0.5, 0.5, 0.5])
         o.step()
         assert not np.allclose(p.numpy(), before), cls.__name__
+
+
+def test_decayed_adagrad_ftrl_dpsgd_converge():
+    """The fluid-era optimizer tail (reference fluid/optimizer.py
+    DecayedAdagrad/Ftrl/Dpsgd) minimizes a quadratic."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    for cls, kw in [
+        (paddle.optimizer.DecayedAdagrad, dict(learning_rate=0.5)),
+        (paddle.optimizer.Ftrl, dict(learning_rate=0.5)),
+        (paddle.optimizer.Dpsgd,
+         dict(learning_rate=0.2, clip=5.0, batch_size=1.0, sigma=1e-6)),
+    ]:
+        w = paddle.to_tensor(np.array([3.0, -2.0], "float32"))
+        w.stop_gradient = False
+        opt = cls(parameters=[w], **kw)
+        for _ in range(60):
+            loss = paddle.sum(paddle.multiply(w, w))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 0.05, cls.__name__
+    # fluid aliases exist with 1.x signatures
+    import paddle_tpu.fluid.optimizer as fo
+
+    o = fo.FtrlOptimizer(0.1, parameter_list=[w])
+    assert isinstance(o, paddle.optimizer.Ftrl)
