@@ -1,0 +1,14 @@
+"""Shared example bootstrap: platform pinning.
+
+Defaults to the CPU backend so examples run anywhere; set
+EXAMPLE_PLATFORM=axon (or tpu) to run on an attached accelerator. The
+hard override matters: the driver environment exports JAX_PLATFORMS=axon
+globally, which would otherwise hijack these CPU-sized examples.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+os.environ["JAX_PLATFORMS"] = os.environ.get("EXAMPLE_PLATFORM", "cpu")
+
+import paddle_tpu  # noqa: E402,F401 — applies the jax_platforms override

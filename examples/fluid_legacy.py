@@ -1,0 +1,50 @@
+"""An unmodified 1.x-era fluid script: static program built with
+fluid.layers, trained through fluid.Executor — the legacy surface runs on
+the same whole-program XLA path."""
+import _common  # noqa: F401
+
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+
+
+def main():
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        hidden = fluid.layers.fc(img, size=128, act="relu")
+        prediction = fluid.layers.fc(hidden, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(prediction,
+                                       fluid.layers.reshape(label, [-1])))
+        fluid.optimizer.AdamOptimizer(learning_rate=1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    # reader-protocol data pipeline, 1.x style
+    import paddle_tpu as paddle_mod
+
+    reader = paddle_mod.batch(
+        paddle_mod.reader.shuffle(paddle_mod.dataset.mnist.train(),
+                                  buf_size=256), batch_size=16)
+    feeder = fluid.DataFeeder(feed_list=[img, label])
+    first = last = None
+    for i, batch in enumerate(reader()):
+        if i == 25:
+            break
+        feed = feeder.feed([(b[0], np.array([b[1]], "int64")) for b in batch])
+        (lv,) = exe.run(main_prog, feed=feed, fetch_list=[loss])
+        first = first if first is not None else float(lv)
+        last = float(lv)
+    print(f"fluid-era script: loss {first:.3f} -> {last:.3f}")
+    assert last < first
+    paddle_mod.disable_static()
+
+
+if __name__ == "__main__":
+    main()

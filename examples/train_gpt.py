@@ -1,0 +1,41 @@
+"""Dygraph GPT training: the 2.x paddle workflow, unchanged."""
+import _common  # noqa: F401
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+
+def main():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(3e-3, T_max=20)
+    opt = paddle.optimizer.AdamW(learning_rate=sched,
+                                 parameters=model.parameters(),
+                                 weight_decay=0.01)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, 512, (8, 65)).astype("int64")
+    losses = []
+    for step in range(20):
+        ids = paddle.to_tensor(data[:, :-1])
+        labels = paddle.to_tensor(data[:, 1:])
+        loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        sched.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+        if step % 5 == 0:
+            print(f"step {step:3d}  loss {losses[-1]:.4f}  "
+                  f"lr {sched.get_lr():.2e}")
+    assert losses[-1] < losses[0], "loss should memorize the fixed batch"
+    paddle.save(model.state_dict(), "/tmp/example_gpt.pdparams")
+    model.set_state_dict(paddle.load("/tmp/example_gpt.pdparams"))
+    print(f"done: {losses[0]:.3f} -> {losses[-1]:.3f}; checkpoint round-trip ok")
+
+
+if __name__ == "__main__":
+    main()
