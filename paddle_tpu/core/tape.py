@@ -88,6 +88,8 @@ def backward(tensor, grad=None, retain_graph=False):
         if seed is None:
             seed = jax.numpy.ones(tensor._value.shape, tensor._value.dtype)
         tensor._accumulate_grad(seed)
+        if getattr(tensor, "_grad_hooks", None):
+            tensor._apply_grad_hooks()
         return
 
     # topo order over nodes
@@ -114,6 +116,8 @@ def backward(tensor, grad=None, retain_graph=False):
         seed = jax.numpy.ones(tensor._value.shape, tensor._value.dtype)
     cts[id(tensor)] = seed
 
+    hooked: list = []  # leaves with registered hooks, in first-touch order
+
     for node in reversed(order):
         out_cts = []
         any_ct = False
@@ -138,6 +142,9 @@ def backward(tensor, grad=None, retain_graph=False):
                 if t._tape_node is None:
                     if not t.stop_gradient:
                         t._accumulate_grad(ct)
+                        if getattr(t, "_grad_hooks", None) and \
+                                t not in hooked:
+                            hooked.append(t)
                 else:
                     from .selected_rows import SelectedRows
 
@@ -152,6 +159,12 @@ def backward(tensor, grad=None, retain_graph=False):
                         t._accumulate_grad(ct)
         if not retain_graph:
             node.vjp_fn = None
+
+    # gradient hooks run ONCE on the fully-ACCUMULATED grad (reference
+    # semantics: the hook sees the final gradient, not each contribution —
+    # a clip hook over per-edge partials would clip the wrong value)
+    for t in hooked:
+        t._apply_grad_hooks()
 
     if not retain_graph:
         for node in order:

@@ -155,6 +155,20 @@ class Tensor:
 
         return RemovableHandle(hooks, key)
 
+    def _apply_grad_hooks(self):
+        """Called by tape.backward AFTER accumulation completes — hooks see
+        the final gradient exactly once per backward (reference semantics).
+        SelectedRows grads skip hooks (hooks see dense grads only)."""
+        from .selected_rows import SelectedRows
+
+        if self.grad is None or isinstance(self.grad._value, SelectedRows):
+            return
+        for hook in list(getattr(self, "_grad_hooks", {}).values()):
+            out = hook(Tensor(self.grad._value, stop_gradient=True))
+            if out is not None:
+                self.grad._value = out._value if isinstance(out, Tensor) \
+                    else self.grad._value * 0 + out
+
     def _accumulate_grad(self, ct):
         # in-place grafting (tape.graft_inplace) detaches the pre-op tensor
         # into an alias; its leaf gradient belongs to the user-visible tensor
@@ -162,13 +176,6 @@ class Tensor:
         if alias is not None:
             return alias._accumulate_grad(ct)
         from .selected_rows import SelectedRows
-
-        if not isinstance(ct, SelectedRows):  # hooks see dense grads only
-            for hook in list(getattr(self, "_grad_hooks", {}).values()):
-                out = hook(Tensor(ct, stop_gradient=True))
-                if out is not None:
-                    ct = out._value if isinstance(out, Tensor) \
-                        else ct * 0 + out
 
         if self.grad is None:
             if isinstance(ct, SelectedRows):

@@ -111,10 +111,15 @@ class _ClipModule:
 clip = _ClipModule()
 
 
-def name_scope(prefix=None):
-    from ..utils.unique_name import name_scope as _impl
+import contextlib as _contextlib
 
-    return _impl(prefix)
+
+@_contextlib.contextmanager
+def name_scope(prefix=None):
+    """reference: fluid/framework.py name_scope — a naming context for
+    debug/visualization; unique_name guard scopes generated names."""
+    with unique_name.guard((prefix or "") + "/" if prefix else None):
+        yield
 
 
 def in_dygraph_mode():
@@ -153,17 +158,17 @@ def release_memory(input_program, skip_opt_set=None):
     """reference: deprecated no-op (see memory_optimize)."""
 
 
-def install_check():
-    """fluid.install_check.run_check analog."""
+class _InstallCheck:
+    """fluid.install_check module shape: fluid.install_check.run_check()."""
 
-    class _M:
-        @staticmethod
-        def run_check():
-            import paddle_tpu as _p
+    @staticmethod
+    def run_check():
+        import paddle_tpu as _p
 
-            _p.utils.run_check()
+        return _p.utils.run_check()
 
-    return _M()
+
+install_check = _InstallCheck()
 
 
 class DataFeeder:
@@ -278,8 +283,10 @@ class _FluidProfiler:
     def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
                  tracer_option="Default"):
         _FluidProfiler.start_profiler(state)
-        yield
-        _FluidProfiler.stop_profiler(sorted_key, profile_path)
+        try:
+            yield
+        finally:  # an exception in the profiled block must not lose the data
+            _FluidProfiler.stop_profiler(sorted_key, profile_path)
 
 
 profiler = _FluidProfiler()

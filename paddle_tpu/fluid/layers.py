@@ -2373,8 +2373,11 @@ def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
     r = _np.asarray(rois.numpy())
     n, c, h, w = x.shape
     ph, pw = pooled_height, pooled_width
-    assert c == output_channels * ph * pw, \
-        "input channels must equal output_channels * ph * pw"
+    if c != output_channels * ph * pw:
+        raise ValueError(
+            f"psroi_pool: input channels ({c}) must equal "
+            f"output_channels * pooled_height * pooled_width "
+            f"({output_channels}*{ph}*{pw})")
     out = _np.zeros((r.shape[0], output_channels, ph, pw), "float32")
     # map each roi to its batch image: rois_num gives per-image counts
     if rois_num is not None:

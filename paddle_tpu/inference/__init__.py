@@ -282,17 +282,40 @@ def get_trt_runtime_version():
 
 
 def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
-                               mixed_params_file, mixed_precision=None,
+                               mixed_params_file, mixed_precision="bfloat16",
                                backend=None, keep_io_types=True,
                                black_list=None, **kwargs):
-    """reference: inference convert_to_mixed_precision — offline fp16/bf16
-    weight conversion. Here: load the pdmodel pair, cast fp32 persistables
-    to bf16, rewrite the params stream (the program bytes pass through)."""
+    """reference: inference convert_to_mixed_precision — offline low-
+    precision weight conversion. Casts fp32 persistables in the params
+    stream to the requested dtype (the program bytes pass through; IO
+    tensors are not persistables, so keep_io_types always holds here).
+    A non-empty black_list needs per-op weight attribution the flat params
+    stream does not carry — raises rather than converting blacklisted
+    layers silently."""
     import shutil
 
     import numpy as np
 
     from ..framework.io import _read_lod_tensor, _write_lod_tensor
+
+    if black_list:
+        raise NotImplementedError(
+            "convert_to_mixed_precision black_list needs op->weight "
+            "attribution; convert selectively by exporting the model with "
+            "the desired per-layer dtypes instead")
+    import ml_dtypes
+
+    target = {
+        "bfloat16": ml_dtypes.bfloat16, "bf16": ml_dtypes.bfloat16,
+        "float16": np.float16, "fp16": np.float16, "half": np.float16,
+        DataType.BFLOAT16: ml_dtypes.bfloat16,
+        DataType.FLOAT16: np.float16,
+    }.get(mixed_precision if not hasattr(mixed_precision, "lower")
+          else mixed_precision.lower())
+    if target is None:
+        raise ValueError(
+            f"unsupported mixed_precision {mixed_precision!r}; expected "
+            "'float16'/'bfloat16' (or DataType.FLOAT16/BFLOAT16)")
 
     shutil.copyfile(model_file, mixed_model_file)
     with open(params_file, "rb") as f:
@@ -301,12 +324,10 @@ def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
 
     src = _io.BytesIO(data)
     out = _io.BytesIO()
-    import ml_dtypes
-
     while src.tell() < len(data):
         arr, lod = _read_lod_tensor(src)
         if arr.dtype == np.float32:
-            arr = arr.astype(ml_dtypes.bfloat16)
+            arr = arr.astype(target)
         _write_lod_tensor(out, arr, lod)
     with open(mixed_params_file, "wb") as f:
         f.write(out.getvalue())

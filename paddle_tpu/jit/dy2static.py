@@ -287,15 +287,24 @@ class _BreakContinueTransformer(ast.NodeTransformer):
             if isinstance(st, ast.Continue):
                 out.append(ast.parse(f"{cont} = True").body[0])
                 break
-            if isinstance(st, ast.If) and (
-                    _contains_break_continue(st.body)
-                    or _contains_break_continue(st.orelse)):
-                new_if = ast.If(
-                    test=st.test,
-                    body=self._rewrite_body(st.body, brk, cont) or [ast.Pass()],
-                    orelse=self._rewrite_body(st.orelse, brk, cont),
-                )
-                out.append(new_if)
+            carries_flow = isinstance(st, (ast.If, ast.With)) and (
+                _contains_break_continue(getattr(st, "body", []))
+                or _contains_break_continue(getattr(st, "orelse", [])))
+            if carries_flow:
+                if isinstance(st, ast.If):
+                    new_st = ast.If(
+                        test=st.test,
+                        body=self._rewrite_body(st.body, brk, cont)
+                        or [ast.Pass()],
+                        orelse=self._rewrite_body(st.orelse, brk, cont),
+                    )
+                else:  # With wrapping a break/continue (no_grad, auto_cast…)
+                    new_st = ast.With(
+                        items=st.items,
+                        body=self._rewrite_body(st.body, brk, cont)
+                        or [ast.Pass()],
+                    )
+                out.append(new_st)
                 rest = self._rewrite_body(stmts[i + 1:], brk, cont)
                 if rest:
                     guard = ast.parse(f"if __jst.not_({cont}):\n    pass"

@@ -28,6 +28,30 @@ class _UniqueNameGenerator:
         with self._lock:
             self._counters.clear()
 
+    def guard(self, new_generator=None):
+        """reference: fluid/unique_name.py guard — scope generated names
+        under a prefix (or a fresh namespace) for the with-block."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            prefix = new_generator if isinstance(new_generator, str) else ""
+            orig = self.generate
+
+            def scoped(p="tmp"):
+                return orig(prefix + p)
+
+            self.generate = scoped
+            try:
+                yield
+            finally:
+                self.generate = orig
+
+        return ctx()
+
+    def switch(self, new_generator=None):
+        self.reset()
+
 
 unique_name = _UniqueNameGenerator()
 

@@ -430,3 +430,32 @@ def test_python_break_semantics_preserved():
         assert float(out.numpy()) == 3.0
     finally:
         os.unlink(path)
+
+
+_WITH_BREAK_CODE = """
+import paddle_tpu as paddle
+
+
+def f_with_break(x):
+    s = paddle.zeros([], 'float32')
+    for i in range(5):
+        with paddle.no_grad():
+            if s > 2.5:
+                break
+        s = s + paddle.sum(x)
+    return s
+"""
+
+
+def test_tensor_break_inside_with_block():
+    """Code-review regression (reproduced): break under `with`
+    (no_grad/auto_cast) must convert like a bare break."""
+    import os
+
+    fn, path = _src_fn(_WITH_BREAK_CODE, "f_with_break")
+    try:
+        x = paddle.to_tensor(np.ones(3, "float32"))
+        out = paddle.jit.to_static(fn)(x)
+        assert float(out.numpy()) == 3.0
+    finally:
+        os.unlink(path)

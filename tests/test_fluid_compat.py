@@ -238,3 +238,14 @@ def test_fluid_save_load_dygraph(tmp_path):
         np.asarray(params["weight"] if "weight" in params
                    else list(params.values())[0]),
         net.state_dict()[list(net.state_dict().keys())[0]].numpy())
+
+
+def test_fluid_name_scope_and_install_check():
+    """Code-review regressions (reproduced): name_scope must not crash and
+    install_check keeps the reference's module call shape."""
+    import paddle_tpu.fluid as fluid
+
+    with fluid.name_scope("encoder"):
+        name = fluid.unique_name.generate("w")
+    assert name.startswith("encoder/w")
+    fluid.install_check.run_check()  # the documented spelling

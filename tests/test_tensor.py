@@ -239,3 +239,26 @@ def test_register_hook_and_activation_methods():
     paddle.sum(t * 3).backward()
     np.testing.assert_allclose(t.grad.numpy(), [6, 6, 6])
     assert len(seen) == 1
+
+
+def test_register_hook_sees_accumulated_grad():
+    """Code-review regression (reproduced): hooks run ONCE on the final
+    accumulated gradient, not per contribution — clip(2)+clip(3) != clip(5)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    t = paddle.to_tensor(np.ones(3, "float32"))
+    t.stop_gradient = False
+    calls = []
+
+    def clip_hook(g):
+        calls.append(g.numpy().copy())
+        return paddle.clip(g, -2.5, 2.5)
+
+    t.register_hook(clip_hook)
+    loss = paddle.add(paddle.sum(t * 2.0), paddle.sum(t * 3.0))
+    loss.backward()
+    assert len(calls) == 1           # once per backward
+    np.testing.assert_allclose(calls[0], [5, 5, 5])   # accumulated value
+    np.testing.assert_allclose(t.grad.numpy(), [2.5, 2.5, 2.5])

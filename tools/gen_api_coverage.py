@@ -18,27 +18,6 @@ import paddle_tpu
 
 REF = "/root/reference/python/paddle"
 
-# reference dirs that are tests/internal codegen, not user API surface
-_SKIP_PARTS = ("tests", "fluid/tests", "utils/code_gen", "libs", "proto",
-               "incubate/fleet", "fluid/incubate", "distributed/fleet/meta_optimizers",
-               "distributed/fleet/meta_parallel", "distributed/fleet/runtime",
-               "distributed/fleet/utils", "distributed/fleet/base",
-               "distributed/fleet/dataset", "distributed/fleet/elastic",
-               "distributed/auto_parallel", "distributed/passes",
-               "distributed/launch", "distributed/ps", "distributed/sharding",
-               "fluid/dygraph/dygraph_to_static", "fluid/contrib",
-               "fluid/distributed", "fluid/transpiler", "jit/dy2static",
-               "io/dataloader", "nn/utils", "nn/layer", "nn/initializer",
-               "nn/quant", "vision/models", "vision/datasets",
-               "vision/transforms", "text/datasets", "dataset",
-               "optimizer/functional", "incubate/distributed",
-               "incubate/operators", "incubate/optimizer", "incubate/nn",
-               "incubate/autograd", "incubate/sparse", "distribution",
-               "device/cuda", "amp", "autograd", "metric", "profiler",
-               "reader", "inference", "static/nn", "hapi", "onnx", "cost_model")
-# modules above are covered through their PARENT namespace rows (their names
-# re-export there), so per-file rows would double-count.
-
 _TOP_MODULES = [
     "", "nn", "nn/functional", "tensor", "optimizer", "static", "distributed",
     "distributed/fleet", "vision", "io", "jit", "sparse", "incubate",
