@@ -40,6 +40,7 @@ from .collective import (  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import utils  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, reshard, shard_op, shard_tensor  # noqa: F401
 from .parallel import DataParallel  # noqa: F401

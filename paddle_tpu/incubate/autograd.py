@@ -17,7 +17,8 @@ from ..core import tape as tape_mod
 from ..core.tensor import Tensor
 
 __all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad",
-           "prim_enabled", "enable_prim", "disable_prim"]
+           "prim_enabled", "enable_prim", "disable_prim",
+           "orig2prim", "prim2orig"]
 
 
 def _to_arrays(xs):
@@ -217,3 +218,17 @@ def enable_prim():
 
 def disable_prim():
     _prim_state["enabled"] = False
+
+
+def orig2prim(block=None):
+    """reference: incubate/autograd/primx.py orig2prim — rewrite original
+    ops into the primitive op set inside a static block. In this framework
+    every lowering is already jax primitives (lax.*), so the rewrite is an
+    identity on the tape; kept for API/workflow parity with enable_prim()."""
+    return block
+
+
+def prim2orig(block=None):
+    """reference: primx.py:537 prim2orig — inverse rewrite after autodiff
+    transforms. Identity here for the same reason as orig2prim."""
+    return block

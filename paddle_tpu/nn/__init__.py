@@ -1,6 +1,7 @@
 """paddle_tpu.nn — layers (reference: python/paddle/nn/, 25.6k LoC)."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .layer import Layer, ParamAttr, Parameter  # noqa: F401
 from .container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .layers_common import (  # noqa: F401

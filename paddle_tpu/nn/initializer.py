@@ -11,6 +11,7 @@ from ..core.dtype import to_jax_dtype
 from ..core.rng import next_rng_key
 
 __all__ = [
+    "Bilinear", "set_global_initializer",
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "Orthogonal", "Dirac", "calculate_gain",
@@ -174,3 +175,50 @@ class Dirac(Initializer):
         for i in range(min(oc, ic * self.groups)):
             w[(i, i % ic) + tuple(k)] = 1.0
         return jnp.asarray(w, dtype=to_jax_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """reference: nn/initializer/Bilinear (fluid/initializer.py
+    BilinearInitializer) — bilinear-upsample kernels for conv-transpose:
+    weight[c_out, c_in, kh, kw] gets a separable triangular kernel."""
+
+    def __call__(self, shape, dtype=jnp.float32, key=None):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs 4-D conv weights, got {shape}")
+        kh, kw = shape[2], shape[3]
+
+        def tri(k):
+            f = np.ceil(k / 2.0)
+            c = (2 * f - 1 - f % 2) / (2.0 * f)
+            x = np.arange(k)
+            return 1 - np.abs(x / f - c)
+
+        kernel = np.outer(tri(kh), tri(kw)).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        w[:, :] = kernel  # every (out, in) channel pair shares the kernel
+        return jnp.asarray(w, dtype)
+
+
+_GLOBAL_INIT = [None, None]  # (weight_init, bias_init)
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """reference: nn/initializer/set_global_initializer — default
+    initializers for parameters created afterwards (layers consult
+    _global_default when no explicit initializer is given)."""
+    if weight_init is not None and not isinstance(weight_init, Initializer):
+        raise TypeError("weight_init must be an Initializer or None")
+    if bias_init is not None and not isinstance(bias_init, Initializer):
+        raise TypeError("bias_init must be an Initializer or None")
+    _GLOBAL_INIT[0] = weight_init
+    _GLOBAL_INIT[1] = bias_init
+
+
+def _global_default(is_bias=False):
+    return _GLOBAL_INIT[1 if is_bias else 0]
+
+
+def _set_global_initializer(weight_init, bias_init=None):  # fluid shim hook
+    set_global_initializer(weight_init, bias_init)

@@ -1,0 +1,144 @@
+"""nn.utils: weight/spectral norm reparameterizations + parameter flatten
+(reference: python/paddle/nn/utils/{weight_norm_hook,spectral_norm_hook,
+transform_parameters}.py).
+
+Reparameterizations install a forward-pre-hook that recomputes the layer's
+weight from auxiliary parameters each call — the reference's hook design
+maps directly onto Layer.register_forward_pre_hook.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    import paddle_tpu as paddle
+
+    axes = [i for i in range(len(w.shape)) if i != dim]
+    sq = paddle.sum(paddle.multiply(w, w), axis=axes, keepdim=True)
+    return paddle.sqrt(sq)
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """reference: weight_norm_hook.py weight_norm — w = g * v / ||v||."""
+    import paddle_tpu as paddle
+
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1  # whole-tensor norm (reference dim=None semantics)
+        g0 = paddle.sqrt(paddle.sum(paddle.multiply(w, w)))
+    else:
+        g0 = _norm_except(w, dim)
+    v = paddle.to_tensor(np.asarray(w.numpy()))
+    v.stop_gradient = False
+    g = paddle.to_tensor(np.asarray(g0.numpy()))
+    g.stop_gradient = False
+    layer.add_parameter(name + "_v", v)
+    layer.add_parameter(name + "_g", g)
+
+    def _compute():
+        vv = getattr(layer, name + "_v")
+        gg = getattr(layer, name + "_g")
+        if dim == -1:
+            nrm = paddle.sqrt(paddle.sum(paddle.multiply(vv, vv)))
+        else:
+            nrm = _norm_except(vv, dim)
+        return paddle.multiply(paddle.divide(vv, nrm), gg)
+
+    def hook(lyr, inputs):
+        # plain attribute, not a parameter: the real trainables are v and g
+        object.__setattr__(lyr, name, _compute())
+        return None
+
+    # the original weight is no longer a parameter of the layer
+    if name in layer._parameters:
+        del layer._parameters[name]
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_state = (name, dim, handle)
+    object.__setattr__(layer, name, _compute())
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """reference: weight_norm_hook.py remove_weight_norm — bake the current
+    w back as a plain parameter and drop v/g."""
+    import paddle_tpu as paddle
+
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None:
+        raise ValueError(f"weight_norm was not applied to {layer!r}")
+    _, dim, handle = state
+    handle.remove()
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    if dim == -1:
+        nrm = paddle.sqrt(paddle.sum(paddle.multiply(v, v)))
+    else:
+        nrm = _norm_except(v, dim)
+    w = paddle.multiply(paddle.divide(v, nrm), g)
+    del layer._parameters[name + "_v"]
+    del layer._parameters[name + "_g"]
+    wp = paddle.to_tensor(np.asarray(w.numpy()))
+    wp.stop_gradient = False
+    layer.add_parameter(name, wp)
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """reference: spectral_norm_hook.py spectral_norm — w / sigma_max via
+    power iteration, recomputed each forward."""
+    import paddle_tpu as paddle
+
+    from ..fluid.layers import spectral_norm as _sn
+
+    if dim is None:
+        dim = 0
+
+    orig = getattr(layer, name)
+    v = paddle.to_tensor(np.asarray(orig.numpy()))
+    v.stop_gradient = False
+    layer.add_parameter(name + "_orig", v)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def hook(lyr, inputs):
+        object.__setattr__(lyr, name, _sn(
+            getattr(lyr, name + "_orig"), dim=dim,
+            power_iters=n_power_iterations, eps=eps))
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._spectral_norm_state = (name, handle)
+    object.__setattr__(layer, name, _sn(v, dim=dim,
+                                        power_iters=n_power_iterations,
+                                        eps=eps))
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """reference: transform_parameters.py — concat flattened params."""
+    import paddle_tpu as paddle
+
+    return paddle.concat([paddle.reshape(p, [-1]) for p in parameters],
+                         axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """reference: transform_parameters.py — scatter a flat vector back."""
+    import paddle_tpu as paddle
+
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p.shape))
+        chunk = paddle.reshape(
+            paddle.slice(vec, [0], [offset], [offset + n]), list(p.shape))
+        p._value = chunk._value.astype(p._value.dtype)
+        offset += n
+    return parameters

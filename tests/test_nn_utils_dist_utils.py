@@ -1,0 +1,96 @@
+"""nn.utils reparameterizations + distributed.utils cluster model
+(reference: nn/utils/{weight_norm,spectral_norm}_hook.py,
+transform_parameters.py; distributed/utils.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_weight_norm_and_remove():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(4, 3)
+    w_before = lin.weight.numpy().copy()
+    paddle.nn.utils.weight_norm(lin, "weight", dim=0)
+    assert "weight_g" in dict(lin.named_parameters())
+    assert "weight" not in lin._parameters
+    x = paddle.to_tensor(np.random.rand(2, 4).astype("float32"))
+    out1 = lin(x)
+    # forward reproduces the original weight (g initialized to ||v||)
+    np.testing.assert_allclose(
+        out1.numpy(),
+        x.numpy() @ w_before + lin.bias.numpy(), rtol=1e-5, atol=1e-5)
+    # v and g are the trainables now
+    loss = paddle.sum(out1)
+    loss.backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+    paddle.nn.utils.remove_weight_norm(lin, "weight")
+    assert "weight" in lin._parameters
+    np.testing.assert_allclose(lin.weight.numpy(), w_before, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_spectral_norm_hook():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(6, 4)
+    paddle.nn.utils.spectral_norm(lin, "weight", n_power_iterations=20)
+    lin(paddle.to_tensor(np.random.rand(2, 6).astype("float32")))
+    s = np.linalg.svd(lin.weight.numpy(), compute_uv=False)
+    assert s[0] == pytest.approx(1.0, rel=5e-2)
+
+
+def test_parameters_vector_roundtrip():
+    lin = paddle.nn.Linear(3, 2)
+    vec = paddle.nn.utils.parameters_to_vector(list(lin.parameters()))
+    assert int(vec.shape[0]) == 3 * 2 + 2
+    doubled = paddle.scale(vec, scale=2.0)
+    paddle.nn.utils.vector_to_parameters(doubled, list(lin.parameters()))
+    np.testing.assert_allclose(
+        paddle.nn.utils.parameters_to_vector(
+            list(lin.parameters())).numpy(), doubled.numpy(), rtol=1e-6)
+
+
+def test_distributed_utils_cluster_model(tmp_path):
+    import paddle_tpu.distributed.utils as du
+
+    cluster, pod = du.get_cluster(
+        ["10.0.0.1", "10.0.0.2"], "10.0.0.2",
+        [["10.0.0.1:9000", "10.0.0.1:9001"],
+         ["10.0.0.2:9000", "10.0.0.2:9001"]])
+    assert cluster.trainers_nranks() == 4
+    assert pod.rank == 1 and pod.trainers[0].rank == 2
+    assert cluster.get_pod_by_id(0).addr == "10.0.0.1"
+    assert len(du.find_free_ports(2)) == 2
+    h = du.Hdfs()
+    assert not h.is_valid()
+
+
+@pytest.mark.slow
+def test_start_and_watch_local_trainers(tmp_path):
+    import time
+
+    import paddle_tpu.distributed.utils as du
+
+    cluster, pod = du.get_cluster(
+        ["127.0.0.1"], "127.0.0.1", [["127.0.0.1:9100", "127.0.0.1:9101"]])
+    script = tmp_path / "w.py"
+    script.write_text("import os\nprint('rank', os.environ['PADDLE_TRAINER_ID'])\n")
+    procs = du.start_local_trainers(cluster, pod, str(script), [],
+                                    log_dir=str(tmp_path))
+    while du.watch_local_trainers(procs, 2):
+        time.sleep(0.2)
+    logs = sorted(p.name for p in tmp_path.glob("workerlog.*"))
+    assert logs == ["workerlog.0", "workerlog.1"]
+
+
+def test_prim2orig_identity():
+    from paddle_tpu.incubate.autograd import orig2prim, prim2orig
+
+    assert prim2orig(None) is None and orig2prim("b") == "b"
+
+
+def test_bilinear_initializer_kernel():
+    w = paddle.nn.initializer.Bilinear()((1, 1, 4, 4))
+    k = np.asarray(w)[0, 0]
+    np.testing.assert_allclose(k, k.T, rtol=1e-6)  # separable symmetric
+    assert k.max() == k[1, 1] or k.max() == k[2, 2]
