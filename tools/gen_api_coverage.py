@@ -29,6 +29,9 @@ _TOP_MODULES = [
     "vision/datasets", "text/datasets", "optimizer/lr.py",
     "fluid/layers", "fluid/dygraph", "fluid/initializer.py",
     "fluid/optimizer.py", "fluid/regularizer.py", "fluid/io.py",
+    "nn/utils", "nn/initializer", "distributed/utils.py",
+    "incubate/autograd", "incubate/nn", "incubate/nn/functional",
+    "distributed/sharding",
 ]
 
 
