@@ -3,7 +3,7 @@
 # BENCH_TPU_HISTORY.jsonl), commit the history artifact, run the long-seq
 # A/B banked, commit again. One shot, then exit.
 cd /root/repo || exit 1
-for i in $(seq 1 40); do
+for i in $(seq 1 120); do
   if timeout 50 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) TUNNEL ALIVE - benching" >> /tmp/tpu_autobank.log
     timeout 700 python bench.py >> /tmp/tpu_autobank.log 2>&1
