@@ -1,0 +1,34 @@
+"""examples/ must keep running end-to-end (each asserts its own learning/
+round-trip invariants internally)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def _run(script, extra_env=None, timeout=420):
+    env = {k: v for k, v in os.environ.items()
+           if "axon" not in v.lower() or k != "PYTHONPATH"}
+    env["PYTHONPATH"] = ""  # drop the axon sitecustomize: examples pin CPU
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script)],
+        env=env, cwd=_ROOT, timeout=timeout,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script,extra", [
+    ("train_gpt.py", None),
+    ("static_train_export.py", None),
+    ("fleet_hybrid.py",
+     {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
+    ("fluid_legacy.py", None),
+])
+def test_example_runs(script, extra):
+    proc = _run(script, extra)
+    assert proc.returncode == 0, proc.stdout.decode()[-2000:]
