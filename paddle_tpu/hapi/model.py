@@ -22,6 +22,30 @@ from ..metric import Metric
 from . import callbacks as cbs_mod
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _dygraph_scope():
+    """Static-mode adapter (reference: hapi/model.py StaticGraphAdapter,
+    :248): the reference keeps two engines, so Model dispatches per mode.
+    This runtime has ONE engine — the whole-step jit below is already the
+    compiled single-computation execution the static adapter exists to
+    provide — so under paddle.enable_static() the Model simply suspends op
+    recording for its internals; semantics and performance match the
+    dygraph path exactly."""
+    import paddle_tpu as paddle
+
+    was_static = paddle.in_static_mode()
+    if was_static:
+        paddle.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            paddle.enable_static()
+
+
 def _as_list(x):
     if x is None:
         return []
@@ -139,6 +163,10 @@ class Model:
         return tuple(arrays[:ni]), tuple(arrays[ni:])
 
     def train_batch(self, inputs, labels=None, update=True):
+        with _dygraph_scope():
+            return self._train_batch_impl(inputs, labels, update)
+
+    def _train_batch_impl(self, inputs, labels=None, update=True):
         if self._fstate is None:
             p, frozen, b = self._sync_fstate_from_network()
             self._fstate = {
@@ -163,6 +191,10 @@ class Model:
         return [float(loss)] + metrics if metrics else [float(loss)]
 
     def eval_batch(self, inputs, labels=None):
+        with _dygraph_scope():
+            return self._eval_batch_impl(inputs, labels)
+
+    def _eval_batch_impl(self, inputs, labels=None):
         if self._fstate is None:
             p, frozen, b = self._sync_fstate_from_network()
             self._fstate = {"p": p, "frozen": frozen, "b": b,
@@ -178,6 +210,10 @@ class Model:
         return [float(loss)] + metrics if metrics else [float(loss)]
 
     def predict_batch(self, inputs):
+        with _dygraph_scope():
+            return self._predict_batch_impl(inputs)
+
+    def _predict_batch_impl(self, inputs):
         self.network.eval()
         with tape_mod.no_grad():
             outs = self.network(*[Tensor(np.asarray(x)) if not isinstance(x, Tensor) else x

@@ -70,3 +70,30 @@ def test_predict():
 def test_summary():
     info = paddle.summary(LeNet())
     assert info["total_params"] > 60000
+
+
+def test_model_fit_in_static_mode():
+    """Reference Model dispatches to a StaticGraphAdapter under
+    enable_static (hapi/model.py:248); here the whole-step jit IS the
+    compiled static execution — fit/evaluate must work and learn."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    try:
+        paddle.seed(11)
+        net = paddle.nn.Sequential(paddle.nn.Flatten(),
+                                   paddle.nn.Linear(784, 10))
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.Adam(parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss(),
+                      paddle.metric.Accuracy())
+        ds = paddle.vision.datasets.MNIST(mode="train", synthetic_size=192)
+        model.fit(ds, epochs=2, batch_size=32, verbose=0)
+        res = model.evaluate(ds, batch_size=64, verbose=0)
+        assert res["acc"] > 0.3  # synthetic blobs learn fast
+        # static mode restored after every entry point
+        assert paddle.in_static_mode()
+    finally:
+        paddle.disable_static()
