@@ -91,7 +91,11 @@ class Layer:
         if attr is False:
             return None
         dtype = dtype or self._dtype
-        init = attr.initializer or default_initializer
+        # precedence (reference set_global_initializer semantics): an
+        # explicit per-param attr wins; the global override beats every
+        # layer-builtin default; then the layer default; then the fallback
+        init = (attr.initializer or I._global_default(is_bias)
+                or default_initializer)
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init(tuple(int(s) for s in shape), dtype)

@@ -15,6 +15,8 @@ from ..core.tensor import Tensor
 __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
            "parameters_to_vector", "vector_to_parameters"]
 
+_WHOLE = object()  # dim=None sentinel (a user's dim=-1 is a REAL axis)
+
 
 def _norm_except(w, dim):
     import paddle_tpu as paddle
@@ -25,12 +27,15 @@ def _norm_except(w, dim):
 
 
 def weight_norm(layer, name="weight", dim=0):
-    """reference: weight_norm_hook.py weight_norm — w = g * v / ||v||."""
+    """reference: weight_norm_hook.py weight_norm — w = g * v / ||v||.
+    dim=None means whole-tensor norm; negative dims count from the end."""
     import paddle_tpu as paddle
 
     w = getattr(layer, name)
+    if dim is not None and dim < 0:
+        dim += len(w.shape)
     if dim is None:
-        dim = -1  # whole-tensor norm (reference dim=None semantics)
+        dim = _WHOLE  # whole-tensor norm (reference dim=None semantics)
         g0 = paddle.sqrt(paddle.sum(paddle.multiply(w, w)))
     else:
         g0 = _norm_except(w, dim)
@@ -44,7 +49,7 @@ def weight_norm(layer, name="weight", dim=0):
     def _compute():
         vv = getattr(layer, name + "_v")
         gg = getattr(layer, name + "_g")
-        if dim == -1:
+        if dim is _WHOLE:
             nrm = paddle.sqrt(paddle.sum(paddle.multiply(vv, vv)))
         else:
             nrm = _norm_except(vv, dim)
@@ -76,13 +81,16 @@ def remove_weight_norm(layer, name="weight"):
     handle.remove()
     v = getattr(layer, name + "_v")
     g = getattr(layer, name + "_g")
-    if dim == -1:
+    if dim is _WHOLE:
         nrm = paddle.sqrt(paddle.sum(paddle.multiply(v, v)))
     else:
         nrm = _norm_except(v, dim)
     w = paddle.multiply(paddle.divide(v, nrm), g)
     del layer._parameters[name + "_v"]
     del layer._parameters[name + "_g"]
+    # drop the hook-era instance attribute or it would SHADOW the restored
+    # parameter in Layer.__getattr__ (stale weight, silent no-training)
+    layer.__dict__.pop(name, None)
     wp = paddle.to_tensor(np.asarray(w.numpy()))
     wp.stop_gradient = False
     layer.add_parameter(name, wp)

@@ -10,8 +10,7 @@ _ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), os.pardir))
 
 
 def _run(script, extra_env=None, timeout=420):
-    env = {k: v for k, v in os.environ.items()
-           if "axon" not in v.lower() or k != "PYTHONPATH"}
+    env = dict(os.environ)
     env["PYTHONPATH"] = ""  # drop the axon sitecustomize: examples pin CPU
     env["JAX_PLATFORMS"] = "cpu"
     env.update(extra_env or {})

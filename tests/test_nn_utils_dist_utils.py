@@ -94,3 +94,50 @@ def test_bilinear_initializer_kernel():
     k = np.asarray(w)[0, 0]
     np.testing.assert_allclose(k, k.T, rtol=1e-6)  # separable symmetric
     assert k.max() == k[1, 1] or k.max() == k[2, 2]
+
+
+def test_remove_weight_norm_then_train():
+    """Review regression: after removal the restored parameter must be the
+    tensor forward uses (the stale hook attribute must not shadow it)."""
+    paddle.seed(1)
+    lin = paddle.nn.Linear(4, 3)
+    paddle.nn.utils.weight_norm(lin, "weight")
+    paddle.nn.utils.remove_weight_norm(lin, "weight")
+    assert lin.weight is lin._parameters["weight"]
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    before = lin(x).numpy().copy()
+    loss = paddle.sum(lin(x))
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    after = lin(x).numpy()
+    assert not np.allclose(before, after), "updates must reach forward"
+
+
+def test_weight_norm_negative_dim_is_real_axis():
+    """Review regression: dim=-1 is the LAST axis, not the dim=None
+    whole-tensor sentinel — g must have per-slice shape."""
+    lin = paddle.nn.Linear(4, 3)
+    paddle.nn.utils.weight_norm(lin, "weight", dim=-1)
+    assert int(np.prod(lin.weight_g.shape)) == 3  # one g per output column
+    lin2 = paddle.nn.Linear(4, 3)
+    paddle.nn.utils.weight_norm(lin2, "weight", dim=None)
+    assert int(np.prod(lin2.weight_g.shape)) == 1  # whole-tensor norm
+
+
+def test_set_global_initializer_takes_effect():
+    """Review regression: set_global_initializer must actually drive
+    parameter creation."""
+    paddle.nn.initializer.set_global_initializer(
+        paddle.nn.initializer.Constant(0.25),
+        paddle.nn.initializer.Constant(-1.0))
+    try:
+        lin = paddle.nn.Linear(3, 2)
+        np.testing.assert_allclose(lin.weight.numpy(), 0.25)
+        np.testing.assert_allclose(lin.bias.numpy(), -1.0)
+    finally:
+        paddle.nn.initializer.set_global_initializer(None)
+    lin2 = paddle.nn.Linear(3, 2)
+    assert not np.allclose(lin2.weight.numpy(), 0.25)
