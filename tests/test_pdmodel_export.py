@@ -157,3 +157,43 @@ def test_export_negative_padding_idx_and_pair_paddings(tmp_path, static_mode):
         desc = parse_program_desc(f.read())
     conv_descs = [o for o in desc["blocks"][0]["ops"] if o["type"] == "conv2d"]
     assert conv_descs[0]["attrs"]["paddings"] == [1, 2, 0, 1]
+
+
+def test_convert_to_mixed_precision_roundtrip(tmp_path, static_mode):
+    """inference.convert_to_mixed_precision rewrites the .pdiparams stream
+    to the requested dtype and rejects unsupported requests loudly."""
+    import ml_dtypes
+
+    from paddle_tpu import inference
+    from paddle_tpu.framework.io import _read_lod_tensor
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [2, 4], "float32")
+        w = paddle.to_tensor(np.random.randn(4, 3).astype("float32"))
+        y = paddle.matmul(x, w)
+    prefix = str(tmp_path / "m")
+    static.save_inference_model(prefix, [x], [y], program=main,
+                                program_format="pdmodel")
+    inference.convert_to_mixed_precision(
+        prefix + ".pdmodel", prefix + ".pdiparams",
+        prefix + "_bf16.pdmodel", prefix + "_bf16.pdiparams",
+        mixed_precision="bfloat16")
+    import io as _io
+
+    data = open(prefix + "_bf16.pdiparams", "rb").read()
+    arr, _ = _read_lod_tensor(_io.BytesIO(data))
+    assert arr.dtype == ml_dtypes.bfloat16
+    # fp16 spelling works; bogus dtype and black_list are loud
+    inference.convert_to_mixed_precision(
+        prefix + ".pdmodel", prefix + ".pdiparams",
+        prefix + "_f16.pdmodel", prefix + "_f16.pdiparams",
+        mixed_precision="fp16")
+    with pytest.raises(ValueError, match="mixed_precision"):
+        inference.convert_to_mixed_precision(
+            prefix + ".pdmodel", prefix + ".pdiparams", "/tmp/x", "/tmp/y",
+            mixed_precision="int3")
+    with pytest.raises(NotImplementedError, match="black_list"):
+        inference.convert_to_mixed_precision(
+            prefix + ".pdmodel", prefix + ".pdiparams", "/tmp/x", "/tmp/y",
+            black_list={"softmax"})
