@@ -1,0 +1,211 @@
+"""OpTest batch 3: activation tail, cumulative/linalg ops, multi-output
+grads (reference test strategy SURVEY §4.1)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.utils.op_test import OpTest
+
+
+class TestEluOp(OpTest):
+    def setUp(self):
+        self.op = F.elu
+        self.inputs = {"x": (np.random.rand(12) * 4 - 2).astype("float32")}
+        self.attrs = {"alpha": 1.5}
+        self.ref = lambda x, alpha: np.where(x > 0, x,
+                                             alpha * (np.exp(x) - 1))
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestSoftplusOp(OpTest):
+    def setUp(self):
+        self.op = F.softplus
+        self.inputs = {"x": (np.random.rand(10) * 6 - 3).astype("float32")}
+        self.attrs = {}
+        self.ref = lambda x: np.log1p(np.exp(x))
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestHardswishOp(OpTest):
+    def setUp(self):
+        self.op = F.hardswish
+        self.inputs = {"x": (np.random.rand(20) * 10 - 5).astype("float32")}
+        self.attrs = {}
+        self.ref = lambda x: x * np.clip(x + 3, 0, 6) / 6
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSeluOp(OpTest):
+    def setUp(self):
+        self.op = F.selu
+        self.inputs = {"x": (np.random.rand(10) * 2 - 1).astype("float32")}
+        self.attrs = {}
+        scale, alpha = 1.0507009873554805, 1.6732632423543772
+        self.ref = lambda x: scale * np.where(
+            x > 0, x, alpha * (np.exp(x) - 1))
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestCumsumOp(OpTest):
+    def setUp(self):
+        self.op = paddle.cumsum
+        self.inputs = {"x": np.random.rand(3, 5).astype("float32")}
+        self.attrs = {"axis": 1}
+        self.ref = lambda x, axis: x.cumsum(axis)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestCumprodOp(OpTest):
+    def setUp(self):
+        self.op = paddle.cumprod
+        self.inputs = {"x": (np.random.rand(4, 3) + 0.5).astype("float32")}
+        self.attrs = {"dim": 0}
+        self.ref = lambda x, dim: x.cumprod(dim)
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestPreluOp(OpTest):
+    def setUp(self):
+        self.op = F.prelu
+        self.inputs = {
+            "x": (np.random.rand(2, 3, 4) * 2 - 1).astype("float32"),
+            "weight": np.full(3, 0.2, "float32"),
+        }
+        self.attrs = {}
+
+        def ref(x, weight):
+            w = weight.reshape(1, -1, 1)
+            return np.where(x > 0, x, x * w)
+
+        self.ref = ref
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "weight"])
+
+
+class TestStackGrad(OpTest):
+    def setUp(self):
+        def op(a, b):
+            return paddle.stack([a, b], axis=0)
+
+        self.op = op
+        self.inputs = {
+            "a": np.random.rand(3, 4).astype("float32"),
+            "b": np.random.rand(3, 4).astype("float32"),
+        }
+        self.attrs = {}
+        self.ref = lambda a, b: np.stack([a, b])
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["a", "b"])
+
+
+class TestSplitMultiOutputGrad(OpTest):
+    def setUp(self):
+        def op(x):
+            a, b = paddle.split(x, 2, axis=1)
+            return a, b
+
+        self.op = op
+        self.inputs = {"x": np.random.rand(3, 8).astype("float32")}
+        self.attrs = {}
+        self.ref = lambda x: (x[:, :4], x[:, 4:])
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestMatmulBatchedOp(OpTest):
+    def setUp(self):
+        self.op = paddle.matmul
+        self.inputs = {
+            "x": np.random.rand(2, 3, 4).astype("float32"),
+            "y": np.random.rand(2, 4, 5).astype("float32"),
+        }
+        self.attrs = {}
+        self.ref = lambda x, y: x @ y
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x", "y"])
+
+
+class TestNormOp(OpTest):
+    def setUp(self):
+        self.op = paddle.linalg.norm
+        self.inputs = {"x": np.random.rand(4, 5).astype("float32")}
+        self.attrs = {"p": 2, "axis": 1}
+        self.ref = lambda x, p, axis: np.linalg.norm(x, p, axis)
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"], atol=1e-3)
+
+
+class TestLogCumsumExpStyleChain(OpTest):
+    def setUp(self):
+        def op(x):
+            return paddle.log(paddle.cumsum(paddle.exp(x), axis=0))
+
+        self.op = op
+        self.inputs = {"x": np.random.rand(4, 3).astype("float32")}
+        self.attrs = {}
+        self.ref = lambda x: np.log(np.exp(x).cumsum(0))
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
+
+
+class TestPadOp(OpTest):
+    def setUp(self):
+        self.op = F.pad
+        self.inputs = {"x": np.random.rand(2, 3).astype("float32")}
+        self.attrs = {"pad": [1, 2], "value": 0.5}
+
+        def ref(x, pad, value):
+            return np.pad(x, ((0, 0), (1, 2)), constant_values=value)
+
+        self.ref = ref
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(["x"])
