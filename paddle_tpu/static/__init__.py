@@ -19,6 +19,7 @@ from .program import (  # noqa: F401
     program_guard,
 )
 from .pipeline import PipelineCompiledProgram, split_program_by_device  # noqa: F401
+from . import amp  # noqa: F401
 from .debug import program_to_dot, program_to_string  # noqa: F401
 from .scope import Scope, scope_guard  # noqa: F401
 from .executor import CompiledProgram, Executor  # noqa: F401

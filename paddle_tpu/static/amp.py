@@ -1,0 +1,136 @@
+"""paddle.static.amp (reference: python/paddle/static/amp/__init__.py —
+re-exports of fluid.contrib.mixed_precision). TPU-native: the static AMP
+rewrite lives in the registered program passes (static/passes.py
+auto_mixed_precision, distributed/passes.py auto_parallel_amp/fp16); this
+namespace keeps the reference's static-AMP entry points working on top of
+them.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["decorate", "CustomOpLists", "AutoMixedPrecisionLists",
+           "fp16_guard", "cast_model_to_fp16", "cast_parameters_to_fp16",
+           "bf16"]
+
+
+class AutoMixedPrecisionLists:
+    """reference: fluid/contrib/mixed_precision/fp16_lists.py — white/black
+    op lists consulted by the AMP passes."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        from .passes import _AMP_WHITELIST
+
+        self.white_list = set(_AMP_WHITELIST) | set(custom_white_list or ())
+        self.black_list = set(custom_black_list or ())
+        self.black_varnames = set(custom_black_varnames or ())
+
+
+CustomOpLists = AutoMixedPrecisionLists
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True, use_pure_fp16=False,
+             use_fp16_guard=None, use_bf16=False):
+    """reference: mixed_precision/decorator.py decorate — wrap an optimizer
+    so minimize() applies the AMP program rewrite. Here: minimize registers
+    the train spec as usual, then the amp (O1) or fp16 (O2 + loss scaling)
+    pass is applied to the program, composing with any other passes."""
+    from ..distributed.passes import new_pass
+
+    class _AmpOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+            self._loss_scaling = float(init_loss_scaling)
+
+        def minimize(self, loss, startup_program=None, parameters=None,
+                     no_grad_set=None):
+            out = self._inner.minimize(loss, startup_program=startup_program,
+                                       parameters=parameters)
+            from .program import default_main_program
+
+            prog = default_main_program()
+            if use_pure_fp16:
+                new_pass("auto_parallel_fp16", {
+                    "init_loss_scaling": init_loss_scaling,
+                    "incr_every_n_steps": incr_every_n_steps,
+                    "decr_every_n_nan_or_inf": decr_every_n_nan_or_inf,
+                    "incr_ratio": incr_ratio, "decr_ratio": decr_ratio,
+                    "use_bf16": use_bf16,
+                    "use_dynamic_loss_scaling": use_dynamic_loss_scaling,
+                }).apply(prog)
+            else:
+                new_pass("auto_parallel_amp", {
+                    "custom_white_list":
+                        sorted(amp_lists.white_list) if amp_lists else None,
+                    "custom_black_list":
+                        sorted(amp_lists.black_list) if amp_lists else None,
+                }).apply(prog)
+            return out
+
+        def amp_init(self, place=None, scope=None, test_program=None,
+                     use_fp16_test=False):
+            """reference: decorator.py amp_init — master-weight cast point;
+            parameter layout is the executor's job on this runtime."""
+
+        def get_loss_scaling(self):
+            return self._loss_scaling
+
+        def __getattr__(self, name):
+            return getattr(self.__dict__["_inner"], name)
+
+    return _AmpOptimizer(optimizer)
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """reference: fp16_utils.py fp16_guard — marks a region whose ops the
+    pure-fp16 pass may cast. The pass here operates whole-program (XLA
+    fuses casts), so the guard is a no-op scope kept for source compat."""
+    yield
+
+
+def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
+    """reference: fp16_utils.py cast_model_to_fp16 — apply the O2 cast
+    rewrite to `program`."""
+    from ..distributed.passes import new_pass
+
+    new_pass("auto_parallel_fp16",
+             {"use_dynamic_loss_scaling": False}).apply(program)
+    return program
+
+
+def cast_parameters_to_fp16(place, program, scope=None, to_fp16_var_names=None):
+    """reference: fp16_utils.py cast_parameters_to_fp16. Parameters live as
+    captured tensors; cast them in place."""
+    import jax.numpy as jnp
+
+    for p in program.captured_params():
+        if p._value.dtype == jnp.float32 and not p.stop_gradient:
+            p._value = p._value.astype(jnp.float16)
+
+
+class _Bf16Namespace:
+    """reference: mixed_precision/bf16 — bf16 variants. bf16 is the
+    DEFAULT low precision on TPU; decorate_bf16 routes to the same passes
+    with use_bf16."""
+
+    AutoMixedPrecisionListsBF16 = AutoMixedPrecisionLists
+
+    @staticmethod
+    def decorate_bf16(optimizer, amp_lists=None, use_pure_bf16=False,
+                      use_bf16_guard=None):
+        return decorate(optimizer, amp_lists=amp_lists,
+                        use_pure_fp16=use_pure_bf16, use_bf16=True,
+                        use_dynamic_loss_scaling=False)
+
+    @staticmethod
+    @contextlib.contextmanager
+    def bf16_guard():
+        yield
+
+
+bf16 = _Bf16Namespace()

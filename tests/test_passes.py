@@ -130,3 +130,44 @@ def test_pass_manager_ordering():
     assert all(op.attrs.get("amp") == "bf16"
                for op in prog.global_block.ops
                if op.type == "fused_gemm_epilogue")
+
+
+def test_static_amp_namespace():
+    """paddle.static.amp (reference static/amp/__init__.py re-exports):
+    decorate(O1/O2), lists, guards, cast helpers route through the
+    registered AMP passes."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("sx", [8, 6], "float32")
+            y = static.data("sy", [8], "int64")
+            net = paddle.nn.Sequential(paddle.nn.Linear(6, 16),
+                                       paddle.nn.ReLU(),
+                                       paddle.nn.Linear(16, 4))
+            loss = paddle.nn.functional.cross_entropy(net(x), y)
+            opt = static.amp.decorate(paddle.optimizer.SGD(0.1),
+                                      use_pure_fp16=True, use_bf16=True)
+            opt.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        feed = {"sx": rng.rand(8, 6).astype("float32"),
+                "sy": rng.randint(0, 4, (8,)).astype("int64")}
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(4)]
+        assert losses[-1] < losses[0]
+        assert opt.get_loss_scaling() > 0
+        with static.amp.fp16_guard():
+            pass
+        with static.amp.bf16.bf16_guard():
+            pass
+        lists = static.amp.AutoMixedPrecisionLists(
+            custom_white_list=["gelu"])
+        assert "gelu" in lists.white_list and "matmul" in lists.white_list
+    finally:
+        paddle.disable_static()
