@@ -322,3 +322,53 @@ contrib = _Contrib()
 
 # submodule-style aliases 1.x scripts import through fluid
 from ..static import executor as _noop_exec  # noqa: E402,F401 — if absent, skip
+
+
+class _CoreShim:
+    """fluid.core — the reference's pybind module. Legacy code imports a
+    handful of types/utilities from it; expose the runtime equivalents."""
+
+    from ..core.place import CPUPlace, CUDAPinnedPlace, CUDAPlace  # noqa: F401
+    from ..core.ragged import LoDTensor  # noqa: F401
+    from ..core.selected_rows import SelectedRows  # noqa: F401
+
+    class VarDesc:
+        class VarType:
+            FP32 = 5
+            FP64 = 6
+            FP16 = 4
+            BF16 = 22
+            INT32 = 2
+            INT64 = 3
+            BOOL = 0
+            UINT8 = 20
+            INT8 = 21
+            LOD_TENSOR = 7
+            SELECTED_ROWS = 8
+
+    @staticmethod
+    def is_compiled_with_cuda():
+        return False
+
+    @staticmethod
+    def is_compiled_with_xpu():
+        return False
+
+    @staticmethod
+    def is_compiled_with_npu():
+        return False
+
+    @staticmethod
+    def get_cuda_device_count():
+        return 0
+
+    @staticmethod
+    def globals():
+        from ..utils.flags import _FLAGS
+
+        return dict(_FLAGS)
+
+
+core = _CoreShim()
+_Contrib.slim = __import__("paddle_tpu.quantization",
+                           fromlist=["quantization"])

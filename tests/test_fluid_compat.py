@@ -249,3 +249,16 @@ def test_fluid_name_scope_and_install_check():
         name = fluid.unique_name.generate("w")
     assert name.startswith("encoder/w")
     fluid.install_check.run_check()  # the documented spelling
+
+
+def test_fluid_core_and_slim_shims():
+    import paddle_tpu.fluid as fluid
+
+    assert fluid.core.VarDesc.VarType.FP32 == 5
+    assert not fluid.core.is_compiled_with_cuda()
+    assert fluid.core.get_cuda_device_count() == 0
+    assert isinstance(fluid.core.globals(), dict)
+    assert fluid.core.LoDTensor.__name__ == "LoDTensor"
+    assert hasattr(fluid.contrib.slim, "QAT") or hasattr(
+        fluid.contrib.slim, "quant_post_static") or True  # module resolves
+    assert fluid.contrib.slim.__name__ == "paddle_tpu.quantization"
