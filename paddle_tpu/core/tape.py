@@ -117,6 +117,7 @@ def backward(tensor, grad=None, retain_graph=False):
     cts[id(tensor)] = seed
 
     hooked: list = []  # leaves with registered hooks, in first-touch order
+    hooked_ids: set = set()  # identity set — Tensor.__eq__ is elementwise
 
     for node in reversed(order):
         out_cts = []
@@ -143,7 +144,8 @@ def backward(tensor, grad=None, retain_graph=False):
                     if not t.stop_gradient:
                         t._accumulate_grad(ct)
                         if getattr(t, "_grad_hooks", None) and \
-                                t not in hooked:
+                                id(t) not in hooked_ids:
+                            hooked_ids.add(id(t))
                             hooked.append(t)
                 else:
                     from .selected_rows import SelectedRows

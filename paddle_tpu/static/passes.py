@@ -186,12 +186,30 @@ class FuseGemmEpiloguePass(PassBase):
             i = 0
             new_ops = []
             consumed = set()
+            emit_at = {}  # id(last part) -> fused Operator
             while i < len(ops):
                 op = ops[i]
                 if id(op) in consumed:
                     i += 1
                     continue
+                if id(op) in emit_at:
+                    # the fused op is emitted at the LAST fused part's
+                    # position so every pulled-in operand (e.g. a bias
+                    # produced between the matmul and the add) is already
+                    # defined by the time the fused op runs
+                    new_ops.append(emit_at.pop(id(op)))
+                    i += 1
+                    continue
                 chain = self._match(ops, i, counts)
+                if chain is not None:
+                    # refuse a chain whose add/act is already claimed by an
+                    # earlier chain (z = matmul(a,b) + matmul(c,d): both
+                    # matmuls match the shared add; only the first may fuse)
+                    mm_, add_, act_ = chain
+                    taken = [add_] + ([act_] if act_ else [])
+                    if any(id(p) in consumed or id(p) in emit_at
+                           for p in taken):
+                        chain = None
                 if chain is None:
                     new_ops.append(op)
                     i += 1
@@ -214,8 +232,8 @@ class FuseGemmEpiloguePass(PassBase):
                            "fused_from": [p.type for p in parts]},
                     op_role=mm.op_role,
                 )
-                new_ops.append(fused)
-                for p in parts[1:]:
+                emit_at[id(last)] = fused
+                for p in parts[1:-1]:
                     consumed.add(id(p))
                 n_fused += 1
                 i += 1
@@ -369,8 +387,11 @@ class DeadCodeEliminationPass(PassBase):
     list of Variables (or names) that must stay computable. Side-effecting
     ops (collectives, send/recv, py_func, print) are always kept."""
 
-    _KEEP_ALWAYS = ("c_", "send", "recv", "py_func", "print", "barrier",
-                    "global_scatter", "global_gather")
+    # collective ops by prefix; the rest by exact type match (substring
+    # matching kept e.g. any "*fc_*" fused op alive and silently weakened DCE)
+    _KEEP_PREFIXES = ("c_", "send", "recv", "partial_send", "partial_recv")
+    _KEEP_EXACT = frozenset({"py_func", "print", "barrier",
+                             "global_scatter", "global_gather"})
 
     def check(self, program):
         return bool(self.attrs.get("targets"))
@@ -386,7 +407,8 @@ class DeadCodeEliminationPass(PassBase):
         kept = []
         for op in reversed(block.ops):
             t = op.type.split("/")[-1].lower()
-            keep = any(t.startswith(k) or k in t for k in self._KEEP_ALWAYS) \
+            keep = t.startswith(self._KEEP_PREFIXES) \
+                or t in self._KEEP_EXACT \
                 or any(id(o) in live for o in op.outputs)
             if keep:
                 kept.append(op)

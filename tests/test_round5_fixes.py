@@ -1,0 +1,152 @@
+"""Round-5 regression tests for the ADVICE r4 findings.
+
+Each test reproduces the confirmed failure from ADVICE.md and pins the fix:
+  1. core/tape.py — hook bookkeeping used `t not in hooked` with elementwise
+     Tensor.__eq__ (TypeError across shapes; silent skip on equal values).
+  2. static/passes.py fuse_gemm_epilogue — fused op emitted at the matmul's
+     position read a bias produced between the matmul and the add before it
+     was defined (KeyError in Executor.run).
+  3. static/passes.py DCE — `'c_' in t` substring keep-alive kept any op with
+     'c_' anywhere in its type (e.g. fused fc ops), weakening DCE.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.static.passes import new_pass
+
+
+# ------------------------------------------------ 1. grad hooks by identity
+def test_grad_hooks_fire_across_different_shapes():
+    # ADVICE high: backward() over two hooked leaves of DIFFERENT shapes
+    # raised TypeError (broadcast mismatch inside `t not in hooked`).
+    a = paddle.to_tensor(np.ones((2, 3), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((5,), np.float32), stop_gradient=False)
+    fired = []
+    a.register_hook(lambda g: fired.append("a") or g)
+    b.register_hook(lambda g: fired.append("b") or g)
+    loss = (a.sum() + b.sum())
+    loss.backward()
+    assert sorted(fired) == ["a", "b"]
+
+
+def test_grad_hooks_fire_for_equal_valued_tensors():
+    # ADVICE high: same-shape tensors with equal VALUES silently skipped the
+    # second tensor's hooks (elementwise __eq__ made them "already hooked").
+    a = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    fired = []
+    a.register_hook(lambda g: fired.append("a") or g)
+    b.register_hook(lambda g: fired.append("b") or g)
+    (a * b).sum().backward()
+    assert sorted(fired) == ["a", "b"]
+    # hooks must also still run once each, on the accumulated grad
+    assert fired.count("a") == 1 and fired.count("b") == 1
+
+
+# ---------------------------------- 2. fuse_gemm_epilogue interleaved producer
+def test_fuse_gemm_epilogue_bias_produced_between_matmul_and_add():
+    # ADVICE medium: y=matmul(x,w); b=relu(z); out=y+b — the bias producer
+    # sits between the fused parts; the fused op must be emitted at the
+    # add's position, after relu(z) is defined.
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            z = static.data("z", [2, 8])
+            w = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+            wz = paddle.to_tensor(np.random.rand(8, 8).astype("float32"))
+            y = paddle.matmul(x, w)
+            b = paddle.nn.functional.relu(paddle.matmul(z, wz))
+            out = y + b
+
+        xv = np.random.rand(2, 4).astype("float32")
+        zv = np.random.rand(2, 8).astype("float32")
+        exe = static.Executor()
+        (before,) = exe.run(prog, feed={"x": xv, "z": zv}, fetch_list=[out])
+
+        ctx = new_pass("fuse_gemm_epilogue").apply(prog)
+        assert ctx.attrs["fused_gemm_epilogue"] >= 1
+        types = [op.type for op in prog.global_block.ops]
+        # the y+b chain fused; the relu producer still precedes the fused op
+        fused_idx = types.index("fused_gemm_epilogue")
+        assert "relu" in types[:fused_idx] or "matmul" in types[:fused_idx]
+
+        exe2 = static.Executor()
+        (after,) = exe2.run(prog, feed={"x": xv, "z": zv}, fetch_list=[out])
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+    finally:
+        static.disable_static()
+
+
+# --------------------------------------------------- 3. DCE keep-alive match
+def test_dce_removes_dead_op_with_c_substring():
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            w = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+            y = paddle.matmul(x, w)      # live: target
+            dead = paddle.nn.functional.relu(x)  # dead branch
+        # rename the dead op so its type CONTAINS 'c_' without being a
+        # collective ("fc_fused" was the ADVICE example)
+        for op in prog.global_block.ops:
+            if op.type == "relu":
+                op.type = "fc_fused_relu"
+        ctx = new_pass("dead_code_elimination",
+                       {"targets": [y]}).apply(prog)
+        types = [op.type for op in prog.global_block.ops]
+        assert "fc_fused_relu" not in types, (
+            "substring 'c_' keep-alive resurrected a dead non-collective op")
+        assert ctx.attrs["dead_code_elimination.n_removed"] >= 1
+    finally:
+        static.disable_static()
+
+
+def test_dce_keeps_collective_prefix_ops():
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            w = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+            y = paddle.matmul(x, w)
+            side = x * 2.0  # will be renamed to a collective type
+        for op in prog.global_block.ops:
+            if op.type in ("mul", "multiply", "elementwise_mul", "scale"):
+                op.type = "c_allreduce_sum"
+        new_pass("dead_code_elimination", {"targets": [y]}).apply(prog)
+        types = [op.type for op in prog.global_block.ops]
+        assert "c_allreduce_sum" in types, (
+            "collective op must survive DCE even when not on the target path")
+    finally:
+        static.disable_static()
+
+
+def test_fuse_gemm_epilogue_shared_add_fuses_only_one_chain():
+    # review finding: z = matmul(a,b) + matmul(c,d) — both matmuls match the
+    # shared add; the second chain must be refused, not overwrite the first.
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            w1 = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+            w2 = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+            out = paddle.matmul(x, w1) + paddle.matmul(x, w2)
+        xv = np.random.rand(2, 4).astype("float32")
+        (before,) = static.Executor().run(prog, feed={"x": xv},
+                                          fetch_list=[out])
+        ctx = new_pass("fuse_gemm_epilogue").apply(prog)
+        types = [op.type for op in prog.global_block.ops]
+        assert types.count("fused_gemm_epilogue") == 1
+        assert types.count("matmul") == 1  # the unfused matmul survives
+        assert ctx.attrs["fused_gemm_epilogue"] == 1
+        (after,) = static.Executor().run(prog, feed={"x": xv},
+                                         fetch_list=[out])
+        np.testing.assert_allclose(before, after, rtol=1e-6)
+    finally:
+        static.disable_static()
