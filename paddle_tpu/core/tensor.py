@@ -41,6 +41,7 @@ class Tensor:
         "_grad_alias",
         "_grad_hooks",
         "_next_hook_key",
+        "_lazy_init",
         "__weakref__",
     )
 
@@ -48,7 +49,14 @@ class Tensor:
         if isinstance(value, Tensor):
             value = value._value
         jdt = dtype_mod.to_jax_dtype(dtype)
-        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+        if isinstance(value, jax.ShapeDtypeStruct):
+            # meta tensor (paddle.LazyGuard): shape/dtype known, storage
+            # unallocated — materialized later (e.g. sharded init of a model
+            # too large for one host). Reference: python/paddle/fluid/
+            # framework.py LazyGuard / lazy-init param_guard.
+            if jdt is not None and value.dtype != jdt:
+                value = jax.ShapeDtypeStruct(value.shape, jdt)
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
             was_ndarray = isinstance(value, np.ndarray)
             arr = np.asarray(value)
             if jdt is None and arr.dtype == np.float64 and not was_ndarray:
@@ -58,6 +66,7 @@ class Tensor:
         elif jdt is not None and value.dtype != jdt:
             value = value.astype(jdt)
         self._value = value
+        self._lazy_init = None  # (init, shape, dtype) for LazyGuard metas
         self._stop_gradient = bool(stop_gradient)
         self.grad = None
         self._tape_node = None
@@ -104,8 +113,18 @@ class Tensor:
     def is_leaf(self) -> bool:
         return self._tape_node is None
 
+    @property
+    def is_meta(self) -> bool:
+        """True for a LazyGuard meta tensor: shape/dtype only, no storage."""
+        return isinstance(self._value, jax.ShapeDtypeStruct)
+
     # ------------------------------------------------------------- conversion
     def numpy(self) -> np.ndarray:
+        if self.is_meta:
+            raise RuntimeError(
+                "Tensor is a LazyGuard meta tensor (shape "
+                f"{tuple(self._value.shape)}): materialize it first "
+                "(Layer.lazy_materialize or a sharded init_fn)")
         return np.asarray(self._value)
 
     def __array__(self, dtype=None):

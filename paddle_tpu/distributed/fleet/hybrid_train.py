@@ -119,12 +119,18 @@ def _batch_spec(ndim, mesh):
 def build_hybrid_step(model, optimizer, loss_fn, mesh: Mesh, zero_stage: int = 0,
                       amp_level: str = "O0", recompute: bool = False,
                       recompute_configs: dict | None = None,
-                      sequence_parallel: bool = False, donate: bool = True):
+                      sequence_parallel: bool = False, donate: bool = True,
+                      with_aux: bool = False):
     """Build (init_fn, step_fn) for the hybrid-parallel training step.
 
     init_fn() -> state dict of device arrays laid out per the sharding rules.
     step_fn(state, key, lr, inputs, labels) -> (loss, new_state); pjit-compiled,
     param/opt buffers donated.
+
+    with_aux=True appends a 4th element: {"state_shardings", "abstract_state",
+    "mesh"} — abstract_state() returns the state as ShapeDtypeStructs with
+    shardings attached, so the step can be AOT-lowered/compiled (memory and
+    cost analysis at any model scale) without materializing a single weight.
     """
     if recompute:
         from .recompute import apply_recompute
@@ -145,7 +151,15 @@ def build_hybrid_step(model, optimizer, loss_fn, mesh: Mesh, zero_stage: int = 0
     f_specs = {k: _param_spec(v, mesh, 0) for k, v in frozen_p.items()}
     b_specs = {k: P() for k in buffers}
 
-    opt_state_template = optimizer.functional_init({k: v._value for k, v in train_p.items()})
+    # LazyGuard meta models (shape-only params, e.g. a 6.7B GPT too large to
+    # materialize on one host): compute the opt-state TEMPLATE abstractly and
+    # materialize everything sharded inside init_fn.
+    any_meta = any(v.is_meta for v in train_p.values())
+    p_arrays = {k: v._value for k, v in train_p.items()}
+    if any_meta:
+        opt_state_template = jax.eval_shape(optimizer.functional_init, p_arrays)
+    else:
+        opt_state_template = optimizer.functional_init(p_arrays)
     slot_specs = {
         "step": P(),
         "slots": {
@@ -167,20 +181,52 @@ def build_hybrid_step(model, optimizer, loss_fn, mesh: Mesh, zero_stage: int = 0
         ),
     }
 
+    def _materialize(v, sh):
+        """device_put a concrete param; jit-init a meta param directly into
+        its sharded layout (each device allocates only its own shard)."""
+        if not getattr(v, "is_meta", False):
+            return jax.device_put(v._value, sh)
+        if v._lazy_init is None:
+            raise RuntimeError(
+                f"meta tensor {getattr(v, 'name', '?')} has no recorded "
+                "initializer (not created under LazyGuard?) — cannot "
+                "materialize")
+        init, shape, dtype = v._lazy_init
+        # draw the key EAGERLY, then pin it inside the jit via
+        # trace_rng_scope — letting the initializer advance the global
+        # generator inside the trace would leak a tracer into it
+        key = rng_mod.next_rng_key()
+
+        def _init(key):
+            with rng_mod.trace_rng_scope(key):
+                return init(shape, dtype)
+
+        arr = jax.jit(_init, out_shardings=sh)(key)
+        v._value = arr  # the model object is now materialized too
+        v._lazy_init = None
+        return arr
+
     def init_fn():
         state = {
-            "p": {k: jax.device_put(v._value, state_shardings["p"][k])
+            "p": {k: _materialize(v, state_shardings["p"][k])
                   for k, v in train_p.items()},
-            "frozen": {k: jax.device_put(v._value, state_shardings["frozen"][k])
+            "frozen": {k: _materialize(v, state_shardings["frozen"][k])
                        for k, v in frozen_p.items()},
             "b": {k: jax.device_put(v._value, state_shardings["b"][k])
                   for k, v in buffers.items() if v is not None},
-            "opt": jax.tree_util.tree_map(
+        }
+        if any_meta:
+            # build opt slots on-device in their final sharded layout
+            state["opt"] = jax.jit(
+                optimizer.functional_init,
+                out_shardings=state_shardings["opt"],
+            )(state["p"])
+        else:
+            state["opt"] = jax.tree_util.tree_map(
                 lambda a, s: jax.device_put(a, s),
                 opt_state_template,
                 state_shardings["opt"],
-            ),
-        }
+            )
         return state
 
     def forward_loss(pvals, frozen, bvals, key, inputs, labels):
@@ -222,8 +268,29 @@ def build_hybrid_step(model, optimizer, loss_fn, mesh: Mesh, zero_stage: int = 0
 
     from .._sharding_utils import make_shard_batch
 
-    return init_fn, step_jit, make_shard_batch(
-        mesh, lambda ndim: _batch_spec(ndim, mesh))
+    shard_batch = make_shard_batch(mesh, lambda ndim: _batch_spec(ndim, mesh))
+    if not with_aux:
+        return init_fn, step_jit, shard_batch
+
+    def abstract_state():
+        def _struct(a, sh):
+            return jax.ShapeDtypeStruct(np.shape(a), a.dtype, sharding=sh)
+
+        return {
+            "p": {k: _struct(train_p[k]._value, state_shardings["p"][k])
+                  for k in train_p},
+            "frozen": {k: _struct(frozen_p[k]._value,
+                                  state_shardings["frozen"][k])
+                       for k in frozen_p},
+            "b": {k: _struct(v._value, state_shardings["b"][k])
+                  for k, v in buffers.items() if v is not None},
+            "opt": jax.tree_util.tree_map(
+                _struct, opt_state_template, state_shardings["opt"]),
+        }
+
+    aux = {"state_shardings": state_shardings, "abstract_state": abstract_state,
+           "mesh": mesh, "param_specs": p_specs}
+    return init_fn, step_jit, shard_batch, aux
 
 
 class HybridParallelModel:

@@ -47,11 +47,32 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     return results
 
 
-class LazyGuard:  # pragma: no cover - API stub for parity
+class LazyGuard:
+    """Construct layers without allocating parameter storage.
+
+    Inside the guard, ``Layer.create_parameter`` produces META parameters —
+    shape/dtype only (``Tensor.is_meta``), with the initializer recorded on
+    ``param._lazy_init`` for later materialization. This is how a model too
+    large for one host (e.g. GPT-6.7B) is built: construct under LazyGuard,
+    then materialize each param directly into its sharded device layout via
+    ``Layer.lazy_materialize(...)`` or the hybrid-parallel ``init_fn``.
+
+    Reference: python/paddle/fluid/framework.py ``LazyGuard`` /
+    python/paddle/jit/dy2static `lazy init` — same contract (delayed
+    parameter initialization), realized here through jax.eval_shape +
+    sharded jit materialization instead of deferred startup-program ops.
+    """
+
     def __enter__(self):
+        from ..nn import layer as layer_mod
+
+        layer_mod._LAZY_INIT_DEPTH += 1
         return self
 
     def __exit__(self, *a):
+        from ..nn import layer as layer_mod
+
+        layer_mod._LAZY_INIT_DEPTH -= 1
         return False
 
 

@@ -53,6 +53,9 @@ class ParamAttr:
         raise TypeError(f"Invalid ParamAttr spec: {attr!r}")
 
 
+_LAZY_INIT_DEPTH = 0  # >0 inside paddle.LazyGuard — create meta parameters
+
+
 class Parameter(Tensor):
     """A trainable Tensor (reference: framework.Parameter)."""
 
@@ -98,12 +101,56 @@ class Layer:
                 or default_initializer)
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
-        value = init(tuple(int(s) for s in shape), dtype)
-        p = Parameter(value, trainable=attr.trainable, name=attr.name)
+        shape = tuple(int(s) for s in shape)
+        if _LAZY_INIT_DEPTH > 0:
+            # LazyGuard: record shape/dtype + initializer, allocate nothing.
+            # Every Initializer returns exactly (shape, to_jax_dtype(dtype))
+            # — except Assign, whose shape comes from its captured value —
+            # so the aval is known without tracing (tracing would thread the
+            # global RNG through an eval_shape and leak tracers into it).
+            import jax
+
+            from ..core.dtype import to_jax_dtype
+
+            if isinstance(init, I.Assign):
+                shape = tuple(np.shape(init.value))
+            p = Parameter(jax.ShapeDtypeStruct(shape, to_jax_dtype(dtype)),
+                          trainable=attr.trainable, name=attr.name)
+            p._lazy_init = (init, shape, dtype)
+        else:
+            value = init(shape, dtype)
+            p = Parameter(value, trainable=attr.trainable, name=attr.name)
         p.optimize_attr = {"learning_rate": attr.learning_rate}
         p.regularizer = attr.regularizer
         p.need_clip = getattr(attr, "need_clip", True)
         return p
+
+    def lazy_materialize(self, sharding_fn=None):
+        """Materialize LazyGuard meta parameters (see framework.LazyGuard).
+
+        sharding_fn(name, param) -> jax.sharding.Sharding | None. When a
+        sharding is returned the initializer runs as ONE jitted computation
+        with that out_sharding, so each device only ever allocates its own
+        shard — a 6.7B model initializes across a mesh without any host
+        needing the full array.
+        """
+        import jax
+
+        n = 0
+        for name, p in self.named_parameters():
+            if p is None or not p.is_meta:
+                continue
+            init, shape, dtype = p._lazy_init
+            sh = sharding_fn(name, p) if sharding_fn is not None else None
+            if sh is not None:
+                value = jax.jit(lambda i=init, s=shape, d=dtype: i(s, d),
+                                out_shardings=sh)()
+            else:
+                value = init(shape, dtype)
+            p._value = value
+            p._lazy_init = None
+            n += 1
+        return n
 
     def add_parameter(self, name: str, parameter: Parameter | None):
         if parameter is None:

@@ -1,0 +1,78 @@
+"""LazyGuard meta-parameter construction + sharded materialization.
+
+Reference: python/paddle/fluid/framework.py LazyGuard (delayed parameter
+initialization). TPU-native realization: meta params carry
+jax.ShapeDtypeStruct; materialization runs the recorded initializer as one
+jitted computation with out_shardings, so each device only allocates its own
+shard — how a model larger than one host is brought up.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_lazy_guard_creates_meta_params():
+    with paddle.LazyGuard():
+        lin = paddle.nn.Linear(8, 4)
+    assert lin.weight.is_meta and lin.bias.is_meta
+    assert lin.weight.shape == [8, 4]
+    with pytest.raises(RuntimeError, match="meta"):
+        lin.weight.numpy()
+
+
+def test_lazy_materialize_unsharded():
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        lin = paddle.nn.Linear(8, 4)
+    n = lin.lazy_materialize()
+    assert n == 2
+    assert not lin.weight.is_meta
+    out = lin(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    assert out.shape == [2, 4]
+
+
+def test_eager_params_unaffected():
+    lin = paddle.nn.Linear(4, 4)
+    assert not lin.weight.is_meta
+    assert lin.lazy_materialize() == 0
+
+
+def test_hybrid_init_materializes_meta_model_sharded():
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.fleet.hybrid_train import build_hybrid_step
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(1)
+    with paddle.LazyGuard():
+        m = GPTForCausalLM(GPTConfig(vocab_size=128, hidden_size=64,
+                                     num_layers=2, num_heads=4,
+                                     max_seq_len=32))
+    assert all(p.is_meta for p in m.parameters())
+    opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2),
+                ("dp", "sharding"))
+    init_fn, step, shard_batch, aux = build_hybrid_step(
+        m, opt, lambda out: out, mesh, zero_stage=1, with_aux=True)
+
+    # abstract_state mirrors the real state: same tree, shapes, dtypes
+    abstract = aux["abstract_state"]()
+    state = init_fn()
+    ab_leaves = jax.tree_util.tree_leaves(abstract)
+    st_leaves = jax.tree_util.tree_leaves(state)
+    assert len(ab_leaves) == len(st_leaves)
+    for a, s in zip(ab_leaves, st_leaves):
+        assert tuple(a.shape) == tuple(s.shape) and a.dtype == s.dtype
+
+    # the model object got materialized as a side effect
+    assert not any(p.is_meta for p in m.parameters())
+    # and a real train step runs on the materialized sharded state
+    # labels ride as a model input: forward computes the fused chunked CE
+    # and loss_fn is identity (the aot_shard_proof convention)
+    batch = shard_batch([
+        np.random.randint(0, 127, (8, 32)).astype(np.int32),
+        np.random.randint(0, 127, (8, 32)).astype(np.int32)])
+    loss, state = step(state, jax.random.key(0), 1e-3, batch, [])
+    assert np.isfinite(float(np.asarray(loss)))
