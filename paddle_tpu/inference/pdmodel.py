@@ -123,6 +123,8 @@ def _parse_attr(buf: bytes):
                     bools.append(bool(x))
             else:
                 bools.append(bool(v))
+        elif field == 12:
+            scalars["block"] = v  # BLOCK attr: index of the child BlockDesc
         elif field == 13:
             scalars["l"] = _signed(v)
         elif field == 15:
@@ -144,6 +146,7 @@ def _parse_attr(buf: bytes):
         _A_STRING: scalars.get("s"), _A_INTS: ints, _A_FLOATS: floats,
         _A_STRINGS: strings, _A_BOOL: scalars.get("b"), _A_BOOLS: bools,
         _A_LONG: scalars.get("l"), _A_LONGS: longs, _A_FLOAT64S: f64s,
+        _A_BLOCK: scalars.get("block"),
     }.get(atype)
     # signed int32 attrs arrive as 64-bit varints
     if atype == _A_INT and value is not None and value >= 1 << 31:
@@ -267,14 +270,18 @@ def _pool2d(env, op):
     x = env[op["inputs"]["X"][0]]
     a = op["attrs"]
     ptype = a.get("pooling_type") or "max"
+    nchw = (a.get("data_format") or "NCHW") == "NCHW"
+    ax_h, ax_w = (2, 3) if nchw else (1, 2)
     if a.get("adaptive") and list(a.get("ksize") or ()) != [1, 1]:
-        raise NotImplementedError(
-            f"adaptive pool2d with output size {a.get('ksize')} — only "
-            "[1, 1] (global) is lowered; a fixed-kernel pool would be "
-            "silently wrong")
+        # exactly the eager ops' lowering (shared helper — cannot drift)
+        from ..nn.functional import _adaptive_pool2d_array
+
+        oh, ow = a["ksize"]
+        return {"Out": _adaptive_pool2d_array(
+            x, oh, ow, "max" if ptype == "max" else "avg", nchw=nchw)}
     if a.get("global_pooling") or a.get("adaptive"):
-        out = (jnp.max(x, axis=(2, 3), keepdims=True) if ptype == "max"
-               else jnp.mean(x, axis=(2, 3), keepdims=True))
+        out = (jnp.max(x, axis=(ax_h, ax_w), keepdims=True) if ptype == "max"
+               else jnp.mean(x, axis=(ax_h, ax_w), keepdims=True))
         return {"Out": out}
     k = tuple(a.get("ksize") or (2, 2))
     s = tuple(a.get("strides") or k)
@@ -525,7 +532,246 @@ def _make_op_map():
             env[op["inputs"]["Condition"][0]],
             env[op["inputs"]["X"][0]], env[op["inputs"]["Y"][0]])},
         "split": _split,
+        # ---- comparison / logical tail (decoder loop conditions) ----
+        "less_equal": _elementwise(lambda x, y: x <= y),
+        "greater_equal": _elementwise(lambda x, y: x >= y),
+        "logical_and": _elementwise(jnp.logical_and),
+        "logical_or": _elementwise(jnp.logical_or),
+        "logical_not": _act(lambda x, a: jnp.logical_not(x)),
+        "logical_xor": _elementwise(jnp.logical_xor),
+        # ---- arithmetic / reduce tail ----
+        "elementwise_mod": _elementwise(jnp.mod),
+        "elementwise_floordiv": _elementwise(jnp.floor_divide),
+        "abs": _act(lambda x, a: jnp.abs(x)),
+        "log": _act(lambda x, a: jnp.log(x)),
+        "floor": _act(lambda x, a: jnp.floor(x)),
+        "ceil": _act(lambda x, a: jnp.ceil(x)),
+        "round": _act(lambda x, a: jnp.round(x)),
+        "mean": _act(lambda x, a: jnp.mean(x)),
+        "reduce_max": _act(lambda x, a: jnp.max(
+            x, axis=None if a.get("reduce_all") else tuple(a.get("dim")),
+            keepdims=bool(a.get("keep_dim")))),
+        "reduce_min": _act(lambda x, a: jnp.min(
+            x, axis=None if a.get("reduce_all") else tuple(a.get("dim")),
+            keepdims=bool(a.get("keep_dim")))),
+        "reduce_prod": _act(lambda x, a: jnp.prod(
+            x, axis=None if a.get("reduce_all") else tuple(a.get("dim")),
+            keepdims=bool(a.get("keep_dim")))),
+        "arg_min": _act(lambda x, a: jnp.argmin(
+            x, axis=a.get("axis") if a.get("axis") is not None else -1)),
+        "increment": _act(lambda x, a: x + jnp.asarray(
+            _attr_or(a, "step", 1.0), x.dtype)),
+        "fill_any_like": _act(lambda x, a: jnp.full_like(
+            x, a.get("value") or 0.0,
+            dtype=(_np_dtype_for_proto(a["dtype"])
+                   if a.get("dtype") not in (None, -1) else None))),
+        "cumsum": _cumsum,
+        # ---- index / gather tail ----
+        "gather": lambda env, op: {"Out": jnp.take(
+            env[op["inputs"]["X"][0]],
+            env[op["inputs"]["Index"][0]].astype(jnp.int32),
+            axis=op["attrs"].get("axis") or 0)},
+        "gather_nd": lambda env, op: {"Out": env[op["inputs"]["X"][0]][
+            tuple(jnp.moveaxis(
+                env[op["inputs"]["Index"][0]].astype(jnp.int32), -1, 0))]},
+        "index_select": lambda env, op: {"Out": jnp.take(
+            env[op["inputs"]["X"][0]],
+            env[op["inputs"]["Index"][0]].astype(jnp.int32),
+            axis=op["attrs"].get("dim") or 0)},
+        "top_k_v2": _top_k_v2,
+        "one_hot_v2": _act(lambda x, a: jax.nn.one_hot(
+            x.astype(jnp.int32), a["depth"], dtype=jnp.float32)),
+        # ---- control-flow helpers ----
+        "select_input": _select_input,
+        "assign_value": _assign_value,
+        # ---- detection tail (PP-YOLO style pipelines) ----
+        "yolo_box": _yolo_box_op,
+        "multiclass_nms3": _multiclass_nms3,
+        "multiclass_nms2": _multiclass_nms3,
     }
+
+
+def _cumsum(env, op):
+    import jax.numpy as jnp
+
+    x = env[op["inputs"]["X"][0]]
+    a = op["attrs"]
+    if a.get("flatten"):
+        x = x.ravel()
+        axis = 0
+    else:
+        axis = a.get("axis") if a.get("axis") is not None else -1
+    if a.get("reverse"):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if a.get("exclusive"):
+        out = jnp.roll(out, 1, axis)
+        idx = [slice(None)] * out.ndim
+        idx[axis] = 0
+        out = out.at[tuple(idx)].set(0)
+    if a.get("reverse"):
+        out = jnp.flip(out, axis)
+    return {"Out": out}
+
+
+def _top_k_v2(env, op):
+    import jax
+    import jax.numpy as jnp
+
+    x = env[op["inputs"]["X"][0]]
+    a = op["attrs"]
+    k_in = op["inputs"].get("K")
+    if k_in:
+        # k from a tensor input must be a compile-time constant under XLA
+        try:
+            k = int(np.asarray(env[k_in[0]]).reshape(()))
+        except Exception as e:
+            raise NotImplementedError(
+                "top_k_v2 with a non-constant K tensor — dynamic output "
+                "shapes are not XLA-compilable") from e
+    else:
+        k = int(a.get("k") or 1)
+    if k <= 0:
+        raise NotImplementedError(f"top_k_v2 with k={k}")
+    axis = a.get("axis") if a.get("axis") is not None else -1
+    largest = a.get("largest", True)
+    xm = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(xm if largest else -xm, k)
+    if not largest:
+        vals = -vals
+    return {"Out": jnp.moveaxis(vals, -1, axis),
+            "Indices": jnp.moveaxis(idx.astype(jnp.int64), -1, axis)}
+
+
+def _select_input(env, op):
+    import jax
+    import jax.numpy as jnp
+
+    xs = [env[n] for n in op["inputs"]["X"]]
+    mask = env[op["inputs"]["Mask"][0]].reshape(()).astype(jnp.int32)
+    if len(xs) != 2:
+        raise NotImplementedError(
+            f"select_input with {len(xs)} branches (expected 2)")
+    out = jax.lax.cond(mask != 0, lambda: xs[1], lambda: xs[0])
+    return {"Out": out}
+
+
+def _assign_value(env, op):
+    import jax.numpy as jnp
+
+    a = op["attrs"]
+    dtype = _np_dtype_for_proto(a.get("dtype")
+                                if a.get("dtype") is not None else 5)
+    for key in ("fp32_values", "int32_values", "int64_values", "bool_values"):
+        vals = a.get(key)
+        if vals:
+            break
+    else:
+        vals = [0]
+    return {"Out": jnp.asarray(
+        np.asarray(vals, dtype).reshape(tuple(a.get("shape") or (-1,))))}
+
+
+def _yolo_box_op(env, op):
+    """Decode a YOLOv3 head (reference: phi yolo_box_kernel) via the
+    vision/ops.py lowering."""
+    from ..vision.ops import yolo_box
+
+    a = op["attrs"]
+    boxes, scores = yolo_box(
+        env[op["inputs"]["X"][0]], env[op["inputs"]["ImgSize"][0]],
+        anchors=list(a.get("anchors") or ()),
+        class_num=int(a["class_num"]),
+        conf_thresh=float(_attr_or(a, "conf_thresh", 0.01)),
+        downsample_ratio=int(_attr_or(a, "downsample_ratio", 32)),
+        clip_bbox=bool(a.get("clip_bbox", True)),
+        scale_x_y=float(_attr_or(a, "scale_x_y", 1.0)))
+    return {"Boxes": boxes._value, "Scores": scores._value}
+
+
+def _multiclass_nms3(env, op):
+    """Static-shape multiclass NMS (reference: phi multiclass_nms3 kernel).
+
+    XLA needs fixed shapes, so the output is padded to keep_top_k rows of
+    [label, score, x1, y1, x2, y2] with label=-1 padding, and NmsRoisNum
+    carries the valid count — the same contract the reference kernel
+    fulfils dynamically.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    boxes = env[op["inputs"]["BBoxes"][0]]   # [N, M, 4]
+    scores = env[op["inputs"]["Scores"][0]]  # [N, C, M]
+    a = op["attrs"]
+    if boxes.shape[0] != 1:
+        raise NotImplementedError(
+            f"multiclass_nms3 with batch {boxes.shape[0]} — only batch 1 is "
+            "lowered (pad-and-loop over images host-side)")
+    b = boxes[0]
+    s = scores[0]
+    C, M = s.shape
+    bg = int(_attr_or(a, "background_label", 0))
+    score_th = float(_attr_or(a, "score_threshold", 0.0))
+    nms_th = float(_attr_or(a, "nms_threshold", 0.3))
+    nms_top_k = int(_attr_or(a, "nms_top_k", -1))
+    keep_top_k = int(_attr_or(a, "keep_top_k", 100))
+    normalized = bool(a.get("normalized", True))
+    if keep_top_k <= 0:
+        keep_top_k = min(C * M, 100)
+
+    # pairwise IoU [M, M]; normalized=False uses the reference's pixel
+    # convention (x2 - x1 + 1)
+    off = 0.0 if normalized else 1.0
+    area = (jnp.maximum(b[:, 2] - b[:, 0] + off, 0)
+            * jnp.maximum(b[:, 3] - b[:, 1] + off, 0))
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+    def per_class(sc):
+        valid = sc > score_th
+        order = jnp.argsort(-jnp.where(valid, sc, -jnp.inf))
+        iou_o = iou[order][:, order]
+        valid_o = valid[order]
+        if nms_top_k > 0:
+            # only the per-class top nms_top_k candidates enter NMS
+            valid_o = jnp.logical_and(valid_o, jnp.arange(M) < nms_top_k)
+
+        def body(i, keep):
+            sup = jnp.sum(jnp.where(jnp.arange(M) < i,
+                                    keep * (iou_o[i] > nms_th), 0.0)) > 0
+            k = jnp.logical_and(valid_o[i], jnp.logical_not(sup))
+            return keep.at[i].set(k.astype(keep.dtype))
+
+        keep_sorted = jax.lax.fori_loop(0, M, body, jnp.zeros((M,)))
+        keep = jnp.zeros((M,)).at[order].set(keep_sorted)
+        return jnp.where(keep > 0, sc, -1.0)
+
+    kept_scores = jax.vmap(per_class)(s)  # [C, M], -1 where suppressed
+    cls_ids = jnp.broadcast_to(jnp.arange(C)[:, None], (C, M))
+    if 0 <= bg < C:
+        kept_scores = kept_scores.at[bg].set(-1.0)
+    flat_s = kept_scores.reshape(-1)
+    flat_c = cls_ids.reshape(-1)
+    k = min(keep_top_k, C * M)
+    top_s, top_i = jax.lax.top_k(flat_s, k)
+    top_box = b[top_i % M]
+    top_cls = flat_c[top_i]
+    valid = top_s > 0
+    out = jnp.concatenate([
+        jnp.where(valid, top_cls, -1).astype(jnp.float32)[:, None],
+        jnp.where(valid, top_s, 0.0)[:, None],
+        top_box * valid[:, None].astype(top_box.dtype),
+    ], axis=1)
+    if k < keep_top_k:
+        out = jnp.pad(out, ((0, keep_top_k - k), (0, 0)),
+                      constant_values=-1.0)
+        top_i = jnp.pad(top_i, (0, keep_top_k - k))
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    return {"Out": out, "Index": (top_i % M).astype(jnp.int64)[:, None],
+            "NmsRoisNum": n_valid.reshape(1)}
 
 
 def _fill_constant_bsl(env, op):
@@ -624,14 +870,14 @@ class PdModelProgram:
             for name in self.param_names:
                 self.params[name] = _read_lod_tensor(f)[0]
         self._jitted = None
+        self._op_map = _make_op_map()
+        self._op_map.update({
+            "while": self._op_while,
+            "conditional_block": self._op_conditional_block,
+        })
 
-    def _execute(self, feed_arrays):
-        import jax.numpy as jnp
-
-        env = {n: jnp.asarray(v) for n, v in self.params.items()}
-        env.update(feed_arrays)
-        op_map = _make_op_map()
-        for op in self.ops:
+    def _run_ops(self, ops, env, op_map):
+        for op in ops:
             fn = op_map.get(op["type"])
             if fn is None:
                 raise NotImplementedError(
@@ -647,6 +893,86 @@ class PdModelProgram:
                         env[name] = v
                 else:
                     env[names[0]] = val
+        return env
+
+    # ------------------------------------------------ control-flow sub-blocks
+    # Reference semantics: while_op / conditional_block_op execute a child
+    # BlockDesc in a child scope (paddle/fluid/operators/controlflow/
+    # while_op.cc, conditional_block_op.cc). TPU-native lowering: the child
+    # block becomes the body of lax.while_loop / lax.cond with a FIXED carry
+    # — the variables the child writes that already exist in the parent
+    # scope (the loop-carried set; shape-invariant, as XLA requires).
+    def _block_write_names(self, block_idx):
+        names = []
+        for op in self.desc["blocks"][block_idx]["ops"]:
+            for outs in op["outputs"].values():
+                names.extend(outs)
+        return names
+
+    def _op_while(self, env, op):
+        import jax
+
+        sub_idx = op["attrs"]["sub_block"]
+        cond_name = op["inputs"]["Condition"][0]
+        sub_ops = self.desc["blocks"][sub_idx]["ops"]
+        op_map = self._op_map
+        carried = [n for n in dict.fromkeys(self._block_write_names(sub_idx))
+                   if n in env]
+        if cond_name not in carried:
+            carried.append(cond_name)
+
+        def cond_fn(carry):
+            return carry[carried.index(cond_name)].reshape(())
+
+        def body_fn(carry):
+            local = dict(env)
+            local.update(zip(carried, carry))
+            local = self._run_ops(sub_ops, local, op_map)
+            return tuple(local[n] for n in carried)
+
+        final = jax.lax.while_loop(
+            cond_fn, body_fn, tuple(env[n] for n in carried))
+        env.update(zip(carried, final))
+        return {}  # wrote env directly — carried names ARE the outputs
+
+    def _op_conditional_block(self, env, op):
+        import jax
+
+        sub_idx = op["attrs"]["sub_block"]
+        cond = env[op["inputs"]["Cond"][0]].reshape(())
+        sub_ops = self.desc["blocks"][sub_idx]["ops"]
+        op_map = self._op_map
+        out_names = [n for n in op["outputs"].get("Out", [])]
+        if not out_names:
+            out_names = [n for n in
+                         dict.fromkeys(self._block_write_names(sub_idx))]
+
+        def true_fn():
+            local = self._run_ops(sub_ops, dict(env), op_map)
+            return tuple(local[n] for n in out_names)
+
+        shapes = jax.eval_shape(true_fn)
+
+        def false_fn():
+            # branch not taken: outputs keep their previous value when one
+            # exists (reference scope semantics), else zeros of the branch
+            # shape (consumed only through select_input, which discards them)
+            import jax.numpy as jnp
+
+            return tuple(
+                env[n] if n in env else jnp.zeros(s.shape, s.dtype)
+                for n, s in zip(out_names, shapes))
+
+        vals = jax.lax.cond(cond, true_fn, false_fn)
+        env.update(zip(out_names, vals))
+        return {}  # wrote env directly
+
+    def _execute(self, feed_arrays):
+        import jax.numpy as jnp
+
+        env = {n: jnp.asarray(v) for n, v in self.params.items()}
+        env.update(feed_arrays)
+        env = self._run_ops(self.ops, env, self._op_map)
         return [env[n] for n in self.fetch_names]
 
     def run(self, feed: dict):

@@ -411,26 +411,49 @@ def _adaptive_avg_matrix(n, out, dtype):
     return m.astype(dtype)
 
 
-def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    out_hw = _pair(output_size)
-
-    def f(a):
-        nchw = data_format == "NCHW"
-        h, w = (a.shape[2], a.shape[3]) if nchw else (a.shape[1], a.shape[2])
-        oh = h if out_hw[0] is None else out_hw[0]
-        ow = w if out_hw[1] is None else out_hw[1]
-        if h % oh == 0 and w % ow == 0:
-            kh, kw = h // oh, w // ow
-            window = (1, 1, kh, kw) if nchw else (1, kh, kw, 1)
-            out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, window, "VALID")
-            return out / (kh * kw)
+def _adaptive_pool2d_array(a, oh, ow, ptype="avg", nchw=True):
+    """Shared adaptive-pool lowering on a raw array: exact reduce_window when
+    the output divides the input, interpolating-matrix (avg) / bin loop (max)
+    otherwise. Used by the eager ops below AND the pdmodel loader
+    (inference/pdmodel.py) so the two cannot drift."""
+    h, w = (a.shape[2], a.shape[3]) if nchw else (a.shape[1], a.shape[2])
+    oh = h if oh is None else oh
+    ow = w if ow is None else ow
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+        window = (1, 1, kh, kw) if nchw else (1, kh, kw, 1)
+        if ptype == "max":
+            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max, window,
+                                         window, "VALID")
+        out = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, window,
+                                    "VALID")
+        return out / (kh * kw)
+    if ptype == "avg":
         mh = jnp.asarray(_adaptive_avg_matrix(h, oh, a.dtype))
         mw = jnp.asarray(_adaptive_avg_matrix(w, ow, a.dtype))
         if nchw:
             return jnp.einsum("nchw,oh,pw->ncop", a, mh, mw)
         return jnp.einsum("nhwc,oh,pw->nopc", a, mh, mw)
+    hs, he = _adaptive_bins(h, oh)
+    ws, we = _adaptive_bins(w, ow)
+    if not nchw:
+        a = jnp.moveaxis(a, -1, 1)
+    rows = [jnp.stack([jnp.max(a[:, :, hs[i]:he[i], ws[j]:we[j]], axis=(2, 3))
+                       for j in range(ow)], axis=-1) for i in range(oh)]
+    out = jnp.stack(rows, axis=-2)
+    return jnp.moveaxis(out, 1, -1) if not nchw else out
 
-    return primitive_call(f, _t(x), name="adaptive_avg_pool2d")
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size)
+
+    def f(a):
+        return _adaptive_pool2d_array(a, out_hw[0], out_hw[1], "avg",
+                                      nchw=(data_format == "NCHW"))
+
+    return primitive_call(f, _t(x), name="adaptive_avg_pool2d",
+                          attrs={"output_size": list(out_hw),
+                                 "data_format": data_format})
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -453,20 +476,12 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     out_hw = _pair(output_size)
 
     def f(a):
-        h, w = a.shape[2], a.shape[3]
-        oh = h if out_hw[0] is None else out_hw[0]
-        ow = w if out_hw[1] is None else out_hw[1]
-        if h % oh == 0 and w % ow == 0:
-            kh, kw = h // oh, w // ow
-            return jax.lax.reduce_window(a, -jnp.inf, jax.lax.max,
-                                         (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
-        hs, he = _adaptive_bins(h, oh)
-        ws, we = _adaptive_bins(w, ow)
-        rows = [jnp.stack([jnp.max(a[:, :, hs[i]:he[i], ws[j]:we[j]], axis=(2, 3))
-                           for j in range(ow)], axis=-1) for i in range(oh)]
-        return jnp.stack(rows, axis=-2)
+        return _adaptive_pool2d_array(a, out_hw[0], out_hw[1], "max",
+                                      nchw=True)
 
-    return primitive_call(f, _t(x))
+    return primitive_call(f, _t(x), name="adaptive_max_pool2d",
+                          attrs={"output_size": list(out_hw),
+                                 "data_format": "NCHW"})
 
 
 # ------------------------------------------------------------------ norm
@@ -1113,7 +1128,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             return ring_attention(q, k, v, sp, causal=is_causal)
         return _attn.sdpa(q, k, v, m[0] if m else None, is_causal=is_causal)
 
-    out = primitive_call(f, *args, name="scaled_dot_product_attention")
+    out = primitive_call(f, *args, name="scaled_dot_product_attention",
+                         attrs={"is_causal": bool(is_causal)})
     if dropout_p > 0.0 and training:
         out = dropout(out, dropout_p, training=training)
     return out

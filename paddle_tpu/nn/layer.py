@@ -143,8 +143,18 @@ class Layer:
             init, shape, dtype = p._lazy_init
             sh = sharding_fn(name, p) if sharding_fn is not None else None
             if sh is not None:
-                value = jax.jit(lambda i=init, s=shape, d=dtype: i(s, d),
-                                out_shardings=sh)()
+                # draw the key eagerly and pin it inside the jit — letting
+                # the initializer advance the global generator under trace
+                # would store an escaped tracer in it (see core/rng.py)
+                from ..core import rng as rng_mod
+
+                key = rng_mod.next_rng_key()
+
+                def _init(key, i=init, s=shape, d=dtype):
+                    with rng_mod.trace_rng_scope(key):
+                        return i(s, d)
+
+                value = jax.jit(_init, out_shardings=sh)(key)
             else:
                 value = init(shape, dtype)
             p._value = value

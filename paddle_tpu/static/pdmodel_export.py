@@ -36,7 +36,12 @@ _VT_FETCH_LIST = 10
 
 # framework.proto AttrType enum
 _A_INT, _A_FLOAT, _A_STRING, _A_INTS, _A_FLOATS, _A_STRINGS = 0, 1, 2, 3, 4, 5
-_A_BOOL, _A_BOOLS, _A_LONG = 6, 7, 9
+_A_BOOL, _A_BOOLS, _A_BLOCK, _A_LONG = 6, 7, 8, 9
+
+
+class BlockIdx(int):
+    """Attr wrapper marking an int as a BLOCK attr (child BlockDesc index) —
+    how while/conditional_block reference their sub-block in framework.proto."""
 
 
 # ----------------------------------------------------------- wire primitives
@@ -67,7 +72,9 @@ def _attr_bytes(name, value):
     """OpDesc.Attr: name=1 type=2 i=3 f=4 s=5 ints=6 floats=7 strings=8
     b=10 bools=11 l=13 (matches the parser, inference/pdmodel.py:84)."""
     out = _sfield(1, name)
-    if isinstance(value, bool):
+    if isinstance(value, BlockIdx):
+        out += _vfield(2, _A_BLOCK) + _vfield(12, int(value))
+    elif isinstance(value, bool):
         out += _vfield(2, _A_BOOL) + _vfield(10, int(value))
     elif isinstance(value, (int, np.integer)):
         if -(1 << 31) <= int(value) < (1 << 31):
@@ -282,6 +289,35 @@ def _emit_pool(op, ctx):
     }]
 
 
+def _emit_adaptive_pool(ptype):
+    def emit(op, ctx):
+        a = op.attrs
+        osize = [int(s) if s is not None else -1
+                 for s in a.get("output_size", [1, 1])]
+        if -1 in osize:
+            # None entries mean "keep input extent": read it off the
+            # recorded output Variable's static shape
+            out_shape = tuple(op.outputs[0]._value.shape)
+            nchw = a.get("data_format", "NCHW") == "NCHW"
+            osize = ([out_shape[2], out_shape[3]] if nchw
+                     else [out_shape[1], out_shape[2]])
+        return [{
+            "type": "pool2d",
+            "inputs": {"X": [ctx.name_of(op.inputs[0])]},
+            "outputs": {"Out": [op.outputs[0].name]},
+            "attrs": {
+                "pooling_type": ptype,
+                "ksize": osize,
+                "adaptive": True,
+                "global_pooling": False,
+                "strides": [1, 1], "paddings": [0, 0],
+                "data_format": a.get("data_format", "NCHW"),
+            },
+        }]
+
+    return emit
+
+
 def _emit_linear(op, ctx):
     mm = {
         "type": "matmul_v2",
@@ -441,6 +477,78 @@ def _emit_gelu(op, ctx):
     }]
 
 
+def _emit_sdpa(op, ctx):
+    """Decompose the fused attention primitive into the op set genuine Paddle
+    writes for an unfused attention block: matmul_v2 (trans_y) -> scale ->
+    [mask via where/add] -> softmax -> matmul_v2. Inputs are [b, h, s, d]
+    (nn/transformer.py layout); all emitted ops act on trailing dims, so the
+    decomposition is leading-dims agnostic. A causal mask is materialized as
+    a persistable bool parameter (shapes are static in an exported program)."""
+    q, k, v = op.inputs[0], op.inputs[1], op.inputs[2]
+    mask = op.inputs[3] if len(op.inputs) > 3 else None
+    d = int(q._value.shape[-1])
+    q_dt = np.dtype(str(q._value.dtype))
+    q_proto = _proto_for_np_dtype(q_dt)
+    # large-negative fill in the QUERY dtype: emitting fp32 would silently
+    # upcast a bf16/fp16 attention chain, and -1e30 overflows fp16
+    neg_val = -65504.0 if q_dt == np.float16 else -1e30
+    ops = []
+    qk = ctx.tmp()
+    ops.append({"type": "matmul_v2",
+                "inputs": {"X": [ctx.name_of(q)], "Y": [ctx.name_of(k)]},
+                "outputs": {"Out": [qk]},
+                "attrs": {"trans_x": False, "trans_y": True}})
+    scaled = ctx.tmp()
+    ops.append({"type": "scale", "inputs": {"X": [qk]},
+                "outputs": {"Out": [scaled]},
+                "attrs": {"scale": float(1.0 / np.sqrt(d)), "bias": 0.0,
+                          "bias_after_scale": True}})
+    cur = scaled
+    if op.attrs.get("is_causal"):
+        s_q = int(q._value.shape[-2])
+        s_k = int(k._value.shape[-2])
+        mname = f"param_{ctx.param_n:05d}"
+        ctx.param_n += 1
+        ctx.params.append((mname, _ConstHolder(
+            np.tril(np.ones((s_q, s_k), dtype=bool), k=s_k - s_q))))
+        neg = ctx.tmp()
+        ops.append({"type": "fill_constant", "inputs": {},
+                    "outputs": {"Out": [neg]},
+                    "attrs": {"shape": [1], "value": neg_val,
+                              "dtype": q_proto}})
+        masked = ctx.tmp()
+        ops.append({"type": "where",
+                    "inputs": {"Condition": [mname], "X": [cur], "Y": [neg]},
+                    "outputs": {"Out": [masked]}, "attrs": {}})
+        cur = masked
+    if mask is not None:
+        mname = ctx.name_of(mask)
+        masked = ctx.tmp()
+        if np.dtype(mask._value.dtype) == np.bool_:
+            neg = ctx.tmp()
+            ops.append({"type": "fill_constant", "inputs": {},
+                        "outputs": {"Out": [neg]},
+                        "attrs": {"shape": [1], "value": neg_val,
+                                  "dtype": q_proto}})
+            ops.append({"type": "where",
+                        "inputs": {"Condition": [mname], "X": [cur],
+                                   "Y": [neg]},
+                        "outputs": {"Out": [masked]}, "attrs": {}})
+        else:
+            ops.append({"type": "elementwise_add",
+                        "inputs": {"X": [cur], "Y": [mname]},
+                        "outputs": {"Out": [masked]}, "attrs": {"axis": -1}})
+        cur = masked
+    probs = ctx.tmp()
+    ops.append({"type": "softmax", "inputs": {"X": [cur]},
+                "outputs": {"Out": [probs]}, "attrs": {"axis": -1}})
+    ops.append({"type": "matmul_v2",
+                "inputs": {"X": [probs], "Y": [ctx.name_of(v)]},
+                "outputs": {"Out": [op.outputs[0].name]},
+                "attrs": {"trans_x": False, "trans_y": False}})
+    return ops
+
+
 class _ConstHolder:
     """Gives a folded-constant value the (name, t._value) shape ctx.params
     stores for weights, so it streams into .pdiparams like any persistable."""
@@ -474,6 +582,8 @@ _EMITTERS = {
     "share": _emit_share,
     "conv2d": _emit_conv2d,
     "pool": _emit_pool,
+    "adaptive_avg_pool2d": _emit_adaptive_pool("avg"),
+    "adaptive_max_pool2d": _emit_adaptive_pool("max"),
     "linear": _emit_linear,
     "matmul": _emit_matmul,
     "batch_norm": _emit_batch_norm,
@@ -486,6 +596,7 @@ _EMITTERS = {
     "scale": _emit_scale,
     "softmax": _emit_softmax,
     "gelu": _emit_gelu,
+    "scaled_dot_product_attention": _emit_sdpa,
     "relu": _unary("relu"),
     "relu6": _unary("relu6"),
     "sigmoid": _unary("sigmoid"),
@@ -499,6 +610,20 @@ _EMITTERS = {
     "maximum": _binary("elementwise_max"),
     "minimum": _binary("elementwise_min"),
 }
+
+
+# Every wire op type an emitter above can write. Gated against the loader's
+# op map by tests/test_pdmodel_roundtrip.py so the two can never drift apart
+# (an export the loader can't read back would be a silent interop break).
+EXPORTED_OP_TYPES = frozenset({
+    "feed", "fetch",
+    "conv2d", "pool2d", "batch_norm", "layer_norm", "matmul_v2",
+    "lookup_table_v2", "reshape2", "transpose2", "flatten_contiguous_range",
+    "concat", "scale", "softmax", "cast", "gelu", "fill_constant", "where",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "relu", "relu6", "sigmoid", "tanh", "exp", "sqrt",
+})
 
 
 # ------------------------------------------------------------------ exporter

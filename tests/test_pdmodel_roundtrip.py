@@ -1,0 +1,256 @@
+"""pdmodel exporter <-> loader round-trip (VERDICT r4 missing #3 / weak #7).
+
+Three layers of gate:
+  1. Enumeration: every wire op type the exporter can write is readable by
+     the loader (EXPORTED_OP_TYPES vs the loader op map) — drift breaks CI.
+  2. Real-model round-trips: ResNet50 and a BERT-shaped encoder export via
+     static/pdmodel_export.py, reload via inference/pdmodel.py, and match
+     the source program numerically.
+  3. Control-flow + detection tail: while / conditional_block+select_input
+     programs synthesized on the real wire format run under lax control
+     flow; yolo_box / multiclass_nms3 match reference semantics.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.inference.pdmodel import (
+    PdModelProgram, _make_op_map, load_pdmodel, parse_program_desc)
+from paddle_tpu.static import pdmodel_export as pe
+from paddle_tpu.static.pdmodel_export import (
+    BlockIdx, save_inference_model_pdmodel)
+
+
+# ------------------------------------------------------------- 1. enumeration
+def test_every_exported_op_type_is_loadable():
+    loader_ops = set(_make_op_map()) | {"feed", "fetch", "while",
+                                        "conditional_block"}
+    missing = pe.EXPORTED_OP_TYPES - loader_ops
+    assert not missing, (
+        f"exporter can write op types the loader cannot read: {missing}")
+
+
+def test_emitter_keys_have_declared_types():
+    # canary: every emitted "type" literal in the module source is declared
+    import re
+
+    src = open(pe.__file__.rstrip("c")).read()
+    emitted = set(re.findall(r'"type": "([a-z0-9_]+)"', src))
+    # _unary/_binary emitters take the type from their argument
+    emitted |= {m for m in re.findall(r'_(?:unary|binary)\("([a-z0-9_]+)"\)',
+                                      src)}
+    assert emitted <= pe.EXPORTED_OP_TYPES, (
+        emitted - pe.EXPORTED_OP_TYPES)
+
+
+# ------------------------------------------------------- 2. real-model trips
+@pytest.mark.slow
+def test_resnet50_roundtrip_numerical_identity():
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [1, 3, 64, 64])
+            from paddle_tpu.vision.models import resnet50
+
+            m = resnet50(num_classes=10)
+            m.eval()
+            out = m(x)
+        xv = np.random.RandomState(0).rand(1, 3, 64, 64).astype("float32")
+        (ref,) = static.Executor().run(prog, feed={"x": xv},
+                                       fetch_list=[out])
+        d = tempfile.mkdtemp()
+        save_inference_model_pdmodel(os.path.join(d, "r50"), [x], [out],
+                                     program=prog)
+        got = load_pdmodel(os.path.join(d, "r50")).run({"x": xv})[0]
+        np.testing.assert_allclose(np.asarray(got), ref, atol=1e-5)
+    finally:
+        static.disable_static()
+
+
+def test_bert_shaped_roundtrip_numerical_identity():
+    static.enable_static()
+    try:
+        paddle.seed(3)
+        prog = static.Program()
+        with static.program_guard(prog):
+            ids = static.data("ids", [2, 16], "int64")
+            emb = paddle.nn.Embedding(100, 32)
+            enc = paddle.nn.TransformerEncoderLayer(
+                32, 4, 64, dropout=0.0, activation="gelu")
+            ln = paddle.nn.LayerNorm(32)
+            out = ln(enc(emb(ids)))
+        iv = np.random.RandomState(1).randint(0, 100, (2, 16)).astype("int64")
+        (ref,) = static.Executor().run(prog, feed={"ids": iv},
+                                       fetch_list=[out])
+        d = tempfile.mkdtemp()
+        save_inference_model_pdmodel(os.path.join(d, "bert"), [ids], [out],
+                                     program=prog)
+        got = load_pdmodel(os.path.join(d, "bert")).run({"ids": iv})[0]
+        np.testing.assert_allclose(np.asarray(got), ref, atol=2e-5)
+    finally:
+        static.disable_static()
+
+
+# --------------------------------------------- 3. control flow on the wire
+def _wire_program(blocks):
+    """blocks: list of (vars, ops) -> ProgramDesc bytes via the exporter's
+    own wire primitives (the format both sides implement from the spec)."""
+    out = b""
+    for i, (vars_b, ops) in enumerate(blocks):
+        parent = -1 if i == 0 else 0
+        out += pe._lfield(1, pe._block_bytes(
+            [pe._var_bytes(*v) for v in vars_b],
+            [pe._op_bytes(o) for o in ops], idx=i, parent=parent))
+    return out
+
+
+def _feed_fetch(feed_names, fetch_names, shapes, dtypes):
+    vars_b = [("feed", pe._VT_FEED_MINIBATCH), ("fetch", pe._VT_FETCH_LIST)]
+    for n, s, dt in zip(feed_names, shapes, dtypes):
+        vars_b.append((n, pe._VT_LOD_TENSOR, np.dtype(dt), s))
+    ops = [{"type": "feed", "inputs": {"X": ["feed"]},
+            "outputs": {"Out": [n]}, "attrs": {"col": i}}
+           for i, n in enumerate(feed_names)]
+    tail = [{"type": "fetch", "inputs": {"X": [n]},
+             "outputs": {"Out": ["fetch"]}, "attrs": {"col": i}}
+            for i, n in enumerate(fetch_names)]
+    return vars_b, ops, tail
+
+
+def test_while_loop_on_wire():
+    # while i < n: x = x * 2; i = i + 1   (reference: while_op.cc semantics)
+    vars_b, head, tail = _feed_fetch(["x", "i", "n"],
+                                     ["x"],
+                                     [(4,), (1,), (1,)],
+                                     ["float32", "float32", "float32"])
+    main_ops = head + [
+        {"type": "less_than", "inputs": {"X": ["i"], "Y": ["n"]},
+         "outputs": {"Out": ["cond"]}, "attrs": {}},
+        {"type": "while",
+         "inputs": {"X": ["x", "i", "n"], "Condition": ["cond"]},
+         "outputs": {"Out": ["x", "i"], "StepScopes": ["_scopes"]},
+         "attrs": {"sub_block": BlockIdx(1)}},
+    ] + tail
+    sub_ops = [
+        {"type": "scale", "inputs": {"X": ["x"]}, "outputs": {"Out": ["x"]},
+         "attrs": {"scale": 2.0, "bias": 0.0, "bias_after_scale": True}},
+        {"type": "increment", "inputs": {"X": ["i"]},
+         "outputs": {"Out": ["i"]}, "attrs": {"step": 1.0}},
+        {"type": "less_than", "inputs": {"X": ["i"], "Y": ["n"]},
+         "outputs": {"Out": ["cond"]}, "attrs": {}},
+    ]
+    blob = _wire_program([(vars_b, main_ops), ([], sub_ops)])
+    pm = PdModelProgram(blob, None)
+    x = np.ones(4, np.float32)
+    (out,) = pm.run({"x": x, "i": np.zeros(1, np.float32),
+                     "n": np.full(1, 3.0, np.float32)})
+    np.testing.assert_allclose(np.asarray(out), x * 8.0)  # 3 doublings
+
+
+def test_conditional_block_select_input_on_wire():
+    # if cond: y = x * 10 else: y = x + 1 — paddle lowers this to two
+    # conditional_blocks + logical_not + select_input (reference:
+    # conditional_block_op.cc + select_input_op.cc)
+    vars_b, head, tail = _feed_fetch(["x", "cond"], ["y"],
+                                     [(3,), (1,)], ["float32", "bool"])
+    main_ops = head + [
+        {"type": "logical_not", "inputs": {"X": ["cond"]},
+         "outputs": {"Out": ["ncond"]}, "attrs": {}},
+        {"type": "conditional_block",
+         "inputs": {"Cond": ["cond"], "Input": ["x"]},
+         "outputs": {"Out": ["yt"], "Scope": ["_s1"]},
+         "attrs": {"sub_block": BlockIdx(1)}},
+        {"type": "conditional_block",
+         "inputs": {"Cond": ["ncond"], "Input": ["x"]},
+         "outputs": {"Out": ["yf"], "Scope": ["_s2"]},
+         "attrs": {"sub_block": BlockIdx(2)}},
+        {"type": "select_input",
+         "inputs": {"X": ["yf", "yt"], "Mask": ["cond"]},
+         "outputs": {"Out": ["y"]}, "attrs": {}},
+    ] + tail
+    sub_true = [{"type": "scale", "inputs": {"X": ["x"]},
+                 "outputs": {"Out": ["yt"]},
+                 "attrs": {"scale": 10.0, "bias": 0.0,
+                           "bias_after_scale": True}}]
+    sub_false = [{"type": "scale", "inputs": {"X": ["x"]},
+                  "outputs": {"Out": ["yf"]},
+                  "attrs": {"scale": 1.0, "bias": 1.0,
+                            "bias_after_scale": True}}]
+    blob = _wire_program([(vars_b, main_ops), ([], sub_true), ([], sub_false)])
+    pm = PdModelProgram(blob, None)
+    x = np.array([1.0, 2.0, 3.0], np.float32)
+    (y_true,) = pm.run({"x": x, "cond": np.array([True])})
+    np.testing.assert_allclose(np.asarray(y_true), x * 10.0)
+    (y_false,) = pm.run({"x": x, "cond": np.array([False])})
+    np.testing.assert_allclose(np.asarray(y_false), x + 1.0)
+
+
+# ------------------------------------------------------- 3b. detection tail
+def test_multiclass_nms3_semantics():
+    from paddle_tpu.inference.pdmodel import _multiclass_nms3
+
+    # two overlapping boxes of class 1 (IoU > 0.5) + one separate class 2
+    boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]]],
+                     np.float32)
+    scores = np.zeros((1, 3, 3), np.float32)
+    scores[0, 1, 0] = 0.9   # class 1, box 0
+    scores[0, 1, 1] = 0.8   # class 1, box 1 — suppressed by box 0
+    scores[0, 2, 2] = 0.7   # class 2, box 2
+    op = {"inputs": {"BBoxes": ["b"], "Scores": ["s"]},
+          "outputs": {"Out": ["o"], "Index": ["i"], "NmsRoisNum": ["n"]},
+          "attrs": {"background_label": 0, "score_threshold": 0.1,
+                    "nms_threshold": 0.5, "keep_top_k": 5,
+                    "nms_top_k": 10, "normalized": True}}
+    outs = _multiclass_nms3({"b": boxes, "s": scores}, op)
+    out = np.asarray(outs["Out"])
+    n = int(np.asarray(outs["NmsRoisNum"])[0])
+    assert n == 2
+    # rows sorted by score: (class 1, 0.9), (class 2, 0.7)
+    np.testing.assert_allclose(out[0, :2], [1.0, 0.9], atol=1e-6)
+    np.testing.assert_allclose(out[1, :2], [2.0, 0.7], atol=1e-6)
+    np.testing.assert_allclose(out[0, 2:], [0, 0, 10, 10], atol=1e-6)
+    assert (out[2:, 0] == -1).all()  # padding rows
+
+
+def test_yolo_box_op_decodes():
+    from paddle_tpu.inference.pdmodel import _yolo_box_op
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2 * (5 + 3), 4, 4).astype(np.float32)  # 2 anchors, 3 cls
+    img = np.array([[128, 128]], np.int32)
+    op = {"inputs": {"X": ["x"], "ImgSize": ["im"]},
+          "outputs": {"Boxes": ["b"], "Scores": ["s"]},
+          "attrs": {"anchors": [10, 13, 16, 30], "class_num": 3,
+                    "conf_thresh": 0.01, "downsample_ratio": 32,
+                    "clip_bbox": True, "scale_x_y": 1.0}}
+    outs = _yolo_box_op({"x": x, "im": img}, op)
+    b = np.asarray(outs["Boxes"])
+    s = np.asarray(outs["Scores"])
+    assert b.shape == (1, 32, 4) and s.shape == (1, 32, 3)
+    assert (b >= 0).all() and (b <= 127).all()  # clipped to image
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+# ---------------------------------------------------- 3c. decoder-tail ops
+def test_top_k_gather_increment_ops():
+    op_map = _make_op_map()
+    import jax.numpy as jnp
+
+    env = {"x": jnp.asarray(np.array([[3.0, 1.0, 2.0]], np.float32))}
+    outs = op_map["top_k_v2"](env, {
+        "inputs": {"X": ["x"]}, "outputs": {"Out": ["v"], "Indices": ["i"]},
+        "attrs": {"k": 2, "axis": -1, "largest": True}})
+    np.testing.assert_allclose(np.asarray(outs["Out"]), [[3.0, 2.0]])
+    np.testing.assert_array_equal(np.asarray(outs["Indices"]), [[0, 2]])
+
+    env2 = {"x": jnp.asarray(np.arange(10.0, dtype=np.float32)),
+            "idx": jnp.asarray(np.array([7, 2], np.int64))}
+    outs2 = op_map["gather"](env2, {
+        "inputs": {"X": ["x"], "Index": ["idx"]},
+        "outputs": {"Out": ["o"]}, "attrs": {}})
+    np.testing.assert_allclose(np.asarray(outs2["Out"]), [7.0, 2.0])
