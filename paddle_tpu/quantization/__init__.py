@@ -28,6 +28,7 @@ __all__ = [
     "QuantedLinear", "QuantedConv2D", "ImperativeQuantAware",
     "PostTrainingQuantization", "quant_post_static", "weight_quantize",
     "weight_dequantize",
+    "Int8Linear", "Int8Conv2D", "convert_to_int8", "load_quantized_model",
 ]
 
 
@@ -104,6 +105,8 @@ class FakeQuantMovingAverageAbsMax(Layer):
         # observer update only on concrete values: under jit tracing the
         # update would leak a tracer into the persistent buffer
         if observing and not isinstance(xv, jax.core.Tracer):
+            if getattr(self, "_hist_observer", None) is not None:
+                self._hist_observer.observe(xv)
             cur = jax.lax.stop_gradient(jnp.max(jnp.abs(xv))).astype(jnp.float32)
             prev = self.scale._value
             if self.algo == "max":
@@ -235,10 +238,21 @@ class PostTrainingQuantization:
 
     def quantize(self):
         model = self.model
+        # abs_max/KL/hist/mse/avg all track the running max as the base
+        # range; the histogram algos then REFINE the clip point from the
+        # collected distribution (reference algo dispatch:
+        # post_training_quantization.py ~line 360)
+        hist_algos = ("KL", "kl", "hist", "mse", "avg")
         qat = ImperativeQuantAware(
             self.types, self.weight_bits, self.activation_bits,
-            act_algo="max" if self.algo == "abs_max" else "ema")
+            act_algo="ema" if self.algo == "ema" else "max")
         qat.quantize(model)
+        if self.algo in hist_algos:
+            from .int8 import HistogramObserver
+
+            for _, sub in model.named_sublayers():
+                if isinstance(sub, FakeQuantMovingAverageAbsMax):
+                    sub._hist_observer = HistogramObserver()
         # calibration runs with INFERENCE semantics (reference PTQ executes the
         # inference program: dropout off, BN running stats frozen) — the
         # observers update via the explicit _observing override, not train()
@@ -260,6 +274,28 @@ class PostTrainingQuantization:
         finally:
             for ob in observers:
                 ob._observing = None
+        # refine activation scales from the collected histograms
+        if self.algo in hist_algos:
+            from .int8 import (compute_hist_scale, compute_kl_scale,
+                               compute_mse_scale)
+
+            for _, sub in model.named_sublayers():
+                ob = getattr(sub, "_hist_observer", None)
+                if not isinstance(sub, FakeQuantMovingAverageAbsMax) \
+                        or ob is None:
+                    continue
+                if self.algo in ("KL", "kl"):
+                    s = compute_kl_scale(ob.hist, ob.amax)
+                elif self.algo == "mse":
+                    s = compute_mse_scale(ob.hist, ob.amax,
+                                          self.activation_bits)
+                elif self.algo == "hist":
+                    s = compute_hist_scale(ob.hist, ob.amax)
+                else:  # avg — mean of per-batch abs maxes
+                    s = float(np.mean(ob.batch_maxes)) if ob.batch_maxes \
+                        else float(ob.amax)
+                sub.scale._value = jnp.asarray(s, jnp.float32)
+                sub._hist_observer = None
         # snapshot the weight int8 codebooks + frozen activation scales
         for name, sub in model.named_sublayers():
             if isinstance(sub, (QuantedLinear, QuantedConv2D)):
@@ -271,15 +307,38 @@ class PostTrainingQuantization:
                 }
         return self.model
 
+    def convert_to_int8(self):
+        """Freeze the calibrated model to int8 execution in place (the
+        QuantizationFreezePass analog). Returns the number of layers
+        converted; the model's Linear/Conv2D now run int8 MXU dots."""
+        from .int8 import convert_to_int8 as _conv
+
+        return _conv(self.model, self.scales, weight_bits=self.weight_bits,
+                     activation_bits=self.activation_bits)
+
     def save_quantized_model(self, save_model_path, input_spec=None):
         import pickle
 
         from .. import jit
 
         jit.save(self.model, save_model_path, input_spec=input_spec)
+        # the sidecar is self-contained: int8 codebooks + scales + the full
+        # float state (biases, scale buffers, any unquantized layers), so
+        # load_quantized_model reproduces the deploy model from a FRESH
+        # architecture without a separate checkpoint
+        quantized_weight_keys = {f"{name}.weight" for name in self.scales}
+        state = {k: np.asarray(v.numpy())
+                 for k, v in self.model.state_dict().items()
+                 if v is not None and k not in quantized_weight_keys}
         with open(save_model_path + ".quant", "wb") as f:
             pickle.dump({"scales": self.scales, "weight_bits": self.weight_bits,
-                         "activation_bits": self.activation_bits}, f, protocol=4)
+                         "activation_bits": self.activation_bits,
+                         "quantizable_op_type": self.types,
+                         "state_dict": state}, f, protocol=4)
+
+
+from .int8 import (  # noqa: E402
+    Int8Conv2D, Int8Linear, convert_to_int8, load_quantized_model)
 
 
 def quant_post_static(executor=None, model_dir=None, quantize_model_path=None,

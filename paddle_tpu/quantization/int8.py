@@ -1,0 +1,293 @@
+"""INT8 execution + calibration algorithms.
+
+Reference analog: `python/paddle/fluid/contrib/slim/quantization/
+post_training_quantization.py` (algo dispatch: abs_max / KL / hist / mse /
+avg, ~line 360) and `quantization_pass.py` (QuantizationFreezePass — replace
+fake-quant pairs with real int8 weights + dequant on the output).
+
+TPU-native design: XLA supports int8 x int8 -> int32 dots/convs on the MXU
+natively (`preferred_element_type=int32`), so "freezing" a quantized model
+here means swapping Linear/Conv2D for Int8Linear/Int8Conv2D — weights stored
+as int8 codebooks (4x smaller), activations quantized on entry with the
+calibrated scale, accumulation in int32, one fused rescale at the exit. No
+separate quant program pass is needed: the swap IS the pass, and XLA fuses
+the quant/rescale arithmetic into the surrounding computation.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive_call
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = [
+    "Int8Linear", "Int8Conv2D", "convert_to_int8", "load_quantized_model",
+    "compute_kl_scale", "compute_mse_scale", "compute_hist_scale",
+    "HistogramObserver",
+]
+
+# ------------------------------------------------------------- calibration
+class HistogramObserver:
+    """Accumulates |x| histograms across calibration batches with dynamic
+    range growth (rebinning), the structure the KL/hist/mse algorithms need.
+    Reference: PostTrainingQuantization._sample_histogram."""
+
+    def __init__(self, bins=2048):
+        self.bins = bins
+        self.hist = np.zeros(bins, np.float64)
+        self.amax = 0.0
+        self.batch_maxes = []
+
+    def observe(self, x):
+        a = np.abs(np.asarray(x)).ravel()
+        m = float(a.max()) if a.size else 0.0
+        self.batch_maxes.append(m)
+        if m <= 0:
+            return
+        if m > self.amax:
+            if self.amax > 0:
+                # stretch the old histogram onto the new range
+                old_edges = np.linspace(0, self.amax, self.bins + 1)
+                centers = (old_edges[:-1] + old_edges[1:]) / 2
+                new_hist, _ = np.histogram(
+                    centers, bins=self.bins, range=(0, m), weights=self.hist)
+                self.hist = new_hist
+            self.amax = m
+        h, _ = np.histogram(a, bins=self.bins, range=(0, self.amax))
+        self.hist += h
+
+
+def _kl_divergence(p, q):
+    p = p / max(p.sum(), 1e-12)
+    q = q / max(q.sum(), 1e-12)
+    mask = p > 0
+    return float(np.sum(p[mask] * np.log(p[mask] / np.maximum(q[mask], 1e-12))))
+
+
+def compute_kl_scale(hist, amax, num_quant_bins=128):
+    """TensorRT-style KL threshold selection (the reference's algo='KL',
+    post_training_quantization.py cal_kl_threshold): pick the clip point
+    whose 128-bin quantized distribution diverges least from the clipped
+    reference distribution."""
+    bins = len(hist)
+    if amax <= 0 or hist.sum() == 0:
+        return max(amax, 1e-8)
+    # drop the zero bin: exact zeros (the post-relu spike) quantize exactly
+    # at ANY scale, and their mass otherwise drags the optimal clip toward
+    # zero (the TensorRT KL convention)
+    hist = hist.copy()
+    hist[0] = 0
+    if hist.sum() == 0:
+        return max(amax, 1e-8)
+    bin_width = amax / bins
+    best_i, best_kl = bins, np.inf
+    # descending, with strict improvement: on near-uniform distributions
+    # every clip point ties at KL~0, and the tie must go to the LARGEST
+    # range (no clip), not the smallest (which would clip 90%+ of the mass)
+    for i in range(bins, num_quant_bins - 1, -8):
+        p = hist[:i].astype(np.float64).copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the last bin
+        # quantize the first i bins down to num_quant_bins levels
+        factor = i / num_quant_bins
+        idx = (np.arange(i) / factor).astype(np.int64)
+        q_small = np.bincount(idx, weights=hist[:i], minlength=num_quant_bins)
+        # expand back, spreading each level over its source bins (only where
+        # the source had mass — empty bins stay empty, as in the reference)
+        counts = np.bincount(idx, weights=(hist[:i] > 0).astype(np.float64),
+                             minlength=num_quant_bins)
+        q = np.where(hist[:i] > 0,
+                     q_small[idx] / np.maximum(counts[idx], 1), 0.0)
+        kl = _kl_divergence(p, q)
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return (best_i + 0.5) * bin_width
+
+
+def compute_mse_scale(hist, amax, bits=8):
+    """Clip threshold minimizing expected squared quantization error over the
+    histogram (reference algo='mse')."""
+    bins = len(hist)
+    if amax <= 0 or hist.sum() == 0:
+        return max(amax, 1e-8)
+    bin_width = amax / bins
+    centers = (np.arange(bins) + 0.5) * bin_width
+    qmax = 2.0 ** (bits - 1) - 1
+    best_t, best_err = amax, np.inf
+    for i in range(bins // 8, bins + 1, 8):
+        t = i * bin_width
+        step = t / qmax
+        clipped = np.minimum(centers, t)
+        deq = np.round(clipped / step) * step
+        err = float(np.sum(hist * (centers - deq) ** 2))
+        if err < best_err:
+            best_err, best_t = err, t
+    return best_t
+
+
+def compute_hist_scale(hist, amax, percent=0.99999):
+    """Percentile clip (reference algo='hist', hist_percent)."""
+    if amax <= 0 or hist.sum() == 0:
+        return max(amax, 1e-8)
+    cdf = np.cumsum(hist) / hist.sum()
+    i = int(np.searchsorted(cdf, percent)) + 1
+    return i * (amax / len(hist))
+
+
+# --------------------------------------------------------------- int8 layers
+class Int8Linear(Layer):
+    """Linear with an int8 weight codebook and int8 MXU execution:
+    x -> int8 (calibrated scale), dot int8xint8 -> int32, one rescale out."""
+
+    def __init__(self, w_int8, w_scale, act_scale, bias=None,
+                 weight_bits=8, activation_bits=8):
+        super().__init__()
+        self.register_buffer("w_int8", Tensor(jnp.asarray(w_int8, jnp.int8)))
+        # dequant factor per output channel: w_scale [1, out] / qmax
+        self._w_scale = np.asarray(w_scale, np.float32).reshape(1, -1)
+        self._act_scale = float(act_scale)
+        self._w_qmax = float(2 ** (weight_bits - 1) - 1)
+        self._a_qmax = float(2 ** (activation_bits - 1) - 1)
+        self.bias = bias
+
+    def forward(self, x):
+        w = self.w_int8
+        w_scale, act_scale = self._w_scale, self._act_scale
+        w_qmax, a_qmax = self._w_qmax, self._a_qmax
+        bias = self.bias
+
+        def f(xv, wv, *b):
+            xq = jnp.clip(jnp.round(xv / act_scale * a_qmax), -a_qmax, a_qmax
+                          ).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, wv, (((xv.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (
+                jnp.asarray(w_scale) * act_scale / (w_qmax * a_qmax))
+            if b:
+                out = out + b[0]
+            return out.astype(xv.dtype)
+
+        args = [x, w] + ([self.bias] if bias is not None else [])
+        return primitive_call(f, *args, name="int8_linear",
+                              attrs={"act_scale": act_scale})
+
+
+class Int8Conv2D(Layer):
+    """Conv2D executing in int8 (NCHW): int8 feature map x int8 kernel ->
+    int32 accumulate, per-output-channel rescale at the exit."""
+
+    def __init__(self, w_int8, w_scale, act_scale, bias=None, stride=(1, 1),
+                 padding=0, dilation=(1, 1), groups=1, data_format="NCHW",
+                 weight_bits=8, activation_bits=8):
+        super().__init__()
+        if data_format != "NCHW":
+            raise NotImplementedError(
+                "Int8Conv2D supports NCHW only (the reference int8 pass is "
+                "also NCHW); convert the model or keep this layer float")
+        from ..nn.functional import _conv_padding, _pair
+
+        self.register_buffer("w_int8", Tensor(jnp.asarray(w_int8, jnp.int8)))
+        self._w_scale = np.asarray(w_scale, np.float32).reshape(1, -1, 1, 1)
+        self._act_scale = float(act_scale)
+        self.bias = bias
+        self._stride = _pair(stride)
+        self._dilation = _pair(dilation)
+        self._pad = _conv_padding(padding, None, self._dilation, 2)
+        self._groups = groups
+        self._w_qmax = float(2 ** (weight_bits - 1) - 1)
+        self._a_qmax = float(2 ** (activation_bits - 1) - 1)
+
+    def forward(self, x):
+        w = self.w_int8
+        w_scale, act_scale = self._w_scale, self._act_scale
+        stride, pad, dil, groups = (self._stride, self._pad, self._dilation,
+                                    self._groups)
+        w_qmax, a_qmax = self._w_qmax, self._a_qmax
+        bias = self.bias
+
+        def f(xv, wv, *b):
+            xq = jnp.clip(jnp.round(xv / act_scale * a_qmax), -a_qmax, a_qmax
+                          ).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                xq, wv, window_strides=stride, padding=pad,
+                rhs_dilation=dil,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (
+                jnp.asarray(w_scale) * act_scale / (w_qmax * a_qmax))
+            if b:
+                out = out + b[0].reshape(1, -1, 1, 1)
+            return out.astype(xv.dtype)
+
+        args = [x, w] + ([self.bias] if bias is not None else [])
+        return primitive_call(f, *args, name="int8_conv2d",
+                              attrs={"act_scale": act_scale})
+
+
+# ----------------------------------------------------------------- converter
+def convert_to_int8(model: Layer, scales: dict, weight_bits=8,
+                    activation_bits=8) -> int:
+    """Swap each calibrated QuantedLinear/QuantedConv2D for its int8
+    executing twin, consuming the PTQ scales dict ({sublayer name ->
+    {weight_int8, weight_scale, act_scale}}). Returns the number of layers
+    converted. The reference analog is QuantizationFreezePass: fake-quant
+    pairs become real int8 weights + dequant."""
+    from . import QuantedConv2D, QuantedLinear
+
+    n = 0
+    for parent_name, parent in [("", model)] + list(model.named_sublayers()):
+        for name, sub in list(parent._sub_layers.items()):
+            full = f"{parent_name}.{name}" if parent_name else name
+            if full not in scales:
+                continue
+            rec = scales[full]
+            if isinstance(sub, QuantedLinear):
+                parent._sub_layers[name] = Int8Linear(
+                    rec["weight_int8"], rec["weight_scale"],
+                    rec["act_scale"], bias=sub.bias,
+                    weight_bits=weight_bits, activation_bits=activation_bits)
+                n += 1
+            elif isinstance(sub, QuantedConv2D):
+                lay = sub._inner
+                parent._sub_layers[name] = Int8Conv2D(
+                    rec["weight_int8"], rec["weight_scale"],
+                    rec["act_scale"], bias=sub.bias,
+                    stride=lay._stride, padding=lay._padding,
+                    dilation=lay._dilation, groups=lay._groups,
+                    data_format=lay._data_format,
+                    weight_bits=weight_bits, activation_bits=activation_bits)
+                n += 1
+    return n
+
+
+def load_quantized_model(model: Layer, quant_path: str) -> int:
+    """Consume a `.quant` sidecar written by
+    PostTrainingQuantization.save_quantized_model: quantize `model` (a fresh
+    float architecture), then freeze it to int8 with the saved codebooks and
+    scales. Returns the number of int8 layers installed."""
+    import pickle
+
+    from . import ImperativeQuantAware
+
+    path = quant_path if quant_path.endswith(".quant") else quant_path + ".quant"
+    with open(path, "rb") as f:
+        payload = pickle.load(f)
+    wb = payload.get("weight_bits", 8)
+    ab = payload.get("activation_bits", 8)
+    ImperativeQuantAware(
+        payload.get("quantizable_op_type", ("Linear", "Conv2D")),
+        weight_bits=wb, activation_bits=ab).quantize(model)
+    state = payload.get("state_dict")
+    if state:
+        # restore the calibration-time float state (biases, unquantized
+        # layers) — a fresh architecture's random init must not leak into
+        # the deploy model. Quantized-layer weights are absent (their int8
+        # codebooks in `scales` replace them at convert time).
+        model.set_state_dict({k: Tensor(np.asarray(v))
+                              for k, v in state.items()})
+    return convert_to_int8(model, payload["scales"], weight_bits=wb,
+                           activation_bits=ab)
