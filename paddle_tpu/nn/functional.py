@@ -1012,18 +1012,88 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
                 align_mode=0, data_format="NCHW", name=None):
+    """Reference: nn/functional/common.py interpolate -> phi interp kernels.
+
+    Modes: nearest / linear / bilinear / trilinear / bicubic / area, over
+    3-5D inputs, channels-first or channels-last (data_format). Coordinate
+    conventions match the reference kernels: nearest uses the asymmetric
+    floor(i*in/out) map; linear-family uses half-pixel (align_mode=0,
+    default), asymmetric src=i*in/out (align_mode=1), or corner-aligned
+    src=i*(in-1)/(out-1) (align_corners=True) via spatial-only
+    map_coordinates; 'area' is the adaptive average pool (matrix form for
+    non-divisible factors); bicubic rides jax.image.resize (half-pixel)."""
+    channels_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
+
     def f(a):
-        n, c = a.shape[0], a.shape[1]
-        ih, iw = a.shape[2], a.shape[3]
+        if channels_last:
+            a = jnp.moveaxis(a, -1, 1)
+        sp = a.ndim - 2
+        in_sp = a.shape[2:]
         if size is not None:
-            oh, ow = _pair(size)
+            osz = tuple(size) if isinstance(size, (list, tuple)) \
+                else (int(size),) * sp
+            osz = tuple(int(s) for s in osz)
         else:
-            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
-            oh, ow = int(ih * sf[0]), int(iw * sf[1])
-        method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
-                  "linear": "linear", "trilinear": "linear", "area": "linear"}[mode]
-        out = jax.image.resize(a, (n, c, oh, ow), method=method)
-        return out
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else (scale_factor,) * sp
+            osz = tuple(int(d * s) for d, s in zip(in_sp, sf))
+        out = _interp_core(a, osz, in_sp)
+        return jnp.moveaxis(out, 1, -1) if channels_last else out
+
+    def _interp_core(a, osz, in_sp):
+        if osz == tuple(in_sp):
+            return a
+        if mode == "area":
+            # adaptive average pooling per spatial dim (exact for divisible
+            # factors; interpolating matrix otherwise)
+            out = a
+            for d, (i_n, o_n) in enumerate(zip(in_sp, osz)):
+                if i_n == o_n:
+                    continue
+                m = jnp.asarray(_adaptive_avg_matrix(i_n, o_n, out.dtype))
+                out = jnp.moveaxis(
+                    jnp.tensordot(out, m, axes=[[2 + d], [1]]), -1, 2 + d)
+            return out
+        if mode == "nearest":
+            # reference convention: src = floor(i*in/out) (align_corners
+            # rounds the corner-aligned positions instead)
+            out = a
+            for d, (i_n, o_n) in enumerate(zip(in_sp, osz)):
+                if i_n == o_n:
+                    continue
+                if align_corners:
+                    idx = jnp.round(
+                        jnp.linspace(0.0, i_n - 1.0, o_n)).astype(jnp.int32)
+                else:
+                    idx = jnp.floor(
+                        jnp.arange(o_n) * (i_n / o_n)).astype(jnp.int32)
+                out = jnp.take(out, idx, axis=2 + d)
+            return out
+        if mode in ("linear", "bilinear", "trilinear") and (
+                align_corners or align_mode == 1):
+            from jax.scipy.ndimage import map_coordinates
+
+            def coords(i_n, o_n):
+                if align_corners:
+                    return jnp.linspace(0.0, i_n - 1.0, o_n)
+                # align_mode=1: asymmetric src = i*in/out, clipped
+                return jnp.clip(jnp.arange(o_n) * (i_n / o_n), 0, i_n - 1)
+
+            grids = jnp.meshgrid(*[coords(i_n, o_n)
+                                   for i_n, o_n in zip(in_sp, osz)],
+                                 indexing="ij")
+            flat = a.reshape((-1,) + tuple(in_sp))
+            out = jax.vmap(
+                lambda img: map_coordinates(img, list(grids), order=1))(flat)
+            return out.reshape(a.shape[:2] + tuple(osz))
+        if align_corners and mode == "bicubic":
+            raise NotImplementedError(
+                "bicubic with align_corners=True has no exact lowering here "
+                "(jax map_coordinates is linear-only); use "
+                "align_corners=False or bilinear")
+        method = {"bilinear": "linear", "bicubic": "cubic",
+                  "linear": "linear", "trilinear": "linear"}[mode]
+        return jax.image.resize(a, a.shape[:2] + osz, method=method)
 
     return primitive_call(f, _t(x), name="interpolate")
 

@@ -215,7 +215,10 @@ def scatter(x, index, updates, overwrite=True, name=None):
         idx = idx.astype(jnp.int32).reshape(-1)
         if overwrite:
             return a.at[idx].set(upd)
-        return a.at[idx].add(upd)
+        # reference accumulate mode (scatter kernel, overwrite=false):
+        # target rows are ZEROED first, then all updates accumulate — the
+        # original row value does not survive
+        return a.at[idx].set(0).at[idx].add(upd)
 
     return primitive_call(f, _to_t(x), _to_t(index), _to_t(updates), name="scatter")
 

@@ -91,11 +91,29 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    xv = np.asarray(_to_t(x)._value)
-    from scipy import stats  # pragma: no cover - scipy baked in with jax
+    """Most frequent value along axis -> (values, indices). Tie-break
+    matches the reference mode kernel (mode_op.h GetMode: scan over the
+    SORTED axis keeps later runs on equal counts): among equally frequent
+    values the LARGEST wins, and the index is its LAST occurrence."""
 
-    m = stats.mode(xv, axis=axis, keepdims=keepdim)
-    return Tensor(m.mode), Tensor(m.count.astype(np.int64))
+    def f(a):
+        am = jnp.moveaxis(a, axis, -1)
+        n = am.shape[-1]
+        eq = am[..., :, None] == am[..., None, :]
+        cnt = eq.sum(-1)
+        cmax = cnt.max(-1, keepdims=True)
+        # dtype-preserving masked max (an -inf literal would promote ints)
+        lo = (jnp.finfo(am.dtype).min if jnp.issubdtype(am.dtype, jnp.floating)
+              else jnp.iinfo(am.dtype).min)
+        vals = jnp.where(cnt == cmax, am, lo).max(-1)
+        idx = (n - 1) - jnp.argmax((am == vals[..., None])[..., ::-1],
+                                   axis=-1)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+
+    return primitive_call(f, _to_t(x), name="mode")
 
 
 def index_sample(x, index):

@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Generate OPTEST_COVERAGE.md: every op-class going through the OpTest
+harness (utils/op_test.py — eager+static paths vs numpy reference,
+finite-difference grad checks), per batch file, with grad-check status.
+Reference analog: the per-op test-file inventory of
+python/paddle/fluid/tests/unittests/ driven by op_test.py:292."""
+import importlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.utils.op_test import OpTest  # noqa: E402
+
+BATCHES = ["test_op_test_harness", "test_op_test_batch2",
+           "test_op_test_batch3", "test_op_test_batch4",
+           "test_op_test_batch5"]
+
+
+def main():
+    lines = ["# OpTest coverage", "",
+             "Op tests running through the `utils/op_test.py` harness "
+             "(reference protocol op_test.py:292): eager AND static-graph "
+             "execution against an independent numpy reference, plus "
+             "central-finite-difference gradient checks where marked.", ""]
+    total = n_grad = 0
+    for modname in BATCHES:
+        m = importlib.import_module(modname)
+        classes = sorted(
+            (c for n, c in vars(m).items()
+             if isinstance(c, type) and issubclass(c, OpTest)
+             and c is not OpTest),
+            key=lambda c: c.__name__)
+        total += len(classes)
+        lines += [f"## {modname} ({len(classes)} ops)", "",
+                  "| op test | grad check |", "|---|---|"]
+        for c in classes:
+            has_grad = any("grad" in n for n in vars(c))
+            n_grad += has_grad
+            lines.append(f"| {c.__name__} | {'yes' if has_grad else '—'} |")
+        lines.append("")
+    lines.insert(2, f"**{total} op test classes, {n_grad} with gradient "
+                    "checks.** (Several classes sweep op families — "
+                    "elementwise, bf16 tolerances — so distinct ops "
+                    "exceed the class count.)")
+    out = os.path.join(REPO, "OPTEST_COVERAGE.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out}: {total} classes, {n_grad} grad-checked")
+
+
+if __name__ == "__main__":
+    main()
