@@ -19,6 +19,14 @@ def on_tpu_backend() -> bool:
         return False
 
 
+def i32_index_scope():
+    """Context for every pallas_call: the package enables x64 globally for
+    Paddle dtype parity (paddle_tpu/__init__.py:19), which makes BlockSpec
+    index-map constants i64 and fails Mosaic legalization ("func.return
+    (i32, i64)"). Scoping x64 off keeps kernel index math i32."""
+    return jax.enable_x64(False)
+
+
 _logged: set[str] = set()
 
 

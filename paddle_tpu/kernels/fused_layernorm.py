@@ -20,6 +20,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from . import _common
+
 _LANE = 128
 _ROW_BLOCK = 8
 
@@ -32,16 +34,19 @@ def _ln_fwd_kernel(eps, p_x, p_g, p_b, p_y, p_mu, p_rstd):
     y = (x - mu) * rstd * p_g[...].astype(jnp.float32) \
         + p_b[...].astype(jnp.float32)
     p_y[...] = y.astype(p_y.dtype)
-    p_mu[...] = mu[..., 0]
-    p_rstd[...] = rstd[..., 0]
+    # stats are (rows, 1): Mosaic requires rank-1 blocks be lane-multiples
+    # (128), which an 8-row stat block is not — rank-2 with minor dim == 1
+    # (equal to the array dim) lowers fine and keeps the stat tensors tiny.
+    p_mu[...] = mu
+    p_rstd[...] = rstd
 
 
 def _ln_dx_kernel(p_x, p_g, p_mu, p_rstd, p_dy, p_dx):
     x = p_x[...].astype(jnp.float32)
     g = p_g[...].astype(jnp.float32)
     dy = p_dy[...].astype(jnp.float32)
-    mu = p_mu[...][..., None]
-    rstd = p_rstd[...][..., None]
+    mu = p_mu[...]
+    rstd = p_rstd[...]
     xhat = (x - mu) * rstd
     wdy = dy * g
     c1 = jnp.mean(wdy, axis=-1, keepdims=True)
@@ -56,19 +61,20 @@ def _call_fwd(x2, gamma, beta, eps, interpret):
     grid = (rows // _ROW_BLOCK,)
     row_block = pl.BlockSpec((_ROW_BLOCK, d), lambda i: (i, 0))
     vec_block = pl.BlockSpec((d,), lambda i: (0,))
-    stat_block = pl.BlockSpec((_ROW_BLOCK,), lambda i: (i,))
-    y, mu, rstd = pl.pallas_call(
-        functools.partial(_ln_fwd_kernel, eps),
-        grid=grid,
-        in_specs=[row_block, vec_block, vec_block],
-        out_specs=[row_block, stat_block, stat_block],
-        out_shape=[
-            jax.ShapeDtypeStruct((rows, d), x2.dtype),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
-            jax.ShapeDtypeStruct((rows,), jnp.float32),
-        ],
-        interpret=interpret,
-    )(x2, gamma, beta)
+    stat_block = pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0))
+    with _common.i32_index_scope():
+        y, mu, rstd = pl.pallas_call(
+            functools.partial(_ln_fwd_kernel, eps),
+            grid=grid,
+            in_specs=[row_block, vec_block, vec_block],
+            out_specs=[row_block, stat_block, stat_block],
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, d), x2.dtype),
+                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+                jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(x2, gamma, beta)
     return y, mu, rstd
 
 
@@ -79,15 +85,16 @@ def _call_dx(x2, gamma, mu, rstd, dy2, interpret):
     grid = (rows // _ROW_BLOCK,)
     row_block = pl.BlockSpec((_ROW_BLOCK, d), lambda i: (i, 0))
     vec_block = pl.BlockSpec((d,), lambda i: (0,))
-    stat_block = pl.BlockSpec((_ROW_BLOCK,), lambda i: (i,))
-    return pl.pallas_call(
-        _ln_dx_kernel,
-        grid=grid,
-        in_specs=[row_block, vec_block, stat_block, stat_block, row_block],
-        out_specs=row_block,
-        out_shape=jax.ShapeDtypeStruct((rows, d), x2.dtype),
-        interpret=interpret,
-    )(x2, gamma, mu, rstd, dy2)
+    stat_block = pl.BlockSpec((_ROW_BLOCK, 1), lambda i: (i, 0))
+    with _common.i32_index_scope():
+        return pl.pallas_call(
+            _ln_dx_kernel,
+            grid=grid,
+            in_specs=[row_block, vec_block, stat_block, stat_block, row_block],
+            out_specs=row_block,
+            out_shape=jax.ShapeDtypeStruct((rows, d), x2.dtype),
+            interpret=interpret,
+        )(x2, gamma, mu, rstd, dy2)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -125,7 +132,7 @@ def _vjp_bwd(eps, interpret, res, dy):
     dy2 = dy.reshape(-1, d)
     dx = _call_dx(x2, gamma, mu, rstd, dy2, interpret).reshape(shape)
     # dgamma/dbeta: small cross-row reductions — XLA's territory
-    xhat = (x2.astype(jnp.float32) - mu[:, None]) * rstd[:, None]
+    xhat = (x2.astype(jnp.float32) - mu) * rstd
     dgamma = jnp.sum(dy2.astype(jnp.float32) * xhat, axis=0).astype(
         gamma.dtype)
     dbeta = jnp.sum(dy2.astype(jnp.float32), axis=0).astype(beta.dtype)

@@ -21,6 +21,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from . import _common
+
 _LANE = 128
 _ROWS_PER_BLOCK = 8  # (8, 128) f32 tile — the VPU-native block
 
@@ -64,15 +66,16 @@ def fused_adam_update(p, g, m, v, lr, bc1, bc2, *, beta1, beta2, eps,
 
     block = pl.BlockSpec((_ROWS_PER_BLOCK, width), lambda i, _: (i, 0))
     out_shape = jax.ShapeDtypeStruct(P.shape, jnp.float32)
-    new_p, new_m, new_v = pl.pallas_call(
-        functools.partial(_adam_kernel, beta1, beta2, eps),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1, grid=grid,
-            in_specs=[block] * 4, out_specs=[block] * 3,
-        ),
-        out_shape=[out_shape] * 3,
-        interpret=interpret,
-    )(scalars, P, G, M, V)
+    with _common.i32_index_scope():
+        new_p, new_m, new_v = pl.pallas_call(
+            functools.partial(_adam_kernel, beta1, beta2, eps),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1, grid=grid,
+                in_specs=[block] * 4, out_specs=[block] * 3,
+            ),
+            out_shape=[out_shape] * 3,
+            interpret=interpret,
+        )(scalars, P, G, M, V)
 
     def unprep(x):
         flat = x.reshape(-1)

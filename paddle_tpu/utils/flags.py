@@ -11,12 +11,18 @@ _FLAGS: dict[str, object] = {
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_cudnn_deterministic": False,
     "FLAGS_use_pallas_kernels": True,
-    # fused one-pass Adam update kernel (kernels/fused_optimizer.py) for
-    # large f32 buffers on TPU
-    "FLAGS_use_fused_optimizer": True,
-    # fused one-pass LayerNorm kernel (kernels/fused_layernorm.py), TPU +
-    # lane-tileable trailing dim
-    "FLAGS_use_fused_layernorm": True,
+    # fused one-pass Adam update kernel (kernels/fused_optimizer.py).
+    # Default OFF: round-5 on-chip A/B at GPT-350M measured it 7% SLOWER
+    # than XLA's fused update chain (32.9k vs 35.5k tok/s) — per-param
+    # pallas launches lose to one fused HLO graph. Available for workloads
+    # with few huge buffers where one-pass streaming can win.
+    "FLAGS_use_fused_optimizer": False,
+    # fused one-pass LayerNorm kernel (kernels/fused_layernorm.py).
+    # Default OFF: round-5 on-chip A/B at GPT-350M measured it 11% SLOWER
+    # (31.4k vs 35.5k tok/s) — the custom_vjp boundary blocks XLA from
+    # fusing LN into its matmul neighbors, costing more than the one-pass
+    # forward saves. Kept for standalone-LN-heavy workloads.
+    "FLAGS_use_fused_layernorm": False,
     # True/False force; "auto" picks splash for causal long-seq (>= 2048)
     # where skipping fully-masked KV tiles pays — at 1024 it measured even
     # with dense-block flash (round-3 on-chip A/B)
