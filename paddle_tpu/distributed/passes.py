@@ -171,31 +171,50 @@ class FP16Pass(PassBase):
     decr_every_n_nan_or_inf (2 — reference default), incr_ratio (2.0),
     decr_ratio (0.5), use_dynamic_loss_scaling (True),
     dtype ("float16" | "bfloat16" — bf16 disables scaling; exponent range
-    matches fp32 so overflow protection is unnecessary).
+    matches fp32 so overflow protection is unnecessary),
+    use_fp16_guard (False — when True, ONLY ops recorded inside
+    paddle.static.amp.fp16_guard() are cast to low precision; every other
+    op keeps fp32 inputs, matching fp16_utils.py _need_keep_fp32:352's
+    region semantics. Unguarded ops get a dtype->fp32 input wrap, so a
+    guarded producer feeding a fragile consumer is re-cast at the boundary).
     """
 
     def _apply_impl(self, main_program, startup_program, context):
+        import warnings
+
         import jax.numpy as jnp
 
         from ..static.passes import _AMP_BLACKLIST, _cast_wrap
 
-        use_fp16 = self.attrs.get("dtype", "float16") == "float16"
+        use_fp16 = (self.attrs.get("dtype", "float16") == "float16"
+                    and not self.attrs.get("use_bf16"))
         dtype = jnp.float16 if use_fp16 else jnp.bfloat16
+        use_guard = bool(self.attrs.get("use_fp16_guard", False))
 
-        n = 0
+        n = n_guarded = 0
         for block in main_program.blocks:
             for op in block.ops:
                 if op.op_role not in (OpRole.Forward, OpRole.Backward) \
                         or "amp" in op.attrs:
                     continue
                 base = op.type.split("/")[-1]
-                if base in _AMP_BLACKLIST:
+                in_guard = bool(op.attrs.get("in_fp16_guard"))
+                n_guarded += in_guard
+                if base in _AMP_BLACKLIST or (use_guard and not in_guard):
                     op.fn = _cast_wrap(op.fn, dtype, jnp.float32)
                     op.attrs["amp"] = "fp32"
                 else:
                     op.fn = _cast_wrap(op.fn, jnp.float32, dtype)
                     op.attrs["amp"] = jnp.dtype(dtype).name
                 n += 1
+        if use_guard and not n_guarded:
+            warnings.warn(
+                "pure-fp16 pass ran with use_fp16_guard=True but NO op was "
+                "recorded inside paddle.static.amp.fp16_guard(): the whole "
+                "program keeps fp32 (reference fp16_utils.py:352 semantics). "
+                "Wrap the castable region in fp16_guard() or pass "
+                "use_fp16_guard=False for whole-program casting.",
+                stacklevel=3)
 
         scaling = {
             "enabled": use_fp16 and bool(
@@ -211,6 +230,8 @@ class FP16Pass(PassBase):
         }
         main_program._loss_scaling = scaling
         context.attrs["fp16"] = {"dtype": jnp.dtype(dtype).name, "n_ops": n,
+                                 "n_guarded": n_guarded,
+                                 "use_fp16_guard": use_guard,
                                  "loss_scaling": scaling["enabled"]}
 
 

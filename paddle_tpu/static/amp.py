@@ -54,12 +54,24 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
 
             prog = default_main_program()
             if use_pure_fp16:
+                # reference decorator.py:632: use_fp16_guard defaults to
+                # use_pure_fp16 — but ONLY honor guard mode when the traced
+                # program actually contains guarded ops; a guard-free script
+                # under the reference default would silently train in fp32,
+                # which the pass itself warns about. Explicit True/False is
+                # passed through untouched.
+                guard = use_fp16_guard
+                if guard is None:
+                    guard = any(
+                        op.attrs.get("in_fp16_guard")
+                        for block in prog.blocks for op in block.ops)
                 new_pass("auto_parallel_fp16", {
                     "init_loss_scaling": init_loss_scaling,
                     "incr_every_n_steps": incr_every_n_steps,
                     "decr_every_n_nan_or_inf": decr_every_n_nan_or_inf,
                     "incr_ratio": incr_ratio, "decr_ratio": decr_ratio,
                     "use_bf16": use_bf16,
+                    "use_fp16_guard": guard,
                     "use_dynamic_loss_scaling": use_dynamic_loss_scaling,
                 }).apply(prog)
             else:
@@ -87,19 +99,25 @@ def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
 
 @contextlib.contextmanager
 def fp16_guard():
-    """reference: fp16_utils.py fp16_guard — marks a region whose ops the
-    pure-fp16 pass may cast. The pass here operates whole-program (XLA
-    fuses casts), so the guard is a no-op scope kept for source compat."""
-    yield
+    """reference: fp16_utils.py fp16_guard — ops recorded inside this scope
+    are the ONLY ones the pure-fp16 pass casts to low precision when
+    use_fp16_guard is on (region-scoped O2; everything outside keeps fp32).
+    Under dygraph there is no recording, so the scope is inert — use
+    paddle.amp.auto_cast there."""
+    from .program import fp16_guard_scope
+
+    with fp16_guard_scope():
+        yield
 
 
 def cast_model_to_fp16(program, amp_lists=None, use_fp16_guard=True):
     """reference: fp16_utils.py cast_model_to_fp16 — apply the O2 cast
-    rewrite to `program`."""
+    rewrite to `program`; with use_fp16_guard only fp16_guard regions cast."""
     from ..distributed.passes import new_pass
 
     new_pass("auto_parallel_fp16",
-             {"use_dynamic_loss_scaling": False}).apply(program)
+             {"use_dynamic_loss_scaling": False,
+              "use_fp16_guard": use_fp16_guard}).apply(program)
     return program
 
 
@@ -125,12 +143,16 @@ class _Bf16Namespace:
                       use_bf16_guard=None):
         return decorate(optimizer, amp_lists=amp_lists,
                         use_pure_fp16=use_pure_bf16, use_bf16=True,
+                        use_fp16_guard=use_bf16_guard,
                         use_dynamic_loss_scaling=False)
 
     @staticmethod
     @contextlib.contextmanager
     def bf16_guard():
-        yield
+        from .program import fp16_guard_scope
+
+        with fp16_guard_scope():
+            yield
 
 
 bf16 = _Bf16Namespace()

@@ -218,6 +218,21 @@ def op_role_guard(role):
 
 _current_device: list = [None]
 
+# fp16_guard scope stack (reference: fp16_utils.py _fp16_guard_pattern —
+# there a name_scope marker on op_namescope; here a direct op attr). Ops
+# recorded while the top is truthy carry attrs["in_fp16_guard"], which the
+# pure-fp16 pass consults when use_fp16_guard is on.
+_current_fp16_guard: list = [False]
+
+
+@contextlib.contextmanager
+def fp16_guard_scope():
+    _current_fp16_guard.append(True)
+    try:
+        yield
+    finally:
+        _current_fp16_guard.pop()
+
 
 @contextlib.contextmanager
 def device_guard(device=None):
@@ -264,6 +279,8 @@ def _static_record(fn, args, name, attrs=None):
         op.attrs.update(attrs)
     if _current_device[-1] is not None:
         op.attrs["device"] = _current_device[-1]
+    if _current_fp16_guard[-1]:
+        op.attrs["in_fp16_guard"] = True
     block.append_op(op)
     if is_tuple:
         return tuple(outputs)

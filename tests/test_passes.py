@@ -171,3 +171,56 @@ def test_static_amp_namespace():
         assert "gelu" in lists.white_list and "matmul" in lists.white_list
     finally:
         paddle.disable_static()
+
+
+def test_fp16_guard_region_scoped_o2():
+    """reference fp16_utils.py:352 (_need_keep_fp32): with use_fp16_guard,
+    ONLY ops inside fp16_guard() cast to fp16 — a numerically fragile op
+    OUTSIDE the guard keeps fp32 and must not overflow. square((h+300)) is
+    ~9e4 > fp16 max 65504: inf if cast, finite when the guard is honored."""
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("fx", [8, 6], "float32")
+            net = paddle.nn.Linear(6, 16)
+            with static.amp.fp16_guard():
+                h = net(x)
+            fragile = paddle.square(h + 300.0)
+        static.amp.cast_model_to_fp16(main, use_fp16_guard=True)
+
+        guarded = [op for op in main.global_block.ops
+                   if op.attrs.get("in_fp16_guard")]
+        assert guarded, "guard scope marked no ops"
+        assert any(op.attrs.get("amp") == "float16" for op in guarded)
+        sq = [op for op in main.global_block.ops if "square" in op.type]
+        assert sq and all(op.attrs.get("amp") == "fp32" for op in sq)
+
+        exe = static.Executor()
+        out = exe.run(main, feed={"fx": np.random.RandomState(0)
+                                  .rand(8, 6).astype("float32")},
+                      fetch_list=[fragile])[0]
+        assert np.all(np.isfinite(np.asarray(out))), \
+            "fragile region outside fp16_guard overflowed — guard not honored"
+
+        # guard flag on, but nothing guarded -> loud warning, program stays fp32
+        main2, startup2 = static.Program(), static.Program()
+        with static.program_guard(main2, startup2):
+            x2 = static.data("fx2", [4, 6], "float32")
+            _ = paddle.nn.Linear(6, 8)(x2)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            static.amp.cast_model_to_fp16(main2, use_fp16_guard=True)
+        assert any("no op was" in str(x.message).lower()
+                   or "fp16_guard" in str(x.message) for x in w)
+        assert all(op.attrs.get("amp") != "float16"
+                   for op in main2.global_block.ops)
+    finally:
+        paddle.disable_static()
