@@ -1024,6 +1024,11 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
     non-divisible factors); bicubic rides jax.image.resize (half-pixel)."""
     channels_last = data_format in ("NHWC", "NLC", "NWC", "NDHWC")
 
+    if size is None and scale_factor is None:
+        raise ValueError(
+            "interpolate: one of size or scale_factor must be set "
+            "(reference nn/functional/common.py raises the same)")
+
     def f(a):
         if channels_last:
             a = jnp.moveaxis(a, -1, 1)
@@ -1032,10 +1037,18 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         if size is not None:
             osz = tuple(size) if isinstance(size, (list, tuple)) \
                 else (int(size),) * sp
+            if len(osz) != sp:
+                raise ValueError(
+                    f"interpolate: size has {len(osz)} elements but the "
+                    f"input has {sp} spatial dims ({data_format})")
             osz = tuple(int(s) for s in osz)
         else:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
                 else (scale_factor,) * sp
+            if len(sf) != sp:
+                raise ValueError(
+                    f"interpolate: scale_factor has {len(sf)} elements but "
+                    f"the input has {sp} spatial dims ({data_format})")
             osz = tuple(int(d * s) for d, s in zip(in_sp, sf))
         out = _interp_core(a, osz, in_sp)
         return jnp.moveaxis(out, 1, -1) if channels_last else out

@@ -97,15 +97,25 @@ def mode(x, axis=-1, keepdim=False, name=None):
     values the LARGEST wins, and the index is its LAST occurrence."""
 
     def f(a):
+        from jax import lax
+
         am = jnp.moveaxis(a, axis, -1)
         n = am.shape[-1]
-        eq = am[..., :, None] == am[..., None, :]
-        cnt = eq.sum(-1)
-        cmax = cnt.max(-1, keepdims=True)
-        # dtype-preserving masked max (an -inf literal would promote ints)
-        lo = (jnp.finfo(am.dtype).min if jnp.issubdtype(am.dtype, jnp.floating)
-              else jnp.iinfo(am.dtype).min)
-        vals = jnp.where(cnt == cmax, am, lo).max(-1)
+        # O(n log n) run-length scan over the sorted axis (an n x n pairwise
+        # count would blow memory at large n): within each run of equal
+        # values the running count peaks at the run's end, so the LAST
+        # position holding the global max count belongs to the largest of
+        # the most-frequent values — the reference tie-break for free.
+        s = jnp.sort(am, axis=-1)
+        new_run = jnp.concatenate(
+            [jnp.ones(am.shape[:-1] + (1,), bool),
+             s[..., 1:] != s[..., :-1]], axis=-1)
+        pos = jnp.arange(n, dtype=jnp.int32)
+        run_start = lax.cummax(
+            jnp.where(new_run, pos, 0).astype(jnp.int32), axis=am.ndim - 1)
+        run_count = pos - run_start + 1
+        best = (n - 1) - jnp.argmax(run_count[..., ::-1], axis=-1)
+        vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
         idx = (n - 1) - jnp.argmax((am == vals[..., None])[..., ::-1],
                                    axis=-1)
         if keepdim:
