@@ -86,3 +86,115 @@ class CompCostModel:
         nbytes = float(ca.get("bytes accessed", ca.get("bytes_accessed", 0.0)))
         return {"flops": flops, "bytes_accessed": nbytes,
                 "time": self.op_time(flops, nbytes)}
+
+
+# ------------------------------------------------- partition-level modeling
+@dataclass
+class ModelDesc:
+    """The transformer-shaped facts the partition cost model needs.
+
+    Reference analog: auto_parallel/cost_model.py builds per-op cost from
+    the serialized program; here the per-step volumes of a transformer
+    train step are closed-form in these seven numbers (survey §7 /
+    scaling-book recipe), which also covers MLP stacks (heads/seq free)."""
+
+    n_params: int
+    layers: int
+    hidden: int
+    heads: int
+    seq: int
+    batch: int
+    dtype_bytes: int = 4
+    opt_slots: int = 2  # adam m+v
+
+    @property
+    def tokens(self) -> float:
+        return float(self.batch) * self.seq
+
+    @property
+    def param_bytes(self) -> float:
+        return float(self.n_params) * self.dtype_bytes
+
+    @property
+    def step_flops(self) -> float:
+        # 6N per token (fwd+bwd matmuls) + causal-attention score/AV term
+        return (6.0 * self.n_params * self.tokens
+                + 12.0 * self.layers * self.hidden * self.tokens * self.seq)
+
+    @property
+    def act_layer_bytes(self) -> float:
+        """One [batch, seq, hidden] activation."""
+        return self.tokens * self.hidden * self.dtype_bytes
+
+
+def partition_comm_volumes(model: ModelDesc, dp: int, sp: int, sh: int,
+                           mp: int) -> dict:
+    """Per-step bytes each axis's collectives move, per chip — the number
+    the verdict asked the cost model to predict per candidate partition.
+
+    Conventions (matching what build_hybrid_step / the GSPMD layout emits):
+    - dp/sp replicate params: ONE grad all-reduce (or reduce-scatter under
+      ZeRO) of the per-chip grad shard param_bytes/(mp*sh) over dp*sp.
+    - sharding (ZeRO>=1): all-gather params + reduce-scatter grads of
+      param_bytes/mp over sh each step.
+    - mp (megatron tp): 2 fwd + 2 bwd all-reduces per layer of the local
+      [b/dp/sp, s, h] activation.
+    - sp (Ulysses): 4 all-to-alls per layer each direction (q,k,v fwd +
+      attn-out, mirrored in bwd) of the local activation — a2a moves
+      (n-1)/n^2 of the tensor per link, captured in CommCostModel.
+    """
+    grad_shard = model.param_bytes / (mp * sh)
+    # batch splits over BOTH dp and sharding (hybrid_train._batch_spec), so
+    # local activations shrink with sh as well
+    act_local = model.act_layer_bytes / (dp * sp * sh)
+    return {
+        "dp": {"collective": "all_reduce", "group": dp * sp,
+               "bytes": grad_shard if dp * sp > 1 else 0.0, "count": 1},
+        "sharding": {"collective": "all_gather+reduce_scatter", "group": sh,
+                     "bytes": 2.0 * model.param_bytes / mp if sh > 1 else 0.0,
+                     "count": 1},
+        "mp": {"collective": "all_reduce", "group": mp,
+               "bytes": act_local if mp > 1 else 0.0,
+               "count": 4 * model.layers},
+        "sp": {"collective": "all_to_all", "group": sp,
+               "bytes": act_local if sp > 1 else 0.0,
+               "count": 8 * model.layers},
+    }
+
+
+def estimate_partition(model: ModelDesc, dp: int, sp: int, sh: int, mp: int,
+                       cluster: ClusterSpec | None = None,
+                       placement: dict | None = None) -> dict:
+    """Score one (dp, sp, sharding, mp) candidate: roofline compute over the
+    per-chip FLOP share + alpha-beta time of every collective the layout
+    implies + per-chip memory. placement (axis->'ici'/'dcn', from the
+    mapper) routes each axis's collective over the right link class."""
+    cluster = cluster or ClusterSpec()
+    comp = CompCostModel(cluster)
+    vols = partition_comm_volumes(model, dp, sp, sh, mp)
+
+    t_comp = comp.matmul_time(model.step_flops / (dp * sp * sh * mp))
+    t_comm = {}
+    for axis, v in vols.items():
+        if not v["bytes"]:
+            t_comm[axis] = 0.0
+            continue
+        comm = CommCostModel(
+            cluster, over_dcn=(placement or {}).get(axis) == "dcn")
+        fn = {"all_reduce": comm.all_reduce, "all_to_all": comm.all_to_all,
+              "all_gather+reduce_scatter":
+                  lambda b, n: comm.all_gather(b / 2, n)
+                  + comm.reduce_scatter(b / 2, n)}[v["collective"]]
+        t_comm[axis] = v["count"] * fn(v["bytes"], v["group"])
+
+    # memory: params+grads replicated over mp (and sh for ZeRO-3-ish slot
+    # sharding), opt slots over mp*sh; activations over every batch/seq axis
+    # (x8: the ~per-layer stash of h, qkv, attn, mlp intermediates)
+    per_chip = (model.param_bytes * 2 / (mp * sh)
+                + model.param_bytes * model.opt_slots / (mp * sh)
+                + 8.0 * model.layers * model.act_layer_bytes
+                / (dp * sp * sh * mp))
+    return {"dp": dp, "sp": sp, "sharding": sh, "mp": mp,
+            "time": t_comp + sum(t_comm.values()),
+            "t_comp": t_comp, "t_comm": t_comm,
+            "comm_volumes": vols, "per_chip_bytes": per_chip}
