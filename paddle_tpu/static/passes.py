@@ -175,70 +175,30 @@ class FuseGemmEpiloguePass(PassBase):
     """
 
     def _apply_impl(self, main_program, startup_program, context):
-        n_fused = 0
+        n = [0]
         for block in main_program.blocks:
-            counts = _use_counts(block)
-            out_of = {}
-            for op in block.ops:
-                for o in op.outputs:
-                    out_of[id(o)] = op
-            ops = block.ops
-            i = 0
-            new_ops = []
-            consumed = set()
-            emit_at = {}  # id(last part) -> fused Operator
-            while i < len(ops):
-                op = ops[i]
-                if id(op) in consumed:
-                    i += 1
-                    continue
-                if id(op) in emit_at:
-                    # the fused op is emitted at the LAST fused part's
-                    # position so every pulled-in operand (e.g. a bias
-                    # produced between the matmul and the add) is already
-                    # defined by the time the fused op runs
-                    new_ops.append(emit_at.pop(id(op)))
-                    i += 1
-                    continue
-                chain = self._match(ops, i, counts)
-                if chain is not None:
-                    # refuse a chain whose add/act is already claimed by an
-                    # earlier chain (z = matmul(a,b) + matmul(c,d): both
-                    # matmuls match the shared add; only the first may fuse)
-                    mm_, add_, act_ = chain
-                    taken = [add_] + ([act_] if act_ else [])
-                    if any(id(p) in consumed or id(p) in emit_at
-                           for p in taken):
-                        chain = None
-                if chain is None:
-                    new_ops.append(op)
-                    i += 1
-                    continue
-                mm, add, act = chain
-                parts = [mm, add] + ([act] if act else [])
-                mm_pos = next(
-                    j for j, t in enumerate(add.inputs)
-                    if isinstance(t, Variable) and id(t) == id(mm.outputs[0])
-                )
-                fused_fn = self._compose(mm, add, act, mm_pos)
-                fused_inputs = list(mm.inputs) + [
-                    t for j, t in enumerate(add.inputs) if j != mm_pos
-                ]
-                last = parts[-1]
-                fused = Operator(
-                    "fused_gemm_epilogue", fused_fn, fused_inputs,
-                    last.outputs,
-                    attrs={"epilogue": (act.type if act else "bias"),
-                           "fused_from": [p.type for p in parts]},
-                    op_role=mm.op_role,
-                )
-                emit_at[id(last)] = fused
-                for p in parts[1:-1]:
-                    consumed.add(id(p))
-                n_fused += 1
-                i += 1
-            block.ops = [o for o in new_ops]
-        context.attrs["fused_gemm_epilogue"] = n_fused
+            _rewrite_chains(block, self._match, "fused_gemm_epilogue",
+                            _use_counts(block), n, make_op=self._make_op)
+        context.attrs["fused_gemm_epilogue"] = n[0]
+
+    @staticmethod
+    def _make_op(parts):
+        mm, add = parts[0], parts[1]
+        act = parts[2] if len(parts) > 2 else None
+        mm_pos = next(
+            j for j, t in enumerate(add.inputs)
+            if isinstance(t, Variable) and id(t) == id(mm.outputs[0])
+        )
+        fused_fn = FuseGemmEpiloguePass._compose(mm, add, act, mm_pos)
+        fused_inputs = list(mm.inputs) + [
+            t for j, t in enumerate(add.inputs) if j != mm_pos
+        ]
+        return Operator(
+            "fused_gemm_epilogue", fused_fn, fused_inputs, parts[-1].outputs,
+            attrs={"epilogue": (act.type if act else "bias"),
+                   "fused_from": [p.type for p in parts]},
+            op_role=mm.op_role,
+        )
 
     @staticmethod
     def _match(ops, i, counts):
@@ -264,7 +224,7 @@ class FuseGemmEpiloguePass(PassBase):
             if cand is not None and cand.type.split("/")[-1] in _EPILOGUE_ACTS \
                     and len(cand.outputs) == 1:
                 act = cand
-        return op, nxt, act
+        return [op, nxt] + ([act] if act else [])
 
     @staticmethod
     def _compose(mm, add, act, mm_pos):
@@ -280,6 +240,190 @@ class FuseGemmEpiloguePass(PassBase):
             return y
 
         return fused
+
+
+# ----------------------------------------------- generic chain-pattern fusion
+def _single_consumer(ops, out, counts):
+    """The one op reading `out`, or None if shared/absent."""
+    if counts.get(id(out), 0) != 1:
+        return None
+    return next((o for o in ops
+                 if any(isinstance(t, Variable) and id(t) == id(out)
+                        for t in _flat_inputs(o.inputs))), None)
+
+
+def _compose_chain(parts):
+    """One closure running `parts` in dataflow order. Returns (fn, ext_inputs):
+    fn takes the chain's EXTERNAL inputs flattened in part order; each part's
+    link input (the previous part's output) is threaded internally."""
+    plan = []
+    ext_inputs = []
+    prev_out = None
+    for p in parts:
+        ins = list(p.inputs)
+        link = next((j for j, t in enumerate(ins)
+                     if prev_out is not None and isinstance(t, Variable)
+                     and id(t) == id(prev_out)), None)
+        plan.append((p.fn, link, len(ins)))
+        ext_inputs.extend(t for j, t in enumerate(ins) if j != link)
+        prev_out = p.outputs[0]
+
+    def fused(*flat_ext):
+        it = iter(flat_ext)
+        y = None
+        for fn, link, n_ins in plan:
+            args = [y if j == link else next(it) for j in range(n_ins)]
+            y = fn(*args)
+        return y
+
+    return fused, ext_inputs
+
+
+def _rewrite_chains(block, match_fn, fused_type, counts, n_fused_box,
+                    make_op=None):
+    """The fuse-rewrite loop shared by the pattern passes: fused op emitted at
+    the LAST part's position (all pulled-in operands already defined —
+    round-4 advisor finding on fuse_gemm_epilogue), interior parts dropped,
+    chains claiming an already-consumed part refused. `make_op(parts)`
+    overrides the default generic-compose Operator construction."""
+    ops = block.ops
+    i = 0
+    new_ops = []
+    consumed = set()
+    emit_at = {}
+    while i < len(ops):
+        op = ops[i]
+        if id(op) in consumed:
+            i += 1
+            continue
+        if id(op) in emit_at:
+            new_ops.append(emit_at.pop(id(op)))
+            i += 1
+            continue
+        parts = match_fn(ops, i, counts)
+        if parts is not None and any(
+                id(p) in consumed or id(p) in emit_at for p in parts[1:]):
+            parts = None
+        if parts is None:
+            new_ops.append(op)
+            i += 1
+            continue
+        last = parts[-1]
+        if make_op is not None:
+            fused = make_op(parts)
+        else:
+            fused_fn, ext_inputs = _compose_chain(parts)
+            fused = Operator(
+                fused_type, fused_fn, ext_inputs, last.outputs,
+                attrs={"fused_from": [p.type for p in parts]},
+                op_role=parts[0].op_role,
+            )
+        emit_at[id(last)] = fused
+        for p in parts[1:-1]:
+            consumed.add(id(p))
+        n_fused_box[0] += 1
+        i += 1
+    block.ops = list(new_ops)
+
+
+_MATMUL_TYPES = {"matmul", "matmul_v2", "bmm", "mul"}
+_SCALE_TYPES = {"scale", "multiply", "elementwise_mul", "divide",
+                "elementwise_div", "truediv", "div"}
+
+
+@register_pass("fuse_attention")
+class FuseAttentionPass(PassBase):
+    """Collapse a hand-rolled attention chain into one `fused_attention` op.
+
+    Pattern: matmul(QK^T) -> [scale]* -> softmax -> [dropout] -> matmul(.V).
+    Reference analog: fused_attention_op.cc / the fuse_multihead_attention
+    inference passes — there one CUDA kernel; here (like fuse_gemm_epilogue)
+    the value is program-level: one tape node for profiler attribution and
+    pass traversal, and loaded .pdmodel programs that hand-roll attention
+    present a single recognizable op. XLA already fuses the HLO chain; the
+    eager path routes native attention through the Pallas flash kernel
+    (nn/functional sdpa), which this pass deliberately does not second-guess
+    — the composed closures preserve the program's exact semantics.
+    """
+
+    def _apply_impl(self, main_program, startup_program, context):
+        n = [0]
+        for block in main_program.blocks:
+            _rewrite_chains(block, self._match, "fused_attention",
+                            _use_counts(block), n)
+        context.attrs["fused_attention"] = n[0]
+
+    @staticmethod
+    def _match(ops, i, counts):
+        op = ops[i]
+        if op.type.split("/")[-1] not in _MATMUL_TYPES or len(op.outputs) != 1:
+            return None
+        parts = [op]
+        cur = op
+        # optional scaling ops between QK^T and softmax
+        for _ in range(2):
+            nxt = _single_consumer(ops, cur.outputs[0], counts)
+            if nxt is not None and nxt.type.split("/")[-1] in _SCALE_TYPES \
+                    and len(nxt.outputs) == 1:
+                parts.append(nxt)
+                cur = nxt
+            else:
+                break
+        sm = _single_consumer(ops, cur.outputs[0], counts)
+        if sm is None or sm.type.split("/")[-1] != "softmax" \
+                or len(sm.outputs) != 1:
+            return None
+        parts.append(sm)
+        cur = sm
+        drop = _single_consumer(ops, cur.outputs[0], counts)
+        if drop is not None and drop.type.split("/")[-1] == "dropout" \
+                and len(drop.outputs) == 1:
+            parts.append(drop)
+            cur = drop
+        av = _single_consumer(ops, cur.outputs[0], counts)
+        if av is None or av.type.split("/")[-1] not in _MATMUL_TYPES \
+                or len(av.outputs) != 1:
+            return None
+        parts.append(av)
+        return parts
+
+
+_FFN_ACTS = {"gelu", "relu", "silu", "swish"}
+
+
+@register_pass("fuse_feedforward")
+class FuseFeedForwardPass(PassBase):
+    """Collapse linear -> activation -> linear into one `fused_feedforward`.
+
+    Reference analog: fused_feedforward_op.cc (one kernel for the transformer
+    FFN block). Same program-level contract as fuse_gemm_epilogue: XLA fuses
+    the HLO; the fused node is for attribution, traversal, and .pdmodel
+    programs exported by frameworks that emit the fused op.
+    """
+
+    def _apply_impl(self, main_program, startup_program, context):
+        n = [0]
+        for block in main_program.blocks:
+            _rewrite_chains(block, self._match, "fused_feedforward",
+                            _use_counts(block), n)
+        context.attrs["fused_feedforward"] = n[0]
+
+    @staticmethod
+    def _match(ops, i, counts):
+        op = ops[i]
+        if op.type.split("/")[-1] not in ("linear", "fused_gemm_epilogue") \
+                or len(op.outputs) != 1:
+            return None
+        act = _single_consumer(ops, op.outputs[0], counts)
+        if act is None or act.type.split("/")[-1] not in _FFN_ACTS \
+                or len(act.outputs) != 1:
+            return None
+        out = _single_consumer(ops, act.outputs[0], counts)
+        if out is None or out.type.split("/")[-1] \
+                not in ("linear", "fused_gemm_epilogue") \
+                or len(out.outputs) != 1:
+            return None
+        return [op, act, out]
 
 
 # ------------------------------------------------- classic IR rewrite passes

@@ -173,6 +173,73 @@ def test_static_amp_namespace():
         paddle.disable_static()
 
 
+def test_fuse_attention_pattern():
+    """fuse_attention: hand-rolled QK^T -> scale -> softmax -> .V collapses
+    to one fused_attention node with identical numerics (reference
+    fused_attention_op contract at the program level)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.static.passes import new_pass
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            q = static.data("aq", [2, 4, 8, 16], "float32")
+            k = static.data("ak", [2, 4, 8, 16], "float32")
+            v = static.data("av", [2, 4, 8, 16], "float32")
+            scores = paddle.matmul(q, k, transpose_y=True) * 0.25
+            probs = paddle.nn.functional.softmax(scores, axis=-1)
+            out = paddle.matmul(probs, v)
+        exe = static.Executor()
+        rng = np.random.RandomState(0)
+        feed = {n: rng.rand(2, 4, 8, 16).astype("float32")
+                for n in ("aq", "ak", "av")}
+        before = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+
+        new_pass("fuse_attention").apply(main)
+        types = [op.type for op in main.global_block.ops]
+        assert "fused_attention" in types, types
+        assert not any(t.split("/")[-1] == "softmax" for t in types)
+        after = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        np.testing.assert_allclose(after, before, rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
+def test_fuse_feedforward_pattern():
+    """fuse_feedforward: linear -> gelu -> linear collapses to one node,
+    numerics preserved (reference fused_feedforward_op)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.static.passes import new_pass
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("ffx", [4, 16], "float32")
+            h = paddle.nn.Linear(16, 64)(x)
+            h = paddle.nn.functional.gelu(h)
+            out = paddle.nn.Linear(64, 16)(h)
+        exe = static.Executor()
+        rng = np.random.RandomState(1)
+        feed = {"ffx": rng.rand(4, 16).astype("float32")}
+        before = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+
+        new_pass("fuse_feedforward").apply(main)
+        types = [op.type for op in main.global_block.ops]
+        assert "fused_feedforward" in types, types
+        after = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        np.testing.assert_allclose(after, before, rtol=1e-6)
+    finally:
+        paddle.disable_static()
+
+
 def test_fp16_guard_region_scoped_o2():
     """reference fp16_utils.py:352 (_need_keep_fp32): with use_fp16_guard,
     ONLY ops inside fp16_guard() cast to fp16 — a numerically fragile op
