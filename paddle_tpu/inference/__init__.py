@@ -46,6 +46,7 @@ class Config:
         self._glog_info = False
         self._device = "tpu"
         self._device_id = 0
+        self._ir_optim = True
 
     def set_model(self, prog_file, params_file=None):
         if prog_file.endswith(".pdmodel"):
@@ -72,7 +73,10 @@ class Config:
         self._glog_info = False
 
     def switch_ir_optim(self, flag=True):
-        pass  # XLA performs all graph optimization
+        # desc-level analysis passes on loaded .pdmodel programs
+        # (delete_dropout / identity_scale / prune — inference/pdmodel.py);
+        # XLA performs the HLO-level optimization either way
+        self._ir_optim = bool(flag)
 
     def enable_tensorrt_engine(self, *a, **k):  # pragma: no cover - parity shim
         pass  # no TRT on TPU; XLA fusion covers this
@@ -116,7 +120,8 @@ class Predictor:
 
         self.config = config
         prog, feed_names, fetch_names = load_inference_model(
-            config.prog_prefix, params_file=config.params_file)
+            config.prog_prefix, params_file=config.params_file,
+            ir_optim=config._ir_optim)
         self._prog = prog
         self._inputs = {n: Tensor(n, s, d) for n, s, d in zip(
             feed_names, prog._meta["feed_shapes"], prog._meta["feed_dtypes"])}

@@ -838,8 +838,10 @@ class PdModelProgram:
     static.io's own loader does.
     """
 
-    def __init__(self, program_bytes: bytes, params_bytes: bytes | None):
+    def __init__(self, program_bytes: bytes, params_bytes: bytes | None,
+                 ir_optim: bool = True):
         self.desc = parse_program_desc(program_bytes)
+        self._ir_optim = ir_optim
         block = self.desc["blocks"][0]
         self.ops = [op for op in block["ops"]
                     if op["type"] not in ("feed", "fetch")]
@@ -875,6 +877,13 @@ class PdModelProgram:
             "while": self._op_while,
             "conditional_block": self._op_conditional_block,
         })
+        self._fetch_resolved = list(self.fetch_names)
+        self.pass_stats = {}
+        if ir_optim:
+            self.ops, self._fetch_resolved, self.pass_stats = \
+                apply_inference_passes(
+                    self.ops, self.fetch_names,
+                    live_names=set(self.feed_names) | set(self.param_names))
 
     def _run_ops(self, ops, env, op_map):
         for op in ops:
@@ -973,7 +982,8 @@ class PdModelProgram:
         env = {n: jnp.asarray(v) for n, v in self.params.items()}
         env.update(feed_arrays)
         env = self._run_ops(self.ops, env, self._op_map)
-        return [env[n] for n in self.fetch_names]
+        fetch = getattr(self, "_fetch_resolved", self.fetch_names)
+        return [env[n] for n in fetch]
 
     def run(self, feed: dict):
         import jax
@@ -986,8 +996,91 @@ class PdModelProgram:
         return self._jitted({k: np.asarray(v) for k, v in feed.items()})
 
 
-def load_pdmodel(path_prefix: str, params_file: str | None = None
-                 ) -> PdModelProgram:
+# ----------------------------------------------------- inference IR passes
+_CONTROL_FLOW_OPS = {"while", "conditional_block", "select_input",
+                     "select_output"}
+
+
+def apply_inference_passes(ops: list, fetch_names: list,
+                           live_names: set | None = None) -> tuple:
+    """Analysis passes over the desc-level op list, the reference
+    analysis_predictor contract (analysis_predictor.cc PrepareProgram ->
+    inference/analysis pass registry) restated for this loader:
+
+    - delete_dropout (delete_dropout_op_pass): inference-mode dropout that
+      is an identity (upscale_in_train, or prob 0) becomes a var alias;
+      downgrade_in_infer keeps its scale semantics via the op lowering.
+    - identity_scale (identity_scale_op_clean_pass): scale(x, 1.0, 0.0)
+      and assign become aliases.
+    - prune (graph clean / Executor prune): drop ops whose outputs nothing
+      reads, walking back from the fetch set.
+
+    Programs with control flow are left untouched (sub-blocks read parent
+    vars the block-0 graph cannot see — rewriting would orphan them); the
+    stats record the skip. Returns (new_ops, resolved_fetch_names, stats).
+    """
+    stats = {"delete_dropout": 0, "identity_scale": 0, "pruned": 0}
+    if any(op["type"] in _CONTROL_FLOW_OPS for op in ops):
+        stats["skipped"] = "control-flow program"
+        return ops, list(fetch_names), stats
+    # Name-level alias folding and pruning are only sound on SSA-shaped
+    # programs. Paddle's inference inplace passes may emit var-name REUSE
+    # (an op writing a name that was already read/written — e.g.
+    # relu(X=[x])->Out=[x]); folding across a rewrite silently changes
+    # numerics. Detect any output name that was already live and bail.
+    live: set = set(live_names or ())  # feeds + params start live
+    for op in ops:
+        ins = [n for ns in op["inputs"].values() for n in ns]
+        outs = [n for ns in op["outputs"].values() for n in ns]
+        if any(o in live or o in ins for o in outs):
+            stats["skipped"] = "in-place var-name reuse"
+            return ops, list(fetch_names), stats
+        live.update(ins)
+        live.update(outs)
+
+    alias: dict = {}
+    kept = []
+    for op in ops:
+        ins = {slot: [alias.get(n, n) for n in names]
+               for slot, names in op["inputs"].items()}
+        op = dict(op, inputs=ins)
+        t = op["type"]
+        a = op.get("attrs") or {}
+        if t == "dropout":
+            impl = a.get("dropout_implementation") or "downgrade_in_infer"
+            prob = _attr_or(a, "dropout_prob", 0.5)
+            if impl == "upscale_in_train" or not prob:
+                alias[op["outputs"]["Out"][0]] = ins["X"][0]
+                stats["delete_dropout"] += 1
+                continue
+        if t == "scale" and float(_attr_or(a, "scale", 1.0)) == 1.0 \
+                and float(_attr_or(a, "bias", 0.0)) == 0.0:
+            alias[op["outputs"]["Out"][0]] = ins["X"][0]
+            stats["identity_scale"] += 1
+            continue
+        if t == "assign":
+            alias[op["outputs"]["Out"][0]] = ins["X"][0]
+            stats["identity_scale"] += 1
+            continue
+        kept.append(op)
+
+    resolved = [alias.get(n, n) for n in fetch_names]
+    needed = set(resolved)
+    pruned = []
+    for op in reversed(kept):
+        outs = [n for ns in op["outputs"].values() for n in ns]
+        if any(o in needed for o in outs):
+            pruned.append(op)
+            for ns in op["inputs"].values():
+                needed.update(ns)
+        else:
+            stats["pruned"] += 1
+    pruned.reverse()
+    return pruned, resolved, stats
+
+
+def load_pdmodel(path_prefix: str, params_file: str | None = None,
+                 ir_optim: bool = True) -> PdModelProgram:
     """Load `<prefix>.pdmodel` with params from `params_file` (explicit
     path, e.g. a `__params__` layout) or `<prefix>.pdiparams`."""
     with open(path_prefix + ".pdmodel", "rb") as f:
@@ -999,7 +1092,7 @@ def load_pdmodel(path_prefix: str, params_file: str | None = None
     if os.path.exists(params_path):
         with open(params_path, "rb") as f:
             params = f.read()
-    model = PdModelProgram(prog, params)
+    model = PdModelProgram(prog, params, ir_optim=ir_optim)
     if params is None and model.param_names:
         raise FileNotFoundError(
             f"{params_path} not found but the program has "

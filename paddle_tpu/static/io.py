@@ -168,7 +168,8 @@ def load_inference_model(path_prefix, executor=None, **kwargs):
         from ..inference.pdmodel import load_pdmodel
 
         prog = _LoadedPdModelProgram(load_pdmodel(
-            path_prefix, params_file=kwargs.get("params_file")))
+            path_prefix, params_file=kwargs.get("params_file"),
+            ir_optim=kwargs.get("ir_optim", True)))
         return prog, prog.feed_names, prog.fetch_names
     with open(path_prefix + ".pdmodel", "rb") as f:
         meta = pickle.load(f)

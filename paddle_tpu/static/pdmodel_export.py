@@ -642,10 +642,25 @@ def serialize_program_desc(program, feed_vars, fetch_vars):
                 f"(supported: {sorted(_EMITTERS)}); export via the StableHLO "
                 "path (static/io.py save_inference_model) instead")
         op_descs.extend(emit(op, ctx))
+    produced = {v.name for v in feed_vars}
+    for d in op_descs:
+        for names in d["outputs"].values():
+            produced.update(names)
+    produced.update(p[0] for p in ctx.params)
     for i, v in enumerate(fetch_vars):
         # ctx.name_of, not v.name: a pass may have aliased the fetch var to
         # a folded constant or a CSE-shared source
-        op_descs.append({"type": "fetch", "inputs": {"X": [ctx.name_of(v)]},
+        src = ctx.name_of(v)
+        if src not in produced:
+            # classic footgun: save_inference_model called OUTSIDE the
+            # program_guard that built the net exports the (empty) default
+            # program — the artifact would load but fail at first run
+            raise ValueError(
+                f"fetch var {src!r} is not produced by any exported op — "
+                "the program being exported does not contain the graph that "
+                "computes it (did you call save_inference_model outside the "
+                "program_guard, or pass the wrong program?)")
+        op_descs.append({"type": "fetch", "inputs": {"X": [src]},
                          "outputs": {"Out": ["fetch"]}, "attrs": {"col": i}})
 
     vars_bytes = [

@@ -186,6 +186,111 @@ def test_pdmodel_mlp_runs_and_matches_numpy(tmp_path):
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
 
 
+def test_pdmodel_inference_passes(tmp_path):
+    """Analysis passes on loaded programs (reference analysis_predictor's
+    pass-then-run contract): inference-identity dropout and scale(1,0)/
+    assign fold to aliases, unread ops prune, numerics identical with
+    ir_optim on/off."""
+    from paddle_tpu.inference.pdmodel import load_pdmodel
+
+    rng = np.random.RandomState(7)
+    w = rng.randn(8, 4).astype(np.float32) * 0.3
+
+    vars_ = [
+        _var("feed", [], False, vtype=9),
+        _var("fetch", [], False, vtype=10),
+        _var("x", [-1, 8], False),
+        _var("w", list(w.shape), True),
+        _var("d0", [-1, 8], False), _var("m0", [-1, 8], False),
+        _var("h0", [-1, 4], False), _var("s0", [-1, 4], False),
+        _var("a0", [-1, 4], False), _var("dead", [-1, 4], False),
+        _var("out", [-1, 4], False),
+    ]
+    ops = [
+        _op("feed", [("X", ["feed"])], [("Out", ["x"])], [("col", 0, 0)]),
+        _op("dropout", [("X", ["x"])], [("Out", ["d0"]), ("Mask", ["m0"])],
+            [("dropout_prob", 1, 0.3),
+             ("dropout_implementation", 2, "upscale_in_train"),
+             ("is_test", 6, True)]),
+        _op("mul", [("X", ["d0"]), ("Y", ["w"])], [("Out", ["h0"])]),
+        _op("scale", [("X", ["h0"])], [("Out", ["s0"])],
+            [("scale", 1, 1.0), ("bias", 1, 0.0)]),
+        _op("assign", [("X", ["s0"])], [("Out", ["a0"])]),
+        _op("relu", [("X", ["h0"])], [("Out", ["dead"])]),  # unread
+        _op("softmax", [("X", ["a0"])], [("Out", ["out"])],
+            [("axis", 0, (1 << 64) - 1)]),
+        _op("fetch", [("X", ["out"])], [("Out", ["fetch"])], [("col", 0, 0)]),
+    ]
+    prefix = str(tmp_path / "passes")
+    with open(prefix + ".pdmodel", "wb") as f:
+        f.write(_program(_block(vars_, ops)))
+    with open(prefix + ".pdiparams", "wb") as f:
+        save_binary_tensor(f, w)
+
+    opt = load_pdmodel(prefix, ir_optim=True)
+    raw = load_pdmodel(prefix, ir_optim=False)
+    assert opt.pass_stats["delete_dropout"] == 1
+    assert opt.pass_stats["identity_scale"] == 2  # scale(1,0) + assign
+    assert opt.pass_stats["pruned"] == 1
+    assert len(opt.ops) == len(raw.ops) - 4
+    x = rng.rand(5, 8).astype(np.float32)
+    (o1,) = opt.run({"x": x})
+    (o2,) = raw.run({"x": x})
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-6)
+
+    # control-flow programs are conservatively skipped
+    from paddle_tpu.inference.pdmodel import apply_inference_passes
+
+    cf_ops = [{"type": "while", "inputs": {"X": ["a"]},
+               "outputs": {"Out": ["b"]}, "attrs": {}}]
+    same, fetch, stats = apply_inference_passes(cf_ops, ["b"])
+    assert same is cf_ops and stats.get("skipped")
+
+    # in-place var-name reuse (Paddle inference inplace passes emit it):
+    # folding assign(x->y) then rewriting x would change add(y, x) to
+    # add(x, x) — the passes must refuse the whole program
+    reuse_ops = [
+        {"type": "assign", "inputs": {"X": ["x"]},
+         "outputs": {"Out": ["y"]}, "attrs": {}},
+        {"type": "relu", "inputs": {"X": ["x"]},
+         "outputs": {"Out": ["x"]}, "attrs": {}},
+        {"type": "elementwise_add", "inputs": {"X": ["y"], "Y": ["x"]},
+         "outputs": {"Out": ["out"]}, "attrs": {}},
+    ]
+    same2, fetch2, stats2 = apply_inference_passes(
+        reuse_ops, ["out"], live_names={"x"})
+    assert same2 is reuse_ops and stats2.get("skipped") == \
+        "in-place var-name reuse"
+    # a feed overwritten before any read is also reuse
+    feed_clobber = [{"type": "relu", "inputs": {"X": ["z"]},
+                     "outputs": {"Out": ["x"]}, "attrs": {}}]
+    _, _, stats3 = apply_inference_passes(
+        feed_clobber, ["x"], live_names={"x", "z"})
+    assert stats3.get("skipped") == "in-place var-name reuse"
+
+
+def test_pdmodel_export_refuses_disconnected_fetch(tmp_path):
+    """save_inference_model called outside the program_guard that built the
+    net exports the EMPTY default program — the exporter must refuse (the
+    artifact would load fine and fail at first run)."""
+    from paddle_tpu import nn, static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("dx", [2, 4], "float32")
+            y = nn.functional.relu(nn.Linear(4, 3)(x))
+            exe = static.Executor()
+            exe.run(startup)
+        # OUTSIDE the guard: default program does not contain the graph
+        with pytest.raises(ValueError, match="not produced by any exported"):
+            static.save_inference_model(str(tmp_path / "oops"), [x], [y],
+                                        exe, program_format="pdmodel")
+    finally:
+        paddle.disable_static()
+
+
 def test_pdmodel_cnn_ops_match_torch(tmp_path):
     torch = pytest.importorskip("torch")
     rng = np.random.RandomState(3)
