@@ -13,12 +13,20 @@ completion, partitioner, reshard, planner, engine). TPU-native mapping:
 - reshard.py             → Resharder / reshard(): one placement op; XLA emits
                            the implied collectives (all-gather/all-to-all/ICI
                            transfer)
-- planner + cost model   → plan_mesh() with an alpha-beta ICI cost model
+- cluster.py             → Cluster: device table × hosts × chips, ICI/DCN
+                           link classes, reference-schema JSON
+- mapper.py              → map_mesh/build_process_mesh: heaviest-comm axis
+                           innermost (ICI), lightest across hosts (DCN)
+- planner + cost model   → plan_parallel(): dp×sp×sharding×mp search scored
+                           by ModelDesc comm volumes + alpha-beta link model
+                           (plan_mesh kept for the 3-axis legacy entry)
 - Engine                 → plan + complete + partition + compile one pjit train
                            step; fit/evaluate/predict/save/load
 """
+from .cluster import Cluster, cpu_test_cluster
 from .completion import complete, complete_param_specs
-from .cost_model import ClusterSpec, CommCostModel, CompCostModel
+from .cost_model import (ClusterSpec, CommCostModel, CompCostModel, ModelDesc,
+                         estimate_partition, partition_comm_volumes)
 from .engine import Engine
 from .interface import (
     TensorDistAttr,
@@ -26,14 +34,17 @@ from .interface import (
     shard_op,
     shard_tensor,
 )
+from .mapper import build_process_mesh, map_mesh
 from .partitioner import Partitioner
-from .planner import plan_mesh
+from .planner import Plan, plan_mesh, plan_parallel
 from .process_mesh import ProcessMesh
 from .reshard import Resharder, needs_reshard, reshard
 
 __all__ = [
     "ProcessMesh", "shard_tensor", "shard_op", "reshard", "dist_attr",
     "TensorDistAttr", "complete", "complete_param_specs", "Partitioner",
-    "Resharder", "needs_reshard", "plan_mesh", "Engine", "ClusterSpec",
-    "CommCostModel", "CompCostModel",
+    "Resharder", "needs_reshard", "plan_mesh", "plan_parallel", "Plan",
+    "Engine", "ClusterSpec", "CommCostModel", "CompCostModel", "ModelDesc",
+    "estimate_partition", "partition_comm_volumes", "Cluster",
+    "cpu_test_cluster", "map_mesh", "build_process_mesh",
 ]

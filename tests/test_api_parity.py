@@ -270,6 +270,7 @@ def test_layers_wrap_functionals():
     assert ((v == 0) | (v > 0.5)).all()
 
 
+@pytest.mark.slow
 def test_sparse_layers():
     import paddle_tpu.sparse as sp
 

@@ -135,6 +135,7 @@ def test_vision_transform_classes_run():
     assert T.pad(img, 2).shape == (3, 20, 20)
 
 
+@pytest.mark.slow
 def test_voc2012_and_vgg_variants():
     from paddle_tpu.vision.datasets import VOC2012
     from paddle_tpu.vision.models import vgg11, vgg13

@@ -27,6 +27,7 @@ def test_mask_2_4_pattern():
     assert mask[0].tolist() == [False, False, True, True]
 
 
+@pytest.mark.slow
 def test_asp_training_loop_preserves_sparsity():
     paddle.seed(77)
     model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))

@@ -218,6 +218,7 @@ def test_old_private_format_still_loads(tmp_path):
     np.testing.assert_array_equal(back["x"].numpy(), np.ones(3, np.float32))
 
 
+@pytest.mark.slow
 def test_gpt_checkpoint_reference_format(tmp_path):
     """End-to-end: GPT weights exported in the reference layout reload into a
     fresh model with identical logits."""

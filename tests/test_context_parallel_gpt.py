@@ -81,6 +81,7 @@ def test_context_parallel_matches_single_device():
     assert got[-1] < got[0], "loss should decrease"
 
 
+@pytest.mark.slow
 def test_context_parallel_uneven_ignore_index_padding():
     """Padding (ignore_index=-100) clustered at sequence tails gives shards
     unequal valid-token counts; the weighted cross-shard mean must still match

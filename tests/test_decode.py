@@ -59,6 +59,7 @@ def test_beam_search_finds_higher_scoring_path_than_greedy():
     assert int(np.asarray(lengths._value)[0, 0]) == 2
 
 
+@pytest.mark.slow
 def test_beam_search_seq2seq_with_lstm_cell_runs_and_terminates():
     paddle.seed(0)
     vocab, hidden, beam = 17, 16, 4

@@ -78,6 +78,7 @@ def test_stick_breaking_simplex():
                                x, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_kl_divergence_closed_forms_vs_monte_carlo():
     """New KL pairs validated against Monte-Carlo estimates (reference kl.py
     register table)."""

@@ -160,6 +160,7 @@ def test_group_sharded_parallel_api():
     assert np.isfinite(l0)
 
 
+@pytest.mark.slow
 def test_pipeline_parallel_1f1b():
     f = _reset_fleet()
     strategy = fleet.DistributedStrategy()

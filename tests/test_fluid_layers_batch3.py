@@ -193,6 +193,7 @@ def test_edit_distance_and_ctc_decode():
     assert int(lens.numpy()[0]) == 2
 
 
+@pytest.mark.slow
 def test_rnn_api_tail():
     x = paddle.to_tensor(np.random.rand(2, 5, 8).astype("float32"))
     out = L.dynamic_gru(x, 16)

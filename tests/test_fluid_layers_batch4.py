@@ -14,6 +14,7 @@ def _t(a):
     return paddle.to_tensor(np.asarray(a, "float32"))
 
 
+@pytest.mark.slow
 def test_basic_decoder_greedy_roundtrip():
     """GreedyEmbeddingHelper + BasicDecoder + dynamic_decode produce
     end-token-terminated sequences."""
@@ -185,6 +186,7 @@ def test_detection_tail():
     assert tuple(ps.shape) == (1, 2, 2, 2)
 
 
+@pytest.mark.slow
 def test_ssd_and_yolo_losses_finite():
     paddle.seed(0)
     loc = _t(np.random.rand(4, 4) * 0.1)
@@ -333,6 +335,7 @@ def test_host_ops_fail_loudly_in_static_mode():
         paddle.disable_static()
 
 
+@pytest.mark.slow
 def test_roi_perspective_transform_identity_and_crop():
     """Homography warp: identity quad reproduces the image; half-width quad
     samples the left half (reference roi_perspective_transform_op)."""

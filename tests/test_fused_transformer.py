@@ -116,6 +116,7 @@ def test_fused_feedforward_matches_composition(pre):
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_fused_encoder_layer_trains():
     paddle.seed(4)
     enc = FusedTransformerEncoderLayer(16, 2, 32, dropout_rate=0.0)
@@ -135,6 +136,7 @@ def test_fused_encoder_layer_trains():
     assert len(list(enc.parameters())) == 16  # 8 MHA + 8 FFN
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_stack():
     mt = FusedMultiTransformer(16, 2, 32, num_layers=3)
     mt.eval()
@@ -146,6 +148,7 @@ def test_fused_multi_transformer_stack():
     assert len(list(mt.parameters())) == 36  # 12 groups x 3 layers
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_kv_cache_decode_parity():
     """Incremental decoding with caches must reproduce the full causal
     forward position for position (the generation-serving contract)."""

@@ -18,6 +18,7 @@ def tiny_gpt():
     return m
 
 
+@pytest.mark.slow
 def test_cached_forward_matches_full_forward(tiny_gpt):
     """Prefill + cached one-token steps must reproduce the uncached logits —
     the cache is an optimization, not an approximation."""
@@ -39,6 +40,7 @@ def test_cached_forward_matches_full_forward(tiny_gpt):
         pos += 1
 
 
+@pytest.mark.slow
 def test_greedy_generate_matches_stepwise_argmax(tiny_gpt):
     m = tiny_gpt
     rng = np.random.RandomState(1)

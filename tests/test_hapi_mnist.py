@@ -29,6 +29,7 @@ def test_lenet_mnist_convergence():
     assert res["acc"] > 0.5, res
 
 
+@pytest.mark.slow
 def test_model_save_load(tmp_path):
     model = Model(LeNet())
     opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
@@ -49,6 +50,7 @@ def test_model_save_load(tmp_path):
         assert np.allclose(sd1[k].numpy(), sd2[k].numpy()), k
 
 
+@pytest.mark.slow
 def test_train_batch_reduces_loss():
     model = Model(LeNet())
     opt = paddle.optimizer.Adam(learning_rate=1e-3, parameters=model.parameters())
@@ -59,6 +61,7 @@ def test_train_batch_reduces_loss():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_predict():
     model = Model(LeNet())
     model.prepare(None, None)
@@ -72,6 +75,7 @@ def test_summary():
     assert info["total_params"] > 60000
 
 
+@pytest.mark.slow
 def test_model_fit_in_static_mode():
     """Reference Model dispatches to a StaticGraphAdapter under
     enable_static (hapi/model.py:248); here the whole-step jit IS the

@@ -44,6 +44,7 @@ def test_splash_causal_rectangular_bottom_right_aligned():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_splash_grad_matches_reference():
     b, h, s, d = 1, 1, 256, 128
     q, k, v = _qkv(b, h, s, s, d, seed=2)

@@ -38,6 +38,7 @@ def test_eager_params_unaffected():
     assert lin.lazy_materialize() == 0
 
 
+@pytest.mark.slow
 def test_hybrid_init_materializes_meta_model_sharded():
     import jax
     from jax.sharding import Mesh

@@ -22,6 +22,7 @@ def _build(seed=13):
     return model, cfg, params
 
 
+@pytest.mark.slow
 def test_single_step_grads_match_numpy():
     """The numpy backward is validated against the framework's autodiff on one
     step — every parameter's gradient, not just the loss."""

@@ -10,6 +10,7 @@ from paddle_tpu import nn
 
 
 # ------------------------------------------------------------ memory surface
+@pytest.mark.slow
 def test_memory_stats_surface():
     from paddle_tpu.core import memory
 

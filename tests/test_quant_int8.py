@@ -35,6 +35,7 @@ def _calib_batches(n=4, bs=4):
             for _ in range(n)]
 
 
+@pytest.mark.slow
 def test_int8_execution_accuracy_and_size():
     model = _small_convnet()
     model.eval()
@@ -64,6 +65,7 @@ def test_int8_execution_accuracy_and_size():
     assert int8_bytes > 0
 
 
+@pytest.mark.slow
 def test_int8_dot_actually_int8():
     import jax
 

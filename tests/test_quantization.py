@@ -60,6 +60,7 @@ def test_imperative_qat_swaps_and_trains():
     assert float(np.asarray(net.fc._a_quant.scale._value)) > 0
 
 
+@pytest.mark.slow
 def test_qat_save_quantized_model(tmp_path):
     paddle.seed(12)
     net = SmallNet()

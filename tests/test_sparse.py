@@ -32,6 +32,7 @@ def test_coo_tensor_is_lazy():
     np.testing.assert_allclose(t.to_dense().numpy(), dense)
 
 
+@pytest.mark.slow
 def test_coo_matmul_and_ops():
     rng = np.random.RandomState(0)
     dense = np.where(rng.rand(16, 8) > 0.7, rng.randn(16, 8), 0).astype(np.float32)

@@ -29,6 +29,7 @@ def test_program_build_and_run():
     assert np.allclose(res[1], xv @ w.numpy(), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_static_layers():
     main = static.Program()
     with static.program_guard(main):

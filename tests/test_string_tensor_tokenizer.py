@@ -74,6 +74,7 @@ def test_faster_tokenizer_truncation_and_padding():
     assert row[0] == VOCAB["[CLS]"] and row[-1] == VOCAB["[SEP]"]
 
 
+@pytest.mark.slow
 def test_faster_tokenizer_layer_feeds_bert():
     from paddle_tpu.text import BertModel
     from paddle_tpu.text.bert import BertConfig

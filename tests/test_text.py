@@ -87,6 +87,7 @@ def test_dataset_family_structures():
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_ernie_family_forward_and_mlm_training():
     """ERNIE-3.0 family: task-type embeddings flow, classification head, and
     the tied-MLM objective trains (fused chunked CE path)."""

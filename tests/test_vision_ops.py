@@ -27,6 +27,7 @@ def _nms_np(boxes, scores, thresh):
     return np.array(kept)
 
 
+@pytest.mark.slow
 def test_nms_matches_greedy_reference():
     rs = np.random.RandomState(0)
     base = rs.rand(40, 2) * 50
