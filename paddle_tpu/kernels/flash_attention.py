@@ -133,12 +133,37 @@ def _splash_kernel(num_heads: int, s_q: int, s_k: int, d: int | None = None,
     )
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _splash(q, k, v, sm_scale, interpret=False):
+    return _splash_impl(q, k, v, sm_scale, interpret)
+
+
+def _splash_impl(q, k, v, sm_scale, interpret):
     kernel = _splash_kernel(q.shape[1], q.shape[2], k.shape[2], q.shape[3],
                             interpret)
     q = (q * sm_scale).astype(q.dtype)
     with jax.enable_x64(False):
         return jax.vmap(kernel)(q, k, v)
+
+
+def _splash_fwd(q, k, v, sm_scale, interpret):
+    # own custom_vjp so the BACKWARD pallas kernel also traces under
+    # x64-off: the library kernel's internal vjp otherwise lowers with the
+    # package-global x64 enabled and Mosaic's dtype converter recurses
+    # forever (RecursionError at seq>=2048 — round-5 on-chip longseq A/B)
+    with jax.enable_x64(False):
+        out, vjp = jax.vjp(
+            lambda q, k, v: _splash_impl(q, k, v, sm_scale, interpret),
+            q, k, v)
+    return out, vjp
+
+
+def _splash_bwd(sm_scale, interpret, vjp, g):
+    with jax.enable_x64(False):
+        return vjp(g)
+
+
+_splash.defvjp(_splash_fwd, _splash_bwd)
 
 
 # auto-select threshold: causal tile-skipping halves attention work, but the
