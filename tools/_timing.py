@@ -1,0 +1,55 @@
+"""Honest on-chip micro-benchmark timer, shared by the kernel sweep tools.
+
+Three failure modes this helper exists to defeat (each produced a bogus
+banked measurement in round 5 before being caught):
+
+1. `block_until_ready` over the axon tunnel returns before real execution
+   completes — times came out below the MXU floor. Close every timed rep
+   with a scalar device->host fetch (an honest barrier).
+2. The tunnel RTT (~60 ms) swamps sub-ms kernels. Amortize `inner` calls
+   per fetch with a lax.scan.
+3. With loop-invariant inputs XLA hoists the computation OUT of the scan
+   (LICM) and the loop times (RTT + ONE exec)/inner. Thread the carry into
+   the inputs via a numerically-negligible perturbation, and fold EVERY
+   output into the carry — a gradient that doesn't feed the carry is DCE'd
+   (the dense-flash backward is two pallas kernels; dropping dk/dv silently
+   removes one of them from the measurement).
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def time_grad_fn(loss_fn, args, iters: int = 5, inner: int = 40) -> float:
+    """Median seconds per fwd+bwd of `loss_fn(*args)` (argnums = all args).
+
+    loss_fn must return a scalar; args are arrays. Returns median over
+    `iters` reps of `inner` amortized calls each.
+    """
+    n = len(args)
+
+    def many(*args):
+        def body(acc, _):
+            perturbed = [
+                (a.astype(jnp.float32) * (1.0 + acc * 1e-30)).astype(a.dtype)
+                for a in args
+            ]
+            grads = jax.grad(loss_fn, argnums=tuple(range(n)))(*perturbed)
+            live = sum(jnp.sum(g.astype(jnp.float32)) for g in grads)
+            return acc + live * 1e-30, None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=inner)
+        return acc
+
+    step = jax.jit(many)
+    float(np.asarray(step(*args)))  # compile + warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        float(np.asarray(step(*args)))
+        ts.append((time.perf_counter() - t0) / inner)
+    return float(np.median(ts))

@@ -31,44 +31,17 @@ CANDIDATES = [128, 256, 512, 1024]
 
 
 def _time_config(q, k, v, block):
-    import jax
+    import jax.numpy as jnp
 
+    from _timing import time_grad_fn
     from paddle_tpu.kernels import flash_attention as fa
 
     fa._TUNED = {f"{q.shape[2]},{q.shape[3]}": block}
 
     def loss(q, k, v):
-        import jax.numpy as jnp
-
         return jnp.sum(fa._flash(q, k, v, True, 0.125).astype(jnp.float32))
 
-    # INNER calls per timed rep + a scalar host fetch to close the async
-    # pipeline: block_until_ready alone returned times below the MXU floor
-    # over the axon tunnel (0.06 ms for a >=0.5 ms computation), so winners
-    # were dispatch noise. A device->host readback is an honest barrier;
-    # amortizing INNER launches per fetch keeps the tunnel RTT out of the
-    # per-call number (same structure as bench.py's timed loop).
-    # 40 inner steps: the tunnel RTT is ~60 ms, so at 10 the constant ~6 ms
-    # share swamped the ~0.5-1 ms kernel deltas at seq 1024
-    INNER = 40
-
-    def many(q, k, v):
-        import jax.numpy as jnp
-
-        def body(acc, _):
-            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-            return acc + jnp.float32(jnp.sum(dq.astype(jnp.float32))), None
-        acc, _ = jax.lax.scan(body, jnp.float32(0.0), None, length=INNER)
-        return acc
-
-    g = jax.jit(many)
-    float(np.asarray(g(q, k, v)))  # compile + warm
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        float(np.asarray(g(q, k, v)))
-        times.append((time.perf_counter() - t0) / INNER)
-    return float(np.median(times))
+    return time_grad_fn(loss, (q, k, v), iters=5, inner=40)
 
 
 def main():

@@ -29,20 +29,15 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _bench_attn(kernel, q, k, v, iters=8):
+def _bench_attn(kernel, q, k, v, iters=5, inner=40):
+    """Full fwd+bwd timing via the shared hoisting/DCE-proof timer
+    (tools/_timing.py — all three grads live, host-fetch barrier)."""
+    from _timing import time_grad_fn
+
     def loss(q, k, v):
         return jnp.sum(kernel(q, k, v).astype(jnp.float32))
 
-    step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-    g = step(q, k, v)
-    jax.block_until_ready(g)
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        g = step(q, k, v)
-        jax.block_until_ready(g)
-        ts.append(time.perf_counter() - t0)
-    return float(np.median(ts))
+    return time_grad_fn(loss, (q, k, v), iters=iters, inner=inner)
 
 
 def main():
