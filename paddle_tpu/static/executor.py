@@ -133,10 +133,22 @@ class Executor:
 
 class CompiledProgram:
     """reference: fluid/compiler.py CompiledProgram/IpuCompiledProgram — on TPU
-    every program is whole-graph compiled, so this is a thin marker."""
+    every program is whole-graph compiled; build_strategy fuse flags apply
+    the matching registered pattern passes before compilation (the rest of
+    the reference's knobs are XLA-subsumed and accepted-only)."""
 
     def __init__(self, program, build_strategy=None):
         self.program = program
+        if build_strategy is not None:
+            from .passes import new_pass
+
+            for flag, pass_name in (
+                ("fuse_gemm_epilogue", "fuse_gemm_epilogue"),
+                ("fuse_attention", "fuse_attention"),
+                ("fuse_feedforward", "fuse_feedforward"),
+            ):
+                if getattr(build_strategy, flag, False):
+                    new_pass(pass_name).apply(program)
 
     def __getattr__(self, name):
         return getattr(self.__dict__["program"], name)

@@ -244,6 +244,12 @@ class BuildStrategy:
         self.enable_inplace = True
         self.fuse_elewise_add_act_ops = True
         self.fuse_bn_act_ops = True
+        # program-level pattern fusion (static/passes.py); CompiledProgram
+        # applies the matching registered pass when set (reference
+        # build_strategy.fuse_gemm_epilogue -> fuse_gemm_epilogue_pass.cc)
+        self.fuse_gemm_epilogue = False
+        self.fuse_attention = False
+        self.fuse_feedforward = False
         self.memory_optimize = True
         self.reduce_strategy = 0
         self.gradient_scale_strategy = 0

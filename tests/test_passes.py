@@ -240,6 +240,34 @@ def test_fuse_feedforward_pattern():
         paddle.disable_static()
 
 
+def test_build_strategy_applies_fusion_passes():
+    """reference: build_strategy.fuse_gemm_epilogue -> the pass actually
+    runs when the program is wrapped in CompiledProgram."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("bsx", [4, 8], "float32")
+            out = paddle.nn.functional.relu(
+                paddle.matmul(x, paddle.ones([8, 8])) + 1.0)
+        bs = static.BuildStrategy()
+        bs.fuse_gemm_epilogue = True
+        compiled = static.CompiledProgram(main, build_strategy=bs)
+        assert any(op.type == "fused_gemm_epilogue"
+                   for op in main.global_block.ops)
+        exe = static.Executor()
+        res = exe.run(compiled, feed={"bsx": np.ones((4, 8), "float32")},
+                      fetch_list=[out])[0]
+        np.testing.assert_allclose(np.asarray(res), np.full((4, 8), 9.0))
+    finally:
+        paddle.disable_static()
+
+
 def test_fp16_guard_region_scoped_o2():
     """reference fp16_utils.py:352 (_need_keep_fp32): with use_fp16_guard,
     ONLY ops inside fp16_guard() cast to fp16 — a numerically fragile op
