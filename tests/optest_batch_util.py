@@ -1,0 +1,51 @@
+"""Shared declaration helper for the OpTest batch files.
+
+One place for the subclass factory (was copy-pasted per batch with drifting
+default tolerances). Each batch calls `make_mk(globals())` once and gets an
+`_mk` bound to its own module namespace, optionally overriding the batch's
+default tolerances.
+"""
+import numpy as np
+
+from paddle_tpu.utils.op_test import OpTest
+
+
+def make_mk(module_globals, *, default_atol=1e-6, default_grad_rtol=1e-2,
+            default_grad_atol=1e-4):
+    """Return an `_mk(name, op, inputs_fn, ref, ...)` that declares one
+    OpTest subclass into `module_globals` (keeps the reference subclass
+    protocol while letting a batch state each op in one place)."""
+
+    def _mk(name, op, inputs_fn, ref, attrs=None, grads=(), rtol=None,
+            atol=default_atol, check_static=True,
+            grad_rtol=default_grad_rtol, grad_atol=default_grad_atol):
+        def setUp(self):
+            self.op = op
+            self.inputs = inputs_fn()
+            self.attrs = dict(attrs or {})
+            self.ref = ref
+
+        body = {"setUp": setUp}
+
+        def test_output(self):
+            self.check_output(rtol=rtol, atol=atol,
+                              check_static=check_static)
+
+        body["test_output"] = test_output
+        if grads:
+            def test_grad(self):
+                self.check_grad(list(grads), rtol=grad_rtol, atol=grad_atol)
+
+            body["test_grad"] = test_grad
+        cls = type(name, (OpTest,), body)
+        module_globals[name] = cls
+        return cls
+
+    return _mk
+
+
+def make_f32(rng: np.random.RandomState):
+    def _f32(*shape, lo=-1.0, hi=1.0):
+        return (rng.rand(*shape) * (hi - lo) + lo).astype("float32")
+
+    return _f32

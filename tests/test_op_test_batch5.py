@@ -5,31 +5,10 @@ implementations, independent of the jax lowerings."""
 import numpy as np
 
 import paddle_tpu.nn.functional as F
-from paddle_tpu.utils.op_test import OpTest
+from optest_batch_util import make_mk
 
 
-def _mk(name, op, inputs_fn, ref, attrs=None, grads=(), rtol=None, atol=1e-5,
-        check_static=True, grad_rtol=1e-2, grad_atol=1e-3):
-    def setUp(self):
-        self.op = op
-        self.inputs = inputs_fn()
-        self.attrs = dict(attrs or {})
-        self.ref = ref
-
-    body = {"setUp": setUp}
-
-    def test_output(self):
-        self.check_output(rtol=rtol, atol=atol, check_static=check_static)
-
-    body["test_output"] = test_output
-    if grads:
-        def test_grad(self):
-            self.check_grad(list(grads), rtol=grad_rtol, atol=grad_atol)
-
-        body["test_grad"] = test_grad
-    cls = type(name, (OpTest,), body)
-    globals()[name] = cls
-    return cls
+_mk = make_mk(globals(), default_atol=1e-5, default_grad_atol=1e-3)
 
 
 _r = np.random.RandomState(3)

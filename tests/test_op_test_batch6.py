@@ -1,0 +1,258 @@
+"""OpTest batch 6: norm family (group/instance/LRN), einsum, loss tail,
+triangular/selection, vision-geometry ops (reference test strategy SURVEY
+§4.1, op_test.py protocol: eager + static paths vs numpy reference,
+finite-difference grad checks where differentiable)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from optest_batch_util import make_f32, make_mk
+
+_mk = make_mk(globals())
+_r = np.random.RandomState(11)
+_f32 = make_f32(_r)
+
+
+# ------------------------------------------------------------- norm family
+def _group_norm_ref(x, num_groups, eps=1e-5):
+    n, c, h, w = x.shape
+    g = x.reshape(n, num_groups, c // num_groups, h, w)
+    mu = g.mean(axis=(2, 3, 4), keepdims=True)
+    var = g.var(axis=(2, 3, 4), keepdims=True)
+    return ((g - mu) / np.sqrt(var + eps)).reshape(x.shape)
+
+
+_mk("TestGroupNormOp",
+    lambda x, num_groups: F.group_norm(x, num_groups,
+                                       weight=paddle.ones([8]),
+                                       bias=paddle.zeros([8])),
+    lambda: {"x": _f32(2, 8, 4, 4)},
+    lambda x, num_groups: _group_norm_ref(x, num_groups),
+    attrs={"num_groups": 4}, grads=("x",), atol=1e-5)
+
+
+def _instance_norm_ref(x, eps=1e-5):
+    mu = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    return (x - mu) / np.sqrt(var + eps)
+
+
+_mk("TestInstanceNormOp", F.instance_norm,
+    lambda: {"x": _f32(2, 3, 5, 5)},
+    _instance_norm_ref, grads=("x",), atol=1e-5)
+
+
+def _lrn_ref(x, size=5, alpha=1e-4, beta=0.75, k=1.0):
+    n, c, h, w = x.shape
+    sq = x ** 2
+    acc = np.zeros_like(x)
+    half = size // 2
+    for ci in range(c):
+        lo, hi = max(0, ci - half), min(c, ci + half + 1)
+        acc[:, ci] = sq[:, lo:hi].sum(axis=1)
+    return x / (k + alpha * acc / size) ** beta
+
+
+_mk("TestLocalResponseNormOp", F.local_response_norm,
+    lambda: {"x": _f32(2, 7, 4, 4)},
+    lambda x, size: _lrn_ref(x, size=size),
+    attrs={"size": 5}, atol=1e-5)
+
+
+# ------------------------------------------------------------------ einsum
+_mk("TestEinsumMatmulOp",
+    lambda x, y, equation: paddle.einsum(equation, x, y),
+    lambda: {"x": _f32(3, 4), "y": _f32(4, 5)},
+    lambda x, y, equation: np.einsum(equation, x, y),
+    attrs={"equation": "ij,jk->ik"}, grads=("x", "y"), atol=1e-5)
+
+_mk("TestEinsumBatchTraceOp",
+    lambda x, equation: paddle.einsum(equation, x),
+    lambda: {"x": _f32(4, 5, 5)},
+    lambda x, equation: np.einsum(equation, x),
+    attrs={"equation": "bii->b"}, grads=("x",), atol=1e-5)
+
+
+# ----------------------------------------------------------- triangular etc
+_mk("TestTrilOp", paddle.tril,
+    lambda: {"x": _f32(4, 6)},
+    lambda x, diagonal: np.tril(x, k=diagonal),
+    attrs={"diagonal": -1}, grads=("x",))
+
+_mk("TestTriuOp", paddle.triu,
+    lambda: {"x": _f32(4, 6)},
+    lambda x, diagonal: np.triu(x, k=diagonal),
+    attrs={"diagonal": 1}, grads=("x",))
+
+_mk("TestWhereOp", paddle.where,
+    lambda: {"condition": (_r.rand(4, 5) > 0.5),
+             "x": _f32(4, 5), "y": _f32(4, 5)},
+    lambda condition, x, y: np.where(condition, x, y),
+    grads=("x", "y"))
+
+_mk("TestTileOp", paddle.tile,
+    lambda: {"x": _f32(2, 3)},
+    lambda x, repeat_times: np.tile(x, repeat_times),
+    attrs={"repeat_times": (2, 2)}, grads=("x",))
+
+_mk("TestExpandAsOp", paddle.expand_as,
+    lambda: {"x": _f32(1, 4), "y": _f32(3, 4)},
+    lambda x, y: np.broadcast_to(x, y.shape).copy(),
+    grads=("x",))
+
+_mk("TestStridedSliceOp", paddle.strided_slice,
+    lambda: {"x": _f32(4, 8, 6)},
+    lambda x, axes, starts, ends, strides: x[:, 1:7:2, ::3],
+    attrs={"axes": [1, 2], "starts": [1, 0], "ends": [7, 6],
+           "strides": [2, 3]}, grads=("x",))
+
+_mk("TestHistogramOp", paddle.histogram,
+    lambda: {"input": (_r.rand(100) * 10).astype("float32")},
+    lambda input, bins, min, max: np.histogram(
+        input, bins=bins, range=(min, max))[0].astype("int64"),
+    attrs={"bins": 8, "min": 0, "max": 10})
+
+
+# ---------------------------------------------------------------- loss tail
+_mk("TestCosineSimilarityOp", F.cosine_similarity,
+    lambda: {"x1": _f32(4, 8), "x2": _f32(4, 8)},
+    lambda x1, x2, axis: (x1 * x2).sum(axis) /
+    (np.sqrt((x1 ** 2).sum(axis)) * np.sqrt((x2 ** 2).sum(axis))),
+    attrs={"axis": 1}, grads=("x1", "x2"), atol=1e-5)
+
+
+def _nll_ref(input, label):
+    return -input[np.arange(len(label)), label].mean()
+
+
+_mk("TestNllLossOp", F.nll_loss,
+    lambda: {"input": np.log(_r.rand(6, 4).astype("float32") + 0.1),
+             "label": _r.randint(0, 4, (6,)).astype("int64")},
+    _nll_ref, grads=("input",))
+
+_mk("TestKlDivOp", F.kl_div,
+    lambda: {"input": np.log(_r.rand(4, 5).astype("float32") + 0.1),
+             "label": (_r.rand(4, 5).astype("float32") + 0.1)},
+    lambda input, label: (label * (np.log(label) - input)).mean(),
+    grads=("input",), atol=1e-5)
+
+_mk("TestSmoothL1Op", F.smooth_l1_loss,
+    lambda: {"input": _f32(4, 5, lo=-2, hi=2),
+             "label": _f32(4, 5, lo=-2, hi=2)},
+    lambda input, label: np.where(
+        np.abs(input - label) < 1.0,
+        0.5 * (input - label) ** 2,
+        np.abs(input - label) - 0.5).mean(),
+    grads=("input",), atol=1e-5)
+
+_mk("TestBCEOp", F.binary_cross_entropy,
+    lambda: {"input": (_r.rand(4, 5) * 0.8 + 0.1).astype("float32"),
+             "label": _r.randint(0, 2, (4, 5)).astype("float32")},
+    lambda input, label: (-(label * np.log(input)
+                            + (1 - label) * np.log(1 - input))).mean(),
+    grads=("input",), atol=1e-5)
+
+_mk("TestMarginRankingOp", F.margin_ranking_loss,
+    lambda: {"input": _f32(6), "other": _f32(6),
+             "label": np.sign(_r.randn(6)).astype("float32")},
+    lambda input, other, label: np.maximum(
+        0.0, -label * (input - other)).mean(),
+    grads=("input", "other"))
+
+_mk("TestGluOp", F.glu,
+    lambda: {"x": _f32(4, 8)},
+    lambda x, axis: x[:, :4] / (1.0 + np.exp(-x[:, 4:])),
+    attrs={"axis": 1}, grads=("x",), atol=1e-5)
+
+
+# ------------------------------------------------------------ vision / geom
+def _affine_grid_ref(theta, out_shape, align_corners=True):
+    n, c, h, w = out_shape
+    if align_corners:
+        ys = np.linspace(-1, 1, h)
+        xs = np.linspace(-1, 1, w)
+    else:
+        ys = (np.arange(h) * 2 + 1) / h - 1
+        xs = (np.arange(w) * 2 + 1) / w - 1
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    base = np.stack([gx, gy, np.ones_like(gx)], axis=-1)  # [h, w, 3]
+    out = np.einsum("hwk,njk->nhwj", base, theta)
+    return out.astype("float32")
+
+
+_mk("TestAffineGridOp", F.affine_grid,
+    lambda: {"theta": _f32(2, 2, 3)},
+    lambda theta, out_shape: _affine_grid_ref(theta, out_shape),
+    attrs={"out_shape": [2, 3, 4, 5]}, grads=("theta",), atol=1e-5)
+
+
+def _temporal_shift_ref(x, seg_num, shift_ratio=0.25):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    out = np.zeros_like(x5)
+    out[:, :-1, :fold] = x5[:, 1:, :fold]          # shift left
+    out[:, 1:, fold:2 * fold] = x5[:, :-1, fold:2 * fold]  # shift right
+    out[:, :, 2 * fold:] = x5[:, :, 2 * fold:]
+    return out.reshape(x.shape)
+
+
+_mk("TestTemporalShiftOp", F.temporal_shift,
+    lambda: {"x": _f32(4, 8, 3, 3)},
+    lambda x, seg_num: _temporal_shift_ref(x, seg_num),
+    attrs={"seg_num": 2}, grads=("x",))
+
+
+def _fold_ref(x, output_sizes, kernel_sizes):
+    # x: [n, c*kh*kw, L] -> [n, c, H, W] sum of patches (stride 1, no pad)
+    n, ckk, L = x.shape
+    kh, kw = kernel_sizes
+    c = ckk // (kh * kw)
+    H, W = output_sizes
+    out = np.zeros((n, c, H, W), x.dtype)
+    cols = x.reshape(n, c, kh, kw, L)
+    li = 0
+    for i in range(H - kh + 1):
+        for j in range(W - kw + 1):
+            out[:, :, i:i + kh, j:j + kw] += cols[:, :, :, :, li]
+            li += 1
+    return out
+
+
+_mk("TestFoldOp", F.fold,
+    lambda: {"x": _f32(2, 3 * 2 * 2, 9)},
+    lambda x, output_sizes, kernel_sizes: _fold_ref(
+        x, output_sizes, kernel_sizes),
+    attrs={"output_sizes": [4, 4], "kernel_sizes": [2, 2]},
+    grads=("x",), atol=1e-5)
+
+
+def _unpool_inputs():
+    x = _f32(1, 2, 4, 4)
+    xt = paddle.to_tensor(x)
+    out, idx = F.max_pool2d(xt, 2, stride=2, return_mask=True)
+    return {"x": out.numpy(), "indices": idx.numpy().astype("int64")}
+
+
+def _unpool_ref(x, indices, kernel_size):
+    n, c, h, w = x.shape
+    out = np.zeros((n, c, h * 2, w * 2), x.dtype)
+    flat = out.reshape(n, c, -1)
+    for ni in range(n):
+        for ci in range(c):
+            flat[ni, ci, indices[ni, ci].reshape(-1)] = \
+                x[ni, ci].reshape(-1)
+    return flat.reshape(out.shape)
+
+
+_mk("TestMaxUnpool2dOp", F.max_unpool2d,
+    lambda: _unpool_inputs(),
+    lambda x, indices, kernel_size: _unpool_ref(x, indices, kernel_size),
+    attrs={"kernel_size": 2})
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()

@@ -19,9 +19,14 @@ jax.config.update("jax_platforms", "cpu")
 
 from paddle_tpu.utils.op_test import OpTest  # noqa: E402
 
-BATCHES = ["test_op_test_harness", "test_op_test_batch2",
-           "test_op_test_batch3", "test_op_test_batch4",
-           "test_op_test_batch5"]
+import glob as _glob
+import re as _re
+
+BATCHES = ["test_op_test_harness"] + sorted(
+    (os.path.splitext(os.path.basename(p))[0]
+     for p in _glob.glob(os.path.join(os.path.dirname(__file__), os.pardir,
+                                      "tests", "test_op_test_batch*.py"))),
+    key=lambda n: int(_re.search(r"(\d+)$", n).group(1)))
 
 
 def main():
