@@ -13,7 +13,6 @@ Usage: python tools/flash_autotune.py
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
