@@ -1,0 +1,90 @@
+"""Auto-parallel planning end to end: describe the cluster and the model,
+let the planner pick the dp x sp x sharding x mp split, lay ranks out with
+the mapper, and train on exactly that mesh.
+
+The reference workflow (cluster.json + planner + dist-attr completion)
+collapses to three calls here: Cluster -> ModelDesc -> plan_parallel; GSPMD
+inserts the collectives the plan implies.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+import _common  # noqa: E402,F401
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.auto_parallel import (
+    Cluster, ModelDesc, cpu_test_cluster, plan_parallel)
+
+
+def main():
+    import jax
+    from jax.sharding import Mesh
+
+    from paddle_tpu.core import rng as rng_mod
+    from paddle_tpu.distributed.fleet import (ColumnParallelLinear,
+                                              RowParallelLinear)
+    from paddle_tpu.distributed.fleet.hybrid_train import build_hybrid_step
+
+    n = len(jax.devices())
+
+    # 1. The machine. cpu_test_cluster models this virtual mesh; a real
+    #    deployment would say e.g. Cluster(accelerator_type="v5p",
+    #    n_hosts=16, chips_per_host=4) or Cluster.from_file("cluster.json").
+    cluster = cpu_test_cluster(n)
+
+    # 2. The model, as the seven numbers the cost model needs.
+    desc = ModelDesc(n_params=4_300_000, layers=1, hidden=512, heads=0,
+                     seq=1, batch=8)
+
+    # 3. Plan. Wide-FFN shape -> the planner picks tensor parallelism (the
+    #    dp grad all-reduce of 17 MB params dwarfs mp's tiny activation
+    #    all-reduces); the breakdown says why.
+    plan = plan_parallel(n, desc, cluster)
+    print("plan:", plan.axis_sizes)
+    print("per-axis comm time (ms):",
+          {k: round(v * 1e3, 3) for k, v in plan.t_comm.items()})
+    pm = plan.process_mesh(cluster)
+    print("rank placement:", pm.placement)
+
+    # 4. Train on the planned mesh with the production hybrid step.
+    class FFN(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = ColumnParallelLinear(512, 4096, gather_output=False)
+            self.row = RowParallelLinear(4096, 16, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.row(nn.functional.relu(self.col(x)))
+
+    paddle.seed(0)
+    model = FFN()
+    opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+    mesh = Mesh(np.array(jax.devices()).reshape(
+        plan.dp, plan.sharding, plan.mp), ("dp", "sharding", "mp"))
+    init_fn, step_fn, shard_batch = build_hybrid_step(
+        model, opt, nn.CrossEntropyLoss(), mesh)
+    state = init_fn()
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 512).astype(np.float32)
+    ys = rng.randint(0, 16, (8,)).astype(np.int64)
+    for i in range(6):
+        loss, state = step_fn(state, rng_mod.next_rng_key(), 1e-3,
+                              shard_batch([xs]), shard_batch([ys]))
+        print(f"step {i}: loss {float(loss):.4f}")
+
+    # Contrast: what would a 64-chip v5p pod do for GPT-6.7B? All-dp
+    # replication would blow 95 GB HBM; the plan splits params.
+    big = ModelDesc(n_params=6_700_000_000, layers=32, hidden=4096,
+                    heads=32, seq=2048, batch=64)
+    pod = Cluster(accelerator_type="v5p", n_hosts=16, chips_per_host=4)
+    big_plan = plan_parallel(64, big, pod)
+    print("GPT-6.7B on v5p-64:", big_plan.axis_sizes,
+          f"per-chip {big_plan.per_chip_bytes / 1e9:.1f} GB (HBM 95 GB)")
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,8 @@ def _run(script, extra_env=None, timeout=420):
     ("fleet_hybrid.py",
      {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
     ("fluid_legacy.py", None),
+    ("auto_parallel_plan.py",
+     {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
 ])
 def test_example_runs(script, extra):
     proc = _run(script, extra)
