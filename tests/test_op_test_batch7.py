@@ -1,0 +1,158 @@
+"""OpTest batch 7: linalg family (reference test strategy SURVEY §4.1,
+op_test.py protocol: eager + static paths vs numpy.linalg references,
+finite-difference grad checks where the decomposition is differentiable
+and well-conditioned)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from optest_batch_util import make_f32, make_mk
+
+_mk = make_mk(globals(), default_atol=1e-4)
+_r = np.random.RandomState(13)
+_f32 = make_f32(_r)
+
+
+def _spd(n, batch=()):
+    """Symmetric positive-definite matrix (well-conditioned)."""
+    a = _r.rand(*batch, n, n).astype("float32")
+    return (a @ np.swapaxes(a, -1, -2) + n * np.eye(n, dtype="float32"))
+
+
+_mk("TestCholeskyOp", paddle.linalg.cholesky,
+    lambda: {"x": _spd(4)},
+    lambda x: np.linalg.cholesky(x),
+    grads=("x",), grad_rtol=5e-2, grad_atol=1e-3)
+
+_mk("TestDetOp", paddle.linalg.det,
+    lambda: {"x": _spd(3)},
+    lambda x: np.linalg.det(x).astype("float32"),
+    grads=("x",), rtol=1e-4)
+
+_mk("TestSlogdetOp",
+    lambda x: paddle.linalg.slogdet(x),
+    lambda: {"x": _spd(3)},
+    lambda x: np.stack(np.linalg.slogdet(x)).astype("float32"))
+
+_mk("TestInvOp", paddle.linalg.inv,
+    lambda: {"x": _spd(4)},
+    lambda x: np.linalg.inv(x),
+    grads=("x",), grad_rtol=5e-2, grad_atol=1e-3)
+
+_mk("TestPinvOp", paddle.linalg.pinv,
+    lambda: {"x": _f32(5, 3)},
+    lambda x: np.linalg.pinv(x), rtol=1e-3)
+
+_mk("TestSolveOp", paddle.linalg.solve,
+    lambda: {"x": _spd(4), "y": _f32(4, 2)},
+    lambda x, y: np.linalg.solve(x, y),
+    grads=("x", "y"), grad_rtol=5e-2, grad_atol=1e-3)
+
+_mk("TestTriangularSolveOp", paddle.linalg.triangular_solve,
+    lambda: {"x": np.tril(_spd(4)).astype("float32"), "y": _f32(4, 2)},
+    lambda x, y, upper: np.linalg.solve(x, y),
+    attrs={"upper": False})
+
+_mk("TestCholeskySolveOp", paddle.linalg.cholesky_solve,
+    lambda: {"x": _f32(4, 2), "y": np.linalg.cholesky(_spd(4))},
+    # x given L solves (L L^T) out = x
+    lambda x, y, upper: np.linalg.solve(y @ y.T, x),
+    attrs={"upper": False}, rtol=1e-3)
+
+_mk("TestMatrixPowerOp", paddle.linalg.matrix_power,
+    lambda: {"x": _spd(3)},
+    lambda x, n: np.linalg.matrix_power(x, n),
+    attrs={"n": 3}, rtol=1e-3)
+
+_mk("TestMatrixRankOp", paddle.linalg.matrix_rank,
+    lambda: {"x": (np.outer(np.arange(1, 5), np.arange(1, 6))
+                   .astype("float32"))},
+    lambda x: np.int64(np.linalg.matrix_rank(x)))
+
+_mk("TestCondOp", paddle.linalg.cond,
+    lambda: {"x": _spd(3)},
+    lambda x: np.linalg.cond(x).astype("float32"), rtol=1e-3)
+
+_mk("TestMultiDotOp",
+    lambda a, b, c: paddle.linalg.multi_dot([a, b, c]),
+    lambda: {"a": _f32(3, 4), "b": _f32(4, 5), "c": _f32(5, 2)},
+    lambda a, b, c: a @ b @ c,
+    grads=("a", "b", "c"), atol=1e-5)
+
+_mk("TestCovOp", paddle.linalg.cov,
+    lambda: {"x": _f32(3, 8)},
+    lambda x: np.cov(x).astype("float32"), rtol=1e-4)
+
+_mk("TestCorrcoefOp", paddle.linalg.corrcoef,
+    lambda: {"x": _f32(3, 8)},
+    lambda x: np.corrcoef(x).astype("float32"), rtol=1e-4)
+
+
+# decompositions: verify reconstruction / invariants rather than raw factors
+# (factor sign/phase conventions differ legitimately between backends — the
+# reference op tests do the same for svd/qr/eigh)
+def _svd_recon(x):
+    return x  # U S V^H must reconstruct x
+
+
+_mk("TestSvdReconstructOp",
+    lambda x: (lambda usv: usv[0] @ paddle.diag(usv[1]) @ usv[2])(
+        paddle.linalg.svd(x, full_matrices=False)),
+    lambda: {"x": _f32(4, 3)},
+    _svd_recon, rtol=1e-3)
+
+_mk("TestQrReconstructOp",
+    lambda x: (lambda qr_: qr_[0] @ qr_[1])(paddle.linalg.qr(x)),
+    lambda: {"x": _f32(4, 3)},
+    lambda x: x, rtol=1e-3)
+
+_mk("TestEighEigvalsOp",
+    lambda x: paddle.linalg.eigvalsh(x),
+    lambda: {"x": _spd(4)},
+    lambda x: np.linalg.eigvalsh(x).astype("float32"), rtol=1e-3)
+
+_mk("TestLuReconstructOp",
+    lambda x: (lambda lu_: lu_[0])(paddle.linalg.lu(x)),
+    lambda: {"x": _spd(4)},
+    # packed LU must satisfy P L U == x; check via scipy-free route:
+    # np's getrf equivalent through solving — compare det products instead
+    lambda x: None, check_static=False)
+
+
+# the LU packed check above needs a custom assertion; replace with a plain
+# invariant test
+del globals()["TestLuReconstructOp"]
+
+
+def test_lu_reconstructs():
+    import paddle_tpu as paddle
+
+    x = _spd(4)
+    lu, pivots = paddle.linalg.lu(np.asarray(x))
+    lu = np.asarray(lu.numpy())
+    piv = np.asarray(pivots.numpy())
+    L = np.tril(lu, -1) + np.eye(4, dtype=lu.dtype)
+    U = np.triu(lu)
+    # apply pivots (1-based LAPACK ipiv: row i swapped with row piv[i]-1)
+    perm = np.arange(4)
+    for i, p in enumerate(piv):
+        perm[[i, p - 1]] = perm[[p - 1, i]]
+    recon = np.zeros_like(x)
+    recon[perm] = (L @ U)
+    np.testing.assert_allclose(recon, x, rtol=1e-3, atol=1e-3)
+
+
+def test_lstsq_matches_numpy():
+    import paddle_tpu as paddle
+
+    a = _f32(6, 3)
+    b = _f32(6, 2)
+    sol = paddle.linalg.lstsq(np.asarray(a), np.asarray(b))[0]
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(sol.numpy()), ref,
+                               rtol=1e-3, atol=1e-4)
+
+
+if __name__ == "__main__":
+    import unittest
+
+    unittest.main()
