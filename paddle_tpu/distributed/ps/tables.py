@@ -267,6 +267,74 @@ class SparseTable:
                 self._rows[int(i)] = rows[j].copy()
                 self._g2[int(i)] = acc[j].copy()
 
+    # --------------------------------------- reference text-format interop
+    def save_text(self, dirname, table_id=0, mode=0, shard=0):
+        """Write the table in the reference PS dump layout
+        (memory_sparse_table.cc:332 SaveLocalFS): one line per feature,
+        `"<key> <values...>"`, in `<dirname>/<table_id>/part-<shard:03d>-00000`.
+        mode 0 saves weights + optimizer accumulators (resume-exact);
+        mode 3 saves weights only (the reference's save-for-inference
+        param, ctr_accessor.cc Save params batch-model convention)."""
+        import os
+
+        if mode not in (0, 3):
+            raise ValueError(
+                f"save_text mode {mode!r} not supported: 0 (resume-exact, "
+                "weights+accumulators) or 3 (weights-only/inference)")
+        table_dir = os.path.join(str(dirname), str(table_id))
+        os.makedirs(table_dir, exist_ok=True)
+        path = os.path.join(table_dir, f"part-{shard:03d}-00000")
+        ids, rows, acc = self.export_state()
+        with open(path, "w") as f:
+            for j, fid in enumerate(ids):
+                vals = list(rows[j])
+                if mode == 0:
+                    vals += list(acc[j])
+                f.write(f"{int(fid)} " +
+                        " ".join(f"{v:.9g}" for v in vals) + "\n")
+        return path
+
+    def load_text(self, dirname, table_id=0, clear=True):
+        """Inverse of save_text: read every part-* file of the table dir.
+        Tolerates both our dumps and reference-written lines whose value
+        count is dim (weights-only — accumulators reset) or 2*dim (with
+        accumulators). `clear=True` (default) erases rows not present in
+        the dump first, so the restore is checkpoint-consistent rather than
+        a merge of two training runs; pass clear=False to intentionally
+        overlay a dump onto live state."""
+        import glob
+        import os
+
+        table_dir = os.path.join(str(dirname), str(table_id))
+        parts = sorted(glob.glob(os.path.join(table_dir, "part-*")))
+        if not parts:
+            raise FileNotFoundError(f"no part-* files under {table_dir}")
+        ids, rows, accs = [], [], []
+        for p in parts:
+            with open(p) as f:
+                for line in f:
+                    toks = line.split()
+                    if not toks:
+                        continue
+                    fid, vals = int(toks[0]), [float(t) for t in toks[1:]]
+                    if len(vals) not in (self.dim, 2 * self.dim):
+                        raise ValueError(
+                            f"{p}: feature {fid} has {len(vals)} values; "
+                            f"expected dim={self.dim} or 2*dim")
+                    ids.append(fid)
+                    rows.append(vals[: self.dim])
+                    accs.append(vals[self.dim:] if len(vals) == 2 * self.dim
+                                else [0.0] * self.dim)
+        if clear:
+            existing, _ = self.export()
+            stale = np.setdiff1d(existing, np.array(ids, np.int64))
+            if stale.size:
+                self.erase(stale)
+        self.assign_state(np.array(ids, np.int64),
+                          np.array(rows, np.float32),
+                          np.array(accs, np.float32))
+        return len(ids)
+
     def __del__(self):  # noqa: D105
         try:
             if getattr(self, "_h", None):

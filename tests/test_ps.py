@@ -292,3 +292,48 @@ def test_ps_end_to_end_embedding_regression(ps_cluster, monkeypatch):
         losses.append(float(loss.numpy()))
     assert losses[-1] < losses[0] * 0.5, losses
     assert client.sparse_size("vocab") <= 50
+
+
+def test_sparse_table_text_dump_roundtrip(tmp_path):
+    """Reference PS dump interop (memory_sparse_table.cc SaveLocalFS):
+    `<dir>/<table_id>/part-NNN-00000` with `"key w... [acc...]"` lines.
+    mode 0 resumes the adagrad trajectory exactly; mode 3 (weights-only,
+    the save-for-inference param) reloads with reset accumulators; a
+    hand-written reference-style file loads too."""
+    t = SparseTable(4, optimizer="adagrad", lr=0.1, seed=7)
+    ids = np.array([3, 11, 42])
+    t.pull(ids)
+    t.push_grad(ids, np.random.RandomState(0).rand(3, 4).astype(np.float32))
+    want = t.pull(ids)
+    _, _, want_acc = t.export_state()
+
+    path = t.save_text(tmp_path, table_id=1, mode=0)
+    assert path.endswith("part-000-00000")
+    with open(path) as f:
+        first = f.readline().split()
+    assert len(first) == 1 + 2 * 4  # key + weights + accumulators
+
+    t2 = SparseTable(4, optimizer="adagrad", lr=0.1, seed=99)
+    t2.pull(np.array([777]))  # stale row a restore must clear
+    assert t2.load_text(tmp_path, table_id=1) == 3
+    assert t2.size() == 3  # clear=True erased the stale id 777
+    np.testing.assert_allclose(t2.pull(ids), want, rtol=1e-6)
+    _, _, acc2 = t2.export_state()
+    np.testing.assert_allclose(np.sort(acc2, 0), np.sort(want_acc, 0),
+                               rtol=1e-6)
+
+    # weights-only dump: loads, accumulators reset
+    t.save_text(tmp_path / "inf", table_id=0, mode=3)
+    t3 = SparseTable(4, optimizer="adagrad", lr=0.1, seed=5)
+    t3.load_text(tmp_path / "inf", table_id=0)
+    np.testing.assert_allclose(t3.pull(ids), want, rtol=1e-6)
+
+    # a reference-shaped file written by hand parses
+    ref_dir = tmp_path / "ref" / "2"
+    ref_dir.mkdir(parents=True)
+    (ref_dir / "part-000-00000").write_text(
+        "7 0.5 -0.25 1.0 2.0\n100 1 2 3 4 0.1 0.2 0.3 0.4\n")
+    t4 = SparseTable(4, optimizer="adagrad", lr=0.1)
+    assert t4.load_text(tmp_path / "ref", table_id=2) == 2
+    np.testing.assert_allclose(t4.pull(np.array([7]))[0],
+                               [0.5, -0.25, 1.0, 2.0])
