@@ -110,6 +110,55 @@ class DenseTable:
             self._acc[:] = 0
         return norm
 
+    # --------------------------------------- reference text-format interop
+    def save_text(self, dirname, table_id=0, mode=0, shard=0):
+        """Reference dense dump layout (memory_dense_table.cc:321 Save):
+        `<dirname>/<table_id>/part-<shard:03d>` with one line per element;
+        mode 0 columns are `weight acc` (resume-exact), mode 3 weight only."""
+        import os
+
+        if mode not in (0, 3):
+            raise ValueError(
+                f"save_text mode {mode!r} not supported: 0 or 3")
+        table_dir = os.path.join(str(dirname), str(table_id))
+        os.makedirs(table_dir, exist_ok=True)
+        path = os.path.join(table_dir, f"part-{shard:03d}")
+        w = self.read()
+        acc = self.read_acc() if mode == 0 else None
+        with open(path, "w") as f:
+            for i in range(self.size):
+                line = f"{w[i]:.9g}"
+                if acc is not None:
+                    line += f" {acc[i]:.9g}"
+                f.write(line + "\n")
+        return path
+
+    def load_text(self, dirname, table_id=0):
+        """Inverse of save_text; weight-only lines reset the accumulator."""
+        import glob
+        import os
+
+        parts = sorted(glob.glob(
+            os.path.join(str(dirname), str(table_id), "part-*")))
+        if not parts:
+            raise FileNotFoundError(
+                f"no part-* files under {dirname}/{table_id}")
+        w, acc = [], []
+        for p in parts:
+            with open(p) as f:
+                for line in f:
+                    toks = line.split()
+                    if not toks:
+                        continue
+                    w.append(float(toks[0]))
+                    acc.append(float(toks[1]) if len(toks) > 1 else 0.0)
+        if len(w) != self.size:
+            raise ValueError(
+                f"dump has {len(w)} values; table size is {self.size}")
+        self.assign(np.array(w, np.float32))
+        self.assign_acc(np.array(acc, np.float32))
+        return len(w)
+
     def __del__(self):
         try:
             if getattr(self, "_h", None):

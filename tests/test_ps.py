@@ -337,3 +337,25 @@ def test_sparse_table_text_dump_roundtrip(tmp_path):
     assert t4.load_text(tmp_path / "ref", table_id=2) == 2
     np.testing.assert_allclose(t4.pull(np.array([7]))[0],
                                [0.5, -0.25, 1.0, 2.0])
+
+
+def test_dense_table_text_dump_roundtrip(tmp_path):
+    """Dense analog of the sparse dump (memory_dense_table.cc Save):
+    one line per element, `weight [acc]` columns."""
+    t = DenseTable(6, optimizer="adagrad", lr=0.1)
+    t.assign(np.arange(6, dtype=np.float32))
+    t.push_grad(np.ones(6, np.float32))
+    t.apply()
+    want, want_acc = t.read(), t.read_acc()
+
+    t.save_text(tmp_path, table_id=7)
+    t2 = DenseTable(6, optimizer="adagrad", lr=0.1)
+    assert t2.load_text(tmp_path, table_id=7) == 6
+    np.testing.assert_allclose(t2.read(), want, rtol=1e-6)
+    np.testing.assert_allclose(t2.read_acc(), want_acc, rtol=1e-6)
+
+    # size-mismatched dump refuses loudly
+    t3 = DenseTable(4)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="table size"):
+        t3.load_text(tmp_path, table_id=7)
