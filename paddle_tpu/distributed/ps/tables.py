@@ -123,8 +123,15 @@ class DenseTable:
         table_dir = os.path.join(str(dirname), str(table_id))
         os.makedirs(table_dir, exist_ok=True)
         path = os.path.join(table_dir, f"part-{shard:03d}")
-        w = self.read()
-        acc = self.read_acc() if mode == 0 else None
+        # tear check: a concurrent apply() between read() and read_acc()
+        # would pair pre-update weights with post-update accumulators —
+        # re-read until the weights are stable around the acc read (the
+        # sparse path gets this from its single export_state call)
+        for _ in range(5):
+            w = self.read()
+            acc = self.read_acc() if mode == 0 else None
+            if np.array_equal(w, self.read()):
+                break
         with open(path, "w") as f:
             for i in range(self.size):
                 line = f"{w[i]:.9g}"
@@ -150,6 +157,14 @@ class DenseTable:
                     toks = line.split()
                     if not toks:
                         continue
+                    if len(toks) > 2:
+                        # e.g. an adam_d2sum reference dump (weight avg_w
+                        # acc ...): guessing which column is the adagrad
+                        # accumulator would silently corrupt resume state
+                        raise ValueError(
+                            f"{p}: {len(toks)} columns per line; this "
+                            "loader reads 'weight [acc]' dumps (sgd/"
+                            "adagrad layouts), not multi-slot accessors")
                     w.append(float(toks[0]))
                     acc.append(float(toks[1]) if len(toks) > 1 else 0.0)
         if len(w) != self.size:

@@ -356,6 +356,13 @@ def test_dense_table_text_dump_roundtrip(tmp_path):
 
     # size-mismatched dump refuses loudly
     t3 = DenseTable(4)
-    import pytest as _pytest
-    with _pytest.raises(ValueError, match="table size"):
+    with pytest.raises(ValueError, match="table size"):
         t3.load_text(tmp_path, table_id=7)
+
+    # multi-slot accessor dumps (e.g. adam_d2sum 'weight avg_w acc') refuse
+    # instead of silently mis-assigning columns
+    d2 = tmp_path / "d2sum" / "0"
+    d2.mkdir(parents=True)
+    (d2 / "part-000").write_text("1.0 0.5 0.25\n" * 6)
+    with pytest.raises(ValueError, match="columns"):
+        DenseTable(6).load_text(tmp_path / "d2sum", table_id=0)
