@@ -83,7 +83,8 @@ def _last_known_tpu() -> dict | None:
         # (resnet50-bench, longseq A/B) are banked for the record but must
         # not shadow the GPT ladder's winning number in last_known_tpu
         prov = str(rec.get("provenance", ""))
-        if prov.startswith(("rung-experiment", "resnet50-bench", "longseq")):
+        if prov.startswith(("rung-experiment", "resnet50-bench", "longseq",
+                            "bert-bench")):
             continue
         return rec
     return None

@@ -123,15 +123,28 @@ class DenseTable:
         table_dir = os.path.join(str(dirname), str(table_id))
         os.makedirs(table_dir, exist_ok=True)
         path = os.path.join(table_dir, f"part-{shard:03d}")
-        # tear check: a concurrent apply() between read() and read_acc()
-        # would pair pre-update weights with post-update accumulators —
-        # re-read until the weights are stable around the acc read (the
-        # sparse path gets this from its single export_state call)
-        for _ in range(5):
+        if mode != 0:
+            w, acc = self.read(), None  # single read cannot tear
+        else:
+            # tear check: a concurrent apply() between read() and read_acc()
+            # would pair pre-update weights with post-update accumulators —
+            # re-read until the weights are stable around the acc read (the
+            # sparse path gets this from its single export_state call)
+            import warnings
+
             w = self.read()
-            acc = self.read_acc() if mode == 0 else None
-            if np.array_equal(w, self.read()):
-                break
+            for attempt in range(5):
+                acc = self.read_acc()
+                w2 = self.read()
+                if np.array_equal(w, w2, equal_nan=True):
+                    break
+                w = w2  # reuse the confirming read as the next candidate
+            else:
+                warnings.warn(
+                    "DenseTable.save_text: weights kept changing under a "
+                    "concurrent trainer; the dump's weight/accumulator pair "
+                    "may be torn — pause updates for a resume-exact "
+                    "checkpoint", stacklevel=2)
         with open(path, "w") as f:
             for i in range(self.size):
                 line = f"{w[i]:.9g}"

@@ -18,6 +18,10 @@ for i in $(seq 1 120); do
     if ! git diff --quiet BENCH_TPU_HISTORY.jsonl 2>/dev/null; then
       git commit -q -m "Bank ResNet50 images/sec (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl
     fi
+    timeout 700 python tools/bert_bench.py >> /tmp/tpu_autobank.log 2>&1
+    if ! git diff --quiet BENCH_TPU_HISTORY.jsonl 2>/dev/null; then
+      git commit -q -m "Bank BERT-base sequences/sec (auto, tunnel revived)" -- BENCH_TPU_HISTORY.jsonl
+    fi
     timeout 900 python tools/flash_autotune.py >> /tmp/tpu_autobank.log 2>&1
     if ! git diff --quiet BENCH_TPU_HISTORY.jsonl paddle_tpu/kernels/flash_tuned.json 2>/dev/null; then
       git add paddle_tpu/kernels/flash_tuned.json 2>/dev/null
