@@ -279,6 +279,12 @@ def _compose_chain(parts):
     return fused, ext_inputs
 
 
+def _scope_sig(op):
+    """The scope tags a fused op must agree on (pipeline stage, fp16
+    region) — chains mixing signatures are refused by _rewrite_chains."""
+    return (op.attrs.get("device"), op.attrs.get("in_fp16_guard"))
+
+
 def _rewrite_chains(block, match_fn, fused_type, counts, n_fused_box,
                     make_op=None):
     """The fuse-rewrite loop shared by the pattern passes: fused op emitted at
@@ -304,6 +310,13 @@ def _rewrite_chains(block, match_fn, fused_type, counts, n_fused_box,
         if parts is not None and any(
                 id(p) in consumed or id(p) in emit_at for p in parts[1:]):
             parts = None
+        if parts is not None and any(
+                _scope_sig(p) != _scope_sig(parts[0]) for p in parts[1:]):
+            # a chain spanning a pipeline-stage or fp16_guard boundary must
+            # NOT fuse: an untagged fused op would erase the boundary (the
+            # splitter would re-stage it; guard mode would un-cast it) —
+            # refusing keeps every part's own tag visible to those passes
+            parts = None
         if parts is None:
             new_ops.append(op)
             i += 1
@@ -318,6 +331,12 @@ def _rewrite_chains(block, match_fn, fused_type, counts, n_fused_box,
                 attrs={"fused_from": [p.type for p in parts]},
                 op_role=parts[0].op_role,
             )
+        # scope attrs other passes consume (pipeline stage, fp16 region)
+        # survive fusion — the signature check above guarantees every part
+        # carries the same values
+        for key, val in zip(("device", "in_fp16_guard"), _scope_sig(parts[0])):
+            if val is not None:
+                fused.attrs.setdefault(key, val)
         emit_at[id(last)] = fused
         for p in parts[1:-1]:
             consumed.add(id(p))

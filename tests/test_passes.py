@@ -268,6 +268,48 @@ def test_build_strategy_applies_fusion_passes():
         paddle.disable_static()
 
 
+def test_fusion_preserves_scope_attrs():
+    """Pass composition: chain fusion must not strip the attrs OTHER passes
+    consume — a fused op losing its device tag would land in the wrong
+    pipeline stage; losing in_fp16_guard silently un-casts a guarded
+    region. Tags propagate only when every fused part agrees."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.static.passes import new_pass
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("fsx", [4, 16], "float32")
+            with static.device_guard("tpu:1"), static.amp.fp16_guard():
+                h = paddle.nn.Linear(16, 32)(x)
+                h = paddle.nn.functional.gelu(h)
+                out = paddle.nn.Linear(32, 8)(h)
+        new_pass("fuse_feedforward").apply(main)
+        fused = [op for op in main.global_block.ops
+                 if op.type == "fused_feedforward"]
+        assert fused, [op.type for op in main.global_block.ops]
+        assert fused[0].attrs.get("device") == "tpu:1"
+        assert fused[0].attrs.get("in_fp16_guard") is True
+
+        # a chain spanning two stages REFUSES to fuse — an untagged fused op
+        # would erase the pipeline cut (the splitter re-stages untagged ops)
+        main2, startup2 = static.Program(), static.Program()
+        with static.program_guard(main2, startup2):
+            x2 = static.data("fsy", [4, 16], "float32")
+            with static.device_guard("tpu:0"):
+                h2 = paddle.nn.Linear(16, 32)(x2)
+                h2 = paddle.nn.functional.gelu(h2)
+            with static.device_guard("tpu:1"):
+                out2 = paddle.nn.Linear(32, 8)(h2)
+        new_pass("fuse_feedforward").apply(main2)
+        types2 = [op.type for op in main2.global_block.ops]
+        assert "fused_feedforward" not in types2, types2
+    finally:
+        paddle.disable_static()
+
+
 def test_fp16_guard_region_scoped_o2():
     """reference fp16_utils.py:352 (_need_keep_fp32): with use_fp16_guard,
     ONLY ops inside fp16_guard() cast to fp16 — a numerically fragile op
