@@ -883,7 +883,8 @@ class PdModelProgram:
             self.ops, self._fetch_resolved, self.pass_stats = \
                 apply_inference_passes(
                     self.ops, self.fetch_names,
-                    live_names=set(self.feed_names) | set(self.param_names))
+                    live_names=set(self.feed_names) | set(self.param_names),
+                    params=self.params)
 
     def _run_ops(self, ops, env, op_map):
         for op in ops:
@@ -1001,8 +1002,62 @@ _CONTROL_FLOW_OPS = {"while", "conditional_block", "select_input",
                      "select_output"}
 
 
+def _fold_conv_bn(ops: list, params: dict, stats: dict) -> list:
+    """conv_bn_fuse_pass.cc at the desc level: a conv2d whose single
+    consumer is an inference-mode batch_norm folds the BN affine into the
+    conv filter (OIHW, per-out-channel) plus one bias add — one fewer
+    normalization pass over the activation at serve time."""
+    by_input = {}
+    for op in ops:
+        for ns in op["inputs"].values():
+            for n in ns:
+                by_input.setdefault(n, []).append(op)
+    replaced = {}  # id(bn op) -> replacement
+    for op in ops:
+        if op["type"] != "conv2d":
+            continue
+        conv_out = op["outputs"]["Output"][0]
+        consumers = by_input.get(conv_out, [])
+        if len(consumers) != 1 or consumers[0]["type"] != "batch_norm":
+            continue
+        bn = consumers[0]
+        names = {k: bn["inputs"][k][0]
+                 for k in ("Scale", "Bias", "Mean", "Variance")}
+        wname = op["inputs"]["Filter"][0]
+        if wname not in params or any(v not in params
+                                      for v in names.values()):
+            continue
+        if len(by_input.get(wname, ())) != 1:
+            # a shared filter (weight tying) must not be rewritten in
+            # place — the other readers would silently see scaled weights
+            continue
+        eps = float(bn["attrs"].get("epsilon") or 1e-5)
+        w_orig = np.asarray(params[wname])
+        gamma = np.asarray(params[names["Scale"]], np.float32)
+        beta = np.asarray(params[names["Bias"]], np.float32)
+        mu = np.asarray(params[names["Mean"]], np.float32)
+        var = np.asarray(params[names["Variance"]], np.float32)
+        f = gamma / np.sqrt(var + eps)
+        # fold in fp32, store back in the model's own param dtype (an fp16
+        # model's conv requires matching operand dtypes)
+        params[wname] = (w_orig.astype(np.float32)
+                         * f[:, None, None, None]).astype(w_orig.dtype)
+        bn_out = bn["outputs"]["Y" if "Y" in bn["outputs"] else "Out"][0]
+        bias_name = bn_out + "__bnfold_bias"
+        params[bias_name] = (beta - mu * f).astype(w_orig.dtype)
+        replaced[id(bn)] = {
+            "type": "elementwise_add",
+            "inputs": {"X": [conv_out], "Y": [bias_name]},
+            "outputs": {"Out": [bn_out]},
+            "attrs": {"axis": 1},
+        }
+        stats["conv_bn_fuse"] = stats.get("conv_bn_fuse", 0) + 1
+    return [replaced.get(id(op), op) for op in ops]
+
+
 def apply_inference_passes(ops: list, fetch_names: list,
-                           live_names: set | None = None) -> tuple:
+                           live_names: set | None = None,
+                           params: dict | None = None) -> tuple:
     """Analysis passes over the desc-level op list, the reference
     analysis_predictor contract (analysis_predictor.cc PrepareProgram ->
     inference/analysis pass registry) restated for this loader:
@@ -1037,6 +1092,9 @@ def apply_inference_passes(ops: list, fetch_names: list,
             return ops, list(fetch_names), stats
         live.update(ins)
         live.update(outs)
+
+    if params is not None:
+        ops = _fold_conv_bn(ops, params, stats)
 
     alias: dict = {}
     kept = []

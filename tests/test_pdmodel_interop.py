@@ -269,6 +269,61 @@ def test_pdmodel_inference_passes(tmp_path):
     assert stats3.get("skipped") == "in-place var-name reuse"
 
 
+def test_pdmodel_conv_bn_fold(tmp_path):
+    """conv_bn_fuse_pass analog: an inference-mode conv2d->batch_norm pair
+    folds the BN affine into the filter + one bias add; numerics identical
+    to the unoptimized program."""
+    from paddle_tpu.inference.pdmodel import load_pdmodel
+
+    rng = np.random.RandomState(9)
+    w = (rng.randn(6, 3, 3, 3) * 0.2).astype(np.float32)
+    gamma = (rng.rand(6) + 0.5).astype(np.float32)
+    beta = (rng.randn(6) * 0.1).astype(np.float32)
+    mean = (rng.randn(6) * 0.1).astype(np.float32)
+    var = (rng.rand(6) + 0.5).astype(np.float32)
+
+    vars_ = [
+        _var("feed", [], False, vtype=9),
+        _var("fetch", [], False, vtype=10),
+        _var("x", [-1, 3, 8, 8], False),
+        _var("w", list(w.shape), True),
+        _var("bn.g", [6], True), _var("bn.b", [6], True),
+        _var("bn.m", [6], True), _var("bn.v", [6], True),
+        _var("c0", [-1, 6, 8, 8], False), _var("b0", [-1, 6, 8, 8], False),
+        _var("out", [-1, 6, 8, 8], False),
+    ]
+    ops = [
+        _op("feed", [("X", ["feed"])], [("Out", ["x"])], [("col", 0, 0)]),
+        _op("conv2d", [("Input", ["x"]), ("Filter", ["w"])],
+            [("Output", ["c0"])],
+            [("strides", 3, [1, 1]), ("paddings", 3, [1, 1]),
+             ("dilations", 3, [1, 1]), ("groups", 0, 1)]),
+        _op("batch_norm",
+            [("X", ["c0"]), ("Scale", ["bn.g"]), ("Bias", ["bn.b"]),
+             ("Mean", ["bn.m"]), ("Variance", ["bn.v"])],
+            [("Y", ["b0"])], [("epsilon", 1, 1e-5), ("is_test", 6, True)]),
+        _op("relu", [("X", ["b0"])], [("Out", ["out"])]),
+        _op("fetch", [("X", ["out"])], [("Out", ["fetch"])], [("col", 0, 0)]),
+    ]
+    prefix = str(tmp_path / "convbn")
+    with open(prefix + ".pdmodel", "wb") as f:
+        f.write(_program(_block(vars_, ops)))
+    params = {"bn.b": beta, "bn.g": gamma, "bn.m": mean, "bn.v": var, "w": w}
+    with open(prefix + ".pdiparams", "wb") as f:
+        for name in sorted(params):
+            save_binary_tensor(f, params[name])
+
+    opt = load_pdmodel(prefix, ir_optim=True)
+    raw = load_pdmodel(prefix, ir_optim=False)
+    assert opt.pass_stats.get("conv_bn_fuse") == 1
+    assert not any(op["type"] == "batch_norm" for op in opt.ops)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    (o1,) = opt.run({"x": x})
+    (o2,) = raw.run({"x": x})
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_pdmodel_export_refuses_disconnected_fetch(tmp_path):
     """save_inference_model called outside the program_guard that built the
     net exports the EMPTY default program — the exporter must refuse (the
