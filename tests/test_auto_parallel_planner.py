@@ -102,6 +102,35 @@ def test_planner_decisions_differ_by_model_shape():
     assert set(pw.comm_volumes) == {"dp", "sharding", "mp", "sp"}
 
 
+def test_planner_always_returns_valid_plan():
+    """Property sweep: over random model shapes and device counts, every
+    plan factors n_devices exactly, satisfies the divisibility contract,
+    and carries a full breakdown — or raises ValueError (never crashes)."""
+    rng = np.random.RandomState(0)
+    for _ in range(60):
+        n = int(2 ** rng.randint(0, 7))
+        model = ModelDesc(
+            n_params=int(10 ** rng.uniform(4, 9)),
+            layers=int(rng.randint(1, 48)),
+            hidden=int(2 ** rng.randint(4, 13)),
+            heads=int(2 ** rng.randint(0, 6)),
+            seq=int(2 ** rng.randint(0, 14)),
+            batch=int(2 ** rng.randint(0, 10)))
+        try:
+            plan = plan_parallel(n, model, cpu_test_cluster(max(n, 1)))
+        except ValueError:
+            continue  # indivisible shapes refuse loudly — acceptable
+        assert plan.dp * plan.sp * plan.sharding * plan.mp == n, \
+            (n, plan.axis_sizes)
+        assert model.batch % (plan.dp * plan.sharding) == 0
+        assert model.seq % plan.sp == 0 and model.hidden % plan.mp == 0
+        if model.heads:
+            assert model.heads % plan.sp == 0
+            assert model.heads % plan.mp == 0
+        assert plan.time > 0 and plan.per_chip_bytes > 0
+        assert set(plan.comm_volumes) == {"dp", "sharding", "mp", "sp"}
+
+
 def test_planner_memory_forces_sharding_at_scale():
     """6.7B on v5p-64: all-dp replication (~116 GB/chip) cannot fit 95 GB
     HBM; the plan must split params and fit the budget."""
