@@ -59,7 +59,12 @@ class Executor:
         return serial
 
     def _cache_key(self, program, feed, fetches):
-        return (self._program_serial(program), tuple(sorted(feed.keys())),
+        # tape version: a pass applied after a run must recompile, not hit
+        # the stale pre-pass computation (PassBase.apply bumps the global
+        # block's version; the block is shared across clone() aliases)
+        return (self._program_serial(program),
+                getattr(program.global_block, "_version", 0),
+                tuple(sorted(feed.keys())),
                 tuple(getattr(f, "name", str(f)) for f in fetches))
 
     @staticmethod
@@ -81,6 +86,13 @@ class Executor:
         fetches = [f for f in fetch_list]
         key = self._cache_key(program, feed, fetches)
         if key not in self._cache:
+            # drop runners compiled for older tape versions of this program
+            # — unreachable after a pass bump, and each holds a compiled
+            # XLA executable (a per-pass-application leak otherwise)
+            stale = [k for k in self._cache
+                     if k[0] == key[0] and k[1] < key[1]]
+            for k in stale:
+                del self._cache[k]
             self._cache[key] = _lower(program, sorted(feed.keys()), fetches)
         runner = self._cache[key]
         feed_arrays = self._feed_arrays(feed)

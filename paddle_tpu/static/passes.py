@@ -62,7 +62,10 @@ class PassBase:
             raise RuntimeError(f"pass {self.name} not applicable")
         context = context or PassContext()
         self._apply_impl(main_program, startup_program, context)
-        main_program._lowered_cache.clear()
+        # invalidate compiled executors (cache keyed on the tape version;
+        # the block is shared by clone() aliases so every alias recompiles)
+        blk = main_program.global_block
+        blk._version = getattr(blk, "_version", 0) + 1
         applied = context.attrs.setdefault("applied_passes", [])
         applied.append(self.name)
         return context

@@ -97,6 +97,12 @@ class Block:
         self.parent_idx = parent_idx
         self.ops: list[Operator] = []
         self.vars: dict[str, Variable] = collections.OrderedDict()
+        # tape version, bumped by every PassBase.apply. Lives on the BLOCK
+        # (shared by Program.clone aliases), not the Program wrapper: a pass
+        # applied through one alias must invalidate executors holding any
+        # alias. The Executor keys its compiled cache on the global block's
+        # value.
+        self._version = 0
 
     def var(self, name):
         return self.vars[name]
@@ -121,7 +127,6 @@ class Program:
         self._data_vars: list[Variable] = []
         self._minimize_spec = None  # (optimizer, loss_var)
         self.random_seed = 0
-        self._lowered_cache = {}
 
     @property
     def global_block(self):
@@ -145,7 +150,6 @@ class Program:
         new._data_vars = list(self._data_vars)
         new._minimize_spec = None if for_test else self._minimize_spec
         new.random_seed = self.random_seed
-        new._lowered_cache = {}
         return new
 
     # ------------------------------------------------------------ param capture

@@ -268,6 +268,50 @@ def test_build_strategy_applies_fusion_passes():
         paddle.disable_static()
 
 
+def test_pass_after_run_invalidates_executor_cache():
+    """A pass applied AFTER the program has executed must recompile on the
+    next run — the reference workflow (exe.run(startup); ...; apply pass;
+    exe.run(main)) silently hit the stale pre-pass computation before the
+    program-version cache key. Observable: square(x+300) is finite in
+    fp32, inf once the fp16 pass casts it."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.distributed.passes import new_pass
+
+    paddle.enable_static()
+    try:
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("cx", [4, 4], "float32")
+            out = paddle.square(x + 300.0)
+        exe = static.Executor()
+        feed = {"cx": np.zeros((4, 4), "float32")}
+        r1 = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        assert np.all(np.isfinite(r1))  # fp32: 9e4 fits
+
+        # warm a CLONE alias too: it shares the tape, so the pass applied
+        # through `main` must also invalidate the clone's cached runner
+        test_prog = main.clone(for_test=True)
+        rc1 = np.asarray(exe.run(test_prog, feed=feed, fetch_list=[out])[0])
+        assert np.all(np.isfinite(rc1))
+
+        new_pass("auto_parallel_fp16",
+                 {"use_dynamic_loss_scaling": False}).apply(main)
+        r2 = np.asarray(exe.run(main, feed=feed, fetch_list=[out])[0])
+        assert np.all(np.isinf(r2)), (
+            "executor served the stale pre-pass computation: "
+            f"{r2[0, 0]} (expected fp16 overflow -> inf)")
+        rc2 = np.asarray(exe.run(test_prog, feed=feed, fetch_list=[out])[0])
+        assert np.all(np.isinf(rc2)), "clone alias served stale computation"
+        # stale pre-pass runners are evicted, not stranded
+        assert all(k[1] >= 1 for k in exe._cache if k[0] ==
+                   exe._program_serial(main))
+    finally:
+        paddle.disable_static()
+
+
 def test_fusion_preserves_scope_attrs():
     """Pass composition: chain fusion must not strip the attrs OTHER passes
     consume — a fused op losing its device tag would land in the wrong
