@@ -73,6 +73,7 @@ from . import static  # noqa: E402,F401
 from . import jit  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import text  # noqa: E402,F401
+from . import serving  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401
 from . import utils  # noqa: E402,F401

@@ -71,11 +71,34 @@ class Cluster:
         return self.device("ici_bandwidth") if self.same_host(a, b) \
             else self.dcn_bandwidth / self.chips_per_host
 
-    def axis_medium(self, group_size: int, stride: int = 1) -> str:
+    def axis_medium(self, group_size: int, stride: int = 1,
+                    groups=None) -> str:
         """Medium a collective over `group_size` ranks spaced `stride` apart
-        rides on: 'ici' when the whole group lives inside one host."""
-        span = group_size * stride
-        return "ici" if span <= self.chips_per_host else "dcn"
+        rides on: 'ici' when EVERY such group lives inside one host.
+
+        `groups` (iterable of rank iterables) checks the mapper's actual
+        groups; otherwise the strided tiling of the whole cluster is
+        enumerated. Checking real ranks via host_of matters when
+        chips_per_host is not a power of two: size 2 stride 2 on a 6-chip
+        host has span 4 <= 6, but the group {4, 6} straddles a host
+        boundary — the old span heuristic called it 'ici' (ADVICE r5
+        item 4)."""
+        if groups is None:
+            groups = (
+                [base + i * stride for i in range(group_size)]
+                for base in range(self.n_chips)
+                if (base // stride) % group_size == 0
+                and base + (group_size - 1) * stride < self.n_chips)
+        checked = False
+        for g in groups:
+            checked = True
+            hosts = {self.host_of(int(r)) for r in g}
+            if len(hosts) > 1:
+                return "dcn"
+        # no group at all (e.g. group_size * stride overruns the cluster):
+        # fail CLOSED — claiming 'ici' would cost-model a cross-host
+        # collective at on-chip bandwidth
+        return "ici" if checked else "dcn"
 
     def to_cluster_spec(self) -> ClusterSpec:
         """Flatten into the alpha-beta cost model's constants."""

@@ -62,7 +62,13 @@ def map_mesh(cluster, axis_sizes: dict, comm_bytes: dict | None = None):
             continue
         stride = int(np.prod(
             [axis_sizes[b] for b in order[order.index(a) + 1:]], dtype=int))
-        placement[a] = cluster.axis_medium(axis_sizes[a], stride)
+        # classify over the axis's ACTUAL rank groups (all other axes
+        # fixed), not the span heuristic — on non-power-of-two hosts a
+        # group can straddle a host boundary even when size*stride fits
+        groups = np.moveaxis(
+            ids, list(axis_sizes).index(a), -1).reshape(-1, axis_sizes[a])
+        placement[a] = cluster.axis_medium(axis_sizes[a], stride,
+                                           groups=groups)
     return ids, placement
 
 

@@ -1082,14 +1082,33 @@ def apply_inference_passes(ops: list, fetch_names: list,
     # programs. Paddle's inference inplace passes may emit var-name REUSE
     # (an op writing a name that was already read/written — e.g.
     # relu(X=[x])->Out=[x]); folding across a rewrite silently changes
-    # numerics. Detect any output name that was already live and bail.
+    # numerics. Detect any output name that was already live and bail —
+    # EXCEPT dead rewrites: an output name the WRITING OP ALONE ever reads
+    # (and that is not fetched) can't change any consumed value. Real Paddle
+    # BN exports write MeanOut/VarianceOut over the Mean/Variance param
+    # names on every batch_norm (the only reader of those names is that same
+    # batch_norm's Mean/Variance input), so without the dead-write exemption
+    # the bailout would disable all passes (incl. conv_bn_fuse, their
+    # headline target) on exactly the BN CNNs they exist for (ADVICE r5
+    # item 2). A read by ANY other op — even an EARLIER one — must still
+    # bail: the assign/identity_scale folding below turns copies into name
+    # aliases, so a pre-overwrite copy's readers would silently see the
+    # post-overwrite value.
+    readers: dict[str, set[int]] = {}  # name -> indices of ops reading it
+    for i, op in enumerate(ops):
+        for ns in op["inputs"].values():
+            for n in ns:
+                readers.setdefault(n, set()).add(i)
+    fetch_set = set(fetch_names)
     live: set = set(live_names or ())  # feeds + params start live
-    for op in ops:
+    for i, op in enumerate(ops):
         ins = [n for ns in op["inputs"].values() for n in ns]
         outs = [n for ns in op["outputs"].values() for n in ns]
-        if any(o in live or o in ins for o in outs):
-            stats["skipped"] = "in-place var-name reuse"
-            return ops, list(fetch_names), stats
+        for o in outs:
+            if (o in live or o in ins) and \
+                    (o in fetch_set or readers.get(o, set()) - {i}):
+                stats["skipped"] = "in-place var-name reuse"
+                return ops, list(fetch_names), stats
         live.update(ins)
         live.update(outs)
 

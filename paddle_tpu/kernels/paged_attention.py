@@ -1,0 +1,135 @@
+"""Paged attention over a fixed page pool (serving decode path).
+
+Reference analog: Ragged Paged Attention (arxiv 2604.15464) — KV lives in
+fixed-size pages of a preallocated pool; each sequence owns a page table and
+requests of different lengths share ONE statically-shaped computation. Two
+paths, dispatched like kernels/attention.py:
+
+1. Pallas ragged decode kernel (jax.experimental.pallas paged_attention) on
+   TPU, behind the same ``FLAGS_use_pallas_kernels`` gate.
+2. Composite XLA everywhere else: gather the sequence's pages via its page
+   table, then a ragged-masked softmax through ``attention.sdpa`` — masked
+   positions contribute exact zeros, so padding pages never change numerics.
+
+Pool layout is ``[num_pages, page_size, num_heads, head_dim]`` per layer
+(serving/kv_cache.py owns allocation). Page 0 is reserved as the null page:
+writes from padding/inactive rows are routed there so a scatter can stay
+branch-free inside jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["paged_write", "paged_gather", "paged_attention"]
+
+
+def paged_write(k_pool, v_pool, k_new, v_new, page_ids, offsets):
+    """Functionally write new K/V into the pools.
+
+    k_new/v_new: [batch, tokens, heads, head_dim] — `tokens` new entries per
+    row. page_ids/offsets: [batch, tokens] int32 destination coordinates
+    (callers route dead writes — padding, inactive slots — to the null page 0).
+    Returns the updated (k_pool, v_pool); `.at[]` keeps the update functional
+    so engine state threads through jit.
+    """
+    k_pool = k_pool.at[page_ids, offsets].set(k_new.astype(k_pool.dtype))
+    v_pool = v_pool.at[page_ids, offsets].set(v_new.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+def paged_gather(pool, page_table):
+    """Gather each row's pages into a contiguous sequence.
+
+    pool: [num_pages, page_size, heads, head_dim]; page_table:
+    [batch, pages_per_seq] int32. Returns [batch, heads, pages_per_seq *
+    page_size, head_dim] (sdpa layout).
+    """
+    b, n_pages = page_table.shape
+    _, ps, h, d = pool.shape
+    seq = pool[page_table]  # [b, pages_per_seq, page_size, h, d]
+    seq = seq.reshape(b, n_pages * ps, h, d)
+    return seq.transpose(0, 2, 1, 3)
+
+
+def _use_pallas_decode(q, k_pool, page_table) -> bool:
+    from ..utils.flags import flag
+    from ._common import on_tpu_backend
+
+    if not flag("FLAGS_use_pallas_kernels", True) or not on_tpu_backend():
+        return False
+    d = q.shape[-1]
+    ps = k_pool.shape[1]
+    # kernel tiling: head_dim on the 128 lane tile; the pages-per-block
+    # choice below must tile the page table width
+    return d % 128 == 0 and page_table.shape[1] % _pages_per_block(ps) == 0
+
+
+def _pages_per_block(page_size: int) -> int:
+    """Pages per flash block: ~512 KV slots per block, at least one page."""
+    return max(1, 512 // page_size)
+
+
+_pallas_fallback_logged: set[tuple] = set()
+
+
+def _pallas_decode(q, k_pool, v_pool, page_table, ctx_lens, scale):
+    """Single-token ragged decode via the Pallas TPU kernel.
+
+    Kernel layout differs from the pool layout: q [b, heads, head_dim],
+    pools [kv_heads, num_pages, page_size, head_dim]; the kernel applies no
+    softmax scale of its own, so q is pre-scaled here.
+    """
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention as _pallas_paged,
+    )
+
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(
+        jnp.asarray(d, jnp.float32))
+    qs = (q[:, :, 0, :] * scale).astype(q.dtype)  # [b, h, d]
+    kp = jnp.transpose(k_pool, (2, 0, 1, 3))  # [h, pages, page_size, d]
+    vp = jnp.transpose(v_pool, (2, 0, 1, 3))
+    lengths = (ctx_lens + 1).astype(jnp.int32)  # current token already written
+    out = _pallas_paged(
+        qs, kp, vp, lengths, page_table.astype(jnp.int32),
+        pages_per_compute_block=_pages_per_block(k_pool.shape[1]))
+    return out[:, :, None, :]
+
+
+def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None):
+    """Attention of new-token queries against a row's paged KV prefix.
+
+    q: [batch, heads, s, head_dim] — queries for s new tokens at positions
+    ``ctx_lens .. ctx_lens + s - 1``, whose K/V are ALREADY in the pool
+    (paged_write first, then attend — the vLLM/RPA decode contract).
+    ctx_lens: [batch] int32 tokens resident per row BEFORE this call's s new
+    tokens. Ragged causality: query t of row b sees pool positions
+    ``j <= ctx_lens[b] + t``; everything beyond is masked to exact zero
+    probability, so the fixed gather width never leaks padding. Returns
+    [batch, heads, s, head_dim].
+    """
+    s = q.shape[2]
+    if s == 1 and _use_pallas_decode(q, k_pool, page_table):
+        try:
+            return _pallas_decode(q, k_pool, v_pool, page_table, ctx_lens,
+                                  scale)
+        except Exception as e:  # noqa: BLE001 — fall back on any pallas failure
+            sig = (q.shape, k_pool.shape, type(e).__name__)
+            if sig not in _pallas_fallback_logged:
+                _pallas_fallback_logged.add(sig)
+                import sys
+
+                print(f"[paddle_tpu] pallas paged attention failed for "
+                      f"q{tuple(q.shape)} pool{tuple(k_pool.shape)} "
+                      f"({type(e).__name__}: {str(e)[:300]}); falling back "
+                      f"to gather + composite attention",
+                      file=sys.stderr, flush=True)
+    from .attention import sdpa
+
+    k_all = paged_gather(k_pool, page_table)  # [b, h, S, d]
+    v_all = paged_gather(v_pool, page_table)
+    total = k_all.shape[2]
+    j = jnp.arange(total)[None, None, None, :]
+    t = jnp.arange(s)[None, None, :, None]
+    mask = j <= ctx_lens.astype(jnp.int32)[:, None, None, None] + t
+    return sdpa(q, k_all, v_all, mask=mask, scale=scale)

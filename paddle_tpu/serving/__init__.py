@@ -1,0 +1,17 @@
+"""paddle_tpu.serving — continuous-batching inference engine.
+
+Request-level serving on top of the text/ decode stack: a paged KV cache
+(fixed pool + free-list allocator + per-request page tables), an admission/
+preemption scheduler, and an engine whose decode step is ONE jitted
+computation over static shapes — requests joining and leaving the batch
+never recompile. Reference shape: Ragged Paged Attention (arxiv 2604.15464)
+and the vLLM continuous-batching loop, restated TPU-native.
+"""
+from .engine import ServingConfig, ServingEngine  # noqa: F401
+from .kv_cache import PagedCacheConfig, PagedKVCache, PageAllocator  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+from .scheduler import Request, Scheduler  # noqa: F401
+
+__all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
+           "PagedKVCache", "PageAllocator", "ServingMetrics", "Request",
+           "Scheduler"]

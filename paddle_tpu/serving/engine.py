@@ -1,0 +1,274 @@
+"""The serving loop: scheduler + paged cache + model, one jitted step.
+
+Static-shape discipline is the whole design: the decode step is a single
+``jax.jit``-compiled function of (params, pools, page_table [max_batch,
+pages_per_seq], ctx_lens [max_batch], last_tok [max_batch], active
+[max_batch], key) — every array keeps its shape for the life of the engine,
+so requests joining and leaving the batch NEVER retrigger compilation (the
+e2e test asserts exactly-one trace per function via ``compile_counts``).
+Prefill is its own once-compiled step: prompts are right-padded to the
+``max_prompt_len`` bucket and the real length rides in as an array.
+
+Decode semantics match text/generation.py: prefill picks the first token
+from the last prompt logit, each decode step feeds the previous token back
+in, writes its KV at position ctx, and samples the next — so per-request
+greedy outputs are identical to single-request ``generate``.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..text.generation import sample_logits
+from .kv_cache import PagedCacheConfig, PagedKVCache
+from .metrics import ServingMetrics
+from .scheduler import Request, Scheduler
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    max_batch: int = 4
+    num_pages: int = 64
+    page_size: int = 16
+    pages_per_seq: int = 0  # 0 -> ceil(max_seq_len / page_size)
+    max_prompt_len: int = 32  # prefill pad bucket (one compile for all prompts)
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_token_id: int | None = None
+    pad_token_id: int = 0
+    seed: int = 0
+
+
+class ServingEngine:
+    """Continuous-batching engine over a GPTForCausalLM-shaped model (any
+    model exposing ``functional_state``/``functional_call`` with the paged
+    cache contract of text/gpt.py works)."""
+
+    def __init__(self, model, config: ServingConfig | None = None):
+        self.config = cfg = config or ServingConfig()
+        self.model = model
+        model.eval()
+        mc = model.cfg
+        if cfg.max_prompt_len > mc.max_seq_len:
+            raise ValueError(
+                f"max_prompt_len {cfg.max_prompt_len} exceeds the model's "
+                f"max_seq_len {mc.max_seq_len}")
+        pages_per_seq = cfg.pages_per_seq or \
+            -(-mc.max_seq_len // cfg.page_size)
+        self.cache = PagedKVCache(PagedCacheConfig(
+            num_layers=mc.num_layers, num_heads=mc.num_heads,
+            head_dim=mc.hidden_size // mc.num_heads,
+            num_pages=cfg.num_pages, page_size=cfg.page_size,
+            max_batch=cfg.max_batch, pages_per_seq=pages_per_seq,
+            dtype=model.gpt.wte.weight._value.dtype))
+        self.scheduler = Scheduler(self.cache, cfg.max_batch)
+        self.metrics = ServingMetrics()
+        params, _ = model.functional_state()
+        self._p = {k: v._value for k, v in params.items()}
+        self._key = jax.random.key(cfg.seed)
+        b = cfg.max_batch
+        self._ctx = np.zeros(b, np.int32)
+        self._last_tok = np.full(b, cfg.pad_token_id, np.int32)
+        self._active = np.zeros(b, bool)
+        self._finished: dict[int, np.ndarray] = {}
+        self._requests: dict[int, Request] = {}
+        # trace counters: the python bodies run only when jax (re)traces,
+        # i.e. exactly once per compilation — the e2e compile-once hook
+        self.compile_counts = {"prefill": 0, "decode": 0}
+        # donate the pools: the engine rebinds self.cache.pools to the
+        # returned arrays immediately, and without donation XLA can't alias
+        # input to output — the .at[] scatter would copy the ENTIRE pool
+        # every token and hold two pools live (for an HBM-sized pool that
+        # doubles cache memory and makes a step O(pool), not O(page))
+        self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(1,))
+        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
+
+    # --------------------------------------------------------- jitted steps
+    def _pick(self, logits, key):
+        cfg = self.config
+        if cfg.do_sample:
+            return sample_logits(logits, key, cfg.temperature, cfg.top_k,
+                                 cfg.top_p)
+        return jnp.argmax(logits, axis=-1)
+
+    def _run_model(self, p_arrays, pools, table, ctx, valid, ids):
+        caches = [dict(pl, page_table=table, ctx_lens=ctx, valid=valid)
+                  for pl in pools]
+        (logits, new_caches), _ = self.model.functional_call(
+            p_arrays, {}, Tensor(ids), caches=caches)
+        new_pools = [{"k_pool": c["k_pool"], "v_pool": c["v_pool"]}
+                     for c in new_caches]
+        return logits._value, new_pools
+
+    def _prefill_impl(self, p_arrays, pools, padded_ids, prompt_len,
+                      page_row, key):
+        """One request's prompt in one pass: padded_ids [max_prompt_len],
+        prompt_len scalar, page_row [pages_per_seq]. Returns (new_pools,
+        first sampled token)."""
+        self.compile_counts["prefill"] += 1
+        n = padded_ids.shape[0]
+        table = page_row[None, :]
+        ctx = jnp.zeros((1,), jnp.int32)
+        valid = (jnp.arange(n, dtype=jnp.int32) < prompt_len)[None, :]
+        logits, new_pools = self._run_model(
+            p_arrays, pools, table, ctx, valid, padded_ids[None, :])
+        last = logits[0, prompt_len - 1, :]
+        tok = self._pick(last[None, :], key)[0]
+        return new_pools, tok.astype(jnp.int32)
+
+    def _decode_impl(self, p_arrays, pools, table, ctx, last_tok, active,
+                     key):
+        """One token for every running slot. Inactive slots run the same
+        computation against the null page and emit pad — branch-free, so the
+        batch composition never changes the compiled program."""
+        self.compile_counts["decode"] += 1
+        logits, new_pools = self._run_model(
+            p_arrays, pools, table, ctx, active[:, None], last_tok[:, None])
+        tok = self._pick(logits[:, -1, :], key)
+        tok = jnp.where(active, tok,
+                        jnp.asarray(self.config.pad_token_id)).astype(jnp.int32)
+        return new_pools, tok
+
+    # ------------------------------------------------------------ host loop
+    def add_request(self, prompt, max_new_tokens: int) -> int:
+        """Queue a prompt; returns the request id. Raises when the request
+        could never fit (prompt too long for the bucket, the model, or the
+        whole pool)."""
+        prompt = np.asarray(
+            prompt._value if isinstance(prompt, Tensor) else prompt)
+        if prompt.ndim != 1:
+            raise ValueError(f"prompt must be 1-D, got shape {prompt.shape}")
+        if prompt.shape[0] == 0:
+            # an empty prompt would sample its first token from the logits
+            # of a padding position (all-null-page KV) — garbage, silently
+            raise ValueError("prompt must contain at least one token")
+        if int(max_new_tokens) <= 0:
+            raise ValueError("max_new_tokens must be positive")
+        if prompt.shape[0] > self.config.max_prompt_len:
+            raise ValueError(
+                f"prompt_len {prompt.shape[0]} exceeds max_prompt_len "
+                f"{self.config.max_prompt_len}")
+        total = prompt.shape[0] + int(max_new_tokens)
+        if total > self.model.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt_len + max_new_tokens = {total} exceeds max_seq_len "
+                f"{self.model.cfg.max_seq_len}")
+        req = Request(prompt=prompt.astype(np.int32),
+                      max_new_tokens=int(max_new_tokens))
+        self.scheduler.add(req)  # validates against pool capacity
+        self._requests[req.rid] = req
+        return req.rid
+
+    def _split_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _clear_slot(self, slot: int) -> None:
+        self._active[slot] = False
+        self._ctx[slot] = 0
+        self._last_tok[slot] = self.config.pad_token_id
+
+    def _maybe_finish(self, req: Request, tok: int) -> bool:
+        eos = self.config.eos_token_id
+        if len(req.generated) >= req.max_new_tokens or \
+                (eos is not None and tok == eos):
+            slot = req.slot
+            self.scheduler.finish(req)
+            self._clear_slot(slot)
+            self._finished[req.rid] = req.output()
+            self._requests.pop(req.rid, None)  # bookkeeping ends at finish
+            return True
+        return False
+
+    def step(self) -> list[int]:
+        """One continuous-batching iteration: admit + prefill joiners, one
+        decode step for the whole batch, retire finishers. Returns the
+        request ids that finished during this step."""
+        from .. import profiler
+
+        finished_now = []
+        for req in self.scheduler.admit():
+            with profiler.RecordEvent("serving::prefill"):
+                padded = np.full(self.config.max_prompt_len,
+                                 self.config.pad_token_id, np.int32)
+                padded[:req.prompt_len] = req.prompt
+                pools, tok = self._prefill_jit(
+                    self._p, self.cache.pools, jnp.asarray(padded),
+                    jnp.asarray(req.prompt_len, jnp.int32),
+                    jnp.asarray(self.cache.page_table[req.slot]),
+                    self._split_key())
+            self.cache.pools = pools
+            tok = int(tok)
+            req.generated.append(tok)
+            self._ctx[req.slot] = req.prompt_len
+            self._last_tok[req.slot] = tok
+            self._active[req.slot] = True
+            self.metrics.on_prefill()
+            self.metrics.on_tokens(1)
+            if self._maybe_finish(req, tok):
+                finished_now.append(req.rid)
+
+        for _req, slot in self.scheduler.ensure_decode_pages():
+            self._clear_slot(slot)
+            self.metrics.on_preempt()
+
+        if self._active.any():
+            with profiler.RecordEvent("serving::decode"):
+                pools, toks = self._decode_jit(
+                    self._p, self.cache.pools,
+                    jnp.asarray(self.cache.page_table),
+                    jnp.asarray(self._ctx), jnp.asarray(self._last_tok),
+                    jnp.asarray(self._active), self._split_key())
+            self.cache.pools = pools
+            toks = np.asarray(toks)
+            self.metrics.on_decode_step()
+            n_new = 0
+            for slot in np.nonzero(self._active)[0]:
+                req = self.scheduler.running[int(slot)]
+                tok = int(toks[slot])
+                req.generated.append(tok)
+                self._ctx[slot] += 1
+                self._last_tok[slot] = tok
+                n_new += 1
+                if self._maybe_finish(req, tok):
+                    finished_now.append(req.rid)
+            self.metrics.on_tokens(n_new)
+
+        self.metrics.on_state(
+            queue_depth=self.scheduler.queue_depth,
+            active=len(self.scheduler.running),
+            pages_used=self.cache.allocator.pages_in_use,
+            usable_pages=self.cache.cfg.usable_pages)
+        return finished_now
+
+    def run(self, max_steps: int = 100000) -> dict[int, np.ndarray]:
+        """Drive step() until every queued request finished; returns
+        {request_id: [prompt + generated] token array} for the requests that
+        finished during THIS call (not historical completions)."""
+        steps = 0
+        done: dict[int, np.ndarray] = {}
+        while not self.scheduler.all_done:
+            for rid in self.step():
+                done[rid] = self._finished[rid]
+            steps += 1
+            if steps > max_steps:
+                raise RuntimeError(f"serving loop exceeded {max_steps} steps")
+        return done
+
+    def result(self, rid: int) -> np.ndarray:
+        return self._finished[rid]
+
+    def pop_finished(self) -> dict[int, np.ndarray]:
+        """Drain and return every completed output. A long-lived server must
+        call this (or ``result`` + its own eviction) — ``_finished`` retains
+        outputs until drained, so never draining grows memory with every
+        request ever served."""
+        done, self._finished = self._finished, {}
+        return done

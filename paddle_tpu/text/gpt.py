@@ -70,6 +70,8 @@ class GPTAttention(nn.Layer):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        if cache is not None and "k_pool" in cache:
+            return self._paged_forward(x, qkv, cache)
         qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3, B, H, S, D
         q, k, v = qkv[0], qkv[1], qkv[2]
         if cache is not None:
@@ -104,6 +106,41 @@ class GPTAttention(nn.Layer):
         )
         out = out.transpose([0, 2, 1, 3]).reshape([b, s, h])
         return self.out_proj(out)
+
+    def _paged_forward(self, x, qkv, cache):
+        """Serving decode/prefill against a paged KV pool (kernels/
+        paged_attention.py). The cache dict carries, besides the per-layer
+        pools, the batch's page tables [B, pages_per_seq], ctx_lens [B]
+        (tokens resident before this call) and valid [B, s] (which of the s
+        new tokens are real — padding and inactive slots write to the
+        reserved null page 0 instead of corrupting live pages)."""
+        import jax.numpy as jnp
+
+        from ..kernels import paged_attention as pa
+
+        b, s, h = x.shape
+        k_pool, v_pool = cache["k_pool"], cache["v_pool"]
+        ctx = cache["ctx_lens"].astype(jnp.int32)  # [B]
+        table = cache["page_table"]  # [B, pages_per_seq]
+        valid = cache["valid"]  # [B, s] bool
+        page_size = k_pool.shape[1]
+        qkv_v = qkv._value  # [B, s, 3, H, D]
+        q = jnp.transpose(qkv_v[:, :, 0], (0, 2, 1, 3))  # [B, H, s, D]
+        k_new = qkv_v[:, :, 1]  # [B, s, H, D]
+        v_new = qkv_v[:, :, 2]
+        positions = ctx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
+        page_ids = jnp.take_along_axis(table, positions // page_size, axis=1)
+        page_ids = jnp.where(valid, page_ids, 0)  # dead writes -> null page
+        offsets = jnp.where(valid, positions % page_size, 0)
+        k_pool, v_pool = pa.paged_write(k_pool, v_pool, k_new, v_new,
+                                        page_ids, offsets)
+        out = pa.paged_attention(q, k_pool, v_pool, table, ctx)
+        out = Tensor(jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
+                     .astype(x._value.dtype))
+        new_cache = dict(cache, k_pool=k_pool, v_pool=v_pool,
+                         ctx_lens=ctx + jnp.sum(valid, axis=1,
+                                                dtype=jnp.int32))
+        return self.out_proj(out), new_cache
 
 
 class GPTMLP(nn.Layer):
@@ -171,7 +208,16 @@ class GPTModel(nn.Layer):
         b, s = input_ids.shape
         if position_ids is None:
             position_ids = P.arange(s, dtype="int64").unsqueeze(0)
-            if caches is not None:
+            if caches is not None and "k_pool" in caches[0]:
+                # paged serving path: every slot decodes at its own length;
+                # clip keeps dead slots' garbage positions inside the table
+                import jax.numpy as jnp
+
+                ctx = caches[0]["ctx_lens"]
+                posn = ctx[:, None] + jnp.arange(s, dtype=ctx.dtype)[None, :]
+                position_ids = Tensor(
+                    jnp.clip(posn, 0, self.cfg.max_seq_len - 1))
+            elif caches is not None:
                 p = pos._value if isinstance(pos, Tensor) else pos
                 position_ids = Tensor(position_ids._value + p)
             else:

@@ -11,7 +11,7 @@ import threading
 import time
 
 __all__ = ["stat_add", "stat_set", "stat_get", "stat_reset", "all_stats",
-           "StatTimer"]
+           "stats_with_prefix", "StatTimer"]
 
 _lock = threading.Lock()
 _stats: dict[str, float] = {}
@@ -44,6 +44,13 @@ def stat_reset(name: str | None = None):
 def all_stats() -> dict:
     with _lock:
         return dict(_stats)
+
+
+def stats_with_prefix(prefix: str) -> dict:
+    """Namespaced view of the registry (e.g. the serving_* stats exported by
+    paddle_tpu.serving.metrics)."""
+    with _lock:
+        return {k: v for k, v in _stats.items() if k.startswith(prefix)}
 
 
 class StatTimer:

@@ -29,6 +29,7 @@ def _run(script, extra_env=None, timeout=420):
     ("fluid_legacy.py", None),
     ("auto_parallel_plan.py",
      {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}),
+    ("serving_demo.py", None),
 ])
 def test_example_runs(script, extra):
     proc = _run(script, extra)

@@ -1,0 +1,515 @@
+"""paddle_tpu.serving — paged KV cache, scheduler, and engine invariants,
+plus regression tests for the PR's satellite fixes (executor stale-runner
+eviction across CompiledProgram/clone aliases; pdmodel dead-output name
+reuse; fetch-of-fused-var diagnostics; axis_medium host mapping).
+
+The e2e tests pin the serving contract from the ISSUE: with a fixed
+max_batch/page pool the jitted prefill and decode steps each compile exactly
+once across a run where requests join and leave (compile_counts increments
+inside the traced python bodies, i.e. once per compilation), and every
+request's greedy output is bit-identical to single-request generate().
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.serving import (PagedCacheConfig, PagedKVCache,
+                                PageAllocator, Request, Scheduler,
+                                ServingConfig, ServingEngine)
+from paddle_tpu.serving.kv_cache import NULL_PAGE
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+
+# ------------------------------------------------------- page allocator
+def test_allocator_alloc_free_invariants():
+    a = PageAllocator(8)  # 7 usable; page 0 reserved
+    assert a.num_usable == 7 and a.num_free == 7
+    got = a.alloc(3)
+    assert len(got) == 3 and NULL_PAGE not in got
+    assert a.num_free == 4 and a.pages_in_use == 3
+    # all-or-nothing: an unservable request changes nothing
+    assert a.alloc(5) is None
+    assert a.num_free == 4 and a.pages_in_use == 3
+    a.free(got)
+    assert a.num_free == 7 and a.pages_in_use == 0
+    # double free and foreign pages raise
+    with pytest.raises(ValueError):
+        a.free(got)
+    with pytest.raises(ValueError):
+        a.free([NULL_PAGE])
+    with pytest.raises(ValueError):
+        a.alloc(-1)
+
+
+def test_allocator_reserves_null_page():
+    a = PageAllocator(4)
+    pages = a.alloc(3)
+    assert sorted(pages) == [1, 2, 3]  # page 0 never handed out
+    assert a.alloc(1) is None
+    with pytest.raises(ValueError):
+        PageAllocator(1)  # nothing usable
+
+
+def _cache(num_pages=9, page_size=4, max_batch=2, pages_per_seq=4):
+    return PagedKVCache(PagedCacheConfig(
+        num_layers=1, num_heads=1, head_dim=4, num_pages=num_pages,
+        page_size=page_size, max_batch=max_batch,
+        pages_per_seq=pages_per_seq))
+
+
+def test_cache_admit_grow_release():
+    c = _cache()
+    assert c.admit(0, num_tokens=6)  # 2 pages of 4
+    row = c.page_table[0]
+    assert (row[:2] != NULL_PAGE).all() and (row[2:] == NULL_PAGE).all()
+    # growing within the current page allocates nothing
+    used = c.allocator.pages_in_use
+    assert c.grow(0, 8) and c.allocator.pages_in_use == used
+    assert c.grow(0, 9) and c.allocator.pages_in_use == used + 1
+    with pytest.raises(ValueError):
+        c.grow(0, c.cfg.max_tokens_per_seq + 1)
+    with pytest.raises(ValueError):
+        c.admit(0, 1)  # already admitted
+    c.release(0)
+    assert c.allocator.pages_in_use == 0
+    assert (c.page_table[0] == NULL_PAGE).all()
+
+
+def test_cache_admit_is_all_or_nothing():
+    c = _cache(num_pages=4)  # 3 usable
+    assert c.admit(0, 12)  # takes all 3
+    assert not c.admit(1, 1)
+    assert c.utilization() == 1.0
+    c.release(0)
+    assert c.admit(1, 1)
+    assert 0 < c.utilization() < 1
+
+
+# ------------------------------------------------------------ scheduler
+def _req(n, budget=4):
+    return Request(prompt=np.arange(n, dtype=np.int32),
+                   max_new_tokens=budget)
+
+
+def test_scheduler_fifo_head_of_line_admission():
+    c = _cache(num_pages=6, max_batch=3)  # 5 usable pages
+    s = Scheduler(c, max_batch=3)
+    big = _req(12)    # needs 3 pages
+    small = _req(2)   # needs 1 page
+    tiny = _req(1)
+    s.add(big)
+    s.add(small)
+    s.add(tiny)
+    admitted = s.admit()
+    # FIFO into slots 0,1,2 in arrival order
+    assert [r.rid for r in admitted] == [big.rid, small.rid, tiny.rid]
+    assert [r.slot for r in admitted] == [0, 1, 2]
+    assert s.queue_depth == 0
+
+
+def test_scheduler_head_of_line_blocks_out_of_order_admission():
+    c = _cache(num_pages=5, max_batch=2)  # 4 usable pages
+    s = Scheduler(c, max_batch=2)
+    first = _req(12)   # 3 pages
+    second = _req(8)   # 2 pages — cannot fit alongside first
+    third = _req(1)    # 1 page — WOULD fit, but must not jump the queue
+    s.add(first)
+    s.add(second)
+    s.add(third)
+    admitted = s.admit()
+    assert [r.rid for r in admitted] == [first.rid]
+    assert s.queue_depth == 2 and s.waiting[0] is second
+
+
+def test_scheduler_rejects_never_fitting_request():
+    c = _cache(num_pages=4, pages_per_seq=4)
+    s = Scheduler(c, max_batch=2)
+    with pytest.raises(ValueError):
+        s.add(_req(12, budget=8))  # 20 tokens > 3 usable pages * 4
+
+
+def test_scheduler_preempts_youngest_and_recomputes():
+    c = _cache(num_pages=5, max_batch=2, pages_per_seq=4)  # 4 usable
+    s = Scheduler(c, max_batch=2)
+    old, young = _req(8, budget=6), _req(4, budget=6)
+    s.add(old)
+    s.add(young)
+    assert len(s.admit()) == 2  # 2 + 1 pages
+    young.generated.append(7)  # decoded one token already
+    # old needs page 3 of 4 for token 9; pool is out -> young must yield
+    old.generated.extend([1, 2, 3])
+    preempted = s.ensure_decode_pages()
+    assert [(r.rid, slot) for r, slot in preempted] == [(young.rid, 1)]
+    assert young.state == "waiting" and young.generated == [] \
+        and young.preemptions == 1
+    assert s.waiting[0] is young  # requeued at the FRONT
+    assert s.preemption_count == 1
+    # the survivor got its page
+    assert old.slot == 0 and c.allocator.pages_in_use == 3
+
+
+def test_scheduler_no_spurious_preempt_at_page_boundary():
+    # tokens_resident exactly fills the slot's pages: the pending decode
+    # step writes INSIDE the last page (position tokens_resident - 1), so
+    # no new page is needed — asking for tokens_resident + 1 used to make
+    # a lone request preempt ITSELF against a full pool
+    c = _cache(num_pages=2, page_size=4, max_batch=1)  # 1 usable page
+    s = Scheduler(c, max_batch=1)
+    req = _req(3, budget=1)
+    s.add(req)
+    assert len(s.admit()) == 1
+    req.generated.append(5)  # tokens_resident = 4 = page_size
+    assert s.ensure_decode_pages() == []
+    assert s.preemption_count == 0 and req.slot == 0
+
+
+# ------------------------------------------------------------ engine e2e
+def _toy_model(seed=11):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=48, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _reference(model, prompt, budget):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=budget)
+    return np.asarray(out._value)[0]
+
+
+def test_engine_e2e_churn_parity_and_single_compile():
+    model = _toy_model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 97, (n,)).astype(np.int32)
+               for n in (3, 7, 5, 2, 6, 4)]
+    budgets = [5, 8, 3, 9, 4, 6]
+
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=20, page_size=4, max_prompt_len=8))
+
+    snaps = []
+    rids = [engine.add_request(p, b)
+            for p, b in zip(prompts[:4], budgets[:4])]
+    for _ in range(5):  # requests finish and new ones join mid-stream
+        engine.step()
+        snaps.append(engine.metrics.snapshot())
+    rids += [engine.add_request(p, b)
+             for p, b in zip(prompts[4:], budgets[4:])]
+    while not engine.scheduler.all_done:
+        engine.step()
+        snaps.append(engine.metrics.snapshot())
+    outputs = dict(engine._finished)
+
+    # per-request parity with the single-batch generate() loop
+    for i, rid in enumerate(rids):
+        ref = _reference(model, prompts[i], budgets[i])
+        np.testing.assert_array_equal(ref, outputs[rid],
+                                      err_msg=f"request {i} diverged")
+    # ONE compilation each for prefill and decode across all the churn
+    assert engine.compile_counts == {"prefill": 1, "decode": 1}
+
+    # observability: metrics were live during the run
+    totals = [s.get("serving_tokens_total", 0) for s in snaps]
+    assert totals == sorted(totals), "token counter must be monotonic"
+    assert totals[-1] == sum(budgets)
+    assert any(s.get("serving_queue_depth", 0) > 0 for s in snaps), \
+        "with max_batch=2 and 4 queued requests the queue must back up"
+    assert any(s.get("serving_page_utilization", 0) > 0 for s in snaps)
+    assert any(s.get("serving_tokens_per_sec", 0) > 0 for s in snaps)
+    assert snaps[-1]["serving_decode_steps"] > 0
+    # pool fully drains when every request retires
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_engine_preemption_under_page_pressure():
+    model = _toy_model(seed=13)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 97, (n,)).astype(np.int32)
+               for n in (6, 5, 4)]
+    budgets = [10, 9, 8]
+    # pool sized so concurrent decodes run out of pages mid-stream
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=3, num_pages=8, page_size=4, max_prompt_len=8))
+    rids = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+    outputs = engine.run()
+    assert engine.scheduler.preemption_count > 0, \
+        "pool of 7 usable pages must preempt (needs 11 pages peak)"
+    assert engine.metrics.snapshot()["serving_preemptions_total"] > 0
+    for i, rid in enumerate(rids):  # greedy recompute is deterministic
+        np.testing.assert_array_equal(
+            _reference(model, prompts[i], budgets[i]), outputs[rid])
+
+
+def test_engine_run_returns_only_this_calls_completions():
+    model = _toy_model(seed=17)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8))
+    p = np.arange(1, 5, dtype=np.int32)
+    r1 = engine.add_request(p, 3)
+    out1 = engine.run()
+    assert set(out1) == {r1}
+    r2 = engine.add_request(p + 1, 3)
+    out2 = engine.run()
+    assert set(out2) == {r2}, "run() must not replay earlier completions"
+    # finished requests leave the per-request bookkeeping immediately…
+    assert engine._requests == {}
+    # …and pop_finished drains the retained outputs (server memory bound)
+    drained = engine.pop_finished()
+    assert set(drained) == {r1, r2}
+    assert engine.pop_finished() == {}
+    np.testing.assert_array_equal(drained[r1], out1[r1])
+
+
+def test_engine_rejects_oversized_requests():
+    model = _toy_model()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=16, page_size=4, max_prompt_len=8))
+    with pytest.raises(ValueError):
+        engine.add_request(np.zeros(9, np.int32), 4)  # prompt > bucket
+    with pytest.raises(ValueError):
+        engine.add_request(np.zeros(4, np.int32), 0)  # no budget
+    with pytest.raises(ValueError):
+        engine.add_request(np.zeros((2, 2), np.int32), 4)  # not 1-D
+    with pytest.raises(ValueError):
+        # empty prompt would sample from a padding position's logits
+        engine.add_request(np.zeros(0, np.int32), 4)
+
+
+# ----------------------------------------- satellite: executor eviction
+def _build_prog(static):
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [2, 4])
+        w = paddle.to_tensor(np.random.rand(4, 4).astype("float32"))
+        y = paddle.matmul(x, w)
+    return prog, y
+
+
+def test_compiled_program_shares_underlying_serial():
+    from paddle_tpu import static
+    from paddle_tpu.static.executor import CompiledProgram
+
+    static.enable_static()
+    try:
+        prog, y = _build_prog(static)
+        cp = CompiledProgram(prog)
+        exe = static.Executor()
+        xv = np.random.rand(2, 4).astype("float32")
+        exe.run(prog, feed={"x": xv}, fetch_list=[y])
+        exe.run(cp, feed={"x": xv}, fetch_list=[y])
+        # the serial is stamped on the underlying Program, never the wrapper
+        assert "_exec_serial" not in cp.__dict__
+        serials = {k[0] for k in exe._cache}
+        assert serials == {prog._exec_serial}
+    finally:
+        static.disable_static()
+
+
+def test_clone_alias_runners_co_evict_on_pass_bump():
+    from paddle_tpu import static
+    from paddle_tpu.static.executor import CompiledProgram
+    from paddle_tpu.static.passes import new_pass
+
+    static.enable_static()
+    try:
+        prog, y = _build_prog(static)
+        clone = prog.clone()
+        cp = CompiledProgram(prog)
+        exe = static.Executor()
+        xv = np.random.rand(2, 4).astype("float32")
+        # distinct feed keys would collide; same key set -> same cache key
+        # except for the serial, so give the clone a different fetch shape
+        exe.run(prog, feed={"x": xv}, fetch_list=[y])
+        exe.run(clone, feed={"x": xv}, fetch_list=[y])
+        exe.run(cp, feed={"x": xv}, fetch_list=[y])
+        v0 = getattr(prog.global_block, "_version", 0)
+        assert {k[1] for k in exe._cache} == {v0}
+        new_pass("fuse_gemm_epilogue").apply(prog)  # bumps the shared block
+        exe.run(prog, feed={"x": xv}, fetch_list=[y])
+        # the clone's (and wrapper's) stale pre-pass runners co-evicted:
+        # nothing in the cache references the old block version
+        assert {k[1] for k in exe._cache} == {v0 + 1}
+    finally:
+        static.disable_static()
+
+
+def test_dead_program_serial_pruned_from_block_groups():
+    import gc
+
+    from paddle_tpu import static
+
+    static.enable_static()
+    try:
+        exe = static.Executor()
+        prog, y = _build_prog(static)
+        xv = np.random.rand(2, 4).astype("float32")
+        exe.run(prog, feed={"x": xv}, fetch_list=[y])
+        serial = prog._exec_serial
+        assert any(serial in g for g in exe._block_serials.values())
+        # the cached runner closes over the program tape, so the program
+        # can only die once its entries are evicted (e.g. a version bump)
+        exe._cache.clear()
+        del prog, y
+        gc.collect()
+        # the finalizer must then drop the serial from its co-eviction
+        # group — otherwise every discarded Program leaks a _block_serials
+        # entry for the life of the executor
+        assert not any(serial in g for g in exe._block_serials.values())
+    finally:
+        static.disable_static()
+
+
+# --------------------------------------- satellite: fetch of a fused var
+def test_fetch_of_fused_away_var_names_the_pass():
+    from paddle_tpu import static
+    from paddle_tpu.static.passes import new_pass
+
+    static.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4])
+            w = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+            b = paddle.to_tensor(np.random.rand(8).astype("float32"))
+            y = paddle.matmul(x, w)  # interior: consumed by the fusion
+            out = y + b
+        ctx = new_pass("fuse_gemm_epilogue").apply(prog)
+        assert ctx.attrs["fused_gemm_epilogue"] >= 1
+        exe = static.Executor()
+        xv = np.random.rand(2, 4).astype("float32")
+        (ov,) = exe.run(prog, feed={"x": xv}, fetch_list=[out])
+        assert ov.shape == (2, 8)
+        with pytest.raises(ValueError, match="fuse_gemm_epilogue"):
+            exe.run(prog, feed={"x": xv}, fetch_list=[y])
+    finally:
+        static.disable_static()
+
+
+# ------------------------------------- satellite: pdmodel BN name reuse
+def _bn_op(x="h", y="y"):
+    # inference-style batch_norm: MeanOut/VarianceOut REUSE the input
+    # names, as real Paddle exports do
+    return {"type": "batch_norm",
+            "inputs": {"X": [x], "Scale": ["bn_s"], "Bias": ["bn_b"],
+                       "Mean": ["bn_m"], "Variance": ["bn_v"]},
+            "outputs": {"Y": [y], "MeanOut": ["bn_m"],
+                        "VarianceOut": ["bn_v"], "SavedMean": ["sm"],
+                        "SavedVariance": ["sv"]},
+            "attrs": {"epsilon": 1e-5}}
+
+
+def test_pdmodel_passes_ignore_dead_output_name_reuse():
+    from paddle_tpu.inference.pdmodel import apply_inference_passes
+
+    ops = [
+        {"type": "relu", "inputs": {"X": ["x"]}, "outputs": {"Out": ["h"]},
+         "attrs": {}},
+        _bn_op(),
+        {"type": "dropout", "inputs": {"X": ["y"]},
+         "outputs": {"Out": ["o"]},
+         "attrs": {"dropout_implementation": "upscale_in_train",
+                   "dropout_prob": 0.5}},
+    ]
+    live = {"x", "bn_s", "bn_b", "bn_m", "bn_v"}
+    new_ops, fetch, stats = apply_inference_passes(ops, ["o"],
+                                                   live_names=live)
+    # the dead MeanOut/VarianceOut rewrites must NOT disable the passes
+    assert "skipped" not in stats
+    assert stats["delete_dropout"] == 1
+    assert fetch == ["y"]
+    assert [op["type"] for op in new_ops] == ["relu", "batch_norm"]
+
+
+def test_pdmodel_passes_fold_conv_bn_despite_dead_reuse():
+    from paddle_tpu.inference.pdmodel import apply_inference_passes
+
+    ops = [
+        {"type": "conv2d",
+         "inputs": {"Input": ["x"], "Filter": ["w"]},
+         "outputs": {"Output": ["c"]}, "attrs": {}},
+        _bn_op(x="c"),
+    ]
+    params = {"w": np.random.rand(3, 2, 1, 1).astype(np.float32),
+              "bn_s": np.ones(3, np.float32),
+              "bn_b": np.zeros(3, np.float32),
+              "bn_m": np.zeros(3, np.float32),
+              "bn_v": np.ones(3, np.float32)}
+    live = {"x"} | set(params)
+    new_ops, _, stats = apply_inference_passes(ops, ["y"], live_names=live,
+                                               params=params)
+    assert stats.get("conv_bn_fuse") == 1, \
+        "the headline conv+BN fold must fire on a real-export-shaped BN"
+    assert [op["type"] for op in new_ops] == ["conv2d", "elementwise_add"]
+
+
+def test_pdmodel_passes_still_bail_on_live_reuse():
+    from paddle_tpu.inference.pdmodel import apply_inference_passes
+
+    # the reused name IS read downstream -> folding is unsound -> bail
+    ops = [
+        {"type": "assign", "inputs": {"X": ["x"]},
+         "outputs": {"Out": ["y"]}, "attrs": {}},
+        {"type": "relu", "inputs": {"X": ["x"]},
+         "outputs": {"Out": ["x"]}, "attrs": {}},
+        {"type": "elementwise_add", "inputs": {"X": ["y"], "Y": ["x"]},
+         "outputs": {"Out": ["out"]}, "attrs": {}},
+    ]
+    same, _, stats = apply_inference_passes(ops, ["out"], live_names={"x"})
+    assert same is ops and stats.get("skipped") == "in-place var-name reuse"
+    # a fetched rewrite is live even with no downstream op
+    ops2 = [{"type": "relu", "inputs": {"X": ["z"]},
+             "outputs": {"Out": ["x"]}, "attrs": {}}]
+    _, _, stats2 = apply_inference_passes(ops2, ["x"],
+                                          live_names={"x", "z"})
+    assert stats2.get("skipped") == "in-place var-name reuse"
+
+
+def test_pdmodel_passes_bail_on_pre_overwrite_copy():
+    from paddle_tpu.inference.pdmodel import apply_inference_passes
+
+    # assign copies x BEFORE the in-place overwrite; alias folding would
+    # rewrite y's reader to read post-overwrite x — must bail even though
+    # no op reads x after the overwrite
+    ops = [
+        {"type": "assign", "inputs": {"X": ["x"]},
+         "outputs": {"Out": ["y"]}, "attrs": {}},
+        {"type": "relu", "inputs": {"X": ["x"]},
+         "outputs": {"Out": ["x"]}, "attrs": {}},
+        {"type": "sigmoid", "inputs": {"X": ["y"]},
+         "outputs": {"Out": ["out"]}, "attrs": {}},
+    ]
+    same, _, stats = apply_inference_passes(ops, ["out"], live_names={"x"})
+    assert same is ops and stats.get("skipped") == "in-place var-name reuse"
+
+
+# --------------------------------------- satellite: axis_medium mapping
+def test_axis_medium_checks_actual_hosts_not_span():
+    from paddle_tpu.distributed.auto_parallel.cluster import Cluster
+
+    c = Cluster(accelerator_type="v5p", n_hosts=2, chips_per_host=6)
+    # span 4 <= 6, but group {4, 6} straddles hosts 0 and 1
+    assert c.axis_medium(2, stride=2) == "dcn"
+    # contiguous tilings that align with hosts stay ICI
+    assert c.axis_medium(6, stride=1) == "ici"
+    assert c.axis_medium(2, stride=6) == "dcn"
+    # explicit groups win over the synthesized tiling
+    assert c.axis_medium(2, stride=2, groups=[[0, 2], [1, 3]]) == "ici"
+    assert c.axis_medium(2, stride=2, groups=[[4, 6]]) == "dcn"
+    # an empty enumeration (span overruns the cluster) fails CLOSED
+    assert c.axis_medium(4, stride=4) == "dcn"
+
+
+def test_mapper_placement_uses_actual_groups():
+    from paddle_tpu.distributed.auto_parallel.cluster import Cluster
+    from paddle_tpu.distributed.auto_parallel.mapper import map_mesh
+
+    c = Cluster(accelerator_type="v5p", n_hosts=2, chips_per_host=6)
+    ids, placement = map_mesh(c, {"dp": 2, "mp": 6},
+                              comm_bytes={"mp": 2.0, "dp": 1.0})
+    # mp (innermost, stride 1, size 6) tiles each host exactly -> ici;
+    # dp pairs rank r with r+6 across hosts -> dcn
+    assert placement["mp"] == "ici"
+    assert placement["dp"] == "dcn"
