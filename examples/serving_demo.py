@@ -7,13 +7,16 @@ Queues a burst of staggered requests against a toy GPT, drives the engine to
 completion, and asserts the serving invariants: per-request outputs identical
 to single-request generate(), exactly one compilation of the prefill and
 decode steps despite requests joining/leaving, and live serving metrics.
+Phase two replays the burst against the resilience layer: a deadline blown
+by an injected stall, a cancellation, and swap-style preemption — all
+deterministic (virtual clock, no sleeps).
 """
 import _common  # noqa: F401
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.serving import ServingConfig, ServingEngine
+from paddle_tpu.serving import FaultInjector, ServingConfig, ServingEngine
 from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
 
 
@@ -54,6 +57,41 @@ def main():
           f"tokens, {snap['serving_decode_steps']:.0f} decode steps, "
           f"{snap.get('serving_preemptions_total', 0):.0f} preemptions, "
           f"compiles={engine.compile_counts}")
+
+    # ---- resilience: deadline + cancel + injected stall, swap preemption
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    # a 3-usable-page pool: the two survivors need 4 pages at peak, so the
+    # run MUST swap-preempt one of them and resume it with tokens intact
+    inj = FaultInjector().arm("slow_step", step=2, delay_s=60.0)
+    eng2 = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=4, page_size=8, max_prompt_len=16,
+        max_waiting=4, shed_policy="shed-oldest", preemption_mode="swap"),
+        clock=Clock(), fault_injector=inj)
+    keep = eng2.add_request(prompts[0], budgets[0])
+    dead = eng2.add_request(prompts[1], 8, deadline_s=30.0)  # blown at step 2
+    gone = eng2.add_request(prompts[2], 8)
+    keep2 = eng2.add_request(prompts[5], 10)
+    assert eng2.cancel(gone)
+    outs2 = eng2.run(budget_s=600.0)
+    assert set(outs2) == {keep, keep2}
+    for rid, i, b in ((keep, 0, budgets[0]), (keep2, 5, 10)):
+        ref = np.asarray(model.generate(
+            Tensor(prompts[i][None]), max_new_tokens=b)._value)[0]
+        assert np.array_equal(ref, outs2[rid]), "survivor diverged"
+    assert eng2.status(dead) == "expired" and eng2.status(gone) == "cancelled"
+    assert eng2.cache.allocator.pages_in_use == 0
+    snap2 = eng2.metrics.snapshot()
+    assert snap2["serving_swap_outs"] >= 1, "demo pool must force a swap"
+    assert snap2["serving_swap_ins"] == snap2["serving_swap_outs"]
+    print(f"resilience: survivor parity OK; expired="
+          f"{snap2['serving_expired']:.0f} cancelled="
+          f"{snap2['serving_cancelled']:.0f} swaps="
+          f"{snap2['serving_swap_outs']:.0f} after an injected 60s stall")
     print("serving_demo OK")
 
 

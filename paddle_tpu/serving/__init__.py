@@ -6,12 +6,19 @@ preemption scheduler, and an engine whose decode step is ONE jitted
 computation over static shapes — requests joining and leaving the batch
 never recompile. Reference shape: Ragged Paged Attention (arxiv 2604.15464)
 and the vLLM continuous-batching loop, restated TPU-native.
+
+Resilience layer: per-request deadlines + cancellation, bounded-queue
+backpressure (reject / shed-oldest), swap-style preemption to host memory,
+and a deterministic fault-injection harness (serving/faults.py).
 """
 from .engine import ServingConfig, ServingEngine  # noqa: F401
-from .kv_cache import PagedCacheConfig, PagedKVCache, PageAllocator  # noqa: F401
+from .faults import FaultInjector, InjectedFault  # noqa: F401
+from .kv_cache import (PagedCacheConfig, PagedKVCache,  # noqa: F401
+                       PageAllocator, SwapHandle)
 from .metrics import ServingMetrics  # noqa: F401
-from .scheduler import Request, Scheduler  # noqa: F401
+from .scheduler import EngineOverloaded, Request, Scheduler  # noqa: F401
 
 __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
-           "PagedKVCache", "PageAllocator", "ServingMetrics", "Request",
-           "Scheduler"]
+           "PagedKVCache", "PageAllocator", "SwapHandle", "ServingMetrics",
+           "Request", "Scheduler", "EngineOverloaded", "FaultInjector",
+           "InjectedFault"]

@@ -3,19 +3,45 @@
 Static-shape discipline is the whole design: the decode step is a single
 ``jax.jit``-compiled function of (params, pools, page_table [max_batch,
 pages_per_seq], ctx_lens [max_batch], last_tok [max_batch], active
-[max_batch], key) — every array keeps its shape for the life of the engine,
-so requests joining and leaving the batch NEVER retrigger compilation (the
-e2e test asserts exactly-one trace per function via ``compile_counts``).
-Prefill is its own once-compiled step: prompts are right-padded to the
-``max_prompt_len`` bucket and the real length rides in as an array.
+[max_batch], rids [max_batch], gen_idx [max_batch]) — every array keeps its
+shape for the life of the engine, so requests joining and leaving the batch
+NEVER retrigger compilation (the e2e test asserts exactly-one trace per
+function via ``compile_counts``). Prefill is its own once-compiled step:
+prompts are right-padded to the ``max_prompt_len`` bucket and the real
+length rides in as an array.
 
 Decode semantics match text/generation.py: prefill picks the first token
 from the last prompt logit, each decode step feeds the previous token back
 in, writes its KV at position ctx, and samples the next — so per-request
-greedy outputs are identical to single-request ``generate``.
+greedy outputs are identical to single-request ``generate``. Sampling PRNG
+keys are derived in-jit from (engine seed, rid, token index): a request's
+token stream is a pure function of its identity, so a RECOMPUTE-preempted
+sampling request replays its original tokens instead of resampling.
+
+Resilience layer:
+
+- per-request deadlines (``add_request(..., deadline_s=)``) swept at every
+  step boundary, and ``cancel(rid)`` — both retire a request from waiting OR
+  running state and free its slot + pages;
+- admission backpressure: ``max_waiting`` bounds the queue, ``shed_policy``
+  picks reject (EngineOverloaded) vs shed-oldest;
+- swap-style preemption (``preemption_mode="swap"``) resumes preempted
+  requests with their generated tokens intact;
+- a deterministic fault-injection harness (serving/faults.py) consulted at
+  step boundaries: a faulted step retires only the affected requests as
+  FAILED (exception recorded on the request) and keeps serving the rest —
+  faults fire BEFORE the mutation they poison, so host scheduler/cache
+  state stays exactly the pre-step state minus the retired request;
+- ``run(budget_s=...)``: a wall-clock budget that pauses admission and
+  drains in-flight work instead of raising mid-stream.
+
+The engine clock is pluggable (``clock=``, default time.monotonic) and the
+``slow_step`` fault point advances a virtual skew on top of it, so every
+deadline/budget behavior is testable without sleeping.
 """
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass
 
@@ -25,9 +51,11 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..text.generation import sample_logits
+from .faults import InjectedFault
 from .kv_cache import PagedCacheConfig, PagedKVCache
 from .metrics import ServingMetrics
-from .scheduler import Request, Scheduler
+from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, RUNNING,
+                        WAITING, EngineOverloaded, Request, Scheduler)
 
 
 @dataclass(frozen=True)
@@ -44,6 +72,9 @@ class ServingConfig:
     eos_token_id: int | None = None
     pad_token_id: int = 0
     seed: int = 0
+    max_waiting: int = 0  # waiting-queue bound; 0 = unbounded
+    shed_policy: str = "reject"  # "reject" | "shed-oldest" when queue full
+    preemption_mode: str = "recompute"  # "recompute" | "swap"
 
 
 class ServingEngine:
@@ -51,7 +82,8 @@ class ServingEngine:
     model exposing ``functional_state``/``functional_call`` with the paged
     cache contract of text/gpt.py works)."""
 
-    def __init__(self, model, config: ServingConfig | None = None):
+    def __init__(self, model, config: ServingConfig | None = None,
+                 clock=None, fault_injector=None):
         self.config = cfg = config or ServingConfig()
         self.model = model
         model.eval()
@@ -68,16 +100,25 @@ class ServingEngine:
             num_pages=cfg.num_pages, page_size=cfg.page_size,
             max_batch=cfg.max_batch, pages_per_seq=pages_per_seq,
             dtype=model.gpt.wte.weight._value.dtype))
-        self.scheduler = Scheduler(self.cache, cfg.max_batch)
+        self.scheduler = Scheduler(
+            self.cache, cfg.max_batch, max_waiting=cfg.max_waiting,
+            shed_policy=cfg.shed_policy, preemption_mode=cfg.preemption_mode)
         self.metrics = ServingMetrics()
         params, _ = model.functional_state()
         self._p = {k: v._value for k, v in params.items()}
-        self._key = jax.random.key(cfg.seed)
+        self._clock = clock or time.monotonic
+        self._skew = 0.0  # virtual seconds injected by slow_step faults
+        self._fault_injector = fault_injector
+        self._step_idx = 0
+        self.admit_paused = False  # run(budget_s=) drain; settable by callers
         b = cfg.max_batch
         self._ctx = np.zeros(b, np.int32)
         self._last_tok = np.full(b, cfg.pad_token_id, np.int32)
         self._active = np.zeros(b, bool)
+        self._rids = np.zeros(b, np.int32)  # per-slot rid (PRNG stream id)
+        self._gen = np.zeros(b, np.int32)   # per-slot generated-token count
         self._finished: dict[int, np.ndarray] = {}
+        self._retired: dict[int, Request] = {}  # cancelled/expired/failed/shed
         self._requests: dict[int, Request] = {}
         # trace counters: the python bodies run only when jax (re)traces,
         # i.e. exactly once per compilation — the e2e compile-once hook
@@ -91,12 +132,18 @@ class ServingEngine:
         self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
 
     # --------------------------------------------------------- jitted steps
-    def _pick(self, logits, key):
+    def _req_key(self, rid, t):
+        """PRNG key for request ``rid``'s token ``t``: fold (seed, rid,
+        token index). Identity-derived, not a split chain — preemption and
+        batch churn cannot shift any other request's stream, and a replayed
+        request reproduces its own."""
+        base = jax.random.key(self.config.seed)
+        return jax.random.fold_in(jax.random.fold_in(base, rid), t)
+
+    def _sample_row(self, logits_row, key):
         cfg = self.config
-        if cfg.do_sample:
-            return sample_logits(logits, key, cfg.temperature, cfg.top_k,
-                                 cfg.top_p)
-        return jnp.argmax(logits, axis=-1)
+        return sample_logits(logits_row[None, :], key, cfg.temperature,
+                             cfg.top_k, cfg.top_p)[0]
 
     def _run_model(self, p_arrays, pools, table, ctx, valid, ids):
         caches = [dict(pl, page_table=table, ctx_lens=ctx, valid=valid)
@@ -108,7 +155,7 @@ class ServingEngine:
         return logits._value, new_pools
 
     def _prefill_impl(self, p_arrays, pools, padded_ids, prompt_len,
-                      page_row, key):
+                      page_row, rid):
         """One request's prompt in one pass: padded_ids [max_prompt_len],
         prompt_len scalar, page_row [pages_per_seq]. Returns (new_pools,
         first sampled token)."""
@@ -120,27 +167,44 @@ class ServingEngine:
         logits, new_pools = self._run_model(
             p_arrays, pools, table, ctx, valid, padded_ids[None, :])
         last = logits[0, prompt_len - 1, :]
-        tok = self._pick(last[None, :], key)[0]
+        if self.config.do_sample:
+            tok = self._sample_row(last, self._req_key(rid, 0))
+        else:
+            tok = jnp.argmax(last, axis=-1)
         return new_pools, tok.astype(jnp.int32)
 
     def _decode_impl(self, p_arrays, pools, table, ctx, last_tok, active,
-                     key):
+                     rids, gen_idx):
         """One token for every running slot. Inactive slots run the same
         computation against the null page and emit pad — branch-free, so the
         batch composition never changes the compiled program."""
         self.compile_counts["decode"] += 1
         logits, new_pools = self._run_model(
             p_arrays, pools, table, ctx, active[:, None], last_tok[:, None])
-        tok = self._pick(logits[:, -1, :], key)
+        last = logits[:, -1, :]
+        if self.config.do_sample:
+            keys = jax.vmap(self._req_key)(rids, gen_idx)
+            tok = jax.vmap(self._sample_row)(last, keys)
+        else:
+            tok = jnp.argmax(last, axis=-1)
         tok = jnp.where(active, tok,
                         jnp.asarray(self.config.pad_token_id)).astype(jnp.int32)
         return new_pools, tok
 
     # ------------------------------------------------------------ host loop
-    def add_request(self, prompt, max_new_tokens: int) -> int:
-        """Queue a prompt; returns the request id. Raises when the request
-        could never fit (prompt too long for the bucket, the model, or the
-        whole pool)."""
+    def now(self) -> float:
+        """Engine time: the pluggable clock plus any slow_step fault skew —
+        the time base for deadlines and run() budgets."""
+        return self._clock() + self._skew
+
+    def add_request(self, prompt, max_new_tokens: int,
+                    deadline_s: float | None = None) -> int:
+        """Queue a prompt; returns the request id. ``deadline_s`` is a
+        wall-clock budget from now — a request still waiting or running when
+        it elapses is retired EXPIRED at the next step boundary. Raises
+        ValueError when the request could never fit (prompt too long for the
+        bucket, the model, or the whole pool) and EngineOverloaded when the
+        bounded waiting queue is full under the reject policy."""
         prompt = np.asarray(
             prompt._value if isinstance(prompt, Tensor) else prompt)
         if prompt.ndim != 1:
@@ -161,19 +225,89 @@ class ServingEngine:
                 f"prompt_len + max_new_tokens = {total} exceeds max_seq_len "
                 f"{self.model.cfg.max_seq_len}")
         req = Request(prompt=prompt.astype(np.int32),
-                      max_new_tokens=int(max_new_tokens))
-        self.scheduler.add(req)  # validates against pool capacity
+                      max_new_tokens=int(max_new_tokens),
+                      deadline=(self.now() + float(deadline_s)
+                                if deadline_s is not None else None))
+        try:
+            shed = self.scheduler.add(req)  # validates against pool capacity
+        except EngineOverloaded:
+            self.metrics.on_rejected()
+            raise
+        if shed is not None:
+            self._requests.pop(shed.rid, None)
+            self._retired[shed.rid] = shed
+            self.metrics.on_shed()
         self._requests[req.rid] = req
         return req.rid
 
-    def _split_key(self):
-        self._key, sub = jax.random.split(self._key)
-        return sub
+    def cancel(self, rid: int) -> bool:
+        """Retire a waiting or running request, freeing its slot and pages.
+        True when something was cancelled; False for unknown or already
+        terminal requests."""
+        req = self._requests.get(rid)
+        if req is None or req.state not in (WAITING, RUNNING):
+            return False
+        self._retire(req, CANCELLED)
+        self.metrics.on_cancelled()
+        return True
+
+    def status(self, rid: int) -> str:
+        """Lifecycle state of a request: waiting/running/finished/cancelled/
+        expired/failed/shed. KeyError for an unknown rid."""
+        if rid in self._requests:
+            return self._requests[rid].state
+        if rid in self._finished:
+            return FINISHED
+        if rid in self._retired:
+            return self._retired[rid].state
+        raise KeyError(f"unknown request {rid}")
+
+    def request(self, rid: int) -> Request | None:
+        """The live or retired Request object (e.g. to read ``.error`` off a
+        FAILED request); None for finished/unknown rids."""
+        return self._requests.get(rid) or self._retired.get(rid)
+
+    def _retire(self, req: Request, state: str,
+                error: BaseException | None = None) -> None:
+        """Terminal exit for a non-finished request: pull it out of waiting
+        or running (slot + pages + swap handle freed) and record it."""
+        slot = self.scheduler.evict(req)
+        if slot is not None:
+            self._clear_slot(slot)
+        req.state, req.error = state, error
+        self._requests.pop(req.rid, None)
+        self._retired[req.rid] = req
+
+    def _sweep_deadlines(self) -> None:
+        with_deadline = [r for r in self._requests.values()
+                         if r.deadline is not None]
+        if not with_deadline:
+            return
+        now = self.now()
+        for req in with_deadline:
+            if now >= req.deadline and req.state in (WAITING, RUNNING):
+                self._retire(req, EXPIRED)
+                self.metrics.on_expired()
 
     def _clear_slot(self, slot: int) -> None:
         self._active[slot] = False
         self._ctx[slot] = 0
         self._last_tok[slot] = self.config.pad_token_id
+        self._rids[slot] = 0
+        self._gen[slot] = 0
+
+    def _preempt_one(self, req: Request, slot: int | None = None) -> None:
+        """The one preemption recipe — the injected pool_exhausted path and
+        the real ensure_decode_pages path share it: vacate the slot and
+        account the preemption (swap mode also counts a swap_out). ``slot``
+        is the already-vacated slot when the scheduler preempted the request
+        itself; None preempts here."""
+        if slot is None:
+            slot = self.scheduler.preempt(req)
+        self._clear_slot(slot)
+        self.metrics.on_preempt()
+        if self.config.preemption_mode == "swap":
+            self.metrics.on_swap_out()
 
     def _maybe_finish(self, req: Request, tok: int) -> bool:
         eos = self.config.eos_token_id
@@ -187,37 +321,111 @@ class ServingEngine:
             return True
         return False
 
+    def _state_summary(self) -> str:
+        s = self.scheduler
+        waiting = [r.rid for r in itertools.islice(s.waiting, 8)]
+        more = "..." if s.queue_depth > 8 else ""
+        active = sorted(r.rid for r in s.running.values())
+        return (f"step={self._step_idx}, queue_depth={s.queue_depth} "
+                f"(waiting rids {waiting}{more}), active rids {active}, "
+                f"pages_in_use={self.cache.allocator.pages_in_use}/"
+                f"{self.cache.cfg.usable_pages}")
+
     def step(self) -> list[int]:
-        """One continuous-batching iteration: admit + prefill joiners, one
-        decode step for the whole batch, retire finishers. Returns the
-        request ids that finished during this step."""
+        """One continuous-batching iteration: sweep deadlines, admit +
+        prefill (or swap-resume) joiners, one decode step for the whole
+        batch, retire finishers. Returns the request ids that finished
+        during this step. Injected faults retire only the requests they
+        name; everything else keeps being served."""
         from .. import profiler
 
+        # the ONLY injector read of the step (pinned by a test): the
+        # uninstalled path costs one attribute lookup and None-checks
+        inj = self._fault_injector
+        step_idx = self._step_idx
+        self._step_idx += 1
+        if inj is not None:
+            slow = inj.hit("slow_step", step=step_idx)
+            if slow is not None:
+                self._skew += slow.delay_s
+        self._sweep_deadlines()
+
         finished_now = []
-        for req in self.scheduler.admit():
+        # a paused engine (run(budget_s=) drain) admits no NEWCOMERS, but
+        # still resumes preemption victims — they are in-flight work
+        admitted = self.scheduler.admit(resume_only=self.admit_paused)
+        for req in admitted:
+            if req.generated:  # swap-resume: KV restored by admit(); there
+                slot = req.slot   # is no prefill here for prefill_fail to hit
+                self._ctx[slot] = req.prompt_len + len(req.generated) - 1
+                self._last_tok[slot] = req.generated[-1]
+                self._active[slot] = True
+                self._rids[slot] = req.rid
+                self._gen[slot] = len(req.generated)
+                req.fresh = True
+                self.metrics.on_swap_in()
+                continue
+            if inj is not None and \
+                    inj.hit("prefill_fail", step=step_idx, rid=req.rid):
+                # consulted before the jitted prefill touches the pools:
+                # undoing the admission IS the pre-step state, minus req
+                self._retire(req, FAILED, InjectedFault(
+                    f"prefill_fail injected (step {step_idx}, "
+                    f"rid {req.rid})"))
+                self.metrics.on_failed()
+                continue
             with profiler.RecordEvent("serving::prefill"):
                 padded = np.full(self.config.max_prompt_len,
                                  self.config.pad_token_id, np.int32)
                 padded[:req.prompt_len] = req.prompt
-                pools, tok = self._prefill_jit(
-                    self._p, self.cache.pools, jnp.asarray(padded),
-                    jnp.asarray(req.prompt_len, jnp.int32),
-                    jnp.asarray(self.cache.page_table[req.slot]),
-                    self._split_key())
+                try:
+                    pools, tok = self._prefill_jit(
+                        self._p, self.cache.pools, jnp.asarray(padded),
+                        jnp.asarray(req.prompt_len, jnp.int32),
+                        jnp.asarray(self.cache.page_table[req.slot]),
+                        jnp.asarray(req.rid, jnp.int32))
+                except Exception as e:  # noqa: BLE001 — isolate the request
+                    if any(arr.is_deleted() for pl in self.cache.pools
+                           for arr in pl.values()):
+                        # the failure landed after donation consumed the
+                        # pools: every sequence's KV is gone, so "retire one
+                        # request and keep serving" would hand the rest
+                        # deleted buffers — engine-fatal, not isolable
+                        raise
+                    self._retire(req, FAILED, e)
+                    self.metrics.on_failed()
+                    continue
             self.cache.pools = pools
             tok = int(tok)
             req.generated.append(tok)
             self._ctx[req.slot] = req.prompt_len
             self._last_tok[req.slot] = tok
             self._active[req.slot] = True
+            self._rids[req.slot] = req.rid
+            self._gen[req.slot] = 1
+            req.fresh = True
             self.metrics.on_prefill()
             self.metrics.on_tokens(1)
             if self._maybe_finish(req, tok):
                 finished_now.append(req.rid)
 
-        for _req, slot in self.scheduler.ensure_decode_pages():
-            self._clear_slot(slot)
-            self.metrics.on_preempt()
+        if inj is not None:
+            for slot in np.nonzero(self._active)[0]:
+                req = self.scheduler.running.get(int(slot))
+                if req is not None and \
+                        inj.hit("decode_fail", step=step_idx, rid=req.rid):
+                    # before the decode launches: the failed request leaves,
+                    # the rest of the batch decodes normally this very step
+                    self._retire(req, FAILED, InjectedFault(
+                        f"decode_fail injected (step {step_idx}, "
+                        f"rid {req.rid})"))
+                    self.metrics.on_failed()
+            if self.scheduler.running and \
+                    inj.hit("pool_exhausted", step=step_idx):
+                self._preempt_one(self.scheduler.pick_victim())
+
+        for req, slot in self.scheduler.ensure_decode_pages():
+            self._preempt_one(req, slot)
 
         if self._active.any():
             with profiler.RecordEvent("serving::decode"):
@@ -225,7 +433,8 @@ class ServingEngine:
                     self._p, self.cache.pools,
                     jnp.asarray(self.cache.page_table),
                     jnp.asarray(self._ctx), jnp.asarray(self._last_tok),
-                    jnp.asarray(self._active), self._split_key())
+                    jnp.asarray(self._active), jnp.asarray(self._rids),
+                    jnp.asarray(self._gen))
             self.cache.pools = pools
             toks = np.asarray(toks)
             self.metrics.on_decode_step()
@@ -234,8 +443,10 @@ class ServingEngine:
                 req = self.scheduler.running[int(slot)]
                 tok = int(toks[slot])
                 req.generated.append(tok)
+                req.fresh = False  # it has decoded: fair game for preemption
                 self._ctx[slot] += 1
                 self._last_tok[slot] = tok
+                self._gen[slot] += 1
                 n_new += 1
                 if self._maybe_finish(req, tok):
                     finished_now.append(req.rid)
@@ -248,18 +459,39 @@ class ServingEngine:
             usable_pages=self.cache.cfg.usable_pages)
         return finished_now
 
-    def run(self, max_steps: int = 100000) -> dict[int, np.ndarray]:
+    def run(self, max_steps: int = 100000,
+            budget_s: float | None = None) -> dict[int, np.ndarray]:
         """Drive step() until every queued request finished; returns
         {request_id: [prompt + generated] token array} for the requests that
-        finished during THIS call (not historical completions)."""
+        finished during THIS call (not historical completions).
+
+        ``budget_s`` is a wall-clock budget on engine time (now()): when it
+        elapses, admission pauses and the in-flight batch — including any
+        preemption victims, which still resume while paused — drains
+        gracefully; never-admitted requests stay queued for a later
+        run()/step(). A caller-set ``admit_paused`` is honored the same way
+        (drain and return) and survives the call. The step budget remains a
+        hard backstop against a stuck engine."""
         steps = 0
         done: dict[int, np.ndarray] = {}
-        while not self.scheduler.all_done:
-            for rid in self.step():
-                done[rid] = self._finished[rid]
-            steps += 1
-            if steps > max_steps:
-                raise RuntimeError(f"serving loop exceeded {max_steps} steps")
+        stop_at = self.now() + budget_s if budget_s is not None else None
+        paused_before = self.admit_paused
+        try:
+            while not self.scheduler.all_done:
+                if stop_at is not None and self.now() >= stop_at:
+                    self.admit_paused = True
+                if self.admit_paused and not self.scheduler.running \
+                        and not self.scheduler.inflight_waiting:
+                    break  # drained: leave the queue for a later call
+                for rid in self.step():
+                    done[rid] = self._finished[rid]
+                steps += 1
+                if steps > max_steps:
+                    raise RuntimeError(
+                        f"serving loop exceeded {max_steps} steps without "
+                        f"draining: {self._state_summary()}")
+        finally:
+            self.admit_paused = paused_before
         return done
 
     def result(self, rid: int) -> np.ndarray:
@@ -271,4 +503,11 @@ class ServingEngine:
         outputs until drained, so never draining grows memory with every
         request ever served."""
         done, self._finished = self._finished, {}
+        return done
+
+    def pop_retired(self) -> dict[int, Request]:
+        """Drain and return every cancelled/expired/failed/shed request —
+        the non-completion analog of pop_finished(), with the same long-
+        lived-server memory contract."""
+        done, self._retired = self._retired, {}
         return done

@@ -1,0 +1,81 @@
+"""Deterministic fault injection for the serving engine.
+
+The engine consults an installed :class:`FaultInjector` at its step
+boundaries — named points, matched by (point, step index, request id):
+
+- ``prefill_fail``  a request's prefill fails: the request is retired FAILED
+  (its admission undone, slot + pages freed) before the jitted prefill runs.
+- ``decode_fail``   decoding a request fails: only that request is retired
+  FAILED; the rest of the batch decodes normally this very step.
+- ``pool_exhausted`` simulates the page pool running dry before a decode
+  step: the scheduler's victim policy preempts one running request
+  (recompute or swap per the engine config).
+- ``slow_step``     advances the engine's virtual clock by ``delay_s``
+  without sleeping — deadline expiry and wall-clock budgets become
+  deterministically testable.
+
+Every fault is consulted BEFORE the state transition it poisons, so the
+host-side scheduler/cache state after a fault equals the pre-step snapshot
+minus the retired request — no partial mutations to roll back, and page
+accounting stays exact (``pages_in_use`` drains to 0).
+
+When no injector is installed the engine pays exactly one attribute lookup
+per step (pinned by a test) — this module is never imported on that path
+beyond the engine's own module import.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+POINTS = ("prefill_fail", "decode_fail", "pool_exhausted", "slow_step")
+
+
+class InjectedFault(RuntimeError):
+    """The exception an armed fail-point raises; the engine records it on
+    the affected request (``Request.error``) and keeps serving the rest."""
+
+
+@dataclass
+class _Arm:
+    point: str
+    step: int | None  # None -> any step
+    rid: int | None   # None -> any request (first consulted wins)
+    times: int        # remaining firings; -1 -> unlimited
+    delay_s: float    # slow_step only: virtual seconds to add
+
+
+@dataclass
+class FaultInjector:
+    """A deterministic schedule of faults. ``arm`` registers a fault;
+    ``hit`` is the engine-side consult (matches, decrements, records)."""
+
+    _arms: list[_Arm] = field(default_factory=list)
+    fired: list[tuple[str, int, int | None]] = field(default_factory=list)
+
+    def arm(self, point: str, *, step: int | None = None,
+            rid: int | None = None, times: int = 1,
+            delay_s: float = 0.0) -> "FaultInjector":
+        if point not in POINTS:
+            raise ValueError(f"unknown fault point {point!r}; one of {POINTS}")
+        if times == 0 or times < -1:
+            raise ValueError(f"times must be positive or -1 (unlimited), "
+                             f"got {times}")
+        self._arms.append(_Arm(point, step, rid, times, float(delay_s)))
+        return self  # chainable: inj.arm(...).arm(...)
+
+    def hit(self, point: str, *, step: int,
+            rid: int | None = None) -> _Arm | None:
+        """First matching armed fault, or None. Matching consumes one
+        firing and appends (point, step, rid) to ``fired``."""
+        for arm in self._arms:
+            if arm.point != point or arm.times == 0:
+                continue
+            if arm.step is not None and arm.step != step:
+                continue
+            if arm.rid is not None and arm.rid != rid:
+                continue
+            if arm.times > 0:
+                arm.times -= 1
+            self.fired.append((point, step, rid))
+            return arm
+        return None

@@ -10,6 +10,11 @@ recompiles.
 
 Page 0 is reserved (never allocated): it is the null/trash page that padding
 tokens and inactive slots write to, keeping the jitted scatter branch-free.
+
+Swap-style preemption: ``swap_out(slot)`` copies the slot's pages into a
+host-memory ``SwapHandle`` and frees the device pages; ``swap_in`` reallocates
+(possibly different page ids) and restores the bytes. Pool shapes never
+change, so swap/restore can never retrigger a compile of the serving steps.
 """
 from __future__ import annotations
 
@@ -67,6 +72,23 @@ class PageAllocator:
                     f"(double free or foreign page)")
             self._allocated.remove(p)
             self._free.append(p)
+
+
+@dataclass
+class SwapHandle:
+    """Host-memory copy of one sequence's KV pages (swap-style preemption).
+
+    ``layers[i]`` holds ``{"k": ndarray, "v": ndarray}`` of shape
+    ``[n_pages, page_size, heads, head_dim]`` in page-table row order, so
+    restoring into ANY n_pages free pages (in order) preserves every token
+    position exactly.
+    """
+    n_pages: int
+    layers: list
+
+    @property
+    def nbytes(self) -> int:
+        return sum(h["k"].nbytes + h["v"].nbytes for h in self.layers)
 
 
 @dataclass(frozen=True)
@@ -151,6 +173,43 @@ class PagedKVCache:
                 return False
             self.page_table[slot, len(pages)] = got[0]
             pages.extend(got)
+        return True
+
+    def swap_out(self, slot: int) -> SwapHandle:
+        """Copy the slot's pages to host memory and free the device pages.
+        The returned handle is all that survives — the caller (scheduler)
+        owns attaching it to the preempted request."""
+        pages = self._slot_pages.get(slot)
+        if not pages:
+            raise ValueError(f"slot {slot} has no pages to swap out")
+        idx = np.asarray(pages, np.int32)
+        layers = [{"k": np.asarray(pl["k_pool"][idx]),
+                   "v": np.asarray(pl["v_pool"][idx])} for pl in self.pools]
+        handle = SwapHandle(n_pages=len(pages), layers=layers)
+        self.release(slot)
+        return handle
+
+    def swap_in(self, slot: int, handle: SwapHandle) -> bool:
+        """Reallocate handle.n_pages pages for the slot and restore the
+        swapped KV into them. False (no state change) when the pool can't
+        cover the handle — the scheduler keeps the request queued. Runs
+        outside jit: a swap event is rare, and the .at[].set copy it costs is
+        the price of never changing the pool's shape (compile-once holds)."""
+        import jax.numpy as jnp
+
+        if slot in self._slot_pages:
+            raise ValueError(f"slot {slot} already admitted")
+        pages = self.allocator.alloc(handle.n_pages)
+        if pages is None:
+            return False
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        self.pools = [
+            {"k_pool": pl["k_pool"].at[idx].set(jnp.asarray(h["k"])),
+             "v_pool": pl["v_pool"].at[idx].set(jnp.asarray(h["v"]))}
+            for pl, h in zip(self.pools, handle.layers)]
+        self._slot_pages[slot] = pages
+        self.page_table[slot, :] = NULL_PAGE
+        self.page_table[slot, :len(pages)] = pages
         return True
 
     def release(self, slot: int) -> None:

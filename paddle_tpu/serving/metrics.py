@@ -13,6 +13,16 @@ them with no new plumbing):
 - serving_prefills_total    counter
 - serving_decode_steps      counter
 - serving_preemptions_total counter
+
+Resilience counters (pre-seeded to 0 so they always appear in snapshots):
+
+- serving_rejected   admissions refused by the bounded queue (reject policy)
+- serving_shed       requests evicted from a full queue (shed-oldest policy)
+- serving_expired    requests retired by a deadline sweep
+- serving_cancelled  requests retired by engine.cancel()
+- serving_failed     requests retired FAILED (injected or real step fault)
+- serving_swap_outs  swap-mode preemptions (KV paged out to host memory)
+- serving_swap_ins   swapped requests restored and resumed
 """
 from __future__ import annotations
 
@@ -22,6 +32,11 @@ from collections import deque
 from ..utils import monitor
 
 PREFIX = "serving_"
+
+# always-visible resilience counters (a snapshot taken before the first
+# shed/expiry must still show the zeros — dashboards key on presence)
+_SEEDED = ("rejected", "shed", "expired", "cancelled", "failed",
+           "swap_outs", "swap_ins")
 
 
 class ServingMetrics:
@@ -36,6 +51,8 @@ class ServingMetrics:
     def reset(self) -> None:
         for k in list(monitor.stats_with_prefix(PREFIX)):
             monitor.stat_reset(k)
+        for k in _SEEDED:
+            monitor.stat_set(PREFIX + k, 0)
         self._samples.clear()
         self._samples.append((time.perf_counter(), 0.0))
 
@@ -45,6 +62,27 @@ class ServingMetrics:
 
     def on_preempt(self) -> None:
         monitor.stat_add(PREFIX + "preemptions_total", 1)
+
+    def on_rejected(self) -> None:
+        monitor.stat_add(PREFIX + "rejected", 1)
+
+    def on_shed(self) -> None:
+        monitor.stat_add(PREFIX + "shed", 1)
+
+    def on_expired(self) -> None:
+        monitor.stat_add(PREFIX + "expired", 1)
+
+    def on_cancelled(self) -> None:
+        monitor.stat_add(PREFIX + "cancelled", 1)
+
+    def on_failed(self) -> None:
+        monitor.stat_add(PREFIX + "failed", 1)
+
+    def on_swap_out(self) -> None:
+        monitor.stat_add(PREFIX + "swap_outs", 1)
+
+    def on_swap_in(self) -> None:
+        monitor.stat_add(PREFIX + "swap_ins", 1)
 
     def on_tokens(self, n: int) -> None:
         total = monitor.stat_add(PREFIX + "tokens_total", int(n))

@@ -3,11 +3,30 @@
 Policy, in the vLLM shape: FIFO admission with head-of-line order (a request
 is only admitted when a decode slot AND its prompt's pages are available, and
 never out of arrival order); one decode step serves every running slot; when
-the pool runs dry mid-decode the YOUNGEST running request is preempted —
-its pages are freed, its generated tokens dropped, and it requeues at the
-FRONT of the waiting queue to recompute (vLLM RECOMPUTE preemption). With
-greedy decoding recomputation reproduces the same tokens; under sampling a
-preempted request may resample — documented engine behavior.
+the pool runs dry mid-decode a running request is preempted — youngest first,
+but requests that were prefilled (or swap-resumed) this very step and have
+not decoded yet are spared while any seasoned victim exists, so admission
+work is never thrown away before it produced a single decode.
+
+Two preemption modes (``preemption_mode``):
+
+- ``recompute`` (vLLM RECOMPUTE): pages freed, generated tokens dropped, the
+  request requeues at the FRONT and replays from prefill. Deterministic for
+  greedy AND sampling: the engine derives PRNG keys from (engine seed, rid,
+  token index), so a recomputed request reproduces its original tokens
+  exactly — recomputation never resamples.
+- ``swap``: pages are copied to host memory (kv_cache.SwapHandle) and the
+  request resumes later with its generated tokens intact — no decode work is
+  lost, at the cost of host RAM and the restore copy.
+
+Backpressure: the waiting queue is bounded by ``max_waiting`` (0 =
+unbounded). A full queue either rejects the newcomer (``shed_policy=
+"reject"`` raises :class:`EngineOverloaded`) or sheds the longest-waiting
+request (``"shed-oldest"``), which is returned to the caller marked SHED.
+Preemption requeues bypass the bound AND are never shed — a preempted
+request was already admitted once and must not be lost to its own
+eviction; a full queue holding only preemption victims rejects the
+newcomer even under shed-oldest.
 
 Admission-time validation guarantees every accepted request can finish with
 the pool to itself, so the preempt-retry loop always terminates.
@@ -23,12 +42,18 @@ import numpy as np
 from .kv_cache import PagedKVCache
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+CANCELLED, FAILED, EXPIRED, SHED = "cancelled", "failed", "expired", "shed"
 
 _rid_counter = itertools.count()
 
 
-@dataclass
-class Request:
+class EngineOverloaded(RuntimeError):
+    """Admission refused: the bounded waiting queue is full and the shed
+    policy is "reject". The caller should back off and retry."""
+
+
+@dataclass(eq=False)  # identity semantics: requests are entities, and the
+class Request:        # generated dataclass __eq__ chokes on ndarray fields
     prompt: np.ndarray  # [prompt_len] int
     max_new_tokens: int
     rid: int = field(default_factory=lambda: next(_rid_counter))
@@ -37,6 +62,10 @@ class Request:
     generated: list = field(default_factory=list)
     preemptions: int = 0
     admit_seq: int = -1  # admission order stamp (preemption victim = max)
+    deadline: float | None = None  # absolute engine-clock time; None = never
+    error: BaseException | None = None  # recorded when state == FAILED
+    swap: object | None = None  # kv_cache.SwapHandle while swapped out
+    fresh: bool = False  # prefilled/swap-resumed this step, no decode yet
 
     @property
     def prompt_len(self) -> int:
@@ -60,9 +89,20 @@ class Request:
 
 
 class Scheduler:
-    def __init__(self, cache: PagedKVCache, max_batch: int):
+    def __init__(self, cache: PagedKVCache, max_batch: int,
+                 max_waiting: int = 0, shed_policy: str = "reject",
+                 preemption_mode: str = "recompute"):
+        if shed_policy not in ("reject", "shed-oldest"):
+            raise ValueError(f"shed_policy {shed_policy!r} not in "
+                             f"('reject', 'shed-oldest')")
+        if preemption_mode not in ("recompute", "swap"):
+            raise ValueError(f"preemption_mode {preemption_mode!r} not in "
+                             f"('recompute', 'swap')")
         self.cache = cache
         self.max_batch = max_batch
+        self.max_waiting = max_waiting
+        self.shed_policy = shed_policy
+        self.preemption_mode = preemption_mode
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}  # slot -> Request
         self._free_slots = list(range(max_batch - 1, -1, -1))  # pop() -> 0,1,..
@@ -78,26 +118,67 @@ class Scheduler:
     def all_done(self) -> bool:
         return not self.waiting and not self.running
 
-    def add(self, req: Request) -> None:
+    @property
+    def inflight_waiting(self) -> int:
+        """Preempted (in-flight) requests sitting in the waiting queue —
+        work a paused drain must still finish."""
+        return sum(r.preemptions > 0 for r in self.waiting)
+
+    def add(self, req: Request) -> Request | None:
+        """Queue a request. Returns the request this admission shed (state
+        SHED, resources dropped), or None. Raises EngineOverloaded when the
+        queue is full under the "reject" policy."""
         total = req.prompt_len + req.max_new_tokens
         if not self.cache.fits_ever(total):
             raise ValueError(
                 f"request {req.rid}: {total} tokens can never fit "
                 f"(max {self.cache.cfg.max_tokens_per_seq} per sequence, "
                 f"{self.cache.cfg.usable_pages} usable pages)")
+        shed = None
+        if self.max_waiting and len(self.waiting) >= self.max_waiting:
+            if self.shed_policy == "reject":
+                raise EngineOverloaded(
+                    f"waiting queue full ({self.max_waiting}); request "
+                    f"{req.rid} rejected")
+            # shed-oldest: the longest-waiting NEWCOMER yields its place —
+            # it is the most likely to be past caring (deadline-wise), and
+            # dropping it keeps FIFO order intact for every survivor.
+            # Preemption victims requeued at the front are not newcomers:
+            # they already spent admission work (and in swap mode hold their
+            # whole KV), so they are never shed — if the queue is all
+            # victims, the newcomer is rejected instead.
+            shed = next((r for r in self.waiting if r.preemptions == 0),
+                        None)
+            if shed is None:
+                raise EngineOverloaded(
+                    f"waiting queue full ({self.max_waiting}) with only "
+                    f"preempted in-flight requests; request {req.rid} "
+                    f"rejected")
+            self.waiting.remove(shed)  # identity removal (eq=False)
+            shed.state, shed.swap = SHED, None
         req.state = WAITING
         self.waiting.append(req)
+        return shed
 
-    def admit(self) -> list[Request]:
-        """Admit waiting requests FIFO into free slots while prompt pages are
+    def admit(self, resume_only: bool = False) -> list[Request]:
+        """Admit waiting requests FIFO into free slots while pages are
         available. Head-of-line: the first request that doesn't fit blocks
         the queue (no out-of-order admission — arrival order is the service
-        order the tests pin)."""
+        order the tests pin). A swapped-out request needs its handle's pages
+        restored rather than prompt pages allocated. ``resume_only`` admits
+        only preemption victims (always queued at the front): the paused-
+        drain mode, where in-flight work resumes but newcomers wait."""
         admitted = []
         while self.waiting and self._free_slots:
             req = self.waiting[0]
+            if resume_only and req.preemptions == 0:
+                break
             slot = self._free_slots[-1]
-            if not self.cache.admit(slot, req.prompt_len):
+            if req.swap is not None:
+                if not self.cache.swap_in(slot, req.swap):
+                    break
+                req.swap = None
+            elif not self.cache.admit(slot, req.prompt_len):
                 break
             self._free_slots.pop()
             self.waiting.popleft()
@@ -108,13 +189,22 @@ class Scheduler:
         return admitted
 
     # ------------------------------------------------------------- decoding
+    def pick_victim(self) -> Request:
+        """Preemption victim: youngest admitted, but among requests that
+        have decoded at least once when any exist — preempting a request
+        that was prefilled this same step wastes its whole prefill before
+        the first decode token it bought."""
+        seasoned = [r for r in self.running.values() if not r.fresh]
+        pool = seasoned or list(self.running.values())
+        return max(pool, key=lambda r: r.admit_seq)
+
     def ensure_decode_pages(self) -> list[tuple[Request, int]]:
         """Before a decode step: every running slot is about to write the KV
         of its last generated token at position ``tokens_resident - 1``
         (engine ctx), so it needs capacity for ``tokens_resident`` tokens —
         NOT one more; asking for tokens_resident + 1 would demand a page one
-        step early and preempt spuriously at page boundaries. Preempts
-        youngest-first until the survivors fit. Returns (request, vacated
+        step early and preempt spuriously at page boundaries. Preempts per
+        ``pick_victim`` until the survivors fit. Returns (request, vacated
         slot) pairs — the engine must deactivate those slots."""
         preempted = []
         for slot in sorted(self.running,
@@ -124,26 +214,47 @@ class Scheduler:
                 continue
             while req.slot is not None \
                     and not self.cache.grow(slot, req.tokens_resident):
-                victim = max(self.running.values(), key=lambda r: r.admit_seq)
+                victim = self.pick_victim()
                 preempted.append((victim, self.preempt(victim)))
                 # admission-time fits_ever() guarantees a lone request can
                 # always grow, so this loop terminates
         return preempted
 
     def preempt(self, req: Request) -> int:
-        """Recompute-style preemption: drop the KV pages AND the generated
-        tokens, requeue at the front of the waiting queue. Returns the
-        vacated slot."""
+        """Preempt a running request per ``preemption_mode`` and requeue it
+        at the front of the waiting queue. Returns the vacated slot."""
         slot = req.slot
         self.running.pop(slot)
-        self.cache.release(slot)
+        if self.preemption_mode == "swap":
+            req.swap = self.cache.swap_out(slot)
+        else:
+            self.cache.release(slot)
+            req.generated.clear()
         self._free_slots.append(slot)
         req.state, req.slot = WAITING, None
-        req.generated.clear()
         req.preemptions += 1
         self.preemption_count += 1
         self.waiting.appendleft(req)
         return slot
+
+    def evict(self, req: Request) -> int | None:
+        """Remove a request from waiting or running WITHOUT finishing it
+        (cancel / deadline expiry / injected failure), freeing its slot,
+        pages, and any swap handle. Returns the vacated slot (None when the
+        request was waiting). The caller owns the terminal state."""
+        if req.state == RUNNING:
+            slot = req.slot
+            self.running.pop(slot)
+            self.cache.release(slot)
+            self._free_slots.append(slot)
+            req.slot = None
+            return slot
+        if req.state == WAITING:
+            # identity removal (Request has eq=False); a missing request
+            # here is a caller bug — let the ValueError be loud
+            self.waiting.remove(req)
+            req.swap = None
+        return None
 
     def finish(self, req: Request) -> None:
         slot = req.slot

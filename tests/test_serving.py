@@ -9,14 +9,16 @@ once across a run where requests join and leave (compile_counts increments
 inside the traced python bodies, i.e. once per compilation), and every
 request's greedy output is bit-identical to single-request generate().
 """
+import itertools
+
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
-from paddle_tpu.serving import (PagedCacheConfig, PagedKVCache,
-                                PageAllocator, Request, Scheduler,
-                                ServingConfig, ServingEngine)
+from paddle_tpu.serving import (EngineOverloaded, PagedCacheConfig,
+                                PagedKVCache, PageAllocator, Request,
+                                Scheduler, ServingConfig, ServingEngine)
 from paddle_tpu.serving.kv_cache import NULL_PAGE
 from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
 
@@ -84,6 +86,52 @@ def test_cache_admit_is_all_or_nothing():
     c.release(0)
     assert c.admit(1, 1)
     assert 0 < c.utilization() < 1
+
+
+def test_cache_swap_roundtrip_preserves_kv_bytes():
+    import jax.numpy as jnp
+
+    c = _cache(num_pages=9, page_size=4)
+    assert c.admit(0, 6)  # 2 pages
+    pages_before = list(c._slot_pages[0])
+    rng = np.random.RandomState(7)
+    k = np.asarray(c.pools[0]["k_pool"]).copy()
+    v = np.asarray(c.pools[0]["v_pool"]).copy()
+    k[pages_before] = rng.rand(2, 4, 1, 4)
+    v[pages_before] = rng.rand(2, 4, 1, 4)
+    c.pools = [{"k_pool": jnp.asarray(k), "v_pool": jnp.asarray(v)}]
+
+    h = c.swap_out(0)
+    assert h.n_pages == 2 and h.nbytes > 0
+    assert c.allocator.pages_in_use == 0
+    assert (c.page_table[0] == NULL_PAGE).all()
+    with pytest.raises(ValueError):
+        c.swap_out(0)  # nothing resident any more
+
+    # land the restore on DIFFERENT page ids than it left from
+    assert c.admit(1, 3)
+    assert c.swap_in(0, h)
+    pages_after = c._slot_pages[0]
+    assert pages_after != pages_before
+    np.testing.assert_array_equal(
+        np.asarray(c.pools[0]["k_pool"])[pages_after], k[pages_before])
+    np.testing.assert_array_equal(
+        np.asarray(c.pools[0]["v_pool"])[pages_after], v[pages_before])
+    with pytest.raises(ValueError):
+        c.swap_in(0, h)  # slot occupied
+
+
+def test_cache_swap_in_is_all_or_nothing():
+    c = _cache(num_pages=4)  # 3 usable
+    assert c.admit(0, 12)  # all 3 pages
+    h = c.swap_out(0)
+    assert c.admit(1, 5)  # 2 pages: only 1 left for the 3-page handle
+    used = c.allocator.pages_in_use
+    assert not c.swap_in(0, h)
+    assert c.allocator.pages_in_use == used  # no partial grant
+    c.release(1)
+    assert c.swap_in(0, h)
+    assert c.allocator.pages_in_use == 3
 
 
 # ------------------------------------------------------------ scheduler
@@ -162,6 +210,46 @@ def test_scheduler_no_spurious_preempt_at_page_boundary():
     req.generated.append(5)  # tokens_resident = 4 = page_size
     assert s.ensure_decode_pages() == []
     assert s.preemption_count == 0 and req.slot == 0
+
+
+def test_scheduler_victim_prefers_requests_that_decoded():
+    c = _cache(num_pages=9, page_size=4, max_batch=3, pages_per_seq=4)
+    s = Scheduler(c, max_batch=3)
+    a, b, f = _req(4, budget=6), _req(4, budget=6), _req(4, budget=6)
+    for r in (a, b, f):
+        s.add(r)
+    assert len(s.admit()) == 3
+    a.generated, b.generated = [1, 2], [3, 4]
+    f.generated, f.fresh = [5], True  # prefilled this step, no decode yet
+    # youngest-first would sacrifice f's fresh prefill; the policy spares
+    # it and preempts the youngest request that already decoded
+    assert s.pick_victim() is b
+    # when EVERY candidate is fresh, fall back to plain youngest-first
+    a.fresh = b.fresh = True
+    assert s.pick_victim() is f
+
+
+def test_scheduler_bounded_queue_reject_and_shed():
+    c = _cache(num_pages=9, max_batch=1)
+    s = Scheduler(c, max_batch=1, max_waiting=2, shed_policy="reject")
+    r1, r2, r3 = _req(2), _req(2), _req(2)
+    assert s.add(r1) is None and s.add(r2) is None
+    with pytest.raises(EngineOverloaded):
+        s.add(r3)
+    assert list(s.waiting) == [r1, r2]
+
+    s2 = Scheduler(c, max_batch=1, max_waiting=2, shed_policy="shed-oldest")
+    q1, q2, q3 = _req(2), _req(2), _req(2)
+    s2.add(q1)
+    s2.add(q2)
+    shed = s2.add(q3)
+    assert shed is q1 and q1.state == "shed"
+    assert list(s2.waiting) == [q2, q3]  # FIFO intact for survivors
+
+    with pytest.raises(ValueError):
+        Scheduler(c, max_batch=1, shed_policy="drop-newest")
+    with pytest.raises(ValueError):
+        Scheduler(c, max_batch=1, preemption_mode="migrate")
 
 
 # ------------------------------------------------------------ engine e2e
@@ -276,6 +364,56 @@ def test_engine_rejects_oversized_requests():
     with pytest.raises(ValueError):
         # empty prompt would sample from a padding position's logits
         engine.add_request(np.zeros(0, np.int32), 4)
+
+
+def test_sampling_recompute_preemption_reproduces_tokens():
+    # PRNG keys derive from (engine seed, rid, token index) — a pure
+    # function of request identity — so a RECOMPUTE-preempted *sampling*
+    # request replays its original tokens instead of silently resampling
+    from paddle_tpu.serving import scheduler as sched_mod
+
+    model = _toy_model(seed=23)
+    prompts = [np.random.RandomState(i).randint(0, 97, (n,)).astype(np.int32)
+               for i, n in enumerate((6, 5, 4))]
+    budgets = [10, 9, 8]
+
+    def drive(num_pages):
+        # align rids across the two engines: the key streams are rid-keyed
+        sched_mod._rid_counter = itertools.count(9000)
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=3, num_pages=num_pages, page_size=4, max_prompt_len=8,
+            do_sample=True, temperature=0.8, top_k=20, seed=5))
+        rids = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+        return engine, rids, engine.run()
+
+    saved_counter = sched_mod._rid_counter
+    try:
+        calm, rids_a, outs_a = drive(num_pages=24)  # pool ample: no preempt
+        tight, rids_b, outs_b = drive(num_pages=8)  # pool dry: preempt+replay
+    finally:
+        sched_mod._rid_counter = saved_counter
+    assert rids_a == rids_b
+    assert calm.scheduler.preemption_count == 0
+    assert tight.scheduler.preemption_count > 0
+    for ra, rb in zip(rids_a, rids_b):
+        np.testing.assert_array_equal(
+            outs_a[ra], outs_b[rb],
+            err_msg="recomputed sampling request resampled different tokens")
+
+
+def test_stuck_engine_report_is_actionable():
+    model = _toy_model(seed=19)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=1, num_pages=16, page_size=4, max_prompt_len=8))
+    engine.add_request(np.arange(1, 5, dtype=np.int32), 8)
+    engine.add_request(np.arange(2, 6, dtype=np.int32), 8)
+    with pytest.raises(RuntimeError) as ei:
+        engine.run(max_steps=0)
+    msg = str(ei.value)
+    # the bare "...exceeded N steps" of PR 1 named nothing — a stuck-engine
+    # report must say what is queued, what is active, and who holds pages
+    for needle in ("queue_depth=", "active rids", "pages_in_use="):
+        assert needle in msg, msg
 
 
 # ----------------------------------------- satellite: executor eviction

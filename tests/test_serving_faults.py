@@ -1,0 +1,424 @@
+"""Serving resilience: deadlines, cancellation, backpressure, swap
+preemption, and the fault-injection harness (paddle_tpu/serving/faults.py).
+
+Everything is deterministic — the engine clock is a manually-held fake and
+time only advances through ``slow_step`` fault skew; no sleeps anywhere.
+The page-accounting invariant every scenario ends on: ``pages_in_use``
+returns to 0 once the engine drains, whatever was cancelled, expired,
+shed, swapped, or failed along the way.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.serving import (EngineOverloaded, FaultInjector,
+                                InjectedFault, ServingConfig, ServingEngine)
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.faults
+
+
+class FakeClock:
+    """Engine time that only moves when the test (or a slow_step fault via
+    the engine's skew) says so."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _toy_model(seed=11):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=48, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _reference(model, prompt, budget):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=budget)
+    return np.asarray(out._value)[0]
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 97, (n,)).astype(np.int32) for n in lens]
+
+
+# ------------------------------------------------------------- faults unit
+def test_injector_arm_validation_and_matching():
+    inj = FaultInjector()
+    with pytest.raises(ValueError):
+        inj.arm("bogus_point")
+    with pytest.raises(ValueError):
+        inj.arm("decode_fail", times=0)
+    inj.arm("decode_fail", step=3, rid=7).arm("slow_step", delay_s=2.5)
+    assert inj.hit("decode_fail", step=2, rid=7) is None  # wrong step
+    assert inj.hit("decode_fail", step=3, rid=8) is None  # wrong rid
+    assert inj.hit("decode_fail", step=3, rid=7) is not None
+    assert inj.hit("decode_fail", step=3, rid=7) is None  # consumed
+    # wildcard step, unlimited firings
+    inj.arm("pool_exhausted", times=-1)
+    assert inj.hit("pool_exhausted", step=0) is not None
+    assert inj.hit("pool_exhausted", step=99) is not None
+    assert inj.hit("slow_step", step=5).delay_s == 2.5
+    assert ("decode_fail", 3, 7) in inj.fired
+
+
+# ------------------------------------------------- deadlines & cancellation
+def test_deadline_expiry_under_pool_pressure():
+    # r1 holds the whole 3-page pool, so r2 waits head-of-line; an injected
+    # 10s stall (slow_step skew — time never really passes) blows r2's 5s
+    # deadline while it is still queued
+    model = _toy_model()
+    clock = FakeClock()
+    inj = FaultInjector().arm("slow_step", step=2, delay_s=10.0)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=4, page_size=4, max_prompt_len=8),
+        clock=clock, fault_injector=inj)
+    p1, p2 = _prompts(0, (6, 4))
+    r1 = engine.add_request(p1, 6)
+    r2 = engine.add_request(p2, 4, deadline_s=5.0)
+    outs = engine.run()
+    assert set(outs) == {r1}
+    np.testing.assert_array_equal(_reference(model, p1, 6), outs[r1])
+    assert engine.status(r2) == "expired"
+    assert engine.metrics.snapshot()["serving_expired"] == 1
+    assert engine.cache.allocator.pages_in_use == 0
+    assert inj.fired == [("slow_step", 2, None)]
+
+
+def test_deadline_expires_running_request_and_frees_pages():
+    model = _toy_model()
+    clock = FakeClock()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8),
+        clock=clock)
+    p1, p2 = _prompts(1, (5, 4))
+    r1 = engine.add_request(p1, 12, deadline_s=3.0)
+    r2 = engine.add_request(p2, 4)
+    engine.step()  # both admitted and decoding
+    assert engine.status(r1) == "running"
+    used_mid = engine.cache.allocator.pages_in_use
+    clock.advance(5.0)  # past r1's deadline, mid-generation
+    engine.step()
+    assert engine.status(r1) == "expired"
+    assert engine.cache.allocator.pages_in_use < used_mid
+    outs = engine.run()
+    assert set(outs) == {r2}
+    np.testing.assert_array_equal(_reference(model, p2, 4), outs[r2])
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_cancel_while_running_frees_pages():
+    model = _toy_model()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8))
+    p1, p2, p3 = _prompts(2, (5, 4, 3))
+    r1 = engine.add_request(p1, 10)
+    r2 = engine.add_request(p2, 4)
+    engine.step()
+    used_both = engine.cache.allocator.pages_in_use
+    assert engine.cancel(r1)
+    assert engine.cache.allocator.pages_in_use < used_both
+    assert engine.status(r1) == "cancelled"
+    assert not engine.cancel(r1)       # already terminal
+    assert not engine.cancel(424242)   # unknown
+    r3 = engine.add_request(p3, 3)
+    assert engine.cancel(r3)  # cancel straight out of the waiting queue
+    outs = engine.run()
+    assert set(outs) == {r2}
+    np.testing.assert_array_equal(_reference(model, p2, 4), outs[r2])
+    assert engine.cache.allocator.pages_in_use == 0
+    assert engine.metrics.snapshot()["serving_cancelled"] == 2
+    assert set(engine.pop_retired()) == {r1, r3}
+
+
+# ------------------------------------------------------------- backpressure
+def test_full_queue_rejects_deterministically():
+    model = _toy_model()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=1, num_pages=24, page_size=4, max_prompt_len=8,
+        max_waiting=1, shed_policy="reject"))
+    p1, p2, p3 = _prompts(3, (4, 4, 4))
+    r1 = engine.add_request(p1, 4)
+    engine.step()  # r1 takes the lone slot
+    r2 = engine.add_request(p2, 4)  # fills the queue
+    with pytest.raises(EngineOverloaded):
+        engine.add_request(p3, 4)
+    assert engine.metrics.snapshot()["serving_rejected"] == 1
+    outs = engine.run()
+    assert set(outs) == {r1, r2}
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_shed_oldest_keeps_fifo_order_for_survivors():
+    model = _toy_model()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=1, num_pages=24, page_size=4, max_prompt_len=8,
+        max_waiting=2, shed_policy="shed-oldest"))
+    prompts = _prompts(4, (4, 5, 3, 6))
+    r1 = engine.add_request(prompts[0], 4)
+    engine.step()  # r1 running; the queue is for r2..r4
+    r2 = engine.add_request(prompts[1], 4)
+    r3 = engine.add_request(prompts[2], 4)
+    r4 = engine.add_request(prompts[3], 4)  # queue full -> sheds r2
+    assert engine.status(r2) == "shed"
+    assert engine.metrics.snapshot()["serving_shed"] == 1
+    order = []
+    while not engine.scheduler.all_done:
+        order.extend(engine.step())
+    assert order == [r1, r3, r4], "survivors must finish in arrival order"
+    for rid, i in ((r1, 0), (r3, 2), (r4, 3)):
+        np.testing.assert_array_equal(
+            _reference(model, prompts[i], 4), engine.result(rid))
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_shed_oldest_never_sheds_a_preemption_victim():
+    # a preempted request requeues at the FRONT of the waiting queue — it is
+    # not the "oldest waiter", it is admitted work in flight. shed-oldest
+    # must shed the longest-waiting NEWCOMER instead, and reject outright
+    # when the queue holds only preemption victims.
+    model = _toy_model()
+    prompts = _prompts(11, (4, 5, 3, 4))
+    inj = FaultInjector().arm("pool_exhausted", step=2)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8,
+        max_waiting=1, shed_policy="shed-oldest"), fault_injector=inj)
+    r1 = engine.add_request(prompts[0], 6)
+    engine.step()  # admit r1 before r2 queues (max_waiting=1)
+    r2 = engine.add_request(prompts[1], 6)
+    engine.step(); engine.step()  # step 2 preempts one running request
+    victim = [r for r in (r1, r2) if engine.status(r) == "waiting"]
+    assert len(victim) == 1, "pool_exhausted must have preempted one request"
+    # queue == [victim] and max_waiting=1: full of in-flight work only
+    with pytest.raises(EngineOverloaded):
+        engine.add_request(prompts[2], 3)
+    assert engine.metrics.snapshot()["serving_rejected"] == 1
+    outs = engine.run()  # the victim is never lost
+    assert set(outs) == {r1, r2}
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_shed_oldest_skips_victim_and_sheds_oldest_newcomer():
+    model = _toy_model()
+    prompts = _prompts(12, (4, 5, 3, 4))
+    inj = FaultInjector().arm("pool_exhausted", step=2)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8,
+        max_waiting=2, shed_policy="shed-oldest"), fault_injector=inj)
+    r1 = engine.add_request(prompts[0], 6)
+    r2 = engine.add_request(prompts[1], 6)
+    engine.step(); engine.step(); engine.step()  # step 2 preempts one
+    r3 = engine.add_request(prompts[2], 3)  # queue: [victim, r3]
+    r4 = engine.add_request(prompts[3], 3)  # full -> sheds r3, NOT victim
+    assert engine.status(r3) == "shed"
+    outs = engine.run()
+    assert set(outs) == {r1, r2, r4}
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+# ---------------------------------------------------------- swap preemption
+def test_swap_preempt_parity_with_recompute():
+    model = _toy_model(seed=13)
+    prompts = _prompts(5, (6, 5, 4))
+    budgets = [10, 9, 8]
+
+    def drive(mode):
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=3, num_pages=8, page_size=4, max_prompt_len=8,
+            preemption_mode=mode))
+        rids = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+        outs = engine.run()
+        # snapshot before the next engine resets the process-wide registry
+        return engine, rids, outs, engine.metrics.snapshot()
+
+    eng_r, rids_r, outs_r, snap_r = drive("recompute")
+    eng_s, rids_s, outs_s, snap_s = drive("swap")
+    assert eng_r.scheduler.preemption_count > 0
+    assert eng_s.scheduler.preemption_count > 0
+    for i, (rr, rs) in enumerate(zip(rids_r, rids_s)):
+        ref = _reference(model, prompts[i], budgets[i])
+        np.testing.assert_array_equal(ref, outs_r[rr])
+        np.testing.assert_array_equal(ref, outs_s[rs])
+    assert snap_s["serving_swap_outs"] > 0
+    assert snap_s["serving_swap_ins"] == snap_s["serving_swap_outs"]
+    # swap keeps generated tokens: every request prefills exactly once,
+    # while recompute re-prefills its preemption victims
+    assert snap_s["serving_prefills_total"] == len(prompts)
+    assert snap_r["serving_prefills_total"] > len(prompts)
+    # host<->device swaps never change pool shapes: still one trace each
+    assert eng_s.compile_counts == {"prefill": 1, "decode": 1}
+    assert eng_s.cache.allocator.pages_in_use == 0
+    assert eng_r.cache.allocator.pages_in_use == 0
+
+
+# ---------------------------------------------------------- injected faults
+def test_decode_fail_isolates_the_failed_request():
+    model = _toy_model()
+    prompts = _prompts(6, (5, 4, 6))
+    budgets = [6, 8, 5]
+    inj = FaultInjector()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=3, num_pages=24, page_size=4, max_prompt_len=8),
+        fault_injector=inj)
+    rids = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+    inj.arm("decode_fail", step=2, rid=rids[1])
+    outs = engine.run()
+    assert set(outs) == {rids[0], rids[2]}, "non-faulted requests finish"
+    for i in (0, 2):
+        np.testing.assert_array_equal(
+            _reference(model, prompts[i], budgets[i]), outs[rids[i]])
+    assert engine.status(rids[1]) == "failed"
+    err = engine.request(rids[1]).error
+    assert isinstance(err, InjectedFault) and "decode_fail" in str(err)
+    assert engine.metrics.snapshot()["serving_failed"] == 1
+    assert engine.cache.allocator.pages_in_use == 0, \
+        "a faulted step must not corrupt page accounting"
+
+
+def test_prefill_fail_undoes_admission_only_for_the_victim():
+    model = _toy_model()
+    prompts = _prompts(7, (5, 4))
+    inj = FaultInjector()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8),
+        fault_injector=inj)
+    r1 = engine.add_request(prompts[0], 5)
+    r2 = engine.add_request(prompts[1], 4)
+    inj.arm("prefill_fail", rid=r1)
+    outs = engine.run()
+    assert set(outs) == {r2}
+    np.testing.assert_array_equal(
+        _reference(model, prompts[1], 4), outs[r2])
+    assert engine.status(r1) == "failed"
+    assert isinstance(engine.request(r1).error, InjectedFault)
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_pool_exhausted_injection_forces_preemption():
+    # the pool is actually ample — the injector simulates it running dry,
+    # and the victim-policy preemption must still converge to full parity
+    model = _toy_model()
+    prompts = _prompts(8, (5, 4))
+    budgets = [8, 7]
+    inj = FaultInjector().arm("pool_exhausted", step=3)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8),
+        fault_injector=inj)
+    rids = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+    outs = engine.run()
+    assert engine.scheduler.preemption_count >= 1
+    assert inj.fired == [("pool_exhausted", 3, None)]
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(
+            _reference(model, prompts[i], budgets[i]), outs[rid])
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+# ----------------------------------------------------- run() budget + drain
+def test_run_budget_pauses_admission_and_drains_gracefully():
+    model = _toy_model()
+    clock = FakeClock()
+    inj = FaultInjector().arm("slow_step", times=-1, delay_s=2.0)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=1, num_pages=24, page_size=4, max_prompt_len=8),
+        clock=clock, fault_injector=inj)
+    p1, p2 = _prompts(9, (4, 5))
+    r1 = engine.add_request(p1, 6)
+    r2 = engine.add_request(p2, 3)
+    outs = engine.run(budget_s=3.0)
+    # every virtual step costs 2s: the budget elapses mid-r1, which drains
+    # to completion; r2 is never admitted and stays queued — no exception
+    assert set(outs) == {r1}
+    np.testing.assert_array_equal(_reference(model, p1, 6), outs[r1])
+    assert engine.status(r2) == "waiting"
+    assert not engine.admit_paused, "drain must re-enable admission"
+    outs2 = engine.run()  # a later call serves the carried-over queue
+    assert set(outs2) == {r2}
+    np.testing.assert_array_equal(_reference(model, p2, 3), outs2[r2])
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_budget_drain_still_resumes_preemption_victims():
+    # the budget pauses NEWCOMER admission only: a request preempted after
+    # the budget elapsed is in-flight work and must drain to completion,
+    # not sit abandoned in the queue (in recompute mode it would also have
+    # lost every generated token)
+    model = _toy_model()
+    clock = FakeClock()
+    inj = FaultInjector().arm("slow_step", times=-1, delay_s=2.0)
+    for mode in ("recompute", "swap"):
+        # 4 usable pages; the two requests need 4+4=8 at peak -> guaranteed
+        # preemption mid-decode, well after the 1s budget elapsed at step 0
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=2, num_pages=5, page_size=4, max_prompt_len=8,
+            preemption_mode=mode), clock=clock, fault_injector=inj)
+        p1, p2 = _prompts(14, (6, 5))
+        r1 = engine.add_request(p1, 8)
+        r2 = engine.add_request(p2, 8)
+        outs = engine.run(budget_s=1.0)
+        assert set(outs) == {r1, r2}, \
+            f"{mode}: a preempted in-flight request was abandoned by drain"
+        assert engine.scheduler.preemption_count > 0, "setup must preempt"
+        np.testing.assert_array_equal(_reference(model, p1, 8), outs[r1])
+        np.testing.assert_array_equal(_reference(model, p2, 8), outs[r2])
+        assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_run_honors_and_preserves_caller_set_admit_pause():
+    # admit_paused is a documented caller knob: run() must drain in-flight
+    # work, leave the queue untouched, and NOT flip the flag back on exit
+    model = _toy_model()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=1, num_pages=24, page_size=4, max_prompt_len=8))
+    p1, p2 = _prompts(13, (4, 5))
+    r1 = engine.add_request(p1, 4)
+    engine.step()  # r1 takes the lone slot
+    r2 = engine.add_request(p2, 3)
+    engine.admit_paused = True
+    outs = engine.run()  # drains r1, returns instead of spinning on r2
+    assert set(outs) == {r1}
+    assert engine.status(r2) == "waiting"
+    assert engine.admit_paused, "run() must not clobber the caller's pause"
+    engine.admit_paused = False
+    outs2 = engine.run()
+    assert set(outs2) == {r2}
+    np.testing.assert_array_equal(_reference(model, p2, 3), outs2[r2])
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+# ----------------------------------------------------- zero-overhead default
+def test_default_path_is_one_injector_lookup_per_step():
+    # the engine may consult the injector exactly ONCE per step; with none
+    # installed the whole harness must cost one attribute read + None check
+    class CountingEngine(ServingEngine):
+        reads = 0
+
+        @property
+        def _fault_injector(self):
+            CountingEngine.reads += 1
+            return self.__dict__.get("_fault_injector_value")
+
+        @_fault_injector.setter
+        def _fault_injector(self, value):
+            self.__dict__["_fault_injector_value"] = value
+
+    model = _toy_model()
+    engine = CountingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=8))
+    engine.add_request(_prompts(10, (4,))[0], 3)
+    CountingEngine.reads = 0
+    engine.step()
+    assert CountingEngine.reads == 1
+    engine.step()
+    assert CountingEngine.reads == 2
