@@ -255,6 +255,65 @@ def _measure(rung: dict, steps: int, warmup: int) -> dict:
     return result
 
 
+def _serving_prefix_bench() -> dict:
+    """Serving phase: a shared-system-prompt workload (every request = one
+    48-token system prompt + a private 8-token tail) served with the
+    automatic prefix cache on vs off. Reports decode throughput and the
+    prefill tokens actually computed in each mode — the hit-vs-miss delta
+    is the tokens the cache saved."""
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(17)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=128, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    system = rng.randint(0, 512, (48,))
+    prompts = [np.concatenate([system, rng.randint(0, 512, (8,))])
+               .astype(np.int32) for _ in range(12)]
+    budget = 8
+
+    def drive(enable):
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=4, num_pages=64, page_size=16, max_prompt_len=64,
+            enable_prefix_caching=enable))
+        # warm BOTH prefill shapes out of the timing: the cold prompt's
+        # bucket, then (caching on) the hit tail's smaller bucket — the
+        # second request must run AFTER the first finishes to hit its pages
+        for p in prompts[:2]:
+            engine.add_request(p, budget)
+            engine.run()
+        t0 = time.perf_counter()
+        for p in prompts[2:]:
+            engine.add_request(p, budget)
+        engine.run()
+        dt = time.perf_counter() - t0
+        snap = engine.metrics.snapshot()
+        return (len(prompts) - 2) * budget / dt, snap
+
+    tps_on, snap_on = drive(True)
+    tps_off, snap_off = drive(False)
+    return {
+        "serving_prefix_tokens_per_sec_on": round(tps_on, 1),
+        "serving_prefix_tokens_per_sec_off": round(tps_off, 1),
+        "serving_prefix_prefill_tokens_on":
+            int(snap_on["serving_prefill_tokens_total"]),
+        "serving_prefix_prefill_tokens_off":
+            int(snap_off["serving_prefill_tokens_total"]),
+        "serving_prefix_tokens_saved":
+            int(snap_on["serving_prefix_tokens_saved"]),
+        "serving_prefix_hits": int(snap_on["serving_prefix_hits"]),
+        "serving_prefix_misses": int(snap_on["serving_prefix_misses"]),
+        "serving_prefix_hit_rate": round(
+            snap_on["serving_prefix_hits"]
+            / max(1, snap_on["serving_prefix_hits"]
+                  + snap_on["serving_prefix_misses"]), 4),
+    }
+
+
 def run_bench(platform: str) -> dict:
     import jax
 
@@ -270,6 +329,12 @@ def run_bench(platform: str) -> dict:
                     policy=None, vocab=1024, seq=128)
         r = _measure(rung, steps=3, warmup=1)
         r["metric"] = "gpt_smoke_train_tokens_per_sec_cpu"
+        try:
+            r["serving_prefix"] = _serving_prefix_bench()
+        except Exception as e:  # noqa: BLE001 — never forfeit the headline number
+            print(f"[bench] serving prefix phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
         return r
 
     deadline = float(os.environ.get(_DEADLINE_ENV, time.time() + _TPU_BUDGET_S))
@@ -295,6 +360,13 @@ def run_bench(platform: str) -> dict:
     # bank only the ladder's winning measurement — ad-hoc --rung experiments
     # must not shadow it as "last known TPU perf"
     _bank_tpu_result(result)
+    if remaining() > 45:
+        try:
+            result["serving_prefix"] = _serving_prefix_bench()
+        except Exception as e:  # noqa: BLE001 — never forfeit the train number
+            print(f"[bench] serving prefix phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
     return result
 
 

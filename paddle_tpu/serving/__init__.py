@@ -10,8 +10,14 @@ and the vLLM continuous-batching loop, restated TPU-native.
 Resilience layer: per-request deadlines + cancellation, bounded-queue
 backpressure (reject / shed-oldest), swap-style preemption to host memory,
 and a deterministic fault-injection harness (serving/faults.py).
+
+Performance layer: automatic prefix caching (refcounted cross-request page
+sharing with an exact content index, copy-on-write, and LRU eviction of
+reclaimable pages — only the uncached prompt tail is prefilled) and
+multi-bucket prefill (one compile per power-of-two pad bucket).
 """
-from .engine import ServingConfig, ServingEngine  # noqa: F401
+from .engine import (ServingConfig, ServingEngine,  # noqa: F401
+                     prefill_buckets)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .kv_cache import (PagedCacheConfig, PagedKVCache,  # noqa: F401
                        PageAllocator, SwapHandle)
@@ -21,4 +27,4 @@ from .scheduler import EngineOverloaded, Request, Scheduler  # noqa: F401
 __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "PagedKVCache", "PageAllocator", "SwapHandle", "ServingMetrics",
            "Request", "Scheduler", "EngineOverloaded", "FaultInjector",
-           "InjectedFault"]
+           "InjectedFault", "prefill_buckets"]

@@ -6,9 +6,21 @@ pages_per_seq], ctx_lens [max_batch], last_tok [max_batch], active
 [max_batch], rids [max_batch], gen_idx [max_batch]) — every array keeps its
 shape for the life of the engine, so requests joining and leaving the batch
 NEVER retrigger compilation (the e2e test asserts exactly-one trace per
-function via ``compile_counts``). Prefill is its own once-compiled step:
-prompts are right-padded to the ``max_prompt_len`` bucket and the real
-length rides in as an array.
+function via ``compile_counts``). Prefill compiles once per PAD BUCKET: a
+prompt (or, on a prefix-cache hit, its uncached tail) is right-padded to
+the smallest bucket in a fixed power-of-two set capped at
+``max_prompt_len``, so short prompts stop paying max-length prefill FLOPs
+and the bucket set is the only source of prefill compiles.
+
+Automatic prefix caching: admission matches the prompt against the paged
+cache's content index in whole pages (kv_cache.py), maps the hit pages
+into the new slot's page-table row by refcount bump, and prefills ONLY the
+uncached tail — queries enter at ``ctx_lens = cached_tokens``, riding the
+same ragged ``paged_attention`` contract decode already uses, so there is
+no kernel change and compile-once holds. Greedy outputs are bit-identical
+with caching on or off: the fixed gather width plus exact-zero ragged
+masking make KV bytes position-deterministic, so cached pages hold exactly
+the bytes a cold prefill would recompute.
 
 Decode semantics match text/generation.py: prefill picks the first token
 from the last prompt logit, each decode step feeds the previous token back
@@ -75,6 +87,19 @@ class ServingConfig:
     max_waiting: int = 0  # waiting-queue bound; 0 = unbounded
     shed_policy: str = "reject"  # "reject" | "shed-oldest" when queue full
     preemption_mode: str = "recompute"  # "recompute" | "swap"
+    enable_prefix_caching: bool = True  # cross-request KV page sharing
+
+
+def prefill_buckets(max_prompt_len: int) -> list[int]:
+    """The fixed prefill pad buckets: powers of two from 8 up, capped at
+    (and always including) ``max_prompt_len``. Each bucket compiles the
+    prefill step once; nothing else ever does."""
+    buckets, b = [], 8
+    while b < max_prompt_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prompt_len)
+    return buckets
 
 
 class ServingEngine:
@@ -99,7 +124,9 @@ class ServingEngine:
             head_dim=mc.hidden_size // mc.num_heads,
             num_pages=cfg.num_pages, page_size=cfg.page_size,
             max_batch=cfg.max_batch, pages_per_seq=pages_per_seq,
-            dtype=model.gpt.wte.weight._value.dtype))
+            dtype=model.gpt.wte.weight._value.dtype,
+            enable_prefix_caching=cfg.enable_prefix_caching))
+        self.prefill_buckets = prefill_buckets(cfg.max_prompt_len)
         self.scheduler = Scheduler(
             self.cache, cfg.max_batch, max_waiting=cfg.max_waiting,
             shed_policy=cfg.shed_policy, preemption_mode=cfg.preemption_mode)
@@ -154,19 +181,24 @@ class ServingEngine:
                      for c in new_caches]
         return logits._value, new_pools
 
-    def _prefill_impl(self, p_arrays, pools, padded_ids, prompt_len,
+    def _prefill_impl(self, p_arrays, pools, padded_ids, tail_len, ctx0,
                       page_row, rid):
-        """One request's prompt in one pass: padded_ids [max_prompt_len],
-        prompt_len scalar, page_row [pages_per_seq]. Returns (new_pools,
-        first sampled token)."""
+        """One request's uncached prompt tail in one pass: padded_ids
+        [bucket], tail_len scalar (real tail tokens), ctx0 scalar (tokens
+        already resident from the prefix cache; 0 on a cold prefill),
+        page_row [pages_per_seq]. The tail's queries enter at positions
+        ``ctx0 .. ctx0 + tail_len - 1`` against the slot's page table —
+        the cached prefix is attended through the same ragged-masked
+        gather decode uses. Returns (new_pools, first sampled token).
+        Compiles once per pad bucket (padded_ids shape)."""
         self.compile_counts["prefill"] += 1
         n = padded_ids.shape[0]
         table = page_row[None, :]
-        ctx = jnp.zeros((1,), jnp.int32)
-        valid = (jnp.arange(n, dtype=jnp.int32) < prompt_len)[None, :]
+        ctx = jnp.reshape(ctx0.astype(jnp.int32), (1,))
+        valid = (jnp.arange(n, dtype=jnp.int32) < tail_len)[None, :]
         logits, new_pools = self._run_model(
             p_arrays, pools, table, ctx, valid, padded_ids[None, :])
-        last = logits[0, prompt_len - 1, :]
+        last = logits[0, tail_len - 1, :]
         if self.config.do_sample:
             tok = self._sample_row(last, self._req_key(rid, 0))
         else:
@@ -314,6 +346,11 @@ class ServingEngine:
         if len(req.generated) >= req.max_new_tokens or \
                 (eos is not None and tok == eos):
             slot = req.slot
+            # index the generated span too (all but the final token, whose
+            # KV was never written) so a future prompt extending this
+            # request's text hits the whole conversation, then release —
+            # refcount-0 indexed pages park reclaimable, not freed
+            self.cache.register_prefix(slot, req.output()[:-1])
             self.scheduler.finish(req)
             self._clear_slot(slot)
             self._finished[req.rid] = req.output()
@@ -375,13 +412,19 @@ class ServingEngine:
                 self.metrics.on_failed()
                 continue
             with profiler.RecordEvent("serving::prefill"):
-                padded = np.full(self.config.max_prompt_len,
-                                 self.config.pad_token_id, np.int32)
-                padded[:req.prompt_len] = req.prompt
+                # prefix-cache hit: only the uncached tail is prefilled,
+                # padded to the smallest bucket that holds it
+                cached = req.cached_tokens
+                tail = req.prompt[cached:]
+                bucket = next(b for b in self.prefill_buckets
+                              if b >= len(tail))
+                padded = np.full(bucket, self.config.pad_token_id, np.int32)
+                padded[:len(tail)] = tail
                 try:
                     pools, tok = self._prefill_jit(
                         self._p, self.cache.pools, jnp.asarray(padded),
-                        jnp.asarray(req.prompt_len, jnp.int32),
+                        jnp.asarray(len(tail), jnp.int32),
+                        jnp.asarray(cached, jnp.int32),
                         jnp.asarray(self.cache.page_table[req.slot]),
                         jnp.asarray(req.rid, jnp.int32))
                 except Exception as e:  # noqa: BLE001 — isolate the request
@@ -404,7 +447,14 @@ class ServingEngine:
             self._rids[req.slot] = req.rid
             self._gen[req.slot] = 1
             req.fresh = True
-            self.metrics.on_prefill()
+            # every full prompt page is now resident: index it for reuse
+            self.cache.register_prefix(req.slot, req.prompt)
+            self.metrics.on_prefill(len(tail))
+            if self.config.enable_prefix_caching:
+                if cached > 0:
+                    self.metrics.on_prefix_hit(cached)
+                else:
+                    self.metrics.on_prefix_miss()
             self.metrics.on_tokens(1)
             if self._maybe_finish(req, tok):
                 finished_now.append(req.rid)
@@ -456,7 +506,11 @@ class ServingEngine:
             queue_depth=self.scheduler.queue_depth,
             active=len(self.scheduler.running),
             pages_used=self.cache.allocator.pages_in_use,
-            usable_pages=self.cache.cfg.usable_pages)
+            usable_pages=self.cache.cfg.usable_pages,
+            shared_pages=self.cache.shared_page_count(),
+            cached_pages=self.cache.allocator.num_reclaimable,
+            cow_copies=self.cache.cow_copies,
+            evictions=self.cache.evictions)
         return finished_now
 
     def run(self, max_steps: int = 100000,

@@ -1,9 +1,10 @@
-"""Paged KV cache: preallocated page pool + free-list allocator + page tables.
+"""Paged KV cache: preallocated page pool + refcounted allocator + page
+tables + automatic prefix caching.
 
 The device side is a per-layer pool ``[num_pages, page_size, heads,
 head_dim]`` (k and v), updated only functionally (``.at[]`` scatters in
 kernels/paged_attention.py) so the whole cache threads through the engine's
-jitted step. The host side is bookkeeping only: a free-list block allocator
+jitted step. The host side is bookkeeping only: a refcounted block allocator
 and per-slot page tables, mirrored into a dense ``[max_batch,
 pages_per_seq]`` int32 array each step — static shape, so table churn never
 recompiles.
@@ -11,14 +12,39 @@ recompiles.
 Page 0 is reserved (never allocated): it is the null/trash page that padding
 tokens and inactive slots write to, keeping the jitted scatter branch-free.
 
+Prefix caching (vLLM-style automatic page sharing): every FULL page whose
+token block is known is registered in a content index under a LINKED exact
+key ``(parent_serial, block_tokens)`` — the parent's never-reused
+registration serial pins the rest of the prefix transitively, giving
+exact matching (no hash collisions, so cached reuse can never corrupt
+numerics) at O(page_size) memory per page. A new
+request's prompt is matched against the index in whole pages; matched pages
+are mapped into its page table with a refcount bump instead of being
+re-prefilled. Pages whose refcount drops to zero while registered stay
+resident in an LRU "reclaimable" set — future identical prefixes re-hit
+them, and an allocation that would otherwise fail evicts them oldest-first
+(purging their index entries so a recycled page can never serve stale KV).
+
+Copy-on-write: a request that must write into a shared page (the only such
+write is the recompute of the LAST prompt token when the entire prompt was
+cached — its logits are needed to sample the first output token) gets a
+private copy first when any other holder exists; the last holder writes in
+place (the rewrite reproduces the identical bytes: KV of the same tokens
+over the same exact-zero-masked prefix is deterministic).
+
 Swap-style preemption: ``swap_out(slot)`` copies the slot's pages into a
-host-memory ``SwapHandle`` and frees the device pages; ``swap_in`` reallocates
-(possibly different page ids) and restores the bytes. Pool shapes never
-change, so swap/restore can never retrigger a compile of the serving steps.
+host-memory ``SwapHandle`` through ONE jitted gather over a stacked
+per-layer pool view (not a per-layer host loop), and ``swap_in``
+reallocates (possibly different page ids) and restores the bytes through
+one jitted donated scatter. Both run over fixed shapes (page index vectors
+padded to ``pages_per_seq`` with the null page), so swap events never
+retrigger a compile — ``compile_counts`` pins exactly one trace each.
 """
 from __future__ import annotations
 
+import itertools
 import math
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
@@ -28,9 +54,13 @@ _RESERVED_PAGES = 1  # page 0 = null page
 
 
 class PageAllocator:
-    """Free-list block allocator over page ids ``[_RESERVED_PAGES,
-    num_pages)``. All-or-nothing allocation; double-free and foreign-page
-    free raise — the invariants the serving tests pin down."""
+    """Refcounted block allocator over page ids ``[_RESERVED_PAGES,
+    num_pages)``. ``alloc`` hands out pages at refcount 1; ``incref``/
+    ``decref`` implement sharing; ``free`` is decref-to-zero for every page
+    (so double-free and foreign-page free still raise — the invariants the
+    serving tests pin down). A page at refcount zero either returns to the
+    free list or, when ``hold=True`` (the prefix cache's reclaimable
+    pages), parks in an LRU side pool until reclaimed or re-taken."""
 
     def __init__(self, num_pages: int):
         if num_pages <= _RESERVED_PAGES:
@@ -39,7 +69,9 @@ class PageAllocator:
         self.num_pages = num_pages
         # pop() hands out low ids first (stable, test-friendly)
         self._free = list(range(num_pages - 1, _RESERVED_PAGES - 1, -1))
-        self._allocated: set[int] = set()
+        self._ref: dict[int, int] = {}  # page -> refcount (>= 1)
+        # refcount-0 pages held for the prefix cache, oldest (LRU) first
+        self._cached: OrderedDict[int, None] = OrderedDict()
 
     @property
     def num_usable(self) -> int:
@@ -50,45 +82,104 @@ class PageAllocator:
         return len(self._free)
 
     @property
+    def num_reclaimable(self) -> int:
+        """Refcount-0 pages parked for the prefix cache — free after an LRU
+        eviction, but still holding valid reusable KV until then."""
+        return len(self._cached)
+
+    @property
     def pages_in_use(self) -> int:
-        return len(self._allocated)
+        """Pages referenced by at least one holder. Reclaimable cached
+        pages are NOT in use: accounting drains to zero when every request
+        retires even while the prefix cache stays warm."""
+        return len(self._ref)
+
+    def refcount(self, page: int) -> int:
+        return self._ref.get(page, 0)
 
     def alloc(self, n: int) -> list[int] | None:
-        """n pages, or None (and no state change) when the pool can't cover
-        the request — partial grants would deadlock the scheduler."""
+        """n pages at refcount 1, or None (and no state change) when the
+        free list can't cover the request — partial grants would deadlock
+        the scheduler. Reclaimable pages are NOT tapped here: the owner of
+        the prefix index must evict (and purge) them explicitly."""
         if n < 0:
             raise ValueError(f"alloc({n})")
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
-        self._allocated.update(pages)
+        for p in pages:
+            self._ref[p] = 1
         return pages
 
+    def incref(self, page: int) -> int:
+        """Add a holder to a live page. A reclaimable (refcount-0) page
+        must be re-taken with ``take_cached`` instead."""
+        if page not in self._ref:
+            raise ValueError(f"incref of page {page} with no live holders")
+        self._ref[page] += 1
+        return self._ref[page]
+
+    def decref(self, page: int, hold: bool = False) -> int:
+        """Drop one holder; returns the remaining count. At zero the page
+        returns to the free list, or parks in the reclaimable LRU pool when
+        ``hold`` (the caller vouches its content is indexed for reuse).
+        Decref of a page with no holders raises — double decref and foreign
+        pages are caller bugs, never silently absorbed."""
+        c = self._ref.get(page)
+        if c is None:
+            raise ValueError(
+                f"decref of page {page} not handed out by this allocator "
+                f"(double free or foreign page)")
+        c -= 1
+        if c:
+            self._ref[page] = c
+            return c
+        del self._ref[page]
+        if hold:
+            self._cached[page] = None
+            self._cached.move_to_end(page)
+        else:
+            self._free.append(page)
+        return 0
+
     def free(self, pages) -> None:
+        """Decref-to-zero each page (back-compat surface: a non-shared page
+        at refcount 1 goes straight back to the free list)."""
         for p in pages:
-            if p not in self._allocated:
-                raise ValueError(
-                    f"free of page {p} not handed out by this allocator "
-                    f"(double free or foreign page)")
-            self._allocated.remove(p)
-            self._free.append(p)
+            self.decref(p)
+
+    def take_cached(self, page: int) -> None:
+        """Prefix-cache hit on a reclaimable page: revive it at refcount 1
+        without touching its pool bytes."""
+        del self._cached[page]
+        self._ref[page] = 1
+
+    def reclaim_lru(self) -> int | None:
+        """Evict the least-recently-parked reclaimable page to the free
+        list; returns its id (the caller MUST purge its index entry) or
+        None when nothing is reclaimable."""
+        if not self._cached:
+            return None
+        page, _ = self._cached.popitem(last=False)
+        self._free.append(page)
+        return page
 
 
 @dataclass
 class SwapHandle:
     """Host-memory copy of one sequence's KV pages (swap-style preemption).
 
-    ``layers[i]`` holds ``{"k": ndarray, "v": ndarray}`` of shape
-    ``[n_pages, page_size, heads, head_dim]`` in page-table row order, so
-    restoring into ANY n_pages free pages (in order) preserves every token
-    position exactly.
+    ``k``/``v`` are stacked over layers: ``[num_layers, n_pages, page_size,
+    heads, head_dim]`` in page-table row order, so restoring into ANY
+    n_pages free pages (in order) preserves every token position exactly.
     """
     n_pages: int
-    layers: list
+    k: np.ndarray
+    v: np.ndarray
 
     @property
     def nbytes(self) -> int:
-        return sum(h["k"].nbytes + h["v"].nbytes for h in self.layers)
+        return self.k.nbytes + self.v.nbytes
 
 
 @dataclass(frozen=True)
@@ -101,6 +192,7 @@ class PagedCacheConfig:
     max_batch: int = 4
     pages_per_seq: int = 8  # page-table width == max seq pages per request
     dtype: object = None  # jnp dtype; None -> float32
+    enable_prefix_caching: bool = True  # cross-request page sharing
 
     @property
     def max_tokens_per_seq(self) -> int:
@@ -122,8 +214,10 @@ def init_pools(cfg: PagedCacheConfig) -> list[dict]:
 
 
 class PagedKVCache:
-    """Host-side manager of the pool: slot admission, on-demand growth during
-    decode, release. The engine owns moving ``self.pools`` through jit."""
+    """Host-side manager of the pool: slot admission (with prefix-cache
+    matching), on-demand growth during decode, release. The engine owns
+    moving ``self.pools`` through jit; the cache's own jitted helpers
+    (swap gather/scatter, COW page copy) rebind them in place."""
 
     def __init__(self, cfg: PagedCacheConfig):
         self.cfg = cfg
@@ -132,35 +226,255 @@ class PagedKVCache:
         self.page_table = np.full((cfg.max_batch, cfg.pages_per_seq),
                                   NULL_PAGE, np.int32)
         self._slot_pages: dict[int, list[int]] = {}
+        # ---- prefix cache: exact token-chain -> full immutable page.
+        # Keys are LINKED, not flat: (parent_serial, block_tokens), where
+        # parent_serial is the registration serial of the page holding the
+        # previous block (0 for the chain head). Serials are NEVER reused,
+        # so a key transitively pins the exact full prefix in O(page_size)
+        # memory per page — flat full-prefix keys would be quadratic in
+        # chain length — while staying collision-free: a recycled PAGE ID
+        # can collide, a retired serial cannot (a stale child entry whose
+        # parent was evicted is simply unreachable until its own page is
+        # evicted and purged).
+        self._key_to_page: dict[tuple, int] = {}
+        self._page_key: dict[int, tuple] = {}
+        self._page_serial: dict[int, int] = {}  # registered page -> serial
+        self._serials = itertools.count(1)      # 0 = chain-head parent
+        self._slot_cached: dict[int, int] = {}  # slot -> cached prompt tokens
+        self.cow_copies = 0   # shared pages privatized before a write
+        self.evictions = 0    # reclaimable pages purged under pressure
+        # trace counters for the cache-owned jitted steps: the python
+        # bodies run only when jax (re)traces — the fixed swap/COW shapes
+        # mean each compiles exactly once for the cache's lifetime
+        self.compile_counts = {"swap_gather": 0, "swap_scatter": 0,
+                               "cow_copy": 0}
+        self._build_jits()
 
+    def _build_jits(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        counts = self.compile_counts
+
+        def gather(pools, idx):
+            counts["swap_gather"] += 1
+            # index each layer BEFORE stacking: stacking whole pools would
+            # materialize an O(pool) concatenate per swap event — the exact
+            # cost this jit exists to avoid; this way only the gathered
+            # pages ([layers, pages_per_seq, ...]) are ever copied
+            k = jnp.stack([pl["k_pool"][idx] for pl in pools])
+            v = jnp.stack([pl["v_pool"][idx] for pl in pools])
+            return k, v
+
+        def scatter(pools, idx, k_all, v_all):
+            counts["swap_scatter"] += 1
+            return [{"k_pool": pl["k_pool"].at[idx].set(k_all[i]),
+                     "v_pool": pl["v_pool"].at[idx].set(v_all[i])}
+                    for i, pl in enumerate(pools)]
+
+        def copy_page(pools, src, dst):
+            counts["cow_copy"] += 1
+            return [{"k_pool": pl["k_pool"].at[dst].set(pl["k_pool"][src]),
+                     "v_pool": pl["v_pool"].at[dst].set(pl["v_pool"][src])}
+                    for pl in pools]
+
+        # gather reads the pools (no donation); scatter and COW consume
+        # them — without donation each .at[] write would copy the ENTIRE
+        # pool and hold two pools live
+        self._gather_jit = jax.jit(gather)
+        self._scatter_jit = jax.jit(scatter, donate_argnums=(0,))
+        self._copy_jit = jax.jit(copy_page, donate_argnums=(0,))
+
+    # ------------------------------------------------------------- sizing
     def pages_for(self, num_tokens: int) -> int:
         return max(1, math.ceil(num_tokens / self.cfg.page_size))
 
     def fits_ever(self, total_tokens: int) -> bool:
         """Could a request of total_tokens run with the whole pool to
         itself? The admission-time check that makes preemption loops
-        terminate (a lone running request can always grow)."""
+        terminate (a lone running request can always grow). Reusable
+        prefix pages don't relax this bound — they may be evicted before
+        the request runs, so the guarantee must hold cold — but they don't
+        tighten it either: every reclaimable page is evictable on demand,
+        so the full ``usable_pages`` capacity always counts."""
         return (total_tokens <= self.cfg.max_tokens_per_seq
                 and self.pages_for(total_tokens) <= self.cfg.usable_pages)
 
-    def admit(self, slot: int, num_tokens: int) -> bool:
-        """Allocate the pages a prompt of num_tokens needs and populate the
-        slot's page-table row. False (no state change) when the pool is out
-        of pages."""
+    # ----------------------------------------------------- prefix caching
+    def _block_key(self, parent_serial: int, tokens, i: int) -> tuple:
+        """Index key for block ``i`` of a token chain: (serial of the
+        parent block's page, the block's exact tokens). Exact tuples (not
+        hash digests) key the dict — a collision could silently splice
+        another prompt's KV into a request, so exactness is a correctness
+        requirement, not a nicety; the parent serial carries the rest of
+        the prefix transitively."""
+        ps = self.cfg.page_size
+        return (parent_serial,
+                tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+
+    def match_prefix(self, tokens) -> list[int]:
+        """Longest chain of cached FULL pages covering a prefix of
+        ``tokens``, in page order. Whole-page granularity: a partial page
+        can never be content-addressed (its key would be ambiguous about
+        the tail)."""
+        if not self.cfg.enable_prefix_caching:
+            return []
+        pages, parent = [], 0
+        for i in range(len(tokens) // self.cfg.page_size):
+            page = self._key_to_page.get(self._block_key(parent, tokens, i))
+            if page is None:
+                break
+            pages.append(page)
+            parent = self._page_serial[page]
+        return pages
+
+    def register_prefix(self, slot: int, tokens) -> int:
+        """Index every full page of ``slot`` whose token block is covered by
+        ``tokens`` (the KV actually resident — the engine passes the prompt
+        after prefill and prompt+generated-with-KV at finish). First
+        registration wins: an identical chain already indexed keeps its
+        existing page. Returns the number of newly indexed pages."""
+        if not self.cfg.enable_prefix_caching:
+            return 0
+        pages = self._slot_pages.get(slot)
+        if not pages:
+            return 0
+        new, parent = 0, 0
+        for i in range(min(len(pages), len(tokens) // self.cfg.page_size)):
+            key = self._block_key(parent, tokens, i)
+            existing = self._key_to_page.get(key)
+            if existing is not None:
+                parent = self._page_serial[existing]
+                continue
+            if pages[i] in self._page_key:
+                # this page already anchors a DIFFERENT chain (e.g. it was
+                # COW-sourced); without it the chain breaks — descendants
+                # would need a parent serial no key can reach
+                break
+            serial = next(self._serials)
+            self._key_to_page[key] = pages[i]
+            self._page_key[pages[i]] = key
+            self._page_serial[pages[i]] = serial
+            parent = serial
+            new += 1
+        return new
+
+    def cached_tokens(self, slot: int) -> int:
+        """Prompt tokens slot ``slot`` reused from the prefix cache at
+        admission (0 for a cold admission or a swap-restore)."""
+        return self._slot_cached.get(slot, 0)
+
+    def _unregister(self, page: int) -> None:
+        key = self._page_key.pop(page, None)
+        if key is not None:
+            self._key_to_page.pop(key, None)
+            self._page_serial.pop(page, None)
+            # descendants keyed on this page's retired serial are now
+            # unreachable (serials never recur); they purge when their own
+            # pages are evicted or re-registered
+
+    def _alloc_or_evict(self, n: int) -> list[int] | None:
+        """Allocate n pages, LRU-evicting reclaimable cached pages when the
+        free list alone can't cover it. Evicted pages are purged from the
+        content index BEFORE they can be handed out again — a recycled page
+        must never be reachable under its stale key."""
+        if n == 0:
+            return []
+        if self.allocator.num_free + self.allocator.num_reclaimable < n:
+            return None  # doomed: keep the warm cache, change no state
+        while self.allocator.num_free < n:
+            page = self.allocator.reclaim_lru()
+            self._unregister(page)
+            self.evictions += 1
+        return self.allocator.alloc(n)
+
+    def _claim_shared(self, page: int) -> None:
+        """Take a hold on a matched cache page: revive a reclaimable page
+        at refcount 1, or bump a live page's count."""
+        if self.allocator.refcount(page) == 0:
+            self.allocator.take_cached(page)
+        else:
+            self.allocator.incref(page)
+
+    def _release_pages(self, pages) -> None:
+        """Drop this holder's reference on every page; indexed pages whose
+        count reaches zero park in the reclaimable LRU pool (their KV stays
+        valid for future hits), everything else returns to the free list."""
+        for p in pages:
+            self.allocator.decref(p, hold=p in self._page_key)
+
+    def shared_page_count(self) -> int:
+        """Pages currently mapped by more than one page table."""
+        return sum(1 for c in self.allocator._ref.values() if c > 1)
+
+    def _copy_page_bytes(self, src: int, dst: int) -> None:
+        """Jitted donated single-page pool copy (the COW data move)."""
+        import jax.numpy as jnp
+
+        from .. import profiler
+
+        with profiler.RecordEvent("serving::cow_copy"):
+            self.pools = self._copy_jit(
+                self.pools, jnp.asarray(src, jnp.int32),
+                jnp.asarray(dst, jnp.int32))
+
+    # ---------------------------------------------------------- admission
+    def admit(self, slot: int, num_tokens: int, tokens=None) -> bool:
+        """Allocate what a prompt of num_tokens needs and populate the
+        slot's page-table row. When ``tokens`` is given and prefix caching
+        is on, the longest indexed whole-page prefix is SHARED (refcount
+        bump, no allocation) and only the remainder is allocated — the
+        engine then prefills only the uncached tail. False (no state
+        change) when even LRU eviction can't cover the private remainder.
+
+        A fully cached prompt still needs its last token recomputed (the
+        first output token is sampled from its logits), so the cached span
+        is capped at ``num_tokens - 1`` and the page holding that last
+        token must be writable: copy-on-write when any OTHER holder shares
+        it, in place when this request is the last (only) holder. The
+        in-place path keeps the page's index entry because the one write
+        that reaches it reproduces the exact bytes already resident (same
+        tokens over the same exact-zero-masked prefix, deterministic
+        kernels). The COW page is reserved inside the same all-or-nothing
+        allocation as the private remainder."""
         if slot in self._slot_pages:
             raise ValueError(f"slot {slot} already admitted")
-        pages = self.allocator.alloc(self.pages_for(num_tokens))
-        if pages is None:
+        total = self.pages_for(num_tokens)
+        shared: list[int] = []
+        if tokens is not None and self.cfg.enable_prefix_caching:
+            shared = self.match_prefix(tokens[:num_tokens])
+            for p in shared:
+                self._claim_shared(p)
+        cached = len(shared) * self.cfg.page_size
+        full_hit = bool(shared) and cached >= num_tokens
+        if full_hit:
+            cached = num_tokens - 1
+        # refcount includes this request's own claim: > 1 = other holders
+        need_cow = full_hit and self.allocator.refcount(shared[-1]) > 1
+        private = self._alloc_or_evict(total - len(shared)
+                                       + (1 if need_cow else 0))
+        if private is None:
+            self._release_pages(shared)
             return False
+        if need_cow:
+            dst = private.pop()
+            src = shared[-1]
+            self._copy_page_bytes(src, dst)
+            self.allocator.decref(src, hold=src in self._page_key)
+            shared[-1] = dst
+            self.cow_copies += 1
+        pages = shared + private
         self._slot_pages[slot] = pages
+        self._slot_cached[slot] = cached
         self.page_table[slot, :] = NULL_PAGE
         self.page_table[slot, :len(pages)] = pages
         return True
 
     def grow(self, slot: int, num_tokens: int) -> bool:
         """Ensure the slot can hold num_tokens, allocating pages on demand
-        (the continuous-batching decode step grows one token at a time).
-        False when the pool is exhausted — the scheduler must preempt."""
+        (the continuous-batching decode step grows one token at a time),
+        evicting reclaimable cached pages first. False when the pool is
+        truly exhausted — the scheduler must preempt."""
         pages = self._slot_pages[slot]
         need = self.pages_for(num_tokens)
         if need > self.cfg.pages_per_seq:
@@ -168,55 +482,104 @@ class PagedKVCache:
                 f"slot {slot}: {num_tokens} tokens need {need} pages > "
                 f"pages_per_seq={self.cfg.pages_per_seq}")
         while len(pages) < need:
-            got = self.allocator.alloc(1)
+            got = self._alloc_or_evict(1)
             if got is None:
                 return False
             self.page_table[slot, len(pages)] = got[0]
             pages.extend(got)
         return True
 
+    # --------------------------------------------------------------- swap
+    def _padded_idx(self, pages) -> np.ndarray:
+        """Page ids padded to the fixed ``pages_per_seq`` width with the
+        null page, so the swap jits never see a new shape (compile-once)."""
+        idx = np.full(self.cfg.pages_per_seq, NULL_PAGE, np.int32)
+        idx[:len(pages)] = pages
+        return idx
+
     def swap_out(self, slot: int) -> SwapHandle:
-        """Copy the slot's pages to host memory and free the device pages.
-        The returned handle is all that survives — the caller (scheduler)
-        owns attaching it to the preempted request."""
+        """Copy the slot's pages to host memory and drop its holds. One
+        jitted gather over the layer-stacked pools replaces the old
+        per-layer host loop (O(layers) device round-trips and a full-pool
+        functional copy per layer); shared pages are copied too — the
+        restore owns private pages — but their device copies survive for
+        the other holders."""
         pages = self._slot_pages.get(slot)
         if not pages:
             raise ValueError(f"slot {slot} has no pages to swap out")
-        idx = np.asarray(pages, np.int32)
-        layers = [{"k": np.asarray(pl["k_pool"][idx]),
-                   "v": np.asarray(pl["v_pool"][idx])} for pl in self.pools]
-        handle = SwapHandle(n_pages=len(pages), layers=layers)
+        import jax.numpy as jnp
+
+        n = len(pages)
+        k, v = self._gather_jit(self.pools,
+                                jnp.asarray(self._padded_idx(pages)))
+        handle = SwapHandle(n_pages=n, k=np.asarray(k)[:, :n].copy(),
+                            v=np.asarray(v)[:, :n].copy())
         self.release(slot)
         return handle
 
     def swap_in(self, slot: int, handle: SwapHandle) -> bool:
         """Reallocate handle.n_pages pages for the slot and restore the
-        swapped KV into them. False (no state change) when the pool can't
-        cover the handle — the scheduler keeps the request queued. Runs
-        outside jit: a swap event is rare, and the .at[].set copy it costs is
-        the price of never changing the pool's shape (compile-once holds)."""
+        swapped KV into them through the jitted donated scatter. False (no
+        state change) when even eviction can't cover the handle — the
+        scheduler keeps the request queued. Pool shapes never change, so
+        swap/restore can never retrigger a compile of the serving steps."""
         import jax.numpy as jnp
 
         if slot in self._slot_pages:
             raise ValueError(f"slot {slot} already admitted")
-        pages = self.allocator.alloc(handle.n_pages)
+        pages = self._alloc_or_evict(handle.n_pages)
         if pages is None:
             return False
-        idx = jnp.asarray(np.asarray(pages, np.int32))
-        self.pools = [
-            {"k_pool": pl["k_pool"].at[idx].set(jnp.asarray(h["k"])),
-             "v_pool": pl["v_pool"].at[idx].set(jnp.asarray(h["v"]))}
-            for pl, h in zip(self.pools, handle.layers)]
+        w = self.cfg.pages_per_seq
+        k_all = np.zeros((handle.k.shape[0], w) + handle.k.shape[2:],
+                         handle.k.dtype)
+        v_all = np.zeros_like(k_all)
+        k_all[:, :handle.n_pages] = handle.k
+        v_all[:, :handle.n_pages] = handle.v
+        # pad rows scatter zeros into the null page — never read unmasked
+        self.pools = self._scatter_jit(
+            self.pools, jnp.asarray(self._padded_idx(pages)),
+            jnp.asarray(k_all), jnp.asarray(v_all))
         self._slot_pages[slot] = pages
         self.page_table[slot, :] = NULL_PAGE
         self.page_table[slot, :len(pages)] = pages
         return True
 
+    # ------------------------------------------------------------ release
     def release(self, slot: int) -> None:
         pages = self._slot_pages.pop(slot, None)
+        self._slot_cached.pop(slot, None)
         if pages:
-            self.allocator.free(pages)
+            self._release_pages(pages)
         self.page_table[slot, :] = NULL_PAGE
 
     def utilization(self) -> float:
         return self.allocator.pages_in_use / max(1, self.cfg.usable_pages)
+
+    # --------------------------------------------------------- invariants
+    def check_invariants(self) -> None:
+        """Structural invariants the test suite sweeps after every
+        scenario; raises AssertionError with the violated relation."""
+        a = self.allocator
+        free = set(a._free)
+        live = set(a._ref)
+        parked = set(a._cached)
+        assert not (free & live) and not (free & parked) \
+            and not (live & parked), "page states must be disjoint"
+        assert len(free) + len(live) + len(parked) == a.num_usable, \
+            "every usable page is exactly one of free/live/reclaimable"
+        assert all(c >= 1 for c in a._ref.values()), "live refcounts >= 1"
+        indexed = set(self._page_key)
+        assert parked <= indexed, "reclaimable pages must stay indexed"
+        assert not (free & indexed), \
+            "a free page reachable through the prefix index would serve " \
+            "stale KV to its next matcher"
+        assert {p for k, p in self._key_to_page.items()} == indexed
+        assert set(self._page_serial) == indexed, \
+            "every indexed page carries exactly one chain serial"
+        held = list(itertools.chain.from_iterable(self._slot_pages.values()))
+        from collections import Counter
+
+        holds = Counter(held)
+        assert all(holds[p] <= a.refcount(p) for p in holds), \
+            "a page table may never hold more references than its refcount"

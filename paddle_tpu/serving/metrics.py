@@ -11,6 +11,9 @@ them with no new plumbing):
 - serving_tokens_total      counter: generated tokens (monotonic)
 - serving_tokens_per_sec    gauge: windowed decode throughput
 - serving_prefills_total    counter
+- serving_prefill_tokens_total counter: tokens actually prefilled (a prefix
+                            cache hit prefills only the uncached tail, so
+                            this is the FLOPs-weighted prefill cost)
 - serving_decode_steps      counter
 - serving_preemptions_total counter
 
@@ -23,6 +26,16 @@ Resilience counters (pre-seeded to 0 so they always appear in snapshots):
 - serving_failed     requests retired FAILED (injected or real step fault)
 - serving_swap_outs  swap-mode preemptions (KV paged out to host memory)
 - serving_swap_ins   swapped requests restored and resumed
+
+Prefix-cache counters/gauges (pre-seeded like the resilience set):
+
+- serving_prefix_hits          admissions that reused >= 1 cached page
+- serving_prefix_misses        cold admissions with caching enabled
+- serving_prefix_tokens_saved  prompt tokens served from cache, not prefill
+- serving_prefix_shared_pages  gauge: pages mapped by > 1 page table now
+- serving_prefix_cached_pages  gauge: refcount-0 reusable pages resident
+- serving_prefix_cow_copies    shared pages privatized before a write
+- serving_prefix_evictions     reusable pages reclaimed under pool pressure
 """
 from __future__ import annotations
 
@@ -36,7 +49,10 @@ PREFIX = "serving_"
 # always-visible resilience counters (a snapshot taken before the first
 # shed/expiry must still show the zeros — dashboards key on presence)
 _SEEDED = ("rejected", "shed", "expired", "cancelled", "failed",
-           "swap_outs", "swap_ins")
+           "swap_outs", "swap_ins",
+           "prefix_hits", "prefix_misses", "prefix_tokens_saved",
+           "prefix_shared_pages", "prefix_cached_pages",
+           "prefix_cow_copies", "prefix_evictions")
 
 
 class ServingMetrics:
@@ -57,8 +73,16 @@ class ServingMetrics:
         self._samples.append((time.perf_counter(), 0.0))
 
     # ------------------------------------------------------------- updates
-    def on_prefill(self) -> None:
+    def on_prefill(self, tokens: int = 0) -> None:
         monitor.stat_add(PREFIX + "prefills_total", 1)
+        monitor.stat_add(PREFIX + "prefill_tokens_total", int(tokens))
+
+    def on_prefix_hit(self, tokens_saved: int) -> None:
+        monitor.stat_add(PREFIX + "prefix_hits", 1)
+        monitor.stat_add(PREFIX + "prefix_tokens_saved", int(tokens_saved))
+
+    def on_prefix_miss(self) -> None:
+        monitor.stat_add(PREFIX + "prefix_misses", 1)
 
     def on_preempt(self) -> None:
         monitor.stat_add(PREFIX + "preemptions_total", 1)
@@ -99,12 +123,19 @@ class ServingMetrics:
         monitor.stat_add(PREFIX + "decode_steps", 1)
 
     def on_state(self, queue_depth: int, active: int, pages_used: int,
-                 usable_pages: int) -> None:
+                 usable_pages: int, shared_pages: int = 0,
+                 cached_pages: int = 0, cow_copies: int = 0,
+                 evictions: int = 0) -> None:
         monitor.stat_set(PREFIX + "queue_depth", queue_depth)
         monitor.stat_set(PREFIX + "active_requests", active)
         monitor.stat_set(PREFIX + "page_pool_used", pages_used)
         monitor.stat_set(PREFIX + "page_utilization",
                          pages_used / max(1, usable_pages))
+        monitor.stat_set(PREFIX + "prefix_shared_pages", shared_pages)
+        monitor.stat_set(PREFIX + "prefix_cached_pages", cached_pages)
+        # cache-owned monotonic counters, mirrored as absolute values
+        monitor.stat_set(PREFIX + "prefix_cow_copies", cow_copies)
+        monitor.stat_set(PREFIX + "prefix_evictions", evictions)
 
     # ------------------------------------------------------------ querying
     def snapshot(self) -> dict:

@@ -28,8 +28,17 @@ request was already admitted once and must not be lost to its own
 eviction; a full queue holding only preemption victims rejects the
 newcomer even under shed-oldest.
 
+Prefix caching changes the ACCOUNTING, not the policy: admission and
+preemption are costed in unique pages. A prompt's cached whole-page prefix
+is mapped by refcount bump (free to admit), a preemption victim only
+returns its private pages to the pool (shared pages keep their other
+holders' refcounts and stay resident), and the cache LRU-evicts
+refcount-0 reusable pages before any allocation is allowed to fail.
+
 Admission-time validation guarantees every accepted request can finish with
-the pool to itself, so the preempt-retry loop always terminates.
+the pool to itself — the bound is checked COLD (reusable prefix pages may
+be evicted before the request runs), so the preempt-retry loop always
+terminates even when every cached page is gone.
 """
 from __future__ import annotations
 
@@ -66,6 +75,7 @@ class Request:        # generated dataclass __eq__ chokes on ndarray fields
     error: BaseException | None = None  # recorded when state == FAILED
     swap: object | None = None  # kv_cache.SwapHandle while swapped out
     fresh: bool = False  # prefilled/swap-resumed this step, no decode yet
+    cached_tokens: int = 0  # prompt tokens served from the prefix cache
 
     @property
     def prompt_len(self) -> int:
@@ -178,7 +188,13 @@ class Scheduler:
                 if not self.cache.swap_in(slot, req.swap):
                     break
                 req.swap = None
-            elif not self.cache.admit(slot, req.prompt_len):
+                req.cached_tokens = 0
+            elif self.cache.admit(slot, req.prompt_len, tokens=req.prompt):
+                # admission cost is counted in UNIQUE pages: the cached
+                # whole-page prefix was mapped by refcount bump, so only
+                # the uncached tail consumed pool capacity
+                req.cached_tokens = self.cache.cached_tokens(slot)
+            else:
                 break
             self._free_slots.pop()
             self.waiting.popleft()

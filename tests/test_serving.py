@@ -401,6 +401,19 @@ def test_sampling_recompute_preemption_reproduces_tokens():
             err_msg="recomputed sampling request resampled different tokens")
 
 
+def test_prefix_counters_pre_seeded_in_registry():
+    # dashboards key on presence: a snapshot taken before the first hit/
+    # miss/COW must already carry the prefix-cache counters as zeros
+    model = _toy_model(seed=31)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=1, num_pages=8, page_size=4, max_prompt_len=8))
+    snap = engine.metrics.snapshot()
+    for k in ("prefix_hits", "prefix_misses", "prefix_tokens_saved",
+              "prefix_shared_pages", "prefix_cached_pages",
+              "prefix_cow_copies", "prefix_evictions"):
+        assert snap.get("serving_" + k) == 0, k
+
+
 def test_stuck_engine_report_is_actionable():
     model = _toy_model(seed=19)
     engine = ServingEngine(model, ServingConfig(

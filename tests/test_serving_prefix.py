@@ -1,0 +1,546 @@
+"""Automatic prefix caching: refcounted page sharing + copy-on-write.
+
+Pins the PR's contract end to end:
+
+- allocator refcount invariants (decref by one holder keeps a shared page
+  resident; double decref still raises; accounting drains to zero),
+- whole-page content matching and shared admission (unique-page cost),
+- COW fires only for the non-last writer; the sole holder writes in place,
+- preemption (recompute AND swap) of a request holding shared pages never
+  frees pages another request still references,
+- LRU eviction reclaims refcount-0 cached pages only when the allocator
+  would otherwise fail, purging their index entries (the recycled-page
+  stale-KV regression),
+- greedy outputs bit-identical with `enable_prefix_caching` on vs off and
+  hit vs cold miss; a shared-prefix pair reduces prefilled tokens by at
+  least the whole-page-rounded shared length,
+- engine + cache compile counts stable across hit/miss/COW/eviction paths
+  (prefix caching never changes pool or table shapes),
+- multi-bucket prefill: the bucket set is the only source of new compiles,
+- the jitted swap gather/scatter path: byte-exact roundtrip, one trace
+  each across swap events of different page counts.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.serving import (FaultInjector, PagedCacheConfig,
+                                PagedKVCache, PageAllocator, ServingConfig,
+                                ServingEngine)
+from paddle_tpu.serving.engine import prefill_buckets
+from paddle_tpu.serving.kv_cache import NULL_PAGE
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+
+def _cache(num_pages=9, page_size=4, max_batch=3, pages_per_seq=4,
+           caching=True):
+    return PagedKVCache(PagedCacheConfig(
+        num_layers=1, num_heads=1, head_dim=4, num_pages=num_pages,
+        page_size=page_size, max_batch=max_batch,
+        pages_per_seq=pages_per_seq, enable_prefix_caching=caching))
+
+
+# ----------------------------------------------------- allocator refcounts
+def test_allocator_refcount_share_and_drain():
+    a = PageAllocator(8)  # 7 usable
+    (p,) = a.alloc(1)
+    assert a.refcount(p) == 1 and a.pages_in_use == 1
+    assert a.incref(p) == 2
+    # decref by ONE holder keeps the page resident for the other
+    assert a.decref(p) == 1
+    assert a.pages_in_use == 1 and p not in a._free
+    assert a.decref(p) == 0
+    assert a.pages_in_use == 0 and a.num_free == 7
+    # double decref raises (free-list pages have no holders)
+    with pytest.raises(ValueError):
+        a.decref(p)
+    with pytest.raises(ValueError):
+        a.free([p])
+    with pytest.raises(ValueError):
+        a.incref(p)  # no live holders: revival goes through take_cached
+
+
+def test_allocator_hold_parks_reclaimable_not_free():
+    a = PageAllocator(4)
+    (p,) = a.alloc(1)
+    assert a.decref(p, hold=True) == 0
+    assert a.num_reclaimable == 1 and a.pages_in_use == 0
+    assert p not in a._free
+    # alloc never taps the reclaimable pool silently
+    assert a.alloc(3) is None and a.num_reclaimable == 1
+    # a cache hit revives it at refcount 1; eviction reclaims it to free
+    a.take_cached(p)
+    assert a.refcount(p) == 1 and a.num_reclaimable == 0
+    a.decref(p, hold=True)
+    assert a.reclaim_lru() == p
+    assert a.num_free == 3 and a.reclaim_lru() is None
+
+
+# ------------------------------------------------- matching + shared admit
+def test_admit_shares_cached_whole_pages_only():
+    c = _cache(num_pages=12, page_size=4)
+    prompt = np.arange(10, dtype=np.int32)  # 2 full pages + 2 tokens
+    assert c.admit(0, 10, tokens=prompt)
+    assert c.cached_tokens(0) == 0  # cold
+    c.register_prefix(0, prompt)  # indexes pages 0 and 1 (full), not 2
+    donor_pages = list(c._slot_pages[0])
+    used = c.allocator.pages_in_use
+
+    assert c.admit(1, 10, tokens=prompt)
+    assert c.cached_tokens(1) == 8  # whole-page granularity
+    pages1 = c._slot_pages[1]
+    assert pages1[:2] == donor_pages[:2]  # shared by table mapping
+    assert pages1[2] != donor_pages[2]    # the partial page is private
+    # sharing cost only ONE unique page
+    assert c.allocator.pages_in_use == used + 1
+    assert c.allocator.refcount(donor_pages[0]) == 2
+    assert c.shared_page_count() == 2
+    c.check_invariants()
+
+    # releasing ONE holder keeps the shared pages resident for the other
+    c.release(1)
+    assert c.allocator.refcount(donor_pages[0]) == 1
+    assert (c.page_table[0, :3] == donor_pages).all()
+    c.check_invariants()
+
+
+def test_release_parks_indexed_pages_reclaimable():
+    c = _cache()
+    prompt = np.arange(8, dtype=np.int32)
+    assert c.admit(0, 8, tokens=prompt)
+    c.register_prefix(0, prompt)
+    pages = list(c._slot_pages[0])
+    c.release(0)
+    # refcount-0 indexed pages park reclaimable (warm cache), in-use drains
+    assert c.allocator.pages_in_use == 0
+    assert c.allocator.num_reclaimable == 2
+    # a new identical prompt re-hits the SAME pages without allocation
+    assert c.admit(1, 8, tokens=prompt)
+    assert c._slot_pages[1][:1] == pages[:1]
+    assert c.cached_tokens(1) == 7  # full hit capped at prompt_len - 1
+    c.check_invariants()
+
+
+# ------------------------------------------------------------ copy-on-write
+def test_cow_triggers_only_for_the_non_last_writer():
+    c = _cache(num_pages=12, page_size=4)
+    prompt = np.arange(8, dtype=np.int32)  # exactly 2 full pages
+    assert c.admit(0, 8, tokens=prompt)
+    c.register_prefix(0, prompt)
+    donor_last = c._slot_pages[0][-1]
+
+    # donor still RUNNING: the full-prompt hit must privatize the last
+    # page before the tail write (another holder exists) -> COW copy
+    assert c.admit(1, 8, tokens=prompt)
+    assert c.cow_copies == 1
+    assert c._slot_pages[1][0] == c._slot_pages[0][0]  # first page shared
+    assert c._slot_pages[1][1] != donor_last           # last page copied
+    assert c.allocator.refcount(donor_last) == 1       # donor's alone again
+    c.check_invariants()
+
+    # all holders gone: the LAST writer takes the cached page in place
+    c.release(0)
+    c.release(1)
+    assert c.admit(2, 8, tokens=prompt)
+    assert c.cow_copies == 1, "sole holder must not copy"
+    assert c._slot_pages[2][1] == donor_last
+    assert c.cached_tokens(2) == 7
+    c.check_invariants()
+
+
+def test_cow_admission_is_all_or_nothing():
+    # pool sized so the COW page itself cannot be allocated: admission
+    # must fail cleanly with every claim rolled back
+    c = _cache(num_pages=5, page_size=4, pages_per_seq=4)  # 4 usable
+    prompt = np.arange(8, dtype=np.int32)
+    assert c.admit(0, 8, tokens=prompt)  # 2 pages
+    c.register_prefix(0, prompt)
+    assert c.admit(1, 7, tokens=np.arange(100, 107, dtype=np.int32))  # 2 more
+    # full hit on slot 0's chain while it still runs: needs 1 COW page,
+    # pool is dry and nothing is reclaimable
+    before = c.allocator.pages_in_use
+    assert not c.admit(2, 8, tokens=prompt)
+    assert c.allocator.pages_in_use == before
+    assert c.cow_copies == 0
+    assert c.allocator.refcount(c._slot_pages[0][0]) == 1  # claim undone
+    c.check_invariants()
+
+
+# ----------------------------------------------- preemption refcount safety
+@pytest.mark.parametrize("mode", ["release", "swap"])
+def test_preempting_shared_holder_never_frees_other_holders_pages(mode):
+    c = _cache(num_pages=12, page_size=4)
+    prompt = np.arange(12, dtype=np.int32)  # 3 full pages
+    assert c.admit(0, 12, tokens=prompt)
+    c.register_prefix(0, prompt)
+    assert c.admit(1, 12, tokens=prompt)  # shares 2, COWs the third
+    shared = c._slot_pages[0][:2]
+    assert c._slot_pages[1][:2] == shared
+
+    # preempt the DONOR (recompute drops its pages; swap copies them out)
+    if mode == "swap":
+        handle = c.swap_out(0)
+        assert handle.n_pages == 3
+    else:
+        c.release(0)
+    # the survivor's mapped pages are untouched and still refcounted
+    assert all(c.allocator.refcount(p) == 1 for p in shared)
+    assert (c.page_table[1, :3] == c._slot_pages[1]).all()
+    c.check_invariants()
+    c.release(1)
+    assert c.allocator.pages_in_use == 0
+    c.check_invariants()
+
+
+# --------------------------------------------------- LRU eviction + staleness
+def test_lru_eviction_only_when_allocator_would_fail():
+    c = _cache(num_pages=6, page_size=4, pages_per_seq=4,
+               max_batch=4)  # 5 usable pages
+    a_prompt = np.arange(8, dtype=np.int32)
+    assert c.admit(0, 8, tokens=a_prompt)
+    c.register_prefix(0, a_prompt)
+    c.release(0)  # 2 reclaimable, 3 free
+    assert c.allocator.num_reclaimable == 2
+
+    # fits in the free list: NO eviction, the warm cache survives
+    assert c.admit(1, 12, tokens=np.arange(50, 62, dtype=np.int32))
+    assert c.evictions == 0 and c.allocator.num_reclaimable == 2
+
+    # next admission overflows the free list: LRU pages are reclaimed and
+    # their index entries purged BEFORE the allocator is allowed to fail
+    assert c.admit(2, 8, tokens=np.arange(80, 88, dtype=np.int32))
+    assert c.evictions == 2
+    assert c.allocator.num_reclaimable == 0
+    assert c._key_to_page == {} and c._page_key == {}
+    c.check_invariants()
+
+    # the evicted chain is gone: the same prompt is now a cold miss
+    c.release(1)
+    c.release(2)
+    assert c.admit(3, 8, tokens=a_prompt)
+    assert c.cached_tokens(3) == 0
+    c.check_invariants()
+
+
+def test_recycled_page_never_serves_stale_kv():
+    """Regression (swap/stale-bytes satellite): a page freed by swap_out or
+    eviction and recycled into a new request must never be reachable
+    through the prefix index — a hit on it would splice stale KV into the
+    new request through the ragged mask's unmasked prefix."""
+    model = _toy_model(seed=31)
+    common = np.arange(1, 9, dtype=np.int32)
+    other = np.arange(40, 48, dtype=np.int32)
+
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=1, num_pages=5, page_size=4, max_prompt_len=8,
+        preemption_mode="swap"))
+    r1 = engine.add_request(common, 4)
+    out1 = engine.run()[r1]
+    # churn the tiny pool: the cached pages of r1 must be evicted to admit
+    # this disjoint request (4 usable pages, 2 cached + 3 needed)
+    engine.add_request(other, 4)
+    engine.run()
+    assert engine.cache.evictions > 0
+    # free-list pages must not be index-reachable
+    engine.cache.check_invariants()
+    # the original prompt again: whatever the cache state, output is
+    # bit-identical to the first run (stale pages would corrupt it)
+    r3 = engine.add_request(common, 4)
+    out3 = engine.run()[r3]
+    np.testing.assert_array_equal(out1, out3)
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_doomed_allocation_never_purges_the_warm_cache():
+    # an admission that cannot succeed even after full eviction must fail
+    # with NO state change — evicting the warm cache for a request that
+    # gets rejected anyway would make the next hit a pointless cold miss
+    c = _cache(num_pages=4, page_size=4, max_batch=2, pages_per_seq=4)
+    prompt = np.arange(8, dtype=np.int32)
+    assert c.admit(0, 8, tokens=prompt)  # 2 of the 3 usable pages
+    c.register_prefix(0, prompt)
+    c.release(0)  # 1 free + 2 reclaimable
+    assert not c.admit(1, 16, tokens=np.arange(30, 46, dtype=np.int32))
+    assert c.evictions == 0 and c.allocator.num_reclaimable == 2
+    assert len(c.match_prefix(prompt)) == 2, "warm chain must survive"
+    c.check_invariants()
+
+
+def test_recycled_page_id_cannot_resurrect_stale_chain_links():
+    """The linked-key index must survive page-id recycling: after chain
+    A's head is evicted and its PAGE ID becomes chain B's head, a prompt
+    of B's first block + A's second block must not match A's orphaned
+    child entry (keys link by never-reused registration serial, not by
+    recyclable page id — a page-id link would splice A's KV under B's
+    prefix)."""
+    c = _cache(num_pages=4, page_size=4, max_batch=2, pages_per_seq=4)
+    blk_a1 = np.arange(0, 4, dtype=np.int32)
+    blk_a2 = np.arange(4, 8, dtype=np.int32)
+    chain_a = np.concatenate([blk_a1, blk_a2])
+    assert c.admit(0, 8, tokens=chain_a)
+    c.register_prefix(0, chain_a)
+    a_head, a_child = c._slot_pages[0]
+    c.release(0)  # both parked reclaimable; head is LRU-oldest
+
+    # chain B needs 2 pages, free list holds 1: evicts ONLY a_head, and
+    # the recycled id becomes B's head page
+    blk_b1 = np.arange(50, 54, dtype=np.int32)
+    chain_b = np.concatenate([blk_b1, np.arange(60, 64, dtype=np.int32)])
+    assert c.admit(1, 8, tokens=chain_b)
+    assert c.evictions == 1
+    assert a_head in c._slot_pages[1], "eviction must recycle A's head id"
+    c.register_prefix(1, chain_b)
+    c.release(1)
+    assert a_child in c._page_key  # orphaned but parked: purges on evict
+
+    # the spliced prompt matches only B's head — never A's orphaned child
+    spliced = np.concatenate([blk_b1, blk_a2])
+    assert c.match_prefix(spliced) == [a_head]
+    c.check_invariants()
+
+
+# ------------------------------------------------------- jitted swap path
+def test_swap_gather_scatter_compile_once_across_sizes():
+    import jax.numpy as jnp
+
+    c = _cache(num_pages=12, page_size=4, max_batch=3, pages_per_seq=4)
+    rng = np.random.RandomState(3)
+    k = rng.rand(*np.shape(np.asarray(c.pools[0]["k_pool"]))).astype(
+        np.float32)
+    v = rng.rand(*k.shape).astype(np.float32)
+    c.pools = [{"k_pool": jnp.asarray(k), "v_pool": jnp.asarray(v)}]
+
+    assert c.admit(0, 6)   # 2 pages
+    assert c.admit(1, 12)  # 3 pages
+    p0, p1 = list(c._slot_pages[0]), list(c._slot_pages[1])
+    h0 = c.swap_out(0)
+    h1 = c.swap_out(1)  # DIFFERENT n_pages: same trace (padded width)
+    assert h0.n_pages == 2 and h1.n_pages == 3
+    np.testing.assert_array_equal(h0.k[0], k[p0])
+    np.testing.assert_array_equal(h1.v[0], v[p1])
+
+    assert c.swap_in(0, h1)  # restore across sizes, fresh page ids
+    assert c.swap_in(1, h0)
+    q0, q1 = c._slot_pages[0], c._slot_pages[1]
+    kk = np.asarray(c.pools[0]["k_pool"])
+    vv = np.asarray(c.pools[0]["v_pool"])
+    np.testing.assert_array_equal(kk[q0], k[p1])
+    np.testing.assert_array_equal(vv[q1], v[p0])
+    # one trace each across four swap events of two different sizes
+    assert c.compile_counts["swap_gather"] == 1
+    assert c.compile_counts["swap_scatter"] == 1
+
+
+# ------------------------------------------------------------- engine e2e
+def _toy_model(seed=29):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+                    max_seq_len=64, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _reference(model, prompt, budget):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=budget)
+    return np.asarray(out._value)[0]
+
+
+def _shared_prefix_prompts(rng, n, system_len=12, tail_len=3):
+    system = rng.randint(0, 97, (system_len,)).astype(np.int32)
+    return [np.concatenate([system, rng.randint(0, 97, (tail_len,))
+                            .astype(np.int32)]) for _ in range(n)]
+
+
+def test_prefix_hit_is_bit_identical_and_saves_prefill_tokens():
+    model = _toy_model()
+    rng = np.random.RandomState(0)
+    # 12-token shared system prompt = 3 whole pages of 4
+    prompts = _shared_prefix_prompts(rng, 3)
+    budgets = [5, 6, 4]
+
+    def drive(enable):
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=1, num_pages=32, page_size=4, max_prompt_len=16,
+            enable_prefix_caching=enable))
+        outs = {}
+        for p, b in zip(prompts, budgets):  # sequential: r2+ hit r1's pages
+            rid = engine.add_request(p, b)
+            outs[rid] = engine.run()[rid]
+        return engine, list(outs.values()), engine.metrics.snapshot()
+
+    eng_on, outs_on, snap_on = drive(True)
+    eng_off, outs_off, snap_off = drive(False)
+
+    # bit-identical on vs off, and vs the single-request reference
+    for i, (a, b) in enumerate(zip(outs_on, outs_off)):
+        np.testing.assert_array_equal(a, b, err_msg=f"request {i} diverged")
+        np.testing.assert_array_equal(a, _reference(model, prompts[i],
+                                                    budgets[i]))
+
+    # requests 2 and 3 each reused >= the whole-page-rounded shared length
+    assert snap_on["serving_prefix_hits"] == 2
+    assert snap_on["serving_prefix_misses"] == 1
+    shared_rounded = 12  # 12-token system prompt on page_size 4
+    assert snap_on["serving_prefix_tokens_saved"] >= 2 * shared_rounded
+    saved = (snap_off["serving_prefill_tokens_total"]
+             - snap_on["serving_prefill_tokens_total"])
+    assert saved >= 2 * shared_rounded
+    assert snap_on["serving_prefills_total"] == \
+        snap_off["serving_prefills_total"] == len(prompts)
+    assert eng_on.cache.allocator.pages_in_use == 0
+    eng_on.cache.check_invariants()
+
+
+def test_full_prompt_hit_and_concurrent_share_parity():
+    model = _toy_model(seed=41)
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, 97, (8,)).astype(np.int32)  # exactly 2 pages
+    ref = _reference(model, prompt, 6)
+
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=32, page_size=4, max_prompt_len=8))
+    r1 = engine.add_request(prompt, 6)
+    out1 = engine.run()[r1]
+    # full-prompt hit against the warm (reclaimable) chain: in-place take
+    r2 = engine.add_request(prompt, 6)
+    out2 = engine.run()[r2]
+    snap = engine.metrics.snapshot()
+    assert snap["serving_prefix_hits"] == 1
+    assert snap["serving_prefix_tokens_saved"] == 7  # capped at len - 1
+    assert snap["serving_prefix_cow_copies"] == 0
+
+    # two CONCURRENT identical prompts: the second must COW the last page
+    r3 = engine.add_request(prompt, 6)
+    r4 = engine.add_request(prompt, 6)
+    outs = engine.run()
+    assert engine.metrics.snapshot()["serving_prefix_cow_copies"] == 1
+    for out in (out1, out2, outs[r3], outs[r4]):
+        np.testing.assert_array_equal(ref, out)
+    assert engine.cache.allocator.pages_in_use == 0
+    engine.cache.check_invariants()
+
+
+def test_compile_counts_stable_across_hit_miss_cow_evict():
+    model = _toy_model(seed=43)
+    rng = np.random.RandomState(1)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=10, page_size=4, max_prompt_len=8))
+    prompts = _shared_prefix_prompts(rng, 4, system_len=4, tail_len=2)
+
+    # warmup: miss, hits, concurrent COW, and pool churn forcing eviction
+    for p in prompts[:2]:
+        engine.add_request(p, 4)
+    engine.run()
+    warm = dict(engine.compile_counts)
+    cache_warm = dict(engine.cache.compile_counts)
+    assert warm == {"prefill": 1, "decode": 1}  # one bucket at max 8
+
+    for p in prompts[2:]:
+        engine.add_request(p, 4)
+    engine.add_request(rng.randint(0, 97, (8,)).astype(np.int32), 6)
+    engine.add_request(rng.randint(0, 97, (7,)).astype(np.int32), 6)
+    engine.run()
+    assert engine.cache.evictions > 0 or \
+        engine.cache.allocator.num_reclaimable > 0
+
+    # hit/miss/COW/eviction never retrace: pool and table shapes are fixed
+    assert engine.compile_counts == warm
+    assert engine.cache.compile_counts["swap_gather"] == \
+        cache_warm["swap_gather"]
+    assert engine.cache.allocator.pages_in_use == 0
+    engine.cache.check_invariants()
+
+
+def test_multi_bucket_prefill_compiles_once_per_bucket():
+    assert prefill_buckets(8) == [8]
+    assert prefill_buckets(32) == [8, 16, 32]
+    assert prefill_buckets(48) == [8, 16, 32, 48]
+    assert prefill_buckets(6) == [6]
+
+    model = _toy_model(seed=47)
+    rng = np.random.RandomState(5)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=48, page_size=4, max_prompt_len=32,
+        enable_prefix_caching=False))  # isolate the bucket dimension
+    assert engine.prefill_buckets == [8, 16, 32]
+
+    def serve(n):
+        p = rng.randint(0, 97, (n,)).astype(np.int32)
+        rid = engine.add_request(p, 3)
+        np.testing.assert_array_equal(_reference(model, p, 3),
+                                      engine.run()[rid])
+
+    serve(3)   # bucket 8
+    assert engine.compile_counts["prefill"] == 1
+    serve(12)  # bucket 16
+    assert engine.compile_counts["prefill"] == 2
+    serve(30)  # bucket 32
+    assert engine.compile_counts["prefill"] == 3
+    # every further prompt reuses its bucket: the set is the ONLY source
+    # of prefill compiles, and decode never retraces
+    for n in (2, 8, 9, 16, 17, 31, 32, 5):
+        serve(n)
+    assert engine.compile_counts == {"prefill": 3, "decode": 1}
+
+
+def test_prefix_cache_accounting_drains_after_fault_suite():
+    model = _toy_model(seed=53)
+    rng = np.random.RandomState(9)
+    prompts = _shared_prefix_prompts(rng, 4, system_len=8, tail_len=2)
+    inj = (FaultInjector()
+           .arm("prefill_fail", step=0, rid=None)
+           .arm("decode_fail", step=2, rid=None)
+           .arm("pool_exhausted", step=3))
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=16, page_size=4, max_prompt_len=16),
+        fault_injector=inj)
+    rids = [engine.add_request(p, 5) for p in prompts]
+    outs = engine.run()
+    assert len(inj.fired) == 3
+    survivors = [r for r in rids if engine.status(r) == "finished"]
+    assert survivors and set(outs) == set(survivors)
+    for rid, p in zip(rids, prompts):
+        if rid in outs:
+            np.testing.assert_array_equal(_reference(model, p, 5),
+                                          outs[rid])
+    # faulted, preempted, and finished alike: page accounting drains to
+    # zero while the warm cache stays structurally sound
+    assert engine.cache.allocator.pages_in_use == 0
+    engine.cache.check_invariants()
+
+
+def test_sampling_parity_with_prefix_hits():
+    # hit-path tail prefill must not shift the (seed, rid, token) PRNG
+    # stream: sampled outputs are identical with caching on vs off
+    import itertools
+
+    from paddle_tpu.serving import scheduler as sched_mod
+
+    model = _toy_model(seed=59)
+    rng = np.random.RandomState(11)
+    prompts = _shared_prefix_prompts(rng, 3, system_len=8, tail_len=3)
+
+    def drive(enable):
+        sched_mod._rid_counter = itertools.count(7000)
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=1, num_pages=32, page_size=4, max_prompt_len=16,
+            do_sample=True, temperature=0.7, top_k=12, seed=3,
+            enable_prefix_caching=enable))
+        outs = []
+        for p in prompts:
+            rid = engine.add_request(p, 6)
+            outs.append(engine.run()[rid])
+        return outs, engine.metrics.snapshot()
+
+    saved = sched_mod._rid_counter
+    try:
+        outs_on, snap_on = drive(True)
+        outs_off, _ = drive(False)
+    finally:
+        sched_mod._rid_counter = saved
+    assert snap_on["serving_prefix_hits"] == 2
+    for a, b in zip(outs_on, outs_off):
+        np.testing.assert_array_equal(a, b)
