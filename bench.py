@@ -260,8 +260,18 @@ def _serving_prefix_bench() -> dict:
     48-token system prompt + a private 8-token tail) served with the
     automatic prefix cache on vs off. Reports decode throughput and the
     prefill tokens actually computed in each mode — the hit-vs-miss delta
-    is the tokens the cache saved."""
+    is the tokens the cache saved.
+
+    A SyncTally around the measured run CERTIFIES the decode loop
+    sync-free — exactly one device->host sync per step boundary (the token
+    fetch), zero strays — and the CompileGuards confirm zero over-budget
+    retraces; both totals are emitted as ``analysis_*`` keys in the JSON.
+    The timing itself runs with ``debug_checks`` OFF (the per-step strict
+    audit is a debugging mode, and its host overhead would pollute the
+    cache-on/off comparison); the tally and the guards' retrace counters
+    work either way."""
     import paddle_tpu as paddle
+    from paddle_tpu.analysis import SyncTally
     from paddle_tpu.serving import ServingConfig, ServingEngine
     from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
 
@@ -286,17 +296,34 @@ def _serving_prefix_bench() -> dict:
         for p in prompts[:2]:
             engine.add_request(p, budget)
             engine.run()
+        pre = engine.metrics.snapshot()
         t0 = time.perf_counter()
         for p in prompts[2:]:
             engine.add_request(p, budget)
-        engine.run()
+        with SyncTally() as tally:
+            engine.run()
         dt = time.perf_counter() - t0
         snap = engine.metrics.snapshot()
-        return (len(prompts) - 2) * budget / dt, snap
+        # sync-free certification: the ONLY host syncs in the measured
+        # region are the per-step-boundary token fetches (one per decode
+        # step + one per prefill's first-token fetch)
+        fetches = int(snap["serving_decode_steps"]
+                      - pre["serving_decode_steps"]
+                      + snap["serving_prefills_total"]
+                      - pre["serving_prefills_total"])
+        assert tally.count == fetches, (
+            f"decode loop not sync-free: {tally.count} syncs vs {fetches} "
+            f"sanctioned token fetches — events: {tally.events[:20]}")
+        assert snap["serving_analysis_retraces_total"] == 0, \
+            "compile budget violated in the serving bench"
+        return (len(prompts) - 2) * budget / dt, snap, tally.count
 
-    tps_on, snap_on = drive(True)
-    tps_off, snap_off = drive(False)
+    tps_on, snap_on, syncs_on = drive(True)
+    tps_off, snap_off, _ = drive(False)
     return {
+        "analysis_retraces_total":
+            int(snap_on["serving_analysis_retraces_total"]),
+        "analysis_host_syncs_total": syncs_on,
         "serving_prefix_tokens_per_sec_on": round(tps_on, 1),
         "serving_prefix_tokens_per_sec_off": round(tps_off, 1),
         "serving_prefix_prefill_tokens_on":

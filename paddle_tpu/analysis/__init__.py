@@ -1,0 +1,27 @@
+"""paddle_tpu.analysis — trace-time jit auditor + AST repo linter.
+
+Turns the serving stack's hand-pinned invariants into enforced checks:
+
+- :mod:`~paddle_tpu.analysis.tracecheck` — :class:`CompileGuard` (trace
+  counting + compile budgets + retrace *explanation* + donation checks),
+  :func:`donation_audit` (jaxpr-level donated-but-unused detection), and
+  :class:`SyncTally` (host-sync counting so a decode loop can be certified
+  sync-free). The serving engine's ``compile_counts`` surface is built on
+  CompileGuard; ``ServingConfig(debug_checks=True)`` turns the audits on
+  at every step boundary.
+- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT007 distilled from bugs
+  this repo shipped, with ``# lint: disable=PTxxx`` pragmas and allowlists.
+  ``python -m paddle_tpu.analysis paddle_tpu/`` must stay clean (a tier-1
+  test enforces zero findings).
+"""
+from .lint import (ALLOWLIST, RULES, Finding, lint_paths,  # noqa: F401
+                   lint_source)
+from .tracecheck import (CompileGuard, DonationViolation,  # noqa: F401
+                         RetraceError, SyncTally, SyncViolation,
+                         abstract_signature, donation_audit,
+                         explain_signature_diff)
+
+__all__ = ["CompileGuard", "RetraceError", "DonationViolation",
+           "SyncViolation", "SyncTally", "donation_audit",
+           "abstract_signature", "explain_signature_diff",
+           "Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths"]

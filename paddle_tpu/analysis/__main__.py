@@ -1,0 +1,6 @@
+"""``python -m paddle_tpu.analysis [paths] [--rule PTxxx] [--path SUB]``."""
+import sys
+
+from .lint import main
+
+sys.exit(main())

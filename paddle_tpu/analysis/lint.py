@@ -1,0 +1,397 @@
+"""AST repo linter: rules distilled from bugs this repo actually shipped.
+
+Every rule encodes a regression that cost a review cycle (or worse, landed):
+
+- PT001 — a ``@dataclass`` with an ndarray/Array field and no ``eq=False``:
+  the generated ``__eq__`` compares arrays elementwise; numpy 2 raises on
+  shape mismatch, and ``deque.remove`` corrupted the PR 2 waiting queue
+  exactly this way.
+- PT002 — a host ``for`` loop doing ``.at[...].set(...)`` per layer over a
+  stacked pool: each iteration is a separate dispatch that functionally
+  copies the ENTIRE pool (the PR 3 swap bug — O(pool) bytes per layer per
+  swap event). One jitted gather/scatter over a stacked view replaces it.
+  (Comprehensions inside to-be-jitted closures trace once and are exempt.)
+- PT003 — a monitor counter incremented (``stat_add``) without pre-seeding
+  in the module's ``_SEEDED`` registry: dashboards key on presence, so a
+  counter that first appears when the first bad event happens is invisible
+  exactly until it matters.
+- PT004 — ``time.time()`` inside ``serving/``: the engine clock is
+  pluggable (``ServingConfig(clock=)``) so deadlines/budgets are testable
+  without sleeping; raw wall-clock reads bypass the virtual clock and the
+  ``slow_step`` fault skew.
+- PT005 — a host-sync call (``np.asarray``/``np.array``/``jax.device_get``/
+  ``.item()``) inside a ``step()``/decode hot path in ``serving/``: every
+  sync stalls the dispatch pipeline; the ONE sanctioned sync (the step's
+  token fetch) carries an explicit pragma. (The dynamic complement is
+  ``analysis.tracecheck.SyncTally`` — this rule catches what's visible
+  statically.)
+- PT006 — jitting a function with pool-sized parameters without
+  ``donate_argnums``: without input/output aliasing every ``.at[]`` write
+  copies the whole pool and holds two pools live.
+- PT007 — mutable default argument: the shared-default-instance classic.
+
+Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
+pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
+path substring to rule codes exempt in matching files. Rules carry a
+``scope`` path-part restriction (PT002/PT004/PT005/PT006 fire only under
+``serving/`` — they encode serving-stack contracts).
+
+CLI: ``python -m paddle_tpu.analysis [paths] [--rule PTxxx] [--path SUB]``
+(also ``tools/lint.py``). Exit code 0 = clean, 1 = findings, 2 = bad usage.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+__all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
+           "main"]
+
+# path substring -> rule codes exempt in matching files (repo-level escape
+# hatch for generated or vendored code; empty by design — prefer pragmas,
+# which are visible at the offending line)
+ALLOWLIST: dict[str, set[str]] = {}
+
+_PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
+_ARRAY_ANN = re.compile(r"\bndarray\b|\bArray\b")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+def _unparse(node) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # noqa: BLE001 — diagnostics only
+        return "<?>"
+
+
+def _is_at_set_call(node) -> bool:
+    """``X.at[...].set(...)`` — the functional scatter-write idiom."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "set"
+            and isinstance(node.func.value, ast.Subscript)
+            and isinstance(node.func.value.value, ast.Attribute)
+            and node.func.value.value.attr == "at")
+
+
+# ------------------------------------------------------------------- rules
+def _pt001(tree, path):
+    """dataclass with ndarray/Array field missing eq=False."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        deco = next((d for d in node.decorator_list
+                     if "dataclass" in _unparse(d)), None)
+        if deco is None:
+            continue
+        if isinstance(deco, ast.Call) and any(
+                k.arg == "eq" and isinstance(k.value, ast.Constant)
+                and k.value.value is False for k in deco.keywords):
+            continue
+        arr = [f"{b.target.id}: {_unparse(b.annotation)}"
+               for b in node.body
+               if isinstance(b, ast.AnnAssign) and b.annotation is not None
+               and isinstance(b.target, ast.Name)
+               and _ARRAY_ANN.search(_unparse(b.annotation))]
+        if arr:
+            # anchored at the decorator: that line carries the fix (and
+            # any pragma)
+            yield (deco.lineno,
+                   f"dataclass {node.name!r} has array field(s) "
+                   f"({', '.join(arr)}) but no eq=False — the generated "
+                   f"__eq__ compares arrays elementwise (numpy 2 raises on "
+                   f"shape mismatch; deque.remove corrupted the PR 2 "
+                   f"queue). Use @dataclass(eq=False).")
+
+
+def _pt002(tree, path):
+    """Per-layer host .at[].set loop over a stacked pool."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.For):
+            continue
+        if "pool" not in _unparse(node.iter).lower():
+            continue
+        hit = next((n for n in ast.walk(node) if _is_at_set_call(n)), None)
+        if hit is not None:
+            yield (node.lineno,
+                   f"host for-loop over {_unparse(node.iter)!r} performs "
+                   f".at[].set per iteration — each is a separate dispatch "
+                   f"that functionally copies the ENTIRE pool (O(pool) "
+                   f"bytes per layer per event, the PR 3 swap bug). Move "
+                   f"the loop inside ONE jitted gather/scatter over a "
+                   f"layer-stacked view.")
+
+
+def _pt003(tree, path):
+    """Counter incremented without pre-seeding in the monitor registry."""
+    seeded, prefix = None, ""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            if tgt == "_SEEDED" and isinstance(node.value, (ast.Tuple,
+                                                            ast.List)):
+                seeded = {e.value for e in node.value.elts
+                          if isinstance(e, ast.Constant)}
+            elif tgt == "PREFIX" and isinstance(node.value, ast.Constant):
+                prefix = node.value.value
+    if seeded is None:  # no seeding registry in this module: no contract
+        return
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _unparse(node.func).endswith("stat_add") and node.args):
+            continue
+        arg, name = node.args[0], None
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+                and _unparse(arg.left) == "PREFIX" \
+                and isinstance(arg.right, ast.Constant):
+            name = arg.right.value
+        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+                and prefix and arg.value.startswith(prefix):
+            name = arg.value[len(prefix):]
+        if name is not None and name not in seeded:
+            yield (node.lineno,
+                   f"counter {name!r} is incremented but never pre-seeded "
+                   f"in _SEEDED — a snapshot taken before its first "
+                   f"increment omits it, and dashboards key on presence. "
+                   f"Add it to _SEEDED so reset() seeds the zero.")
+
+
+def _pt004(tree, path):
+    """time.time() in serving/ instead of the pluggable engine clock."""
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "time"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in ("time", "_time")):
+            yield (node.lineno,
+                   "time.time() in serving/ bypasses the pluggable engine "
+                   "clock (ServingConfig clock= + slow_step fault skew) — "
+                   "deadlines and budgets become untestable without "
+                   "sleeping. Use engine.now() / the injected clock.")
+
+
+_HOT_NAMES = ("step", "_step")
+
+
+def _pt005(tree, path):
+    """Host-sync call inside a step()/decode hot path."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not (fn.name in _HOT_NAMES or "decode" in fn.name):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            sync = None
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+                if f.value.id in ("np", "numpy") and \
+                        f.attr in ("asarray", "array"):
+                    sync = f"np.{f.attr}"
+                elif f.value.id == "jax" and f.attr == "device_get":
+                    sync = "jax.device_get"
+            if isinstance(f, ast.Attribute) and f.attr == "item" \
+                    and not node.args and not node.keywords:
+                sync = ".item()"
+            if sync:
+                yield (node.lineno,
+                       f"{sync} inside hot path {fn.name!r} blocks on a "
+                       f"device->host sync every step. If this is a "
+                       f"sanctioned token fetch, annotate it with "
+                       f"`# lint: disable=PT005`; otherwise move it off "
+                       f"the decode path. NOTE: bare int()/float() "
+                       f"coercions of device arrays sync too but are "
+                       f"invisible statically — route them through "
+                       f"np.asarray so this rule sees them, and rely on "
+                       f"SyncTally to certify the loop dynamically.")
+
+
+def _pt006(tree, path):
+    """jit of pool-sized args without donate_argnums."""
+    defs = {n.name: n for n in ast.walk(tree)
+            if isinstance(n, ast.FunctionDef)}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fname = _unparse(node.func)
+        if not (fname.endswith("jit") or fname.endswith("CompileGuard")):
+            continue
+        if any(k.arg == "donate_argnums" for k in node.keywords):
+            continue
+        target = node.args[0]
+        if isinstance(target, ast.Name):
+            fn = defs.get(target.id)
+        elif isinstance(target, ast.Attribute):
+            fn = defs.get(target.attr)
+        else:
+            fn = None
+        if fn is None:
+            continue
+        pool_args = [a.arg for a in fn.args.args if "pool" in a.arg.lower()]
+        if pool_args:
+            yield (node.lineno,
+                   f"{fname}({fn.name}) takes pool-sized argument(s) "
+                   f"{pool_args} but declares no donate_argnums — without "
+                   f"input/output aliasing every .at[] write copies the "
+                   f"whole pool and holds two pools live. Donate the pool, "
+                   f"or pragma-suppress if the function only READS it.")
+
+
+def _pt007(tree, path):
+    """Mutable default argument."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)):
+            continue
+        name = getattr(fn, "name", "<lambda>")
+        for d in list(fn.args.defaults) + [x for x in fn.args.kw_defaults
+                                           if x is not None]:
+            mutable = isinstance(d, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp,
+                                     ast.SetComp)) or (
+                isinstance(d, ast.Call) and isinstance(d.func, ast.Name)
+                and d.func.id in ("list", "dict", "set"))
+            if mutable:
+                yield (d.lineno,
+                       f"mutable default {_unparse(d)!r} in {name}() is "
+                       f"created ONCE and shared across every call — use "
+                       f"None and construct inside, or a dataclass "
+                       f"default_factory.")
+
+
+@dataclass(frozen=True)
+class Rule:
+    code: str
+    doc: str
+    check: object  # generator fn(tree, path) -> (line, message)
+    scope: str | None = None  # path part required for the rule to fire
+
+
+RULES: dict[str, Rule] = {r.code: r for r in (
+    Rule("PT001", "dataclass with ndarray/Array field missing eq=False",
+         _pt001),
+    Rule("PT002", "per-layer host .at[].set loop over a stacked pool",
+         _pt002, scope="serving"),
+    Rule("PT003", "metric counter incremented without pre-seeding", _pt003),
+    Rule("PT004", "time.time() in serving/ instead of the engine clock",
+         _pt004, scope="serving"),
+    Rule("PT005", "host-sync call inside a step()/decode hot path", _pt005,
+         scope="serving"),
+    Rule("PT006", "jit of pool-sized args without donate_argnums", _pt006,
+         scope="serving"),
+    Rule("PT007", "mutable default argument", _pt007),
+)}
+
+
+# ------------------------------------------------------------------ driver
+def _pragmas(source: str) -> dict[int, set[str]]:
+    out: dict[int, set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _PRAGMA.search(line)
+        if m:
+            out[i] = {c.strip() for c in m.group(1).split(",") if c.strip()}
+    return out
+
+
+def lint_source(source: str, path: str, rules=None,
+                allowlist=None) -> list[Finding]:
+    """Lint one module's source. ``path`` scopes path-restricted rules (a
+    fixture can be linted "as if" it lived under serving/)."""
+    allowlist = ALLOWLIST if allowlist is None else allowlist
+    parts = Path(path).parts
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("PT000", path, e.lineno or 0,
+                        f"syntax error: {e.msg}")]
+    pragmas = _pragmas(source)
+    exempt = set().union(*(codes for sub, codes in allowlist.items()
+                           if sub in path), set())
+    findings = []
+    for rule in RULES.values():
+        if rules is not None and rule.code not in rules:
+            continue
+        if rule.scope is not None and rule.scope not in parts:
+            continue
+        if rule.code in exempt:
+            continue
+        for line, msg in rule.check(tree, path):
+            if rule.code in pragmas.get(line, ()):
+                continue
+            findings.append(Finding(rule.code, path, line, msg))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def lint_paths(paths, rules=None, path_filter: str | None = None,
+               allowlist=None) -> list[Finding]:
+    """Lint every ``.py`` file under the given files/directories."""
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        files.extend(sorted(p.rglob("*.py")) if p.is_dir() else [p])
+    findings = []
+    for f in files:
+        rel = f.as_posix()
+        if path_filter is not None and path_filter not in rel:
+            continue
+        findings.extend(lint_source(f.read_text(), rel, rules=rules,
+                                    allowlist=allowlist))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="Repo linter: invariants this repo shipped bugs "
+                    "against, enforced (rules PT001-PT007).")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the installed "
+                             "paddle_tpu package)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="PTxxx", help="run only these rules "
+                        "(repeatable / comma-separated)")
+    parser.add_argument("--path", default=None, metavar="SUBSTR",
+                        help="lint only files whose path contains SUBSTR")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule table and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in RULES.values():
+            scope = f" [scope: {r.scope}/]" if r.scope else ""
+            print(f"{r.code}  {r.doc}{scope}")
+        return 0
+    rules = None
+    if args.rule:
+        rules = {c.strip() for spec in args.rule for c in spec.split(",")}
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))} "
+                  f"(have: {', '.join(RULES)})")
+            return 2
+    paths = args.paths
+    if not paths:
+        paths = [Path(__file__).resolve().parent.parent]
+    findings = lint_paths(paths, rules=rules, path_filter=args.path)
+    for f in findings:
+        print(f)
+    n = len(findings)
+    print(f"{n} finding(s)" if n else "clean: 0 findings")
+    return 1 if findings else 0

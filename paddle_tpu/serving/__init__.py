@@ -15,6 +15,12 @@ Performance layer: automatic prefix caching (refcounted cross-request page
 sharing with an exact content index, copy-on-write, and LRU eviction of
 reclaimable pages — only the uncached prompt tail is prefilled) and
 multi-bucket prefill (one compile per power-of-two pad bucket).
+
+Analysis layer (paddle_tpu.analysis): every jitted step sits behind a
+``CompileGuard`` (trace counting, compile budgets, retrace explanations,
+donation checks) — ``ServingConfig(debug_checks=True)`` makes the guards
+strict and sweeps ``PagedKVCache.check_invariants`` + a host-sync tally at
+every step boundary.
 """
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      prefill_buckets)

@@ -6,7 +6,11 @@ pages_per_seq], ctx_lens [max_batch], last_tok [max_batch], active
 [max_batch], rids [max_batch], gen_idx [max_batch]) — every array keeps its
 shape for the life of the engine, so requests joining and leaving the batch
 NEVER retrigger compilation (the e2e test asserts exactly-one trace per
-function via ``compile_counts``). Prefill compiles once per PAD BUCKET: a
+function via ``compile_counts``, which is now a read-through view of the
+``analysis.tracecheck.CompileGuard`` wrapping each jitted step — the guard
+counts traces, enforces the compile budget, and on an unexpected retrace
+explains WHICH argument's signature changed). Prefill compiles once per PAD
+BUCKET: a
 prompt (or, on a prefix-cache hit, its uncached tail) is right-padded to
 the smallest bucket in a fixed power-of-two set capped at
 ``max_prompt_len``, so short prompts stop paying max-length prefill FLOPs
@@ -50,6 +54,15 @@ Resilience layer:
 The engine clock is pluggable (``clock=``, default time.monotonic) and the
 ``slow_step`` fault point advances a virtual skew on top of it, so every
 deadline/budget behavior is testable without sleeping.
+
+Debug checks (``ServingConfig(debug_checks=True)``): every step boundary
+runs the CompileGuard audits in strict mode (an over-budget retrace raises
+RetraceError naming the offending argument BEFORE paying the recompile; a
+donated-then-referenced pool raises DonationViolation), sweeps
+``PagedKVCache.check_invariants()``, and tallies host syncs
+(``analysis.tracecheck.SyncTally``) into the ``serving_analysis_*``
+metrics. Costs host work per step (signature hashing + a structural sweep)
+— a debugging mode, not a serving mode.
 """
 from __future__ import annotations
 
@@ -61,6 +74,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.tracecheck import (CompileGuard, DonationViolation,
+                                   RetraceError, SyncTally)
 from ..core.tensor import Tensor
 from ..text.generation import sample_logits
 from .faults import InjectedFault
@@ -88,6 +103,7 @@ class ServingConfig:
     shed_policy: str = "reject"  # "reject" | "shed-oldest" when queue full
     preemption_mode: str = "recompute"  # "recompute" | "swap"
     enable_prefix_caching: bool = True  # cross-request KV page sharing
+    debug_checks: bool = False  # strict CompileGuard + invariant sweep/step
 
 
 def prefill_buckets(max_prompt_len: int) -> list[int]:
@@ -125,7 +141,8 @@ class ServingEngine:
             num_pages=cfg.num_pages, page_size=cfg.page_size,
             max_batch=cfg.max_batch, pages_per_seq=pages_per_seq,
             dtype=model.gpt.wte.weight._value.dtype,
-            enable_prefix_caching=cfg.enable_prefix_caching))
+            enable_prefix_caching=cfg.enable_prefix_caching,
+            debug_checks=cfg.debug_checks))
         self.prefill_buckets = prefill_buckets(cfg.max_prompt_len)
         self.scheduler = Scheduler(
             self.cache, cfg.max_batch, max_waiting=cfg.max_waiting,
@@ -147,16 +164,29 @@ class ServingEngine:
         self._finished: dict[int, np.ndarray] = {}
         self._retired: dict[int, Request] = {}  # cancelled/expired/failed/shed
         self._requests: dict[int, Request] = {}
-        # trace counters: the python bodies run only when jax (re)traces,
-        # i.e. exactly once per compilation — the e2e compile-once hook
-        self.compile_counts = {"prefill": 0, "decode": 0}
+        self._host_syncs = 0  # SyncTally total, counted under debug_checks
+        self._retraces_emitted = 0  # last value mirrored into the metrics
         # donate the pools: the engine rebinds self.cache.pools to the
         # returned arrays immediately, and without donation XLA can't alias
         # input to output — the .at[] scatter would copy the ENTIRE pool
         # every token and hold two pools live (for an HBM-sized pool that
-        # doubles cache memory and makes a step O(pool), not O(page))
-        self._prefill_jit = jax.jit(self._prefill_impl, donate_argnums=(1,))
-        self._decode_jit = jax.jit(self._decode_impl, donate_argnums=(1,))
+        # doubles cache memory and makes a step O(pool), not O(page)).
+        # CompileGuard counts traces (the compile_counts surface), enforces
+        # the compile budget — one trace per prefill bucket, one decode —
+        # and under debug_checks refuses an over-budget retrace with a
+        # diff naming the argument whose signature changed.
+        # prefill groups by pad-bucket shape: EACH bucket compiles at most
+        # once, so a same-bucket retrace (e.g. dtype drift) can't hide in
+        # the headroom of buckets this workload never used
+        self._prefill_jit = CompileGuard(
+            self._prefill_impl, "prefill", donate_argnums=(1,),
+            budget=len(self.prefill_buckets), strict=cfg.debug_checks,
+            group_by=lambda *a: tuple(a[2].shape))
+        self._decode_jit = CompileGuard(
+            self._decode_impl, "decode", donate_argnums=(1,),
+            budget=1, strict=cfg.debug_checks)
+        self.guards = {"prefill": self._prefill_jit,
+                       "decode": self._decode_jit}
 
     # --------------------------------------------------------- jitted steps
     def _req_key(self, rid, t):
@@ -191,7 +221,6 @@ class ServingEngine:
         the cached prefix is attended through the same ragged-masked
         gather decode uses. Returns (new_pools, first sampled token).
         Compiles once per pad bucket (padded_ids shape)."""
-        self.compile_counts["prefill"] += 1
         n = padded_ids.shape[0]
         table = page_row[None, :]
         ctx = jnp.reshape(ctx0.astype(jnp.int32), (1,))
@@ -210,7 +239,6 @@ class ServingEngine:
         """One token for every running slot. Inactive slots run the same
         computation against the null page and emit pad — branch-free, so the
         batch composition never changes the compiled program."""
-        self.compile_counts["decode"] += 1
         logits, new_pools = self._run_model(
             p_arrays, pools, table, ctx, active[:, None], last_tok[:, None])
         last = logits[:, -1, :]
@@ -224,6 +252,13 @@ class ServingEngine:
         return new_pools, tok
 
     # ------------------------------------------------------------ host loop
+    @property
+    def compile_counts(self) -> dict:
+        """Trace counts per jitted step, dict-shaped — the surface PR 1-3
+        pinned (``{"prefill": 1, "decode": 1}``), now read off the
+        CompileGuards instead of ad-hoc in-body counters."""
+        return {k: g.traces for k, g in self.guards.items()}
+
     def now(self) -> float:
         """Engine time: the pluggable clock plus any slow_step fault skew —
         the time base for deadlines and run() budgets."""
@@ -373,7 +408,31 @@ class ServingEngine:
         prefill (or swap-resume) joiners, one decode step for the whole
         batch, retire finishers. Returns the request ids that finished
         during this step. Injected faults retire only the requests they
-        name; everything else keeps being served."""
+        name; everything else keeps being served.
+
+        Under ``debug_checks`` the step body runs inside a SyncTally (host
+        syncs accumulate into ``serving_analysis_host_syncs_total``) and is
+        followed by a ``PagedKVCache.check_invariants()`` sweep; the
+        CompileGuards are strict, so an unexpected retrace or donation
+        misuse raises instead of silently recompiling."""
+        if self.config.debug_checks:
+            with SyncTally() as tally:
+                finished = self._step()
+            self._host_syncs += tally.count
+            self.cache.check_invariants()
+        else:
+            finished = self._step()
+        retraces = sum(g.retraces for g in
+                       (*self.guards.values(), *self.cache.guards.values()))
+        # the counters are pre-seeded at 0, so the non-debug hot loop only
+        # pays the two monitor stat_sets when something actually changed
+        if self.config.debug_checks or retraces != self._retraces_emitted:
+            self.metrics.on_analysis(retraces=retraces,
+                                     host_syncs=self._host_syncs)
+            self._retraces_emitted = retraces
+        return finished
+
+    def _step(self) -> list[int]:
         from .. import profiler
 
         # the ONLY injector read of the step (pinned by a test): the
@@ -428,6 +487,11 @@ class ServingEngine:
                         jnp.asarray(self.cache.page_table[req.slot]),
                         jnp.asarray(req.rid, jnp.int32))
                 except Exception as e:  # noqa: BLE001 — isolate the request
+                    if isinstance(e, (RetraceError, DonationViolation)):
+                        # a strict-guard refusal is an AUDIT failure — the
+                        # contract debug_checks exists to surface — not a
+                        # request-level fault to retire and serve past
+                        raise
                     if any(arr.is_deleted() for pl in self.cache.pools
                            for arr in pl.values()):
                         # the failure landed after donation consumed the
@@ -439,7 +503,10 @@ class ServingEngine:
                     self.metrics.on_failed()
                     continue
             self.cache.pools = pools
-            tok = int(tok)
+            # the prefill's sanctioned device->host sync: its first-token
+            # fetch, routed through the same np.asarray site PT005 polices
+            # (a bare int() coercion would sync invisibly to the linter)
+            tok = int(np.asarray(tok))  # lint: disable=PT005
             req.generated.append(tok)
             self._ctx[req.slot] = req.prompt_len
             self._last_tok[req.slot] = tok
@@ -486,7 +553,8 @@ class ServingEngine:
                     jnp.asarray(self._active), jnp.asarray(self._rids),
                     jnp.asarray(self._gen))
             self.cache.pools = pools
-            toks = np.asarray(toks)
+            # the step's ONE sanctioned device->host sync: the token fetch
+            toks = np.asarray(toks)  # lint: disable=PT005
             self.metrics.on_decode_step()
             n_new = 0
             for slot in np.nonzero(self._active)[0]:

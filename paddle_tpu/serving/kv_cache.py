@@ -165,7 +165,7 @@ class PageAllocator:
         return page
 
 
-@dataclass
+@dataclass(eq=False)  # ndarray fields: identity semantics (lint rule PT001)
 class SwapHandle:
     """Host-memory copy of one sequence's KV pages (swap-style preemption).
 
@@ -193,6 +193,7 @@ class PagedCacheConfig:
     pages_per_seq: int = 8  # page-table width == max seq pages per request
     dtype: object = None  # jnp dtype; None -> float32
     enable_prefix_caching: bool = True  # cross-request page sharing
+    debug_checks: bool = False  # strict CompileGuards on the swap/COW jits
 
     @property
     def max_tokens_per_seq(self) -> int:
@@ -243,21 +244,21 @@ class PagedKVCache:
         self._slot_cached: dict[int, int] = {}  # slot -> cached prompt tokens
         self.cow_copies = 0   # shared pages privatized before a write
         self.evictions = 0    # reclaimable pages purged under pressure
-        # trace counters for the cache-owned jitted steps: the python
-        # bodies run only when jax (re)traces — the fixed swap/COW shapes
-        # mean each compiles exactly once for the cache's lifetime
-        self.compile_counts = {"swap_gather": 0, "swap_scatter": 0,
-                               "cow_copy": 0}
         self._build_jits()
 
+    @property
+    def compile_counts(self) -> dict:
+        """Trace counts per cache-owned jitted step, dict-shaped (the PR 3
+        pinned surface), read off the CompileGuards: the fixed swap/COW
+        shapes mean each compiles exactly once for the cache's lifetime."""
+        return {k: g.traces for k, g in self.guards.items()}
+
     def _build_jits(self) -> None:
-        import jax
         import jax.numpy as jnp
 
-        counts = self.compile_counts
+        from ..analysis.tracecheck import CompileGuard
 
         def gather(pools, idx):
-            counts["swap_gather"] += 1
             # index each layer BEFORE stacking: stacking whole pools would
             # materialize an O(pool) concatenate per swap event — the exact
             # cost this jit exists to avoid; this way only the gathered
@@ -267,23 +268,32 @@ class PagedKVCache:
             return k, v
 
         def scatter(pools, idx, k_all, v_all):
-            counts["swap_scatter"] += 1
             return [{"k_pool": pl["k_pool"].at[idx].set(k_all[i]),
                      "v_pool": pl["v_pool"].at[idx].set(v_all[i])}
                     for i, pl in enumerate(pools)]
 
         def copy_page(pools, src, dst):
-            counts["cow_copy"] += 1
             return [{"k_pool": pl["k_pool"].at[dst].set(pl["k_pool"][src]),
                      "v_pool": pl["v_pool"].at[dst].set(pl["v_pool"][src])}
                     for pl in pools]
 
-        # gather reads the pools (no donation); scatter and COW consume
-        # them — without donation each .at[] write would copy the ENTIRE
-        # pool and hold two pools live
-        self._gather_jit = jax.jit(gather)
-        self._scatter_jit = jax.jit(scatter, donate_argnums=(0,))
-        self._copy_jit = jax.jit(copy_page, donate_argnums=(0,))
+        # gather READS the pools — donation would delete the other
+        # sequences' live KV; scatter and COW consume them: without
+        # donation each .at[] write would copy the ENTIRE pool and hold
+        # two pools live. Budget 1 each: the padded fixed shapes mean a
+        # second trace is always a bug.
+        strict = self.cfg.debug_checks
+        self._gather_jit = CompileGuard(  # lint: disable=PT006
+            gather, "swap_gather", budget=1, strict=strict)
+        self._scatter_jit = CompileGuard(
+            scatter, "swap_scatter", budget=1, strict=strict,
+            donate_argnums=(0,))
+        self._copy_jit = CompileGuard(
+            copy_page, "cow_copy", budget=1, strict=strict,
+            donate_argnums=(0,))
+        self.guards = {"swap_gather": self._gather_jit,
+                       "swap_scatter": self._scatter_jit,
+                       "cow_copy": self._copy_jit}
 
     # ------------------------------------------------------------- sizing
     def pages_for(self, num_tokens: int) -> int:

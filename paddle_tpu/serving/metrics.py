@@ -36,6 +36,19 @@ Prefix-cache counters/gauges (pre-seeded like the resilience set):
 - serving_prefix_cached_pages  gauge: refcount-0 reusable pages resident
 - serving_prefix_cow_copies    shared pages privatized before a write
 - serving_prefix_evictions     reusable pages reclaimed under pool pressure
+
+Analysis counters (paddle_tpu.analysis integration, pre-seeded):
+
+- serving_analysis_retraces_total    CompileGuard traces beyond the
+                                     declared compile budgets (0 = the
+                                     compile-once contract held)
+- serving_analysis_host_syncs_total  host-sync events tallied inside
+                                     step() under debug_checks (one per
+                                     step boundary — the token fetch — is
+                                     the sanctioned floor)
+
+Every counter incremented here is pre-seeded in ``_SEEDED`` — lint rule
+PT003 (this module shipped unseeded counters once) enforces it.
 """
 from __future__ import annotations
 
@@ -46,13 +59,17 @@ from ..utils import monitor
 
 PREFIX = "serving_"
 
-# always-visible resilience counters (a snapshot taken before the first
-# shed/expiry must still show the zeros — dashboards key on presence)
-_SEEDED = ("rejected", "shed", "expired", "cancelled", "failed",
+# always-visible counters (a snapshot taken before the first event must
+# still show the zeros — dashboards key on presence; lint rule PT003 flags
+# any stat_add of a name missing here)
+_SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
+           "decode_steps", "preemptions_total",
+           "rejected", "shed", "expired", "cancelled", "failed",
            "swap_outs", "swap_ins",
            "prefix_hits", "prefix_misses", "prefix_tokens_saved",
            "prefix_shared_pages", "prefix_cached_pages",
-           "prefix_cow_copies", "prefix_evictions")
+           "prefix_cow_copies", "prefix_evictions",
+           "analysis_retraces_total", "analysis_host_syncs_total")
 
 
 class ServingMetrics:
@@ -136,6 +153,12 @@ class ServingMetrics:
         # cache-owned monotonic counters, mirrored as absolute values
         monitor.stat_set(PREFIX + "prefix_cow_copies", cow_copies)
         monitor.stat_set(PREFIX + "prefix_evictions", evictions)
+
+    def on_analysis(self, retraces: int, host_syncs: int) -> None:
+        """CompileGuard/SyncTally totals, mirrored as absolute values (the
+        guards own the monotonic counts)."""
+        monitor.stat_set(PREFIX + "analysis_retraces_total", retraces)
+        monitor.stat_set(PREFIX + "analysis_host_syncs_total", host_syncs)
 
     # ------------------------------------------------------------ querying
     def snapshot(self) -> dict:

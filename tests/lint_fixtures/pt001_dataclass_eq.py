@@ -1,0 +1,27 @@
+"""PT001 fixture: dataclass with array fields and no eq=False."""
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class BadHandle:  # finding: generated __eq__ compares arrays elementwise
+    n_pages: int
+    k: np.ndarray
+    v: np.ndarray
+
+
+@dataclass  # lint: disable=PT001
+class SuppressedHandle:
+    k: np.ndarray
+
+
+@dataclass(eq=False)
+class GoodHandle:
+    k: np.ndarray
+
+
+@dataclass(frozen=True)
+class NoArrays:  # no array field: not a finding
+    n_pages: int
+    name: str

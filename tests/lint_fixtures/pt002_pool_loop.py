@@ -1,0 +1,23 @@
+"""PT002 fixture: per-layer host .at[].set loop over a stacked pool."""
+
+
+def swap_in_bad(self, pages, k_all, v_all):
+    for i, pl in enumerate(self.pools):  # finding: O(pool) copy per layer
+        pl["k_pool"] = pl["k_pool"].at[pages].set(k_all[i])
+        pl["v_pool"] = pl["v_pool"].at[pages].set(v_all[i])
+
+
+def swap_in_suppressed(self, pages, k_all, v_all):
+    for i, pl in enumerate(self.pools):  # lint: disable=PT002
+        pl["k_pool"] = pl["k_pool"].at[pages].set(k_all[i])
+
+
+def swap_in_good(self, pages, k_all, v_all):
+    # one jitted scatter over the stacked view: traced once, no host loop
+    self.pools = self._scatter_jit(self.pools, pages, k_all, v_all)
+
+
+def unrelated_loop(items, table):
+    for it in items:  # not over a pool: not a finding
+        table = table.at[it].set(0)
+    return table

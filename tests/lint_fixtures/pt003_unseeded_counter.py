@@ -1,0 +1,24 @@
+"""PT003 fixture: counter incremented without pre-seeding in _SEEDED."""
+from paddle_tpu.utils import monitor
+
+PREFIX = "serving_"
+
+_SEEDED = ("rejected", "expired")
+
+
+class Metrics:
+    def reset(self):
+        for k in _SEEDED:
+            monitor.stat_set(PREFIX + k, 0)
+
+    def on_rejected(self):
+        monitor.stat_add(PREFIX + "rejected", 1)  # seeded: not a finding
+
+    def on_shed(self):
+        monitor.stat_add(PREFIX + "shed", 1)  # finding: never seeded
+
+    def on_timeout(self):
+        monitor.stat_add("serving_timeouts", 1)  # finding: literal name
+
+    def on_legacy(self):
+        monitor.stat_add(PREFIX + "legacy", 1)  # lint: disable=PT003

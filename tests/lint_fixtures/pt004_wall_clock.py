@@ -1,0 +1,18 @@
+"""PT004 fixture: time.time() in serving/ instead of the engine clock."""
+import time
+
+
+def sweep_deadlines(requests):
+    now = time.time()  # finding: bypasses the pluggable clock
+    return [r for r in requests if r.deadline and now >= r.deadline]
+
+
+def sweep_suppressed(requests):
+    now = time.time()  # lint: disable=PT004
+    return [r for r in requests if r.deadline and now >= r.deadline]
+
+
+def sweep_good(engine, requests):
+    now = engine.now()  # pluggable clock + fault skew: not a finding
+    t0 = time.monotonic()  # the default clock itself is fine
+    return now, t0, requests

@@ -1,0 +1,21 @@
+"""PT005 fixture: host-sync call inside a step()/decode hot path."""
+import jax
+import numpy as np
+
+
+def step(self):
+    toks = self._decode_jit(self.pools)
+    toks = np.asarray(toks)  # finding: device->host sync every step
+    ctx = jax.device_get(self.ctx)  # finding
+    last = toks[0].item()  # finding
+    return toks, ctx, last
+
+
+def decode_loop(self):
+    toks = np.asarray(self._decode_jit(self.pools))  # lint: disable=PT005
+    return toks
+
+
+def admit(self, prompt):
+    # not a hot-path function name: not a finding
+    return np.asarray(prompt)

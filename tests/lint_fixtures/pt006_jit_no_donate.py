@@ -1,0 +1,20 @@
+"""PT006 fixture: jit of pool-sized args without donate_argnums."""
+import jax
+
+
+def scatter(pools, idx, vals):
+    return [pl.at[idx].set(vals) for pl in pools]
+
+
+def gather(pools, idx):
+    return [pl[idx] for pl in pools]
+
+
+def lookup(table, idx):
+    return table[idx]
+
+
+scatter_bad = jax.jit(scatter)  # finding: every .at[] write copies the pool
+scatter_good = jax.jit(scatter, donate_argnums=(0,))
+gather_read_only = jax.jit(gather)  # lint: disable=PT006
+lookup_jit = jax.jit(lookup)  # no pool-sized arg: not a finding

@@ -1,0 +1,21 @@
+"""PT007 fixture: mutable default argument."""
+
+
+def queue_request(req, queue=[]):  # finding: shared across every call
+    queue.append(req)
+    return queue
+
+
+def tally(name, counts={}):  # lint: disable=PT007
+    counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def keyword_only(*, seen=set()):  # finding: kw-only defaults count too
+    return seen
+
+
+def good(req, queue=None):
+    queue = [] if queue is None else queue
+    queue.append(req)
+    return queue

@@ -1,0 +1,397 @@
+"""paddle_tpu.analysis — trace-time auditor + repo linter.
+
+Three layers of coverage:
+
+- tracecheck golden tests: the retrace explainer must name the RIGHT
+  argument (and axis/dtype/static value) when a signature changes; budget
+  and donation violations raise; SyncTally counts exactly the host-sync
+  events and nothing else.
+- serving integration: the engine's pinned ``compile_counts`` surface now
+  reads off CompileGuard unchanged; ``debug_checks=True`` turns an
+  unexpected decode retrace into a RetraceError naming the argument and
+  runs the cache invariant sweep each step.
+- lint: one fixture per rule (positive + pragma-suppressed), the repo
+  self-lint at ZERO findings (the tier-1 enforcement of every fix this PR
+  made), and reintroduction tests proving the linter would catch the PR 2
+  ``eq`` bug and a ``time.time()`` in serving again.
+"""
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import (RULES, CompileGuard, DonationViolation,
+                                 RetraceError, SyncTally, SyncViolation,
+                                 donation_audit, lint_paths, lint_source)
+from paddle_tpu.serving import ServingConfig, ServingEngine
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.analysis
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+
+
+# ----------------------------------------------------------- CompileGuard
+def test_guard_counts_traces_not_calls():
+    g = CompileGuard(lambda x: x * 2, "double", budget=2)
+    for _ in range(3):
+        g(jnp.zeros((4,)))
+    g(jnp.zeros((8,)))
+    assert g.calls == 4 and g.traces == 2 and g.retraces == 0
+    assert len(g.signatures) == 2
+
+
+def test_guard_budget_counts_overage_when_not_strict():
+    g = CompileGuard(lambda x: x + 1, "inc", budget=1)
+    g(jnp.zeros((2,)))
+    g(jnp.zeros((3,)))  # over budget but unstrict: counted, not raised
+    assert g.traces == 2 and g.retraces == 1
+
+
+def test_retrace_explainer_names_argument_and_axis():
+    g = CompileGuard(lambda lhs, rhs: lhs @ rhs, "mm", budget=1, strict=True)
+    g(jnp.zeros((4, 8)), jnp.zeros((8, 2)))
+    with pytest.raises(RetraceError) as ei:
+        g(jnp.zeros((4, 16)), jnp.zeros((16, 2)))
+    msg = str(ei.value)
+    assert "'mm'" in msg and "budget of 1" in msg
+    assert "lhs" in msg and "rhs" in msg
+    assert "axis 1: 8 -> 16" in msg  # lhs changed on axis 1
+    assert "axis 0: 8 -> 16" in msg  # rhs changed on axis 0
+    # strict mode refuses BEFORE paying the recompile
+    assert g.traces == 1 and g.retraces == 1
+
+
+def test_retrace_explainer_names_dtype_change():
+    g = CompileGuard(lambda ctx, tok: ctx + tok, "step", budget=1,
+                     strict=True)
+    g(jnp.zeros((4,), jnp.int32), jnp.zeros((4,), jnp.int32))
+    with pytest.raises(RetraceError) as ei:
+        g(jnp.zeros((4,), jnp.float32), jnp.zeros((4,), jnp.int32))
+    msg = str(ei.value)
+    assert "ctx" in msg and "dtype int32 -> float32" in msg
+    assert "tok:" not in msg  # the unchanged argument is not blamed
+
+
+def test_retrace_explainer_names_static_value():
+    g = CompileGuard(lambda x, width: x[:width], "slice", budget=1,
+                     strict=True, static_argnums=(1,))
+    g(jnp.arange(8), 4)
+    g(jnp.arange(8), 4)  # same static value: cache hit
+    with pytest.raises(RetraceError) as ei:
+        g(jnp.arange(8), 6)
+    assert "width" in str(ei.value)
+    assert "static value 4 -> 6" in str(ei.value)
+
+
+def test_retrace_explainer_pytree_structure_change():
+    g = CompileGuard(lambda pools: [p * 2 for p in pools], "pools",
+                     budget=1, strict=True)
+    g([jnp.zeros(2)])
+    with pytest.raises(RetraceError) as ei:
+        g([jnp.zeros(2), jnp.zeros(2)])
+    assert "pytree structure changed" in str(ei.value)
+
+
+def test_strict_retry_of_refused_signature_counts_one_retrace():
+    # retraces counts retrace EVENTS, matching non-strict accounting: a
+    # caller looping on the same refused signature is one event, N raises
+    g = CompileGuard(lambda x: x + 1, "inc", budget=1, strict=True)
+    g(jnp.zeros((2,)))
+    for _ in range(3):
+        with pytest.raises(RetraceError):
+            g(jnp.zeros((5,)))
+    assert g.traces == 1 and g.retraces == 1
+    with pytest.raises(RetraceError):
+        g(jnp.zeros((7,)))  # a DIFFERENT bad signature is a second event
+    assert g.retraces == 2
+
+
+def test_group_budget_catches_same_group_retrace_despite_headroom():
+    # the prefill shape: aggregate budget 4 (buckets), but bucket (8,) must
+    # compile ONCE — a dtype drift re-tracing it is refused even though
+    # the aggregate budget has room for 3 more traces
+    g = CompileGuard(lambda ids: ids * 2, "prefill", budget=4, strict=True,
+                     group_by=lambda ids: tuple(ids.shape))
+    g(jnp.zeros((8,), jnp.int32))
+    g(jnp.zeros((16,), jnp.int32))  # a new bucket: allowed
+    with pytest.raises(RetraceError) as ei:
+        g(jnp.zeros((8,), jnp.float32))  # same bucket, drifted dtype
+    msg = str(ei.value)
+    assert "group (8,)" in msg and "dtype int32 -> float32" in msg
+    assert g.traces == 2 and g.retraces == 1
+
+
+def test_sync_tally_keeps_keyword_numpy_calls_working():
+    with SyncTally() as t:
+        out = np.asarray(a=jnp.arange(3))  # operand by keyword
+        np.asarray(np.ones(2), dtype=np.float32)
+    assert out.tolist() == [0, 1, 2] and t.count == 1
+
+
+def test_guard_use_after_donation_raises():
+    g = CompileGuard(lambda pool, i: pool.at[i].set(0.0), "scatter",
+                     donate_argnums=(0,), strict=True)
+    pool = jnp.ones((4, 2))
+    new_pool = g(pool, jnp.asarray(1))
+    with pytest.raises(DonationViolation) as ei:
+        g(pool, jnp.asarray(2))  # consumed buffer referenced again
+    assert "pool" in str(ei.value) and "donated" in str(ei.value)
+    g(new_pool, jnp.asarray(2))  # the returned array is the live one
+
+
+def test_guard_double_donation_raises():
+    g = CompileGuard(lambda a, b: (a.at[0].set(1.0), b.at[0].set(2.0)),
+                     "dd", donate_argnums=(0, 1), strict=True)
+    x = jnp.ones((3,))
+    with pytest.raises(DonationViolation) as ei:
+        g(x, x)
+    assert "double donation" in str(ei.value)
+
+
+def test_donation_audit_reports_unused_donated_leaf():
+    reports = donation_audit(lambda pool, dead: pool * 2, (0, 1),
+                             jnp.ones(3), jnp.ones(4))
+    assert len(reports) == 1 and "dead" in reports[0] \
+        and "never consumed" in reports[0]
+    assert donation_audit(lambda pool: pool * 2, (0,), jnp.ones(3)) == []
+
+
+# -------------------------------------------------------------- SyncTally
+def test_sync_tally_counts_sync_events_only():
+    with SyncTally() as t:
+        arr = jnp.arange(4)
+        jnp.sum(arr)            # device compute: not a sync
+        np.asarray(np.ones(2))  # host->host: not a sync
+        np.asarray(arr)         # sync
+        int(arr[0])             # sync
+        arr[1].item()           # sync
+        jax.device_get(arr)     # sync
+    assert t.count == 4
+    assert t.events == ["np.asarray", "int", "item", "device_get"]
+    # patches removed on exit: no counting outside the region
+    before = t.count
+    np.asarray(jnp.zeros(2))
+    assert t.count == before
+
+
+def test_sync_tally_nests_and_enforces_allowance():
+    with SyncTally() as outer:
+        with SyncTally() as inner:
+            np.asarray(jnp.zeros(2))
+        np.asarray(jnp.zeros(2))
+    assert inner.count == 1 and outer.count == 2
+    with pytest.raises(SyncViolation) as ei:
+        with SyncTally(allowed=1, name="decode"):
+            np.asarray(jnp.zeros(2))
+            np.asarray(jnp.zeros(2))
+    assert "decode" in str(ei.value) and "allows 1" in str(ei.value)
+
+
+# ------------------------------------------------------ serving integration
+def _toy_engine(**overrides):
+    paddle.seed(23)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=48, dropout=0.0))
+    model.eval()
+    kw = dict(max_batch=2, num_pages=20, page_size=4, max_prompt_len=8,
+              debug_checks=True)
+    kw.update(overrides)
+    return ServingEngine(model, ServingConfig(**kw))
+
+
+def test_engine_compile_counts_surface_reads_off_guards():
+    engine = _toy_engine()
+    rng = np.random.RandomState(0)
+    for n, b in ((3, 4), (6, 3)):
+        engine.add_request(rng.randint(0, 97, (n,)).astype(np.int32), b)
+    engine.run()
+    # the exact dict-shaped pin PR 1-3 rely on, now a CompileGuard view
+    assert engine.compile_counts == {"prefill": 1, "decode": 1}
+    assert engine.compile_counts["decode"] == \
+        engine.guards["decode"].traces
+    assert dict(engine.cache.compile_counts) == \
+        {"swap_gather": 0, "swap_scatter": 0, "cow_copy": 0}
+
+
+def test_engine_debug_checks_retrace_raises_naming_argument():
+    engine = _toy_engine()
+    rng = np.random.RandomState(1)
+    engine.add_request(rng.randint(0, 97, (4,)).astype(np.int32), 3)
+    engine.run()  # compiles prefill + decode once, audits clean
+    # an unexpected decode retrace: ctx at the wrong width. The guard must
+    # refuse it (budget 1 already spent) and blame exactly 'ctx'.
+    b = engine.config.max_batch
+    with pytest.raises(RetraceError) as ei:
+        engine._decode_jit(
+            engine._p, engine.cache.pools,
+            jnp.asarray(engine.cache.page_table),
+            jnp.zeros((b + 1,), jnp.int32),  # <- ctx grew an element
+            jnp.asarray(engine._last_tok), jnp.asarray(engine._active),
+            jnp.asarray(engine._rids), jnp.asarray(engine._gen))
+    msg = str(ei.value)
+    assert "'decode'" in msg and "ctx" in msg
+    assert f"axis 0: {b} -> {b + 1}" in msg
+    assert engine.compile_counts == {"prefill": 1, "decode": 1}
+
+
+def test_engine_debug_checks_serves_correctly_and_counts_syncs():
+    # debug_checks must not change behavior: outputs still match the
+    # reference loop, invariants sweep clean, and the analysis metrics
+    # report the per-step token fetches as the only host syncs
+    engine = _toy_engine()
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, 97, (n,)).astype(np.int32) for n in (3, 5)]
+    rids = [engine.add_request(p, 4) for p in prompts]
+    outs = engine.run()
+    from paddle_tpu.core.tensor import Tensor
+    for rid, p in zip(rids, prompts):
+        ref = np.asarray(engine.model.generate(
+            Tensor(p[None]), max_new_tokens=4)._value)[0]
+        np.testing.assert_array_equal(ref, outs[rid])
+    snap = engine.metrics.snapshot()
+    assert snap["serving_analysis_retraces_total"] == 0
+    # every decode step fetches its token batch (1 sync), every prefill
+    # fetches its first token (1 sync) — and NOTHING else syncs
+    expected = snap["serving_decode_steps"] + snap["serving_prefills_total"]
+    assert snap["serving_analysis_host_syncs_total"] == expected
+
+
+def test_analysis_counters_pre_seeded():
+    engine = _toy_engine(debug_checks=False)
+    snap = engine.metrics.snapshot()
+    assert snap["serving_analysis_retraces_total"] == 0
+    assert snap["serving_analysis_host_syncs_total"] == 0
+    # the PT003 backfill: every counter is visible before its first event
+    for k in ("tokens_total", "prefills_total", "prefill_tokens_total",
+              "decode_steps", "preemptions_total"):
+        assert snap["serving_" + k] == 0, k
+
+
+# ------------------------------------------------------------------- lint
+# fixture file -> (path the rule scope sees, {line: rule} expected)
+_FIXTURE_CASES = {
+    "pt001_dataclass_eq.py": ("pt001.py", {7: "PT001"}),
+    "pt002_pool_loop.py": ("serving/pt002.py", {5: "PT002"}),
+    "pt003_unseeded_counter.py": ("pt003.py", {18: "PT003", 21: "PT003"}),
+    "pt004_wall_clock.py": ("serving/pt004.py", {6: "PT004"}),
+    "pt005_hot_sync.py": ("serving/pt005.py",
+                          {8: "PT005", 9: "PT005", 10: "PT005"}),
+    "pt006_jit_no_donate.py": ("serving/pt006.py", {17: "PT006"}),
+    "pt007_mutable_default.py": ("pt007.py", {4: "PT007", 14: "PT007"}),
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(_FIXTURE_CASES))
+def test_lint_rule_fixture(fixture):
+    """Each rule: the positive cases fire at the expected lines, the
+    pragma-suppressed twin of the same defect stays quiet, clean code
+    stays quiet."""
+    as_path, expected = _FIXTURE_CASES[fixture]
+    src = (FIXTURES / fixture).read_text()
+    findings = lint_source(src, as_path)
+    assert {(f.line, f.rule) for f in findings} == set(expected.items()), \
+        [str(f) for f in findings]
+    assert "lint: disable" not in "".join(
+        src.splitlines()[f.line - 1] for f in findings)
+
+
+def test_lint_rule_table_is_complete():
+    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 8)]
+    for code, rule in RULES.items():
+        assert rule.doc and rule.code == code
+
+
+def test_serving_scoped_rules_do_not_fire_outside_serving():
+    src = (FIXTURES / "pt004_wall_clock.py").read_text()
+    assert lint_source(src, "io/dataloader_helper.py") == []
+
+
+def test_allowlist_exempts_matching_paths():
+    src = (FIXTURES / "pt004_wall_clock.py").read_text()
+    assert lint_source(src, "serving/legacy.py",
+                       allowlist={"legacy": {"PT004"}}) == []
+    assert lint_source(src, "serving/fresh.py",
+                       allowlist={"legacy": {"PT004"}}) != []
+
+
+def test_repo_self_lint_zero_findings():
+    """The tier-1 enforcement: every invariant the linter encodes holds
+    over paddle_tpu/ itself. A regression in any fixed violation (the
+    SwapHandle eq, the unseeded counters, a stray sync in step()) fails
+    here, forever."""
+    findings = lint_paths([REPO / "paddle_tpu"])
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_self_lint_catches_reintroduced_pr2_eq_bug():
+    """Deliberately strip SwapHandle's eq=False: the linter must fail the
+    way it would have failed PR 2's review."""
+    path = REPO / "paddle_tpu" / "serving" / "kv_cache.py"
+    src = path.read_text()
+    bad = src.replace("@dataclass(eq=False)  # ndarray fields: identity "
+                      "semantics (lint rule PT001)", "@dataclass")
+    assert bad != src, "kv_cache.py no longer carries the PT001 fix marker"
+    findings = lint_source(bad, "paddle_tpu/serving/kv_cache.py")
+    assert any(f.rule == "PT001" and "SwapHandle" in f.message
+               for f in findings)
+
+
+def test_self_lint_catches_reintroduced_wall_clock():
+    path = REPO / "paddle_tpu" / "serving" / "engine.py"
+    src = path.read_text()
+    bad = src.replace("self._clock = clock or time.monotonic",
+                      "self._clock = clock or (lambda: time.time())")
+    assert bad != src
+    findings = lint_source(bad, "paddle_tpu/serving/engine.py")
+    assert any(f.rule == "PT004" for f in findings)
+
+
+def test_lint_cli_exit_codes_and_filters(tmp_path):
+    clean = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu/"],
+        cwd=REPO, capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "0 findings" in clean.stdout
+
+    bad = tmp_path / "serving" / "dirty.py"
+    bad.parent.mkdir()
+    bad.write_text("import time\n\n\ndef step(self, q=[]):\n"
+                   "    return time.time()\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", str(tmp_path)],
+        cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "PT004" in r.stdout and "PT007" in r.stdout
+
+    only = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", str(tmp_path),
+         "--rule", "PT007"],
+        cwd=REPO, capture_output=True, text=True)
+    assert only.returncode == 1
+    assert "PT007" in only.stdout and "PT004" not in only.stdout
+
+    r2 = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", str(tmp_path),
+         "--path", "nonexistent-substring"],
+        cwd=REPO, capture_output=True, text=True)
+    assert r2.returncode == 0
+
+    unknown = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "--rule", "PT999"],
+        cwd=REPO, capture_output=True, text=True)
+    assert unknown.returncode == 2
+
+
+def test_tools_lint_entry_point():
+    r = subprocess.run([sys.executable, str(REPO / "tools" / "lint.py")],
+                       cwd=REPO, capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 findings" in r.stdout

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""CI/dev lint entry point — exit-code-clean wrapper over the repo linter.
+
+Usage:
+    python tools/lint.py                       # lint paddle_tpu/ (default)
+    python tools/lint.py tests/ examples/      # explicit paths
+    python tools/lint.py --rule PT004 --path serving
+    python tools/lint.py --list-rules
+
+Exit codes: 0 clean, 1 findings, 2 bad usage. The same engine runs as
+``python -m paddle_tpu.analysis``; tier-1 pins the self-lint at zero
+findings (tests/test_analysis.py::test_repo_self_lint_zero_findings).
+
+The repo root is forced onto sys.path FIRST, so with no paths given
+``main()``'s default — the directory of the imported paddle_tpu package —
+is this checkout's ``paddle_tpu/``, never an installed copy.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis.lint import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
