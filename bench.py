@@ -269,7 +269,15 @@ def _serving_prefix_bench() -> dict:
     The timing itself runs with ``debug_checks`` OFF (the per-step strict
     audit is a debugging mode, and its host overhead would pollute the
     cache-on/off comparison); the tally and the guards' retrace counters
-    work either way."""
+    work either way.
+
+    Observability phase (PR 5): the caching-on run reports its latency
+    decomposition — ``serving_ttft_s_p50/p99``, ``serving_tpot_s_p50/
+    p99``, ``serving_queue_wait_s_p99`` from the obs histograms — and
+    writes its Perfetto-loadable Chrome trace to
+    ``profiles/serving_trace.json``. A third run with tracing DISABLED
+    pins the obs overhead delta (``serving_obs_tokens_per_sec_on/off``):
+    tracing is on by default, so its cost must stay in the noise."""
     import paddle_tpu as paddle
     from paddle_tpu.analysis import SyncTally
     from paddle_tpu.serving import ServingConfig, ServingEngine
@@ -286,10 +294,10 @@ def _serving_prefix_bench() -> dict:
                .astype(np.int32) for _ in range(12)]
     budget = 8
 
-    def drive(enable):
+    def drive(enable, tracing=True):
         engine = ServingEngine(model, ServingConfig(
             max_batch=4, num_pages=64, page_size=16, max_prompt_len=64,
-            enable_prefix_caching=enable))
+            enable_prefix_caching=enable, enable_tracing=tracing))
         # warm BOTH prefill shapes out of the timing: the cold prompt's
         # bucket, then (caching on) the hit tail's smaller bucket — the
         # second request must run AFTER the first finishes to hit its pages
@@ -306,7 +314,8 @@ def _serving_prefix_bench() -> dict:
         snap = engine.metrics.snapshot()
         # sync-free certification: the ONLY host syncs in the measured
         # region are the per-step-boundary token fetches (one per decode
-        # step + one per prefill's first-token fetch)
+        # step + one per prefill's first-token fetch) — UNCHANGED with
+        # request tracing enabled (trace events never touch the device)
         fetches = int(snap["serving_decode_steps"]
                       - pre["serving_decode_steps"]
                       + snap["serving_prefills_total"]
@@ -316,10 +325,22 @@ def _serving_prefix_bench() -> dict:
             f"sanctioned token fetches — events: {tally.events[:20]}")
         assert snap["serving_analysis_retraces_total"] == 0, \
             "compile budget violated in the serving bench"
-        return (len(prompts) - 2) * budget / dt, snap, tally.count
+        return (len(prompts) - 2) * budget / dt, snap, tally.count, engine
 
-    tps_on, snap_on, syncs_on = drive(True)
-    tps_off, snap_off, _ = drive(False)
+    tps_on, snap_on, syncs_on, engine_on = drive(True)
+    tps_off, snap_off, _, _ = drive(False)
+    tps_obs_off, _, _, _ = drive(True, tracing=False)
+
+    trace_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "profiles",
+        "serving_trace.json")
+    try:
+        os.makedirs(os.path.dirname(trace_path), exist_ok=True)
+        engine_on.export_chrome_trace(trace_path)
+    except OSError as e:
+        print(f"[bench] WARNING: could not write serving trace: {e}",
+              file=sys.stderr, flush=True)
+        trace_path = None
     return {
         "analysis_retraces_total":
             int(snap_on["serving_analysis_retraces_total"]),
@@ -338,6 +359,17 @@ def _serving_prefix_bench() -> dict:
             snap_on["serving_prefix_hits"]
             / max(1, snap_on["serving_prefix_hits"]
                   + snap_on["serving_prefix_misses"]), 4),
+        # latency decomposition of the caching-on run (obs histograms)
+        "serving_ttft_s_p50": round(snap_on["serving_ttft_s_p50"], 6),
+        "serving_ttft_s_p99": round(snap_on["serving_ttft_s_p99"], 6),
+        "serving_tpot_s_p50": round(snap_on["serving_tpot_s_p50"], 6),
+        "serving_tpot_s_p99": round(snap_on["serving_tpot_s_p99"], 6),
+        "serving_queue_wait_s_p99":
+            round(snap_on["serving_queue_wait_s_p99"], 6),
+        # obs overhead delta: same workload, tracing on (default) vs off
+        "serving_obs_tokens_per_sec_on": round(tps_on, 1),
+        "serving_obs_tokens_per_sec_off": round(tps_obs_off, 1),
+        "serving_trace_path": trace_path,
     }
 
 

@@ -29,6 +29,12 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   ``donate_argnums``: without input/output aliasing every ``.at[]`` write
   copies the whole pool and holds two pools live.
 - PT007 — mutable default argument: the shared-default-instance classic.
+- PT008 — a monitor gauge written (``stat_set``/``stat_max``) without
+  pre-seeding in the module's ``_SEEDED`` registry: the unseeded-GAUGE
+  mirror of PT003. A gauge that first appears at its first write is
+  invisible on dashboards exactly until the condition it reports starts
+  happening (the serving gauges shipped this way — a snapshot taken
+  before the first step had no ``serving_queue_depth``).
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
@@ -37,7 +43,12 @@ path substring to rule codes exempt in matching files. Rules carry a
 ``serving/`` — they encode serving-stack contracts).
 
 CLI: ``python -m paddle_tpu.analysis [paths] [--rule PTxxx] [--path SUB]``
-(also ``tools/lint.py``). Exit code 0 = clean, 1 = findings, 2 = bad usage.
+(also ``tools/lint.py``). With no paths the DEFAULT sweep covers the
+installed package plus the repo's ``tests/`` and ``examples/`` trees
+(``--include`` overrides the extra trees) — the lint fixtures'
+intentional positives are exempted via :data:`ALLOWLIST`, and a tier-1
+test pins the whole default sweep at zero findings. Exit code 0 = clean,
+1 = findings, 2 = bad usage.
 """
 from __future__ import annotations
 
@@ -49,10 +60,14 @@ from pathlib import Path
 __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
            "main"]
 
-# path substring -> rule codes exempt in matching files (repo-level escape
-# hatch for generated or vendored code; empty by design — prefer pragmas,
-# which are visible at the offending line)
-ALLOWLIST: dict[str, set[str]] = {}
+# path substring -> rule codes exempt in matching files. Kept to the one
+# entry that CANNOT be a pragma: the lint fixtures are intentional
+# positives whose tests assert the rules DO fire — a pragma in the fixture
+# would defeat the fixture. Everything else should use pragmas, which are
+# visible at the offending line.
+ALLOWLIST: dict[str, set[str]] = {
+    "lint_fixtures": {f"PT00{i}" for i in range(1, 9)},
+}
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
 _ARRAY_ANN = re.compile(r"\bndarray\b|\bArray\b")
@@ -134,8 +149,10 @@ def _pt002(tree, path):
                    f"layer-stacked view.")
 
 
-def _pt003(tree, path):
-    """Counter incremented without pre-seeding in the monitor registry."""
+def _seeding_contract(tree):
+    """The module's (seeded names, stat prefix) — the registry PT003 and
+    PT008 check against. ``seeded`` is None when the module declares no
+    ``_SEEDED`` tuple (no contract to enforce)."""
     seeded, prefix = None, ""
     for node in tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
@@ -147,20 +164,35 @@ def _pt003(tree, path):
                           if isinstance(e, ast.Constant)}
             elif tgt == "PREFIX" and isinstance(node.value, ast.Constant):
                 prefix = node.value.value
+    return seeded, prefix
+
+
+def _stat_call_name(node, fn_suffixes, prefix):
+    """The statically visible stat name of a ``stat_xxx`` call: resolves
+    ``PREFIX + "name"`` concatenations and ``"prefix_name"`` literals;
+    None when the call isn't one of ``fn_suffixes`` or the name is built
+    dynamically (runtime-computed names can't be checked statically)."""
+    if not (isinstance(node, ast.Call) and node.args
+            and _unparse(node.func).endswith(fn_suffixes)):
+        return None
+    arg = node.args[0]
+    if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+            and _unparse(arg.left) == "PREFIX" \
+            and isinstance(arg.right, ast.Constant):
+        return arg.right.value
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
+            and prefix and arg.value.startswith(prefix):
+        return arg.value[len(prefix):]
+    return None
+
+
+def _pt003(tree, path):
+    """Counter incremented without pre-seeding in the monitor registry."""
+    seeded, prefix = _seeding_contract(tree)
     if seeded is None:  # no seeding registry in this module: no contract
         return
     for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and _unparse(node.func).endswith("stat_add") and node.args):
-            continue
-        arg, name = node.args[0], None
-        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
-                and _unparse(arg.left) == "PREFIX" \
-                and isinstance(arg.right, ast.Constant):
-            name = arg.right.value
-        elif isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
-                and prefix and arg.value.startswith(prefix):
-            name = arg.value[len(prefix):]
+        name = _stat_call_name(node, ("stat_add",), prefix)
         if name is not None and name not in seeded:
             yield (node.lineno,
                    f"counter {name!r} is incremented but never pre-seeded "
@@ -274,6 +306,23 @@ def _pt007(tree, path):
                        f"default_factory.")
 
 
+def _pt008(tree, path):
+    """Gauge written (stat_set/stat_max) without pre-seeding — the
+    unseeded-gauge mirror of PT003."""
+    seeded, prefix = _seeding_contract(tree)
+    if seeded is None:
+        return
+    for node in ast.walk(tree):
+        name = _stat_call_name(node, ("stat_set", "stat_max"), prefix)
+        if name is not None and name not in seeded:
+            yield (node.lineno,
+                   f"gauge {name!r} is written but never pre-seeded in "
+                   f"_SEEDED — it first appears in the registry when the "
+                   f"condition it reports starts happening, so a "
+                   f"dashboard keyed on presence is blind exactly until "
+                   f"then. Add it to _SEEDED so reset() seeds the zero.")
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -295,6 +344,8 @@ RULES: dict[str, Rule] = {r.code: r for r in (
     Rule("PT006", "jit of pool-sized args without donate_argnums", _pt006,
          scope="serving"),
     Rule("PT007", "mutable default argument", _pt007),
+    Rule("PT008", "metric gauge written (stat_set/stat_max) without "
+         "pre-seeding", _pt008),
 )}
 
 
@@ -360,10 +411,17 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Repo linter: invariants this repo shipped bugs "
-                    "against, enforced (rules PT001-PT007).")
+                    "against, enforced (rules PT001-PT008).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the installed "
-                             "paddle_tpu package)")
+                             "paddle_tpu package plus the repo's --include "
+                             "trees)")
+    parser.add_argument("--include", action="append", default=None,
+                        metavar="DIR",
+                        help="repo-root-relative trees swept in addition "
+                             "to the package when no paths are given "
+                             "(default: tests, examples; missing trees "
+                             "are skipped)")
     parser.add_argument("--rule", action="append", default=None,
                         metavar="PTxxx", help="run only these rules "
                         "(repeatable / comma-separated)")
@@ -388,7 +446,15 @@ def main(argv=None) -> int:
             return 2
     paths = args.paths
     if not paths:
-        paths = [Path(__file__).resolve().parent.parent]
+        # default sweep: the package itself + the repo's test/example
+        # trees (the satellites where a serving contract regression can
+        # hide just as well; intentional fixture findings are exempted
+        # via ALLOWLIST, so the sweep pins zero NON-fixture findings)
+        pkg = Path(__file__).resolve().parent.parent
+        include = args.include if args.include is not None \
+            else ["tests", "examples"]
+        paths = [pkg] + [p for d in include
+                         if (p := pkg.parent / d).is_dir()]
     findings = lint_paths(paths, rules=rules, path_filter=args.path)
     for f in findings:
         print(f)

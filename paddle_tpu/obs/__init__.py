@@ -1,0 +1,40 @@
+"""paddle_tpu.obs — serving-grade observability.
+
+The layer that answers the operational questions the serving invariants
+(compile-once, sync-free decode — paddle_tpu.analysis) cannot: where did a
+request spend its time, what are TTFT/TPOT at p50/p99, and what did the
+engine's step timeline look like when tail latency spiked.
+
+- :mod:`~paddle_tpu.obs.trace` — per-request lifecycle traces
+  (:class:`Tracer`, :class:`RequestTrace`): timestamped events from the
+  pluggable engine clock, summarized into queue_wait / prefill_time /
+  TTFT / TPOT / e2e. O(1) per event, bounded retention.
+- :mod:`~paddle_tpu.obs.histogram` — fixed-bucket streaming
+  :class:`Histogram` (bounded memory, pre-seeded presence) backing the
+  ``serving_ttft_s`` / ``serving_tpot_s`` / ``serving_queue_wait_s`` /
+  ``serving_e2e_s`` / ``serving_step_duration_s`` /
+  ``serving_batch_occupancy`` percentile gauges.
+- :mod:`~paddle_tpu.obs.timeline` — the engine loop's bounded per-step
+  ring (:class:`StepTimeline`): phase mix, batch size, page pressure,
+  preemptions, host syncs under ``debug_checks``.
+- :mod:`~paddle_tpu.obs.export` — Chrome ``trace_event`` JSON (one track
+  per request + one for the engine loop; loads in Perfetto) and
+  Prometheus text exposition.
+
+Imports nothing from ``paddle_tpu.serving`` — serving imports us. Tracing
+is on by default in the engine (``ServingConfig(enable_tracing=)``); the
+off path costs one attribute check per event site and the on path adds no
+host syncs to the decode loop (the SyncTally certification is unchanged).
+"""
+from .export import (chrome_trace, latency_table,  # noqa: F401
+                     prometheus_text, write_chrome_trace)
+from .histogram import (LATENCY_EDGES_S, OCCUPANCY_EDGES,  # noqa: F401
+                        QUANTILES, Histogram)
+from .timeline import StepRecord, StepTimeline  # noqa: F401
+from .trace import RequestTrace, TraceEvent, Tracer  # noqa: F401
+
+__all__ = ["Histogram", "LATENCY_EDGES_S", "OCCUPANCY_EDGES", "QUANTILES",
+           "Tracer", "RequestTrace", "TraceEvent",
+           "StepTimeline", "StepRecord",
+           "chrome_trace", "write_chrome_trace", "prometheus_text",
+           "latency_table"]

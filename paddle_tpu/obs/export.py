@@ -1,0 +1,183 @@
+"""Exporters: Chrome ``trace_event`` JSON (Perfetto) and Prometheus text.
+
+Chrome trace: the classic ``{"traceEvents": [...]}`` JSON that
+chrome://tracing and https://ui.perfetto.dev load directly. Layout is one
+process (pid 1, "paddle_tpu.serving") holding one track per request (tid =
+rid + 1, named "request <rid>") plus the engine loop on tid 0: request
+tracks carry complete ("X") spans for the queued / prefill / decode phases
+rebuilt from the raw lifecycle events, with instants ("i") for
+preemptions, swaps, decode marks, and retirement; the engine track carries
+one span per step, labeled by its phase mix and carrying the step's batch
+size / page pressure / preemption count in ``args``. Timestamps are
+engine-clock seconds rebased to the earliest event and scaled to the
+microseconds the format requires — a virtual test clock exports exactly
+like a wall clock.
+
+Prometheus: standard text exposition (``# TYPE`` + samples) over the
+monitor registry's ``serving_*`` scalars and the obs histograms rendered
+as cumulative ``_bucket{le="..."}`` series with ``_sum``/``_count`` — the
+format every Prometheus scraper and promtool understands.
+"""
+from __future__ import annotations
+
+import json
+
+from .histogram import Histogram
+from .timeline import StepTimeline
+from .trace import RequestTrace
+
+__all__ = ["chrome_trace", "write_chrome_trace", "prometheus_text",
+           "latency_table"]
+
+_ENGINE_TID = 0
+_PID = 1
+
+# lifecycle events that ALSO render as instants on the request's track
+_INSTANTS = ("preempted", "swap_out", "swap_in", "decode_mark", "retired")
+
+
+def _request_events(trace: RequestTrace) -> list[dict]:
+    """Rebuild one request's phase spans + instants from its raw events.
+    A span left open at the end of the trace (a still-live request) is
+    closed at the last event's timestamp so exports of a running engine
+    stay loadable."""
+    tid = trace.rid + 1
+    out: list[dict] = []
+    open_name: str | None = None
+    open_t = 0.0
+
+    def close(t: float) -> None:
+        nonlocal open_name
+        if open_name is not None:
+            out.append({"name": open_name, "ph": "X", "ts": open_t,
+                        "dur": max(t - open_t, 0.0), "pid": _PID,
+                        "tid": tid, "cat": "request"})
+            open_name = None
+
+    for ev in trace.events:
+        if ev.name == "enqueued":
+            open_name, open_t = "queued", ev.t
+        elif ev.name == "admitted":
+            close(ev.t)
+        elif ev.name == "prefill_start":
+            close(ev.t)
+            open_name, open_t = "prefill", ev.t
+        elif ev.name == "prefill_end":
+            close(ev.t)
+        elif ev.name in ("first_token", "resumed"):
+            close(ev.t)
+            open_name, open_t = "decode", ev.t
+        elif ev.name == "preempted":
+            close(ev.t)
+            open_name, open_t = "queued", ev.t
+        elif ev.name == "retired":
+            close(ev.t)
+        if ev.name in _INSTANTS:
+            name = ev.name
+            if ev.name == "retired":
+                name = f"retired: {ev.arg('state', '?')}"
+            out.append({"name": name, "ph": "i", "ts": ev.t, "pid": _PID,
+                        "tid": tid, "s": "t", "cat": "request",
+                        "args": dict(ev.args or {})})
+    if trace.events:
+        close(trace.events[-1].t)
+    return out
+
+
+def chrome_trace(traces=(), timeline: StepTimeline | None = None) -> dict:
+    """Build the ``trace_event`` JSON dict from request traces and/or the
+    engine step timeline. Pure function of its inputs — safe to call on a
+    live engine between steps."""
+    raw: list[dict] = []
+    names: dict[int, str] = {_ENGINE_TID: "engine loop"}
+    for trace in traces:
+        names[trace.rid + 1] = f"request {trace.rid}"
+        raw.extend(_request_events(trace))
+    if timeline is not None:
+        for rec in timeline.records():
+            args = {"step": rec.step, "batch": rec.batch,
+                    "prefills": rec.prefills, "admitted": rec.admitted,
+                    "finished": rec.finished,
+                    "preemptions": rec.preemptions,
+                    "queue_depth": rec.queue_depth,
+                    "pages_in_use": rec.pages_in_use}
+            if rec.host_syncs is not None:
+                args["host_syncs"] = rec.host_syncs
+            args.update(rec.extra)
+            raw.append({"name": rec.phase_mix(), "ph": "X",
+                        "ts": rec.t_start, "dur": rec.duration,
+                        "pid": _PID, "tid": _ENGINE_TID, "cat": "engine",
+                        "args": args})
+    # rebase to the earliest timestamp and scale seconds -> microseconds
+    origin = min((e["ts"] for e in raw), default=0.0)
+    for e in raw:
+        e["ts"] = (e["ts"] - origin) * 1e6
+        if "dur" in e:
+            e["dur"] *= 1e6
+    meta = [{"name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
+             "args": {"name": "paddle_tpu.serving"}}]
+    meta += [{"name": "thread_name", "ph": "M", "pid": _PID, "tid": tid,
+              "args": {"name": label}}
+             for tid, label in sorted(names.items())]
+    return {"traceEvents": meta + raw, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path, traces=(),
+                       timeline: StepTimeline | None = None) -> dict:
+    """Render and write the Perfetto-loadable JSON; returns the dict."""
+    doc = chrome_trace(traces, timeline)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc
+
+
+def _fmt(v) -> str:
+    """Prometheus sample value: integral floats print as ints."""
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def prometheus_text(stats: dict, histograms=(), types: dict | None = None,
+                    ) -> str:
+    """Text exposition of scalar stats (``types`` maps name -> "counter";
+    everything else is a gauge) plus histograms as cumulative bucket
+    series. Histogram-derived scalar mirrors (``<hist>_p50`` etc.) are
+    skipped — scrapers should aggregate the buckets themselves."""
+    types = types or {}
+    lines: list[str] = []
+    hist_prefixes = tuple(h.name for h in histograms)
+    for name in sorted(stats):
+        if name.startswith(hist_prefixes) and hist_prefixes:
+            continue  # published as a real histogram below
+        lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
+        lines.append(f"{name} {_fmt(stats[name])}")
+    for h in histograms:
+        lines.append(f"# TYPE {h.name} histogram")
+        for edge, cum in h.cumulative_buckets():
+            le = "+Inf" if edge == float("inf") else f"{edge:.10g}"
+            lines.append(f'{h.name}_bucket{{le="{le}"}} {cum}')
+        lines.append(f"{h.name}_sum {_fmt(h.sum)}")
+        lines.append(f"{h.name}_count {h.count}")
+    return "\n".join(lines) + "\n"
+
+
+def latency_table(summaries, header: bool = True) -> str:
+    """Fixed-width per-request latency table (queue wait / TTFT / TPOT /
+    e2e, seconds) from :meth:`RequestTrace.summary` dicts — the demo's
+    human-readable view of the same decomposition the histograms
+    aggregate."""
+    def cell(v, width=10):
+        return (f"{v:>{width}.4f}" if isinstance(v, float)
+                else f"{str(v) if v is not None else '-':>{width}}")
+
+    rows = []
+    if header:
+        rows.append(f"{'rid':>5} {'state':>9} {'tokens':>6} "
+                    f"{'queue_wait':>10} {'ttft':>10} {'tpot':>10} "
+                    f"{'e2e':>10}")
+    for s in summaries:
+        rows.append(" ".join([f"{s['rid']:>5}", f"{s['state'] or '?':>9}",
+                              f"{s['tokens']:>6}",
+                              cell(s["queue_wait"]), cell(s["ttft"]),
+                              cell(s["tpot"]), cell(s["e2e"])]))
+    return "\n".join(rows)

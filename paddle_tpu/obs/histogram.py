@@ -1,0 +1,126 @@
+"""Fixed-bucket streaming histograms for serving latency metrics.
+
+Design constraints, in order:
+
+- **Bounded memory.** A serving engine observes one latency sample per
+  request (TTFT, TPOT, queue wait, e2e) and two per step (duration,
+  occupancy) forever; storing raw samples grows without bound. A fixed
+  bucket layout costs ``len(edges) + 1`` ints for the life of the process
+  — the same shape Prometheus client histograms use, so the exporter in
+  obs/export.py renders the classic ``_bucket{le=...}`` series directly.
+- **O(log buckets) observe.** ``observe`` is a bisect + two adds — cheap
+  enough to sit on the engine's step boundary without showing up in the
+  obs-on-vs-off bench delta.
+- **Pre-seeded presence** (the PT003/PT008 contract): a histogram exists —
+  and its percentile gauges read 0 — from construction, not from its first
+  sample, so dashboards keyed on metric presence never miss the early
+  window of an incident.
+
+Percentiles are estimated by linear interpolation inside the bucket that
+holds the requested rank (the standard Prometheus ``histogram_quantile``
+estimator): exact at bucket edges, within one bucket width everywhere
+else. The overflow bucket is reported as its lower edge — a deliberate
+underestimate that keeps a single runaway sample from painting p99 as
+infinity.
+"""
+from __future__ import annotations
+
+from bisect import bisect_left
+
+__all__ = ["Histogram", "LATENCY_EDGES_S", "OCCUPANCY_EDGES", "QUANTILES"]
+
+# Latency edges in seconds: ~Prometheus default widened to cover both a
+# microbenchmark CPU step (sub-millisecond) and a multi-minute queue wait.
+LATENCY_EDGES_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+)
+
+# Batch-occupancy edges: small integers exact, powers of two beyond — a
+# decode batch is a slot count, not a duration.
+OCCUPANCY_EDGES = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+                   32.0, 64.0, 128.0, 256.0)
+
+# The quantiles every serving histogram publishes: (suffix, q).
+QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+class Histogram:
+    """Fixed-bucket histogram: bucket ``i`` counts samples in
+    ``(edges[i-1], edges[i]]`` (bucket 0 is ``(-inf, edges[0]]``), plus one
+    overflow bucket above ``edges[-1]``. Tracks ``count``/``sum`` so mean
+    and Prometheus exposition come for free."""
+
+    def __init__(self, name: str, edges=LATENCY_EDGES_S):
+        edges = tuple(float(e) for e in edges)
+        if len(edges) < 2 or any(a >= b for a, b in zip(edges, edges[1:])):
+            raise ValueError(
+                f"histogram {name!r}: edges must be >= 2 strictly "
+                f"increasing values, got {edges}")
+        self.name = name
+        self.edges = edges
+        self.counts = [0] * (len(edges) + 1)  # + overflow bucket
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, value: float) -> None:
+        """O(log buckets): bisect to the owning bucket, bump two counters."""
+        v = float(value)
+        self.counts[bisect_left(self.edges, v)] += 1
+        self.count += 1
+        self.sum += v
+
+    def reset(self) -> None:
+        self.counts = [0] * (len(self.edges) + 1)
+        self.count = 0
+        self.sum = 0.0
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def percentile(self, q: float) -> float:
+        """Linear interpolation inside the bucket holding rank ``q *
+        count`` (the histogram_quantile estimator). 0.0 for an empty
+        histogram; the first bucket interpolates from 0 (these are
+        non-negative measurements); the overflow bucket clamps to the top
+        edge rather than extrapolating to infinity."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} outside [0, 1]")
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if cum + c >= target:
+                if i == len(self.edges):  # overflow: clamp, don't invent
+                    return self.edges[-1]
+                lo = 0.0 if i == 0 else self.edges[i - 1]
+                hi = self.edges[i]
+                frac = (target - cum) / c if c else 0.0
+                return lo + frac * (hi - lo)
+            cum += c
+        return self.edges[-1]
+
+    def snapshot(self) -> dict:
+        """Percentiles + count/sum/mean, always present (zeros when
+        empty), keyed by the quantile suffixes the metrics registry
+        publishes."""
+        out = {suffix: self.percentile(q) for suffix, q in QUANTILES}
+        out.update(count=self.count, sum=self.sum, mean=self.mean)
+        return out
+
+    def cumulative_buckets(self) -> list[tuple[float, int]]:
+        """``(upper_edge, cumulative_count)`` pairs, Prometheus
+        ``_bucket{le=...}`` shaped; the final pair is ``(inf, count)``."""
+        out, cum = [], 0
+        for edge, c in zip(self.edges, self.counts):
+            cum += c
+            out.append((edge, cum))
+        out.append((float("inf"), self.count))
+        return out
+
+    def __repr__(self) -> str:
+        return (f"Histogram({self.name!r}, count={self.count}, "
+                f"p50={self.percentile(0.5):.4g}, "
+                f"p99={self.percentile(0.99):.4g})")

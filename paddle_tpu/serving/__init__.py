@@ -19,8 +19,16 @@ multi-bucket prefill (one compile per power-of-two pad bucket).
 Analysis layer (paddle_tpu.analysis): every jitted step sits behind a
 ``CompileGuard`` (trace counting, compile budgets, retrace explanations,
 donation checks) — ``ServingConfig(debug_checks=True)`` makes the guards
-strict and sweeps ``PagedKVCache.check_invariants`` + a host-sync tally at
+strict, donation-audits each step at jaxpr level before its first trace,
+and sweeps ``PagedKVCache.check_invariants`` + a host-sync tally at
 every step boundary.
+
+Observability layer (paddle_tpu.obs, on by default): per-request
+lifecycle traces off the engine clock (``engine.trace(rid)`` — queue
+wait / TTFT / TPOT / e2e summaries), streaming latency histograms with
+``_p50/_p90/_p99`` gauges in ``ServingMetrics.snapshot()``, a bounded
+per-step timeline, and Chrome-trace/Prometheus exporters
+(``engine.export_chrome_trace()``, ``ServingMetrics.prometheus()``).
 """
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      prefill_buckets)

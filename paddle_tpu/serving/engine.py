@@ -61,8 +61,24 @@ RetraceError naming the offending argument BEFORE paying the recompile; a
 donated-then-referenced pool raises DonationViolation), sweeps
 ``PagedKVCache.check_invariants()``, and tallies host syncs
 (``analysis.tracecheck.SyncTally``) into the ``serving_analysis_*``
-metrics. Costs host work per step (signature hashing + a structural sweep)
-— a debugging mode, not a serving mode.
+metrics. Each jitted step is additionally donation-audited at jaxpr level
+before its FIRST trace (``analysis.donation_audit``): a donated buffer the
+computation never consumes is a wrong ``donate_argnums`` and raises
+DonationViolation naming the leaf. Costs host work per step (signature
+hashing + a structural sweep) — a debugging mode, not a serving mode.
+
+Observability (``paddle_tpu.obs``, on by default via ``enable_tracing``):
+every request accrues a timestamped lifecycle trace (enqueued, admitted,
+prefill_start/end, first_token, periodic decode marks, preemption/swap
+events, retired-with-state) off the same pluggable clock — retrievable
+with ``engine.trace(rid)``, summarized into queue_wait / TTFT / TPOT /
+e2e, fed into the fixed-bucket serving histograms at retirement, and
+exportable as Perfetto-loadable Chrome trace JSON
+(``engine.export_chrome_trace()``) alongside the bounded per-step
+timeline (``engine.timeline``). The contract: O(1) appends per event,
+ONE attribute check per event site when tracing is off, and ZERO new
+host syncs on the decode loop either way (the SyncTally certification
+in bench/demo is unchanged with tracing enabled).
 """
 from __future__ import annotations
 
@@ -75,14 +91,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.tracecheck import (CompileGuard, DonationViolation,
-                                   RetraceError, SyncTally)
+                                   RetraceError, SyncTally, donation_audit)
 from ..core.tensor import Tensor
+from ..obs import StepRecord, StepTimeline, Tracer, chrome_trace
+from ..obs import write_chrome_trace
 from ..text.generation import sample_logits
 from .faults import InjectedFault
 from .kv_cache import PagedCacheConfig, PagedKVCache
 from .metrics import ServingMetrics
 from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, RUNNING,
-                        WAITING, EngineOverloaded, Request, Scheduler)
+                        SHED, WAITING, EngineOverloaded, Request, Scheduler)
 
 
 @dataclass(frozen=True)
@@ -104,6 +122,10 @@ class ServingConfig:
     preemption_mode: str = "recompute"  # "recompute" | "swap"
     enable_prefix_caching: bool = True  # cross-request KV page sharing
     debug_checks: bool = False  # strict CompileGuard + invariant sweep/step
+    enable_tracing: bool = True  # per-request traces + step timeline (obs)
+    trace_capacity: int = 2048  # retained traces (terminal evicted oldest)
+    decode_mark_every: int = 32  # decode_mark trace event cadence (tokens)
+    timeline_capacity: int = 512  # step records retained in the ring
 
 
 def prefill_buckets(max_prompt_len: int) -> list[int]:
@@ -144,14 +166,26 @@ class ServingEngine:
             enable_prefix_caching=cfg.enable_prefix_caching,
             debug_checks=cfg.debug_checks))
         self.prefill_buckets = prefill_buckets(cfg.max_prompt_len)
-        self.scheduler = Scheduler(
-            self.cache, cfg.max_batch, max_waiting=cfg.max_waiting,
-            shed_policy=cfg.shed_policy, preemption_mode=cfg.preemption_mode)
         self.metrics = ServingMetrics()
         params, _ = model.functional_state()
         self._p = {k: v._value for k, v in params.items()}
         self._clock = clock or time.monotonic
         self._skew = 0.0  # virtual seconds injected by slow_step faults
+        # obs layer: request tracer + step timeline run off the engine
+        # clock (virtual-clock testable, zero host syncs); None when off —
+        # every event site costs one attribute check and nothing else
+        if cfg.enable_tracing:
+            self._tracer = Tracer(self.now, capacity=cfg.trace_capacity,
+                                  mark_every=cfg.decode_mark_every)
+            self._timeline = StepTimeline(cfg.timeline_capacity)
+        else:
+            self._tracer = None
+            self._timeline = None
+        self._step_stats: dict | None = None  # _step -> step() handoff
+        self.scheduler = Scheduler(
+            self.cache, cfg.max_batch, max_waiting=cfg.max_waiting,
+            shed_policy=cfg.shed_policy, preemption_mode=cfg.preemption_mode,
+            tracer=self._tracer)
         self._fault_injector = fault_injector
         self._step_idx = 0
         self.admit_paused = False  # run(budget_s=) drain; settable by callers
@@ -166,6 +200,7 @@ class ServingEngine:
         self._requests: dict[int, Request] = {}
         self._host_syncs = 0  # SyncTally total, counted under debug_checks
         self._retraces_emitted = 0  # last value mirrored into the metrics
+        self._donation_audits: dict[str, list] = {}  # debug_checks reports
         # donate the pools: the engine rebinds self.cache.pools to the
         # returned arrays immediately, and without donation XLA can't alias
         # input to output — the .at[] scatter would copy the ENTIRE pool
@@ -300,10 +335,14 @@ class ServingEngine:
         except EngineOverloaded:
             self.metrics.on_rejected()
             raise
+        tr = self._tracer
+        if tr is not None:
+            tr.begin(req.rid)
         if shed is not None:
             self._requests.pop(shed.rid, None)
             self._retired[shed.rid] = shed
             self.metrics.on_shed()
+            self._trace_retire(shed, SHED)
         self._requests[req.rid] = req
         return req.rid
 
@@ -334,6 +373,18 @@ class ServingEngine:
         FAILED request); None for finished/unknown rids."""
         return self._requests.get(rid) or self._retired.get(rid)
 
+    def _trace_retire(self, req: Request, state: str) -> None:
+        """Stamp the terminal ``retired`` trace event and feed the
+        request-latency histograms from the completed lifecycle. One
+        attribute check when tracing is off."""
+        tr = self._tracer
+        if tr is not None:
+            tr.event(req.rid, "retired", state=state,
+                     tokens=len(req.generated))
+            trace = tr.get(req.rid)
+            if trace is not None:
+                self.metrics.observe_request(trace.summary())
+
     def _retire(self, req: Request, state: str,
                 error: BaseException | None = None) -> None:
         """Terminal exit for a non-finished request: pull it out of waiting
@@ -344,6 +395,7 @@ class ServingEngine:
         req.state, req.error = state, error
         self._requests.pop(req.rid, None)
         self._retired[req.rid] = req
+        self._trace_retire(req, state)
 
     def _sweep_deadlines(self) -> None:
         with_deadline = [r for r in self._requests.values()
@@ -390,6 +442,7 @@ class ServingEngine:
             self._clear_slot(slot)
             self._finished[req.rid] = req.output()
             self._requests.pop(req.rid, None)  # bookkeeping ends at finish
+            self._trace_retire(req, FINISHED)
             return True
         return False
 
@@ -420,8 +473,10 @@ class ServingEngine:
                 finished = self._step()
             self._host_syncs += tally.count
             self.cache.check_invariants()
+            syncs = tally.count
         else:
             finished = self._step()
+            syncs = None
         retraces = sum(g.retraces for g in
                        (*self.guards.values(), *self.cache.guards.values()))
         # the counters are pre-seeded at 0, so the non-debug hot loop only
@@ -430,6 +485,13 @@ class ServingEngine:
             self.metrics.on_analysis(retraces=retraces,
                                      host_syncs=self._host_syncs)
             self._retraces_emitted = retraces
+        # obs: the step record is appended HERE (not in _step) so the
+        # debug-mode sync tally covers the whole step body it reports on
+        if self._timeline is not None and self._step_stats is not None:
+            st, self._step_stats = self._step_stats, None
+            self._timeline.append(StepRecord(host_syncs=syncs, **st))
+            self.metrics.observe_step(st["t_end"] - st["t_start"],
+                                      st["batch"])
         return finished
 
     def _step(self) -> list[int]:
@@ -446,6 +508,9 @@ class ServingEngine:
                 self._skew += slow.delay_s
         self._sweep_deadlines()
 
+        t_start = self.now() if self._timeline is not None else 0.0
+        preempt0 = self.scheduler.preemption_count
+        n_prefills = n_active = 0
         finished_now = []
         # a paused engine (run(budget_s=) drain) admits no NEWCOMERS, but
         # still resumes preemption victims — they are in-flight work
@@ -460,6 +525,10 @@ class ServingEngine:
                 self._gen[slot] = len(req.generated)
                 req.fresh = True
                 self.metrics.on_swap_in()
+                tr = self._tracer
+                if tr is not None:
+                    tr.event(req.rid, "swap_in", tokens=len(req.generated))
+                    tr.event(req.rid, "resumed", tokens=len(req.generated))
                 continue
             if inj is not None and \
                     inj.hit("prefill_fail", step=step_idx, rid=req.rid):
@@ -479,13 +548,19 @@ class ServingEngine:
                               if b >= len(tail))
                 padded = np.full(bucket, self.config.pad_token_id, np.int32)
                 padded[:len(tail)] = tail
-                try:
-                    pools, tok = self._prefill_jit(
-                        self._p, self.cache.pools, jnp.asarray(padded),
+                tr = self._tracer
+                if tr is not None:
+                    tr.event(req.rid, "prefill_start", tokens=len(tail),
+                             cached=cached, bucket=bucket)
+                args = (self._p, self.cache.pools, jnp.asarray(padded),
                         jnp.asarray(len(tail), jnp.int32),
                         jnp.asarray(cached, jnp.int32),
                         jnp.asarray(self.cache.page_table[req.slot]),
                         jnp.asarray(req.rid, jnp.int32))
+                if self.config.debug_checks and not self._prefill_jit.traces:
+                    self._audit_donation(self._prefill_jit, args)
+                try:
+                    pools, tok = self._prefill_jit(*args)
                 except Exception as e:  # noqa: BLE001 — isolate the request
                     if isinstance(e, (RetraceError, DonationViolation)):
                         # a strict-guard refusal is an AUDIT failure — the
@@ -514,6 +589,12 @@ class ServingEngine:
             self._rids[req.slot] = req.rid
             self._gen[req.slot] = 1
             req.fresh = True
+            n_prefills += 1
+            if tr is not None:
+                # prefill_end IS first-token time: the prefill pass samples
+                # the request's first output token from its last logit
+                tr.event(req.rid, "prefill_end", tokens=len(tail))
+                tr.event(req.rid, "first_token")
             # every full prompt page is now resident: index it for reuse
             self.cache.register_prefix(req.slot, req.prompt)
             self.metrics.on_prefill(len(tail))
@@ -546,17 +627,20 @@ class ServingEngine:
 
         if self._active.any():
             with profiler.RecordEvent("serving::decode"):
-                pools, toks = self._decode_jit(
-                    self._p, self.cache.pools,
-                    jnp.asarray(self.cache.page_table),
-                    jnp.asarray(self._ctx), jnp.asarray(self._last_tok),
-                    jnp.asarray(self._active), jnp.asarray(self._rids),
-                    jnp.asarray(self._gen))
+                args = (self._p, self.cache.pools,
+                        jnp.asarray(self.cache.page_table),
+                        jnp.asarray(self._ctx), jnp.asarray(self._last_tok),
+                        jnp.asarray(self._active), jnp.asarray(self._rids),
+                        jnp.asarray(self._gen))
+                if self.config.debug_checks and not self._decode_jit.traces:
+                    self._audit_donation(self._decode_jit, args)
+                pools, toks = self._decode_jit(*args)
             self.cache.pools = pools
             # the step's ONE sanctioned device->host sync: the token fetch
             toks = np.asarray(toks)  # lint: disable=PT005
             self.metrics.on_decode_step()
             n_new = 0
+            tr = self._tracer
             for slot in np.nonzero(self._active)[0]:
                 req = self.scheduler.running[int(slot)]
                 tok = int(toks[slot])
@@ -566,19 +650,33 @@ class ServingEngine:
                 self._last_tok[slot] = tok
                 self._gen[slot] += 1
                 n_new += 1
+                if tr is not None and \
+                        len(req.generated) % tr.mark_every == 0:
+                    tr.event(req.rid, "decode_mark",
+                             tokens=len(req.generated))
                 if self._maybe_finish(req, tok):
                     finished_now.append(req.rid)
             self.metrics.on_tokens(n_new)
+            n_active = n_new
 
+        cs = self.cache.stats()
         self.metrics.on_state(
             queue_depth=self.scheduler.queue_depth,
             active=len(self.scheduler.running),
-            pages_used=self.cache.allocator.pages_in_use,
-            usable_pages=self.cache.cfg.usable_pages,
-            shared_pages=self.cache.shared_page_count(),
-            cached_pages=self.cache.allocator.num_reclaimable,
-            cow_copies=self.cache.cow_copies,
-            evictions=self.cache.evictions)
+            pages_used=cs["pages_in_use"],
+            usable_pages=cs["usable_pages"],
+            shared_pages=cs["shared_pages"],
+            cached_pages=cs["reclaimable_pages"],
+            cow_copies=cs["cow_copies"],
+            evictions=cs["evictions"])
+        if self._timeline is not None:
+            self._step_stats = {
+                "step": step_idx, "t_start": t_start, "t_end": self.now(),
+                "admitted": len(admitted), "prefills": n_prefills,
+                "batch": n_active, "finished": len(finished_now),
+                "preemptions": self.scheduler.preemption_count - preempt0,
+                "queue_depth": self.scheduler.queue_depth,
+                "pages_in_use": cs["pages_in_use"]}
         return finished_now
 
     def run(self, max_steps: int = 100000,
@@ -615,6 +713,59 @@ class ServingEngine:
         finally:
             self.admit_paused = paused_before
         return done
+
+    # -------------------------------------------------------- observability
+    def _audit_donation(self, guard: CompileGuard, args) -> None:
+        """debug_checks satellite: before a guarded step's FIRST trace,
+        audit it at jaxpr level (analysis.donation_audit) with the real
+        call's arguments — the wrapped impl and its ``donate_argnums``
+        are read off the guard itself, so the audit can never
+        desynchronize from what the jit actually donates. A donated leaf
+        the computation never consumes can alias nothing into any output
+        — a wrong ``donate_argnums`` that silently forfeits the in-place
+        pool update — and raises DonationViolation naming the leaf.
+        Identity pass-through reports are recorded
+        (``engine._donation_audits``) but not fatal."""
+        reports = donation_audit(guard.fn, guard.donate_argnums, *args)
+        dead = [r for r in reports if "never consumed" in r]
+        if dead:
+            raise DonationViolation(
+                f"donation audit of {guard.name!r} jitted step: "
+                + "; ".join(dead))
+        self._donation_audits[guard.name] = reports
+
+    @property
+    def timeline(self) -> StepTimeline | None:
+        """The bounded per-step ring (obs.StepTimeline); None when
+        ``enable_tracing=False``."""
+        return self._timeline
+
+    def trace(self, rid: int):
+        """The request's lifecycle trace (obs.RequestTrace) — live or
+        retained-terminal — or None when tracing is off or the trace was
+        evicted under the retention bound."""
+        return self._tracer.get(rid) if self._tracer is not None else None
+
+    def traces(self) -> list:
+        """Every retained RequestTrace, oldest first (empty with tracing
+        off)."""
+        return self._tracer.traces() if self._tracer is not None else []
+
+    def latency_summaries(self) -> list[dict]:
+        """Per-request latency decompositions (queue_wait / prefill_time /
+        ttft / tpot / e2e + state/tokens/preemptions) for every retained
+        trace."""
+        return self._tracer.summaries() if self._tracer is not None else []
+
+    def export_chrome_trace(self, path=None) -> dict:
+        """Chrome ``trace_event`` JSON of every retained request trace
+        plus the engine step timeline — loadable in chrome://tracing and
+        ui.perfetto.dev. Writes to ``path`` when given; returns the
+        document either way (empty-track document with tracing off)."""
+        traces = self.traces()
+        if path is not None:
+            return write_chrome_trace(path, traces, self._timeline)
+        return chrome_trace(traces, self._timeline)
 
     def result(self, rid: int) -> np.ndarray:
         return self._finished[rid]

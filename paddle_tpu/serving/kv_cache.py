@@ -566,6 +566,20 @@ class PagedKVCache:
     def utilization(self) -> float:
         return self.allocator.pages_in_use / max(1, self.cfg.usable_pages)
 
+    def stats(self) -> dict:
+        """One consistent host-side reading of the pool's observable state
+        — the shared source for the serving gauges (metrics.on_state) and
+        the obs step-timeline records, so the two surfaces can never
+        disagree about page pressure within a step."""
+        a = self.allocator
+        return {"pages_in_use": a.pages_in_use,
+                "free_pages": a.num_free,
+                "reclaimable_pages": a.num_reclaimable,
+                "usable_pages": self.cfg.usable_pages,
+                "shared_pages": self.shared_page_count(),
+                "cow_copies": self.cow_copies,
+                "evictions": self.evictions}
+
     # --------------------------------------------------------- invariants
     def check_invariants(self) -> None:
         """Structural invariants the test suite sweeps after every

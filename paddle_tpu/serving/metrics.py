@@ -47,21 +47,44 @@ Analysis counters (paddle_tpu.analysis integration, pre-seeded):
                                      step boundary — the token fetch — is
                                      the sanctioned floor)
 
+Latency histograms (paddle_tpu.obs integration): fixed-bucket streaming
+histograms — bounded memory, O(log buckets) per observation — feed the
+percentile gauges ``serving_<hist>_p50/p90/p99`` (+ ``_count``) for:
+
+- serving_ttft_s           enqueue -> first token (time to first token)
+- serving_tpot_s           mean seconds per output token over decode
+- serving_queue_wait_s     enqueue -> admission
+- serving_e2e_s            enqueue -> retirement
+- serving_step_duration_s  one engine step, engine-clock seconds
+- serving_batch_occupancy  active decode slots per step
+
+The request-latency histograms are fed from request traces at retirement
+(``observe_request``), the step histograms at every step boundary
+(``observe_step``); all percentile gauges are pre-seeded to 0 at reset
+and recomputed lazily at ``snapshot()`` — the hot path only pays the
+bisect+add of the observation itself. High-watermark gauges
+``serving_queue_depth_peak`` / ``serving_page_pool_peak`` keep the spikes
+a sampled gauge misses.
+
 Every counter incremented here is pre-seeded in ``_SEEDED`` — lint rule
-PT003 (this module shipped unseeded counters once) enforces it.
+PT003 (this module shipped unseeded counters once) enforces it; every
+``stat_set``/``stat_max`` gauge likewise, per the mirror rule PT008.
 """
 from __future__ import annotations
 
 import time
 from collections import deque
 
+from ..obs.histogram import (LATENCY_EDGES_S, OCCUPANCY_EDGES, QUANTILES,
+                             Histogram)
 from ..utils import monitor
 
 PREFIX = "serving_"
 
-# always-visible counters (a snapshot taken before the first event must
-# still show the zeros — dashboards key on presence; lint rule PT003 flags
-# any stat_add of a name missing here)
+# always-visible counters and gauges (a snapshot taken before the first
+# event must still show the zeros — dashboards key on presence; lint rule
+# PT003 flags any stat_add of a name missing here, PT008 any
+# stat_set/stat_max)
 _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "decode_steps", "preemptions_total",
            "rejected", "shed", "expired", "cancelled", "failed",
@@ -69,7 +92,33 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "prefix_hits", "prefix_misses", "prefix_tokens_saved",
            "prefix_shared_pages", "prefix_cached_pages",
            "prefix_cow_copies", "prefix_evictions",
-           "analysis_retraces_total", "analysis_host_syncs_total")
+           "analysis_retraces_total", "analysis_host_syncs_total",
+           "tokens_per_sec", "queue_depth", "active_requests",
+           "page_pool_used", "page_utilization",
+           "queue_depth_peak", "page_pool_peak")
+
+# histogram name -> bucket edges; percentile gauges <name>_{p50,p90,p99}
+# and <name>_count are seeded for each (dynamically — same presence
+# contract as _SEEDED)
+_HISTOGRAMS = (("ttft_s", LATENCY_EDGES_S),
+               ("tpot_s", LATENCY_EDGES_S),
+               ("queue_wait_s", LATENCY_EDGES_S),
+               ("e2e_s", LATENCY_EDGES_S),
+               ("step_duration_s", LATENCY_EDGES_S),
+               ("batch_occupancy", OCCUPANCY_EDGES))
+
+# trace-summary key -> histogram it feeds
+_SUMMARY_HISTS = (("ttft", "ttft_s"), ("tpot", "tpot_s"),
+                  ("queue_wait", "queue_wait_s"), ("e2e", "e2e_s"))
+
+# Prometheus exposition types for the monotonic stats; unlisted serving_*
+# scalars export as gauges, the histograms as real bucket series
+COUNTER_STATS = frozenset(
+    PREFIX + k for k in _SEEDED
+    if k.endswith("_total") or k in (
+        "decode_steps", "rejected", "shed", "expired", "cancelled",
+        "failed", "swap_outs", "swap_ins", "prefix_hits", "prefix_misses",
+        "prefix_tokens_saved", "prefix_cow_copies", "prefix_evictions"))
 
 
 class ServingMetrics:
@@ -79,6 +128,8 @@ class ServingMetrics:
     def __init__(self, window_s: float = 10.0):
         self.window_s = window_s
         self._samples: deque[tuple[float, float]] = deque()
+        self.hists = {name: Histogram(PREFIX + name, edges)
+                      for name, edges in _HISTOGRAMS}
         self.reset()
 
     def reset(self) -> None:
@@ -86,6 +137,9 @@ class ServingMetrics:
             monitor.stat_reset(k)
         for k in _SEEDED:
             monitor.stat_set(PREFIX + k, 0)
+        for h in self.hists.values():
+            h.reset()
+        self._publish_hists()  # seed the percentile gauges at 0
         self._samples.clear()
         self._samples.append((time.perf_counter(), 0.0))
 
@@ -148,6 +202,8 @@ class ServingMetrics:
         monitor.stat_set(PREFIX + "page_pool_used", pages_used)
         monitor.stat_set(PREFIX + "page_utilization",
                          pages_used / max(1, usable_pages))
+        monitor.stat_max(PREFIX + "queue_depth_peak", queue_depth)
+        monitor.stat_max(PREFIX + "page_pool_peak", pages_used)
         monitor.stat_set(PREFIX + "prefix_shared_pages", shared_pages)
         monitor.stat_set(PREFIX + "prefix_cached_pages", cached_pages)
         # cache-owned monotonic counters, mirrored as absolute values
@@ -160,6 +216,43 @@ class ServingMetrics:
         monitor.stat_set(PREFIX + "analysis_retraces_total", retraces)
         monitor.stat_set(PREFIX + "analysis_host_syncs_total", host_syncs)
 
+    # ---------------------------------------------------------- histograms
+    def observe_request(self, summary: dict) -> None:
+        """Feed the request-latency histograms from one trace summary
+        (obs.trace.RequestTrace.summary). None fields — a milestone the
+        lifecycle never reached, e.g. TTFT of a request cancelled while
+        waiting — are skipped, not recorded as zeros."""
+        for key, hist in _SUMMARY_HISTS:
+            v = summary.get(key)
+            if v is not None:
+                self.hists[hist].observe(v)
+
+    def observe_step(self, duration_s: float, occupancy: int) -> None:
+        """One engine step: duration (engine-clock seconds) and the number
+        of active decode slots it served."""
+        self.hists["step_duration_s"].observe(duration_s)
+        self.hists["batch_occupancy"].observe(occupancy)
+
+    def _publish_hists(self) -> None:
+        """Mirror percentiles + counts into the monitor registry. Called
+        lazily from snapshot()/reset(), never on the serving hot path —
+        observation stays O(log buckets)."""
+        for name, h in self.hists.items():
+            for suffix, q in QUANTILES:
+                monitor.stat_set(f"{PREFIX}{name}_{suffix}",
+                                 h.percentile(q))
+            monitor.stat_set(f"{PREFIX}{name}_count", h.count)
+
     # ------------------------------------------------------------ querying
     def snapshot(self) -> dict:
+        self._publish_hists()
         return monitor.stats_with_prefix(PREFIX)
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition of every serving stat: scalars typed
+        counter/gauge, the obs histograms as cumulative bucket series."""
+        from ..obs.export import prometheus_text
+
+        types = {k: "counter" for k in COUNTER_STATS}
+        return prometheus_text(self.snapshot(), list(self.hists.values()),
+                               types)

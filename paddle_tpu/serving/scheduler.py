@@ -101,7 +101,7 @@ class Request:        # generated dataclass __eq__ chokes on ndarray fields
 class Scheduler:
     def __init__(self, cache: PagedKVCache, max_batch: int,
                  max_waiting: int = 0, shed_policy: str = "reject",
-                 preemption_mode: str = "recompute"):
+                 preemption_mode: str = "recompute", tracer=None):
         if shed_policy not in ("reject", "shed-oldest"):
             raise ValueError(f"shed_policy {shed_policy!r} not in "
                              f"('reject', 'shed-oldest')")
@@ -113,6 +113,10 @@ class Scheduler:
         self.max_waiting = max_waiting
         self.shed_policy = shed_policy
         self.preemption_mode = preemption_mode
+        # the engine's obs.trace.Tracer (or None, costing one attribute
+        # check per event site): the scheduler stamps the lifecycle
+        # transitions it owns — admitted, preempted, swap_out
+        self._tracer = tracer
         self.waiting: deque[Request] = deque()
         self.running: dict[int, Request] = {}  # slot -> Request
         self._free_slots = list(range(max_batch - 1, -1, -1))  # pop() -> 0,1,..
@@ -179,6 +183,7 @@ class Scheduler:
         only preemption victims (always queued at the front): the paused-
         drain mode, where in-flight work resumes but newcomers wait."""
         admitted = []
+        tr = self._tracer
         while self.waiting and self._free_slots:
             req = self.waiting[0]
             if resume_only and req.preemptions == 0:
@@ -202,6 +207,9 @@ class Scheduler:
             req.admit_seq = next(self._admit_seq)
             self.running[slot] = req
             admitted.append(req)
+            if tr is not None:
+                tr.event(req.rid, "admitted", slot=slot,
+                         cached_tokens=req.cached_tokens)
         return admitted
 
     # ------------------------------------------------------------- decoding
@@ -241,8 +249,14 @@ class Scheduler:
         at the front of the waiting queue. Returns the vacated slot."""
         slot = req.slot
         self.running.pop(slot)
+        tr = self._tracer
+        if tr is not None:
+            tr.event(req.rid, "preempted", mode=self.preemption_mode,
+                     tokens=len(req.generated))
         if self.preemption_mode == "swap":
             req.swap = self.cache.swap_out(slot)
+            if tr is not None:
+                tr.event(req.rid, "swap_out", pages=req.swap.n_pages)
         else:
             self.cache.release(slot)
             req.generated.clear()

@@ -10,8 +10,8 @@ from __future__ import annotations
 import threading
 import time
 
-__all__ = ["stat_add", "stat_set", "stat_get", "stat_reset", "all_stats",
-           "stats_with_prefix", "StatTimer"]
+__all__ = ["stat_add", "stat_set", "stat_max", "stat_get", "stat_reset",
+           "all_stats", "stats_with_prefix", "StatTimer"]
 
 _lock = threading.Lock()
 _stats: dict[str, float] = {}
@@ -26,6 +26,16 @@ def stat_add(name: str, value=1):
 def stat_set(name: str, value):
     with _lock:
         _stats[name] = value
+
+
+def stat_max(name: str, value):
+    """High-watermark gauge: keeps the largest value ever set (e.g. peak
+    queue depth / page pressure — the spike a sampled gauge misses)."""
+    with _lock:
+        cur = _stats.get(name)
+        if cur is None or value > cur:
+            _stats[name] = value
+        return _stats[name]
 
 
 def stat_get(name: str, default=0):

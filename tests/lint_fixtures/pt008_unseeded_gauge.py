@@ -1,0 +1,24 @@
+"""PT008 fixture: gauge written (stat_set/stat_max) without pre-seeding."""
+from paddle_tpu.utils import monitor
+
+PREFIX = "serving_"
+
+_SEEDED = ("queue_depth", "page_pool_peak")
+
+
+class Metrics:
+    def reset(self):
+        for k in _SEEDED:
+            monitor.stat_set(PREFIX + k, 0)  # Name, not Constant: exempt
+
+    def on_state(self, depth, active):
+        monitor.stat_set(PREFIX + "queue_depth", depth)  # seeded: clean
+        monitor.stat_set(PREFIX + "active_requests", active)  # finding
+        monitor.stat_set("serving_utilization", 0.5)  # finding: literal
+        monitor.stat_max(PREFIX + "depth_peak", depth)  # finding: stat_max
+
+    def on_peak(self, pages):
+        monitor.stat_max(PREFIX + "page_pool_peak", pages)  # seeded: clean
+
+    def on_legacy(self, v):
+        monitor.stat_set(PREFIX + "legacy", v)  # lint: disable=PT008

@@ -264,6 +264,43 @@ def test_engine_debug_checks_serves_correctly_and_counts_syncs():
     assert snap["serving_analysis_host_syncs_total"] == expected
 
 
+def test_debug_checks_runs_donation_audit_at_first_trace():
+    """PR 5 satellite: debug_checks audits each jitted step at jaxpr
+    level before its FIRST trace — the engine's donated pools must all be
+    consumed by the computation (a donated-but-unused buffer is a wrong
+    donate_argnums). Clean audits are recorded per step name."""
+    engine = _toy_engine()
+    assert engine._donation_audits == {}  # nothing traced yet
+    rng = np.random.RandomState(3)
+    engine.add_request(rng.randint(0, 97, (4,)).astype(np.int32), 3)
+    engine.run()
+    assert set(engine._donation_audits) == {"prefill", "decode"}
+    # the engine's donation is clean: no dead donated leaves survived to
+    # raise, and no identity pass-through reports were recorded either
+    assert engine._donation_audits == {"prefill": [], "decode": []}
+
+
+def test_donation_audit_helper_raises_on_dead_donated_leaf():
+    # the audit reads the impl and donate_argnums OFF THE GUARD, so it
+    # can never desynchronize from what the jit actually donates
+    engine = _toy_engine()
+    bad = CompileGuard(lambda pool, dead: pool * 2, "bad_step",
+                       donate_argnums=(0, 1))
+    with pytest.raises(DonationViolation) as ei:
+        engine._audit_donation(bad, (jnp.ones(3), jnp.ones(4)))
+    msg = str(ei.value)
+    assert "bad_step" in msg and "dead" in msg and "never consumed" in msg
+    assert "bad_step" not in engine._donation_audits  # fatal, not recorded
+
+
+def test_debug_checks_off_skips_donation_audit():
+    engine = _toy_engine(debug_checks=False)
+    rng = np.random.RandomState(4)
+    engine.add_request(rng.randint(0, 97, (4,)).astype(np.int32), 3)
+    engine.run()
+    assert engine._donation_audits == {}
+
+
 def test_analysis_counters_pre_seeded():
     engine = _toy_engine(debug_checks=False)
     snap = engine.metrics.snapshot()
@@ -286,6 +323,8 @@ _FIXTURE_CASES = {
                           {8: "PT005", 9: "PT005", 10: "PT005"}),
     "pt006_jit_no_donate.py": ("serving/pt006.py", {17: "PT006"}),
     "pt007_mutable_default.py": ("pt007.py", {4: "PT007", 14: "PT007"}),
+    "pt008_unseeded_gauge.py": ("pt008.py",
+                                {16: "PT008", 17: "PT008", 18: "PT008"}),
 }
 
 
@@ -304,7 +343,7 @@ def test_lint_rule_fixture(fixture):
 
 
 def test_lint_rule_table_is_complete():
-    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 8)]
+    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 9)]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -329,6 +368,34 @@ def test_repo_self_lint_zero_findings():
     here, forever."""
     findings = lint_paths([REPO / "paddle_tpu"])
     assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_tests_and_examples_lint_zero_nonfixture_findings():
+    """The PR 5 widening: the default sweep also covers tests/ and
+    examples/ — a serving contract regression (mutable default, unseeded
+    stat, array-field dataclass) hides in a test helper as easily as in
+    the package. The lint fixtures' INTENTIONAL positives are exempted
+    via the ALLOWLIST (a pragma inside a fixture would defeat the
+    fixture), so the pin is zero NON-fixture findings."""
+    findings = lint_paths([REPO / "tests", REPO / "examples"])
+    assert findings == [], "\n".join(str(f) for f in findings)
+    # the allowlist is doing real work: without it the fixtures DO fire
+    fixture_findings = lint_paths([REPO / "tests" / "lint_fixtures"],
+                                  allowlist={})
+    assert fixture_findings, "fixture positives vanished — dead fixtures"
+
+
+def test_self_lint_catches_reintroduced_unseeded_gauge():
+    """Deliberately strip a gauge from metrics._SEEDED: PT008 must fail
+    the way PT003 would for a counter."""
+    path = REPO / "paddle_tpu" / "serving" / "metrics.py"
+    src = path.read_text()
+    bad = src.replace('"queue_depth_peak", "page_pool_peak")',
+                      '"queue_depth_peak",)')
+    assert bad != src, "metrics.py no longer seeds the peak gauges"
+    findings = lint_source(bad, "paddle_tpu/serving/metrics.py")
+    assert any(f.rule == "PT008" and "page_pool_peak" in f.message
+               for f in findings)
 
 
 def test_self_lint_catches_reintroduced_pr2_eq_bug():
@@ -388,6 +455,35 @@ def test_lint_cli_exit_codes_and_filters(tmp_path):
         [sys.executable, "-m", "paddle_tpu.analysis", "--rule", "PT999"],
         cwd=REPO, capture_output=True, text=True)
     assert unknown.returncode == 2
+
+
+def test_lint_cli_default_sweep_covers_tests_and_examples():
+    """No-path invocation lints the package + tests/ + examples/ (clean
+    because fixtures are allowlisted); --include overrides the extra
+    trees."""
+    clean = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis"],
+        cwd=REPO, capture_output=True, text=True)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert "0 findings" in clean.stdout
+
+    # the default sweep actually REACHES tests/: a transient dirty helper
+    # dropped there is found by the no-path invocation...
+    probe = REPO / "tests" / "_lint_probe_tmp_do_not_commit.py"
+    probe.write_text("def helper(q=[]):\n    return q\n")
+    try:
+        dirty = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis"],
+            cwd=REPO, capture_output=True, text=True)
+        assert dirty.returncode == 1 and "PT007" in dirty.stdout
+        # ...and --include overrides the extra trees away again
+        narrowed = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis",
+             "--include", "examples"],
+            cwd=REPO, capture_output=True, text=True)
+        assert narrowed.returncode == 0, narrowed.stdout + narrowed.stderr
+    finally:
+        probe.unlink()
 
 
 def test_tools_lint_entry_point():

@@ -2,8 +2,9 @@
 """CI/dev lint entry point — exit-code-clean wrapper over the repo linter.
 
 Usage:
-    python tools/lint.py                       # lint paddle_tpu/ (default)
+    python tools/lint.py               # paddle_tpu/ + tests/ + examples/
     python tools/lint.py tests/ examples/      # explicit paths
+    python tools/lint.py --include tests       # narrow the default sweep
     python tools/lint.py --rule PT004 --path serving
     python tools/lint.py --list-rules
 
