@@ -181,6 +181,25 @@ def main():
     print(f"analysis: decode loop certified sync-free ({tally.count} token "
           f"fetches across {fetches} step boundaries, 0 retraces, compile "
           f"budgets held under debug_checks)")
+
+    # ---- hlocheck: the same audited engine certified at the COMPILED
+    # level — every program (each prefill bucket + decode) was AOT-lowered
+    # at its first trace and its optimized HLO held to the single-chip
+    # budget: zero collective ops, zero host-transfer/callback ops, and
+    # XLA aliasing every donated KV pool (a copied donation would be a
+    # silent 2x HBM cost)
+    audits = eng3.hlo_audits
+    assert set(audits) == {"prefill[16]", "prefill[8]", "decode"}, audits
+    assert all(not r.collectives and not r.host_transfers
+               for r in audits.values())
+    assert all(r.aliased_leaves == r.donated_leaves and not r.unaliased
+               for r in audits.values())
+    assert snap4["serving_hlo_collective_ops"] == 0
+    peak = max(r.peak_bytes for r in audits.values())
+    print(f"hlocheck: {len(audits)} compiled programs audited — 0 "
+          f"collectives, 0 host transfers, "
+          f"{sum(r.donated_leaves for r in audits.values())} donated pool "
+          f"buffers all aliased; peak step HBM {peak / 1024:.1f} KiB")
     print("serving_demo OK")
 
 

@@ -1,4 +1,5 @@
-"""paddle_tpu.analysis — trace-time jit auditor + AST repo linter.
+"""paddle_tpu.analysis — trace-time jit auditor, compiled-artifact
+auditor, and AST repo linter.
 
 Turns the serving stack's hand-pinned invariants into enforced checks:
 
@@ -9,19 +10,33 @@ Turns the serving stack's hand-pinned invariants into enforced checks:
   sync-free). The serving engine's ``compile_counts`` surface is built on
   CompileGuard; ``ServingConfig(debug_checks=True)`` turns the audits on
   at every step boundary.
-- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT007 distilled from bugs
+- :mod:`~paddle_tpu.analysis.hlocheck` — the compiled-artifact twin: AOT-
+  lower any step and audit the optimized HLO — collective census against
+  a declared :class:`~paddle_tpu.analysis.hlocheck.CollectiveBudget`,
+  host-transfer ops, XLA input-output aliasing honoring every donation,
+  and flops/peak-HBM roll-up. ``python -m paddle_tpu.analysis --hlo``
+  sweeps the registered steps (including the 8-device ``shard_map``
+  tensor-parallel certification the sharded-serving arc gates on).
+- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT009 distilled from bugs
   this repo shipped, with ``# lint: disable=PTxxx`` pragmas and allowlists.
   ``python -m paddle_tpu.analysis paddle_tpu/`` must stay clean (a tier-1
   test enforces zero findings).
 """
+from .hlocheck import (SINGLE_CHIP, AliasingViolation,  # noqa: F401
+                       CollectiveBudget, CollectiveBudgetError,
+                       HloAuditReport, HloCheckError, HostTransferError)
 from .lint import (ALLOWLIST, RULES, Finding, lint_paths,  # noqa: F401
                    lint_source)
 from .tracecheck import (CompileGuard, DonationViolation,  # noqa: F401
                          RetraceError, SyncTally, SyncViolation,
                          abstract_signature, donation_audit,
-                         explain_signature_diff)
+                         explain_signature_diff, sync_tally_paused)
 
 __all__ = ["CompileGuard", "RetraceError", "DonationViolation",
            "SyncViolation", "SyncTally", "donation_audit",
            "abstract_signature", "explain_signature_diff",
+           "sync_tally_paused",
+           "CollectiveBudget", "HloAuditReport", "HloCheckError",
+           "CollectiveBudgetError", "HostTransferError",
+           "AliasingViolation", "SINGLE_CHIP",
            "Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths"]

@@ -1,6 +1,15 @@
-"""``python -m paddle_tpu.analysis [paths] [--rule PTxxx] [--path SUB]``."""
+"""``python -m paddle_tpu.analysis [paths] [--rule PTxxx] [--path SUB]``
+runs the repo linter; ``python -m paddle_tpu.analysis --hlo [--step NAME]``
+runs the compiled-artifact auditor over the registered step registry
+instead. One entry point, two engines, shared exit-code contract
+(0 clean, 1 findings/violations, 2 bad usage)."""
 import sys
 
-from .lint import main
+argv = list(sys.argv[1:])
+if "--hlo" in argv:
+    argv.remove("--hlo")
+    from .hlocheck import main
+else:
+    from .lint import main
 
-sys.exit(main())
+sys.exit(main(argv))

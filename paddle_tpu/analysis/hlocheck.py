@@ -1,0 +1,669 @@
+"""Compiled-artifact auditor: what did XLA *actually* build for a step?
+
+PR 4's CompileGuard/SyncTally certify the serving invariants at the Python
+trace level — but the ROADMAP's tensor-parallel arc needs those contracts
+to survive sharding, and a sharded step can silently acquire implicit
+all-gathers, resharding copies, or un-honored donation that no trace-level
+check can see. This module reads the truth straight off the compiled
+artifact, the way ``tools/aot_shard_proof.py`` already reads
+``memory_analysis`` for training:
+
+- **Collective census** — AOT-lower a step and walk the optimized HLO for
+  ``all-reduce`` / ``all-gather`` / ``reduce-scatter`` /
+  ``collective-permute`` / ``all-to-all`` instructions (sync and
+  ``-start`` async forms; ``-done`` halves are not double-counted), each
+  with its payload byte volume parsed from the result shape. The census is
+  enforced against a declared :class:`CollectiveBudget` — a decode step on
+  a single chip budgets ZERO, a tensor-parallel step budgets exactly the
+  collectives its sharding implies.
+- **Host-transfer check** — the compiled-level twin of SyncTally: flag
+  ``infeed``/``outfeed``, host ``send``/``recv``, and host-callback
+  ``custom-call``s (``xla_python_cpu_callback`` & friends) baked into a
+  hot step. A trace-level tally can only see syncs the *host* initiates;
+  this sees the ones the *program* performs.
+- **Aliasing verification** — the compiled proof behind lint rule PT006:
+  confirm XLA's ``input_output_alias`` table actually honors every
+  ``donate_argnums`` leaf. A donated-but-copied KV pool silently holds two
+  pools live (a 2x HBM cost no Python-level check can observe — jax still
+  marks the donated buffer deleted either way).
+- **Resource roll-up** — ``cost_analysis()`` flops and
+  ``memory_analysis()`` peak bytes per step (arguments + temp arena +
+  outputs − aliased), reported through ``serving_hlo_*`` metrics and the
+  bench JSON.
+
+:data:`REGISTRY` names the repo's auditable steps (the serving engine's
+prefill/decode, the paged cache's swap/COW jits, and the toy 8-device
+``shard_map`` tensor-parallel step that gates the sharded-serving arc);
+``python -m paddle_tpu.analysis --hlo [--step NAME]`` sweeps them with
+clean exit codes. ``ServingConfig(debug_checks=True)`` audits every engine
+step once per compiled program (per prefill bucket + decode) at its first
+trace — one extra AOT lower+compile per program, a debugging cost, never a
+serving-path cost.
+
+Like tracecheck, this module never imports the serving stack at module
+level — serving imports us; the registry builders import serving lazily.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import re
+import warnings
+from dataclasses import dataclass, field
+
+__all__ = ["CollectiveBudget", "CollectiveOp", "HostTransfer",
+           "HloAuditReport", "HloCheckError", "CollectiveBudgetError",
+           "HostTransferError", "AliasingViolation", "SINGLE_CHIP",
+           "census", "audit", "audit_guard", "StepSpec", "REGISTRY",
+           "run_step", "main"]
+
+
+class HloCheckError(RuntimeError):
+    """A compiled-artifact audit failed."""
+
+
+class CollectiveBudgetError(HloCheckError):
+    """The compiled step issues more collective traffic than its declared
+    CollectiveBudget. The message names the op kind, count, and bytes."""
+
+
+class HostTransferError(HloCheckError):
+    """The compiled step contains host-transfer ops (infeed/outfeed/host
+    callback) beyond its budget — a hidden device<->host stall per step."""
+
+
+class AliasingViolation(HloCheckError):
+    """XLA did not honor a donated buffer with input-output aliasing: the
+    donated-and-deleted input is COPIED into its output, so two copies are
+    live — for a pool-sized buffer, a silent 2x HBM cost."""
+
+
+# --------------------------------------------------------------- HLO parsing
+# element widths in BITS — sub-byte dtypes (s2/s4, the EQuARX-style
+# quantized-collective payloads these byte volumes are the baseline for)
+# must not round up per element, only per buffer
+_DTYPE_BITS = {
+    "pred": 8, "s2": 2, "u2": 2, "s4": 4, "u4": 4, "s8": 8, "u8": 8,
+    "f8e5m2": 8, "f8e4m3": 8, "f8e4m3fn": 8, "f8e4m3b11fnuz": 8,
+    "f8e5m2fnuz": 8, "f8e4m3fnuz": 8,
+    "s16": 16, "u16": 16, "f16": 16, "bf16": 16,
+    "s32": 32, "u32": 32, "f32": 32,
+    "s64": 64, "u64": 64, "f64": 64, "c64": 64, "c128": 128,
+}
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([\d,]*)\]")
+
+# one HLO instruction: `%name = TYPE opcode(...)` where TYPE is a scalar/
+# array type or a tuple `(t1, t2)` (tuple element types never nest parens)
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<iname>[\w.\-]+)\s*=\s*"
+    r"(?P<type>\([^=]*?\)|\S+)\s+(?P<op>[\w\-]+)\(")
+
+_ALIAS_RE = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(?:may|must)-alias\)")
+
+_TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
+
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all",
+                    "collective-broadcast")
+
+# host-callback custom-call targets (CPU + TPU spellings)
+_HOST_TARGET_RE = re.compile(r"callback|host|infeed|outfeed", re.IGNORECASE)
+
+
+def _shape_elem_bytes(type_str: str) -> list[int]:
+    """Per-array-element byte volumes of an HLO type string. Layouts
+    (``{1,0}``) and token/opaque elements contribute nothing."""
+    out = []
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        bits = _DTYPE_BITS.get(dtype)
+        if bits is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append((n * bits + 7) // 8)
+    return out
+
+
+def _type_bytes(type_str: str) -> int:
+    """Total byte volume of an HLO result type — ``f32[4,8]{1,0}`` or a
+    tuple ``(f32[4]{0}, bf16[2,2]{1,0})``."""
+    return sum(_shape_elem_bytes(type_str))
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    kind: str     # base opcode: all-reduce, all-gather, ...
+    nbytes: int   # payload bytes parsed from the result type
+    instr: str    # HLO instruction name (%...)
+    line: str     # the instruction line, trimmed
+
+
+@dataclass(frozen=True)
+class HostTransfer:
+    kind: str    # infeed | outfeed | send | recv | custom-call
+    detail: str  # custom_call_target for callbacks, else the opcode
+    line: str
+
+
+def census(hlo_text: str) -> tuple[tuple[CollectiveOp, ...],
+                                   tuple[HostTransfer, ...]]:
+    """Walk optimized HLO text and collect (collectives, host transfers).
+    Async ``-start``/``-done`` pairs count once (at the start)."""
+    colls: list[CollectiveOp] = []
+    hosts: list[HostTransfer] = []
+    for raw in hlo_text.splitlines():
+        m = _INSTR_RE.match(raw)
+        if m is None:
+            continue
+        op = m.group("op")
+        line = raw.strip()[:200]
+        base = op[:-6] if op.endswith("-start") else op
+        if base in COLLECTIVE_KINDS and not op.endswith("-done"):
+            # an async `-start` result is a tuple carrying operand AND
+            # result buffers — ((op, res)) scalar form, ((op0..opN-1,
+            # res0..resN-1)) when XLA's combiner merged N collectives.
+            # Charge the result half only: the payload the sync form(s)
+            # would report, so byte caps hold across sync/async/combined
+            # compilation of the same traffic
+            elems = _shape_elem_bytes(m.group("type"))
+            nbytes = (sum(elems[len(elems) // 2:])
+                      if op.endswith("-start") and len(elems) > 1
+                      else sum(elems))
+            colls.append(CollectiveOp(base, nbytes, m.group("iname"), line))
+        elif op in ("infeed", "outfeed"):
+            hosts.append(HostTransfer(op, op, line))
+        elif op in ("send", "recv") and "is_host_transfer=true" in raw:
+            hosts.append(HostTransfer(op, op, line))
+        elif op == "custom-call":
+            t = _TARGET_RE.search(raw)
+            if t is not None and _HOST_TARGET_RE.search(t.group(1)):
+                hosts.append(HostTransfer("custom-call", t.group(1), line))
+    return tuple(colls), tuple(hosts)
+
+
+# ------------------------------------------------------------------ budgets
+@dataclass(frozen=True)
+class CollectiveBudget:
+    """Per-step ceiling on compiled collective/host-transfer traffic. The
+    default is the single-chip serving contract: ZERO everything — a
+    sharded step declares exactly the collectives its partitioning implies
+    (and optionally caps their total payload bytes)."""
+    all_reduce: int = 0
+    all_gather: int = 0
+    reduce_scatter: int = 0
+    collective_permute: int = 0
+    all_to_all: int = 0
+    collective_broadcast: int = 0
+    host_transfers: int = 0
+    max_collective_bytes: int | None = None
+
+    def allowed(self, kind: str) -> int:
+        return getattr(self, kind.replace("-", "_"), 0)
+
+
+#: the single-chip serving contract: no collectives, no host transfers
+SINGLE_CHIP = CollectiveBudget()
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB"):
+        if abs(n) < 1024:
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024
+    return f"{n:.2f} GiB"
+
+
+# ------------------------------------------------------------------- report
+@dataclass(frozen=True)
+class HloAuditReport:
+    """Everything the compiled artifact admits about one jitted step."""
+    name: str
+    collectives: tuple[CollectiveOp, ...] = ()
+    host_transfers: tuple[HostTransfer, ...] = ()
+    donated_leaves: int = 0
+    aliased_leaves: int = 0
+    donated_bytes: int = 0
+    alias_bytes: int = 0
+    # donated leaf names with no alias entry; () when compiled-parameter
+    # pruning makes the name mapping ambiguous (counts still enforced)
+    unaliased: tuple[str, ...] = ()
+    flops: float = 0.0
+    argument_bytes: int = 0
+    temp_bytes: int = 0
+    output_bytes: int = 0
+    peak_bytes: int = 0
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for c in self.collectives:
+            out[c.kind] = out.get(c.kind, 0) + 1
+        return out
+
+    @property
+    def collective_bytes(self) -> int:
+        return sum(c.nbytes for c in self.collectives)
+
+    def enforce(self, budget: CollectiveBudget) -> "HloAuditReport":
+        """Raise naming the offending op when the artifact exceeds the
+        budget; aliasing of donated buffers is always enforced."""
+        for kind, n in sorted(self.counts().items()):
+            allowed = budget.allowed(kind)
+            if n > allowed:
+                first = next(c for c in self.collectives if c.kind == kind)
+                raise CollectiveBudgetError(
+                    f"hlocheck({self.name!r}): {kind} x{n} "
+                    f"({_fmt_bytes(self.collective_bytes)} total collective "
+                    f"payload) exceeds the declared budget of {allowed} — "
+                    f"first over-budget op: {first.line}")
+        if budget.max_collective_bytes is not None and \
+                self.collective_bytes > budget.max_collective_bytes:
+            raise CollectiveBudgetError(
+                f"hlocheck({self.name!r}): total collective payload "
+                f"{self.collective_bytes} bytes exceeds the declared cap of "
+                f"{budget.max_collective_bytes} bytes "
+                f"({', '.join(sorted(self.counts()))})")
+        if len(self.host_transfers) > budget.host_transfers:
+            first = self.host_transfers[0]
+            raise HostTransferError(
+                f"hlocheck({self.name!r}): {len(self.host_transfers)} "
+                f"host-transfer op(s) compiled into the step (budget "
+                f"{budget.host_transfers}) — every one stalls the dispatch "
+                f"pipeline mid-program. First: {first.kind} "
+                f"({first.detail})")
+        if self.donated_leaves and (
+                self.aliased_leaves < self.donated_leaves
+                or self.alias_bytes < self.donated_bytes):
+            who = (f" — unaliased leaf/leaves: "
+                   f"{', '.join(self.unaliased)}" if self.unaliased else "")
+            raise AliasingViolation(
+                f"hlocheck({self.name!r}): {self.donated_leaves} donated "
+                f"leaf/leaves ({_fmt_bytes(self.donated_bytes)}) but the "
+                f"compiled artifact aliases only {self.aliased_leaves} "
+                f"({_fmt_bytes(self.alias_bytes)}) — a donated-but-copied "
+                f"buffer holds TWO copies live (for a KV pool, a silent 2x "
+                f"HBM cost){who}")
+        return self
+
+    def summary(self) -> str:
+        c = self.counts()
+        coll = ", ".join(f"{k}x{v}" for k, v in sorted(c.items())) or "none"
+        alias = (f"{self.aliased_leaves}/{self.donated_leaves} donated "
+                 f"aliased" if self.donated_leaves else "no donation")
+        return (f"hlocheck {self.name}: collectives {coll} "
+                f"({_fmt_bytes(self.collective_bytes)}); host transfers "
+                f"{len(self.host_transfers)}; {alias}; "
+                f"flops/step {self.flops:.4g}; peak HBM "
+                f"{_fmt_bytes(self.peak_bytes)}")
+
+
+# -------------------------------------------------------------------- audit
+def _leaf_nbytes(leaf) -> int:
+    n = getattr(leaf, "nbytes", None)
+    if n is not None:
+        return int(n)
+    return 0  # python scalar: negligible and never donated in practice
+
+
+def audit(fn, args, *, name: str | None = None, static_argnums=(),
+          donate_argnums=(), budget: CollectiveBudget | None = None
+          ) -> HloAuditReport:
+    """AOT-lower ``jax.jit(fn, static_argnums, donate_argnums)`` on
+    ``args``, compile it, and audit the artifact. Lowering never executes
+    or donates anything — the caller's buffers stay live. With ``budget``
+    the report is enforced before being returned.
+
+    The lower+compile runs with SyncTally counting suspended: lowering
+    materializes traced constants host-side, which is compile-time work,
+    not a serving-path sync."""
+    import jax
+    from jax.tree_util import keystr, tree_flatten_with_path
+
+    from .tracecheck import sync_tally_paused
+
+    name = name or getattr(fn, "__name__", "jitted")
+    static_argnums = tuple(static_argnums)
+    donate_argnums = tuple(donate_argnums)
+    jit_kwargs = {}
+    if static_argnums:
+        jit_kwargs["static_argnums"] = static_argnums
+    if donate_argnums:
+        jit_kwargs["donate_argnums"] = donate_argnums
+    with sync_tally_paused(), warnings.catch_warnings():
+        # "Some donated buffers were not usable" becomes a structured
+        # AliasingViolation below — don't also leak the warning
+        warnings.simplefilter("ignore")
+        compiled = jax.jit(fn, **jit_kwargs).lower(*args).compile()
+        txt = compiled.as_text()
+        ma = compiled.memory_analysis()
+        ca = compiled.cost_analysis()
+    colls, hosts = census(txt)
+
+    # flatten the non-static args the way jit does: flat leaf i <-> compiled
+    # parameter i, UNLESS XLA pruned unused parameters (detected below)
+    try:
+        params = [p.name for p in inspect.signature(fn).parameters.values()]
+    except (TypeError, ValueError):
+        params = []
+    flat: list[str] = []
+    donated_idx: set[int] = set()
+    donated_bytes = 0
+    for i, arg in enumerate(args):
+        if i in static_argnums:
+            continue
+        arg_name = params[i] if i < len(params) else f"arg{i}"
+        for path, leaf in tree_flatten_with_path(arg)[0]:
+            if i in donate_argnums:
+                donated_idx.add(len(flat))
+                donated_bytes += _leaf_nbytes(leaf)
+            flat.append(arg_name + keystr(path))
+
+    alias_entries = _ALIAS_RE.findall(txt)
+    aliased_params = {int(p) for _out, p in alias_entries}
+    entry = txt[txt.rfind("\nENTRY"):]
+    n_entry_params = len(set(re.findall(r"parameter\((\d+)\)", entry)))
+    unaliased: tuple[str, ...] = ()
+    if n_entry_params == len(flat):
+        # no parameter pruning: compiled param numbers ARE flat leaf indices
+        unaliased = tuple(flat[i] for i in sorted(donated_idx)
+                          if i not in aliased_params)
+
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    flops = float((ca or {}).get("flops", 0.0))
+    arg_b = int(ma.argument_size_in_bytes)
+    temp_b = int(ma.temp_size_in_bytes)
+    out_b = int(ma.output_size_in_bytes)
+    alias_b = int(ma.alias_size_in_bytes)
+    report = HloAuditReport(
+        name=name, collectives=colls, host_transfers=hosts,
+        donated_leaves=len(donated_idx), aliased_leaves=len(alias_entries),
+        donated_bytes=donated_bytes, alias_bytes=alias_b,
+        unaliased=unaliased, flops=flops, argument_bytes=arg_b,
+        temp_bytes=temp_b, output_bytes=out_b,
+        # resident set while the step runs (the aot_shard_proof formula:
+        # XLA:CPU's peak_memory_in_bytes leaves out the temp arena)
+        peak_bytes=arg_b + temp_b + out_b - alias_b)
+    if budget is not None:
+        report.enforce(budget)
+    return report
+
+
+def audit_guard(guard, args, budget: CollectiveBudget | None = None,
+                name: str | None = None) -> HloAuditReport:
+    """Audit a CompileGuard-wrapped step: the wrapped impl and its
+    static/donate argnums are read off the guard itself, so the audited
+    artifact can never desynchronize from what the guard's jit builds."""
+    return audit(guard.fn, args, name=name or guard.name,
+                 static_argnums=guard.static_argnums,
+                 donate_argnums=guard.donate_argnums, budget=budget)
+
+
+# ----------------------------------------------------------------- registry
+@dataclass(frozen=True)
+class StepSpec:
+    """A named auditable step: ``build()`` returns ``(target, args,
+    jit_kwargs, budget)`` where target is a CompileGuard or a plain
+    callable (jit_kwargs supplies static/donate argnums for the latter)."""
+    name: str
+    doc: str
+    build: object = field(repr=False)
+    min_devices: int = 1
+
+
+def _build_engine_step(which: str):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    from ..serving.engine import ServingConfig, ServingEngine
+    from ..text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=32, dropout=0.0))
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=16, page_size=4, max_prompt_len=8))
+    if which == "prefill":
+        bucket = eng.prefill_buckets[0]
+        padded = np.zeros(bucket, np.int32)
+        padded[:3] = (5, 7, 11)
+        args = (eng._p, eng.cache.pools, jnp.asarray(padded),
+                jnp.asarray(3, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.asarray(eng.cache.page_table[0]),
+                jnp.asarray(1, jnp.int32))
+        return eng._prefill_jit, args, None, SINGLE_CHIP
+    args = (eng._p, eng.cache.pools, jnp.asarray(eng.cache.page_table),
+            jnp.asarray(eng._ctx), jnp.asarray(eng._last_tok),
+            jnp.asarray(eng._active), jnp.asarray(eng._rids),
+            jnp.asarray(eng._gen))
+    return eng._decode_jit, args, None, SINGLE_CHIP
+
+
+def _build_cache_step(which: str):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..serving.kv_cache import PagedCacheConfig, PagedKVCache
+
+    cache = PagedKVCache(PagedCacheConfig(
+        num_layers=2, num_heads=2, head_dim=4, num_pages=8, page_size=4,
+        max_batch=2, pages_per_seq=4))
+    cfg = cache.cfg
+    idx = jnp.asarray(np.zeros(cfg.pages_per_seq, np.int32))
+    if which == "swap_gather":
+        return cache._gather_jit, (cache.pools, idx), None, SINGLE_CHIP
+    if which == "swap_scatter":
+        shape = (cfg.num_layers, cfg.pages_per_seq, cfg.page_size,
+                 cfg.num_heads, cfg.head_dim)
+        k_all = jnp.zeros(shape)
+        v_all = jnp.zeros(shape)
+        return (cache._scatter_jit, (cache.pools, idx, k_all, v_all),
+                None, SINGLE_CHIP)
+    args = (cache.pools, jnp.asarray(1, jnp.int32),
+            jnp.asarray(2, jnp.int32))
+    return cache._copy_jit, args, None, SINGLE_CHIP
+
+
+_TP8_BATCH, _TP8_HIDDEN, _TP8_FF = 2, 16, 64
+
+
+def _build_tp8_decode():
+    """A toy tensor-parallel decode step: the Megatron split — column-
+    parallel first matmul, row-parallel second, ONE psum of the [B, H]
+    partials per step. Its declared budget is exactly that all-reduce;
+    anything more (an implicit resharding all-gather, say) is a bug."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ("tp",))
+
+    def tp_block(x, w1, w2):
+        h = jnp.maximum(x @ w1, 0.0)   # [B, FF/8] — column shard, local
+        y = h @ w2                     # [B, H] partial sums
+        return jax.lax.psum(y, "tp")   # the ONE declared all-reduce
+
+    fn = shard_map(tp_block, mesh=mesh,
+                   in_specs=(P(None, None), P(None, "tp"), P("tp", None)),
+                   out_specs=P(None, None))
+    args = (jnp.ones((_TP8_BATCH, _TP8_HIDDEN), jnp.float32),
+            jnp.ones((_TP8_HIDDEN, _TP8_FF), jnp.float32),
+            jnp.ones((_TP8_FF, _TP8_HIDDEN), jnp.float32))
+    budget = CollectiveBudget(
+        all_reduce=1,
+        max_collective_bytes=_TP8_BATCH * _TP8_HIDDEN * 4)
+    return fn, args, {}, budget
+
+
+REGISTRY: dict[str, StepSpec] = {s.name: s for s in (
+    StepSpec("swap_gather", "paged-cache swap-out gather (read-only, no "
+             "donation)", lambda: _build_cache_step("swap_gather")),
+    StepSpec("swap_scatter", "paged-cache swap-in scatter (pools donated)",
+             lambda: _build_cache_step("swap_scatter")),
+    StepSpec("cow_copy", "prefix-cache copy-on-write page copy (pools "
+             "donated)", lambda: _build_cache_step("cow_copy")),
+    StepSpec("engine_prefill", "serving prefill step, smallest pad bucket "
+             "(toy GPT)", lambda: _build_engine_step("prefill")),
+    StepSpec("engine_decode", "serving decode step, whole batch (toy GPT)",
+             lambda: _build_engine_step("decode")),
+    StepSpec("tp8_decode", "toy tensor-parallel shard_map step on an "
+             "8-device mesh: budget = exactly one all-reduce",
+             _build_tp8_decode, min_devices=8),
+)}
+
+
+def run_step(name: str) -> HloAuditReport:
+    """Build and audit one registered step, enforcing its declared budget.
+    Raises HloCheckError on violation (or when the step needs more devices
+    than the process has — the CLI respawns onto a forced CPU mesh)."""
+    spec = REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(f"unknown hlocheck step {name!r} "
+                       f"(have: {', '.join(REGISTRY)})")
+    import jax
+
+    have = len(jax.devices())
+    if have < spec.min_devices:
+        raise HloCheckError(
+            f"step {name!r} needs {spec.min_devices} devices, have {have} "
+            f"— run under XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{spec.min_devices} (the --hlo CLI does this automatically)")
+    target, args, jit_kwargs, budget = spec.build()
+    from .tracecheck import CompileGuard
+
+    if isinstance(target, CompileGuard):
+        return audit_guard(target, args, budget=budget, name=name)
+    kw = jit_kwargs or {}
+    return audit(target, args, name=name, budget=budget, **kw)
+
+
+# ---------------------------------------------------------------------- CLI
+_CHILD_ENV = "PADDLE_TPU_HLOCHECK_CHILD"  # set in respawned children
+
+
+def _run_in_subprocess(spec: StepSpec) -> tuple[int, str]:
+    """Re-run one step in a child forced onto a CPU mesh wide enough for
+    it (the certification is a virtual-mesh proof, not an on-chip run).
+    Returns (exit code, relayed child output) so the caller can classify
+    a nonzero exit as budget violation vs execution error."""
+    import pathlib
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env[_CHILD_ENV] = "1"  # recursion guard: a child never respawns
+    # APPEND the forced count (last occurrence wins in XLA) so operator-
+    # supplied flags (--xla_dump_to=...) survive into the child
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        f" --xla_force_host_platform_device_count="
+                        f"{spec.min_devices}").strip()
+    root = str(pathlib.Path(__file__).resolve().parents[2])
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    print(f"[hlocheck] {spec.name}: needs {spec.min_devices} devices — "
+          f"re-running on a forced {spec.min_devices}-device CPU mesh")
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "--hlo",
+             "--step", spec.name],
+            env=env, timeout=900,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    except subprocess.TimeoutExpired as e:
+        # a wedged child must not crash the sweep: report it as an
+        # execution error (rc 124, the conventional timeout code) so the
+        # remaining steps still run and the summary stays honest
+        tail = (e.stdout or b"").decode(errors="replace")[-2000:]
+        print(f"[hlocheck] {spec.name}: child timed out after 900s"
+              + (f"\n{tail}" if tail else ""))
+        return 124, ""
+    out = proc.stdout.decode(errors="replace")
+    print(out, end="")
+    return proc.returncode, out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis --hlo",
+        description="Compiled-artifact auditor: collective census, "
+                    "host-transfer & aliasing verification, HBM/flops "
+                    "roll-up for every registered jitted step.")
+    parser.add_argument("--step", action="append", default=None,
+                        metavar="NAME",
+                        help="audit only these registered steps "
+                             "(repeatable; default: all)")
+    parser.add_argument("--list-steps", action="store_true",
+                        help="print the step registry and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_steps:
+        for s in REGISTRY.values():
+            extra = (f" [needs {s.min_devices} devices]"
+                     if s.min_devices > 1 else "")
+            print(f"{s.name}  {s.doc}{extra}")
+        return 0
+    names = args.step or list(REGISTRY)
+    unknown = [n for n in names if n not in REGISTRY]
+    if unknown:
+        print(f"unknown step(s): {', '.join(unknown)} "
+              f"(have: {', '.join(REGISTRY)})")
+        return 2
+    import jax
+
+    violations = errors = 0
+    for name in names:
+        spec = REGISTRY[name]
+        if len(jax.devices()) < spec.min_devices:
+            if os.environ.get(_CHILD_ENV):
+                # already the respawned child and the forced device count
+                # still didn't take: report, never spawn a grandchild
+                print(f"FAIL {name}: forced "
+                      f"{spec.min_devices}-device CPU mesh did not take "
+                      f"effect in the respawned child (execution error, "
+                      f"not a budget violation)")
+                errors += 1
+                continue
+            rc, out = _run_in_subprocess(spec)
+            if rc == 0:
+                continue
+            # a child exits 1 for a real budget violation AND for its own
+            # error paths (which self-report "not a budget violation") or
+            # an uncaught crash — classify by the child's report, so the
+            # summary never sends a reader chasing a nonexistent HLO
+            # budget breach
+            if rc == 1 and "FAIL" in out \
+                    and "not a budget violation" not in out:
+                violations += 1
+            else:
+                print(f"FAIL {name}: respawned child exited rc={rc} "
+                      f"(execution error, not a budget violation)")
+                errors += 1
+            continue
+        try:
+            print(run_step(name).summary())
+        except HloCheckError as e:
+            print(f"FAIL {name}: {e}")
+            violations += 1
+        except Exception as e:  # noqa: BLE001 — one broken step must not
+            # abort the sweep: the remaining steps still run and the
+            # summary stays honest, same contract as the child path
+            print(f"FAIL {name}: {type(e).__name__}: {e} "
+                  f"(execution error, not a budget violation)")
+            errors += 1
+    if violations or errors:
+        print(f"{violations} step(s) over budget, {errors} step(s) "
+              f"errored")
+    else:
+        print(f"hlocheck clean: {len(names)} step(s) within budget")
+    return 1 if (violations or errors) else 0

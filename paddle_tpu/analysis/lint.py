@@ -35,12 +35,17 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   invisible on dashboards exactly until the condition it reports starts
   happening (the serving gauges shipped this way — a snapshot taken
   before the first step had no ``serving_queue_depth``).
+- PT009 — raw ``jax.jit`` in ``serving/`` not routed through an
+  ``analysis.CompileGuard``: an unregistered jitted step is invisible to
+  the compile budgets, the retrace explainer, AND the hlocheck
+  compiled-artifact audits (collective census, aliasing verification,
+  HBM/flops roll-up) — exactly the steps those exist to certify.
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
 path substring to rule codes exempt in matching files. Rules carry a
-``scope`` path-part restriction (PT002/PT004/PT005/PT006 fire only under
-``serving/`` — they encode serving-stack contracts).
+``scope`` path-part restriction (PT002/PT004/PT005/PT006/PT009 fire only
+under ``serving/`` — they encode serving-stack contracts).
 
 CLI: ``python -m paddle_tpu.analysis [paths] [--rule PTxxx] [--path SUB]``
 (also ``tools/lint.py``). With no paths the DEFAULT sweep covers the
@@ -66,7 +71,7 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # would defeat the fixture. Everything else should use pragmas, which are
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
-    "lint_fixtures": {f"PT00{i}" for i in range(1, 9)},
+    "lint_fixtures": {f"PT00{i}" for i in range(1, 10)},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -323,6 +328,37 @@ def _pt008(tree, path):
                    f"then. Add it to _SEEDED so reset() seeds the zero.")
 
 
+def _pt009(tree, path):
+    """Raw jax.jit in serving/ escaping the CompileGuard registry. Any
+    reference to the ``jax.jit`` attribute counts — a call, a decorator,
+    a ``functools.partial(jax.jit, ...)``, or a bare alias assignment all
+    produce a jitted step no guard (and no hlocheck audit) can see — and
+    so does importing the name bare (``from jax import jit``), the
+    trivial respelling that would otherwise evade the attribute check."""
+    msg = ("raw jax.jit in serving/ bypasses the CompileGuard "
+           "registry — compile budgets, the retrace explainer, "
+           "and the hlocheck compiled-artifact audits (collective "
+           "census, donation aliasing, HBM/flops budgets) cannot "
+           "see unregistered steps. Wrap the step in "
+           "analysis.CompileGuard (or pragma-suppress a "
+           "sanctioned raw jit).")
+    jax_names = {"jax"} | {
+        a.asname for node in ast.walk(tree) if isinstance(node, ast.Import)
+        for a in node.names if a.name == "jax" and a.asname}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in jax_names):
+            yield (node.lineno, msg)
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax" \
+                and any(a.name == "jit" for a in node.names):
+            yield (node.lineno,
+                   "`from jax import jit` in serving/ imports the raw "
+                   "jit bare — every use is a step the CompileGuard "
+                   "registry (and hlocheck) can't see, and the bare name "
+                   "is invisible to the jax.jit attribute check. " + msg)
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -346,6 +382,8 @@ RULES: dict[str, Rule] = {r.code: r for r in (
     Rule("PT007", "mutable default argument", _pt007),
     Rule("PT008", "metric gauge written (stat_set/stat_max) without "
          "pre-seeding", _pt008),
+    Rule("PT009", "raw jax.jit in serving/ not routed through a "
+         "CompileGuard", _pt009, scope="serving"),
 )}
 
 
@@ -411,7 +449,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Repo linter: invariants this repo shipped bugs "
-                    "against, enforced (rules PT001-PT008).")
+                    "against, enforced (rules PT001-PT009).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the installed "
                              "paddle_tpu package plus the repo's --include "

@@ -22,16 +22,20 @@ module turns those pins into enforced, *explained* checks:
   ``donate_argnums``) and donated leaves returned unchanged.
 
 - :class:`SyncTally` counts host-sync events (``jax.device_get``,
-  ``Array.__array__`` — the ``np.asarray(jax_array)`` path — ``.item()``
-  and ``int()``/``float()``/``bool()`` coercions of device arrays) inside
-  a ``with`` region, so a decode loop can be *certified* sync-free up to
-  its one sanctioned token fetch per step. Tallies nest; each active tally
-  counts every event.
+  ``Array.__array__`` — the ``np.asarray(jax_array)`` path — ``.item()``,
+  ``.tolist()``, ``int()``/``float()``/``bool()`` coercions of device
+  arrays, and iteration over a device array — the ``for tok in toks`` /
+  ``list(toks)`` pattern, one event per loop) inside a ``with`` region, so
+  a decode loop can be *certified* sync-free up to its one sanctioned
+  token fetch per step. Tallies nest; each active tally counts every
+  event. :func:`sync_tally_paused` suspends counting for compile-time
+  host work (AOT lowering materializes traced constants host-side).
 
 None of this imports the serving stack — serving imports us.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import inspect
 import threading
@@ -40,7 +44,8 @@ import numpy as np
 
 __all__ = ["CompileGuard", "RetraceError", "DonationViolation",
            "SyncViolation", "SyncTally", "donation_audit",
-           "abstract_signature", "explain_signature_diff"]
+           "abstract_signature", "explain_signature_diff",
+           "sync_tally_paused"]
 
 
 class RetraceError(RuntimeError):
@@ -410,7 +415,13 @@ def _install_patches() -> None:
 
     targets = [(jax, "device_get", "device_get", _wrap)]
     impl = jarray.ArrayImpl
+    # tolist is a full-array materialization; __iter__ covers BOTH the
+    # `for tok in device_array` loop and `list(device_array)` (including
+    # the __len__/__getitem__ sequence-protocol fallback) — per-element
+    # coercions inside the loop still count separately, the iteration
+    # itself counts once (the PR 6 SyncTally blind-spot fix)
     for attr, kind in (("__array__", "np.asarray"), ("item", "item"),
+                       ("tolist", "tolist"), ("__iter__", "iter"),
                        ("__int__", "int"), ("__float__", "float"),
                        ("__bool__", "bool"), ("__index__", "index")):
         if hasattr(impl, attr):
@@ -429,12 +440,30 @@ def _remove_patches() -> None:
         setattr(obj, attr, orig)
 
 
+@contextlib.contextmanager
+def sync_tally_paused():
+    """Suspend SyncTally counting for the region. For compile-time host
+    work that is not a serving-path sync — AOT lowering (hlocheck audits)
+    converts traced constants through ``np.asarray`` on device arrays,
+    which would otherwise pollute a step's certified sync count. Nested
+    real sync events inside the region are deliberately NOT counted."""
+    prev = getattr(_in_event, "on", False)
+    _in_event.on = True
+    try:
+        yield
+    finally:
+        _in_event.on = prev
+
+
 class SyncTally:
     """Counts device->host sync events inside a ``with`` region:
     ``jax.device_get``, ``Array.__array__`` (the ``np.asarray(jax_array)``
-    path), ``.item()``, and ``int()``/``float()``/``bool()`` coercions of
-    device arrays. ``allowed=N`` turns the tally into an assertion: leaving
-    the region with more than N syncs raises :class:`SyncViolation`.
+    path), ``.item()``, ``.tolist()``, ``int()``/``float()``/``bool()``
+    coercions of device arrays, and iteration over a device array (one
+    event per ``for``/``list()`` pass — per-element coercions inside the
+    loop still count on top). ``allowed=N`` turns the tally into an
+    assertion: leaving the region with more than N syncs raises
+    :class:`SyncViolation`.
 
     Reentrant — nested tallies each count every event — but not
     thread-safe: the patches are process-global, so tally regions on

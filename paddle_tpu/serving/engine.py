@@ -64,8 +64,16 @@ donated-then-referenced pool raises DonationViolation), sweeps
 metrics. Each jitted step is additionally donation-audited at jaxpr level
 before its FIRST trace (``analysis.donation_audit``): a donated buffer the
 computation never consumes is a wrong ``donate_argnums`` and raises
-DonationViolation naming the leaf. Costs host work per step (signature
-hashing + a structural sweep) — a debugging mode, not a serving mode.
+DonationViolation naming the leaf. On top of that, every COMPILED PROGRAM
+(each prefill pad bucket + the decode step) is hlocheck-audited ONCE at
+its first trace (``analysis.hlocheck``): the step is AOT-lowered and its
+optimized HLO certified against the single-chip budget — zero collective
+ops, zero host-transfer/callback ops baked into the program, and XLA's
+``input_output_alias`` table honoring every donated pool (a
+donated-but-copied pool is a silent 2x HBM cost no trace-level check can
+see). Reports land in ``engine.hlo_audits`` and roll up into the
+``serving_hlo_*`` metrics. Costs host work per step plus one extra AOT
+compile per program — a debugging mode, not a serving mode.
 
 Observability (``paddle_tpu.obs``, on by default via ``enable_tracing``):
 every request accrues a timestamped lifecycle trace (enqueued, admitted,
@@ -90,6 +98,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis import hlocheck
 from ..analysis.tracecheck import (CompileGuard, DonationViolation,
                                    RetraceError, SyncTally, donation_audit)
 from ..core.tensor import Tensor
@@ -201,6 +210,9 @@ class ServingEngine:
         self._host_syncs = 0  # SyncTally total, counted under debug_checks
         self._retraces_emitted = 0  # last value mirrored into the metrics
         self._donation_audits: dict[str, list] = {}  # debug_checks reports
+        # hlocheck reports per compiled program ("prefill[BUCKET]"/"decode"),
+        # recorded under debug_checks at each program's first trace
+        self._hlo_audits: dict[str, hlocheck.HloAuditReport] = {}
         # donate the pools: the engine rebinds self.cache.pools to the
         # returned arrays immediately, and without donation XLA can't alias
         # input to output — the .at[] scatter would copy the ENTIRE pool
@@ -557,8 +569,9 @@ class ServingEngine:
                         jnp.asarray(cached, jnp.int32),
                         jnp.asarray(self.cache.page_table[req.slot]),
                         jnp.asarray(req.rid, jnp.int32))
-                if self.config.debug_checks and not self._prefill_jit.traces:
-                    self._audit_donation(self._prefill_jit, args)
+                if self.config.debug_checks:
+                    self._audit_step(self._prefill_jit, args,
+                                     f"prefill[{bucket}]")
                 try:
                     pools, tok = self._prefill_jit(*args)
                 except Exception as e:  # noqa: BLE001 — isolate the request
@@ -632,8 +645,8 @@ class ServingEngine:
                         jnp.asarray(self._ctx), jnp.asarray(self._last_tok),
                         jnp.asarray(self._active), jnp.asarray(self._rids),
                         jnp.asarray(self._gen))
-                if self.config.debug_checks and not self._decode_jit.traces:
-                    self._audit_donation(self._decode_jit, args)
+                if self.config.debug_checks:
+                    self._audit_step(self._decode_jit, args, "decode")
                 pools, toks = self._decode_jit(*args)
             self.cache.pools = pools
             # the step's ONE sanctioned device->host sync: the token fetch
@@ -733,6 +746,38 @@ class ServingEngine:
                 f"donation audit of {guard.name!r} jitted step: "
                 + "; ".join(dead))
         self._donation_audits[guard.name] = reports
+
+    def _audit_step(self, guard: CompileGuard, args, label: str) -> None:
+        """debug_checks: the pre-dispatch audits for one step call. The
+        jaxpr-level donation audit runs once per GUARD (at its first
+        trace); the hlocheck compiled-artifact audit runs once per
+        COMPILED PROGRAM (per prefill bucket + decode, keyed by ``label``)
+        — the step is AOT-lowered and its optimized HLO enforced against
+        the single-chip budget: zero collectives, zero host transfers,
+        every donated pool honored with input-output aliasing. Violations
+        raise (engine-fatal — an audit failure is the contract
+        debug_checks exists to surface, not a request fault); clean
+        reports land in ``hlo_audits`` and the ``serving_hlo_*``
+        metrics. One extra AOT compile per program, never a serving-path
+        cost."""
+        if not guard.traces:
+            self._audit_donation(guard, args)
+        if label in self._hlo_audits:
+            return
+        report = hlocheck.audit_guard(guard, args, name=label)
+        report.enforce(hlocheck.SINGLE_CHIP)
+        self._hlo_audits[label] = report
+        self.metrics.on_hlo_audit(
+            collective_ops=len(report.collectives),
+            host_transfers=len(report.host_transfers),
+            peak_hbm_bytes=report.peak_bytes, flops=report.flops)
+
+    @property
+    def hlo_audits(self) -> dict:
+        """Per-compiled-program hlocheck reports recorded under
+        ``debug_checks`` — one per prefill pad bucket (``prefill[N]``)
+        plus ``decode``. Empty with debug checks off."""
+        return dict(self._hlo_audits)
 
     @property
     def timeline(self) -> StepTimeline | None:

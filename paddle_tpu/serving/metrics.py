@@ -47,6 +47,17 @@ Analysis counters (paddle_tpu.analysis integration, pre-seeded):
                                      step boundary — the token fetch — is
                                      the sanctioned floor)
 
+hlocheck roll-up (compiled-artifact audits under debug_checks, one per
+compiled program — per prefill bucket + decode; pre-seeded):
+
+- serving_hlo_collective_ops   total collective ops across audited
+                               programs (single-chip contract: 0)
+- serving_hlo_host_transfers   total infeed/outfeed/host-callback ops
+                               compiled into audited programs (floor: 0)
+- serving_hlo_peak_hbm_bytes   max per-step resident bytes (args + temp
+                               arena + outputs - aliased) over programs
+- serving_hlo_flops_per_step   max XLA cost_analysis flops over programs
+
 Latency histograms (paddle_tpu.obs integration): fixed-bucket streaming
 histograms — bounded memory, O(log buckets) per observation — feed the
 percentile gauges ``serving_<hist>_p50/p90/p99`` (+ ``_count``) for:
@@ -93,6 +104,8 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "prefix_shared_pages", "prefix_cached_pages",
            "prefix_cow_copies", "prefix_evictions",
            "analysis_retraces_total", "analysis_host_syncs_total",
+           "hlo_collective_ops", "hlo_host_transfers",
+           "hlo_peak_hbm_bytes", "hlo_flops_per_step",
            "tokens_per_sec", "queue_depth", "active_requests",
            "page_pool_used", "page_utilization",
            "queue_depth_peak", "page_pool_peak")
@@ -118,7 +131,8 @@ COUNTER_STATS = frozenset(
     if k.endswith("_total") or k in (
         "decode_steps", "rejected", "shed", "expired", "cancelled",
         "failed", "swap_outs", "swap_ins", "prefix_hits", "prefix_misses",
-        "prefix_tokens_saved", "prefix_cow_copies", "prefix_evictions"))
+        "prefix_tokens_saved", "prefix_cow_copies", "prefix_evictions",
+        "hlo_collective_ops", "hlo_host_transfers"))
 
 
 class ServingMetrics:
@@ -215,6 +229,16 @@ class ServingMetrics:
         guards own the monotonic counts)."""
         monitor.stat_set(PREFIX + "analysis_retraces_total", retraces)
         monitor.stat_set(PREFIX + "analysis_host_syncs_total", host_syncs)
+
+    def on_hlo_audit(self, collective_ops: int, host_transfers: int,
+                     peak_hbm_bytes: int, flops: float) -> None:
+        """One hlocheck compiled-artifact audit (debug_checks, once per
+        compiled program): collective/host-transfer ops accumulate across
+        programs, peak HBM and flops keep the per-program maximum."""
+        monitor.stat_add(PREFIX + "hlo_collective_ops", int(collective_ops))
+        monitor.stat_add(PREFIX + "hlo_host_transfers", int(host_transfers))
+        monitor.stat_max(PREFIX + "hlo_peak_hbm_bytes", int(peak_hbm_bytes))
+        monitor.stat_max(PREFIX + "hlo_flops_per_step", float(flops))
 
     # ---------------------------------------------------------- histograms
     def observe_request(self, summary: dict) -> None:

@@ -1,0 +1,29 @@
+"""PT009 fixture: raw jax.jit in serving/ escapes the CompileGuard
+registry — no compile budget, no retrace explanation, no hlocheck audit.
+Linted as if it lived under serving/."""
+import functools
+
+import jax
+
+
+def decode_step(params, state):
+    return state
+
+
+raw = jax.jit(decode_step, donate_argnums=(1,))
+
+partial_raw = functools.partial(jax.jit, donate_argnums=(1,))(decode_step)
+
+
+@jax.jit
+def other_step(x):
+    return x
+
+
+sanctioned = jax.jit(decode_step)  # lint: disable=PT009
+
+from jax import jit  # noqa: E402 — the bare-import respelling fires too
+
+import jax as j  # noqa: E402 — the alias itself is fine...
+
+aliased = j.jit(decode_step)  # ...but its .jit use fires

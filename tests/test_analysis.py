@@ -181,6 +181,41 @@ def test_sync_tally_counts_sync_events_only():
     assert t.count == before
 
 
+def test_sync_tally_counts_tolist_and_iteration():
+    """The PR 6 blind-spot fix: ``.tolist()`` is a full-array host
+    materialization and iterating a device array (``for``/``list()``,
+    including the __len__/__getitem__ sequence-protocol path) drives a
+    per-element dispatch loop from the host — both must count. Per-element
+    coercions inside a loop still count on top of the iteration event."""
+    with SyncTally() as t:
+        arr = jnp.arange(3)
+        arr.tolist()                    # sync: full materialization
+        for _ in arr:                   # sync: one event per loop
+            pass
+        total = sum(int(x) for x in arr)  # iter + 3 int coercions
+    assert total == 3
+    assert t.events == ["tolist", "iter", "iter", "int", "int", "int"], \
+        t.events
+    # patches removed on exit
+    before = t.count
+    jnp.zeros(2).tolist()
+    assert t.count == before
+
+
+def test_sync_tally_paused_suppresses_counting():
+    """hlocheck AOT-lowers steps inside debug_checks step tallies;
+    lowering materializes traced constants host-side — compile-time work
+    the certification must not count. Nested pauses restore correctly."""
+    from paddle_tpu.analysis import sync_tally_paused
+
+    with SyncTally() as t:
+        with sync_tally_paused():
+            np.asarray(jnp.zeros(2))
+            jnp.zeros(2).tolist()
+        np.asarray(jnp.zeros(2))  # counting resumes after the pause
+    assert t.count == 1 and t.events == ["np.asarray"]
+
+
 def test_sync_tally_nests_and_enforces_allowance():
     with SyncTally() as outer:
         with SyncTally() as inner:
@@ -321,10 +356,13 @@ _FIXTURE_CASES = {
     "pt004_wall_clock.py": ("serving/pt004.py", {6: "PT004"}),
     "pt005_hot_sync.py": ("serving/pt005.py",
                           {8: "PT005", 9: "PT005", 10: "PT005"}),
-    "pt006_jit_no_donate.py": ("serving/pt006.py", {17: "PT006"}),
+    "pt006_jit_no_donate.py": ("serving/pt006.py", {23: "PT006"}),
     "pt007_mutable_default.py": ("pt007.py", {4: "PT007", 14: "PT007"}),
     "pt008_unseeded_gauge.py": ("pt008.py",
                                 {16: "PT008", 17: "PT008", 18: "PT008"}),
+    "pt009_raw_jit.py": ("serving/pt009.py",
+                         {13: "PT009", 15: "PT009", 18: "PT009",
+                          25: "PT009", 29: "PT009"}),
 }
 
 
@@ -343,7 +381,7 @@ def test_lint_rule_fixture(fixture):
 
 
 def test_lint_rule_table_is_complete():
-    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 9)]
+    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -409,6 +447,23 @@ def test_self_lint_catches_reintroduced_pr2_eq_bug():
     findings = lint_source(bad, "paddle_tpu/serving/kv_cache.py")
     assert any(f.rule == "PT001" and "SwapHandle" in f.message
                for f in findings)
+
+
+def test_self_lint_catches_reintroduced_raw_jit():
+    """Deliberately route the engine's decode step through a raw jax.jit
+    instead of its CompileGuard: PT009 must fire — an unregistered step is
+    invisible to the compile budgets AND the hlocheck artifact audits."""
+    path = REPO / "paddle_tpu" / "serving" / "engine.py"
+    src = path.read_text()
+    bad = src.replace("self._decode_jit = CompileGuard(",
+                      "self._decode_jit = jax.jit(")
+    assert bad != src, "engine.py no longer guards the decode step"
+    findings = lint_source(bad, "paddle_tpu/serving/engine.py")
+    assert any(f.rule == "PT009" and "CompileGuard" in f.message
+               for f in findings)
+    # the guarded original is clean: the guard IS the sanctioned route
+    assert not any(f.rule == "PT009"
+                   for f in lint_source(src, "paddle_tpu/serving/engine.py"))
 
 
 def test_self_lint_catches_reintroduced_wall_clock():

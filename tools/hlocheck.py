@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""CI/dev entry point for the compiled-artifact auditor.
+
+Usage:
+    python tools/hlocheck.py                  # sweep every registered step
+    python tools/hlocheck.py --step tp8_decode
+    python tools/hlocheck.py --list-steps
+
+Exit codes: 0 all steps within budget, 1 violations, 2 bad usage. The same
+engine runs as ``python -m paddle_tpu.analysis --hlo``. Steps that need a
+wider mesh than this process has (the 8-device shard_map certification)
+are re-run automatically in a child on a forced CPU mesh.
+
+The repo root is forced onto sys.path FIRST, so the audited package is
+this checkout's ``paddle_tpu/``, never an installed copy.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis.hlocheck import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
