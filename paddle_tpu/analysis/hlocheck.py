@@ -439,6 +439,20 @@ def _build_engine_step(which: str):
                 jnp.asarray(eng.cache.page_table[0]),
                 jnp.asarray(1, jnp.int32))
         return eng._prefill_jit, args, None, SINGLE_CHIP
+    if which == "prefill_chunk":
+        # chunked prefill: a MID-PROMPT chunk — queries enter at ctx0 > 0
+        # against already-resident KV, through the SAME prefill program
+        # shape (chunk padded to its bucket). Audited separately so the
+        # registry certifies the exact call signature the chunk phase
+        # dispatches, not just the cold ctx0 = 0 case.
+        bucket = eng.prefill_buckets[0]
+        padded = np.zeros(bucket, np.int32)
+        padded[:4] = (3, 5, 7, 11)
+        args = (eng._p, eng.cache.pools, jnp.asarray(padded),
+                jnp.asarray(4, jnp.int32), jnp.asarray(4, jnp.int32),
+                jnp.asarray(eng.cache.page_table[0]),
+                jnp.asarray(1, jnp.int32))
+        return eng._prefill_jit, args, None, SINGLE_CHIP
     args = (eng._p, eng.cache.pools, jnp.asarray(eng.cache.page_table),
             jnp.asarray(eng._ctx), jnp.asarray(eng._last_tok),
             jnp.asarray(eng._active), jnp.asarray(eng._rids),
@@ -514,6 +528,10 @@ REGISTRY: dict[str, StepSpec] = {s.name: s for s in (
              "donated)", lambda: _build_cache_step("cow_copy")),
     StepSpec("engine_prefill", "serving prefill step, smallest pad bucket "
              "(toy GPT)", lambda: _build_engine_step("prefill")),
+    StepSpec("engine_prefill_chunk", "serving CHUNKED prefill step: one "
+             "mid-prompt chunk at ctx0 > 0 through the same prefill "
+             "program (toy GPT)",
+             lambda: _build_engine_step("prefill_chunk")),
     StepSpec("engine_decode", "serving decode step, whole batch (toy GPT)",
              lambda: _build_engine_step("decode")),
     StepSpec("tp8_decode", "toy tensor-parallel shard_map step on an "

@@ -33,7 +33,8 @@ _ENGINE_TID = 0
 _PID = 1
 
 # lifecycle events that ALSO render as instants on the request's track
-_INSTANTS = ("preempted", "swap_out", "swap_in", "decode_mark", "retired")
+_INSTANTS = ("preempted", "swap_out", "swap_in", "decode_mark",
+             "prefill_chunk", "retired")
 
 
 def _request_events(trace: RequestTrace) -> list[dict]:
@@ -62,6 +63,12 @@ def _request_events(trace: RequestTrace) -> list[dict]:
         elif ev.name == "prefill_start":
             close(ev.t)
             open_name, open_t = "prefill", ev.t
+        elif ev.name == "prefill_chunk":
+            # chunked prefill: each chunk gets its own span on the track
+            # (the first closes the opening "prefill" sliver, later ones
+            # close their predecessor) — chunk boundaries stay visible
+            close(ev.t)
+            open_name, open_t = "prefill_chunk", ev.t
         elif ev.name == "prefill_end":
             close(ev.t)
         elif ev.name in ("first_token", "resumed"):
@@ -96,7 +103,8 @@ def chrome_trace(traces=(), timeline: StepTimeline | None = None) -> dict:
     if timeline is not None:
         for rec in timeline.records():
             args = {"step": rec.step, "batch": rec.batch,
-                    "prefills": rec.prefills, "admitted": rec.admitted,
+                    "prefills": rec.prefills, "chunks": rec.chunks,
+                    "admitted": rec.admitted,
                     "finished": rec.finished,
                     "preemptions": rec.preemptions,
                     "queue_depth": rec.queue_depth,

@@ -27,7 +27,8 @@ from __future__ import annotations
 
 from bisect import bisect_left
 
-__all__ = ["Histogram", "LATENCY_EDGES_S", "OCCUPANCY_EDGES", "QUANTILES"]
+__all__ = ["Histogram", "LATENCY_EDGES_S", "OCCUPANCY_EDGES", "QUANTILES",
+           "percentile_from_counts"]
 
 # Latency edges in seconds: ~Prometheus default widened to cover both a
 # microbenchmark CPU step (sub-millisecond) and a multi-minute queue wait.
@@ -43,6 +44,33 @@ OCCUPANCY_EDGES = (0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
 
 # The quantiles every serving histogram publishes: (suffix, q).
 QUANTILES = (("p50", 0.50), ("p90", 0.90), ("p99", 0.99))
+
+
+def percentile_from_counts(edges, counts, q: float,
+                           count: int | None = None) -> float:
+    """The histogram_quantile estimator over a raw bucket-count vector
+    (``len(edges) + 1`` entries, last = overflow). Shared by
+    :meth:`Histogram.percentile` and callers holding count DELTAS — the
+    SLO admission controller computes windowed p99s by subtracting two
+    snapshots of a cumulative histogram's counts and estimating over the
+    difference, without a second histogram on the hot path."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile {q} outside [0, 1]")
+    count = sum(counts) if count is None else count
+    if count == 0:
+        return 0.0
+    target = q * count
+    cum = 0
+    for i, c in enumerate(counts):
+        if cum + c >= target:
+            if i == len(edges):  # overflow: clamp, don't invent
+                return edges[-1]
+            lo = 0.0 if i == 0 else edges[i - 1]
+            hi = edges[i]
+            frac = (target - cum) / c if c else 0.0
+            return lo + frac * (hi - lo)
+        cum += c
+    return edges[-1]
 
 
 class Histogram:
@@ -85,22 +113,8 @@ class Histogram:
         histogram; the first bucket interpolates from 0 (these are
         non-negative measurements); the overflow bucket clamps to the top
         edge rather than extrapolating to infinity."""
-        if not 0.0 <= q <= 1.0:
-            raise ValueError(f"quantile {q} outside [0, 1]")
-        if self.count == 0:
-            return 0.0
-        target = q * self.count
-        cum = 0
-        for i, c in enumerate(self.counts):
-            if cum + c >= target:
-                if i == len(self.edges):  # overflow: clamp, don't invent
-                    return self.edges[-1]
-                lo = 0.0 if i == 0 else self.edges[i - 1]
-                hi = self.edges[i]
-                frac = (target - cum) / c if c else 0.0
-                return lo + frac * (hi - lo)
-            cum += c
-        return self.edges[-1]
+        return percentile_from_counts(self.edges, self.counts, q,
+                                      self.count)
 
     def snapshot(self) -> dict:
         """Percentiles + count/sum/mean, always present (zeros when

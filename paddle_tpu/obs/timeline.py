@@ -24,12 +24,13 @@ class StepRecord:
     t_start: float       # engine-clock seconds
     t_end: float
     admitted: int        # requests admitted this step (incl. swap resumes)
-    prefills: int        # prefill passes run this step
+    prefills: int        # prefills COMPLETED this step (first token out)
     batch: int           # active decode slots this step
     finished: int        # requests that finished this step
     preemptions: int     # victims preempted this step
     queue_depth: int     # waiting requests after the step
     pages_in_use: int    # pool pages held after the step
+    chunks: int = 0      # chunked-prefill chunks executed this step
     host_syncs: int | None = None  # SyncTally count (debug_checks only)
     extra: dict = field(default_factory=dict)  # exporter passthrough
 
@@ -39,9 +40,10 @@ class StepRecord:
 
     def phase_mix(self) -> str:
         """Coarse label of what the step did — the field Perfetto colors
-        the engine track by."""
+        the engine track by. A step that only advanced chunks (no prefill
+        completed, nothing decoding yet) still reads "prefill"."""
         parts = []
-        if self.prefills:
+        if self.prefills or self.chunks:
             parts.append("prefill")
         if self.batch:
             parts.append("decode")

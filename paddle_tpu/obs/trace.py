@@ -2,7 +2,9 @@
 
 A :class:`RequestTrace` is an append-only list of ``(event, timestamp,
 args)`` triples covering one request's whole life — enqueued, admitted,
-prefill_start/prefill_end, first_token, periodic decode_mark, preempted /
+prefill_start/prefill_end (with a ``prefill_chunk`` per chunk in between
+under chunked prefill — TTFT stays anchored to ``first_token``, which
+only the FINAL chunk emits), first_token, periodic decode_mark, preempted /
 swap_out / swap_in / resumed, and a terminal ``retired`` carrying the final
 state (finished/cancelled/expired/failed/shed). Timestamps come from the
 ENGINE clock (``ServingConfig(clock=)`` + fault skew), never from the wall
@@ -92,7 +94,8 @@ class RequestTrace:
         - ``e2e``: enqueued -> retired,
 
         plus ``state``, ``tokens`` (generated count at retirement),
-        ``preemptions``, and ``cached_tokens`` (prefix-cache hit width).
+        ``preemptions``, ``cached_tokens`` (prefix-cache hit width), and
+        ``prefill_chunks`` (chunked-prefill chunk count; 0 unchunked).
         """
         enq = self.first("enqueued")
         adm = self.first("admitted")
@@ -123,6 +126,7 @@ class RequestTrace:
             "e2e": dt(enq, ret),
             "preemptions": self.count("preempted"),
             "cached_tokens": ps.arg("cached", 0) if ps else 0,
+            "prefill_chunks": self.count("prefill_chunk"),
         }
 
     def __repr__(self) -> str:

@@ -13,8 +13,12 @@ and a deterministic fault-injection harness (serving/faults.py).
 
 Performance layer: automatic prefix caching (refcounted cross-request page
 sharing with an exact content index, copy-on-write, and LRU eviction of
-reclaimable pages — only the uncached prompt tail is prefilled) and
-multi-bucket prefill (one compile per power-of-two pad bucket).
+reclaimable pages — only the uncached prompt tail is prefilled),
+multi-bucket prefill (one compile per power-of-two pad bucket), and
+chunked prefill with SLO-adaptive admission (``chunk_size=`` interleaves
+long-prompt prefill with decode through the same compiled programs;
+``slo=SLOConfig(...)`` adapts chunks-per-step to TTFT/TPOT p99 targets
+off the obs histograms — serving/slo.py).
 
 Analysis layer (paddle_tpu.analysis): every jitted step sits behind a
 ``CompileGuard`` (trace counting, compile budgets, retrace explanations,
@@ -37,8 +41,10 @@ from .kv_cache import (PagedCacheConfig, PagedKVCache,  # noqa: F401
                        PageAllocator, SwapHandle)
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import EngineOverloaded, Request, Scheduler  # noqa: F401
+from .slo import SLOConfig, SLOController  # noqa: F401
 
 __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "PagedKVCache", "PageAllocator", "SwapHandle", "ServingMetrics",
            "Request", "Scheduler", "EngineOverloaded", "FaultInjector",
-           "InjectedFault", "prefill_buckets"]
+           "InjectedFault", "prefill_buckets", "SLOConfig",
+           "SLOController"]

@@ -26,6 +26,30 @@ with caching on or off: the fixed gather width plus exact-zero ragged
 masking make KV bytes position-deterministic, so cached pages hold exactly
 the bytes a cold prefill would recompute.
 
+Chunked prefill (``ServingConfig(chunk_size=N)``): a long prompt no longer
+monopolizes an engine step at its full pad bucket. An admitted request
+enters a PREFILLING state and advances N prompt tokens per step through
+the SAME prefill program — each chunk's queries enter at ``ctx_lens =
+tokens already prefilled``, the exact ragged mechanism the prefix-cache
+tail prefill already rides, with the chunk padded into the existing bucket
+set (the bucket set stays the only source of prefill compiles, whatever
+the chunk size or count). Decode for the running batch proceeds in the
+same step, so TPOT stays bounded while whales prefill and newcomer TTFT
+stops queueing behind them. Intermediate chunks never fetch their sampled
+token, so the sync-free decode certification is unchanged: one fetch per
+decode step plus one per COMPLETED prefill. Outputs are bit-identical
+chunked or not — same KV bytes, same last-token logits (the PR 3
+exact-zero ragged masking argument, applied inductively per chunk).
+
+On top, ``ServingConfig(slo=SLOConfig(ttft_p99_s=, tpot_p99_s=))`` installs
+an SLO-adaptive admission controller (serving/slo.py): each step boundary
+it reads the streaming ``serving_step_duration_s`` / ``serving_tpot_s``
+histograms — host-side integer bucket counts, zero added device syncs —
+and AIMD-adapts how many prefill chunks each step may admit; while
+degraded, waiters with warm prefix-cache hits are admitted ahead of cold
+ones (their uncached tail is cheap). The current limit is mirrored in the
+``serving_chunk_limit`` gauge.
+
 Decode semantics match text/generation.py: prefill picks the first token
 from the last prompt logit, each decode step feeds the previous token back
 in, writes its KV at position ctx, and samples the next — so per-request
@@ -108,8 +132,10 @@ from ..text.generation import sample_logits
 from .faults import InjectedFault
 from .kv_cache import PagedCacheConfig, PagedKVCache
 from .metrics import ServingMetrics
-from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, RUNNING,
-                        SHED, WAITING, EngineOverloaded, Request, Scheduler)
+from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, PREFILLING,
+                        RUNNING, SHED, WAITING, EngineOverloaded, Request,
+                        Scheduler)
+from .slo import SLOConfig, SLOController
 
 
 @dataclass(frozen=True)
@@ -130,6 +156,12 @@ class ServingConfig:
     shed_policy: str = "reject"  # "reject" | "shed-oldest" when queue full
     preemption_mode: str = "recompute"  # "recompute" | "swap"
     enable_prefix_caching: bool = True  # cross-request KV page sharing
+    chunk_size: int = 0  # prefill tokens per step per request; 0 = whole
+    # tail in one pass (chunking off). Chunks ride the SAME prefill jit
+    # (ctx_lens = tokens already resident) padded into the existing
+    # bucket set — no new compiles, ever.
+    slo: SLOConfig | None = None  # SLO-adaptive chunk admission (needs
+    # chunk_size > 0 and enable_tracing — it reads the obs histograms)
     debug_checks: bool = False  # strict CompileGuard + invariant sweep/step
     enable_tracing: bool = True  # per-request traces + step timeline (obs)
     trace_capacity: int = 2048  # retained traces (terminal evicted oldest)
@@ -164,6 +196,24 @@ class ServingEngine:
             raise ValueError(
                 f"max_prompt_len {cfg.max_prompt_len} exceeds the model's "
                 f"max_seq_len {mc.max_seq_len}")
+        if cfg.chunk_size < 0:
+            raise ValueError(f"chunk_size {cfg.chunk_size} < 0")
+        if cfg.chunk_size > cfg.max_prompt_len:
+            # a chunk must pad into the existing bucket set (capped at
+            # max_prompt_len) — a larger chunk would need a new compile
+            raise ValueError(
+                f"chunk_size {cfg.chunk_size} exceeds max_prompt_len "
+                f"{cfg.max_prompt_len} (chunks pad into the prefill "
+                f"bucket set)")
+        if cfg.slo is not None and not cfg.chunk_size:
+            raise ValueError(
+                "ServingConfig(slo=) adapts chunked prefill admission — "
+                "set chunk_size > 0 to enable chunking first")
+        if cfg.slo is not None and not cfg.enable_tracing:
+            raise ValueError(
+                "the SLO controller reads the obs step/tpot histograms, "
+                "which enable_tracing feeds — it cannot run with tracing "
+                "disabled (it would silently never throttle)")
         pages_per_seq = cfg.pages_per_seq or \
             -(-mc.max_seq_len // cfg.page_size)
         self.cache = PagedKVCache(PagedCacheConfig(
@@ -196,6 +246,15 @@ class ServingEngine:
             shed_policy=cfg.shed_policy, preemption_mode=cfg.preemption_mode,
             tracer=self._tracer)
         self._fault_injector = fault_injector
+        # SLO-adaptive chunk admission: a host-side AIMD controller over
+        # chunks-per-step, windowing the obs histograms (serving/slo.py).
+        # None (chunking off or no SLO) costs one attribute check per step.
+        if cfg.slo is not None:
+            self._slo = SLOController(cfg.slo, self.metrics,
+                                      default_max_chunks=cfg.max_batch)
+            self.metrics.on_chunk_limit(self._slo.chunk_limit)
+        else:
+            self._slo = None
         self._step_idx = 0
         self.admit_paused = False  # run(budget_s=) drain; settable by callers
         b = cfg.max_batch
@@ -363,15 +422,18 @@ class ServingEngine:
         True when something was cancelled; False for unknown or already
         terminal requests."""
         req = self._requests.get(rid)
-        if req is None or req.state not in (WAITING, RUNNING):
+        if req is None or req.state not in (WAITING, RUNNING, PREFILLING):
             return False
         self._retire(req, CANCELLED)
         self.metrics.on_cancelled()
         return True
 
     def status(self, rid: int) -> str:
-        """Lifecycle state of a request: waiting/running/finished/cancelled/
-        expired/failed/shed. KeyError for an unknown rid."""
+        """Lifecycle state of a request: waiting/prefilling/running/
+        finished/cancelled/expired/failed/shed (``prefilling`` only under
+        chunked prefill: admitted, slot + pages held, prompt still
+        streaming through the prefill step). KeyError for an unknown
+        rid."""
         if rid in self._requests:
             return self._requests[rid].state
         if rid in self._finished:
@@ -416,7 +478,8 @@ class ServingEngine:
             return
         now = self.now()
         for req in with_deadline:
-            if now >= req.deadline and req.state in (WAITING, RUNNING):
+            if now >= req.deadline and \
+                    req.state in (WAITING, RUNNING, PREFILLING):
                 self._retire(req, EXPIRED)
                 self.metrics.on_expired()
 
@@ -439,6 +502,94 @@ class ServingEngine:
         self.metrics.on_preempt()
         if self.config.preemption_mode == "swap":
             self.metrics.on_swap_out()
+
+    def _prefill_chunk(self, req: Request) -> int | None:
+        """Advance one PREFILLING request by one chunk through the SAME
+        jitted prefill step: queries enter at ``ctx_lens =
+        req.prefilled_tokens`` (exactly the ragged contract the
+        prefix-cache tail prefill rides), the chunk is padded into the
+        existing bucket set, so the bucket set stays the only source of
+        prefill compiles. Intermediate chunks never touch the host — the
+        step's sampled token is discarded undelivered, keeping the
+        dispatch pipeline async and the SyncTally certification formula
+        (one fetch per decode step + one per COMPLETED prefill)
+        unchanged. Returns the first generated token when this chunk
+        completed the prefill, else None; a request-local failure retires
+        the request FAILED here (engine-fatal failures re-raise)."""
+        from .. import profiler
+
+        cfg = self.config
+        start = req.prefilled_tokens
+        n = min(cfg.chunk_size, req.prompt_len - start)
+        final = start + n >= req.prompt_len
+        bucket = next(b for b in self.prefill_buckets if b >= n)
+        padded = np.full(bucket, cfg.pad_token_id, np.int32)
+        padded[:n] = req.prompt[start:start + n]
+        tr = self._tracer
+        args = (self._p, self.cache.pools, jnp.asarray(padded),
+                jnp.asarray(n, jnp.int32), jnp.asarray(start, jnp.int32),
+                jnp.asarray(self.cache.page_table[req.slot]),
+                jnp.asarray(req.rid, jnp.int32))
+        if cfg.debug_checks:
+            self._audit_step(self._prefill_jit, args, f"prefill[{bucket}]")
+        with profiler.RecordEvent("serving::prefill_chunk"):
+            try:
+                pools, tok = self._prefill_jit(*args)
+            except Exception as e:  # noqa: BLE001 — isolate the request
+                if isinstance(e, (RetraceError, DonationViolation)):
+                    # a strict-guard refusal is an AUDIT failure, not a
+                    # request fault — surface it
+                    raise
+                if any(arr.is_deleted() for pl in self.cache.pools
+                       for arr in pl.values()):
+                    # donation consumed the pools before the failure:
+                    # every sequence's KV is gone — engine-fatal
+                    raise
+                self._retire(req, FAILED, e)
+                self.metrics.on_failed()
+                return None
+        self.cache.pools = pools
+        req.prefilled_tokens = start + n
+        self.metrics.on_prefill_chunk(n)
+        # stamped AFTER the dispatch succeeded, so the trace's chunk
+        # count, the Chrome-export chunk spans, and the
+        # serving_prefill_chunks_total counter can never disagree about
+        # a chunk whose jit call failed
+        if tr is not None:
+            tr.event(req.rid, "prefill_chunk", start=start, tokens=n,
+                     bucket=bucket, final=final)
+        if not final:
+            return None
+        # the chunked prefill's ONE sanctioned device->host sync: the
+        # final chunk's first-token fetch (the same np.asarray site
+        # PT005 polices on the unchunked path)
+        tok = int(np.asarray(tok))  # lint: disable=PT005
+        req.generated.append(tok)
+        slot = req.slot
+        self._ctx[slot] = req.prompt_len
+        self._last_tok[slot] = tok
+        self._active[slot] = True
+        self._rids[slot] = req.rid
+        self._gen[slot] = 1
+        req.state = RUNNING
+        req.fresh = True
+        if tr is not None:
+            # accounting reads prefix_hit_tokens, not cached_tokens: a
+            # mid-prefill swap restore zeroes the latter, but this
+            # prefill attempt's cache hit still served those tokens
+            tr.event(req.rid, "prefill_end",
+                     tokens=req.prompt_len - req.prefix_hit_tokens)
+            tr.event(req.rid, "first_token")
+        # every full prompt page is now resident: index it for reuse
+        self.cache.register_prefix(slot, req.prompt)
+        self.metrics.on_prefill(0)  # chunk tokens were counted per chunk
+        if cfg.enable_prefix_caching:
+            if req.prefix_hit_tokens > 0:
+                self.metrics.on_prefix_hit(req.prefix_hit_tokens)
+            else:
+                self.metrics.on_prefix_miss()
+        self.metrics.on_tokens(1)
+        return tok
 
     def _maybe_finish(self, req: Request, tok: int) -> bool:
         eos = self.config.eos_token_id
@@ -504,6 +655,13 @@ class ServingEngine:
             self._timeline.append(StepRecord(host_syncs=syncs, **st))
             self.metrics.observe_step(st["t_end"] - st["t_start"],
                                       st["batch"])
+        # SLO-adaptive admission: windowed p99s over the histograms just
+        # fed above — pure host-side integer reads, zero device syncs
+        if self._slo is not None:
+            change = self._slo.on_step()
+            if change is not None:
+                old, new = change
+                self.metrics.on_chunk_limit(new, throttled=new < old)
         return finished
 
     def _step(self) -> list[int]:
@@ -525,11 +683,16 @@ class ServingEngine:
         n_prefills = n_active = 0
         finished_now = []
         # a paused engine (run(budget_s=) drain) admits no NEWCOMERS, but
-        # still resumes preemption victims — they are in-flight work
-        admitted = self.scheduler.admit(resume_only=self.admit_paused)
+        # still resumes preemption victims — they are in-flight work.
+        # Under SLO degradation, warm prefix-cache waiters jump cold ones
+        # (their uncached tail barely touches the throttled chunk budget).
+        admitted = self.scheduler.admit(
+            resume_only=self.admit_paused,
+            prefer_cached=self._slo is not None and self._slo.degraded)
         for req in admitted:
             if req.generated:  # swap-resume: KV restored by admit(); there
                 slot = req.slot   # is no prefill here for prefill_fail to hit
+                req.resumed_from_swap = False
                 self._ctx[slot] = req.prompt_len + len(req.generated) - 1
                 self._last_tok[slot] = req.generated[-1]
                 self._active[slot] = True
@@ -550,6 +713,35 @@ class ServingEngine:
                     f"prefill_fail injected (step {step_idx}, "
                     f"rid {req.rid})"))
                 self.metrics.on_failed()
+                continue
+            if self.config.chunk_size:
+                # chunked prefill: hold the slot in PREFILLING and let the
+                # chunk phase below stream the prompt, chunk_size tokens
+                # per step. fresh=True spares the in-flight prefill from
+                # preemption while any decoded victim exists.
+                req.state = PREFILLING
+                req.fresh = True
+                tr = self._tracer
+                if req.resumed_from_swap:
+                    # a mid-prefill swap victim: its restored pages hold
+                    # prefilled_tokens of KV — chunking continues there,
+                    # no second prefill_start (the trace shows the swap)
+                    req.resumed_from_swap = False
+                    self.metrics.on_swap_in()
+                    if tr is not None:
+                        tr.event(req.rid, "swap_in",
+                                 tokens=req.prefilled_tokens)
+                        tr.event(req.rid, "resumed",
+                                 tokens=req.prefilled_tokens)
+                else:
+                    # cold or recompute-readmitted: start (over) from the
+                    # prefix-cache hit the admission just mapped
+                    req.prefilled_tokens = req.cached_tokens
+                    req.prefix_hit_tokens = req.cached_tokens
+                    if tr is not None:
+                        tr.event(req.rid, "prefill_start",
+                                 tokens=req.prompt_len - req.prefilled_tokens,
+                                 cached=req.cached_tokens, chunked=True)
                 continue
             with profiler.RecordEvent("serving::prefill"):
                 # prefix-cache hit: only the uncached tail is prefilled,
@@ -620,6 +812,37 @@ class ServingEngine:
             if self._maybe_finish(req, tok):
                 finished_now.append(req.rid)
 
+        # ---- chunked prefill phase: every PREFILLING request advances one
+        # chunk through the SAME prefill program, oldest admitted first,
+        # capped at the SLO controller's chunks-per-step limit. Decode for
+        # the running batch proceeds below in this same step — a whale
+        # prompt can no longer monopolize an iteration.
+        n_chunks = 0
+        if self.config.chunk_size:
+            limit = (self._slo.chunk_limit if self._slo is not None
+                     else self.config.max_batch)
+            prefilling = sorted(
+                (r for r in self.scheduler.running.values()
+                 if r.state == PREFILLING),
+                key=lambda r: r.admit_seq)
+            for req in prefilling[:limit]:
+                if inj is not None and \
+                        inj.hit("chunk_fail", step=step_idx, rid=req.rid):
+                    # before the chunk touches the pools: the partial
+                    # prefill's pages drain with the retirement, survivors
+                    # keep prefilling/decoding this very step
+                    self._retire(req, FAILED, InjectedFault(
+                        f"chunk_fail injected (step {step_idx}, "
+                        f"rid {req.rid})"))
+                    self.metrics.on_failed()
+                    continue
+                tok = self._prefill_chunk(req)
+                n_chunks += 1
+                if tok is not None:  # final chunk: first token sampled
+                    n_prefills += 1
+                    if self._maybe_finish(req, tok):
+                        finished_now.append(req.rid)
+
         if inj is not None:
             for slot in np.nonzero(self._active)[0]:
                 req = self.scheduler.running.get(int(slot))
@@ -686,7 +909,8 @@ class ServingEngine:
             self._step_stats = {
                 "step": step_idx, "t_start": t_start, "t_end": self.now(),
                 "admitted": len(admitted), "prefills": n_prefills,
-                "batch": n_active, "finished": len(finished_now),
+                "chunks": n_chunks, "batch": n_active,
+                "finished": len(finished_now),
                 "preemptions": self.scheduler.preemption_count - preempt0,
                 "queue_depth": self.scheduler.queue_depth,
                 "pages_in_use": cs["pages_in_use"]}

@@ -5,6 +5,11 @@ boundaries — named points, matched by (point, step index, request id):
 
 - ``prefill_fail``  a request's prefill fails: the request is retired FAILED
   (its admission undone, slot + pages freed) before the jitted prefill runs.
+- ``chunk_fail``    a CHUNKED prefill fails mid-stream: consulted before
+  every prefill chunk (``ServingConfig(chunk_size=)``), so a request can be
+  failed after some of its prompt KV is already resident — it retires
+  FAILED, its pages (including the partial prefill) drain, and the rest of
+  the batch keeps prefilling/decoding this very step.
 - ``decode_fail``   decoding a request fails: only that request is retired
   FAILED; the rest of the batch decodes normally this very step.
 - ``pool_exhausted`` simulates the page pool running dry before a decode
@@ -27,7 +32,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-POINTS = ("prefill_fail", "decode_fail", "pool_exhausted", "slow_step")
+POINTS = ("prefill_fail", "chunk_fail", "decode_fail", "pool_exhausted",
+          "slow_step")
 
 
 class InjectedFault(RuntimeError):

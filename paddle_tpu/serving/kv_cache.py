@@ -374,6 +374,13 @@ class PagedKVCache:
         admission (0 for a cold admission or a swap-restore)."""
         return self._slot_cached.get(slot, 0)
 
+    def cached_prefix_tokens(self, tokens) -> int:
+        """Tokens of ``tokens`` a fresh admission would serve from the
+        prefix cache right now (whole-page index matches). A read-only
+        probe — no refcounts move — used by the scheduler's degraded-mode
+        preference for warm waiters."""
+        return len(self.match_prefix(tokens)) * self.cfg.page_size
+
     def _unregister(self, page: int) -> None:
         key = self._page_key.pop(page, None)
         if key is not None:

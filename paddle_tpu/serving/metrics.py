@@ -37,6 +37,16 @@ Prefix-cache counters/gauges (pre-seeded like the resilience set):
 - serving_prefix_cow_copies    shared pages privatized before a write
 - serving_prefix_evictions     reusable pages reclaimed under pool pressure
 
+Chunked prefill + SLO admission (pre-seeded like everything else):
+
+- serving_prefill_chunks_total  prefill chunks executed (a full prefill
+                                in chunked mode is >= 1 chunk; unchunked
+                                prefills don't count here)
+- serving_chunk_limit           gauge: the SLO controller's current
+                                chunks-admitted-per-step (0 when no
+                                controller is installed)
+- serving_slo_throttles_total   controller windows that LOWERED the limit
+
 Analysis counters (paddle_tpu.analysis integration, pre-seeded):
 
 - serving_analysis_retraces_total    CompileGuard traces beyond the
@@ -97,6 +107,7 @@ PREFIX = "serving_"
 # PT003 flags any stat_add of a name missing here, PT008 any
 # stat_set/stat_max)
 _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
+           "prefill_chunks_total", "chunk_limit", "slo_throttles_total",
            "decode_steps", "preemptions_total",
            "rejected", "shed", "expired", "cancelled", "failed",
            "swap_outs", "swap_ins",
@@ -165,6 +176,20 @@ class ServingMetrics:
     def on_prefix_hit(self, tokens_saved: int) -> None:
         monitor.stat_add(PREFIX + "prefix_hits", 1)
         monitor.stat_add(PREFIX + "prefix_tokens_saved", int(tokens_saved))
+
+    def on_prefill_chunk(self, tokens: int) -> None:
+        """One chunk of a chunked prefill: the chunk counter plus the
+        FLOPs-weighted token count (the final chunk's ``on_prefill(0)``
+        then adds only the per-request prefill count)."""
+        monitor.stat_add(PREFIX + "prefill_chunks_total", 1)
+        monitor.stat_add(PREFIX + "prefill_tokens_total", int(tokens))
+
+    def on_chunk_limit(self, limit: int, throttled: bool = False) -> None:
+        """Mirror the SLO controller's chunks-per-step limit; a window
+        that lowered it also counts a throttle."""
+        monitor.stat_set(PREFIX + "chunk_limit", int(limit))
+        if throttled:
+            monitor.stat_add(PREFIX + "slo_throttles_total", 1)
 
     def on_prefix_miss(self) -> None:
         monitor.stat_add(PREFIX + "prefix_misses", 1)

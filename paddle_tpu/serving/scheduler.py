@@ -39,6 +39,17 @@ Admission-time validation guarantees every accepted request can finish with
 the pool to itself — the bound is checked COLD (reusable prefix pages may
 be evicted before the request runs), so the preempt-retry loop always
 terminates even when every cached page is gone.
+
+Chunked prefill (``ServingConfig(chunk_size=)``) adds one state between
+admission and decode: a PREFILLING request holds its slot and pages but is
+still streaming its prompt through the prefill step, ``chunk_size`` tokens
+per engine step. The scheduler treats it like RUNNING everywhere
+(eviction, deadlines, preemption); ``Request.prefilled_tokens`` tracks the
+progress — it survives a swap preemption (the swapped pages hold exactly
+those tokens' KV) and resets with a recompute preemption. Under SLO
+degradation the engine passes ``admit(prefer_cached=True)``, which relaxes
+strict FIFO to prefer waiters with warm prefix-cache hits (their uncached
+tail is cheap); preemption victims still always go first.
 """
 from __future__ import annotations
 
@@ -52,6 +63,10 @@ from .kv_cache import PagedKVCache
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 CANCELLED, FAILED, EXPIRED, SHED = "cancelled", "failed", "expired", "shed"
+# chunked prefill: admitted (slot + pages held) but still streaming its
+# prompt through the prefill step chunk_size tokens per step — not yet
+# decoding. Treated like RUNNING for eviction/deadlines/preemption.
+PREFILLING = "prefilling"
 
 _rid_counter = itertools.count()
 
@@ -76,6 +91,17 @@ class Request:        # generated dataclass __eq__ chokes on ndarray fields
     swap: object | None = None  # kv_cache.SwapHandle while swapped out
     fresh: bool = False  # prefilled/swap-resumed this step, no decode yet
     cached_tokens: int = 0  # prompt tokens served from the prefix cache
+    prefilled_tokens: int = 0  # prompt tokens with KV resident (chunked
+    # prefill progress; includes the cached prefix). Survives swap
+    # preemption — the restored pages hold exactly these tokens — and
+    # resets with a recompute preemption, whose pages are gone.
+    prefix_hit_tokens: int = 0  # the prefix-cache hit width at this
+    # prefill attempt's START — unlike cached_tokens (which a swap
+    # restore zeroes: restored pages are not an admission-time hit), it
+    # survives swap so the completion-time hit/miss accounting still
+    # credits the tokens the cache genuinely served.
+    resumed_from_swap: bool = False  # set by admit()'s swap-restore path,
+    # consumed (cleared) by the engine when it stamps swap_in/resumed
 
     @property
     def prompt_len(self) -> int:
@@ -122,6 +148,7 @@ class Scheduler:
         self._free_slots = list(range(max_batch - 1, -1, -1))  # pop() -> 0,1,..
         self._admit_seq = itertools.count()
         self.preemption_count = 0
+        self._head_skips = 0  # prefer_cached fairness counter
 
     # ------------------------------------------------------------ admission
     @property
@@ -174,18 +201,62 @@ class Scheduler:
         self.waiting.append(req)
         return shed
 
-    def admit(self, resume_only: bool = False) -> list[Request]:
+    #: consecutive times a warm waiter may jump the same queue head under
+    #: prefer_cached before the head is force-admitted next — bounds
+    #: starvation of a cold whale under sustained degraded warm traffic
+    HEAD_SKIP_LIMIT = 16
+
+    def _next_waiter(self, prefer_cached: bool, probe: dict) -> Request:
+        """The next admission candidate. FIFO head-of-line by default.
+        Under SLO degradation (``prefer_cached``) a WARM waiter — one
+        with a non-empty prefix-cache hit — may jump the queue: its
+        uncached tail costs almost none of the throttled chunk budget.
+        Cold waiters never reorder among themselves (no shortest-job
+        scheduling smuggled in), preemption victims at the front always
+        go first, a head skipped ``HEAD_SKIP_LIMIT`` consecutive times is
+        force-admitted (warm traffic cannot starve a cold whale
+        indefinitely), and strict FIFO returns the moment degradation
+        clears. ``probe`` memoizes the per-waiter index probes for the
+        duration of one admit() call."""
+        head = self.waiting[0]
+        if not prefer_cached or head.preemptions > 0:
+            return head
+        if self._head_skips >= self.HEAD_SKIP_LIMIT:
+            self._head_skips = 0
+            return head
+        best, best_key = head, None
+        for i, r in enumerate(self.waiting):
+            if r.rid not in probe:
+                probe[r.rid] = self.cache.cached_prefix_tokens(r.prompt)
+            cached = probe[r.rid]
+            if cached <= 0:  # cold: only eligible as the FIFO head
+                continue
+            key = (r.prompt_len - cached, i)
+            if best_key is None or key < best_key:
+                best, best_key = r, key
+        if best is not head:
+            self._head_skips += 1
+        else:
+            self._head_skips = 0
+        return best
+
+    def admit(self, resume_only: bool = False,
+              prefer_cached: bool = False) -> list[Request]:
         """Admit waiting requests FIFO into free slots while pages are
         available. Head-of-line: the first request that doesn't fit blocks
         the queue (no out-of-order admission — arrival order is the service
         order the tests pin). A swapped-out request needs its handle's pages
         restored rather than prompt pages allocated. ``resume_only`` admits
         only preemption victims (always queued at the front): the paused-
-        drain mode, where in-flight work resumes but newcomers wait."""
+        drain mode, where in-flight work resumes but newcomers wait.
+        ``prefer_cached`` (the SLO controller's degraded mode) relaxes
+        strict arrival order to prefer warm prefix-cache waiters — see
+        ``_next_waiter``."""
         admitted = []
         tr = self._tracer
+        probe: dict[int, int] = {}  # rid -> cached tokens, one admit() call
         while self.waiting and self._free_slots:
-            req = self.waiting[0]
+            req = self._next_waiter(prefer_cached, probe)
             if resume_only and req.preemptions == 0:
                 break
             slot = self._free_slots[-1]
@@ -194,6 +265,7 @@ class Scheduler:
                     break
                 req.swap = None
                 req.cached_tokens = 0
+                req.resumed_from_swap = True
             elif self.cache.admit(slot, req.prompt_len, tokens=req.prompt):
                 # admission cost is counted in UNIQUE pages: the cached
                 # whole-page prefix was mapped by refcount bump, so only
@@ -202,7 +274,10 @@ class Scheduler:
             else:
                 break
             self._free_slots.pop()
-            self.waiting.popleft()
+            if self.waiting[0] is req:
+                self.waiting.popleft()
+            else:  # prefer_cached picked past the head: identity removal
+                self.waiting.remove(req)
             req.state, req.slot = RUNNING, slot
             req.admit_seq = next(self._admit_seq)
             self.running[slot] = req
@@ -260,6 +335,8 @@ class Scheduler:
         else:
             self.cache.release(slot)
             req.generated.clear()
+            # a mid-prefill victim's chunk progress lived in those pages
+            req.prefilled_tokens = 0
         self._free_slots.append(slot)
         req.state, req.slot = WAITING, None
         req.preemptions += 1
@@ -272,7 +349,7 @@ class Scheduler:
         (cancel / deadline expiry / injected failure), freeing its slot,
         pages, and any swap handle. Returns the vacated slot (None when the
         request was waiting). The caller owns the terminal state."""
-        if req.state == RUNNING:
+        if req.state in (RUNNING, PREFILLING):
             slot = req.slot
             self.running.pop(slot)
             self.cache.release(slot)

@@ -277,8 +277,8 @@ def test_run_step_unknown_name_raises():
 
 def test_registry_names_are_stable():
     assert set(REGISTRY) == {"swap_gather", "swap_scatter", "cow_copy",
-                             "engine_prefill", "engine_decode",
-                             "tp8_decode"}
+                             "engine_prefill", "engine_prefill_chunk",
+                             "engine_decode", "tp8_decode"}
     assert REGISTRY["tp8_decode"].min_devices == 8
 
 

@@ -1,0 +1,607 @@
+"""Chunked prefill + SLO-adaptive admission (serving/engine.py chunk
+phase, serving/slo.py controller).
+
+The contracts pinned here, in the order the ISSUE names them:
+
+- **Bit-identical outputs** chunked vs. unchunked — greedy, across chunk
+  sizes (including one that doesn't divide the prompt), through a
+  prefix-cache hit, and under sampling (the per-request PRNG key scheme
+  is position-keyed, so chunk boundaries cannot shift any stream).
+- **Compile-count stability**: chunks pad into the existing bucket set,
+  so the bucket set stays the ONLY source of prefill compiles — no new
+  trace per chunk size or chunk count, pinned via ``compile_counts``.
+- **Mid-prefill preemption**: a recompute victim replays its chunks from
+  scratch, a swap victim resumes exactly where it left (chunk counters
+  prove no rework) — both bit-identical.
+- **SLO controller**: windowed-p99 AIMD over chunks-per-step, unit-level
+  goldens plus an engine integration on a ticking virtual clock; the
+  degraded mode's warm-prefix admission preference at scheduler level.
+- **Sync-free certification unchanged**: intermediate chunks never fetch
+  their token, so SyncTally == decode steps + COMPLETED prefills with
+  chunking and the controller both ON.
+- Obs: ``prefill_chunk`` lifecycle events, chunk spans in the Chrome
+  export, pre-seeded chunk gauges; hlocheck: the chunk-shaped call is a
+  registered, clean step.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import SyncTally
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.serving import (FaultInjector, PagedCacheConfig,
+                                PagedKVCache, Request, Scheduler,
+                                ServingConfig, ServingEngine, ServingMetrics,
+                                SLOConfig, SLOController)
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.chunked
+
+
+class TickClock:
+    """Strictly increasing engine clock: 10 ms per read — step durations
+    become a deterministic function of how much host work a step did."""
+
+    def __init__(self, tick=0.01):
+        self.t = 0.0
+        self.tick = tick
+
+    def __call__(self):
+        self.t += self.tick
+        return self.t
+
+
+def _toy_model(seed=11, max_seq_len=64):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=max_seq_len, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _reference(model, prompt, budget):
+    out = model.generate(Tensor(np.asarray(prompt)[None]),
+                         max_new_tokens=budget)
+    return np.asarray(out._value)[0]
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 97, (n,)).astype(np.int32) for n in lens]
+
+
+def _engine(model, chunk_size=8, **overrides):
+    kw = dict(max_batch=3, num_pages=32, page_size=4, max_prompt_len=24,
+              chunk_size=chunk_size)
+    kw.update(overrides)
+    return ServingEngine(model, ServingConfig(**kw))
+
+
+# ---------------------------------------------------------------- parity
+def test_greedy_parity_across_chunk_sizes_and_compile_stability():
+    model = _toy_model()
+    prompts = _prompts(0, (20, 4, 13, 7))
+    budgets = [6, 8, 5, 7]
+    refs = [_reference(model, p, b) for p, b in zip(prompts, budgets)]
+
+    traces = {}
+    for chunk in (0, 4, 8, 16):
+        engine = _engine(model, chunk_size=chunk)
+        rids = [engine.add_request(p, b)
+                for p, b in zip(prompts, budgets)]
+        outs = engine.run()
+        for i, rid in enumerate(rids):
+            np.testing.assert_array_equal(
+                refs[i], outs[rid],
+                err_msg=f"chunk_size={chunk}: request {i} diverged")
+        traces[chunk] = engine.compile_counts
+        assert engine.cache.allocator.pages_in_use == 0
+    # the bucket set is the only source of prefill compiles: chunk size 4
+    # and 8 route every chunk through bucket 8 (ONE prefill program);
+    # chunk 16 uses buckets {8, 16}; unchunked spans all three buckets of
+    # max_prompt_len=24. Chunking never ADDS a program.
+    assert traces[4] == {"prefill": 1, "decode": 1}
+    assert traces[8] == {"prefill": 1, "decode": 1}
+    assert traces[16] == {"prefill": 2, "decode": 1}
+    assert traces[0] == {"prefill": 3, "decode": 1}
+
+
+def test_no_new_trace_per_chunk_count():
+    # the SAME engine serves prompts needing 1, 2, and 3 chunks: trace
+    # count must not move after the first chunk compiles its bucket
+    model = _toy_model()
+    engine = _engine(model, chunk_size=8)
+    for n, budget in ((5, 3), (13, 3), (20, 3)):
+        rid = engine.add_request(_prompts(n, (n,))[0], budget)
+        engine.run()
+        assert engine.compile_counts == {"prefill": 1, "decode": 1}, \
+            f"prompt of {n} tokens retraced the prefill"
+
+
+def test_parity_on_prefix_cache_hit_chunked():
+    # the second request's cached whole-page prefix is mapped by refcount
+    # and only its uncached tail streams through chunks — bit-identical
+    model = _toy_model()
+    system = _prompts(2, (16,))[0]  # 4 whole pages of 4
+    tails = _prompts(3, (7, 5))
+    prompts = [np.concatenate([system, t]).astype(np.int32) for t in tails]
+    refs = [_reference(model, p, 5) for p in prompts]
+
+    engine = _engine(model, chunk_size=4)
+    outs = {}
+    for p in prompts:  # sequential so the second hits the first's pages
+        rid = engine.add_request(p, 5)
+        outs[rid] = engine.run()[rid]
+    for (rid, out), ref in zip(sorted(outs.items()), refs):
+        np.testing.assert_array_equal(ref, out)
+    snap = engine.metrics.snapshot()
+    assert snap["serving_prefix_hits"] == 1
+    assert snap["serving_prefix_tokens_saved"] >= 16
+    tr = engine.trace(max(outs))
+    # the hit request chunked ONLY its tail: ceil(7/4) = 2 chunks, each
+    # starting at or past the cached 16 tokens
+    chunk_starts = [e.arg("start") for e in tr.events
+                    if e.name == "prefill_chunk"]
+    assert len(chunk_starts) == 2 and min(chunk_starts) >= 16
+
+
+def test_sampling_parity_chunked_vs_unchunked():
+    # PRNG keys fold (seed, rid, token index) — pure position identity —
+    # so chunk boundaries cannot resample any request's stream
+    from paddle_tpu.serving import scheduler as sched_mod
+
+    model = _toy_model(seed=23)
+    prompts = _prompts(4, (18, 6, 11))
+    budgets = [7, 6, 5]
+
+    def drive(chunk):
+        sched_mod._rid_counter = itertools.count(7000)  # align rids
+        engine = _engine(model, chunk_size=chunk, do_sample=True,
+                         temperature=0.8, top_k=20, seed=5)
+        rids = [engine.add_request(p, b)
+                for p, b in zip(prompts, budgets)]
+        return rids, engine.run()
+
+    saved = sched_mod._rid_counter
+    try:
+        rids_a, outs_a = drive(0)
+        rids_b, outs_b = drive(8)
+    finally:
+        sched_mod._rid_counter = saved
+    assert rids_a == rids_b
+    for ra, rb in zip(rids_a, rids_b):
+        np.testing.assert_array_equal(
+            outs_a[ra], outs_b[rb],
+            err_msg="chunked prefill resampled a different stream")
+
+
+# ------------------------------------------------- mid-prefill preemption
+@pytest.mark.parametrize("mode", ["recompute", "swap"])
+def test_mid_prefill_preemption_parity(mode):
+    # pool_exhausted at step 1: the whale is mid-prefill (one chunk
+    # resident) and is the only candidate — it IS the victim. Recompute
+    # replays its chunks from scratch; swap restores the partial KV and
+    # continues exactly where it left (chunk events prove no rework).
+    model = _toy_model()
+    whale = _prompts(5, (20,))[0]
+    ref = _reference(model, whale, 6)
+    inj = FaultInjector().arm("pool_exhausted", step=1)
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=32, page_size=4, max_prompt_len=24,
+        chunk_size=8, preemption_mode=mode), fault_injector=inj)
+    rid = engine.add_request(whale, 6)
+    outs = engine.run()
+    np.testing.assert_array_equal(ref, outs[rid])
+    tr = engine.trace(rid)
+    assert tr.count("preempted") == 1
+    chunks = [(e.arg("start"), e.arg("tokens")) for e in tr.events
+              if e.name == "prefill_chunk"]
+    snap = engine.metrics.snapshot()
+    if mode == "recompute":
+        # 2 chunks before the preemption (steps 0-1), then a full replay
+        assert chunks == [(0, 8), (8, 8), (0, 8), (8, 8), (16, 4)]
+        assert tr.count("prefill_start") == 2  # the replay's second span
+    else:
+        # swap: the restored pages hold the first two chunks' KV — the
+        # prefill CONTINUES at token 16, no chunk is ever recomputed
+        assert chunks == [(0, 8), (8, 8), (16, 4)]
+        assert tr.count("prefill_start") == 1
+        assert tr.count("swap_out") == 1 and tr.count("swap_in") == 1
+        assert snap["serving_swap_ins"] == snap["serving_swap_outs"] == 1
+    assert snap["serving_prefill_chunks_total"] == len(chunks)
+    assert engine.cache.allocator.pages_in_use == 0
+
+
+def test_cancel_and_deadline_mid_prefill_drain_pages():
+    model = _toy_model()
+    whale1, whale2, short = _prompts(6, (20, 18, 4))
+
+    # cancel while PREFILLING
+    engine = _engine(model, chunk_size=4, max_batch=2)
+    r1 = engine.add_request(whale1, 4)
+    r2 = engine.add_request(short, 4)
+    engine.step()  # one chunk of the whale; the short one completes
+    assert engine.status(r1) == "prefilling"
+    assert engine.cancel(r1)
+    assert engine.status(r1) == "cancelled"
+    outs = engine.run()
+    assert set(outs) == {r2}
+    np.testing.assert_array_equal(_reference(model, short, 4), outs[r2])
+    assert engine.cache.allocator.pages_in_use == 0
+
+    # deadline expiry while PREFILLING (virtual clock)
+    class Held:
+        t = 0.0
+
+        def __call__(self):
+            return Held.t
+
+    engine2 = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=32, page_size=4, max_prompt_len=24,
+        chunk_size=4), clock=Held())
+    r3 = engine2.add_request(whale2, 4, deadline_s=5.0)
+    engine2.step()
+    assert engine2.status(r3) == "prefilling"
+    Held.t = 60.0
+    engine2.step()
+    assert engine2.status(r3) == "expired"
+    assert engine2.cache.allocator.pages_in_use == 0
+
+
+# ------------------------------------------------------- SLO controller
+def _fed_metrics(step_s=0.0, tpot_s=0.0, n=8):
+    m = ServingMetrics()
+    for _ in range(n):
+        if step_s:
+            m.hists["step_duration_s"].observe(step_s)
+        if tpot_s:
+            m.hists["tpot_s"].observe(tpot_s)
+    return m
+
+
+def test_slo_config_validation():
+    m = ServingMetrics()
+    with pytest.raises(ValueError, match="at least one"):
+        SLOController(SLOConfig(), m, 4)
+    with pytest.raises(ValueError, match="window_steps"):
+        SLOController(SLOConfig(ttft_p99_s=1.0, window_steps=0), m, 4)
+    with pytest.raises(ValueError, match="min_chunks"):
+        SLOController(SLOConfig(ttft_p99_s=1.0, min_chunks_per_step=0),
+                      m, 4)
+    with pytest.raises(ValueError, match="step_budget_frac"):
+        SLOController(SLOConfig(ttft_p99_s=1.0, step_budget_frac=0.0),
+                      m, 4)
+    with pytest.raises(ValueError, match="max_chunks"):
+        # a negative cap would slice prefilling[:-1] and hang the engine
+        SLOController(SLOConfig(ttft_p99_s=1.0, max_chunks_per_step=-1),
+                      m, 4)
+    model = _toy_model()
+    with pytest.raises(ValueError, match="chunk_size"):
+        ServingEngine(model, ServingConfig(
+            max_prompt_len=8, slo=SLOConfig(ttft_p99_s=1.0)))
+    with pytest.raises(ValueError, match="enable_tracing"):
+        ServingEngine(model, ServingConfig(
+            max_prompt_len=8, chunk_size=4, enable_tracing=False,
+            slo=SLOConfig(ttft_p99_s=1.0)))
+    with pytest.raises(ValueError, match="chunk_size"):
+        ServingEngine(model, ServingConfig(max_prompt_len=8, chunk_size=-1))
+    with pytest.raises(ValueError, match="chunk_size"):
+        ServingEngine(model, ServingConfig(max_prompt_len=8, chunk_size=9))
+
+
+def test_slo_controller_aimd_golden():
+    # breach -> multiplicative decrease (halve, floored); healthy ->
+    # additive increase (+1, capped); degraded holds until fully recovered
+    cfg = SLOConfig(tpot_p99_s=0.05, window_steps=4)
+    m = _fed_metrics()  # empty: the construction-time mark sees zeros
+    ctl = SLOController(cfg, m, default_max_chunks=8)
+    assert ctl.chunk_limit == 8 and not ctl.degraded
+
+    def window(tpot):
+        for _ in range(4):
+            if tpot:
+                m.hists["tpot_s"].observe(tpot)
+            change = ctl.on_step()
+        return change
+
+    assert window(0.2) == (8, 4) and ctl.degraded  # breach: halve
+    assert window(0.2) == (4, 2) and ctl.throttles == 2
+    assert window(0.2) == (2, 1)
+    assert window(0.2) is None          # floored at min: no change
+    assert ctl.chunk_limit == 1 and ctl.throttles == 3
+    assert "tpot_p99" in ctl.last_breach[0]
+    # recovery: +1 per clean window, degraded until back at the cap
+    assert window(0.001) == (1, 2) and ctl.degraded
+    for expect in (3, 4, 5, 6, 7):
+        assert window(None) == (expect - 1, expect) and ctl.degraded
+    assert window(None) == (7, 8) and not ctl.degraded
+    assert window(None) is None  # capped
+    # an empty window is NOT a breach and still recovers — but here we're
+    # at the cap already, so nothing moves
+    assert ctl.chunk_limit == 8 and ctl.evaluations == 12
+
+
+def test_slo_ttft_step_budget_breach():
+    # the TTFT target is enforced through its step-duration proxy:
+    # p99(step) must stay under ttft_p99_s * step_budget_frac
+    cfg = SLOConfig(ttft_p99_s=1.0, step_budget_frac=0.25, window_steps=2)
+    m = ServingMetrics()
+    ctl = SLOController(cfg, m, default_max_chunks=4)
+    m.hists["step_duration_s"].observe(0.2)  # under the 0.25 budget
+    m.hists["step_duration_s"].observe(0.2)
+    ctl.on_step()
+    assert ctl.on_step() is None and not ctl.degraded
+    m.hists["step_duration_s"].observe(0.6)  # over budget
+    m.hists["step_duration_s"].observe(0.6)
+    ctl.on_step()
+    assert ctl.on_step() == (4, 2) and ctl.degraded
+    assert "step_duration_p99" in ctl.last_breach[0]
+
+
+def test_slo_engine_integration_throttles_and_stays_correct():
+    # ticking clock: every step has a real (virtual) duration, and a
+    # microscopic TTFT target guarantees every window breaches — the
+    # controller must throttle to the floor while outputs stay exact
+    model = _toy_model()
+    prompts = _prompts(7, (20, 13, 4, 18))
+    budgets = [5, 6, 7, 4]
+    refs = [_reference(model, p, b) for p, b in zip(prompts, budgets)]
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=3, num_pages=32, page_size=4, max_prompt_len=24,
+        chunk_size=4, slo=SLOConfig(ttft_p99_s=1e-6, window_steps=2)),
+        clock=TickClock())
+    rids = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+    outs = engine.run()
+    for i, rid in enumerate(rids):
+        np.testing.assert_array_equal(refs[i], outs[rid])
+    snap = engine.metrics.snapshot()
+    assert engine._slo.chunk_limit == 1, "every window breached: floor"
+    assert snap["serving_chunk_limit"] == 1
+    assert snap["serving_slo_throttles_total"] >= 1
+    assert engine._slo.degraded
+
+
+def test_prefer_cached_admission_prefers_warm_waiters():
+    # scheduler-level: with prefer_cached the warm waiter (indexed prefix)
+    # jumps the cold head; default admit() stays strictly FIFO; a
+    # preemption victim at the front always outranks the preference
+    cache = PagedKVCache(PagedCacheConfig(
+        num_layers=1, num_heads=1, head_dim=4, num_pages=16, page_size=4,
+        max_batch=2, pages_per_seq=4))
+    warm_prefix = np.arange(8, dtype=np.int32)
+    assert cache.admit(0, 8, tokens=warm_prefix)
+    cache.register_prefix(0, warm_prefix)
+    cache.release(0)  # pages park reclaimable, stay indexed
+
+    cold = Request(prompt=np.arange(100, 108, dtype=np.int32),
+                   max_new_tokens=2)
+    warm = Request(prompt=np.concatenate(
+        [warm_prefix, np.asarray([9], np.int32)]), max_new_tokens=2)
+    s = Scheduler(cache, max_batch=1)
+    s.add(cold)
+    s.add(warm)
+    admitted = s.admit(prefer_cached=True)
+    assert [r.rid for r in admitted] == [warm.rid], \
+        "degraded mode must admit the warm-prefix waiter first"
+    assert warm.cached_tokens == 8
+    assert list(s.waiting) == [cold]  # identity-removed mid-queue
+
+    # default admission is untouched FIFO (slot 0 freed for the new
+    # scheduler — the warm pages park reclaimable and stay indexed)
+    cache.release(0)
+    s2 = Scheduler(cache, max_batch=1)
+    cold2 = Request(prompt=np.arange(200, 208, dtype=np.int32),
+                    max_new_tokens=2)
+    warm2 = Request(prompt=np.concatenate(
+        [warm_prefix, np.asarray([10], np.int32)]), max_new_tokens=2)
+    s2.add(cold2)
+    s2.add(warm2)
+    assert [r.rid for r in s2.admit()] == [cold2.rid]
+
+    # cold waiters NEVER reorder among themselves: prefer_cached is a
+    # warm-prefix preference, not shortest-job-first — a long cold head
+    # keeps its turn against a shorter cold newcomer
+    cache.release(0)
+    s_cold = Scheduler(cache, max_batch=1)
+    long_cold = Request(prompt=np.arange(400, 412, dtype=np.int32),
+                        max_new_tokens=2)
+    short_cold = Request(prompt=np.arange(500, 503, dtype=np.int32),
+                         max_new_tokens=2)
+    s_cold.add(long_cold)
+    s_cold.add(short_cold)
+    assert [r.rid for r in s_cold.admit(prefer_cached=True)] == \
+        [long_cold.rid]
+
+    # a front-queued victim outranks the warm preference
+    cache.release(0)
+    s3 = Scheduler(cache, max_batch=1)
+    victim = Request(prompt=np.arange(300, 306, dtype=np.int32),
+                     max_new_tokens=2)
+    victim.preemptions = 1
+    warm3 = Request(prompt=np.concatenate(
+        [warm_prefix, np.asarray([11], np.int32)]), max_new_tokens=2)
+    s3.waiting.appendleft(warm3)
+    warm3.state = "waiting"
+    s3.waiting.appendleft(victim)
+    victim.state = "waiting"
+    assert [r.rid for r in s3.admit(prefer_cached=True)] == [victim.rid]
+
+
+def test_prefer_cached_head_skip_bound():
+    # a cold head skipped HEAD_SKIP_LIMIT consecutive times by warm
+    # waiters is force-admitted next — sustained warm traffic cannot
+    # starve a cold whale indefinitely
+    cache = PagedKVCache(PagedCacheConfig(
+        num_layers=1, num_heads=1, head_dim=4, num_pages=16, page_size=4,
+        max_batch=1, pages_per_seq=4))
+    warm_prefix = np.arange(8, dtype=np.int32)
+    assert cache.admit(0, 8, tokens=warm_prefix)
+    cache.register_prefix(0, warm_prefix)
+    cache.release(0)
+    s = Scheduler(cache, max_batch=1)
+    cold_head = Request(prompt=np.arange(100, 112, dtype=np.int32),
+                        max_new_tokens=2)
+    s.add(cold_head)
+    skips = 0
+    for i in range(s.HEAD_SKIP_LIMIT + 1):
+        warm = Request(prompt=np.concatenate(
+            [warm_prefix, np.asarray([i], np.int32)]), max_new_tokens=2)
+        s.add(warm)
+        (req,) = s.admit(prefer_cached=True)
+        if req is cold_head:
+            break
+        skips += 1
+        assert req is warm
+        s.finish(warm)
+    else:
+        raise AssertionError("cold head never admitted")
+    assert skips == s.HEAD_SKIP_LIMIT
+
+
+def test_swap_mid_prefill_keeps_prefix_hit_accounting():
+    # the swap restore zeroes cached_tokens (restored pages are not an
+    # admission-time hit), but the prefill ATTEMPT's cache hit must still
+    # be credited when the final chunk completes
+    model = _toy_model()
+    system = _prompts(20, (16,))[0]  # 4 whole pages of 4
+    tail = _prompts(21, (8,))[0]
+    warm_whale = np.concatenate([system, tail]).astype(np.int32)
+    ref = _reference(model, warm_whale, 4)
+
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=32, page_size=4, max_prompt_len=24,
+        chunk_size=4, preemption_mode="swap"))
+    seed_rid = engine.add_request(system.copy(), 2)  # indexes the system pages
+    engine.run()
+    inj_free = engine.metrics.snapshot()
+    w = engine.add_request(warm_whale, 4)
+    engine.step()  # hit mapped, first tail chunk resident
+    assert engine.status(w) == "prefilling"
+    victim = engine._requests[w]
+    engine.scheduler.preempt(victim)  # swap out mid-prefill (the slot's
+    # engine arrays were never activated — nothing to clear)
+    outs = engine.run()
+    np.testing.assert_array_equal(ref, outs[w])
+    snap = engine.metrics.snapshot()
+    hits = snap["serving_prefix_hits"] - inj_free["serving_prefix_hits"]
+    saved = (snap["serving_prefix_tokens_saved"]
+             - inj_free["serving_prefix_tokens_saved"])
+    assert hits == 1, "the swap-interrupted attempt's hit must count"
+    assert saved == 16
+    tr = engine.trace(w)
+    assert tr.count("swap_in") == 1
+    # prefill_end reports only the tokens this attempt actually computed
+    assert tr.first("prefill_end").arg("tokens") == 8
+
+
+# --------------------------------------------------- certification + obs
+def test_sync_free_certification_unchanged_with_chunking_and_slo():
+    # the acceptance pin: intermediate chunks never fetch their sampled
+    # token, so the SyncTally formula is BYTE-IDENTICAL to the unchunked
+    # engine's — one fetch per decode step + one per COMPLETED prefill —
+    # with chunking and the controller both ON
+    model = _toy_model()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=3, num_pages=32, page_size=4, max_prompt_len=24,
+        chunk_size=8, slo=SLOConfig(ttft_p99_s=100.0, tpot_p99_s=100.0,
+                                    window_steps=4)), clock=TickClock())
+    for p, b in zip(_prompts(8, (20, 4, 13)), (5, 6, 4)):
+        engine.add_request(p, b)
+    pre = engine.metrics.snapshot()
+    with SyncTally() as tally:
+        engine.run()
+    snap = engine.metrics.snapshot()
+    fetches = int(snap["serving_decode_steps"] - pre["serving_decode_steps"]
+                  + snap["serving_prefills_total"]
+                  - pre["serving_prefills_total"])
+    assert tally.count == fetches, (tally.count, fetches,
+                                    tally.events[:20])
+    assert snap["serving_prefill_chunks_total"] > \
+        snap["serving_prefills_total"], "chunking really was on"
+    assert snap["serving_analysis_retraces_total"] == 0
+
+
+def test_chunk_gauges_pre_seeded_and_chunk_limit_published():
+    model = _toy_model()
+    engine = _engine(model, chunk_size=0)  # chunking off
+    snap = engine.metrics.snapshot()
+    for k in ("prefill_chunks_total", "chunk_limit",
+              "slo_throttles_total"):
+        assert snap["serving_" + k] == 0, k
+    engine2 = ServingEngine(model, ServingConfig(
+        max_batch=3, num_pages=32, page_size=4, max_prompt_len=24,
+        chunk_size=8, slo=SLOConfig(ttft_p99_s=10.0)))
+    # the controller's initial limit is published at construction
+    assert engine2.metrics.snapshot()["serving_chunk_limit"] == 3
+
+
+def test_chunk_trace_events_and_chrome_export():
+    model = _toy_model()
+    engine = _engine(model, chunk_size=8)
+    whale = _prompts(9, (20,))[0]
+    rid = engine.add_request(whale, 4)
+    engine.run()
+    tr = engine.trace(rid)
+    chunk_evs = [e for e in tr.events if e.name == "prefill_chunk"]
+    assert [(e.arg("start"), e.arg("tokens")) for e in chunk_evs] == \
+        [(0, 8), (8, 8), (16, 4)]
+    assert [e.arg("final") for e in chunk_evs] == [False, False, True]
+    assert chunk_evs[0].arg("bucket") == 8
+    s = tr.summary()
+    assert s["prefill_chunks"] == 3 and s["state"] == "finished"
+    # TTFT anchoring unchanged: first_token only exists after the final
+    # chunk, and prefill_time spans the whole chunked prefill
+    assert tr.first("first_token").t >= chunk_evs[-1].t
+    assert s["ttft"] is not None and s["prefill_time"] is not None
+    # chrome export: chunk spans + instants on the request track, chunk
+    # counts on the engine track
+    doc = engine.export_chrome_trace()
+    spans = [ev for ev in doc["traceEvents"]
+             if ev["ph"] == "X" and ev["name"] == "prefill_chunk"]
+    assert len(spans) == 3
+    instants = [ev for ev in doc["traceEvents"]
+                if ev["ph"] == "i" and ev["name"] == "prefill_chunk"]
+    assert len(instants) == 3 and instants[0]["args"]["tokens"] == 8
+    engine_steps = [ev for ev in doc["traceEvents"]
+                    if ev.get("cat") == "engine" and ev["ph"] == "X"]
+    assert sum(ev["args"]["chunks"] for ev in engine_steps) == 3
+
+
+def test_timeline_records_chunks_and_phase_mix():
+    model = _toy_model()
+    engine = _engine(model, chunk_size=8, max_batch=1)
+    engine.add_request(_prompts(10, (20,))[0], 3)
+    engine.step()
+    rec = engine.timeline.last
+    # first step: one chunk advanced, nothing decoding yet
+    assert rec.chunks == 1 and rec.prefills == 0 and rec.batch == 0
+    assert rec.phase_mix() == "prefill"
+    engine.step()
+    engine.step()  # final chunk completes -> first token + decode
+    rec = engine.timeline.last
+    assert rec.prefills == 1 and rec.batch == 1
+    assert rec.phase_mix() == "prefill+decode"
+
+
+def test_chunked_debug_checks_audits_chunk_program_clean():
+    # the chunk phase routes through the same _audit_step hook: under
+    # debug_checks the chunk bucket's compiled program is hlo-audited at
+    # its first trace, and the registered chunk-shaped step is clean
+    from paddle_tpu.analysis import hlocheck
+
+    model = _toy_model()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=32, page_size=4, max_prompt_len=24,
+        chunk_size=8, debug_checks=True))
+    rid = engine.add_request(_prompts(12, (20,))[0], 3)
+    engine.run()
+    assert set(engine.hlo_audits) == {"prefill[8]", "decode"}
+    for name, rep in engine.hlo_audits.items():
+        assert not rep.collectives and not rep.host_transfers, name
+        assert rep.aliased_leaves == rep.donated_leaves and not rep.unaliased
+
+    report = hlocheck.run_step("engine_prefill_chunk")
+    assert not report.collectives and not report.host_transfers

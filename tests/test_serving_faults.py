@@ -305,6 +305,37 @@ def test_prefill_fail_undoes_admission_only_for_the_victim():
     assert engine.cache.allocator.pages_in_use == 0
 
 
+def test_chunk_fail_retires_mid_prefill_and_survivors_keep_serving():
+    # chunked prefill: the whale fails on its SECOND chunk (step 1), after
+    # one chunk of its prompt KV is already resident — the partial prefill
+    # must drain with the retirement while the short survivor (admitted
+    # the same step, decoding by then) finishes with exact parity
+    model = _toy_model()
+    whale, short = _prompts(15, (20, 5))
+    inj = FaultInjector()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=24, page_size=4, max_prompt_len=24,
+        chunk_size=8), fault_injector=inj)
+    r1 = engine.add_request(whale, 6)
+    r2 = engine.add_request(short, 4)
+    inj.arm("chunk_fail", step=1, rid=r1)
+    outs = engine.run()
+    assert set(outs) == {r2}, "only the non-faulted request finishes"
+    np.testing.assert_array_equal(_reference(model, short, 4), outs[r2])
+    assert engine.status(r1) == "failed"
+    err = engine.request(r1).error
+    assert isinstance(err, InjectedFault) and "chunk_fail" in str(err)
+    assert inj.fired == [("chunk_fail", 1, r1)]
+    snap = engine.metrics.snapshot()
+    assert snap["serving_failed"] == 1
+    # exactly one chunk ran before the fault; no prefill ever completed
+    # for the whale (prefills_total counts only the survivor's)
+    assert snap["serving_prefill_chunks_total"] == 2  # whale's 1st + short
+    assert snap["serving_prefills_total"] == 1
+    assert engine.cache.allocator.pages_in_use == 0, \
+        "a mid-prefill failure must not leak the partial prompt's pages"
+
+
 def test_pool_exhausted_injection_forces_preemption():
     # the pool is actually ample — the injector simulates it running dry,
     # and the victim-policy preemption must still converge to full parity
