@@ -17,7 +17,7 @@ Turns the serving stack's hand-pinned invariants into enforced checks:
   and flops/peak-HBM roll-up. ``python -m paddle_tpu.analysis --hlo``
   sweeps the registered steps (including the 8-device ``shard_map``
   tensor-parallel certification the sharded-serving arc gates on).
-- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT009 distilled from bugs
+- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT010 distilled from bugs
   this repo shipped, with ``# lint: disable=PTxxx`` pragmas and allowlists.
   ``python -m paddle_tpu.analysis paddle_tpu/`` must stay clean (a tier-1
   test enforces zero findings).

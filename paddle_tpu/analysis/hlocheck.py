@@ -32,8 +32,11 @@ artifact, the way ``tools/aot_shard_proof.py`` already reads
   bench JSON.
 
 :data:`REGISTRY` names the repo's auditable steps (the serving engine's
-prefill/decode, the paged cache's swap/COW jits, and the toy 8-device
-``shard_map`` tensor-parallel step that gates the sharded-serving arc);
+prefill/chunk/decode, the paged cache's swap/COW jits, the toy 8-device
+``shard_map`` step that gated the sharded-serving arc, and the REAL
+tensor-parallel serving steps it grew into — ``tp2_engine_*`` + the
+per-shard cache movers, certified against the budgets the engine itself
+declares);
 ``python -m paddle_tpu.analysis --hlo [--step NAME]`` sweeps them with
 clean exit codes. ``ServingConfig(debug_checks=True)`` audits every engine
 step once per compiled program (per prefill bucket + decode) at its first
@@ -302,6 +305,22 @@ class HloAuditReport:
 
 # -------------------------------------------------------------------- audit
 def _leaf_nbytes(leaf) -> int:
+    """Per-DEVICE bytes of one argument leaf: for a sharded array, the
+    shard each device actually holds — XLA's ``memory_analysis`` numbers
+    (incl. ``alias_size_in_bytes``, which the donation check compares
+    against) are all per-device, so a donated heads-sharded KV pool must
+    be costed at pool/tp bytes or the aliasing check would demand more
+    aliased bytes than any device owns."""
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None:
+        try:
+            shape = sharding.shard_shape(leaf.shape)
+            n = leaf.dtype.itemsize
+            for d in shape:
+                n *= d
+            return int(n)
+        except Exception:  # noqa: BLE001 — fall back to the global size
+            pass
     n = getattr(leaf, "nbytes", None)
     if n is not None:
         return int(n)
@@ -414,7 +433,12 @@ class StepSpec:
     min_devices: int = 1
 
 
-def _build_engine_step(which: str):
+def _build_engine_step(which: str, tensor_parallel: int = 1):
+    """Engine-step audit targets. ``tensor_parallel=2`` builds the SAME
+    step on a 2-device mesh (Megatron weight + KV-pool shards via
+    serving/tp.py shard_map) with the budget the engine itself declares:
+    2 all-reduces per block + 1 for the logits, byte-capped — the
+    single-chip variants certify at SINGLE_CHIP (all zeros)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -429,46 +453,56 @@ def _build_engine_step(which: str):
         max_seq_len=32, dropout=0.0))
     model.eval()
     eng = ServingEngine(model, ServingConfig(
-        max_batch=2, num_pages=16, page_size=4, max_prompt_len=8))
-    if which == "prefill":
+        max_batch=2, num_pages=16, page_size=4, max_prompt_len=8,
+        tensor_parallel=tensor_parallel))
+    if which in ("prefill", "prefill_chunk"):
         bucket = eng.prefill_buckets[0]
         padded = np.zeros(bucket, np.int32)
-        padded[:3] = (5, 7, 11)
+        if which == "prefill":
+            padded[:3] = (5, 7, 11)
+            tail, ctx0 = 3, 0
+        else:
+            # chunked prefill: a MID-PROMPT chunk — queries enter at
+            # ctx0 > 0 against already-resident KV, through the SAME
+            # prefill program shape (chunk padded to its bucket). Audited
+            # separately so the registry certifies the exact call
+            # signature the chunk phase dispatches, not just the cold
+            # ctx0 = 0 case.
+            padded[:4] = (3, 5, 7, 11)
+            tail, ctx0 = 4, 4
         args = (eng._p, eng.cache.pools, jnp.asarray(padded),
-                jnp.asarray(3, jnp.int32), jnp.asarray(0, jnp.int32),
+                jnp.asarray(tail, jnp.int32), jnp.asarray(ctx0, jnp.int32),
                 jnp.asarray(eng.cache.page_table[0]),
                 jnp.asarray(1, jnp.int32))
-        return eng._prefill_jit, args, None, SINGLE_CHIP
-    if which == "prefill_chunk":
-        # chunked prefill: a MID-PROMPT chunk — queries enter at ctx0 > 0
-        # against already-resident KV, through the SAME prefill program
-        # shape (chunk padded to its bucket). Audited separately so the
-        # registry certifies the exact call signature the chunk phase
-        # dispatches, not just the cold ctx0 = 0 case.
-        bucket = eng.prefill_buckets[0]
-        padded = np.zeros(bucket, np.int32)
-        padded[:4] = (3, 5, 7, 11)
-        args = (eng._p, eng.cache.pools, jnp.asarray(padded),
-                jnp.asarray(4, jnp.int32), jnp.asarray(4, jnp.int32),
-                jnp.asarray(eng.cache.page_table[0]),
-                jnp.asarray(1, jnp.int32))
-        return eng._prefill_jit, args, None, SINGLE_CHIP
+        return (eng._prefill_jit, args, None,
+                eng._step_budget(f"prefill[{bucket}]"))
     args = (eng._p, eng.cache.pools, jnp.asarray(eng.cache.page_table),
             jnp.asarray(eng._ctx), jnp.asarray(eng._last_tok),
             jnp.asarray(eng._active), jnp.asarray(eng._rids),
             jnp.asarray(eng._gen))
-    return eng._decode_jit, args, None, SINGLE_CHIP
+    return eng._decode_jit, args, None, eng._step_budget("decode")
 
 
-def _build_cache_step(which: str):
+def _build_cache_step(which: str, tensor_parallel: int = 1):
+    """Cache-mover audit targets. ``tensor_parallel=2`` shards the pools'
+    heads axis and runs the mover per-shard (shard_map over replicated
+    page indices) — pure local data movement, so the declared budget
+    stays ZERO collectives either way."""
     import jax.numpy as jnp
     import numpy as np
 
     from ..serving.kv_cache import PagedCacheConfig, PagedKVCache
 
+    tp = None
+    if tensor_parallel > 1:
+        from ..serving.tp import TPContext
+        from ..text.gpt import GPTConfig
+
+        tp = TPContext(tensor_parallel, GPTConfig(
+            vocab_size=97, hidden_size=8, num_layers=2, num_heads=2))
     cache = PagedKVCache(PagedCacheConfig(
         num_layers=2, num_heads=2, head_dim=4, num_pages=8, page_size=4,
-        max_batch=2, pages_per_seq=4))
+        max_batch=2, pages_per_seq=4, tp=tp))
     cfg = cache.cfg
     idx = jnp.asarray(np.zeros(cfg.pages_per_seq, np.int32))
     if which == "swap_gather":
@@ -537,6 +571,37 @@ REGISTRY: dict[str, StepSpec] = {s.name: s for s in (
     StepSpec("tp8_decode", "toy tensor-parallel shard_map step on an "
              "8-device mesh: budget = exactly one all-reduce",
              _build_tp8_decode, min_devices=8),
+    # ---- tensor-parallel serving (ServingConfig(tensor_parallel=2) on a
+    # 2-device mesh): the REAL sharded engine steps, certified against the
+    # budgets the engine itself declares — 2 all-reduces per block + 1 for
+    # the logits, byte-capped (serving/tp.py step_budget); the per-shard
+    # cache movers certify at ZERO collectives
+    StepSpec("tp2_engine_prefill", "TENSOR-PARALLEL serving prefill step "
+             "(tp=2 Megatron shards, budget 2L+1 all-reduces)",
+             lambda: _build_engine_step("prefill", tensor_parallel=2),
+             min_devices=2),
+    StepSpec("tp2_engine_prefill_chunk", "TENSOR-PARALLEL chunked prefill "
+             "step: mid-prompt chunk at ctx0 > 0 through the same sharded "
+             "program (budget 2L+1 all-reduces)",
+             lambda: _build_engine_step("prefill_chunk",
+                                        tensor_parallel=2),
+             min_devices=2),
+    StepSpec("tp2_engine_decode", "TENSOR-PARALLEL serving decode step, "
+             "whole batch (budget 2L+1 all-reduces)",
+             lambda: _build_engine_step("decode", tensor_parallel=2),
+             min_devices=2),
+    StepSpec("tp2_swap_gather", "per-shard swap-out gather over the "
+             "heads-sharded pools (budget: zero collectives)",
+             lambda: _build_cache_step("swap_gather", tensor_parallel=2),
+             min_devices=2),
+    StepSpec("tp2_swap_scatter", "per-shard swap-in scatter (pools "
+             "donated; budget: zero collectives)",
+             lambda: _build_cache_step("swap_scatter", tensor_parallel=2),
+             min_devices=2),
+    StepSpec("tp2_cow_copy", "per-shard COW page copy (pools donated; "
+             "budget: zero collectives)",
+             lambda: _build_cache_step("cow_copy", tensor_parallel=2),
+             min_devices=2),
 )}
 
 

@@ -40,6 +40,15 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   the compile budgets, the retrace explainer, AND the hlocheck
   compiled-artifact audits (collective census, aliasing verification,
   HBM/flops roll-up) — exactly the steps those exist to certify.
+- PT010 — ``shard_map`` in ``serving/`` (the attribute, or any
+  ``from jax.experimental.shard_map import shard_map`` respelling):
+  a sharded step whose wrapped computation is not registered with a
+  declared ``CollectiveBudget`` in the hlocheck registry can acquire
+  implicit resharding collectives no budget ever audits — the exact
+  regression the tensor-parallel serving arc certifies against. The one
+  sanctioned entry point (serving/tp.py, whose wrapped steps ARE
+  registered: tp2_engine_* + the per-shard cache movers) carries the
+  pragma.
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
@@ -71,7 +80,7 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # would defeat the fixture. Everything else should use pragmas, which are
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
-    "lint_fixtures": {f"PT00{i}" for i in range(1, 10)},
+    "lint_fixtures": {f"PT00{i}" for i in range(1, 10)} | {"PT010"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -359,6 +368,32 @@ def _pt009(tree, path):
                    "is invisible to the jax.jit attribute check. " + msg)
 
 
+def _pt010(tree, path):
+    """shard_map in serving/ outside the registered tensor-parallel
+    wrapper. Flags the ENTRY POINTS — any ``.shard_map`` attribute access
+    and any ``from ... import shard_map`` (aliased or not) — so every
+    respelling is caught where the name enters the module; a sanctioned
+    use (a wrapper whose wrapped steps are registered with declared
+    CollectiveBudgets in the hlocheck registry) pragma-suppresses its one
+    import/attribute line."""
+    msg = ("shard_map in serving/ builds a sharded step the hlocheck "
+           "registry doesn't know: without a registered, declared "
+           "CollectiveBudget the compiled program can acquire implicit "
+           "resharding collectives no audit ever counts. Route sharding "
+           "through serving/tp.py (whose wrapped steps are registered as "
+           "tp2_engine_* / the per-shard cache movers), or register the "
+           "step's budget and pragma-suppress this entry point.")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "shard_map":
+            yield (node.lineno, msg)
+        elif isinstance(node, ast.ImportFrom) and (
+                (node.module or "").endswith("shard_map")
+                or any(a.name == "shard_map" for a in node.names)):
+            yield (node.lineno,
+                   "importing shard_map bare makes every call site "
+                   "invisible to the attribute check. " + msg)
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -384,6 +419,9 @@ RULES: dict[str, Rule] = {r.code: r for r in (
          "pre-seeding", _pt008),
     Rule("PT009", "raw jax.jit in serving/ not routed through a "
          "CompileGuard", _pt009, scope="serving"),
+    Rule("PT010", "shard_map in serving/ whose wrapped step is not "
+         "registered with a CollectiveBudget in the hlocheck registry",
+         _pt010, scope="serving"),
 )}
 
 
@@ -449,7 +487,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Repo linter: invariants this repo shipped bugs "
-                    "against, enforced (rules PT001-PT009).")
+                    "against, enforced (rules PT001-PT010).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the installed "
                              "paddle_tpu package plus the repo's --include "

@@ -14,11 +14,14 @@ and a deterministic fault-injection harness (serving/faults.py).
 Performance layer: automatic prefix caching (refcounted cross-request page
 sharing with an exact content index, copy-on-write, and LRU eviction of
 reclaimable pages — only the uncached prompt tail is prefilled),
-multi-bucket prefill (one compile per power-of-two pad bucket), and
-chunked prefill with SLO-adaptive admission (``chunk_size=`` interleaves
+multi-bucket prefill (one compile per power-of-two pad bucket), chunked
+prefill with SLO-adaptive admission (``chunk_size=`` interleaves
 long-prompt prefill with decode through the same compiled programs;
 ``slo=SLOConfig(...)`` adapts chunks-per-step to TTFT/TPOT p99 targets
-off the obs histograms — serving/slo.py).
+off the obs histograms — serving/slo.py), and tensor-parallel sharded
+serving (``tensor_parallel=N`` Megatron-shards the weights + the paged
+KV pool's heads axis across an N-device mesh via shard_map — serving/
+tp.py — with every step's collectives declared and hlocheck-certified).
 
 Analysis layer (paddle_tpu.analysis): every jitted step sits behind a
 ``CompileGuard`` (trace counting, compile budgets, retrace explanations,

@@ -41,6 +41,18 @@ decode step plus one per COMPLETED prefill. Outputs are bit-identical
 chunked or not — same KV bytes, same last-token logits (the PR 3
 exact-zero ragged masking argument, applied inductively per chunk).
 
+Tensor-parallel serving (``ServingConfig(tensor_parallel=N)``): the
+weights shard Megatron-style and the paged KV pool shards its heads axis
+across an N-device mesh (serving/tp.py), and the SAME step bodies run
+inside ``shard_map`` — compiled once per bucket like single-chip, with
+exactly ``2 * num_layers + 1`` all-reduces per step (row-parallel
+out_proj + fc2 per block, one for the logits), declared as a
+``CollectiveBudget`` and certified by the hlocheck audit under
+``debug_checks``. Outputs are bit-identical TP=N vs TP=1 and every
+invariant below — compile counts, the sync-free certification formula,
+prefix-cache/COW/eviction on logical page ids, per-shard swap — is
+sharding-blind.
+
 On top, ``ServingConfig(slo=SLOConfig(ttft_p99_s=, tpot_p99_s=))`` installs
 an SLO-adaptive admission controller (serving/slo.py): each step boundary
 it reads the streaming ``serving_step_duration_s`` / ``serving_tpot_s``
@@ -156,6 +168,13 @@ class ServingConfig:
     shed_policy: str = "reject"  # "reject" | "shed-oldest" when queue full
     preemption_mode: str = "recompute"  # "recompute" | "swap"
     enable_prefix_caching: bool = True  # cross-request KV page sharing
+    tensor_parallel: int = 1  # Megatron-shard the weights + the paged KV
+    # pool (heads axis) across an N-device mesh via shard_map (serving/
+    # tp.py): the prefill buckets, chunk phase, and decode still compile
+    # ONCE each as sharded programs with exactly 2*num_layers + 1
+    # all-reduces per step (row-parallel out_proj + fc2 per block, one
+    # for the logits) — declared as a CollectiveBudget and certified by
+    # the hlocheck audit under debug_checks. 1 = single-chip serving.
     chunk_size: int = 0  # prefill tokens per step per request; 0 = whole
     # tail in one pass (chunking off). Chunks ride the SAME prefill jit
     # (ctx_lens = tokens already resident) padded into the existing
@@ -214,6 +233,15 @@ class ServingEngine:
                 "the SLO controller reads the obs step/tpot histograms, "
                 "which enable_tracing feeds — it cannot run with tracing "
                 "disabled (it would silently never throttle)")
+        if cfg.tensor_parallel < 1:
+            raise ValueError(f"tensor_parallel {cfg.tensor_parallel} < 1")
+        if cfg.tensor_parallel > 1:
+            # mesh + Megatron shard specs + shard_map wrappers; validates
+            # divisibility (heads/hidden/ffn) and the visible device count
+            from .tp import TPContext
+            self._tp = TPContext(cfg.tensor_parallel, mc)
+        else:
+            self._tp = None
         pages_per_seq = cfg.pages_per_seq or \
             -(-mc.max_seq_len // cfg.page_size)
         self.cache = PagedKVCache(PagedCacheConfig(
@@ -223,11 +251,18 @@ class ServingEngine:
             max_batch=cfg.max_batch, pages_per_seq=pages_per_seq,
             dtype=model.gpt.wte.weight._value.dtype,
             enable_prefix_caching=cfg.enable_prefix_caching,
-            debug_checks=cfg.debug_checks))
+            debug_checks=cfg.debug_checks, tp=self._tp))
         self.prefill_buckets = prefill_buckets(cfg.max_prompt_len)
         self.metrics = ServingMetrics()
+        self.metrics.on_tp_degree(cfg.tensor_parallel)
         params, _ = model.functional_state()
         self._p = {k: v._value for k, v in params.items()}
+        if self._tp is not None:
+            # Megatron placement: qkv/fc1 column-split, out_proj/fc2
+            # row-split (bias on device 0 only — psum adds it exactly
+            # once), everything else replicated; recorded shard specs feed
+            # the step wrappers below
+            self._p = self._tp.shard_params(self._p)
         self._clock = clock or time.monotonic
         self._skew = 0.0  # virtual seconds injected by slow_step faults
         # obs layer: request tracer + step timeline run off the engine
@@ -284,12 +319,23 @@ class ServingEngine:
         # prefill groups by pad-bucket shape: EACH bucket compiles at most
         # once, so a same-bucket retrace (e.g. dtype drift) can't hide in
         # the headroom of buckets this workload never used
+        prefill_impl, decode_impl = self._prefill_impl, self._decode_impl
+        if self._tp is not None:
+            # sharded programs: the SAME step bodies run inside shard_map
+            # (params/pools under their shard specs, host operands
+            # replicated, model psums enabled for the trace) — the guards
+            # wrap the sharded callables, so compile counts, budgets, and
+            # the retrace/donation audits are identical to single-chip
+            prefill_impl = self._tp.wrap_step(prefill_impl,
+                                              mc.num_layers, n_rest=5)
+            decode_impl = self._tp.wrap_step(decode_impl,
+                                             mc.num_layers, n_rest=6)
         self._prefill_jit = CompileGuard(
-            self._prefill_impl, "prefill", donate_argnums=(1,),
+            prefill_impl, "prefill", donate_argnums=(1,),
             budget=len(self.prefill_buckets), strict=cfg.debug_checks,
             group_by=lambda *a: tuple(a[2].shape))
         self._decode_jit = CompileGuard(
-            self._decode_impl, "decode", donate_argnums=(1,),
+            decode_impl, "decode", donate_argnums=(1,),
             budget=1, strict=cfg.debug_checks)
         self.guards = {"prefill": self._prefill_jit,
                        "decode": self._decode_jit}
@@ -989,12 +1035,41 @@ class ServingEngine:
         if label in self._hlo_audits:
             return
         report = hlocheck.audit_guard(guard, args, name=label)
-        report.enforce(hlocheck.SINGLE_CHIP)
+        report.enforce(self._step_budget(label))
         self._hlo_audits[label] = report
         self.metrics.on_hlo_audit(
             collective_ops=len(report.collectives),
             host_transfers=len(report.host_transfers),
             peak_hbm_bytes=report.peak_bytes, flops=report.flops)
+        if self._tp is not None:
+            # the EQuARX baseline gauges, fed straight from the census:
+            # collective ops per step and collective bytes per token this
+            # program advances (decode: max_batch tokens; prefill[N]: up
+            # to N prompt tokens)
+            b, s = self._step_shape(label)
+            self.metrics.on_tp_audit(
+                collective_ops=len(report.collectives),
+                bytes_per_token=report.collective_bytes / (b * s))
+
+    def _step_shape(self, label: str) -> tuple[int, int]:
+        """(batch, seq) of a compiled engine program, from its audit label
+        — ``decode`` runs the whole batch one token wide, ``prefill[N]``
+        one request N padded tokens wide."""
+        if label == "decode":
+            return self.config.max_batch, 1
+        return 1, int(label[label.index("[") + 1:-1])
+
+    def _step_budget(self, label: str) -> hlocheck.CollectiveBudget:
+        """The per-program hlocheck budget ``debug_checks`` enforces:
+        single-chip steps certify at the all-zero SINGLE_CHIP budget;
+        tensor-parallel steps at exactly the collectives their Megatron
+        partitioning implies (2 all-reduces per block + 1 for the logits,
+        byte-capped — serving/tp.py)."""
+        if self._tp is None:
+            return hlocheck.SINGLE_CHIP
+        b, s = self._step_shape(label)
+        itemsize = np.dtype(self.model.gpt.wte.weight._value.dtype).itemsize
+        return self._tp.step_budget(batch=b, seq=s, itemsize=itemsize)
 
     @property
     def hlo_audits(self) -> dict:

@@ -194,6 +194,11 @@ class PagedCacheConfig:
     dtype: object = None  # jnp dtype; None -> float32
     enable_prefix_caching: bool = True  # cross-request page sharing
     debug_checks: bool = False  # strict CompileGuards on the swap/COW jits
+    tp: object = None  # serving.tp.TPContext: pools sharded on the heads
+    # axis across its mesh, swap/COW jits wrapped to run per-shard. None =
+    # single-chip. The allocator, page tables, and prefix index are
+    # host-side and operate on LOGICAL page ids — sharding never touches
+    # them.
 
     @property
     def max_tokens_per_seq(self) -> int:
@@ -224,6 +229,13 @@ class PagedKVCache:
         self.cfg = cfg
         self.allocator = PageAllocator(cfg.num_pages)
         self.pools = init_pools(cfg)
+        if cfg.tp is not None:
+            # tensor parallelism shards the pools' heads axis across the
+            # mesh: each device owns [num_pages, page_size, heads/tp,
+            # head_dim] per layer — the page ids in the (host-side) table
+            # stay logical, so every allocator/prefix-cache/COW decision
+            # below is sharding-agnostic
+            self.pools = cfg.tp.shard_pools(self.pools)
         self.page_table = np.full((cfg.max_batch, cfg.pages_per_seq),
                                   NULL_PAGE, np.int32)
         self._slot_pages: dict[int, list[int]] = {}
@@ -282,6 +294,15 @@ class PagedKVCache:
         # donation each .at[] write would copy the ENTIRE pool and hold
         # two pools live. Budget 1 each: the padded fixed shapes mean a
         # second trace is always a bug.
+        if self.cfg.tp is not None:
+            # per-shard data movement: each device gathers/scatters/copies
+            # its own heads slice; the replicated page-index operands make
+            # it collective-free (certified by the tp2_swap/cow hlocheck
+            # registry steps)
+            nl = self.cfg.num_layers
+            gather = self.cfg.tp.wrap_cache(gather, "gather", nl)
+            scatter = self.cfg.tp.wrap_cache(scatter, "scatter", nl)
+            copy_page = self.cfg.tp.wrap_cache(copy_page, "copy", nl)
         strict = self.cfg.debug_checks
         self._gather_jit = CompileGuard(  # lint: disable=PT006
             gather, "swap_gather", budget=1, strict=strict)

@@ -68,6 +68,18 @@ compiled program — per prefill bucket + decode; pre-seeded):
                                arena + outputs - aliased) over programs
 - serving_hlo_flops_per_step   max XLA cost_analysis flops over programs
 
+Tensor-parallel serving (pre-seeded; fed from the hlocheck census at
+each sharded program's first-trace audit — the EQuARX baseline numbers):
+
+- serving_tp_degree                      gauge: ServingConfig
+                                         tensor_parallel (1 = single
+                                         chip), set at construction
+- serving_tp_collective_ops_per_step     max collective ops in one
+                                         audited sharded program
+                                         (2*layers + 1 by declaration)
+- serving_tp_collective_bytes_per_token  max collective payload bytes
+                                         per token a program advances
+
 Latency histograms (paddle_tpu.obs integration): fixed-bucket streaming
 histograms — bounded memory, O(log buckets) per observation — feed the
 percentile gauges ``serving_<hist>_p50/p90/p99`` (+ ``_count``) for:
@@ -117,6 +129,8 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "analysis_retraces_total", "analysis_host_syncs_total",
            "hlo_collective_ops", "hlo_host_transfers",
            "hlo_peak_hbm_bytes", "hlo_flops_per_step",
+           "tp_degree", "tp_collective_ops_per_step",
+           "tp_collective_bytes_per_token",
            "tokens_per_sec", "queue_depth", "active_requests",
            "page_pool_used", "page_utilization",
            "queue_depth_peak", "page_pool_peak")
@@ -254,6 +268,24 @@ class ServingMetrics:
         guards own the monotonic counts)."""
         monitor.stat_set(PREFIX + "analysis_retraces_total", retraces)
         monitor.stat_set(PREFIX + "analysis_host_syncs_total", host_syncs)
+
+    def on_tp_degree(self, degree: int) -> None:
+        """The engine's tensor-parallel degree (1 = single-chip), set at
+        construction so dashboards can segment every other gauge by it."""
+        monitor.stat_set(PREFIX + "tp_degree", int(degree))
+
+    def on_tp_audit(self, collective_ops: int,
+                    bytes_per_token: float) -> None:
+        """One tensor-parallel hlocheck audit (debug_checks, once per
+        compiled program): the per-step collective op count and the
+        collective payload bytes per token the program advances — the
+        baseline numbers EQuARX-style quantized collectives get measured
+        against. stat_max keeps the steady-state (decode) worst case
+        across programs."""
+        monitor.stat_max(PREFIX + "tp_collective_ops_per_step",
+                         int(collective_ops))
+        monitor.stat_max(PREFIX + "tp_collective_bytes_per_token",
+                         float(bytes_per_token))
 
     def on_hlo_audit(self, collective_ops: int, host_transfers: int,
                      peak_hbm_bytes: int, flops: float) -> None:

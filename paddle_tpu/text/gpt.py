@@ -10,11 +10,73 @@ ColumnParallelLinear/RowParallelLinear program surgery.
 from __future__ import annotations
 
 import math
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 
 from .. import nn
 from ..core.tensor import Tensor
 from ..nn import functional as F
+
+# --------------------------------------------------------- tensor parallelism
+# The serving engine's sharded steps (serving/tp.py) run this model INSIDE a
+# shard_map over a named mesh axis: every device holds a Megatron shard of
+# the weights (qkv/fc1 column-split, out_proj/fc2 row-split) and of the
+# paged KV pool (heads axis), and the row-parallel partial sums must be
+# psum-reduced back to the replicated residual stream. The model code stays
+# layout-agnostic — local head counts are derived from the actual weight
+# shapes — and the ONLY tensor-parallel hook is this trace-time axis name:
+# set by ``tp_axis(...)`` around the traced call, it makes the two
+# row-parallel sites (attention out_proj, MLP fc2) and the LM head emit
+# exactly one ``lax.psum`` each. None (the default) is a no-op on every
+# single-chip path.
+_TP_AXIS: str | None = None
+
+
+@contextmanager
+def tp_axis(name: str):
+    """Trace-time context: the mesh axis name the model's row-parallel
+    partial sums psum over. Used by serving/tp.py around the shard_map'd
+    engine steps; nested/exception-safe."""
+    global _TP_AXIS
+    prev, _TP_AXIS = _TP_AXIS, name
+    try:
+        yield
+    finally:
+        _TP_AXIS = prev
+
+
+def _tp_psum(t: Tensor) -> Tensor:
+    """Reduce a row-parallel partial sum across the tensor-parallel axis
+    (identity outside a ``tp_axis`` context)."""
+    if _TP_AXIS is None:
+        return t
+    import jax.lax as lax
+
+    return Tensor(lax.psum(t._value, _TP_AXIS))
+
+
+def _tp_logits(h: Tensor, weight: Tensor, transpose_y: bool) -> Tensor:
+    """The LM head under tensor parallelism: the hidden (contraction) axis
+    is split across the mesh — each device multiplies its OWN hidden slice
+    of ``h`` against the matching slice of the replicated head weight, and
+    ONE psum of the [.., vocab] partials reassembles the full logits. The
+    head's FLOPs shard N ways at the cost of exactly one declared
+    all-reduce — the "one for the logits" entry in the step's
+    CollectiveBudget."""
+    import jax.lax as lax
+
+    hv, wv = h._value, weight._value
+    n = lax.psum(1, _TP_AXIS)  # axis size: constant-folded, no collective
+    i = lax.axis_index(_TP_AXIS)
+    k = hv.shape[-1] // n
+    h_loc = lax.dynamic_slice_in_dim(hv, i * k, k, axis=hv.ndim - 1)
+    if transpose_y:  # tied wte [vocab, hidden]: slice its hidden columns
+        w_loc = lax.dynamic_slice_in_dim(wv, i * k, k, axis=1)
+        part = h_loc @ w_loc.T
+    else:            # untied lm_head [hidden, vocab]: slice its rows
+        w_loc = lax.dynamic_slice_in_dim(wv, i * k, k, axis=0)
+        part = h_loc @ w_loc
+    return Tensor(lax.psum(part, _TP_AXIS))
 
 
 @dataclass
@@ -69,7 +131,13 @@ class GPTAttention(nn.Layer):
     def forward(self, x, attn_mask=None, cache=None, pos=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)
-        qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
+        # head count derived from the ACTUAL projection width, not the
+        # config: inside a tensor-parallel shard_map the local qkv weight
+        # holds num_heads / tp heads (serving/tp.py), and everything
+        # downstream — attention, paged KV writes — runs on that local
+        # slice. Single-chip, this is exactly self.num_heads.
+        nh = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = qkv.reshape([b, s, 3, nh, self.head_dim])
         if cache is not None and "k_pool" in cache:
             return self._paged_forward(x, qkv, cache)
         qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3, B, H, S, D
@@ -135,12 +203,17 @@ class GPTAttention(nn.Layer):
         k_pool, v_pool = pa.paged_write(k_pool, v_pool, k_new, v_new,
                                         page_ids, offsets)
         out = pa.paged_attention(q, k_pool, v_pool, table, ctx)
-        out = Tensor(jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, h)
+        # -1, not h: under tensor parallelism the local heads span h / tp
+        # and the row-parallel out_proj contracts that local width
+        out = Tensor(jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, -1)
                      .astype(x._value.dtype))
         new_cache = dict(cache, k_pool=k_pool, v_pool=v_pool,
                          ctx_lens=ctx + jnp.sum(valid, axis=1,
                                                 dtype=jnp.int32))
-        return self.out_proj(out), new_cache
+        # row-parallel out_proj under tensor parallelism: each device
+        # contracts its local heads; the psum restores the full projection
+        # (the per-block attention all-reduce in the step's budget)
+        return _tp_psum(self.out_proj(out)), new_cache
 
 
 class GPTMLP(nn.Layer):
@@ -153,7 +226,12 @@ class GPTMLP(nn.Layer):
         self.dropout = nn.Dropout(cfg.dropout)
 
     def forward(self, x):
-        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+        # row-parallel fc2 under tensor parallelism: fc1 is column-split
+        # (gelu is elementwise, so the split needs no communication), fc2
+        # contracts the local ffn shard — the psum of the partials is the
+        # per-block MLP all-reduce in the step's budget
+        return self.dropout(
+            _tp_psum(self.fc2(F.gelu(self.fc1(x), approximate=True))))
 
 
 class GPTBlock(nn.Layer):
@@ -271,6 +349,12 @@ class GPTForCausalLM(nn.Layer):
             h, new_caches = self.gpt(input_ids, attn_mask, caches=caches, pos=pos)
             from ..tensor_ops.math import matmul
 
+            if _TP_AXIS is not None:
+                # hidden-contraction-sharded LM head: one all-reduce of the
+                # logits, head FLOPs split across the mesh
+                w = (self.lm_head.weight if self.lm_head is not None
+                     else self.gpt.wte.weight)
+                return _tp_logits(h, w, self.lm_head is None), new_caches
             if self.lm_head is not None:
                 return self.lm_head(h), new_caches
             return matmul(h, self.gpt.wte.weight, transpose_y=True), new_caches

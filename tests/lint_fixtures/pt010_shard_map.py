@@ -1,0 +1,18 @@
+"""PT010 fixture: shard_map entry points in serving/ outside the
+registered tensor-parallel wrapper — the bare import, an aliased import,
+and the attribute respelling all fire; the pragma-suppressed twin is the
+sanctioned serving/tp.py idiom (its wrapped steps are registered with
+declared CollectiveBudgets in the hlocheck registry)."""
+from jax.experimental.shard_map import shard_map
+from jax.experimental.shard_map import shard_map as smap
+
+import jax.experimental.shard_map as sm_mod
+
+
+def rogue_attribute(fn, mesh, specs):
+    return sm_mod.shard_map(fn, mesh=mesh, in_specs=specs, out_specs=specs)
+
+
+def sanctioned(fn, mesh, specs):
+    from jax.experimental.shard_map import shard_map  # lint: disable=PT010
+    return shard_map(fn, mesh=mesh, in_specs=specs, out_specs=specs)

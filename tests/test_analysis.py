@@ -242,6 +242,8 @@ def _toy_engine(**overrides):
     return ServingEngine(model, ServingConfig(**kw))
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 budget; the read-through property is exercised
+# by every compile_counts pin across test_serving*/test_serving_tp and the demo
 def test_engine_compile_counts_surface_reads_off_guards():
     engine = _toy_engine()
     rng = np.random.RandomState(0)
@@ -363,6 +365,8 @@ _FIXTURE_CASES = {
     "pt009_raw_jit.py": ("serving/pt009.py",
                          {13: "PT009", 15: "PT009", 18: "PT009",
                           25: "PT009", 29: "PT009"}),
+    "pt010_shard_map.py": ("serving/pt010.py",
+                           {6: "PT010", 7: "PT010", 13: "PT010"}),
 }
 
 
@@ -381,7 +385,7 @@ def test_lint_rule_fixture(fixture):
 
 
 def test_lint_rule_table_is_complete():
-    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)]
+    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + ["PT010"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -466,6 +470,30 @@ def test_self_lint_catches_reintroduced_raw_jit():
                    for f in lint_source(src, "paddle_tpu/serving/engine.py"))
 
 
+def test_self_lint_catches_reintroduced_rogue_shard_map():
+    """Deliberately give the engine its own shard_map import (the way a
+    quick hack would shard a step without declaring its budget): PT010
+    must fire — an unregistered sharded step can acquire implicit
+    resharding collectives no hlocheck audit ever counts. The sanctioned
+    serving/tp.py entry point (registered tp2_engine_* steps) stays
+    clean under its pragma."""
+    path = REPO / "paddle_tpu" / "serving" / "engine.py"
+    src = path.read_text()
+    bad = src.replace(
+        "from ..analysis import hlocheck",
+        "from ..analysis import hlocheck\n"
+        "from jax.experimental.shard_map import shard_map")
+    assert bad != src
+    findings = lint_source(bad, "paddle_tpu/serving/engine.py")
+    assert any(f.rule == "PT010" and "hlocheck registry" in f.message
+               for f in findings)
+    tp_src = (REPO / "paddle_tpu" / "serving" / "tp.py").read_text()
+    assert "lint: disable=PT010" in tp_src
+    assert not any(f.rule == "PT010"
+                   for f in lint_source(tp_src,
+                                        "paddle_tpu/serving/tp.py"))
+
+
 def test_self_lint_catches_reintroduced_wall_clock():
     path = REPO / "paddle_tpu" / "serving" / "engine.py"
     src = path.read_text()
@@ -476,6 +504,7 @@ def test_self_lint_catches_reintroduced_wall_clock():
     assert any(f.rule == "PT004" for f in findings)
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_lint_cli_exit_codes_and_filters(tmp_path):
     clean = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu/"],
@@ -512,6 +541,7 @@ def test_lint_cli_exit_codes_and_filters(tmp_path):
     assert unknown.returncode == 2
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_lint_cli_default_sweep_covers_tests_and_examples():
     """No-path invocation lints the package + tests/ + examples/ (clean
     because fixtures are allowlisted); --include overrides the extra
@@ -541,6 +571,7 @@ def test_lint_cli_default_sweep_covers_tests_and_examples():
         probe.unlink()
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_tools_lint_entry_point():
     r = subprocess.run([sys.executable, str(REPO / "tools" / "lint.py")],
                        cwd=REPO, capture_output=True, text=True)

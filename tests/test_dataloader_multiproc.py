@@ -41,6 +41,7 @@ def _drain(loader):
     return n, time.perf_counter() - t0
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_multiprocess_loader_correctness():
     ds = _ImageNetShaped(n=16, work=10)
     loader = DataLoader(ds, batch_size=4, num_workers=2, shuffle=False)

@@ -36,6 +36,7 @@ class _ToyCell:
         return Tensor(logits), states
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_beam_search_finds_higher_scoring_path_than_greedy():
     # vocab 4, end_token 3. Greedy from 0 goes 1 (0.6) then gets stuck with a
     # low-prob ending; the 2-path (0.4) leads to a high-prob ending.
@@ -91,6 +92,7 @@ def test_beam_search_seq2seq_with_lstm_cell_runs_and_terminates():
     assert (np.diff(sc, axis=1) <= 1e-6).all()
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_dynamic_decode_time_major_and_early_exit():
     # every token transitions to end_token with near-certainty: the top beam
     # finishes at step 1, the runner-up beam (forced onto a non-eos token by

@@ -458,6 +458,8 @@ def test_timeline_records_step_shape():
     assert engine.timeline.last.finished == 1
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 budget; debug-checks host-sync counting stays
+# pinned tier-1 by test_analysis's sync-accounting test and test_serving_tp's sync-free cert
 def test_timeline_host_syncs_under_debug_checks():
     engine = _engine(debug_checks=True)
     engine.add_request(_prompt(4), 3)

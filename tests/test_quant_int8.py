@@ -91,6 +91,7 @@ def test_int8_dot_actually_int8():
         "no int32-accumulating MXU op in the traced program")
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_quant_sidecar_roundtrip(tmp_path):
     model = _small_convnet()
     model.eval()

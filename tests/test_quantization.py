@@ -85,6 +85,7 @@ def test_weight_quantize_roundtrip():
     assert np.abs(back - w).max() < np.abs(w).max() / 100  # <1% of range
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover
 def test_post_training_quantization():
     paddle.seed(13)
     net = SmallNet()

@@ -312,6 +312,8 @@ def test_engine_e2e_churn_parity_and_single_compile():
     assert engine.cache.allocator.pages_in_use == 0
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 budget; page-pressure preemption stays pinned
+# tier-1 by the faults suite's pool_exhausted scenarios and test_serving_tp's preemption-parity pair
 def test_engine_preemption_under_page_pressure():
     model = _toy_model(seed=13)
     rng = np.random.RandomState(1)

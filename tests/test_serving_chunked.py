@@ -81,6 +81,9 @@ def _engine(model, chunk_size=8, **overrides):
 
 
 # ---------------------------------------------------------------- parity
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 budget; chunked parity + the one-program-per-bucket
+# pin stay tier-1 via test_no_new_trace_per_chunk_count, the chunk-8 parity/sampling/prefix tests,
+# and test_serving_tp's chunked compile_counts pin
 def test_greedy_parity_across_chunk_sizes_and_compile_stability():
     model = _toy_model()
     prompts = _prompts(0, (20, 4, 13, 7))

@@ -228,6 +228,9 @@ def test_shed_oldest_skips_victim_and_sheds_oldest_newcomer():
 
 
 # ---------------------------------------------------------- swap preemption
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 budget; swap-vs-recompute parity stays pinned
+# tier-1 by test_serving_tp's preemption-parity pair (both modes, TP=1 reference engines included)
+# and test_serving's swap suite
 def test_swap_preempt_parity_with_recompute():
     model = _toy_model(seed=13)
     prompts = _prompts(5, (6, 5, 4))

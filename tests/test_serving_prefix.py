@@ -454,6 +454,9 @@ def test_compile_counts_stable_across_hit_miss_cow_evict():
     engine.cache.check_invariants()
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; the
+# one-compile-per-bucket invariant stays pinned tier-1 by test_serving_chunked's 3-bucket matrix,
+# test_serving_tp's compile_counts pins, and the serving demo's bucket assert
 def test_multi_bucket_prefill_compiles_once_per_bucket():
     assert prefill_buckets(8) == [8]
     assert prefill_buckets(32) == [8, 16, 32]
