@@ -433,12 +433,18 @@ class StepSpec:
     min_devices: int = 1
 
 
-def _build_engine_step(which: str, tensor_parallel: int = 1):
+def _build_engine_step(which: str, tensor_parallel: int = 1,
+                       kv_dtype: str = "float32"):
     """Engine-step audit targets. ``tensor_parallel=2`` builds the SAME
     step on a 2-device mesh (Megatron weight + KV-pool shards via
     serving/tp.py shard_map) with the budget the engine itself declares:
     2 all-reduces per block + 1 for the logits, byte-capped — the
-    single-chip variants certify at SINGLE_CHIP (all zeros)."""
+    single-chip variants certify at SINGLE_CHIP (all zeros).
+    ``kv_dtype="int8"`` builds the quantized-pool twin: the SAME budgets
+    must hold (quantization is per-device arithmetic — zero extra
+    collectives), and the donated int8 pools + scale leaves must all
+    alias (a donated-but-copied quantized pool would silently forfeit
+    the 4x HBM win the mode exists for)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -454,7 +460,7 @@ def _build_engine_step(which: str, tensor_parallel: int = 1):
     model.eval()
     eng = ServingEngine(model, ServingConfig(
         max_batch=2, num_pages=16, page_size=4, max_prompt_len=8,
-        tensor_parallel=tensor_parallel))
+        tensor_parallel=tensor_parallel, kv_dtype=kv_dtype))
     if which in ("prefill", "prefill_chunk"):
         bucket = eng.prefill_buckets[0]
         padded = np.zeros(bucket, np.int32)
@@ -483,11 +489,15 @@ def _build_engine_step(which: str, tensor_parallel: int = 1):
     return eng._decode_jit, args, None, eng._step_budget("decode")
 
 
-def _build_cache_step(which: str, tensor_parallel: int = 1):
+def _build_cache_step(which: str, tensor_parallel: int = 1,
+                      kv_dtype: str = "float32"):
     """Cache-mover audit targets. ``tensor_parallel=2`` shards the pools'
     heads axis and runs the mover per-shard (shard_map over replicated
     page indices) — pure local data movement, so the declared budget
-    stays ZERO collectives either way."""
+    stays ZERO collectives either way. ``kv_dtype="int8"`` moves int8
+    codes + scale stacks instead of f32 pages (the spill/restore payload
+    of the host tier) — still zero collectives, scatter still aliases
+    every donated leaf."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -502,7 +512,7 @@ def _build_cache_step(which: str, tensor_parallel: int = 1):
             vocab_size=97, hidden_size=8, num_layers=2, num_heads=2))
     cache = PagedKVCache(PagedCacheConfig(
         num_layers=2, num_heads=2, head_dim=4, num_pages=8, page_size=4,
-        max_batch=2, pages_per_seq=4, tp=tp))
+        max_batch=2, pages_per_seq=4, tp=tp, kv_dtype=kv_dtype))
     cfg = cache.cfg
     idx = jnp.asarray(np.zeros(cfg.pages_per_seq, np.int32))
     if which == "swap_gather":
@@ -510,10 +520,14 @@ def _build_cache_step(which: str, tensor_parallel: int = 1):
     if which == "swap_scatter":
         shape = (cfg.num_layers, cfg.pages_per_seq, cfg.page_size,
                  cfg.num_heads, cfg.head_dim)
-        k_all = jnp.zeros(shape)
-        v_all = jnp.zeros(shape)
-        return (cache._scatter_jit, (cache.pools, idx, k_all, v_all),
-                None, SINGLE_CHIP)
+        if cfg.quantized:
+            sshape = (cfg.num_layers, cfg.pages_per_seq, cfg.num_heads)
+            args = (cache.pools, idx, jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape, jnp.int8), jnp.zeros(sshape),
+                    jnp.zeros(sshape))
+        else:
+            args = (cache.pools, idx, jnp.zeros(shape), jnp.zeros(shape))
+        return cache._scatter_jit, args, None, SINGLE_CHIP
     args = (cache.pools, jnp.asarray(1, jnp.int32),
             jnp.asarray(2, jnp.int32))
     return cache._copy_jit, args, None, SINGLE_CHIP
@@ -601,6 +615,27 @@ REGISTRY: dict[str, StepSpec] = {s.name: s for s in (
     StepSpec("tp2_cow_copy", "per-shard COW page copy (pools donated; "
              "budget: zero collectives)",
              lambda: _build_cache_step("cow_copy", tensor_parallel=2),
+             min_devices=2),
+    # ---- quantized paged KV pool (kv_dtype="int8"): int8 codes + per-
+    # page-per-head scale leaves, all donated and all aliased; budgets
+    # identical to the fp32 twins — quantize/dequantize is per-device
+    # arithmetic, so a collective appearing here is a sharding bug
+    StepSpec("engine_decode_q8", "serving decode step over the INT8-"
+             "quantized pool (codes + scale leaves donated/aliased; "
+             "budget: zero collectives)",
+             lambda: _build_engine_step("decode", kv_dtype="int8")),
+    StepSpec("swap_gather_q8", "swap/spill gather over the int8 pool — "
+             "the host-tier spill payload: raw codes + scales, never "
+             "dequantized (read-only, no donation)",
+             lambda: _build_cache_step("swap_gather", kv_dtype="int8")),
+    StepSpec("swap_scatter_q8", "swap/restore scatter into the int8 pool "
+             "(codes + scale leaves donated)",
+             lambda: _build_cache_step("swap_scatter", kv_dtype="int8")),
+    StepSpec("tp2_engine_decode_q8", "TENSOR-PARALLEL decode over the "
+             "heads-sharded int8 pool (budget 2L+1 all-reduces — "
+             "unchanged by quantization)",
+             lambda: _build_engine_step("decode", tensor_parallel=2,
+                                        kv_dtype="int8"),
              min_devices=2),
 )}
 

@@ -15,12 +15,29 @@ Pool layout is ``[num_pages, page_size, num_heads, head_dim]`` per layer
 (serving/kv_cache.py owns allocation). Page 0 is reserved as the null page:
 writes from padding/inactive rows are routed there so a scatter can stay
 branch-free inside jit.
+
+Quantized pools (KVQuant-style, arxiv 2401.18079): with
+``PagedCacheConfig(kv_dtype="int8")`` the pools store int8 codes plus a
+per-page-per-HEAD f32 absmax scale (``[num_pages, num_heads]``), computed
+in-jit at scatter time. The scale is MONOTONE per page: a write
+scatter-maxes the new tokens' |absmax| into the page scales, rescales the
+page's existing codes by ``old_scale / new_scale`` (exactly 1.0 — hence
+bit-stable — whenever the scale didn't grow), then writes the new tokens
+quantized at the final scale. The attention gather dequantizes
+``codes * scale / 127`` before the ragged-masked sdpa, so everything
+downstream of the gather — masking, page tables, sharding — is
+layout-blind; the Pallas decode kernel is skipped in quantized mode (it
+reads raw pools) in favor of the composite path.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["paged_write", "paged_gather", "paged_attention"]
+__all__ = ["paged_write", "paged_write_quant", "paged_gather",
+           "paged_gather_quant", "paged_attention", "QMAX"]
+
+#: symmetric int8 code range: codes in [-127, 127], dequant = code*scale/127
+QMAX = 127.0
 
 
 def paged_write(k_pool, v_pool, k_new, v_new, page_ids, offsets):
@@ -37,6 +54,45 @@ def paged_write(k_pool, v_pool, k_new, v_new, page_ids, offsets):
     return k_pool, v_pool
 
 
+def _write_quant(pool, scale, new, page_ids, offsets):
+    """One quantized pool's write: update page scales (scatter-max absmax),
+    rescale the touched pages' resident codes, write the new tokens.
+
+    A page receiving several tokens in one call sees ONE consistent scale:
+    ``old`` is read before the scatter-max and ``cur`` after, so every
+    duplicate page index writes the identical rescaled page image (the
+    element-level token writes never collide — each (page, offset) pair is
+    unique). When the scale didn't grow the rescale ratio is exactly 1.0
+    and ``round(code * 1.0) == code``: decode steps that don't move a
+    page's absmax leave its resident codes bit-identical."""
+    absmax = jnp.max(jnp.abs(new), axis=-1)        # [b, s, heads]
+    old = scale[page_ids]                          # per-token page scale, pre
+    scale = scale.at[page_ids].max(absmax)
+    cur = scale[page_ids]                          # final page scale
+    safe = jnp.where(cur > 0, cur, 1.0)
+    ratio = (old / safe)[:, :, None, :, None]
+    codes = pool[page_ids].astype(jnp.float32)     # [b, s, page_size, h, d]
+    pool = pool.at[page_ids].set(
+        jnp.round(codes * ratio).astype(pool.dtype))
+    q = jnp.clip(jnp.round(new / safe[..., None] * QMAX), -QMAX, QMAX)
+    pool = pool.at[page_ids, offsets].set(q.astype(pool.dtype))
+    return pool, scale
+
+
+def paged_write_quant(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
+                      page_ids, offsets):
+    """Quantized twin of :func:`paged_write`: pools are int8 codes, scales
+    are the per-page-per-head f32 absmax factors ``[num_pages, heads]``.
+    Same coordinate contract (dead writes to the null page 0 — its scale
+    accrues garbage but its content is only ever read masked-to-zero).
+    Returns (k_pool, v_pool, k_scale, v_scale)."""
+    k_new = k_new.astype(jnp.float32)
+    v_new = v_new.astype(jnp.float32)
+    k_pool, k_scale = _write_quant(k_pool, k_scale, k_new, page_ids, offsets)
+    v_pool, v_scale = _write_quant(v_pool, v_scale, v_new, page_ids, offsets)
+    return k_pool, v_pool, k_scale, v_scale
+
+
 def paged_gather(pool, page_table):
     """Gather each row's pages into a contiguous sequence.
 
@@ -48,6 +104,18 @@ def paged_gather(pool, page_table):
     _, ps, h, d = pool.shape
     seq = pool[page_table]  # [b, pages_per_seq, page_size, h, d]
     seq = seq.reshape(b, n_pages * ps, h, d)
+    return seq.transpose(0, 2, 1, 3)
+
+
+def paged_gather_quant(pool, scale, page_table, out_dtype=jnp.float32):
+    """Dequantizing gather: int8 codes + per-page-per-head scales back to
+    ``out_dtype`` in the sdpa layout — the ONE site where quantized KV
+    becomes numbers, so nothing downstream knows the pool was compressed."""
+    b, n_pages = page_table.shape
+    _, ps, h, d = pool.shape
+    seq = pool[page_table].astype(jnp.float32)  # [b, pages, page_size, h, d]
+    sc = (scale[page_table] / QMAX)[:, :, None, :, None]
+    seq = (seq * sc).astype(out_dtype).reshape(b, n_pages * ps, h, d)
     return seq.transpose(0, 2, 1, 3)
 
 
@@ -96,7 +164,8 @@ def _pallas_decode(q, k_pool, v_pool, page_table, ctx_lens, scale):
     return out[:, :, None, :]
 
 
-def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None):
+def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None,
+                    k_scale=None, v_scale=None):
     """Attention of new-token queries against a row's paged KV prefix.
 
     q: [batch, heads, s, head_dim] — queries for s new tokens at positions
@@ -107,8 +176,22 @@ def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None):
     ``j <= ctx_lens[b] + t``; everything beyond is masked to exact zero
     probability, so the fixed gather width never leaks padding. Returns
     [batch, heads, s, head_dim].
+
+    ``k_scale``/``v_scale`` (both or neither): the pools are int8 codes
+    under per-page-per-head scales — the gather dequantizes and the same
+    ragged-masked sdpa runs on the reconstructed values (the Pallas kernel
+    reads raw pools, so quantized mode always takes the composite path).
     """
     s = q.shape[2]
+    if k_scale is not None:
+        from .attention import sdpa as _sdpa
+
+        k_all = paged_gather_quant(k_pool, k_scale, page_table, q.dtype)
+        v_all = paged_gather_quant(v_pool, v_scale, page_table, q.dtype)
+        j = jnp.arange(k_all.shape[2])[None, None, None, :]
+        t = jnp.arange(s)[None, None, :, None]
+        mask = j <= ctx_lens.astype(jnp.int32)[:, None, None, None] + t
+        return _sdpa(q, k_all, v_all, mask=mask, scale=scale)
     if s == 1 and _use_pallas_decode(q, k_pool, page_table):
         try:
             return _pallas_decode(q, k_pool, v_pool, page_table, ctx_lens,

@@ -34,7 +34,7 @@ _PID = 1
 
 # lifecycle events that ALSO render as instants on the request's track
 _INSTANTS = ("preempted", "swap_out", "swap_in", "decode_mark",
-             "prefill_chunk", "retired")
+             "prefill_chunk", "retired", "spill", "restore")
 
 
 def _request_events(trace: RequestTrace) -> list[dict]:

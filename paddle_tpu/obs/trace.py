@@ -5,8 +5,10 @@ args)`` triples covering one request's whole life — enqueued, admitted,
 prefill_start/prefill_end (with a ``prefill_chunk`` per chunk in between
 under chunked prefill — TTFT stays anchored to ``first_token``, which
 only the FINAL chunk emits), first_token, periodic decode_mark, preempted /
-swap_out / swap_in / resumed, and a terminal ``retired`` carrying the final
-state (finished/cancelled/expired/failed/shed). Timestamps come from the
+swap_out / swap_in / resumed, host-tier ``spill`` / ``restore`` (prefix
+pages this admission pushed to or pulled from the host cache tier), and a
+terminal ``retired`` carrying the final state
+(finished/cancelled/expired/failed/shed). Timestamps come from the
 ENGINE clock (``ServingConfig(clock=)`` + fault skew), never from the wall
 clock directly: every trace behavior is testable sleep-free with a virtual
 clock, and the ``slow_step`` fault's skew shows up in traces exactly like

@@ -23,6 +23,15 @@ serving (``tensor_parallel=N`` Megatron-shards the weights + the paged
 KV pool's heads axis across an N-device mesh via shard_map — serving/
 tp.py — with every step's collectives declared and hlocheck-certified).
 
+Capacity layer: int8-quantized paged KV (``kv_dtype="int8"`` stores the
+pools as codes + per-page-per-head absmax scales, quantized at scatter
+time and dequantized inside the attention gather — ~4x the concurrent
+users per HBM byte at a bounded greedy-quality delta) and a bounded
+host-memory cache tier (``host_tier_bytes=`` spills evicted refcount-0
+prefix pages to host RAM, keeping their content-index keys, and restores
+them bit-exactly on the next prefix hit — warm system prompts survive
+far beyond HBM).
+
 Analysis layer (paddle_tpu.analysis): every jitted step sits behind a
 ``CompileGuard`` (trace counting, compile budgets, retrace explanations,
 donation checks) — ``ServingConfig(debug_checks=True)`` makes the guards
@@ -40,8 +49,9 @@ per-step timeline, and Chrome-trace/Prometheus exporters
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      prefill_buckets)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
-from .kv_cache import (PagedCacheConfig, PagedKVCache,  # noqa: F401
-                       PageAllocator, SwapHandle)
+from .kv_cache import (HostTier, HostTierRestoreError,  # noqa: F401
+                       PagedCacheConfig, PagedKVCache, PageAllocator,
+                       SpilledPage, SwapHandle)
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import EngineOverloaded, Request, Scheduler  # noqa: F401
 from .slo import SLOConfig, SLOController  # noqa: F401
@@ -50,4 +60,5 @@ __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "PagedKVCache", "PageAllocator", "SwapHandle", "ServingMetrics",
            "Request", "Scheduler", "EngineOverloaded", "FaultInjector",
            "InjectedFault", "prefill_buckets", "SLOConfig",
-           "SLOController"]
+           "SLOController", "HostTier", "HostTierRestoreError",
+           "SpilledPage"]

@@ -179,6 +179,19 @@ class ServingConfig:
     # tail in one pass (chunking off). Chunks ride the SAME prefill jit
     # (ctx_lens = tokens already resident) padded into the existing
     # bucket set — no new compiles, ever.
+    kv_dtype: str = "float32"  # "float32" | "int8": int8 stores the paged
+    # KV pool as codes + per-page-per-head f32 absmax scales, quantized
+    # in-jit at scatter time and dequantized inside the attention gather
+    # (kernels/paged_attention.py) — ~4x the concurrent users per HBM
+    # byte at a bounded greedy-quality delta; compile counts, sync-free
+    # certification, and TP collective budgets are unchanged. The fp32
+    # default is bit-identical to the pre-quantization engine.
+    host_tier_bytes: int = 0  # bounded host-memory spill tier: evicted
+    # refcount-0 prefix pages keep their content-index keys and spill
+    # here (one batched jitted gather per eviction sweep) instead of
+    # being purged; the next prefix hit restores them through the donated
+    # swap scatter before prefill — warm system prompts survive far
+    # beyond HBM. 0 = off (evictions purge, the PR 3 behavior).
     slo: SLOConfig | None = None  # SLO-adaptive chunk admission (needs
     # chunk_size > 0 and enable_tracing — it reads the obs histograms)
     debug_checks: bool = False  # strict CompileGuard + invariant sweep/step
@@ -235,6 +248,14 @@ class ServingEngine:
                 "disabled (it would silently never throttle)")
         if cfg.tensor_parallel < 1:
             raise ValueError(f"tensor_parallel {cfg.tensor_parallel} < 1")
+        if cfg.kv_dtype not in ("float32", "int8"):
+            raise ValueError(f"kv_dtype {cfg.kv_dtype!r} not in "
+                             f"('float32', 'int8')")
+        if cfg.host_tier_bytes and not cfg.enable_prefix_caching:
+            raise ValueError(
+                "host_tier_bytes gives evicted INDEXED prefix pages a "
+                "second life — enable_prefix_caching=False would leave "
+                "nothing to spill; enable it or drop the tier")
         if cfg.tensor_parallel > 1:
             # mesh + Megatron shard specs + shard_map wrappers; validates
             # divisibility (heads/hidden/ffn) and the visible device count
@@ -251,10 +272,15 @@ class ServingEngine:
             max_batch=cfg.max_batch, pages_per_seq=pages_per_seq,
             dtype=model.gpt.wte.weight._value.dtype,
             enable_prefix_caching=cfg.enable_prefix_caching,
-            debug_checks=cfg.debug_checks, tp=self._tp))
+            debug_checks=cfg.debug_checks, tp=self._tp,
+            kv_dtype=cfg.kv_dtype, host_tier_bytes=cfg.host_tier_bytes))
+        # the jitted steps thread every pool leaf through — scale leaves
+        # ride beside the codes in quantized mode, nothing else changes
+        self._pool_keys = self.cache.cfg.pool_leaf_keys
         self.prefill_buckets = prefill_buckets(cfg.max_prompt_len)
         self.metrics = ServingMetrics()
         self.metrics.on_tp_degree(cfg.tensor_parallel)
+        self.metrics.on_kv_bytes_per_token(self.cache.cfg.kv_bytes_per_token)
         params, _ = model.functional_state()
         self._p = {k: v._value for k, v in params.items()}
         if self._tp is not None:
@@ -281,6 +307,12 @@ class ServingEngine:
             shed_policy=cfg.shed_policy, preemption_mode=cfg.preemption_mode,
             tracer=self._tracer)
         self._fault_injector = fault_injector
+        if fault_injector is not None and self.cache.host_tier is not None:
+            # the restore_fail fault point: consulted by the cache right
+            # before a host-tier restore scatter. Installed only when an
+            # injector exists, so the injector-off path keeps its
+            # one-attribute-check contract inside the cache too.
+            self.cache.restore_fault = self._restore_fault_probe
         # SLO-adaptive chunk admission: a host-side AIMD controller over
         # chunks-per-step, windowing the obs histograms (serving/slo.py).
         # None (chunking off or no SLO) costs one attribute check per step.
@@ -291,6 +323,7 @@ class ServingEngine:
         else:
             self._slo = None
         self._step_idx = 0
+        self._now_step = 0  # step index the restore_fail probe matches
         self.admit_paused = False  # run(budget_s=) drain; settable by callers
         b = cfg.max_batch
         self._ctx = np.zeros(b, np.int32)
@@ -326,10 +359,12 @@ class ServingEngine:
             # replicated, model psums enabled for the trace) — the guards
             # wrap the sharded callables, so compile counts, budgets, and
             # the retrace/donation audits are identical to single-chip
-            prefill_impl = self._tp.wrap_step(prefill_impl,
-                                              mc.num_layers, n_rest=5)
-            decode_impl = self._tp.wrap_step(decode_impl,
-                                             mc.num_layers, n_rest=6)
+            prefill_impl = self._tp.wrap_step(
+                prefill_impl, mc.num_layers, n_rest=5,
+                quantized=self.cache.cfg.quantized)
+            decode_impl = self._tp.wrap_step(
+                decode_impl, mc.num_layers, n_rest=6,
+                quantized=self.cache.cfg.quantized)
         self._prefill_jit = CompileGuard(
             prefill_impl, "prefill", donate_argnums=(1,),
             budget=len(self.prefill_buckets), strict=cfg.debug_checks,
@@ -359,7 +394,7 @@ class ServingEngine:
                   for pl in pools]
         (logits, new_caches), _ = self.model.functional_call(
             p_arrays, {}, Tensor(ids), caches=caches)
-        new_pools = [{"k_pool": c["k_pool"], "v_pool": c["v_pool"]}
+        new_pools = [{k: c[k] for k in self._pool_keys}
                      for c in new_caches]
         return logits._value, new_pools
 
@@ -535,6 +570,15 @@ class ServingEngine:
         self._last_tok[slot] = self.config.pad_token_id
         self._rids[slot] = 0
         self._gen[slot] = 0
+
+    def _restore_fault_probe(self, rid) -> bool:
+        """Cache-side consult of the ``restore_fail`` fault point (armed
+        FaultInjector only): matched against the CURRENT step index and
+        the admitting request's rid, like every other step-boundary
+        fault."""
+        inj = self._fault_injector
+        return inj is not None and inj.hit(
+            "restore_fail", step=self._now_step, rid=rid) is not None
 
     def _preempt_one(self, req: Request, slot: int | None = None) -> None:
         """The one preemption recipe — the injected pool_exhausted path and
@@ -717,6 +761,7 @@ class ServingEngine:
         # uninstalled path costs one attribute lookup and None-checks
         inj = self._fault_injector
         step_idx = self._step_idx
+        self._now_step = step_idx  # the restore_fail probe reads this
         self._step_idx += 1
         if inj is not None:
             slow = inj.hit("slow_step", step=step_idx)
@@ -735,6 +780,13 @@ class ServingEngine:
         admitted = self.scheduler.admit(
             resume_only=self.admit_paused,
             prefer_cached=self._slo is not None and self._slo.degraded)
+        # a failed host-tier restore (restore_fail injection or a real
+        # scatter error) aborted that request's admission cleanly — the
+        # stale tier entries are dropped, the pool state is the pre-admit
+        # state: retire it FAILED and keep serving everyone else
+        for req, err in self.scheduler.pop_restore_failures():
+            self._retire(req, FAILED, err)
+            self.metrics.on_failed()
         for req in admitted:
             if req.generated:  # swap-resume: KV restored by admit(); there
                 slot = req.slot   # is no prefill here for prefill_fail to hit
@@ -950,7 +1002,12 @@ class ServingEngine:
             shared_pages=cs["shared_pages"],
             cached_pages=cs["reclaimable_pages"],
             cow_copies=cs["cow_copies"],
-            evictions=cs["evictions"])
+            evictions=cs["evictions"],
+            host_tier_pages=cs["host_tier_pages"],
+            host_tier_bytes=cs["host_tier_bytes"],
+            host_tier_hits=cs["host_tier_hits"],
+            host_tier_spills=cs["host_tier_spills"],
+            host_tier_restores=cs["host_tier_restores"])
         if self._timeline is not None:
             self._step_stats = {
                 "step": step_idx, "t_start": t_start, "t_end": self.now(),

@@ -15,6 +15,11 @@ boundaries — named points, matched by (point, step index, request id):
 - ``pool_exhausted`` simulates the page pool running dry before a decode
   step: the scheduler's victim policy preempts one running request
   (recompute or swap per the engine config).
+- ``restore_fail``  a host-tier prefix restore fails mid-admission
+  (``ServingConfig(host_tier_bytes=)``): consulted by the cache right
+  before the restore scatter — the admission is undone, the stale tier
+  entries are dropped, and the engine retires the request FAILED while
+  survivors keep serving.
 - ``slow_step``     advances the engine's virtual clock by ``delay_s``
   without sleeping — deadline expiry and wall-clock budgets become
   deterministically testable.
@@ -33,7 +38,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 POINTS = ("prefill_fail", "chunk_fail", "decode_fail", "pool_exhausted",
-          "slow_step")
+          "restore_fail", "slow_step")
 
 
 class InjectedFault(RuntimeError):

@@ -39,6 +39,26 @@ reallocates (possibly different page ids) and restores the bytes through
 one jitted donated scatter. Both run over fixed shapes (page index vectors
 padded to ``pages_per_seq`` with the null page), so swap events never
 retrigger a compile — ``compile_counts`` pins exactly one trace each.
+
+Quantized pool (``kv_dtype="int8"``, KVQuant-style — arxiv 2401.18079):
+the per-layer pools store int8 codes plus per-page-per-head f32 absmax
+scales ``[num_pages, num_heads]``, quantized in-jit at scatter time and
+dequantized inside the attention gather (kernels/paged_attention.py).
+Every host-side structure here — allocator, page tables, prefix index,
+COW, swap — moves LOGICAL page ids and opaque page bytes, so quantization
+changes only the byte volume: swap handles and the host tier carry the
+codes + scales verbatim (restores are bit-exact), and HBM per page drops
+~4x. The fp32 default path is byte-for-byte unchanged.
+
+Host spill tier (``host_tier_bytes > 0``): at LRU eviction, refcount-0
+indexed prefix pages are SPILLED to a bounded host-memory tier through the
+same jitted swap gather (one batched gather per eviction sweep, chunked at
+``pages_per_seq``) instead of being purged. Each spilled page keeps its
+content-index key AND its chain serial, so the next prompt matching that
+prefix restores it through the donated swap scatter before prefill — the
+restored page re-registers under its original serial, descendants on
+device or in the tier stay reachable, and the admission counts as a prefix
+hit. The tier LRU-drops its own oldest entries past the byte bound.
 """
 from __future__ import annotations
 
@@ -165,6 +185,12 @@ class PageAllocator:
         return page
 
 
+class HostTierRestoreError(RuntimeError):
+    """A host-tier prefix restore failed (injected via the ``restore_fail``
+    fault point or a real scatter error). The admission is undone and the
+    stale tier entries dropped; the engine retires the request FAILED."""
+
+
 @dataclass(eq=False)  # ndarray fields: identity semantics (lint rule PT001)
 class SwapHandle:
     """Host-memory copy of one sequence's KV pages (swap-style preemption).
@@ -172,14 +198,87 @@ class SwapHandle:
     ``k``/``v`` are stacked over layers: ``[num_layers, n_pages, page_size,
     heads, head_dim]`` in page-table row order, so restoring into ANY
     n_pages free pages (in order) preserves every token position exactly.
+    Quantized pools additionally carry the per-page-per-head scales
+    ``[num_layers, n_pages, heads]`` — the handle holds the pool's raw
+    bytes either way, so a swap round-trip is bit-exact in both modes.
     """
     n_pages: int
     k: np.ndarray
     v: np.ndarray
+    k_scale: np.ndarray | None = None
+    v_scale: np.ndarray | None = None
 
     @property
     def nbytes(self) -> int:
-        return self.k.nbytes + self.v.nbytes
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+
+@dataclass(eq=False)  # ndarray fields: identity semantics (lint rule PT001)
+class SpilledPage:
+    """One prefix page in the host tier: its content-index key, its chain
+    serial (kept so a restore re-links descendants exactly), and the raw
+    per-layer page bytes — codes + scales in quantized mode."""
+    key: tuple
+    serial: int
+    k: np.ndarray  # [num_layers, page_size, heads, head_dim]
+    v: np.ndarray
+    k_scale: np.ndarray | None = None  # [num_layers, heads] (quantized)
+    v_scale: np.ndarray | None = None
+
+    @property
+    def nbytes(self) -> int:
+        n = self.k.nbytes + self.v.nbytes
+        if self.k_scale is not None:
+            n += self.k_scale.nbytes + self.v_scale.nbytes
+        return n
+
+
+class HostTier:
+    """Bounded LRU of :class:`SpilledPage` keyed by content-index key —
+    the capacity tier behind the paged pool. Pure host-side bookkeeping:
+    the cache owns every device transfer."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self.bytes = 0
+        self._entries: OrderedDict[tuple, SpilledPage] = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def get(self, key: tuple, touch: bool = True) -> SpilledPage | None:
+        """Peek an entry; the caller pops it only after a successful
+        restore. ``touch`` promotes it to MRU — pass False for read-only
+        PROBES (the scheduler's degraded-mode warm-waiter scan probes
+        every waiter every step; letting probes reorder the LRU would
+        make never-admitted stale prefixes outlive the genuinely warm
+        ones at the byte bound)."""
+        e = self._entries.get(key)
+        if e is not None and touch:
+            self._entries.move_to_end(key)
+        return e
+
+    def put(self, entry: SpilledPage) -> None:
+        """Insert, dropping oldest entries (for real — their KV is gone)
+        until the byte bound holds. An entry larger than the whole bound
+        is refused outright."""
+        self.pop(entry.key)
+        if entry.nbytes > self.max_bytes:
+            return
+        while self._entries and self.bytes + entry.nbytes > self.max_bytes:
+            _, old = self._entries.popitem(last=False)
+            self.bytes -= old.nbytes
+        self._entries[entry.key] = entry
+        self.bytes += entry.nbytes
+
+    def pop(self, key: tuple) -> SpilledPage | None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self.bytes -= e.nbytes
+        return e
 
 
 @dataclass(frozen=True)
@@ -199,6 +298,40 @@ class PagedCacheConfig:
     # single-chip. The allocator, page tables, and prefix index are
     # host-side and operate on LOGICAL page ids — sharding never touches
     # them.
+    kv_dtype: str = "float32"  # "float32" | "int8": int8 stores the pools
+    # as codes + per-page-per-head f32 absmax scales, quantized at scatter
+    # time and dequantized inside the attention gather — ~4x less HBM per
+    # resident token at a bounded greedy-quality delta. The fp32 default
+    # is byte-for-byte the pre-quantization path.
+    host_tier_bytes: int = 0  # host-memory spill tier bound; 0 = off.
+    # Evicted refcount-0 prefix pages spill here (keeping their index keys)
+    # instead of being purged, and restore on the next prefix hit.
+
+    @property
+    def quantized(self) -> bool:
+        return self.kv_dtype == "int8"
+
+    @property
+    def pool_leaf_keys(self) -> tuple:
+        """The per-layer pool dict's leaf names, in a fixed order — the
+        engine and the movers use this to stay mode-agnostic."""
+        return (("k_pool", "v_pool", "k_scale", "v_scale")
+                if self.quantized else ("k_pool", "v_pool"))
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """Device bytes one resident token costs across all layers (k+v
+        codes plus, quantized, the per-page scales amortized per token) —
+        the ``serving_kv_bytes_per_token`` gauge."""
+        per = 2 * self.num_layers * self.num_heads * self.head_dim
+        if self.quantized:
+            return per + (2 * self.num_layers * self.num_heads * 4
+                          + self.page_size - 1) // self.page_size
+        # the fp32-path pools are allocated in cfg.dtype (the MODEL's
+        # dtype — bf16 pools cost 2 B/elem, not 4)
+        itemsize = np.dtype(self.dtype).itemsize if self.dtype is not None \
+            else 4
+        return per * itemsize
 
     @property
     def max_tokens_per_seq(self) -> int:
@@ -210,11 +343,21 @@ class PagedCacheConfig:
 
 
 def init_pools(cfg: PagedCacheConfig) -> list[dict]:
-    """Per-layer {k_pool, v_pool} device arrays, zero-filled."""
+    """Per-layer {k_pool, v_pool} device arrays, zero-filled; quantized
+    pools add the zero-initialized {k_scale, v_scale} leaves (a zero scale
+    marks an all-zero page — the write path substitutes 1.0 before any
+    division)."""
     import jax.numpy as jnp
 
-    dt = cfg.dtype or jnp.float32
     shape = (cfg.num_pages, cfg.page_size, cfg.num_heads, cfg.head_dim)
+    if cfg.quantized:
+        sshape = (cfg.num_pages, cfg.num_heads)
+        return [{"k_pool": jnp.zeros(shape, jnp.int8),
+                 "v_pool": jnp.zeros(shape, jnp.int8),
+                 "k_scale": jnp.zeros(sshape, jnp.float32),
+                 "v_scale": jnp.zeros(sshape, jnp.float32)}
+                for _ in range(cfg.num_layers)]
+    dt = cfg.dtype or jnp.float32
     return [{"k_pool": jnp.zeros(shape, dt), "v_pool": jnp.zeros(shape, dt)}
             for _ in range(cfg.num_layers)]
 
@@ -226,6 +369,15 @@ class PagedKVCache:
     (swap gather/scatter, COW page copy) rebind them in place."""
 
     def __init__(self, cfg: PagedCacheConfig):
+        if cfg.kv_dtype not in ("float32", "int8"):
+            raise ValueError(f"kv_dtype {cfg.kv_dtype!r} not in "
+                             f"('float32', 'int8')")
+        if cfg.host_tier_bytes < 0:
+            raise ValueError(f"host_tier_bytes {cfg.host_tier_bytes} < 0")
+        if cfg.host_tier_bytes and not cfg.enable_prefix_caching:
+            raise ValueError(
+                "host_tier_bytes spills INDEXED prefix pages — it needs "
+                "enable_prefix_caching=True (nothing would ever spill)")
         self.cfg = cfg
         self.allocator = PageAllocator(cfg.num_pages)
         self.pools = init_pools(cfg)
@@ -256,6 +408,17 @@ class PagedKVCache:
         self._slot_cached: dict[int, int] = {}  # slot -> cached prompt tokens
         self.cow_copies = 0   # shared pages privatized before a write
         self.evictions = 0    # reclaimable pages purged under pressure
+        # ---- host spill tier: evicted prefix pages' second life
+        self.host_tier = (HostTier(cfg.host_tier_bytes)
+                          if cfg.host_tier_bytes else None)
+        self.spills = 0        # pages spilled to the host tier
+        self.restores = 0      # pages restored from the host tier
+        self.host_tier_hits = 0  # admissions that restored >= 1 page
+        self._slot_restored: dict[int, int] = {}  # slot -> restored pages
+        # engine-installed probe: restore_fault(rid) -> True fails the
+        # restore (the ``restore_fail`` fault point); None costs one
+        # attribute check per admission that would restore
+        self.restore_fault = None
         self._build_jits()
 
     @property
@@ -270,21 +433,46 @@ class PagedKVCache:
 
         from ..analysis.tracecheck import CompileGuard
 
+        quantized = self.cfg.quantized
+
         def gather(pools, idx):
             # index each layer BEFORE stacking: stacking whole pools would
             # materialize an O(pool) concatenate per swap event — the exact
             # cost this jit exists to avoid; this way only the gathered
-            # pages ([layers, pages_per_seq, ...]) are ever copied
+            # pages ([layers, pages_per_seq, ...]) are ever copied.
+            # Quantized pools move their raw codes + the touched pages'
+            # scale rows — never dequantized, so a round-trip is bit-exact.
             k = jnp.stack([pl["k_pool"][idx] for pl in pools])
             v = jnp.stack([pl["v_pool"][idx] for pl in pools])
+            if quantized:
+                ks = jnp.stack([pl["k_scale"][idx] for pl in pools])
+                vs = jnp.stack([pl["v_scale"][idx] for pl in pools])
+                return k, v, ks, vs
             return k, v
 
-        def scatter(pools, idx, k_all, v_all):
+        def scatter(pools, idx, k_all, v_all, *scales):
+            if quantized:
+                ks_all, vs_all = scales
+                return [{"k_pool": pl["k_pool"].at[idx].set(k_all[i]),
+                         "v_pool": pl["v_pool"].at[idx].set(v_all[i]),
+                         "k_scale": pl["k_scale"].at[idx].set(ks_all[i]),
+                         "v_scale": pl["v_scale"].at[idx].set(vs_all[i])}
+                        for i, pl in enumerate(pools)]
             return [{"k_pool": pl["k_pool"].at[idx].set(k_all[i]),
                      "v_pool": pl["v_pool"].at[idx].set(v_all[i])}
                     for i, pl in enumerate(pools)]
 
         def copy_page(pools, src, dst):
+            if quantized:
+                return [{"k_pool":
+                         pl["k_pool"].at[dst].set(pl["k_pool"][src]),
+                         "v_pool":
+                         pl["v_pool"].at[dst].set(pl["v_pool"][src]),
+                         "k_scale":
+                         pl["k_scale"].at[dst].set(pl["k_scale"][src]),
+                         "v_scale":
+                         pl["v_scale"].at[dst].set(pl["v_scale"][src])}
+                        for pl in pools]
             return [{"k_pool": pl["k_pool"].at[dst].set(pl["k_pool"][src]),
                      "v_pool": pl["v_pool"].at[dst].set(pl["v_pool"][src])}
                     for pl in pools]
@@ -300,9 +488,12 @@ class PagedKVCache:
             # it collective-free (certified by the tp2_swap/cow hlocheck
             # registry steps)
             nl = self.cfg.num_layers
-            gather = self.cfg.tp.wrap_cache(gather, "gather", nl)
-            scatter = self.cfg.tp.wrap_cache(scatter, "scatter", nl)
-            copy_page = self.cfg.tp.wrap_cache(copy_page, "copy", nl)
+            gather = self.cfg.tp.wrap_cache(gather, "gather", nl,
+                                            quantized=quantized)
+            scatter = self.cfg.tp.wrap_cache(scatter, "scatter", nl,
+                                             quantized=quantized)
+            copy_page = self.cfg.tp.wrap_cache(copy_page, "copy", nl,
+                                               quantized=quantized)
         strict = self.cfg.debug_checks
         self._gather_jit = CompileGuard(  # lint: disable=PT006
             gather, "swap_gather", budget=1, strict=strict)
@@ -386,6 +577,11 @@ class PagedKVCache:
             self._key_to_page[key] = pages[i]
             self._page_key[pages[i]] = key
             self._page_serial[pages[i]] = serial
+            if self.host_tier is not None:
+                # a freshly prefilled page re-registering a key a spilled
+                # page still holds (e.g. the same text regenerated) makes
+                # the tier copy stale — the device index always wins
+                self.host_tier.pop(key)
             parent = serial
             new += 1
         return new
@@ -395,12 +591,42 @@ class PagedKVCache:
         admission (0 for a cold admission or a swap-restore)."""
         return self._slot_cached.get(slot, 0)
 
+    def restored_pages(self, slot: int) -> int:
+        """Host-tier pages restored into ``slot`` at its admission (0
+        otherwise) — the scheduler stamps the ``restore`` trace event off
+        this."""
+        return self._slot_restored.get(slot, 0)
+
+    def _match_host_tail(self, tokens, parent: int, start_block: int,
+                         touch: bool = True) -> list[SpilledPage]:
+        """Continue a device-index prefix chain into the host tier: the
+        longest run of spilled pages extending block ``start_block`` of
+        ``tokens`` from chain serial ``parent``. ``touch=False`` for
+        read-only probes (no LRU reorder); the restore pops the entries
+        only after the scatter lands."""
+        if self.host_tier is None:
+            return []
+        out = []
+        for i in range(start_block, len(tokens) // self.cfg.page_size):
+            e = self.host_tier.get(self._block_key(parent, tokens, i),
+                                   touch=touch)
+            if e is None:
+                break
+            out.append(e)
+            parent = e.serial
+        return out
+
     def cached_prefix_tokens(self, tokens) -> int:
         """Tokens of ``tokens`` a fresh admission would serve from the
-        prefix cache right now (whole-page index matches). A read-only
-        probe — no refcounts move — used by the scheduler's degraded-mode
-        preference for warm waiters."""
-        return len(self.match_prefix(tokens)) * self.cfg.page_size
+        prefix cache right now (whole-page device-index matches plus the
+        host tier's continuation of the chain). A read-only probe — no
+        refcounts move, no tier LRU reorder — used by the scheduler's
+        degraded-mode preference for warm waiters."""
+        pages = self.match_prefix(tokens)
+        parent = self._page_serial[pages[-1]] if pages else 0
+        spilled = self._match_host_tail(tokens, parent, len(pages),
+                                        touch=False)
+        return (len(pages) + len(spilled)) * self.cfg.page_size
 
     def _unregister(self, page: int) -> None:
         key = self._page_key.pop(page, None)
@@ -411,19 +637,55 @@ class PagedKVCache:
             # unreachable (serials never recur); they purge when their own
             # pages are evicted or re-registered
 
+    def _spill_pages(self, pages: list[int]) -> None:
+        """Copy the named (still-resident, refcount-0 indexed) pages into
+        the host tier before they are reclaimed, keeping their index keys
+        and chain serials. ONE batched jitted gather per ``pages_per_seq``
+        chunk of the sweep — the same compiled program swap_out uses, so a
+        spill can never retrigger a compile — not a per-page transfer."""
+        import jax.numpy as jnp
+
+        w = self.cfg.pages_per_seq
+        for at in range(0, len(pages), w):
+            chunk = pages[at:at + w]
+            got = self._gather_jit(self.pools,
+                                   jnp.asarray(self._padded_idx(chunk)))
+            if self.cfg.quantized:
+                k, v, ks, vs = (np.asarray(a) for a in got)
+            else:
+                k, v = (np.asarray(a) for a in got)
+                ks = vs = None
+            for j, page in enumerate(chunk):
+                self.host_tier.put(SpilledPage(
+                    key=self._page_key[page],
+                    serial=self._page_serial[page],
+                    k=k[:, j].copy(), v=v[:, j].copy(),
+                    k_scale=None if ks is None else ks[:, j].copy(),
+                    v_scale=None if vs is None else vs[:, j].copy()))
+                self.spills += 1
+
     def _alloc_or_evict(self, n: int) -> list[int] | None:
         """Allocate n pages, LRU-evicting reclaimable cached pages when the
         free list alone can't cover it. Evicted pages are purged from the
         content index BEFORE they can be handed out again — a recycled page
-        must never be reachable under its stale key."""
+        must never be reachable under its stale key. With the host tier
+        enabled, the sweep's victims spill their bytes (and keys) there
+        first — one batched gather, then the reclaims."""
         if n == 0:
             return []
         if self.allocator.num_free + self.allocator.num_reclaimable < n:
             return None  # doomed: keep the warm cache, change no state
-        while self.allocator.num_free < n:
-            page = self.allocator.reclaim_lru()
-            self._unregister(page)
-            self.evictions += 1
+        need = n - self.allocator.num_free
+        if need > 0:
+            if self.host_tier is not None:
+                # reclaim_lru pops oldest-first — exactly this LRU prefix
+                victims = list(itertools.islice(
+                    self.allocator._cached, need))
+                self._spill_pages(victims)
+            for _ in range(need):
+                page = self.allocator.reclaim_lru()
+                self._unregister(page)
+                self.evictions += 1
         return self.allocator.alloc(n)
 
     def _claim_shared(self, page: int) -> None:
@@ -457,7 +719,64 @@ class PagedKVCache:
                 jnp.asarray(dst, jnp.int32))
 
     # ---------------------------------------------------------- admission
-    def admit(self, slot: int, num_tokens: int, tokens=None) -> bool:
+    def _restore_pages(self, entries: list[SpilledPage],
+                       pages: list[int], rid=None) -> None:
+        """Scatter host-tier entries into freshly allocated ``pages``
+        (aligned lists) through the jitted donated swap scatter, chunked at
+        ``pages_per_seq``, then re-register each page under its ORIGINAL
+        key and serial — descendants of the chain, on device or still in
+        the tier, stay reachable. The ``restore_fail`` fault point (and any
+        real scatter error that didn't consume the pools) raises
+        HostTierRestoreError AFTER dropping the stale tier entries; the
+        caller undoes the admission."""
+        import jax.numpy as jnp
+
+        hook = self.restore_fault
+        if hook is not None and hook(rid):
+            for e in entries:
+                self.host_tier.pop(e.key)
+            raise HostTierRestoreError(
+                f"restore_fail injected (rid {rid})")
+        c = self.cfg
+        w = c.pages_per_seq
+        for at in range(0, len(entries), w):
+            es = entries[at:at + w]
+            k_all = np.zeros((c.num_layers, w, c.page_size, c.num_heads,
+                              c.head_dim), es[0].k.dtype)
+            v_all = np.zeros_like(k_all)
+            for j, e in enumerate(es):
+                k_all[:, j] = e.k
+                v_all[:, j] = e.v
+            args = [jnp.asarray(self._padded_idx(pages[at:at + w])),
+                    jnp.asarray(k_all), jnp.asarray(v_all)]
+            if c.quantized:
+                ks = np.zeros((c.num_layers, w, c.num_heads), np.float32)
+                vs = np.zeros_like(ks)
+                for j, e in enumerate(es):
+                    ks[:, j] = e.k_scale
+                    vs[:, j] = e.v_scale
+                args += [jnp.asarray(ks), jnp.asarray(vs)]
+            try:
+                self.pools = self._scatter_jit(self.pools, *args)
+            except Exception as err:  # noqa: BLE001 — isolate the restore
+                if any(arr.is_deleted() for pl in self.pools
+                       for arr in pl.values()):
+                    raise  # donation consumed the pools: engine-fatal
+                for e in entries:
+                    self.host_tier.pop(e.key)
+                raise HostTierRestoreError(
+                    f"host-tier restore failed: "
+                    f"{type(err).__name__}: {err}") from err
+        for e, page in zip(entries, pages):
+            self.host_tier.pop(e.key)
+            self._key_to_page[e.key] = page
+            self._page_key[page] = e.key
+            self._page_serial[page] = e.serial
+            self.restores += 1
+        self.host_tier_hits += 1
+
+    def admit(self, slot: int, num_tokens: int, tokens=None,
+              rid=None) -> bool:
         """Allocate what a prompt of num_tokens needs and populate the
         slot's page-table row. When ``tokens`` is given and prefix caching
         is on, the longest indexed whole-page prefix is SHARED (refcount
@@ -474,26 +793,51 @@ class PagedKVCache:
         that reaches it reproduces the exact bytes already resident (same
         tokens over the same exact-zero-masked prefix, deterministic
         kernels). The COW page is reserved inside the same all-or-nothing
-        allocation as the private remainder."""
+        allocation as the private remainder.
+
+        Host tier: the device-index match is extended into the spill tier
+        — matching spilled pages are restored (allocated as private pages,
+        scattered back, re-registered under their original keys/serials)
+        and count toward ``cached`` exactly like device hits. A failed
+        restore (``restore_fail`` injection or a real scatter error) undoes
+        the whole admission and raises HostTierRestoreError — the engine
+        retires the request FAILED."""
         if slot in self._slot_pages:
             raise ValueError(f"slot {slot} already admitted")
         total = self.pages_for(num_tokens)
         shared: list[int] = []
+        spilled: list[SpilledPage] = []
         if tokens is not None and self.cfg.enable_prefix_caching:
             shared = self.match_prefix(tokens[:num_tokens])
+            parent = self._page_serial[shared[-1]] if shared else 0
+            spilled = self._match_host_tail(tokens[:num_tokens], parent,
+                                            len(shared))
             for p in shared:
                 self._claim_shared(p)
-        cached = len(shared) * self.cfg.page_size
-        full_hit = bool(shared) and cached >= num_tokens
+        cached = (len(shared) + len(spilled)) * self.cfg.page_size
+        full_hit = bool(shared or spilled) and cached >= num_tokens
         if full_hit:
             cached = num_tokens - 1
-        # refcount includes this request's own claim: > 1 = other holders
-        need_cow = full_hit and self.allocator.refcount(shared[-1]) > 1
+        # refcount includes this request's own claim: > 1 = other holders.
+        # A restored page is always this request's private copy, so a full
+        # hit whose LAST page comes from the tier never needs COW.
+        need_cow = full_hit and not spilled \
+            and self.allocator.refcount(shared[-1]) > 1
+        # the spilled pages' slots are part of the private remainder: they
+        # are allocated here and filled by the restore scatter below
         private = self._alloc_or_evict(total - len(shared)
                                        + (1 if need_cow else 0))
         if private is None:
             self._release_pages(shared)
             return False
+        if spilled:
+            try:
+                self._restore_pages(spilled, private[:len(spilled)], rid)
+            except HostTierRestoreError:
+                for p in private:  # fresh refcount-1 pages: free them
+                    self.allocator.decref(p)
+                self._release_pages(shared)
+                raise
         if need_cow:
             dst = private.pop()
             src = shared[-1]
@@ -504,6 +848,8 @@ class PagedKVCache:
         pages = shared + private
         self._slot_pages[slot] = pages
         self._slot_cached[slot] = cached
+        if spilled:
+            self._slot_restored[slot] = len(spilled)
         self.page_table[slot, :] = NULL_PAGE
         self.page_table[slot, :len(pages)] = pages
         return True
@@ -548,10 +894,19 @@ class PagedKVCache:
         import jax.numpy as jnp
 
         n = len(pages)
-        k, v = self._gather_jit(self.pools,
-                                jnp.asarray(self._padded_idx(pages)))
-        handle = SwapHandle(n_pages=n, k=np.asarray(k)[:, :n].copy(),
-                            v=np.asarray(v)[:, :n].copy())
+        got = self._gather_jit(self.pools,
+                               jnp.asarray(self._padded_idx(pages)))
+        if self.cfg.quantized:
+            k, v, ks, vs = got
+            handle = SwapHandle(
+                n_pages=n, k=np.asarray(k)[:, :n].copy(),
+                v=np.asarray(v)[:, :n].copy(),
+                k_scale=np.asarray(ks)[:, :n].copy(),
+                v_scale=np.asarray(vs)[:, :n].copy())
+        else:
+            k, v = got
+            handle = SwapHandle(n_pages=n, k=np.asarray(k)[:, :n].copy(),
+                                v=np.asarray(v)[:, :n].copy())
         self.release(slot)
         return handle
 
@@ -574,10 +929,17 @@ class PagedKVCache:
         v_all = np.zeros_like(k_all)
         k_all[:, :handle.n_pages] = handle.k
         v_all[:, :handle.n_pages] = handle.v
+        args = [jnp.asarray(self._padded_idx(pages)),
+                jnp.asarray(k_all), jnp.asarray(v_all)]
+        if self.cfg.quantized:
+            ks = np.zeros((handle.k_scale.shape[0], w)
+                          + handle.k_scale.shape[2:], handle.k_scale.dtype)
+            vs = np.zeros_like(ks)
+            ks[:, :handle.n_pages] = handle.k_scale
+            vs[:, :handle.n_pages] = handle.v_scale
+            args += [jnp.asarray(ks), jnp.asarray(vs)]
         # pad rows scatter zeros into the null page — never read unmasked
-        self.pools = self._scatter_jit(
-            self.pools, jnp.asarray(self._padded_idx(pages)),
-            jnp.asarray(k_all), jnp.asarray(v_all))
+        self.pools = self._scatter_jit(self.pools, *args)
         self._slot_pages[slot] = pages
         self.page_table[slot, :] = NULL_PAGE
         self.page_table[slot, :len(pages)] = pages
@@ -587,6 +949,7 @@ class PagedKVCache:
     def release(self, slot: int) -> None:
         pages = self._slot_pages.pop(slot, None)
         self._slot_cached.pop(slot, None)
+        self._slot_restored.pop(slot, None)
         if pages:
             self._release_pages(pages)
         self.page_table[slot, :] = NULL_PAGE
@@ -600,13 +963,19 @@ class PagedKVCache:
         the obs step-timeline records, so the two surfaces can never
         disagree about page pressure within a step."""
         a = self.allocator
+        t = self.host_tier
         return {"pages_in_use": a.pages_in_use,
                 "free_pages": a.num_free,
                 "reclaimable_pages": a.num_reclaimable,
                 "usable_pages": self.cfg.usable_pages,
                 "shared_pages": self.shared_page_count(),
                 "cow_copies": self.cow_copies,
-                "evictions": self.evictions}
+                "evictions": self.evictions,
+                "host_tier_pages": len(t) if t is not None else 0,
+                "host_tier_bytes": t.bytes if t is not None else 0,
+                "host_tier_hits": self.host_tier_hits,
+                "host_tier_spills": self.spills,
+                "host_tier_restores": self.restores}
 
     # --------------------------------------------------------- invariants
     def check_invariants(self) -> None:
@@ -635,3 +1004,12 @@ class PagedKVCache:
         holds = Counter(held)
         assert all(holds[p] <= a.refcount(p) for p in holds), \
             "a page table may never hold more references than its refcount"
+        if self.host_tier is not None:
+            t = self.host_tier
+            assert t.bytes == sum(e.nbytes for e in t._entries.values()), \
+                "host-tier byte accounting must match its entries"
+            assert t.bytes <= t.max_bytes, \
+                "host tier exceeded its declared byte bound"
+            assert not (set(t._entries) & set(self._key_to_page)), \
+                "a content key reachable both on device and in the host " \
+                "tier would make the tier copy silently stale"

@@ -37,6 +37,19 @@ Prefix-cache counters/gauges (pre-seeded like the resilience set):
 - serving_prefix_cow_copies    shared pages privatized before a write
 - serving_prefix_evictions     reusable pages reclaimed under pool pressure
 
+KV quantization + host cache tier (pre-seeded like everything else):
+
+- serving_kv_bytes_per_token      gauge: device bytes one resident token
+                                  costs across layers (codes + amortized
+                                  scales), set at construction — 4x lower
+                                  under kv_dtype="int8"
+- serving_host_tier_pages         gauge: spilled prefix pages resident in
+                                  the host tier now
+- serving_host_tier_bytes         gauge: host bytes the tier holds now
+- serving_host_tier_hits_total    admissions that restored >= 1 page
+- serving_host_tier_spills_total  pages spilled at eviction sweeps
+- serving_host_tier_restores_total pages restored on prefix hits
+
 Chunked prefill + SLO admission (pre-seeded like everything else):
 
 - serving_prefill_chunks_total  prefill chunks executed (a full prefill
@@ -126,6 +139,9 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "prefix_hits", "prefix_misses", "prefix_tokens_saved",
            "prefix_shared_pages", "prefix_cached_pages",
            "prefix_cow_copies", "prefix_evictions",
+           "kv_bytes_per_token", "host_tier_pages", "host_tier_bytes",
+           "host_tier_hits_total", "host_tier_spills_total",
+           "host_tier_restores_total",
            "analysis_retraces_total", "analysis_host_syncs_total",
            "hlo_collective_ops", "hlo_host_transfers",
            "hlo_peak_hbm_bytes", "hlo_flops_per_step",
@@ -246,10 +262,19 @@ class ServingMetrics:
     def on_decode_step(self) -> None:
         monitor.stat_add(PREFIX + "decode_steps", 1)
 
+    def on_kv_bytes_per_token(self, nbytes: int) -> None:
+        """Device bytes one resident token costs (set once at engine
+        construction — a static consequence of kv_dtype + the model
+        shape, the denominator capacity dashboards divide HBM by)."""
+        monitor.stat_set(PREFIX + "kv_bytes_per_token", int(nbytes))
+
     def on_state(self, queue_depth: int, active: int, pages_used: int,
                  usable_pages: int, shared_pages: int = 0,
                  cached_pages: int = 0, cow_copies: int = 0,
-                 evictions: int = 0) -> None:
+                 evictions: int = 0, host_tier_pages: int = 0,
+                 host_tier_bytes: int = 0, host_tier_hits: int = 0,
+                 host_tier_spills: int = 0,
+                 host_tier_restores: int = 0) -> None:
         monitor.stat_set(PREFIX + "queue_depth", queue_depth)
         monitor.stat_set(PREFIX + "active_requests", active)
         monitor.stat_set(PREFIX + "page_pool_used", pages_used)
@@ -262,6 +287,13 @@ class ServingMetrics:
         # cache-owned monotonic counters, mirrored as absolute values
         monitor.stat_set(PREFIX + "prefix_cow_copies", cow_copies)
         monitor.stat_set(PREFIX + "prefix_evictions", evictions)
+        monitor.stat_set(PREFIX + "host_tier_pages", host_tier_pages)
+        monitor.stat_set(PREFIX + "host_tier_bytes", host_tier_bytes)
+        monitor.stat_set(PREFIX + "host_tier_hits_total", host_tier_hits)
+        monitor.stat_set(PREFIX + "host_tier_spills_total",
+                         host_tier_spills)
+        monitor.stat_set(PREFIX + "host_tier_restores_total",
+                         host_tier_restores)
 
     def on_analysis(self, retraces: int, host_syncs: int) -> None:
         """CompileGuard/SyncTally totals, mirrored as absolute values (the

@@ -59,7 +59,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .kv_cache import PagedKVCache
+from .kv_cache import HostTierRestoreError, PagedKVCache
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 CANCELLED, FAILED, EXPIRED, SHED = "cancelled", "failed", "expired", "shed"
@@ -149,6 +149,11 @@ class Scheduler:
         self._admit_seq = itertools.count()
         self.preemption_count = 0
         self._head_skips = 0  # prefer_cached fairness counter
+        # (request, error) pairs whose host-tier restore failed mid-admit:
+        # the admission was undone (pool state = pre-admit), the request
+        # still sits in ``waiting`` — the engine drains this right after
+        # admit() and retires each FAILED
+        self.restore_failures: list[tuple[Request, Exception]] = []
 
     # ------------------------------------------------------------ admission
     @property
@@ -260,19 +265,31 @@ class Scheduler:
             if resume_only and req.preemptions == 0:
                 break
             slot = self._free_slots[-1]
+            spills0 = self.cache.spills
             if req.swap is not None:
                 if not self.cache.swap_in(slot, req.swap):
                     break
                 req.swap = None
                 req.cached_tokens = 0
                 req.resumed_from_swap = True
-            elif self.cache.admit(slot, req.prompt_len, tokens=req.prompt):
+            else:
+                try:
+                    ok = self.cache.admit(slot, req.prompt_len,
+                                          tokens=req.prompt, rid=req.rid)
+                except HostTierRestoreError as e:
+                    # the cache undid the whole admission (tier entries
+                    # dropped, pages freed, shares released); the request
+                    # stays queued HERE — the engine drains
+                    # restore_failures immediately after admit() and
+                    # retires it FAILED through the normal evict path
+                    self.restore_failures.append((req, e))
+                    break
+                if not ok:
+                    break
                 # admission cost is counted in UNIQUE pages: the cached
                 # whole-page prefix was mapped by refcount bump, so only
                 # the uncached tail consumed pool capacity
                 req.cached_tokens = self.cache.cached_tokens(slot)
-            else:
-                break
             self._free_slots.pop()
             if self.waiting[0] is req:
                 self.waiting.popleft()
@@ -283,9 +300,24 @@ class Scheduler:
             self.running[slot] = req
             admitted.append(req)
             if tr is not None:
+                # host-tier lifecycle instants, chronological: spills this
+                # admission forced (its allocation's eviction sweep), then
+                # the pages restored INTO it, then the admission itself
+                spilled = self.cache.spills - spills0
+                if spilled:
+                    tr.event(req.rid, "spill", pages=spilled)
+                restored = self.cache.restored_pages(slot)
+                if restored:
+                    tr.event(req.rid, "restore", pages=restored)
                 tr.event(req.rid, "admitted", slot=slot,
                          cached_tokens=req.cached_tokens)
         return admitted
+
+    def pop_restore_failures(self) -> list[tuple[Request, Exception]]:
+        """Drain the restore-failed (request, error) pairs recorded by
+        admit() — the engine retires each FAILED."""
+        out, self.restore_failures = self.restore_failures, []
+        return out
 
     # ------------------------------------------------------------- decoding
     def pick_victim(self) -> Request:

@@ -55,8 +55,12 @@ __all__ = ["TPContext"]
 
 #: the paged pool's sharded axis: [num_pages, page_size, HEADS, head_dim]
 _POOL_AXES = (None, None, "tp", None)
+#: a quantized pool's per-page scales: [num_pages, HEADS]
+_SCALE_AXES = (None, "tp")
 #: a swap gather/scatter payload: [layers, pages, page_size, HEADS, head_dim]
 _KV_STACK_AXES = (None, None, None, "tp", None)
+#: a swap payload's scale stack: [layers, pages, HEADS]
+_SCALE_STACK_AXES = (None, None, "tp")
 
 
 class TPContext:
@@ -146,18 +150,28 @@ class TPContext:
             placed[name] = jax.device_put(arr, self._sharding(*axes))
         return placed
 
-    def _pool_specs(self, num_layers: int):
+    def _pool_specs(self, num_layers: int, quantized: bool = False):
         from jax.sharding import PartitionSpec as P
 
         spec = P(*_POOL_AXES)
-        return [{"k_pool": spec, "v_pool": spec} for _ in range(num_layers)]
+        leaf = {"k_pool": spec, "v_pool": spec}
+        if quantized:
+            # the per-page-per-head scales shard the SAME heads axis as
+            # the codes they dequantize — every device dequantizes its own
+            # heads locally, so quantization adds zero collectives
+            leaf |= {"k_scale": P(*_SCALE_AXES), "v_scale": P(*_SCALE_AXES)}
+        return [dict(leaf) for _ in range(num_layers)]
 
     def shard_pools(self, pools: list) -> list:
-        """Shard the freshly initialized per-layer pools on the heads axis."""
+        """Shard the freshly initialized per-layer pools on the heads axis
+        (codes and, quantized, their per-page scale leaves)."""
         import jax
 
-        sh = self._sharding(*_POOL_AXES)
-        return [{k: jax.device_put(v, sh) for k, v in pl.items()}
+        pool_sh = self._sharding(*_POOL_AXES)
+        scale_sh = self._sharding(*_SCALE_AXES)
+        return [{k: jax.device_put(v, scale_sh if k.endswith("_scale")
+                                   else pool_sh)
+                 for k, v in pl.items()}
                 for pl in pools]
 
     # -------------------------------------------------------- step wrappers
@@ -172,7 +186,8 @@ class TPContext:
         return shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                          out_specs=out_specs, check_rep=False)
 
-    def wrap_step(self, fn, num_layers: int, n_rest: int):
+    def wrap_step(self, fn, num_layers: int, n_rest: int,
+                  quantized: bool = False):
         """The engine step wrapper: ``fn(params, pools, *rest) ->
         (new_pools, tok)`` becomes a sharded program — params and pools
         enter under their shard specs, the ``n_rest`` host-built operands
@@ -189,26 +204,32 @@ class TPContext:
             with tp_axis(self.AXIS):
                 return fn(p, pools, *rest)
 
-        pool = self._pool_specs(num_layers)
+        pool = self._pool_specs(num_layers, quantized)
         return self._shard_map(
             stepped,
             in_specs=(dict(self.param_specs), pool) + (P(),) * n_rest,
             out_specs=(pool, P()))
 
-    def wrap_cache(self, fn, kind: str, num_layers: int):
+    def wrap_cache(self, fn, kind: str, num_layers: int,
+                   quantized: bool = False):
         """The paged cache's data movers, per-shard: the swap gather reads
         each device's pool shard into its slice of the layer-stacked
         [layers, pages, page_size, heads, head_dim] payload (host side
         reassembles the full handle), the swap scatter and COW copy write
-        each shard in place. Pure data movement on logical page indices —
-        zero collectives, certified by the tp2_swap/cow registry steps."""
+        each shard in place. Quantized pools move their int8 codes plus
+        the heads-sharded scale stacks the same way. Pure data movement on
+        logical page indices — zero collectives, certified by the
+        tp2_swap/cow registry steps."""
         from jax.sharding import PartitionSpec as P
 
-        pool = self._pool_specs(num_layers)
+        pool = self._pool_specs(num_layers, quantized)
         kv = P(*_KV_STACK_AXES)
+        sc = P(*_SCALE_STACK_AXES)
         in_specs, out_specs = {
-            "gather": ((pool, P()), (kv, kv)),
-            "scatter": ((pool, P(), kv, kv), pool),
+            "gather": ((pool, P()),
+                       (kv, kv, sc, sc) if quantized else (kv, kv)),
+            "scatter": ((pool, P(), kv, kv) + ((sc, sc) if quantized
+                                               else ()), pool),
             "copy": ((pool, P(), P()), pool),
         }[kind]
         return self._shard_map(fn, in_specs=in_specs, out_specs=out_specs)

@@ -200,14 +200,27 @@ class GPTAttention(nn.Layer):
         page_ids = jnp.take_along_axis(table, positions // page_size, axis=1)
         page_ids = jnp.where(valid, page_ids, 0)  # dead writes -> null page
         offsets = jnp.where(valid, positions % page_size, 0)
-        k_pool, v_pool = pa.paged_write(k_pool, v_pool, k_new, v_new,
-                                        page_ids, offsets)
-        out = pa.paged_attention(q, k_pool, v_pool, table, ctx)
+        if "k_scale" in cache:
+            # int8-quantized pool: quantize at scatter time (per-page-per-
+            # head absmax scales), dequantize inside the attention gather —
+            # the ragged mask, page tables, and everything downstream stay
+            # byte-for-byte layout-blind (serving/kv_cache.py kv_dtype)
+            k_pool, v_pool, k_sc, v_sc = pa.paged_write_quant(
+                k_pool, v_pool, cache["k_scale"], cache["v_scale"],
+                k_new, v_new, page_ids, offsets)
+            out = pa.paged_attention(q, k_pool, v_pool, table, ctx,
+                                     k_scale=k_sc, v_scale=v_sc)
+            scales = {"k_scale": k_sc, "v_scale": v_sc}
+        else:
+            k_pool, v_pool = pa.paged_write(k_pool, v_pool, k_new, v_new,
+                                            page_ids, offsets)
+            out = pa.paged_attention(q, k_pool, v_pool, table, ctx)
+            scales = {}
         # -1, not h: under tensor parallelism the local heads span h / tp
         # and the row-parallel out_proj contracts that local width
         out = Tensor(jnp.transpose(out, (0, 2, 1, 3)).reshape(b, s, -1)
                      .astype(x._value.dtype))
-        new_cache = dict(cache, k_pool=k_pool, v_pool=v_pool,
+        new_cache = dict(cache, k_pool=k_pool, v_pool=v_pool, **scales,
                          ctx_lens=ctx + jnp.sum(valid, axis=1,
                                                 dtype=jnp.int32))
         # row-parallel out_proj under tensor parallelism: each device

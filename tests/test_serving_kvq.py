@@ -1,0 +1,505 @@
+"""Quantized paged KV cache (kv_dtype="int8") + host-memory prefix spill
+tier. Pins the PR's contract end to end:
+
+- fp32 stays the default and byte-identical (no scale leaves, same pool
+  dtype, same kv_bytes_per_token math);
+- int8 pools store codes + per-page-per-head scales, compile_counts are
+  pinned EQUAL to fp32, the decode loop stays sync-free, and the greedy
+  token streams diverge from fp32 by no more than a pinned bound on the
+  tier-1 toy model (prefix-cache hit/cold parity is exact: cached pages
+  hold exactly the codes a cold prefill would write);
+- swap preemption and COW move codes + scales bit-exactly;
+- the hlocheck artifact audits: every donated int8 pool + scale leaf is
+  aliased, budgets (single-chip zero / TP 2L+1) are unchanged, and the
+  quantized pool's donated/aliased HBM is < 0.3x fp32;
+- the host tier: eviction spills refcount-0 indexed prefix pages (one
+  batched gather per sweep), a later prefix hit restores them BIT-EXACTLY
+  and counts as a prefix hit (prefill tokens saved pinned), the tier
+  honors its byte bound, restore_fail retires only the affected request,
+  and the spill/restore lifecycle shows up in traces + Chrome export.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import SyncTally
+from paddle_tpu.analysis.hlocheck import audit_guard, run_step
+from paddle_tpu.serving import (FaultInjector, HostTier, PagedCacheConfig,
+                                PagedKVCache, ServingConfig, ServingEngine,
+                                SpilledPage)
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.kvq
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(1234)
+    m = GPTForCausalLM(GPTConfig(vocab_size=97, hidden_size=32,
+                                 num_layers=2, num_heads=2,
+                                 max_seq_len=64, dropout=0.0))
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    cfg = dict(max_batch=2, num_pages=32, page_size=4, max_prompt_len=16)
+    cfg.update(kw)
+    return ServingEngine(model, ServingConfig(**cfg))
+
+
+def _prompts(lens=(5, 9, 12)):
+    rng = np.random.RandomState(3)
+    return [rng.randint(0, 97, (n,)).astype(np.int32) for n in lens]
+
+
+def _run_all(eng, prompts, new=6):
+    for p in prompts:
+        eng.add_request(p, new)
+    outs = eng.run()
+    return [outs[k] for k in sorted(outs)]
+
+
+# ------------------------------------------------------------- validation
+def test_kv_dtype_and_tier_validation(model):
+    with pytest.raises(ValueError, match="kv_dtype"):
+        _engine(model, kv_dtype="fp8")
+    with pytest.raises(ValueError, match="kv_dtype"):
+        PagedKVCache(PagedCacheConfig(num_layers=1, num_heads=1, head_dim=4,
+                                      kv_dtype="int4"))
+    with pytest.raises(ValueError, match="host_tier_bytes"):
+        PagedKVCache(PagedCacheConfig(num_layers=1, num_heads=1, head_dim=4,
+                                      host_tier_bytes=-1))
+    # the tier spills INDEXED prefix pages: prefix caching is a hard dep
+    with pytest.raises(ValueError, match="prefix"):
+        _engine(model, host_tier_bytes=1 << 20,
+                enable_prefix_caching=False)
+
+
+def test_fp32_default_pools_unchanged(model):
+    eng = _engine(model)
+    for pl in eng.cache.pools:
+        assert set(pl) == {"k_pool", "v_pool"}
+        assert pl["k_pool"].dtype == np.float32
+    assert eng.cache.cfg.kv_bytes_per_token == \
+        2 * 2 * 2 * 16 * 4  # 2(kv) * layers * heads * head_dim * itemsize
+    assert eng.cache.host_tier is None
+
+
+def test_int8_pools_store_codes_and_scales(model):
+    eng = _engine(model, kv_dtype="int8")
+    for pl in eng.cache.pools:
+        assert set(pl) == {"k_pool", "v_pool", "k_scale", "v_scale"}
+        assert pl["k_pool"].dtype == np.int8
+        assert pl["k_scale"].dtype == np.float32
+        assert pl["k_scale"].shape == (32, 2)  # [num_pages, heads]
+    # codes + amortized per-page scales: 4x+ under the fp32 figure
+    q8 = eng.cache.cfg.kv_bytes_per_token
+    assert q8 < 0.3 * (2 * 2 * 2 * 16 * 4)
+
+
+# ------------------------------------------------- quality + compile pins
+def test_int8_compile_counts_pinned_equal_fp32_and_sync_free(model):
+    prompts = _prompts()
+    e32 = _engine(model)
+    o32 = _run_all(e32, prompts)
+    e8 = _engine(model, kv_dtype="int8")
+    for p in prompts:
+        e8.add_request(p, 6)
+    pre = e8.metrics.snapshot()
+    with SyncTally() as tally:
+        outs = e8.run()
+    o8 = [outs[k] for k in sorted(outs)]
+    # compile-once is quantization-blind: same guard counts, same dict
+    assert e8.compile_counts == e32.compile_counts
+    assert e8.compile_counts["decode"] == 1
+    assert e8.cache.compile_counts == e32.cache.compile_counts
+    # the sync-free certification formula is unchanged in int8 mode
+    snap = e8.metrics.snapshot()
+    fetches = int(snap["serving_decode_steps"] - pre["serving_decode_steps"]
+                  + snap["serving_prefills_total"]
+                  - pre["serving_prefills_total"])
+    assert tally.count == fetches
+    # greedy divergence vs fp32 bounded on the toy model: the pinned
+    # threshold (mean common-prefix fraction of the full token streams)
+    # is deliberately loose — measured 1.0 here, bound at 0.5
+    fracs = []
+    for a, b in zip(o32, o8):
+        common = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            common += 1
+        fracs.append(common / len(a))
+    assert np.mean(fracs) >= 0.5, f"divergence too high: {fracs}"
+
+
+def test_int8_prefix_hit_parity_exact(model):
+    """Cached pages hold exactly the codes a cold prefill would write
+    (same tokens, same exact-zero-masked prefix, deterministic quantizer),
+    so greedy outputs are bit-identical cache-on/hit vs cache-off."""
+    rng = np.random.RandomState(11)
+    shared = rng.randint(0, 97, (8,)).astype(np.int32)
+    prompts = [np.concatenate([shared, rng.randint(0, 97, (4,))
+                               .astype(np.int32)]) for _ in range(3)]
+    e_on = _engine(model, kv_dtype="int8")
+    outs_on = []
+    for p in prompts:  # sequential: later prompts HIT the shared pages
+        rid = e_on.add_request(p, 6)
+        outs_on.append(e_on.run()[rid])
+    assert e_on.metrics.snapshot()["serving_prefix_hits"] >= 2
+    e_off = _engine(model, kv_dtype="int8", enable_prefix_caching=False)
+    for p, on in zip(prompts, outs_on):
+        rid = e_off.add_request(p, 6)
+        assert np.array_equal(e_off.run()[rid], on)
+
+
+def test_int8_swap_preemption_bit_exact(model):
+    """Swap handles carry codes + scales; a preempted int8 request resumes
+    with bit-identical output to an unpreempted run."""
+    prompts = _prompts(lens=(9, 10))
+    ref = _run_all(_engine(model, num_pages=32, kv_dtype="int8"),
+                   prompts, new=14)
+    eng = _engine(model, num_pages=9, kv_dtype="int8",
+                  preemption_mode="swap", debug_checks=True)
+    outs = _run_all(eng, prompts, new=14)
+    snap = eng.metrics.snapshot()
+    assert snap["serving_swap_outs"] > 0 and snap["serving_swap_ins"] > 0
+    assert all(np.array_equal(a, b) for a, b in zip(ref, outs))
+
+
+def test_int8_cow_copies_codes_and_scales(model):
+    """A fully-cached prompt admitted beside its live twin privatizes the
+    last page — codes AND scales — before the one sanctioned rewrite."""
+    rng = np.random.RandomState(13)
+    p = rng.randint(0, 97, (8,)).astype(np.int32)  # 2 full pages
+    eng = _engine(model, kv_dtype="int8", debug_checks=True)
+    r1 = eng.add_request(p, 10)  # long holder: stays running
+    eng.step()  # prefill r1 -> its prompt pages register in the index
+    r2 = eng.add_request(p, 2)   # full hit while r1 still holds the pages
+    outs = eng.run()
+    assert eng.cache.cow_copies == 1
+    assert np.array_equal(outs[r1][:len(outs[r2])], outs[r2])
+
+
+# --------------------------------------------------- hlocheck/HBM audits
+def test_q8_registry_steps_certify_and_alias_all_leaves():
+    dec = run_step("engine_decode_q8")
+    assert dec.collectives == () and dec.host_transfers == ()
+    # 2 layers x (k_pool, v_pool, k_scale, v_scale) all donated + aliased
+    assert dec.donated_leaves == 8 == dec.aliased_leaves
+    gather = run_step("swap_gather_q8")
+    assert gather.donated_leaves == 0 and gather.collectives == ()
+    scatter = run_step("swap_scatter_q8")
+    assert scatter.donated_leaves == 8 == scatter.aliased_leaves
+
+
+def test_quantized_pool_hbm_under_0p3x_fp32(model):
+    """The ISSUE's pinned capacity claim, read off the compiled artifact:
+    on a pool-dominated config the decode step's donated (pool) bytes and
+    its peak HBM both shrink below 0.3x fp32."""
+    import jax.numpy as jnp
+
+    def decode_report(kv_dtype):
+        # pool-dominated on purpose: 4096 pages x 4 tokens -> the fp32
+        # pool is ~8 MiB against ~120 KiB of params, so the ratio reads
+        # the POOL, not the model
+        eng = ServingEngine(model, ServingConfig(
+            max_batch=2, num_pages=4096, page_size=4, max_prompt_len=8,
+            kv_dtype=kv_dtype))
+        args = (eng._p, eng.cache.pools,
+                jnp.asarray(eng.cache.page_table), jnp.asarray(eng._ctx),
+                jnp.asarray(eng._last_tok), jnp.asarray(eng._active),
+                jnp.asarray(eng._rids), jnp.asarray(eng._gen))
+        return audit_guard(eng._decode_jit, args, name=f"decode-{kv_dtype}")
+
+    r32 = decode_report("float32")
+    r8 = decode_report("int8")
+    assert r8.donated_leaves == r8.aliased_leaves
+    assert r8.donated_bytes < 0.3 * r32.donated_bytes
+    assert r8.peak_bytes < 0.3 * r32.peak_bytes
+
+
+def test_tp2_int8_decode_certifies_same_budget():
+    """TP x quantization: the sharded int8 decode certifies against the
+    UNCHANGED 2L+1 all-reduce budget (quantization adds no collectives)
+    with every donated code + scale shard aliased."""
+    rep = run_step("tp2_engine_decode_q8")
+    assert rep.counts() == {"all-reduce": 5}  # 2*2 layers + 1 logits
+    assert rep.donated_leaves == 8 == rep.aliased_leaves
+
+
+@pytest.mark.slow  # tier-1 budget: the TP x int8 composition is pinned by
+# tp2_engine_decode_q8 (budget + aliasing, tier-1 above) plus the fp32
+# TP parity suite (-m tp); the full two-engine parity run gates rounds
+def test_tp2_int8_outputs_bit_identical_tp1(model):
+    import itertools
+
+    from paddle_tpu.serving import scheduler as sched_mod
+
+    prompts = _prompts()
+
+    def run(tp):
+        sched_mod._rid_counter = itertools.count(31000)
+        eng = ServingEngine(model, ServingConfig(
+            max_batch=2, num_pages=16, page_size=4, max_prompt_len=16,
+            kv_dtype="int8", tensor_parallel=tp))
+        return _run_all(eng, prompts)
+
+    assert all(np.array_equal(a, b) for a, b in zip(run(1), run(2)))
+
+
+# ------------------------------------------------------- host spill tier
+_PS = 4                      # page size used by the tier tests
+_SYS_TOKENS = 16             # 4 full shareable pages
+
+
+def _tier_engine(model, kv_dtype="float32", tier_bytes=1 << 20, **kw):
+    cfg = dict(max_batch=2, num_pages=14, page_size=_PS, max_prompt_len=32,
+               kv_dtype=kv_dtype, host_tier_bytes=tier_bytes,
+               debug_checks=True)
+    cfg.update(kw)
+    return ServingEngine(model, ServingConfig(**cfg))
+
+
+def _system_prompt():
+    rng = np.random.RandomState(29)
+    return rng.randint(0, 97, (_SYS_TOKENS,)).astype(np.int32)
+
+
+def _pressure(eng, n=2, lens=22, new=2, seed=31):
+    """Cold whales that force the LRU sweep through the parked system
+    pages WITHOUT oversubscribing the pool: two concurrent 6-page whales
+    demand 12 of the 13 usable pages, so the allocator evicts exactly the
+    oldest parked pages (the system chain) instead of preempt-thrashing."""
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        eng.add_request(rng.randint(0, 97, (lens,)).astype(np.int32), new)
+    eng.run()
+
+
+def _gather_pages(cache, pages):
+    """Raw device bytes of the named pages via the jitted swap gather —
+    the bit-exactness witness for the spill/restore round trip."""
+    import jax.numpy as jnp
+
+    got = cache._gather_jit(cache.pools,
+                            jnp.asarray(cache._padded_idx(pages)))
+    return [np.asarray(a)[:, :len(pages)].copy() for a in got]
+
+
+@pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+def test_evict_spill_hit_restore_roundtrip_bit_exact(model, kv_dtype):
+    """The tentpole round trip: a warm prefix's pages are captured, the
+    pool is thrashed (eviction -> spill), and a re-admission restores the
+    SAME bytes into fresh pages — codes and scales bit-identical — while
+    counting as a prefix hit with the prefill tokens saved pinned."""
+    system = _system_prompt()
+    eng = _tier_engine(model, kv_dtype=kv_dtype)
+    tail = np.asarray([1, 2, 3], np.int32)
+    eng.add_request(np.concatenate([system, tail]), 4)
+    eng.run()
+    # the registered system pages, in chain order, still resident
+    keys_before = dict(eng.cache._key_to_page)
+    sys_pages = eng.cache.match_prefix(system)
+    assert len(sys_pages) == _SYS_TOKENS // _PS
+    before = _gather_pages(eng.cache, sys_pages)
+    serials = [eng.cache._page_serial[p] for p in sys_pages]
+
+    _pressure(eng)  # wipes the pool: every parked page spills
+    st = eng.cache.stats()
+    assert st["host_tier_pages"] > 0 and st["host_tier_spills"] >= \
+        len(sys_pages)
+    assert eng.cache.match_prefix(system) == []  # gone from the device
+
+    pre = eng.metrics.snapshot()
+    tail2 = np.asarray([7, 8, 9], np.int32)
+    rid = eng.add_request(np.concatenate([system, tail2]), 4)
+    out = eng.run()[rid]
+    assert out is not None
+    snap = eng.metrics.snapshot()
+    # restored pages count as a prefix hit; ONLY the tail was prefilled
+    assert snap["serving_prefix_hits"] - pre["serving_prefix_hits"] == 1
+    assert snap["serving_prefix_tokens_saved"] \
+        - pre["serving_prefix_tokens_saved"] == _SYS_TOKENS
+    assert snap["serving_prefill_tokens_total"] \
+        - pre["serving_prefill_tokens_total"] == len(tail2)
+    assert snap["serving_host_tier_restores_total"] >= len(sys_pages)
+    assert snap["serving_host_tier_hits_total"] >= 1
+    # the lifecycle surfaced: this admission's trace carries the restore
+    names = [e.name for e in eng.trace(rid).events]
+    assert "restore" in names and \
+        names.index("restore") < names.index("admitted")
+    # bit-exactness: the restored pages hold the captured bytes, under
+    # their ORIGINAL chain serials (descendant keys stay reachable)
+    new_pages = eng.cache.match_prefix(system)
+    assert len(new_pages) == len(sys_pages)
+    after = _gather_pages(eng.cache, new_pages)
+    for a, b in zip(before, after):
+        assert a.dtype == b.dtype and np.array_equal(a, b)
+    assert [eng.cache._page_serial[p] for p in new_pages] == serials
+    assert keys_before.keys() >= \
+        {eng.cache._page_key[p] for p in new_pages}
+    eng.cache.check_invariants()
+
+
+@pytest.mark.slow  # tier-1 budget: the tier-key/device-index disjointness
+# invariant is swept by check_invariants under debug_checks in EVERY
+# tier-1 host-tier test above; this re-registration scenario gates rounds
+def test_spilled_page_outlives_generated_registration(model):
+    """Registration of new device pages drops a stale tier twin: the
+    device index always wins, and the invariant sweep (no key reachable
+    both on device and in the tier) holds across the whole lifecycle."""
+    system = _system_prompt()
+    eng = _tier_engine(model)
+    eng.add_request(np.concatenate([system, [1, 2, 3]]).astype(np.int32), 4)
+    eng.run()
+    _pressure(eng)
+    tier_keys = set(eng.cache.host_tier._entries)
+    assert tier_keys
+    # a fresh identical prompt restores (not re-registers) — but even if
+    # content re-registers through the generated span, invariants hold
+    eng.add_request(np.concatenate([system, [1, 2, 3]]).astype(np.int32), 4)
+    eng.run()
+    eng.cache.check_invariants()
+
+
+def test_kv_bytes_per_token_tracks_model_dtype():
+    """The gauge reads the POOL's real itemsize: a bf16 model's fp32-path
+    pools cost 2 B/elem, not a hardcoded 4 (capacity dashboards divide
+    HBM by this figure)."""
+    import jax.numpy as jnp
+
+    per = 2 * 2 * 2 * 4  # 2(kv) * layers * heads * head_dim
+    f32 = PagedCacheConfig(num_layers=2, num_heads=2, head_dim=4)
+    bf16 = PagedCacheConfig(num_layers=2, num_heads=2, head_dim=4,
+                            dtype=jnp.bfloat16)
+    assert f32.kv_bytes_per_token == per * 4
+    assert bf16.kv_bytes_per_token == per * 2
+
+
+def test_tier_probe_does_not_reorder_lru():
+    """cached_prefix_tokens is a PROBE: the scheduler's degraded-mode
+    warm-waiter scan runs it every step for every waiter, so it must not
+    promote never-admitted entries over genuinely warm ones — only a
+    touching get() (the admit/restore path) reorders the tier LRU."""
+    t = HostTier(max_bytes=100)
+
+    def entry(i):
+        return SpilledPage(key=(0, (i,)), serial=i,
+                           k=np.zeros(20, np.int8), v=np.zeros(20, np.int8))
+
+    t.put(entry(1))
+    t.put(entry(2))
+    assert t.get((0, (1,)), touch=False) is not None  # probe: no reorder
+    t.put(entry(3))  # bound forces a drop: 1 is STILL the oldest
+    assert t.get((0, (1,))) is None
+    assert t.get((0, (2,))) is not None
+    # a touching get promotes: now 3 is older than 2
+    t.put(entry(4))
+    assert t.get((0, (3,))) is None and t.get((0, (2,))) is not None
+
+
+def test_host_tier_byte_bound_drops_oldest():
+    t = HostTier(max_bytes=100)
+
+    def entry(i, nbytes=40):
+        return SpilledPage(key=(0, (i,)), serial=i,
+                           k=np.zeros(nbytes // 2, np.int8),
+                           v=np.zeros(nbytes - nbytes // 2, np.int8))
+
+    t.put(entry(1))
+    t.put(entry(2))
+    assert t.bytes == 80 and len(t) == 2
+    t.put(entry(3))  # 120 > 100: oldest (1) drops
+    assert t.bytes == 80 and t.get((0, (1,))) is None
+    assert t.get((0, (2,))) is not None
+    t.put(entry(4, nbytes=200))  # larger than the whole bound: refused
+    assert t.get((0, (4,))) is None and t.bytes == 80
+    # replacing a key never double-counts
+    t.put(entry(2))
+    assert t.bytes == 80 and len(t) == 2
+
+
+def test_restore_fail_retires_request_survivors_keep_serving(model):
+    """The new fault point: a failed host-tier restore retires ONLY the
+    re-admitted request (FAILED, error recorded, stale tier entries
+    dropped); everyone else keeps serving and page accounting drains."""
+    system = _system_prompt()
+    inj = FaultInjector()
+    eng = ServingEngine(
+        model,
+        ServingConfig(max_batch=2, num_pages=14, page_size=_PS,
+                      max_prompt_len=32, host_tier_bytes=1 << 20,
+                      debug_checks=True),
+        fault_injector=inj)
+    eng.add_request(np.concatenate([system, [1, 2, 3]]).astype(np.int32), 4)
+    eng.run()
+    _pressure(eng)
+    assert len(eng.cache.host_tier) > 0
+    head_key = (0, tuple(int(t) for t in system[:_PS]))
+    assert head_key in eng.cache.host_tier._entries
+
+    inj.arm("restore_fail")  # next restore, any step, any rid
+    doomed = eng.add_request(
+        np.concatenate([system, [7, 8, 9]]).astype(np.int32), 4)
+    survivor = eng.add_request(
+        np.asarray([5, 6, 7, 8, 9], np.int32), 4)
+    outs = eng.run()
+    assert eng.status(doomed) == "failed"
+    assert "restore_fail" in str(eng.request(doomed).error)
+    assert survivor in outs  # the batch kept serving
+    # the stale entries the failed restore touched are gone from the tier
+    # (the sweep that ran BEFORE the failure may have spilled new ones —
+    # those are fine; the system chain must be dropped)
+    assert head_key not in eng.cache.host_tier._entries
+    assert eng.cache.cached_prefix_tokens(system) == 0
+    assert any(pt == "restore_fail" and rid == doomed
+               for pt, _, rid in inj.fired)
+    # no leaked pages: the undone admission left the pool accounted
+    eng.cache.check_invariants()
+    final = eng.run()  # drains cleanly
+    assert eng.cache.allocator.pages_in_use == 0 or final is not None
+
+
+@pytest.mark.slow  # tier-1 budget: restore accounting (hits/saved tokens)
+# is pinned tier-1 by the roundtrip test; the trace/Chrome surface of the
+# same events gates rounds
+def test_spill_restore_trace_events_and_chrome_instants(model):
+    system = _system_prompt()
+    eng = _tier_engine(model)
+    eng.add_request(np.concatenate([system, [1, 2, 3]]).astype(np.int32), 4)
+    eng.run()
+    _pressure(eng)
+    rid = eng.add_request(
+        np.concatenate([system, [7, 8, 9]]).astype(np.int32), 4)
+    eng.run()
+    names = [e.name for e in eng.trace(rid).events]
+    assert "restore" in names
+    assert names.index("restore") < names.index("admitted")
+    restore = eng.trace(rid).first("restore")
+    assert restore.arg("pages") == _SYS_TOKENS // _PS
+    # some admission in the pressure burst stamped the spills it forced
+    spilled = [t for t in eng.traces()
+               if any(e.name == "spill" for e in t.events)]
+    assert spilled, "no admission carried a spill event"
+    doc = eng.export_chrome_trace()
+    phases = {(ev.get("name"), ev.get("ph")) for ev in doc["traceEvents"]}
+    assert ("restore", "i") in phases and ("spill", "i") in phases
+
+
+def test_host_tier_gauges_preseeded_and_fed(model):
+    eng = _tier_engine(model, kv_dtype="int8")
+    snap = eng.metrics.snapshot()
+    for k in ("serving_kv_bytes_per_token", "serving_host_tier_pages",
+              "serving_host_tier_bytes", "serving_host_tier_hits_total",
+              "serving_host_tier_spills_total",
+              "serving_host_tier_restores_total"):
+        assert k in snap, f"{k} missing from a fresh snapshot"
+    assert snap["serving_kv_bytes_per_token"] == \
+        eng.cache.cfg.kv_bytes_per_token > 0
+    assert snap["serving_host_tier_pages"] == 0
+    # prometheus types: the _total mirrors export as counters
+    text = eng.metrics.prometheus()
+    assert "# TYPE serving_host_tier_spills_total counter" in text
+    assert "# TYPE serving_host_tier_pages gauge" in text
