@@ -444,13 +444,20 @@ def _build_engine_step(which: str, tensor_parallel: int = 1,
     must hold (quantization is per-device arithmetic — zero extra
     collectives), and the donated int8 pools + scale leaves must all
     alias (a donated-but-copied quantized pool would silently forfeit
-    the 4x HBM win the mode exists for)."""
+    the 4x HBM win the mode exists for). ``which="verify_spec"`` builds
+    the speculative-decoding verify step (serving/spec.py, n-gram
+    proposer at depth 2): the in-jit propose + K+1-token ragged verify +
+    accept count as ONE program — zero collectives single-chip, the
+    target's own 2L+1 all-reduces (and not one more: the proposer adds
+    no collectives) under tensor parallelism, donated pools aliased
+    either way."""
     import jax.numpy as jnp
     import numpy as np
 
     import paddle_tpu as paddle
 
     from ..serving.engine import ServingConfig, ServingEngine
+    from ..serving.spec import SpecConfig
     from ..text.gpt import GPTConfig, GPTForCausalLM
 
     paddle.seed(7)
@@ -458,9 +465,18 @@ def _build_engine_step(which: str, tensor_parallel: int = 1,
         vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
         max_seq_len=32, dropout=0.0))
     model.eval()
+    spec = (SpecConfig(method="ngram", depth=2)
+            if which == "verify_spec" else None)
     eng = ServingEngine(model, ServingConfig(
         max_batch=2, num_pages=16, page_size=4, max_prompt_len=8,
-        tensor_parallel=tensor_parallel, kv_dtype=kv_dtype))
+        tensor_parallel=tensor_parallel, kv_dtype=kv_dtype, spec=spec))
+    if which == "verify_spec":
+        args = (eng._p, eng.cache.pools,
+                jnp.asarray(eng.cache.page_table), jnp.asarray(eng._ctx),
+                jnp.asarray(eng._last_tok), jnp.asarray(eng._active),
+                jnp.asarray(eng._rids), jnp.asarray(eng._gen),
+                jnp.asarray(eng._spec_hist()))
+        return eng._verify_jit, args, None, eng._step_budget("verify")
     if which in ("prefill", "prefill_chunk"):
         bucket = eng.prefill_buckets[0]
         padded = np.zeros(bucket, np.int32)
@@ -582,6 +598,11 @@ REGISTRY: dict[str, StepSpec] = {s.name: s for s in (
              lambda: _build_engine_step("prefill_chunk")),
     StepSpec("engine_decode", "serving decode step, whole batch (toy GPT)",
              lambda: _build_engine_step("decode")),
+    StepSpec("engine_verify_spec", "speculative-decoding verify step: "
+             "in-jit n-gram propose + whole-batch K+1-token ragged "
+             "verify + accept count, one program (budget: zero "
+             "collectives, donated pools aliased)",
+             lambda: _build_engine_step("verify_spec")),
     StepSpec("tp8_decode", "toy tensor-parallel shard_map step on an "
              "8-device mesh: budget = exactly one all-reduce",
              _build_tp8_decode, min_devices=8),
@@ -603,6 +624,11 @@ REGISTRY: dict[str, StepSpec] = {s.name: s for s in (
     StepSpec("tp2_engine_decode", "TENSOR-PARALLEL serving decode step, "
              "whole batch (budget 2L+1 all-reduces)",
              lambda: _build_engine_step("decode", tensor_parallel=2),
+             min_devices=2),
+    StepSpec("tp2_engine_verify_spec", "TENSOR-PARALLEL speculative "
+             "verify step: the SAME 2L+1 all-reduce budget as decode — "
+             "the in-jit proposer adds zero collectives",
+             lambda: _build_engine_step("verify_spec", tensor_parallel=2),
              min_devices=2),
     StepSpec("tp2_swap_gather", "per-shard swap-out gather over the "
              "heads-sharded pools (budget: zero collectives)",

@@ -34,7 +34,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 __all__ = ["paged_write", "paged_write_quant", "paged_gather",
-           "paged_gather_quant", "paged_attention", "QMAX"]
+           "paged_gather_quant", "paged_attention", "ragged_mask", "QMAX"]
 
 #: symmetric int8 code range: codes in [-127, 127], dequant = code*scale/127
 QMAX = 127.0
@@ -91,6 +91,23 @@ def paged_write_quant(k_pool, v_pool, k_scale, v_scale, k_new, v_new,
     k_pool, k_scale = _write_quant(k_pool, k_scale, k_new, page_ids, offsets)
     v_pool, v_scale = _write_quant(v_pool, v_scale, v_new, page_ids, offsets)
     return k_pool, v_pool, k_scale, v_scale
+
+
+def ragged_mask(ctx_lens, total: int, num_query_tokens: int):
+    """The ragged causal-prefix mask every multi-token paged call shares:
+    query ``t`` of row ``b`` (entering at position ``ctx_lens[b] + t``)
+    sees gathered positions ``j <= ctx_lens[b] + t``, everything beyond
+    masked to EXACT zero probability. [batch, 1, num_query_tokens, total]
+    bool, broadcast over heads.
+
+    ``num_query_tokens`` is 1 for plain decode, the pad bucket for
+    prefill/chunk calls, and ``depth + 1`` for the speculative-decoding
+    verify step (serving/spec.py) — the pending token plus K candidates
+    verified in one pass, each candidate attending exactly the prefix a
+    sequential decode would have given it."""
+    j = jnp.arange(total)[None, None, None, :]
+    t = jnp.arange(num_query_tokens)[None, None, :, None]
+    return j <= ctx_lens.astype(jnp.int32)[:, None, None, None] + t
 
 
 def paged_gather(pool, page_table):
@@ -177,6 +194,12 @@ def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None,
     probability, so the fixed gather width never leaks padding. Returns
     [batch, heads, s, head_dim].
 
+    ``s`` is the num_query_tokens of the call: 1 for plain decode (the
+    Pallas kernel's case), the pad bucket for prefill, and ``depth + 1``
+    for the speculative-decoding verify step — a whole-batch ragged
+    multi-token decode through this same contract (the s > 1 decode-style
+    call always takes the composite gather + masked-sdpa path).
+
     ``k_scale``/``v_scale`` (both or neither): the pools are int8 codes
     under per-page-per-head scales — the gather dequantizes and the same
     ragged-masked sdpa runs on the reconstructed values (the Pallas kernel
@@ -188,9 +211,7 @@ def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None,
 
         k_all = paged_gather_quant(k_pool, k_scale, page_table, q.dtype)
         v_all = paged_gather_quant(v_pool, v_scale, page_table, q.dtype)
-        j = jnp.arange(k_all.shape[2])[None, None, None, :]
-        t = jnp.arange(s)[None, None, :, None]
-        mask = j <= ctx_lens.astype(jnp.int32)[:, None, None, None] + t
+        mask = ragged_mask(ctx_lens, k_all.shape[2], s)
         return _sdpa(q, k_all, v_all, mask=mask, scale=scale)
     if s == 1 and _use_pallas_decode(q, k_pool, page_table):
         try:
@@ -211,8 +232,5 @@ def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None,
 
     k_all = paged_gather(k_pool, page_table)  # [b, h, S, d]
     v_all = paged_gather(v_pool, page_table)
-    total = k_all.shape[2]
-    j = jnp.arange(total)[None, None, None, :]
-    t = jnp.arange(s)[None, None, :, None]
-    mask = j <= ctx_lens.astype(jnp.int32)[:, None, None, None] + t
+    mask = ragged_mask(ctx_lens, k_all.shape[2], s)
     return sdpa(q, k_all, v_all, mask=mask, scale=scale)

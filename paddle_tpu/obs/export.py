@@ -34,7 +34,8 @@ _PID = 1
 
 # lifecycle events that ALSO render as instants on the request's track
 _INSTANTS = ("preempted", "swap_out", "swap_in", "decode_mark",
-             "prefill_chunk", "retired", "spill", "restore")
+             "prefill_chunk", "retired", "spill", "restore",
+             "spec_verify")
 
 
 def _request_events(trace: RequestTrace) -> list[dict]:
@@ -109,6 +110,10 @@ def chrome_trace(traces=(), timeline: StepTimeline | None = None) -> dict:
                     "preemptions": rec.preemptions,
                     "queue_depth": rec.queue_depth,
                     "pages_in_use": rec.pages_in_use}
+            if rec.accepted:
+                # speculative decoding: candidates the verify accepted
+                # (tokens this step = batch + accepted)
+                args["accepted"] = rec.accepted
             if rec.host_syncs is not None:
                 args["host_syncs"] = rec.host_syncs
             args.update(rec.extra)

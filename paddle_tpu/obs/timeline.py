@@ -31,6 +31,8 @@ class StepRecord:
     queue_depth: int     # waiting requests after the step
     pages_in_use: int    # pool pages held after the step
     chunks: int = 0      # chunked-prefill chunks executed this step
+    accepted: int = 0    # speculative candidates accepted this step
+    # (ServingConfig(spec=); tokens emitted = batch + accepted per step)
     host_syncs: int | None = None  # SyncTally count (debug_checks only)
     extra: dict = field(default_factory=dict)  # exporter passthrough
 

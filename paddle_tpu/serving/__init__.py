@@ -32,6 +32,16 @@ prefix pages to host RAM, keeping their content-index keys, and restores
 them bit-exactly on the next prefix hit — warm system prompts survive
 far beyond HBM).
 
+Latency layer: speculative decoding (``spec=SpecConfig(...)`` — serving/
+spec.py) attacks TPOT at small batch, where continuous batching alone
+leaves the chips idle: each step proposes K candidate tokens per running
+request in-jit (a small draft model over a sliding window, or free
+prompt/output n-gram lookup) and verifies all K+1 in ONE batched ragged
+pass through the existing paged decode path, emitting 1..K+1 tokens per
+request per step with outputs bit-identical to plain decoding (greedy and
+sampling), one compiled verify program per depth, and the same single
+host fetch per step.
+
 Analysis layer (paddle_tpu.analysis): every jitted step sits behind a
 ``CompileGuard`` (trace counting, compile budgets, retrace explanations,
 donation checks) — ``ServingConfig(debug_checks=True)`` makes the guards
@@ -55,10 +65,11 @@ from .kv_cache import (HostTier, HostTierRestoreError,  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import EngineOverloaded, Request, Scheduler  # noqa: F401
 from .slo import SLOConfig, SLOController  # noqa: F401
+from .spec import SpecConfig  # noqa: F401
 
 __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "PagedKVCache", "PageAllocator", "SwapHandle", "ServingMetrics",
            "Request", "Scheduler", "EngineOverloaded", "FaultInjector",
            "InjectedFault", "prefill_buckets", "SLOConfig",
            "SLOController", "HostTier", "HostTierRestoreError",
-           "SpilledPage"]
+           "SpilledPage", "SpecConfig"]

@@ -41,6 +41,23 @@ decode step plus one per COMPLETED prefill. Outputs are bit-identical
 chunked or not — same KV bytes, same last-token logits (the PR 3
 exact-zero ragged masking argument, applied inductively per chunk).
 
+Speculative decoding (``ServingConfig(spec=SpecConfig(...))``): each step
+proposes ``depth`` candidate tokens per running request in-jit (a small
+stateless draft model over a sliding window, or n-gram lookup on the
+request's own token history — serving/spec.py) and verifies all K+1
+tokens in ONE batched ragged pass through the same paged decode path
+(queries at ``ctx_lens .. ctx_lens + K``), with accept/reject computed
+in-jit as a masked cumulative match against the target's own tokens.
+Because every emitted token is the TARGET's (greedy argmax, or the sample
+under the identical (seed, rid, token_idx) fold), outputs are
+bit-identical to plain decoding at any acceptance rate and preemption
+replay stays exact. The verify program compiles once per configured
+depth, the host fetches one packed [batch, K+2] array per step (the
+decode token fetch renamed — the sync-free certification formula is
+unchanged), the scheduler reserves K extra token slots per decoding
+request, and the rejected span's pages recycle through the refcounted
+allocator (``PagedKVCache.shrink``) as soon as the accept count lands.
+
 Tensor-parallel serving (``ServingConfig(tensor_parallel=N)``): the
 weights shard Megatron-style and the paged KV pool shards its heads axis
 across an N-device mesh (serving/tp.py), and the SAME step bodies run
@@ -148,6 +165,7 @@ from .scheduler import (CANCELLED, EXPIRED, FAILED, FINISHED, PREFILLING,
                         RUNNING, SHED, WAITING, EngineOverloaded, Request,
                         Scheduler)
 from .slo import SLOConfig, SLOController
+from .spec import SpecConfig, accept_counts, draft_window, propose_ngram
 
 
 @dataclass(frozen=True)
@@ -194,6 +212,16 @@ class ServingConfig:
     # beyond HBM. 0 = off (evictions purge, the PR 3 behavior).
     slo: SLOConfig | None = None  # SLO-adaptive chunk admission (needs
     # chunk_size > 0 and enable_tracing — it reads the obs histograms)
+    spec: SpecConfig | None = None  # speculative decoding (serving/
+    # spec.py): each step proposes depth=K candidate tokens per running
+    # request in-jit (a small draft model or prompt/output n-gram lookup)
+    # and verifies all K+1 in ONE batched ragged pass through the paged
+    # decode path, emitting 1..K+1 tokens per request per step. Outputs
+    # stay bit-identical to non-speculative decoding (greedy AND
+    # sampling: every emitted token is the target's own, under the same
+    # (seed, rid, token_idx) PRNG fold), the verify program compiles once
+    # per configured depth, and the host still fetches exactly one packed
+    # output per step. None = plain decode.
     debug_checks: bool = False  # strict CompileGuard + invariant sweep/step
     enable_tracing: bool = True  # per-request traces + step timeline (obs)
     trace_capacity: int = 2048  # retained traces (terminal evicted oldest)
@@ -219,11 +247,16 @@ class ServingEngine:
     cache contract of text/gpt.py works)."""
 
     def __init__(self, model, config: ServingConfig | None = None,
-                 clock=None, fault_injector=None):
+                 clock=None, fault_injector=None, draft_model=None):
         self.config = cfg = config or ServingConfig()
         self.model = model
         model.eval()
         mc = model.cfg
+        if draft_model is not None and (
+                cfg.spec is None or cfg.spec.method != "draft"):
+            raise ValueError(
+                "draft_model= is the spec proposer — it needs "
+                "ServingConfig(spec=SpecConfig(method='draft', ...))")
         if cfg.max_prompt_len > mc.max_seq_len:
             raise ValueError(
                 f"max_prompt_len {cfg.max_prompt_len} exceeds the model's "
@@ -256,6 +289,12 @@ class ServingEngine:
                 "host_tier_bytes gives evicted INDEXED prefix pages a "
                 "second life — enable_prefix_caching=False would leave "
                 "nothing to spill; enable it or drop the tier")
+        if cfg.spec is not None:
+            # bad method/depth/draft-shape mismatches fail here, not at
+            # the first verify trace; a prebuilt draft_model's real
+            # config wins over spec.draft
+            cfg.spec.validate(
+                mc, draft_model.cfg if draft_model is not None else None)
         if cfg.tensor_parallel > 1:
             # mesh + Megatron shard specs + shard_map wrappers; validates
             # divisibility (heads/hidden/ffn) and the visible device count
@@ -281,6 +320,7 @@ class ServingEngine:
         self.metrics = ServingMetrics()
         self.metrics.on_tp_degree(cfg.tensor_parallel)
         self.metrics.on_kv_bytes_per_token(self.cache.cfg.kv_bytes_per_token)
+        self.metrics.on_spec_depth(cfg.spec.depth if cfg.spec else 0)
         params, _ = model.functional_state()
         self._p = {k: v._value for k, v in params.items()}
         if self._tp is not None:
@@ -306,6 +346,34 @@ class ServingEngine:
             self.cache, cfg.max_batch, max_waiting=cfg.max_waiting,
             shed_policy=cfg.shed_policy, preemption_mode=cfg.preemption_mode,
             tracer=self._tracer)
+        # speculative decoding (serving/spec.py): proposer state plus the
+        # host-mirrored token-history buffer the proposers read — shipped
+        # with every verify call via _spec_hist (full buffer for n-gram,
+        # just the [max_batch, window] known-token slice for draft), a
+        # static shape either way so history growth never recompiles.
+        # Spec off costs one attribute check per step, nothing else.
+        if cfg.spec is not None:
+            self._spec = cfg.spec
+            # a verify step writes KV at ctx .. ctx + K before the accept
+            # count is known: admission and per-step growth must reserve
+            # those K slots (over-allocation recycles via cache.shrink)
+            self.scheduler.decode_reserve = cfg.spec.depth
+            self._hist = np.zeros((cfg.max_batch, mc.max_seq_len),
+                                  np.int32)
+            if cfg.spec.method == "draft":
+                if draft_model is None:
+                    from ..text.gpt import GPTForCausalLM
+                    draft_model = GPTForCausalLM(cfg.spec.draft)
+                draft_model.eval()
+                self._draft = draft_model
+                dp, _ = draft_model.functional_state()
+                self._draft_p = {k: v._value for k, v in dp.items()}
+            else:
+                self._draft = self._draft_p = None
+        else:
+            self._spec = None
+            self._hist = None
+            self._draft = self._draft_p = None
         self._fault_injector = fault_injector
         if fault_injector is not None and self.cache.host_tier is not None:
             # the restore_fail fault point: consulted by the cache right
@@ -374,6 +442,26 @@ class ServingEngine:
             budget=1, strict=cfg.debug_checks)
         self.guards = {"prefill": self._prefill_jit,
                        "decode": self._decode_jit}
+        if cfg.spec is not None:
+            # the speculative verify step: fixed depth K means ONE
+            # compiled program per configured K for the engine's lifetime
+            # — budget 1, like decode. Under tensor parallelism the
+            # replicated draft params (if any) ride as a replicated rest
+            # operand; the target's collectives are unchanged and the
+            # draft adds none (its psums are suppressed — see
+            # _propose_draft).
+            verify_impl = self._verify_impl
+            if self._tp is not None:
+                n_rest = 7 + (1 if cfg.spec.method == "draft" else 0)
+                verify_impl = self._tp.wrap_step(
+                    verify_impl, mc.num_layers, n_rest=n_rest,
+                    quantized=self.cache.cfg.quantized)
+            self._verify_jit = CompileGuard(
+                verify_impl, "verify", donate_argnums=(1,),
+                budget=1, strict=cfg.debug_checks)
+            self.guards["verify"] = self._verify_jit
+        else:
+            self._verify_jit = None
 
     # --------------------------------------------------------- jitted steps
     def _req_key(self, rid, t):
@@ -437,6 +525,85 @@ class ServingEngine:
         tok = jnp.where(active, tok,
                         jnp.asarray(self.config.pad_token_id)).astype(jnp.int32)
         return new_pools, tok
+
+    def _propose_draft(self, draft_p, win):
+        """The draft proposer, in-jit: decode K candidates greedily from a
+        fresh dense (non-paged) KV buffer over ``win`` — the request's
+        last ``window`` known tokens, right-aligned, sliced host-side by
+        ``_spec_hist`` — at window-relative positions. The buffer is created
+        zero-filled inside the trace every step — the draft carries no
+        state across steps, so preemption/prefix-cache/swap/quantization
+        never interact with it. Under tensor parallelism the draft is
+        replicated and its row-parallel psums are suppressed (every
+        device computes the identical candidates locally — zero extra
+        collectives, keeping the verify budget at the target's own
+        2*num_layers + 1)."""
+        from ..text.gpt import tp_axis
+
+        sp, dc = self.config.spec, self._draft.cfg
+        K, W = sp.depth, sp.window
+        b = win.shape[0]
+        dt = self._draft.gpt.wte.weight._value.dtype
+        shape = (b, dc.num_heads, W + K, dc.hidden_size // dc.num_heads)
+        caches = [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+                  for _ in range(dc.num_layers)]
+        with tp_axis(None):
+            (logits, caches), _ = self._draft.functional_call(
+                draft_p, {}, Tensor(win), caches=caches, pos=0)
+            tok = jnp.argmax(logits._value[:, -1, :], axis=-1)
+            cands = [tok.astype(jnp.int32)]
+            for j in range(1, K):
+                (logits, caches), _ = self._draft.functional_call(
+                    draft_p, {}, Tensor(tok[:, None]), caches=caches,
+                    pos=W + j - 1)
+                tok = jnp.argmax(logits._value[:, 0, :], axis=-1)
+                cands.append(tok.astype(jnp.int32))
+        return jnp.stack(cands, axis=1)  # [b, K]
+
+    def _verify_impl(self, p_arrays, pools, table, ctx, last_tok, active,
+                     rids, gen_idx, hist, draft_p=None):
+        """One speculative step for every running slot: propose K
+        candidates, verify all K+1 tokens (pending last token + the
+        candidates) in ONE ragged multi-token pass through the paged
+        decode path, and compute the accept count in-jit. Returns
+        (new_pools, packed [batch, K+2] int32): the target's own token at
+        each of the K+1 positions followed by the accept count — ONE
+        host fetch per step, exactly like plain decode's token vector.
+        Every emitted token is the TARGET's (argmax, or the sample under
+        the (seed, rid, token_idx) fold non-speculative decoding would
+        have drawn with the identical context), so acceptance only
+        decides how MANY of them this step emits — never their values.
+        Inactive slots run the same computation against the null page and
+        emit pad, branch-free."""
+        cfg = self.config
+        sp = cfg.spec
+        K = sp.depth
+        if sp.method == "draft":
+            # ``hist`` is already the right-aligned [batch, window]
+            # known-token context (_spec_hist slices it host-side)
+            cand = self._propose_draft(draft_p, hist)
+        else:
+            known = ctx.astype(jnp.int32) + 1  # resident + pending token
+            cand = propose_ngram(hist, known, K, sp.ngram,
+                                 cfg.pad_token_id)
+        cand = jnp.where(active[:, None], cand, cfg.pad_token_id)
+        ids = jnp.concatenate([last_tok[:, None], cand], axis=1)
+        valid = jnp.broadcast_to(active[:, None], ids.shape)
+        logits, new_pools = self._run_model(
+            p_arrays, pools, table, ctx, valid, ids)
+        if cfg.do_sample:
+            offs = jnp.arange(K + 1, dtype=jnp.int32)
+            keys = jax.vmap(lambda r, g: jax.vmap(
+                lambda j: self._req_key(r, g + j))(offs))(rids, gen_idx)
+            target = jax.vmap(jax.vmap(self._sample_row))(logits, keys)
+        else:
+            target = jnp.argmax(logits, axis=-1)
+        target = jnp.where(active[:, None], target.astype(jnp.int32),
+                           cfg.pad_token_id).astype(jnp.int32)
+        accepted = jnp.where(active, accept_counts(cand, target),
+                             0).astype(jnp.int32)
+        packed = jnp.concatenate([target, accepted[:, None]], axis=1)
+        return new_pools, packed
 
     # ------------------------------------------------------------ host loop
     @property
@@ -570,6 +737,33 @@ class ServingEngine:
         self._last_tok[slot] = self.config.pad_token_id
         self._rids[slot] = 0
         self._gen[slot] = 0
+        if self._hist is not None:
+            self._hist[slot] = 0
+
+    def _hist_sync(self, req: Request) -> None:
+        """Mirror a request's known tokens (prompt + generated) into its
+        row of the spec proposers' token-history buffer — the in-jit
+        n-gram lookup and the draft's context window both read it. One
+        attribute check when speculation is off."""
+        if self._hist is None:
+            return
+        row = self._hist[req.slot]
+        row[:] = 0
+        row[:req.prompt_len] = req.prompt
+        if req.generated:
+            row[req.prompt_len:req.prompt_len + len(req.generated)] = \
+                req.generated
+
+    def _spec_hist(self) -> np.ndarray:
+        """The history operand the verify dispatch ships. The n-gram
+        proposer scans the whole [max_batch, max_seq_len] mirror; the
+        draft proposer reads only its right-aligned window of known
+        tokens, so method="draft" slices [max_batch, window] host-side —
+        O(batch * window) H2D bytes per step instead of the full buffer.
+        Fixed shape either way: history growth never recompiles."""
+        if self._spec.method != "draft":
+            return self._hist
+        return draft_window(self._hist, self._ctx + 1, self._spec.window)
 
     def _restore_fault_probe(self, rid) -> bool:
         """Cache-side consult of the ``restore_fail`` fault point (armed
@@ -663,6 +857,7 @@ class ServingEngine:
         self._gen[slot] = 1
         req.state = RUNNING
         req.fresh = True
+        self._hist_sync(req)
         if tr is not None:
             # accounting reads prefix_hit_tokens, not cached_tokens: a
             # mid-prefill swap restore zeroes the latter, but this
@@ -797,6 +992,7 @@ class ServingEngine:
                 self._rids[slot] = req.rid
                 self._gen[slot] = len(req.generated)
                 req.fresh = True
+                self._hist_sync(req)
                 self.metrics.on_swap_in()
                 tr = self._tracer
                 if tr is not None:
@@ -892,6 +1088,7 @@ class ServingEngine:
             self._rids[req.slot] = req.rid
             self._gen[req.slot] = 1
             req.fresh = True
+            self._hist_sync(req)
             n_prefills += 1
             if tr is not None:
                 # prefill_end IS first-token time: the prefill pass samples
@@ -944,12 +1141,26 @@ class ServingEngine:
         if inj is not None:
             for slot in np.nonzero(self._active)[0]:
                 req = self.scheduler.running.get(int(slot))
-                if req is not None and \
-                        inj.hit("decode_fail", step=step_idx, rid=req.rid):
+                if req is None:
+                    continue
+                if inj.hit("decode_fail", step=step_idx, rid=req.rid):
                     # before the decode launches: the failed request leaves,
                     # the rest of the batch decodes normally this very step
                     self._retire(req, FAILED, InjectedFault(
                         f"decode_fail injected (step {step_idx}, "
+                        f"rid {req.rid})"))
+                    self.metrics.on_failed()
+                    continue
+                if self._spec is not None and \
+                        inj.hit("verify_fail", step=step_idx, rid=req.rid):
+                    # before the verify dispatch: the faulted request
+                    # retires FAILED with its pages — including any
+                    # speculative over-reservation — draining via the
+                    # normal evict path (the draft proposer holds no
+                    # per-request state to clean); survivors verify this
+                    # very step
+                    self._retire(req, FAILED, InjectedFault(
+                        f"verify_fail injected (step {step_idx}, "
                         f"rid {req.rid})"))
                     self.metrics.on_failed()
             if self.scheduler.running and \
@@ -959,7 +1170,13 @@ class ServingEngine:
         for req, slot in self.scheduler.ensure_decode_pages():
             self._preempt_one(req, slot)
 
-        if self._active.any():
+        n_accepted = 0
+        if self._active.any() and self._spec is not None:
+            # speculative decoding: the verify step replaces plain decode
+            # wholesale — one batched K+1-token ragged pass, one packed
+            # fetch, 1..K+1 tokens emitted per slot
+            n_active, n_accepted = self._verify_phase(finished_now)
+        elif self._active.any():
             with profiler.RecordEvent("serving::decode"):
                 args = (self._p, self.cache.pools,
                         jnp.asarray(self.cache.page_table),
@@ -1013,11 +1230,86 @@ class ServingEngine:
                 "step": step_idx, "t_start": t_start, "t_end": self.now(),
                 "admitted": len(admitted), "prefills": n_prefills,
                 "chunks": n_chunks, "batch": n_active,
+                "accepted": n_accepted,
                 "finished": len(finished_now),
                 "preemptions": self.scheduler.preemption_count - preempt0,
                 "queue_depth": self.scheduler.queue_depth,
                 "pages_in_use": cs["pages_in_use"]}
         return finished_now
+
+    def _verify_phase(self, finished_now: list) -> tuple[int, int]:
+        """The speculative twin of the decode phase: ONE verify dispatch
+        for the whole batch, ONE packed fetch (the decode token fetch,
+        renamed — the SyncTally formula is unchanged), then each slot
+        emits its accepted candidates plus the target's own next token
+        (1..K+1 tokens) and the pages its rejected span over-reserved
+        recycle through the refcounted allocator. Returns (active slots,
+        candidates accepted)."""
+        from .. import profiler
+
+        cfg = self.config
+        K = self._spec.depth
+        tr = self._tracer
+        with profiler.RecordEvent("serving::verify"):
+            args = (self._p, self.cache.pools,
+                    jnp.asarray(self.cache.page_table),
+                    jnp.asarray(self._ctx), jnp.asarray(self._last_tok),
+                    jnp.asarray(self._active), jnp.asarray(self._rids),
+                    jnp.asarray(self._gen), jnp.asarray(self._spec_hist()))
+            if self._spec.method == "draft":
+                args = args + (self._draft_p,)
+            if cfg.debug_checks:
+                self._audit_step(self._verify_jit, args, "verify")
+            pools, packed = self._verify_jit(*args)
+        self.cache.pools = pools
+        # the step's ONE sanctioned device->host sync: the packed
+        # (target tokens, accept count) fetch
+        packed = np.asarray(packed)  # lint: disable=PT005
+        self.metrics.on_decode_step()
+        n_slots = n_new = n_accepted = 0
+        for slot in np.nonzero(self._active)[0]:
+            req = self.scheduler.running[int(slot)]
+            a = int(packed[slot, K + 1])
+            n_slots += 1
+            n_accepted += a
+            req.fresh = False
+            if tr is not None:
+                tr.event(req.rid, "spec_verify", proposed=K, accepted=a)
+            emitted = 0
+            finished = False
+            for tok in packed[slot, :a + 1]:
+                # the accepted candidates ARE the target's tokens at
+                # positions 0..a-1, position a is the target's own next
+                # token after the accepted span — emit them in order,
+                # stopping at eos/budget exactly like sequential decode
+                tok = int(tok)
+                req.generated.append(tok)
+                emitted += 1
+                if tr is not None and \
+                        len(req.generated) % tr.mark_every == 0:
+                    tr.event(req.rid, "decode_mark",
+                             tokens=len(req.generated))
+                if self._maybe_finish(req, tok):
+                    finished_now.append(req.rid)
+                    finished = True
+                    break
+            n_new += emitted
+            if finished:
+                continue
+            self._ctx[slot] += emitted
+            self._last_tok[slot] = req.generated[-1]
+            self._gen[slot] += emitted
+            # speculative rewind: pages reserved for the rejected span
+            # return to the allocator now that the accept count is known
+            self.cache.shrink(slot, req.tokens_resident)
+            # history append: only the emitted span is new — the full-row
+            # rebuild (_hist_sync) runs only at prefill-end/swap-in, so
+            # the hot loop's host work stays O(emitted), not O(seq_len)
+            self._hist[slot, req.tokens_resident - emitted:
+                       req.tokens_resident] = req.generated[-emitted:]
+        self.metrics.on_tokens(n_new)
+        self.metrics.on_spec(proposed=K * n_slots, accepted=n_accepted)
+        return n_slots, n_accepted
 
     def run(self, max_steps: int = 100000,
             budget_s: float | None = None) -> dict[int, np.ndarray]:
@@ -1110,10 +1402,13 @@ class ServingEngine:
 
     def _step_shape(self, label: str) -> tuple[int, int]:
         """(batch, seq) of a compiled engine program, from its audit label
-        — ``decode`` runs the whole batch one token wide, ``prefill[N]``
-        one request N padded tokens wide."""
+        — ``decode`` runs the whole batch one token wide, ``verify`` the
+        whole batch depth + 1 tokens wide, ``prefill[N]`` one request N
+        padded tokens wide."""
         if label == "decode":
             return self.config.max_batch, 1
+        if label == "verify":
+            return self.config.max_batch, self.config.spec.depth + 1
         return 1, int(label[label.index("[") + 1:-1])
 
     def _step_budget(self, label: str) -> hlocheck.CollectiveBudget:

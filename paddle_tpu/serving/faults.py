@@ -12,6 +12,11 @@ boundaries — named points, matched by (point, step index, request id):
   the batch keeps prefilling/decoding this very step.
 - ``decode_fail``   decoding a request fails: only that request is retired
   FAILED; the rest of the batch decodes normally this very step.
+- ``verify_fail``   a request's speculative verify fails
+  (``ServingConfig(spec=)``): consulted before the verify dispatch — the
+  request retires FAILED, its pages (including the speculative
+  over-reservation) drain, the stateless draft proposer needs no cleanup,
+  and the survivors verify this very step.
 - ``pool_exhausted`` simulates the page pool running dry before a decode
   step: the scheduler's victim policy preempts one running request
   (recompute or swap per the engine config).
@@ -37,8 +42,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
-POINTS = ("prefill_fail", "chunk_fail", "decode_fail", "pool_exhausted",
-          "restore_fail", "slow_step")
+POINTS = ("prefill_fail", "chunk_fail", "decode_fail", "verify_fail",
+          "pool_exhausted", "restore_fail", "slow_step")
 
 
 class InjectedFault(RuntimeError):

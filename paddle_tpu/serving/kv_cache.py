@@ -854,6 +854,33 @@ class PagedKVCache:
         self.page_table[slot, :len(pages)] = pages
         return True
 
+    def shrink(self, slot: int, num_tokens: int) -> int:
+        """Return the slot's over-allocated TAIL pages to the allocator —
+        the speculative-decoding rewind: a verify step reserves capacity
+        for ``ctx + depth + 1`` tokens up front (scheduler
+        ``decode_reserve``), and once the in-jit accept count is fetched,
+        the pages past the accepted span recycle here. Only pages this
+        slot privately over-allocated are popped: a shared (refcount > 1)
+        or content-indexed tail page is never speculative headroom, so
+        the walk stops there. Returns the number of pages freed; the
+        rejected tokens' KV bytes inside the kept pages need no scrub —
+        the ragged exact-zero mask never attends past ``ctx_lens`` and
+        the next write overwrites them."""
+        pages = self._slot_pages.get(slot)
+        if not pages:
+            return 0
+        keep = self.pages_for(num_tokens)
+        freed = 0
+        while len(pages) > keep:
+            page = pages[-1]
+            if self.allocator.refcount(page) != 1 or page in self._page_key:
+                break
+            pages.pop()
+            self.page_table[slot, len(pages)] = NULL_PAGE
+            self.allocator.decref(page)
+            freed += 1
+        return freed
+
     def grow(self, slot: int, num_tokens: int) -> bool:
         """Ensure the slot can hold num_tokens, allocating pages on demand
         (the continuous-batching decode step grows one token at a time),

@@ -50,6 +50,19 @@ KV quantization + host cache tier (pre-seeded like everything else):
 - serving_host_tier_spills_total  pages spilled at eviction sweeps
 - serving_host_tier_restores_total pages restored on prefix hits
 
+Speculative decoding (pre-seeded like everything else):
+
+- serving_spec_depth                  gauge: the configured speculation
+                                      depth K (0 = speculation off), set
+                                      at construction
+- serving_spec_proposed_tokens_total  candidate tokens proposed (K per
+                                      running request per verify step)
+- serving_spec_accepted_tokens_total  candidates the target accepted
+- serving_spec_acceptance_rate        gauge: accepted / proposed over the
+                                      engine's lifetime (each verify step
+                                      ALSO emits the target's own next
+                                      token — tokens/step = rate*K + 1)
+
 Chunked prefill + SLO admission (pre-seeded like everything else):
 
 - serving_prefill_chunks_total  prefill chunks executed (a full prefill
@@ -139,6 +152,8 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "prefix_hits", "prefix_misses", "prefix_tokens_saved",
            "prefix_shared_pages", "prefix_cached_pages",
            "prefix_cow_copies", "prefix_evictions",
+           "spec_depth", "spec_proposed_tokens_total",
+           "spec_accepted_tokens_total", "spec_acceptance_rate",
            "kv_bytes_per_token", "host_tier_pages", "host_tier_bytes",
            "host_tier_hits_total", "host_tier_spills_total",
            "host_tier_restores_total",
@@ -261,6 +276,22 @@ class ServingMetrics:
 
     def on_decode_step(self) -> None:
         monitor.stat_add(PREFIX + "decode_steps", 1)
+
+    def on_spec_depth(self, depth: int) -> None:
+        """The configured speculation depth K (0 = speculation off), set
+        once at engine construction."""
+        monitor.stat_set(PREFIX + "spec_depth", int(depth))
+
+    def on_spec(self, proposed: int, accepted: int) -> None:
+        """One verify step's speculation outcome: candidates proposed
+        (depth per active slot) and accepted; the lifetime acceptance
+        rate is recomputed off the running totals stat_add returns."""
+        p = monitor.stat_add(PREFIX + "spec_proposed_tokens_total",
+                             int(proposed))
+        a = monitor.stat_add(PREFIX + "spec_accepted_tokens_total",
+                             int(accepted))
+        monitor.stat_set(PREFIX + "spec_acceptance_rate",
+                         a / p if p else 0.0)
 
     def on_kv_bytes_per_token(self, nbytes: int) -> None:
         """Device bytes one resident token costs (set once at engine

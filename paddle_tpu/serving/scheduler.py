@@ -148,6 +148,13 @@ class Scheduler:
         self._free_slots = list(range(max_batch - 1, -1, -1))  # pop() -> 0,1,..
         self._admit_seq = itertools.count()
         self.preemption_count = 0
+        # extra per-slot token capacity every decode step must hold BEYOND
+        # tokens_resident — the speculative-decoding engine sets this to
+        # its depth K (a verify step writes KV at ctx .. ctx + K before
+        # the accept count is known; rejected tokens' pages shrink back).
+        # 0 = plain decode, byte-identical accounting to the pre-spec
+        # engine.
+        self.decode_reserve = 0
         self._head_skips = 0  # prefer_cached fairness counter
         # (request, error) pairs whose host-tier restore failed mid-admit:
         # the admission was undone (pool state = pre-admit), the request
@@ -174,12 +181,19 @@ class Scheduler:
         """Queue a request. Returns the request this admission shed (state
         SHED, resources dropped), or None. Raises EngineOverloaded when the
         queue is full under the "reject" policy."""
-        total = req.prompt_len + req.max_new_tokens
+        # the decode reserve is part of the admission bound: a verify step
+        # may hold KV capacity for decode_reserve speculative tokens past
+        # the request's own total, and the lone-request growth guarantee
+        # must cover that worst case too
+        total = req.prompt_len + req.max_new_tokens + self.decode_reserve
         if not self.cache.fits_ever(total):
             raise ValueError(
                 f"request {req.rid}: {total} tokens can never fit "
                 f"(max {self.cache.cfg.max_tokens_per_seq} per sequence, "
-                f"{self.cache.cfg.usable_pages} usable pages)")
+                f"{self.cache.cfg.usable_pages} usable pages"
+                + (f", incl. the speculative decode reserve of "
+                   f"{self.decode_reserve}" if self.decode_reserve else "")
+                + ")")
         shed = None
         if self.max_waiting and len(self.waiting) >= self.max_waiting:
             if self.shed_policy == "reject":
@@ -334,17 +348,23 @@ class Scheduler:
         of its last generated token at position ``tokens_resident - 1``
         (engine ctx), so it needs capacity for ``tokens_resident`` tokens —
         NOT one more; asking for tokens_resident + 1 would demand a page one
-        step early and preempt spuriously at page boundaries. Preempts per
-        ``pick_victim`` until the survivors fit. Returns (request, vacated
-        slot) pairs — the engine must deactivate those slots."""
+        step early and preempt spuriously at page boundaries. A nonzero
+        ``decode_reserve`` (speculative decoding) adds its K candidate
+        writes at ``ctx + 1 .. ctx + K`` on top — for decoding slots only;
+        a PREFILLING request isn't in the verify batch and holds its full
+        prompt allocation already. Preempts per ``pick_victim`` until the
+        survivors fit. Returns (request, vacated slot) pairs — the engine
+        must deactivate those slots."""
         preempted = []
         for slot in sorted(self.running,
                            key=lambda s: self.running[s].admit_seq):
             req = self.running.get(slot)
             if req is None:  # already preempted this round
                 continue
+            reserve = self.decode_reserve if req.state != PREFILLING else 0
             while req.slot is not None \
-                    and not self.cache.grow(slot, req.tokens_resident):
+                    and not self.cache.grow(slot,
+                                            req.tokens_resident + reserve):
                 victim = self.pick_victim()
                 preempted.append((victim, self.preempt(victim)))
                 # admission-time fits_ever() guarantees a lone request can

@@ -1,0 +1,171 @@
+"""Speculative decoding: in-jit draft proposal + batched paged verify.
+
+At small batch (1-4) continuous batching alone leaves the chips idle:
+every decode step moves the whole model's weights through the MXU to emit
+ONE token per running request. Speculative decoding trades that memory-
+bound step for a K+1-token verify pass of nearly the same wall time —
+``ServingConfig(spec=SpecConfig(...))`` makes each engine step:
+
+1. PROPOSE K candidate tokens per running request, in-jit:
+
+   - ``method="draft"``: a small ``text/gpt.py`` draft model decodes K
+     tokens greedily from a fixed window of the request's last ``window``
+     known tokens, against its OWN dense (non-paged) KV buffer of depth
+     ``window + depth`` — created zero-filled inside the jit each step, so
+     the draft carries no persistent state: preemption, prefix caching,
+     swap, and quantized pools never know it exists.
+   - ``method="ngram"``: no second model — the last ``ngram`` known tokens
+     are matched against every earlier position of the request's token
+     history (prompt + generated, a host-mirrored buffer shipped with the
+     step), and the K tokens that followed the most recent earlier
+     occurrence are proposed. Free FLOPs; strong on templated/self-
+     repetitive traffic.
+
+2. VERIFY all K+1 tokens (the pending last token + the K candidates) in
+   ONE batched pass through the EXISTING paged decode path: queries enter
+   at ``ctx_lens .. ctx_lens + K`` — the same ragged multi-token contract
+   chunked prefill rides — writing their KV as they go. The target's own
+   token at every position is computed in-jit (argmax, or the sampled
+   token under the engine's ``(seed, rid, token_idx)`` PRNG fold), and a
+   candidate is accepted only while it EXACTLY matches the target's token
+   stream (:func:`accept_counts` — a masked cumulative match, so variable
+   acceptance never changes shapes). Accepted-or-not, every token the
+   engine emits is a token the TARGET computed with the same context and
+   the same PRNG key non-speculative decoding would have used, so outputs
+   are bit-identical speculation on or off — greedy AND sampling — and
+   preemption replay stays exact for free.
+
+The verify program compiles ONCE per configured depth (a CompileGuard with
+budget 1), the host fetches exactly one packed ``[batch, K+2]`` array per
+step (K+1 target tokens + the accept count — the renamed step kind in the
+SyncTally formula, count unchanged), and the pages over-reserved for
+rejected candidates recycle through the refcounted allocator
+(``PagedKVCache.shrink``) the moment the accept count is known. Rejected
+tokens' KV bytes need no device-side scrub: the ragged exact-zero mask
+already guarantees positions beyond ``ctx_lens`` are never attended, and
+the next verify step overwrites them.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+METHODS = ("draft", "ngram")
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knobs (``ServingConfig(spec=...)``).
+
+    ``depth`` (K) candidates are proposed and verified per engine step —
+    each step emits between 1 and K+1 tokens. ``draft`` is the proposer
+    model's GPTConfig for ``method="draft"`` (the engine builds it, or
+    accepts a prebuilt ``draft_model=``); ``window`` is the draft's
+    context width in tokens (it decodes from the last ``window`` known
+    tokens at window-relative positions). ``ngram`` is the match width of
+    the n-gram proposer."""
+
+    method: str = "ngram"       # "draft" | "ngram"
+    depth: int = 4              # K: candidates proposed per step
+    draft: object | None = None  # text.gpt.GPTConfig for method="draft"
+    window: int = 8             # draft context window (last W known tokens)
+    ngram: int = 2              # n-gram proposer match width
+
+    def validate(self, model_cfg, draft_cfg=None) -> None:
+        """Raise ValueError for a config that could never serve correctly
+        against ``model_cfg`` (the target model's GPTConfig).
+        ``draft_cfg`` is the real config of a prebuilt ``draft_model=``
+        when one was passed — it wins over ``self.draft``."""
+        if self.method not in METHODS:
+            raise ValueError(
+                f"spec.method {self.method!r} not in {METHODS}")
+        if self.depth < 1:
+            raise ValueError(f"spec.depth {self.depth} < 1 (K candidates "
+                             f"are proposed per step)")
+        if self.method == "ngram":
+            if self.ngram < 1:
+                raise ValueError(f"spec.ngram {self.ngram} < 1")
+            return
+        draft_cfg = draft_cfg or self.draft
+        if draft_cfg is None:
+            raise ValueError(
+                "spec.method='draft' needs spec.draft (the proposer "
+                "model's GPTConfig) or an explicit draft_model=")
+        if self.window < 1:
+            raise ValueError(f"spec.window {self.window} < 1")
+        if draft_cfg.vocab_size != model_cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab_size {draft_cfg.vocab_size} != target "
+                f"vocab_size {model_cfg.vocab_size} — candidate ids must "
+                f"be target token ids")
+        if draft_cfg.max_seq_len < self.window + self.depth:
+            raise ValueError(
+                f"draft max_seq_len {draft_cfg.max_seq_len} < window + "
+                f"depth = {self.window + self.depth} (the draft decodes "
+                f"depth tokens after its window)")
+
+
+def propose_ngram(hist, known, depth: int, n: int, pad_id: int):
+    """N-gram proposal, in-jit: for each row, match the last ``n`` known
+    tokens against every earlier position of ``hist`` and propose the
+    ``depth`` tokens following the MOST RECENT earlier occurrence.
+
+    hist: [batch, L] int32 token history (prompt + generated, zero-padded);
+    known: [batch] int32 tokens actually known per row (== ctx_lens + 1 —
+    the pending last token is known, its KV is not). Rows with no match
+    (or history shorter than n+1) propose ``pad_id`` — the verify pass
+    rejects them and the step degrades to plain decode, never to a wrong
+    token. O(L * n) comparisons per row, static shapes throughout.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    L = hist.shape[1]
+    pos = jnp.arange(L, dtype=jnp.int32)
+
+    def one(row, k):
+        tail = row[jnp.clip(k - n + jnp.arange(n), 0, L - 1)]
+        win = row[jnp.clip(pos[:, None] + jnp.arange(n)[None, :], 0, L - 1)]
+        # an occurrence starting at i is usable iff it is fully known AND
+        # strictly earlier than the tail itself (i <= k - n - 1), which
+        # also guarantees at least one known continuation token
+        ok = jnp.all(win == tail[None, :], axis=1) & (pos + n <= k - 1)
+        best = jnp.max(jnp.where(ok, pos, -1))
+        src = best + n + jnp.arange(depth, dtype=jnp.int32)
+        cand = row[jnp.clip(src, 0, L - 1)]
+        return jnp.where((best >= 0) & (src <= k - 1), cand,
+                         pad_id).astype(jnp.int32)
+
+    return jax.vmap(one)(hist, known.astype(jnp.int32))
+
+
+def draft_window(hist, known, width: int):
+    """The draft proposer's context: the last ``width`` known tokens per
+    row, right-aligned (rows shorter than the window repeat their first
+    token on the left — the real history always ends at the window's last
+    position, where the draft starts decoding). [batch, width] int32.
+
+    Computed HOST-side per step (``engine._spec_hist``): the window is
+    the ONLY thing the draft reads, so the verify dispatch ships
+    O(batch * width) bytes instead of the whole [batch, max_seq_len]
+    history mirror — that buffer crosses to device only for the n-gram
+    proposer, which genuinely scans all of it."""
+    import numpy as np
+
+    L = hist.shape[1]
+    idx = known[:, None].astype(np.int64) - width \
+        + np.arange(width, dtype=np.int64)[None, :]
+    return np.take_along_axis(hist, np.clip(idx, 0, L - 1), axis=1)
+
+
+def accept_counts(cand, target):
+    """How many leading candidates each row accepts: cand [batch, K]
+    against the target's own tokens target [batch, K+1] (token ``j`` of
+    the target stream is what follows the first ``j`` candidates).
+    ``cand[:, j]`` is accepted iff it equals ``target[:, j]`` AND every
+    earlier candidate was accepted — a masked cumulative product, so the
+    count is computed without data-dependent shapes. [batch] int32 in
+    ``0..K``."""
+    import jax.numpy as jnp
+
+    match = (cand == target[:, :cand.shape[1]]).astype(jnp.int32)
+    return jnp.sum(jnp.cumprod(match, axis=1), axis=1).astype(jnp.int32)

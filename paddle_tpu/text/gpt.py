@@ -197,7 +197,14 @@ class GPTAttention(nn.Layer):
         k_new = qkv_v[:, :, 1]  # [B, s, H, D]
         v_new = qkv_v[:, :, 2]
         positions = ctx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]
-        page_ids = jnp.take_along_axis(table, positions // page_size, axis=1)
+        # clamp the page lookup explicitly: a multi-token decode-style call
+        # (the speculative-verify step writes s = depth+1 tokens at
+        # ctx..ctx+depth) may form positions past the table width on rows
+        # whose ctx is garbage (inactive slots) — those writes are routed
+        # to the null page by `valid` below, but the INDEX itself must
+        # stay in range rather than rely on gather clip semantics
+        page_idx = jnp.minimum(positions // page_size, table.shape[1] - 1)
+        page_ids = jnp.take_along_axis(table, page_idx, axis=1)
         page_ids = jnp.where(valid, page_ids, 0)  # dead writes -> null page
         offsets = jnp.where(valid, positions % page_size, 0)
         if "k_scale" in cache:

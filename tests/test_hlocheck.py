@@ -278,10 +278,12 @@ def test_run_step_unknown_name_raises():
 def test_registry_names_are_stable():
     assert set(REGISTRY) == {"swap_gather", "swap_scatter", "cow_copy",
                              "engine_prefill", "engine_prefill_chunk",
-                             "engine_decode", "tp8_decode",
+                             "engine_decode", "engine_verify_spec",
+                             "tp8_decode",
                              "tp2_engine_prefill",
                              "tp2_engine_prefill_chunk",
-                             "tp2_engine_decode", "tp2_swap_gather",
+                             "tp2_engine_decode",
+                             "tp2_engine_verify_spec", "tp2_swap_gather",
                              "tp2_swap_scatter", "tp2_cow_copy",
                              "engine_decode_q8", "swap_gather_q8",
                              "swap_scatter_q8", "tp2_engine_decode_q8"}

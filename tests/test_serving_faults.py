@@ -289,6 +289,40 @@ def test_decode_fail_isolates_the_failed_request():
         "a faulted step must not corrupt page accounting"
 
 
+def test_verify_fail_retires_mid_speculation_and_survivors_keep_serving():
+    # speculative decoding (ServingConfig(spec=)): the verify_fail point
+    # is consulted before the verify dispatch — the faulted request
+    # retires FAILED with its pages (including the K-token speculative
+    # over-reservation the scheduler grew for this very step) draining,
+    # the stateless draft proposer needs no cleanup, and the survivors
+    # verify this same step with exact output parity
+    from paddle_tpu.serving import SpecConfig
+
+    model = _toy_model()
+    prompts = _prompts(11, (5, 4, 6))
+    budgets = [6, 8, 5]
+    inj = FaultInjector()
+    engine = ServingEngine(model, ServingConfig(
+        max_batch=3, num_pages=24, page_size=4, max_prompt_len=8,
+        spec=SpecConfig(method="ngram", depth=4)), fault_injector=inj)
+    rids = [engine.add_request(p, b) for p, b in zip(prompts, budgets)]
+    # step 1: rids[1] (budget 8) is certainly still mid-speculation — one
+    # verify step emits at most depth + 1 = 5 tokens
+    inj.arm("verify_fail", step=1, rid=rids[1])
+    outs = engine.run()
+    assert set(outs) == {rids[0], rids[2]}, "non-faulted requests finish"
+    for i in (0, 2):
+        np.testing.assert_array_equal(
+            _reference(model, prompts[i], budgets[i]), outs[rids[i]])
+    assert engine.status(rids[1]) == "failed"
+    err = engine.request(rids[1]).error
+    assert isinstance(err, InjectedFault) and "verify_fail" in str(err)
+    assert engine.metrics.snapshot()["serving_failed"] == 1
+    assert engine.compile_counts["verify"] == 1
+    assert engine.cache.allocator.pages_in_use == 0, \
+        "a faulted verify must not corrupt page accounting"
+
+
 def test_prefill_fail_undoes_admission_only_for_the_victim():
     model = _toy_model()
     prompts = _prompts(7, (5, 4))
