@@ -17,7 +17,18 @@ Turns the serving stack's hand-pinned invariants into enforced checks:
   and flops/peak-HBM roll-up. ``python -m paddle_tpu.analysis --hlo``
   sweeps the registered steps (including the 8-device ``shard_map``
   tensor-parallel certification the sharded-serving arc gates on).
-- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT010 distilled from bugs
+- :mod:`~paddle_tpu.analysis.kernelcheck` — static certification of the
+  Pallas kernels themselves: trace each registered kernel entry point to
+  its jaxpr and certify every ``pallas_call`` against a frozen
+  :class:`~paddle_tpu.analysis.kernelcheck.KernelBudget` — VMEM working
+  set per grid step, (sublane, lane) tiling lint, output index-map
+  injectivity over the grid (write races proven absent before hardware
+  ever runs), and a roofline contract banked to
+  ``profiles/kernelcheck.json`` and diffed against the composite path's
+  hlocheck cost roll-up. ``python -m paddle_tpu.analysis kernelcheck``
+  sweeps the registry + the dispatch-coverage report (which serving
+  configs reach a Pallas kernel vs the composite).
+- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT011 distilled from bugs
   this repo shipped, with ``# lint: disable=PTxxx`` pragmas and allowlists.
   ``python -m paddle_tpu.analysis paddle_tpu/`` must stay clean (a tier-1
   test enforces zero findings).
@@ -25,6 +36,10 @@ Turns the serving stack's hand-pinned invariants into enforced checks:
 from .hlocheck import (SINGLE_CHIP, AliasingViolation,  # noqa: F401
                        CollectiveBudget, CollectiveBudgetError,
                        HloAuditReport, HloCheckError, HostTransferError)
+from .kernelcheck import (KernelBudget, KernelCertReport,  # noqa: F401
+                          KernelCheckError, KernelFinding,
+                          validate_flash_tuned)
+from .kernelcheck import certify as certify_kernel  # noqa: F401
 from .lint import (ALLOWLIST, RULES, Finding, lint_paths,  # noqa: F401
                    lint_source)
 from .tracecheck import (CompileGuard, DonationViolation,  # noqa: F401
@@ -39,4 +54,6 @@ __all__ = ["CompileGuard", "RetraceError", "DonationViolation",
            "CollectiveBudget", "HloAuditReport", "HloCheckError",
            "CollectiveBudgetError", "HostTransferError",
            "AliasingViolation", "SINGLE_CHIP",
+           "KernelBudget", "KernelCertReport", "KernelCheckError",
+           "KernelFinding", "certify_kernel", "validate_flash_tuned",
            "Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths"]

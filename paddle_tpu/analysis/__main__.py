@@ -1,12 +1,17 @@
 """``python -m paddle_tpu.analysis [paths] [--rule PTxxx] [--path SUB]``
 runs the repo linter; ``python -m paddle_tpu.analysis --hlo [--step NAME]``
-runs the compiled-artifact auditor over the registered step registry
-instead. One entry point, two engines, shared exit-code contract
+runs the compiled-artifact auditor over the registered step registry;
+``python -m paddle_tpu.analysis kernelcheck [--kernel NAME]`` runs the
+static Pallas-kernel certifier (VMEM/tiling/race/roofline + dispatch
+coverage). One entry point, three engines, shared exit-code contract
 (0 clean, 1 findings/violations, 2 bad usage)."""
 import sys
 
 argv = list(sys.argv[1:])
-if "--hlo" in argv:
+if argv[:1] == ["kernelcheck"]:
+    argv = argv[1:]
+    from .kernelcheck import main
+elif "--hlo" in argv:
     argv.remove("--hlo")
     from .hlocheck import main
 else:

@@ -49,6 +49,14 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   sanctioned entry point (serving/tp.py, whose wrapped steps ARE
   registered: tp2_engine_* + the per-shard cache movers) carries the
   pragma.
+- PT011 — a ``pl.pallas_call`` (or ``from ... import pallas_call``) in a
+  module with no registered kernelcheck certificate: an uncertified
+  Pallas kernel ships with no VMEM budget, no tiling lint, no grid-race
+  proof, and no roofline contract — exactly how the paged-decode
+  dispatch shipped a kernel that could not even trace. A pallas-kernel
+  module declares ``KERNELCHECK_CERTS = (...)`` naming its
+  ``analysis.kernelcheck.REGISTRY`` entries (a tier-1 test pins each
+  name to a live entry).
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
@@ -80,7 +88,8 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # would defeat the fixture. Everything else should use pragmas, which are
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
-    "lint_fixtures": {f"PT00{i}" for i in range(1, 10)} | {"PT010"},
+    "lint_fixtures": {f"PT00{i}" for i in range(1, 10)}
+    | {"PT010", "PT011"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -394,6 +403,43 @@ def _pt010(tree, path):
                    "invisible to the attribute check. " + msg)
 
 
+def _pt011(tree, path):
+    """pallas_call in a module with no registered kernelcheck
+    certificate. A module sanctions itself by declaring a top-level
+    ``KERNELCHECK_CERTS = ("entry", ...)`` tuple naming its
+    analysis.kernelcheck REGISTRY entries — the declaration is what a
+    tier-1 test cross-checks against the live registry, so a stale name
+    can't silently satisfy the rule."""
+    def _declares(node):
+        if isinstance(node, ast.Assign):
+            return (len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "KERNELCHECK_CERTS")
+        if isinstance(node, ast.AnnAssign):  # KERNELCHECK_CERTS: tuple = ...
+            return (isinstance(node.target, ast.Name)
+                    and node.target.id == "KERNELCHECK_CERTS"
+                    and node.value is not None)
+        return False
+
+    has_certs = any(_declares(node) for node in tree.body)
+    if has_certs:
+        return
+    msg = ("pallas_call in a module with no registered kernelcheck "
+           "certificate — the kernel ships with no VMEM budget, tiling "
+           "lint, grid-race proof, or roofline contract. Register it in "
+           "analysis/kernelcheck.py REGISTRY and declare "
+           "KERNELCHECK_CERTS = (\"<entry>\", ...) at module top level "
+           "(or pragma-suppress a sanctioned uncertified call).")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "pallas_call":
+            yield (node.lineno, msg)
+        elif isinstance(node, ast.ImportFrom) and any(
+                a.name == "pallas_call" for a in node.names):
+            yield (node.lineno,
+                   "importing pallas_call bare makes every launch site "
+                   "invisible to the attribute check. " + msg)
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -422,6 +468,8 @@ RULES: dict[str, Rule] = {r.code: r for r in (
     Rule("PT010", "shard_map in serving/ whose wrapped step is not "
          "registered with a CollectiveBudget in the hlocheck registry",
          _pt010, scope="serving"),
+    Rule("PT011", "pallas_call in a module with no registered "
+         "kernelcheck certificate (KERNELCHECK_CERTS)", _pt011),
 )}
 
 

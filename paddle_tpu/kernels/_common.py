@@ -23,8 +23,17 @@ def i32_index_scope():
     """Context for every pallas_call: the package enables x64 globally for
     Paddle dtype parity (paddle_tpu/__init__.py:19), which makes BlockSpec
     index-map constants i64 and fails Mosaic legalization ("func.return
-    (i32, i64)"). Scoping x64 off keeps kernel index math i32."""
-    return jax.enable_x64(False)
+    (i32, i64)"). Scoping x64 off keeps kernel index math i32.
+
+    ``jax.enable_x64`` was removed from the jax namespace (newer builds
+    raise AttributeError through the deprecation shim, which every kernel
+    launch then swallowed into its composite fallback — the exact silent
+    MFU loss kernelcheck certifies against); the experimental spelling is
+    the one that exists across the versions this repo supports."""
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx
+    return ctx(False)
 
 
 _logged: set[str] = set()

@@ -16,24 +16,54 @@ from jax.experimental.pallas.ops.tpu.flash_attention import (
     flash_attention as _pallas_flash,
 )
 
+from ._common import i32_index_scope
+
+#: kernelcheck certificates this module's Pallas kernels are registered
+#: under (analysis/kernelcheck.py REGISTRY) — lint rule PT011 requires
+#: every pallas-kernel module to carry this declaration, and a tier-1
+#: test pins each name to a live registry entry
+KERNELCHECK_CERTS = ("flash_fwd", "splash_fwd")
 
 _TUNED = None
+
+import os as _os
+
+#: overridable for tests; the shipped table lives beside this module
+_TUNED_PATH = _os.path.join(_os.path.dirname(__file__), "flash_tuned.json")
 
 
 def _tuned_table() -> dict:
     """kernels/flash_tuned.json: on-chip autotuned block edges keyed
-    "seq,head_dim" (written by tools/flash_autotune.py; absent = defaults)."""
+    "seq,head_dim" (written by tools/flash_autotune.py; absent = defaults).
+
+    Entries are validated against the kernel tiling constraints at load
+    time (analysis/kernelcheck.py validate_flash_tuned): a hand-edited or
+    stale table entry whose block edge doesn't tile its sequence (or isn't
+    a 128-lane multiple) used to silently degrade to the 512 default —
+    or worse, reach Pallas and die at launch. Now it raises here, naming
+    the entry, before any kernel is dispatched with it."""
     global _TUNED
     if _TUNED is None:
         import json
-        import os
 
-        path = os.path.join(os.path.dirname(__file__), "flash_tuned.json")
+        path = _TUNED_PATH
         try:
             with open(path) as f:
-                _TUNED = {k: int(v) for k, v in json.load(f).items()}
+                table = dict(json.load(f))
         except (OSError, ValueError):
-            _TUNED = {}
+            table = {}  # absent/unreadable table = defaults, by design
+        if table:
+            from ..analysis.kernelcheck import validate_flash_tuned
+
+            errors = validate_flash_tuned(table)
+            if errors:
+                raise ValueError(
+                    f"flash_tuned.json at {path} has entries violating the "
+                    f"flash-attention tiling constraints:\n  "
+                    + "\n  ".join(errors)
+                    + "\nRe-run tools/flash_autotune.py (which validates "
+                    "before writing) or fix the entries by hand.")
+        _TUNED = table
     return _TUNED
 
 
@@ -79,7 +109,7 @@ import jax
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, causal, sm_scale):
-    with jax.enable_x64(False):  # kernel index math assumes int32 defaults
+    with i32_index_scope():  # kernel index math assumes int32 defaults
         return _pallas_flash(
             q, k, v, causal=causal, sm_scale=sm_scale,
             block_sizes=_block_sizes(q.shape[2], k.shape[2], q.shape[3]),
@@ -87,7 +117,7 @@ def _flash(q, k, v, causal, sm_scale):
 
 
 def _flash_fwd(q, k, v, causal, sm_scale):
-    with jax.enable_x64(False):
+    with i32_index_scope():
         out, vjp = jax.vjp(
             lambda q, k, v: _pallas_flash(
                 q, k, v, causal=causal, sm_scale=sm_scale,
@@ -99,7 +129,7 @@ def _flash_fwd(q, k, v, causal, sm_scale):
 
 
 def _flash_bwd(causal, sm_scale, vjp, g):
-    with jax.enable_x64(False):
+    with i32_index_scope():
         return vjp(g)
 
 
@@ -142,7 +172,7 @@ def _splash_impl(q, k, v, sm_scale, interpret):
     kernel = _splash_kernel(q.shape[1], q.shape[2], k.shape[2], q.shape[3],
                             interpret)
     q = (q * sm_scale).astype(q.dtype)
-    with jax.enable_x64(False):
+    with i32_index_scope():
         return jax.vmap(kernel)(q, k, v)
 
 
@@ -151,7 +181,7 @@ def _splash_fwd(q, k, v, sm_scale, interpret):
     # x64-off: the library kernel's internal vjp otherwise lowers with the
     # package-global x64 enabled and Mosaic's dtype converter recurses
     # forever (RecursionError at seq>=2048 — round-5 on-chip longseq A/B)
-    with jax.enable_x64(False):
+    with i32_index_scope():
         out, vjp = jax.vjp(
             lambda q, k, v: _splash_impl(q, k, v, sm_scale, interpret),
             q, k, v)
@@ -159,7 +189,7 @@ def _splash_fwd(q, k, v, sm_scale, interpret):
 
 
 def _splash_bwd(sm_scale, interpret, vjp, g):
-    with jax.enable_x64(False):
+    with i32_index_scope():
         return vjp(g)
 
 

@@ -22,6 +22,9 @@ import jax.numpy as jnp
 
 from . import _common
 
+#: kernelcheck certificates for this module's pallas_calls (lint PT011)
+KERNELCHECK_CERTS = ("fused_layernorm_fwd", "fused_layernorm_dx")
+
 _LANE = 128
 _ROW_BLOCK = 8
 

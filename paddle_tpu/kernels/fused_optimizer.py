@@ -23,6 +23,9 @@ import jax.numpy as jnp
 
 from . import _common
 
+#: kernelcheck certificate for this module's pallas_call (lint PT011)
+KERNELCHECK_CERTS = ("fused_adam",)
+
 _LANE = 128
 _ROWS_PER_BLOCK = 8  # (8, 128) f32 tile — the VPU-native block
 

@@ -33,7 +33,8 @@ _ENGINE_TID = 0
 _PID = 1
 
 # lifecycle events that ALSO render as instants on the request's track
-_INSTANTS = ("preempted", "swap_out", "swap_in", "decode_mark",
+_INSTANTS = ("pallas_fallback",
+             "preempted", "swap_out", "swap_in", "decode_mark",
              "prefill_chunk", "retired", "spill", "restore",
              "spec_verify")
 

@@ -374,6 +374,26 @@ class ServingEngine:
             self._spec = None
             self._hist = None
             self._draft = self._draft_p = None
+        # pallas-fallback surfacing: the kernel layer counts the
+        # pre-seeded serving_pallas_fallback_total gauge itself; this
+        # hook additionally stamps a `pallas_fallback` trace event (exc
+        # class + dispatch signature) on every request running in the
+        # step whose dispatch just degraded. Module-level: the kernel
+        # can't know the engine — last-constructed engine owns the hook,
+        # through a weakref so a dropped engine (and its KV pools) is
+        # collectable instead of pinned forever by the module global.
+        import weakref
+
+        from ..kernels import paged_attention as _pa
+
+        _self = weakref.ref(self)
+
+        def _fallback_hook(exc_name, signature, _ref=_self):
+            eng = _ref()
+            if eng is not None:
+                eng._on_pallas_fallback(exc_name, signature)
+
+        _pa.fallback_hook = _fallback_hook
         self._fault_injector = fault_injector
         if fault_injector is not None and self.cache.host_tier is not None:
             # the restore_fail fault point: consulted by the cache right
@@ -617,6 +637,22 @@ class ServingEngine:
         """Engine time: the pluggable clock plus any slow_step fault skew —
         the time base for deadlines and run() budgets."""
         return self._clock() + self._skew
+
+    def _on_pallas_fallback(self, exc_name: str, signature: str) -> None:
+        """kernels/paged_attention fallback hook: the Pallas decode
+        dispatch raised at trace time and the composite path is serving
+        instead. The kernel layer already counted the pre-seeded
+        ``serving_pallas_fallback_total`` gauge; here every request
+        active in the degraded step gets a ``pallas_fallback`` trace
+        event (a Chrome-trace instant) carrying the exception class and
+        dispatch signature — the machine-readable record of which
+        traffic lost its fast kernel."""
+        tr = self._tracer
+        if tr is None:
+            return
+        for slot in np.flatnonzero(self._active):
+            tr.event(int(self._rids[slot]), "pallas_fallback",
+                     exc=exc_name, signature=signature)
 
     def add_request(self, prompt, max_new_tokens: int,
                     deadline_s: float | None = None) -> int:

@@ -73,6 +73,18 @@ Chunked prefill + SLO admission (pre-seeded like everything else):
                                 controller is installed)
 - serving_slo_throttles_total   controller windows that LOWERED the limit
 
+Kernel-dispatch counters (pre-seeded):
+
+- serving_pallas_fallback_total  Pallas kernel dispatches that raised and
+                                 silently degraded to the composite path
+                                 (incremented by kernels/paged_attention
+                                 at the fallback site; each also stamps a
+                                 ``pallas_fallback`` trace event on the
+                                 running requests via the engine hook).
+                                 0 is the certified steady state — any
+                                 growth means the serving hot path lost
+                                 its fast kernel.
+
 Analysis counters (paddle_tpu.analysis integration, pre-seeded):
 
 - serving_analysis_retraces_total    CompileGuard traces beyond the
@@ -157,6 +169,7 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "kv_bytes_per_token", "host_tier_pages", "host_tier_bytes",
            "host_tier_hits_total", "host_tier_spills_total",
            "host_tier_restores_total",
+           "pallas_fallback_total",
            "analysis_retraces_total", "analysis_host_syncs_total",
            "hlo_collective_ops", "hlo_host_transfers",
            "hlo_peak_hbm_bytes", "hlo_flops_per_step",

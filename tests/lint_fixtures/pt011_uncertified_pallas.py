@@ -1,0 +1,19 @@
+"""PT011 fixture: pallas_call in a module with NO KERNELCHECK_CERTS
+declaration — the attribute launch and the bare import both fire; the
+pragma-suppressed twin is the sanctioned-uncertified escape hatch. (A
+module that DOES declare KERNELCHECK_CERTS is covered by linting the real
+kernels/fused_layernorm.py in test_analysis.py.)"""
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import pallas_call
+
+
+def uncertified_launch(kernel, x, out_shape):
+    return pl.pallas_call(kernel, out_shape=out_shape)(x)
+
+
+def uncertified_bare(kernel, x, out_shape):
+    return pallas_call(kernel, out_shape=out_shape)(x)
+
+
+def sanctioned(kernel, x, out_shape):
+    return pl.pallas_call(kernel, out_shape=out_shape)(x)  # lint: disable=PT011

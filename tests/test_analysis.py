@@ -367,6 +367,8 @@ _FIXTURE_CASES = {
                           25: "PT009", 29: "PT009"}),
     "pt010_shard_map.py": ("serving/pt010.py",
                            {6: "PT010", 7: "PT010", 13: "PT010"}),
+    "pt011_uncertified_pallas.py": ("kernels/pt011.py",
+                                    {7: "PT011", 11: "PT011"}),
 }
 
 
@@ -385,7 +387,8 @@ def test_lint_rule_fixture(fixture):
 
 
 def test_lint_rule_table_is_complete():
-    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + ["PT010"]
+    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + ["PT010",
+                                                                  "PT011"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -492,6 +495,29 @@ def test_self_lint_catches_reintroduced_rogue_shard_map():
     assert not any(f.rule == "PT010"
                    for f in lint_source(tp_src,
                                         "paddle_tpu/serving/tp.py"))
+
+
+def test_self_lint_catches_uncertified_pallas_kernel():
+    """Deliberately strip fused_layernorm's KERNELCHECK_CERTS declaration:
+    PT011 must fire on every pallas_call — an uncertified kernel ships
+    with no VMEM budget, tiling lint, race proof, or roofline contract.
+    The declared original stays clean."""
+    path = REPO / "paddle_tpu" / "kernels" / "fused_layernorm.py"
+    src = path.read_text()
+    bad = "\n".join(line for line in src.splitlines()
+                    if not line.startswith("KERNELCHECK_CERTS"))
+    assert bad != src, "fused_layernorm.py no longer declares its certs"
+    findings = lint_source(bad, "paddle_tpu/kernels/fused_layernorm.py")
+    assert any(f.rule == "PT011" and "kernelcheck" in f.message
+               for f in findings)
+    assert not any(f.rule == "PT011" for f in lint_source(
+        src, "paddle_tpu/kernels/fused_layernorm.py"))
+    # the annotated declaration form sanctions the module just the same
+    ann = src.replace("KERNELCHECK_CERTS = ",
+                      "KERNELCHECK_CERTS: tuple = ")
+    assert ann != src
+    assert not any(f.rule == "PT011" for f in lint_source(
+        ann, "paddle_tpu/kernels/fused_layernorm.py"))
 
 
 def test_self_lint_catches_reintroduced_wall_clock():

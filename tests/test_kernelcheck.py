@@ -1,0 +1,457 @@
+"""kernelcheck: static certification of Pallas kernels.
+
+- the registered in-tree kernel families certify (VMEM, tiling, race
+  proof, roofline banked + composite diff) on CPU, no TPU required
+- two deliberately defective fixture kernels are flagged: a colliding
+  output index_map (write race) and an over-VMEM block config
+- interpret-mode numerics smoke: certified kernels match their (jitted)
+  composite references bit-for-bit on CPU (ULP-bounded where the lowering
+  genuinely differs — see the test comments)
+- the dispatch-coverage report names the int8 decode path as kernel-less
+- the Pallas-fallback gauge + trace event satellite
+- flash_tuned.json tiling validation at load and at autotune-bank time
+- KERNELCHECK_CERTS module declarations cross-check the live registry
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import kernelcheck as kc
+from paddle_tpu.utils import monitor
+
+pytestmark = pytest.mark.kernelcheck
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+
+# certify each registry entry at most once per session — tracing the
+# library kernels is the dominant cost, every test below reads the result
+_RUNS: dict = {}
+
+
+def _run(name):
+    if name not in _RUNS:
+        _RUNS[name] = kc.run_kernel(name)
+    return _RUNS[name]
+
+
+FAST_FAMILIES = ("fused_layernorm_fwd", "fused_layernorm_dx", "fused_adam",
+                 "paged_decode")
+
+
+# ------------------------------------------------------------ certification
+@pytest.mark.parametrize("name", FAST_FAMILIES)
+def test_registry_kernel_certifies(name):
+    report, record = _run(name)
+    assert report.ok, [str(f) for f in report.all_findings()]
+    assert len(report.calls) == 1
+    assert report.vmem_bytes > 0
+    assert report.vmem_bytes <= report.calls[0].vmem_cap
+    # the banked roofline record carries the full contract
+    assert record["flops"] > 0 and record["hbm_bytes"] > 0
+    assert record["intensity"] == round(
+        record["flops"] / record["hbm_bytes"], 3)
+    assert record["composite"]["flops"] > 0
+    assert record["predicted_speedup"] is not None
+
+
+def test_flash_and_splash_certify_with_declared_revisits():
+    """The attention kernels revisit their output across the KV grid dim
+    (online-softmax accumulation) — legal exactly because their budgets
+    declare allow_output_revisits."""
+    for name in ("flash_fwd", "splash_fwd"):
+        report, record = _run(name)
+        assert report.ok, (name, [str(f) for f in report.all_findings()])
+        assert sum(c.output_revisits for c in report.calls) > 0, name
+        assert record["predicted_speedup"] > 1.0, name
+
+
+def test_paged_decode_certifies_the_int8_skip():
+    """The quantized pool's kernel-lessness is a DECLARED dispatch
+    constraint on the paged certificate, not a docstring aside."""
+    report, _ = _run("paged_decode")
+    assert report.ok
+    spec = kc.REGISTRY["paged_decode"].build()
+    names = {c[0]: c[1] for c in spec["constraints"]}
+    assert names["int8_skip_is_declared"] is True
+    assert names["decode_kernel_eligible"] is True
+
+
+# -------------------------------------------------------- defect fixtures
+def _fixture_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def _racy_call(x):
+    """Deliberate write race: grid point i writes output block i % 2 —
+    block 0 REAPPEARS at i=2 after the map moved away at i=1."""
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(  # lint: disable=PT011
+        _fixture_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (i % 2, 0)),
+        out_shape=jax.ShapeDtypeStruct((16, 128), jnp.float32))(x)
+
+
+def test_race_fixture_flagged():
+    x = jax.ShapeDtypeStruct((32, 128), jnp.float32)
+    report = kc.certify(_racy_call, (x,), name="racy")
+    assert not report.ok
+    races = [f for f in report.errors if f.kind == "race"]
+    assert races and "REAPPEARS" in races[0].message
+    assert "write race" in races[0].message
+
+
+def _revisit_call(x):
+    """Every grid point maps to output block 0 — the accumulation idiom,
+    an error unless the budget declares it."""
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(  # lint: disable=PT011
+        _fixture_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 128), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32))(x)
+
+
+def test_undeclared_revisit_flagged_and_declarable():
+    x = jax.ShapeDtypeStruct((32, 128), jnp.float32)
+    report = kc.certify(_revisit_call, (x,), name="revisit")
+    assert not report.ok
+    assert any("allow_output_revisits" in f.message for f in report.errors)
+    sanctioned = kc.certify(
+        _revisit_call, (x,), name="revisit",
+        budget=kc.KernelBudget(allow_output_revisits=True))
+    assert sanctioned.ok
+    assert sanctioned.calls[0].output_revisits == 3
+
+
+def _over_vmem_call(x):
+    """One 64 MiB f32 block — 4x the v5e VMEM, before double-buffering."""
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(  # lint: disable=PT011
+        _fixture_kernel,
+        grid=(2,),
+        in_specs=[pl.BlockSpec((8192, 2048), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8192, 2048), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((16384, 2048), jnp.float32))(x)
+
+
+def test_over_vmem_fixture_flagged():
+    # ShapeDtypeStructs only — nothing this size ever materializes
+    x = jax.ShapeDtypeStruct((16384, 2048), jnp.float32)
+    report = kc.certify(_over_vmem_call, (x,), name="whale")
+    assert not report.ok
+    vmem = [f for f in report.errors if f.kind == "vmem"]
+    assert vmem and "VMEM working set" in vmem[0].message
+    assert "exceeds" in vmem[0].message
+    # 2 blocks x 64 MiB x 2 (pipeline double buffer)
+    assert report.vmem_bytes == 2 * 8192 * 2048 * 4 * 2
+
+
+def _misaligned_call(x):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(  # lint: disable=PT011
+        _fixture_kernel,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((8, 100), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((8, 100), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((32, 400), jnp.float32))(x)
+
+
+def test_tiling_lane_misalignment_flagged():
+    x = jax.ShapeDtypeStruct((32, 400), jnp.float32)
+    report = kc.certify(_misaligned_call, (x,), name="misaligned")
+    tiling = [f for f in report.errors if f.kind == "tiling"]
+    assert tiling, [str(f) for f in report.all_findings()]
+    assert any("128-lane" in f.message for f in tiling)
+
+
+def test_dispatch_constraint_failure_flagged():
+    x = jax.ShapeDtypeStruct((32, 128), jnp.float32)
+    report = kc.certify(
+        _revisit_call, (x,), name="gated",
+        budget=kc.KernelBudget(allow_output_revisits=True),
+        constraints=(("the_%512_rule", False,
+                      "s=640 must take the composite path"),))
+    assert not report.ok
+    assert any(f.kind == "dispatch" and "the_%512_rule" in f.message
+               for f in report.errors)
+
+
+def test_untraceable_kernel_is_the_finding():
+    """A kernel entry that cannot even trace (the paged-decode x64 bug's
+    shape) certifies as a trace-kind violation, not a checker crash."""
+    def broken(x):
+        raise TypeError("mosaic legalization failed")
+
+    report = kc.certify(broken, (jax.ShapeDtypeStruct((8,), jnp.float32),),
+                        name="broken")
+    assert not report.ok
+    assert any(f.kind == "trace" and "composite fallback" in f.message
+               for f in report.errors)
+
+
+# ------------------------------------------------- interpret-mode numerics
+# The reference is the registry's own composite, JITTED: interpret-mode
+# pallas runs under jit, and eager-vs-jit constant folding alone costs
+# thousands of ULPs on a reduction. Jit-to-jit, layernorm is bitwise.
+def test_fused_layernorm_interpret_matches_composite_bitwise():
+    from paddle_tpu.kernels import fused_layernorm as fl
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 256), jnp.float32)
+    g = jnp.asarray(rng.randn(256), jnp.float32)
+    b = jnp.asarray(rng.randn(256), jnp.float32)
+    y = fl.fused_layer_norm(x, g, b, 1e-5, interpret=True)
+    spec = kc.REGISTRY["fused_layernorm_fwd"].build()
+    ref, _, _ = jax.jit(spec["composite"])(x, g, b)
+    assert np.array_equal(np.asarray(y), np.asarray(ref))
+
+
+def test_fused_adam_interpret_matches_composite_bitwise():
+    from paddle_tpu.kernels import fused_optimizer as fo
+
+    rng = np.random.RandomState(1)
+    n = 1 << 16
+    p, g, m, v = (jnp.asarray(rng.randn(n), jnp.float32) for _ in range(4))
+    v = jnp.abs(v)
+    lr, bc1, bc2 = (jnp.asarray(s, jnp.float32)
+                    for s in (1e-3, 0.9, 0.999))
+    out = fo.fused_adam_update(p, g, m, v, lr, bc1, bc2, beta1=0.9,
+                               beta2=0.999, eps=1e-8, interpret=True)
+    spec = kc.REGISTRY["fused_adam"].build()
+    ref = jax.jit(spec["composite"])(p, g, m, v, lr, bc1, bc2)
+    # m/v are bitwise; p's div-by-(sqrt+eps) lowers differently inside the
+    # pallas interpreter (measured max 8 ULP on 116/65536 elements)
+    assert np.array_equal(np.asarray(out[1]), np.asarray(ref[1]))
+    assert np.array_equal(np.asarray(out[2]), np.asarray(ref[2]))
+    np.testing.assert_array_max_ulp(np.asarray(out[0]), np.asarray(ref[0]),
+                                    maxulp=8)
+
+
+# ------------------------------------------------------- dispatch coverage
+def test_coverage_names_int8_decode_kernel_less():
+    cov = kc.coverage_report()
+    assert any("kv_dtype=int8" in k and "paged_decode" in k
+               for k in cov["kernel_less"])
+    by_config = {(r["family"], r["config"]): r for r in cov["rows"]}
+    hot = by_config[("paged_decode",
+                     "platform=tpu pallas_flag=on kv_dtype=float32")]
+    assert hot["path"] == "pallas" and not hot["blocked_by"]
+    q8 = by_config[("paged_decode",
+                    "platform=tpu pallas_flag=on kv_dtype=int8")]
+    assert q8["path"] == "composite" and "int8" in q8["blocked_by"]
+    cpu = by_config[("paged_decode",
+                     "platform=cpu pallas_flag=on kv_dtype=float32")]
+    assert cpu["path"] == "composite"
+    # the %512 composite-fallback rule, certified statically
+    assert any(r["family"] == "flash_prefill" and "seq=640" in r["config"]
+               and r["path"] == "composite" for r in cov["rows"])
+
+
+def test_coverage_predicate_is_the_runtime_gate():
+    """The coverage rows come from decode_kernel_eligible — the SAME
+    predicate _use_pallas_decode calls, so the table can't drift."""
+    from paddle_tpu.kernels import paged_attention as pa
+
+    ok, why = pa.decode_kernel_eligible(128, 32, 16)
+    assert ok and why == ""
+    ok, why = pa.decode_kernel_eligible(64, 32, 16)
+    assert not ok and "% 128" in why
+    ok, why = pa.decode_kernel_eligible(128, 30, 16)
+    assert not ok and "pages_per_block" in why
+    ok, why = pa.decode_kernel_eligible(128, 32, 16, quantized=True)
+    assert not ok and "int8" in why
+
+
+# -------------------------------------------------- flash_tuned validation
+def test_validate_flash_tuned():
+    assert kc.validate_flash_tuned({"1024,128": 512, "2048,64": 1024}) == []
+    errors = kc.validate_flash_tuned({
+        "1024,128": 500,      # not a 128 multiple
+        "1000,64": 512,       # does not tile seq
+        "512,64": 1024,       # block exceeds seq
+        "bogus": 512,         # unparseable key
+        "1024,96": 512,       # head_dim off the 64 tile
+        "1024,64": "512",     # non-int value
+    })
+    msgs = "\n".join(errors)
+    assert "128-lane" in msgs and "does not tile" in msgs
+    assert "exceeds seq" in msgs and "seq,head_dim" in msgs
+    assert "head_dim 96" in msgs and "positive int" in msgs
+
+
+def test_shipped_flash_tuned_table_is_valid():
+    from paddle_tpu.kernels import flash_attention as fa
+
+    table = fa._tuned_table()  # raises on a misaligned shipped table
+    assert kc.validate_flash_tuned(table) == []
+
+
+def test_flash_tuned_load_rejects_misaligned(tmp_path, monkeypatch):
+    from paddle_tpu.kernels import flash_attention as fa
+
+    bad = tmp_path / "flash_tuned.json"
+    bad.write_text(json.dumps({"1024,64": 500}))
+    monkeypatch.setattr(fa, "_TUNED_PATH", str(bad))
+    monkeypatch.setattr(fa, "_TUNED", None)
+    with pytest.raises(ValueError, match="tiling constraints"):
+        fa._tuned_table()
+    monkeypatch.setattr(fa, "_TUNED", None)  # don't poison the cache
+
+
+def test_autotune_refuses_to_bank_misaligned(monkeypatch):
+    """tools/flash_autotune.py validates before writing — the same
+    validator, so the load site can never see a table the bank site
+    accepted."""
+    from paddle_tpu.analysis.kernelcheck import validate_flash_tuned
+
+    assert validate_flash_tuned({"1024,64": 500})  # what main() raises on
+
+
+# ------------------------------------------------- fallback gauge + events
+def test_pallas_fallback_counts_gauge_and_calls_hook(monkeypatch):
+    from paddle_tpu.kernels import paged_attention as pa
+
+    calls = []
+    monkeypatch.setattr(pa, "_use_pallas_decode", lambda *a: True)
+
+    def boom(*a, **k):
+        raise RuntimeError("mosaic says no")
+
+    monkeypatch.setattr(pa, "_pallas_decode", boom)
+    monkeypatch.setattr(pa, "fallback_hook",
+                        lambda exc, sig: calls.append((exc, sig)))
+    q = jnp.zeros((1, 2, 1, 8), jnp.float32)
+    pool = jnp.zeros((4, 2, 2, 8), jnp.float32)
+    table = jnp.zeros((1, 2), jnp.int32)
+    ctx = jnp.zeros((1,), jnp.int32)
+    before = monitor.stats_with_prefix("serving_").get(
+        "serving_pallas_fallback_total", 0)
+    out = pa.paged_attention(q, pool, pool, table, ctx)
+    assert out.shape == (1, 2, 1, 8)  # the composite path served
+    after = monitor.stats_with_prefix("serving_")[
+        "serving_pallas_fallback_total"]
+    assert after == before + 1
+    assert calls == [("RuntimeError", "q(1, 2, 1, 8) pool(4, 2, 2, 8)")]
+
+
+def test_engine_stamps_fallback_trace_event():
+    from paddle_tpu.obs.export import _INSTANTS
+    from paddle_tpu.serving.engine import ServingConfig, ServingEngine
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    assert "pallas_fallback" in _INSTANTS  # renders as a Chrome instant
+    paddle.seed(11)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=61, hidden_size=16, num_layers=1, num_heads=2,
+        max_seq_len=16, dropout=0.0))
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=8, page_size=4, max_prompt_len=8))
+    from paddle_tpu.kernels import paged_attention as pa
+
+    eng._tracer.begin(7)
+    eng._active[0] = True
+    eng._rids[0] = 7
+    # drive the INSTALLED module-level hook, not the method: this is the
+    # exact call the kernel fallback site makes
+    pa.fallback_hook("ValueError", "q(2, 2, 1, 8) pool(8, 4, 2, 8)")
+    ev = eng._tracer.get(7).last("pallas_fallback")
+    assert ev is not None
+    assert ev.arg("exc") == "ValueError"
+    assert "pool(8, 4, 2, 8)" in ev.arg("signature")
+    # the gauge is pre-seeded: visible at zero before any fallback
+    assert eng.metrics.snapshot()["serving_pallas_fallback_total"] == 0
+    assert ("# TYPE serving_pallas_fallback_total counter"
+            in eng.metrics.prometheus())
+    # the hook holds only a weakref: dropping the engine must not leak it
+    # (its KV pools) through the module global, and a post-mortem
+    # fallback is a safe no-op
+    import gc
+    import weakref
+
+    alive = weakref.ref(eng)
+    del eng
+    gc.collect()
+    assert alive() is None, "module-level hook pinned the dropped engine"
+    pa.fallback_hook("ValueError", "q(2, 2, 1, 8) pool(8, 4, 2, 8)")
+
+
+# --------------------------------------------- registry <-> module certs
+def test_kernelcheck_certs_declarations_match_registry():
+    """Every pallas-kernel module's KERNELCHECK_CERTS names live registry
+    entries, and every registry entry is declared by exactly one module —
+    PT011's declaration can't go stale in either direction."""
+    from paddle_tpu.kernels import (flash_attention, fused_layernorm,
+                                    fused_optimizer, paged_attention)
+
+    declared = []
+    for mod in (flash_attention, fused_layernorm, fused_optimizer,
+                paged_attention):
+        certs = mod.KERNELCHECK_CERTS
+        assert certs, mod.__name__
+        declared.extend(certs)
+    assert sorted(declared) == sorted(kc.REGISTRY)
+    assert len(declared) == len(set(declared))
+
+
+# ----------------------------------------------------------- bank + drift
+def test_bank_and_drift_detection():
+    _, rec = _run("fused_adam")
+    records = {"fused_adam": rec}
+    banked = json.loads(json.dumps(records))  # round-trip like the file
+    assert kc.diff_banked(records, banked) == []
+    banked["fused_adam"]["flops"] += 1
+    drift = kc.diff_banked(records, banked)
+    assert any(f.kind == "drift" and f.severity == "error"
+               and "flops" in f.message for f in drift)
+    missing = kc.diff_banked({"fused_adam": rec, "new_kernel": rec}, banked)
+    assert any("--bank" in f.message for f in missing)
+    # composite re-measurements drift only as warnings
+    banked = json.loads(json.dumps(records))
+    banked["fused_adam"]["composite"]["flops"] *= 2
+    drift = kc.diff_banked(records, banked)
+    assert drift and all(f.severity == "warn" for f in drift)
+
+
+# ----------------------------------------------------------------- CLI
+def test_cli_inprocess(tmp_path, capsys):
+    assert kc.main(["--list-kernels"]) == 0
+    assert "paged_decode" in capsys.readouterr().out
+    assert kc.main(["--kernel", "bogus"]) == 2
+    capsys.readouterr()
+    profile = tmp_path / "kernelcheck.json"
+    rc = kc.main(["--kernel", "fused_adam", "--kernel",
+                  "fused_layernorm_fwd", "--bank", "--no-coverage",
+                  "--profile", str(profile)])
+    out = capsys.readouterr().out
+    assert rc == 0 and profile.exists()
+    assert "banked 2 roofline record(s)" in out
+    banked = json.loads(profile.read_text())
+    assert set(banked) == {"fused_adam", "fused_layernorm_fwd"}
+    assert banked["fused_adam"]["flops"] == 14 * (1 << 16)
+
+
+def test_cli_coverage_and_violation_exit(tmp_path, capsys):
+    """A drifted bank fails the default sweep loudly (the PR 6 contract);
+    the coverage table prints the kernel-less int8 finding either way."""
+    profile = tmp_path / "kernelcheck.json"
+    bad = {name: {"grid": [], "vmem_bytes": 0, "flops": -1,
+                  "hbm_bytes": 0} for name in kc.REGISTRY}
+    profile.write_text(json.dumps(bad))
+    rc = kc.main(["--profile", str(profile)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "drifted from the banked contract" in out
+    assert "kernel-less production configs" in out
+    assert "kv_dtype=int8" in out

@@ -96,6 +96,17 @@ def main():
             "provenance": "rung-experiment (flash_autotune)",
         })
 
+    # validate BEFORE writing: a misaligned entry would otherwise be
+    # rejected at every future load (kernels/flash_attention.py) — the
+    # kernelcheck tiling constraints are the single source of truth
+    from paddle_tpu.analysis.kernelcheck import validate_flash_tuned
+
+    errors = validate_flash_tuned(table)
+    if errors:
+        raise ValueError(
+            "flash_autotune produced entries violating the kernel tiling "
+            "constraints (refusing to write flash_tuned.json):\n  "
+            + "\n  ".join(errors))
     out_path = os.path.join(os.path.dirname(__file__), os.pardir,
                             "paddle_tpu", "kernels", "flash_tuned.json")
     with open(out_path, "w") as f:

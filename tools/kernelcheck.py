@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""CI/dev entry point for the static Pallas-kernel certifier.
+
+Usage:
+    python tools/kernelcheck.py                    # certify every kernel
+                                                   # + dispatch coverage
+    python tools/kernelcheck.py --kernel flash_fwd
+    python tools/kernelcheck.py --bank             # freeze the rooflines
+    python tools/kernelcheck.py --list-kernels
+
+Exit codes: 0 all kernels certified (and no roofline drift), 1 any
+violation, 2 bad usage. The same engine runs as ``python -m
+paddle_tpu.analysis kernelcheck``. Everything runs on CPU: kernels are
+traced to jaxprs and statically checked (VMEM budgets, tiling lint,
+grid-race proofs, roofline contracts); only the composite references are
+AOT-compiled for the cost diff. No TPU required.
+
+The repo root is forced onto sys.path FIRST, so the audited package is
+this checkout's ``paddle_tpu/``, never an installed copy.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis.kernelcheck import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
