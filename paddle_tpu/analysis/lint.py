@@ -57,6 +57,14 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   module declares ``KERNELCHECK_CERTS = (...)`` naming its
   ``analysis.kernelcheck.REGISTRY`` entries (a tier-1 test pins each
   name to a live entry).
+- PT012 — a LABELED stat family used at a ``stat_add``/``stat_set``/
+  ``stat_max`` call site (a name shaped ``base{label=value}``, usually
+  built with an f-string) whose base is in neither ``_SEEDED`` nor the
+  module's ``_FAMILIES`` registry: the dynamically formatted name is
+  invisible to PT003/PT008 — exactly the gap the
+  ``serving_alerts_total{rule=}`` / ``serving_step_phase_s{phase=}``
+  families opened — so an unregistered family ships with no pre-seeded
+  members and appears on dashboards only once its first event fires.
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
@@ -89,7 +97,7 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
     "lint_fixtures": {f"PT00{i}" for i in range(1, 10)}
-    | {"PT010", "PT011"},
+    | {"PT010", "PT011", "PT012"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -190,23 +198,75 @@ def _seeding_contract(tree):
     return seeded, prefix
 
 
-def _stat_call_name(node, fn_suffixes, prefix):
-    """The statically visible stat name of a ``stat_xxx`` call: resolves
-    ``PREFIX + "name"`` concatenations and ``"prefix_name"`` literals;
-    None when the call isn't one of ``fn_suffixes`` or the name is built
-    dynamically (runtime-computed names can't be checked statically)."""
+def _stat_name_text(node, fn_suffixes, prefix):
+    """The leading static text of a ``stat_xxx`` call's name argument —
+    the ONE resolver behind PT003/PT008 (whole names) and PT012
+    (labeled-family heads), so a newly supported naming idiom lands in
+    exactly one place and the rules can never disagree about which call
+    sites they see. Resolves ``PREFIX + "..."`` / ``PREFIX + f"..."``
+    concatenations and bare (f-)strings carrying the prefix inline.
+    Returns ``(text, whole)`` where ``whole`` says the text is the
+    ENTIRE name (a plain constant) rather than the constant head of a
+    formatted one; None when the call isn't one of ``fn_suffixes`` or
+    nothing is statically visible (runtime-computed names can't be
+    checked statically)."""
     if not (isinstance(node, ast.Call) and node.args
             and _unparse(node.func).endswith(fn_suffixes)):
         return None
     arg = node.args[0]
+    strip = True  # bare names carry the prefix inline; PREFIX + x doesn't
     if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
-            and _unparse(arg.left) == "PREFIX" \
-            and isinstance(arg.right, ast.Constant):
-        return arg.right.value
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, str) \
-            and prefix and arg.value.startswith(prefix):
-        return arg.value[len(prefix):]
-    return None
+            and _unparse(arg.left) == "PREFIX":
+        arg, strip = arg.right, False
+    if isinstance(arg, ast.Constant):
+        text, whole = arg.value, True
+    elif isinstance(arg, ast.JoinedStr) and arg.values \
+            and isinstance(arg.values[0], ast.Constant):
+        text, whole = arg.values[0].value, False
+    else:
+        return None
+    if not isinstance(text, str):
+        return None
+    if strip:
+        if not (prefix and text.startswith(prefix)):
+            return None
+        text = text[len(prefix):]
+    return text, whole
+
+
+def _stat_call_name(node, fn_suffixes, prefix):
+    """The statically visible WHOLE stat name of a ``stat_xxx`` call;
+    None when the name has a formatted tail, or is a labeled-family
+    member (contains ``{`` — PT012's domain, where the check is against
+    ``_FAMILIES``, not ``_SEEDED``)."""
+    resolved = _stat_name_text(node, fn_suffixes, prefix)
+    if resolved is None:
+        return None
+    text, whole = resolved
+    if not whole or "{" in text:
+        return None  # formatted tail / labeled family: PT012's domain
+    return text
+
+
+_STAT_FNS = ("stat_add", "stat_set", "stat_max")
+
+
+def _labeled_stat_head(node, prefix):
+    """The static HEAD of a labeled stat name at a ``stat_xxx`` call
+    site — the leading constant text of the name expression, when that
+    text contains a ``{`` (the ``base{label=value}`` family shape, e.g.
+    ``PREFIX + f"base{{label={v}}}"``). None for anything else — a name
+    whose brace only appears after a formatted field (e.g. the family
+    percentile mirrors ``f"base_{suffix}{{label=...}}"``) has no
+    checkable base, the same documented blindness PT003 has to fully
+    dynamic names."""
+    resolved = _stat_name_text(node, _STAT_FNS, prefix)
+    if resolved is None:
+        return None
+    text, _ = resolved
+    if "{" not in text:
+        return None
+    return text.split("{", 1)[0]
 
 
 def _pt003(tree, path):
@@ -440,6 +500,48 @@ def _pt011(tree, path):
                    "invisible to the attribute check. " + msg)
 
 
+def _family_registry(tree):
+    """The module's declared labeled-family bases: the constant keys of a
+    top-level ``_FAMILIES = {...}`` dict. None when the module declares
+    no registry."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "_FAMILIES" \
+                and isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)}
+    return None
+
+
+def _pt012(tree, path):
+    """Labeled stat family written without a ``_FAMILIES`` declaration —
+    the dynamically-formatted-name gap of PT003/PT008. Gated, like them,
+    on the module declaring a ``_SEEDED`` contract."""
+    seeded, prefix = _seeding_contract(tree)
+    if seeded is None:  # no seeding registry in this module: no contract
+        return
+    families = _family_registry(tree) or set()
+
+    def registered(base):
+        # a declared family sanctions its derived mirror names too
+        # (step_phase_s -> step_phase_s_count / step_phase_s_p99)
+        return base in seeded or any(
+            base == fam or base.startswith(fam + "_") for fam in families)
+
+    for node in ast.walk(tree):
+        base = _labeled_stat_head(node, prefix)
+        if base is not None and not registered(base):
+            yield (node.lineno,
+                   f"labeled stat family {base!r} ({base}{{...=...}}) is "
+                   f"written but declared in neither _FAMILIES nor "
+                   f"_SEEDED — the formatted name is invisible to "
+                   f"PT003/PT008, so its members are never pre-seeded "
+                   f"and dashboards keyed on presence are blind until "
+                   f"the first event. Declare the base in _FAMILIES and "
+                   f"seed its label values (ServingMetrics.seed_family).")
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -470,6 +572,9 @@ RULES: dict[str, Rule] = {r.code: r for r in (
          _pt010, scope="serving"),
     Rule("PT011", "pallas_call in a module with no registered "
          "kernelcheck certificate (KERNELCHECK_CERTS)", _pt011),
+    Rule("PT012", "labeled stat family (base{label=}) written without a "
+         "_FAMILIES declaration — the PT003/PT008 gap for formatted "
+         "names", _pt012),
 )}
 
 
@@ -535,7 +640,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Repo linter: invariants this repo shipped bugs "
-                    "against, enforced (rules PT001-PT010).")
+                    "against, enforced (rules PT001-PT012).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the installed "
                              "paddle_tpu package plus the repo's --include "

@@ -2,8 +2,11 @@
 
 The layer that answers the operational questions the serving invariants
 (compile-once, sync-free decode — paddle_tpu.analysis) cannot: where did a
-request spend its time, what are TTFT/TPOT at p50/p99, and what did the
-engine's step timeline look like when tail latency spiked.
+request spend its time, what are TTFT/TPOT at p50/p99, what did the
+engine's step timeline look like when tail latency spiked — and, since
+the goodput-attribution layer, WHERE each step's wall time went, whether
+the analytic cost models still predict reality, and what the engine was
+doing right before it died.
 
 - :mod:`~paddle_tpu.obs.trace` — per-request lifecycle traces
   (:class:`Tracer`, :class:`RequestTrace`): timestamped events from the
@@ -13,28 +16,67 @@ engine's step timeline look like when tail latency spiked.
   :class:`Histogram` (bounded memory, pre-seeded presence) backing the
   ``serving_ttft_s`` / ``serving_tpot_s`` / ``serving_queue_wait_s`` /
   ``serving_e2e_s`` / ``serving_step_duration_s`` /
-  ``serving_batch_occupancy`` percentile gauges.
+  ``serving_batch_occupancy`` percentile gauges, plus
+  :class:`HistogramFamily` — label-keyed families
+  (``serving_step_phase_s{phase=}``, and the per-tenant latency classes
+  the fleet router will reuse).
 - :mod:`~paddle_tpu.obs.timeline` — the engine loop's bounded per-step
   ring (:class:`StepTimeline`): phase mix, batch size, page pressure,
-  preemptions, host syncs under ``debug_checks``.
+  preemptions, per-phase wall-time attribution, host syncs under
+  ``debug_checks``.
+- :mod:`~paddle_tpu.obs.attribution` — goodput attribution:
+  :class:`PhaseAccumulator` (exact per-phase step wall-time split) and
+  :class:`RooflineTracker` (live MFU / HBM-bandwidth utilization /
+  cost-model drift against the engine's own hlocheck audits, plus the
+  kernelcheck predicted-vs-measured speedup A/B).
+- :mod:`~paddle_tpu.obs.alerts` — anomaly watchdogs (:class:`Watchdog`):
+  edge-triggered rules over host-resident step state — retrace after
+  warmup, Pallas fallback, speculative-acceptance collapse, eviction
+  thrash, queue stall — each firing a structured :class:`Alert`.
+- :mod:`~paddle_tpu.obs.recorder` — the black-box flight recorder:
+  bounded schema-versioned JSON dumps of the step ring + alerts +
+  gauges + audit roll-ups, written automatically on engine-fatal paths
+  and request failures.
 - :mod:`~paddle_tpu.obs.export` — Chrome ``trace_event`` JSON (one track
-  per request + one for the engine loop; loads in Perfetto) and
-  Prometheus text exposition.
+  per request + the engine loop + counter tracks + alert instants; loads
+  in Perfetto) and Prometheus text exposition with labeled families.
+
+``python -m paddle_tpu.obs --flight-record DUMP`` pretty-prints a flight
+record (``--prometheus`` / ``--latency-table`` render its gauge and
+latency sections); exit 0 clean, 1 alerts/fatal recorded, 2 bad usage.
 
 Imports nothing from ``paddle_tpu.serving`` — serving imports us. Tracing
 is on by default in the engine (``ServingConfig(enable_tracing=)``); the
 off path costs one attribute check per event site and the on path adds no
 host syncs to the decode loop (the SyncTally certification is unchanged).
 """
+from .alerts import RULES as ALERT_RULES  # noqa: F401
+from .alerts import Alert, Watchdog, WatchdogConfig  # noqa: F401
+from .attribution import (DEFAULT_PEAK_FLOPS_PER_S,  # noqa: F401
+                          DEFAULT_PEAK_HBM_BYTES_PER_S, PHASES,
+                          PhaseAccumulator, RooflineTracker,
+                          load_banked_kernel_speedups)
 from .export import (chrome_trace, latency_table,  # noqa: F401
                      prometheus_text, write_chrome_trace)
 from .histogram import (LATENCY_EDGES_S, OCCUPANCY_EDGES,  # noqa: F401
-                        QUANTILES, Histogram)
+                        QUANTILES, Histogram, HistogramFamily,
+                        split_labels)
+from .recorder import (FLIGHT_RECORD_SCHEMA,  # noqa: F401
+                       build_flight_record, dump_flight_record,
+                       format_flight_record, validate_flight_record)
 from .timeline import StepRecord, StepTimeline  # noqa: F401
 from .trace import RequestTrace, TraceEvent, Tracer  # noqa: F401
 
-__all__ = ["Histogram", "LATENCY_EDGES_S", "OCCUPANCY_EDGES", "QUANTILES",
+__all__ = ["Histogram", "HistogramFamily", "LATENCY_EDGES_S",
+           "OCCUPANCY_EDGES", "QUANTILES", "split_labels",
            "Tracer", "RequestTrace", "TraceEvent",
            "StepTimeline", "StepRecord",
+           "PHASES", "PhaseAccumulator", "RooflineTracker",
+           "DEFAULT_PEAK_FLOPS_PER_S", "DEFAULT_PEAK_HBM_BYTES_PER_S",
+           "load_banked_kernel_speedups",
+           "Alert", "ALERT_RULES", "Watchdog", "WatchdogConfig",
+           "FLIGHT_RECORD_SCHEMA", "build_flight_record",
+           "dump_flight_record", "format_flight_record",
+           "validate_flight_record",
            "chrome_trace", "write_chrome_trace", "prometheus_text",
            "latency_table"]

@@ -1,0 +1,107 @@
+"""``python -m paddle_tpu.obs`` — the observability CLI.
+
+Operates on a flight-record dump (``engine.dump_flight_record(path)`` or
+the automatic fatal/failure dumps):
+
+    python -m paddle_tpu.obs --flight-record dump.json
+        pretty-print the dump: reason, alert table, newest step records,
+        audited programs, nonzero gauges
+    python -m paddle_tpu.obs --flight-record dump.json --prometheus
+        render the dump's gauge snapshot as Prometheus text exposition
+    python -m paddle_tpu.obs --flight-record dump.json --latency-table
+        render the dump's per-request latency summaries as the fixed-
+        width table
+    python -m paddle_tpu.obs --prometheus
+        (no dump) text exposition of THIS process's live ``serving_*``
+        registry — for embedding in a scrape handler
+
+Exit codes follow the analysis CLI convention: 0 clean, 1 findings (the
+dump records alerts or an engine-fatal/failure reason), 2 bad usage or
+an unreadable/invalid dump. Also available as ``tools/obs.py``.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+from .export import latency_table, prometheus_text
+from .recorder import format_flight_record, validate_flight_record
+
+
+def _counter_types(gauges: dict) -> dict:
+    """Type the monotonic names for exposition from the serving
+    registry's COUNTER_STATS — the same single source of truth behind
+    the live ``ServingMetrics.prometheus()``, so a dump's exposition can
+    never type-flap against a live scrape of the same process. (Runtime
+    import: the obs LIBRARY modules never import serving — serving
+    imports them — but this CLI entry point is never imported by
+    serving, so there is no cycle.)"""
+    from ..serving.metrics import COUNTER_STATS
+    from .histogram import split_labels
+
+    out = {}
+    for name in gauges:
+        base = split_labels(name)[0]
+        if base in COUNTER_STATS:
+            out[base] = "counter"
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.obs",
+        description="Flight-record reader + Prometheus exposition "
+                    "(0 clean, 1 alerts/fatal recorded, 2 bad usage).")
+    parser.add_argument("--flight-record", metavar="PATH", default=None,
+                        help="flight-record JSON dump to read")
+    view = parser.add_mutually_exclusive_group()
+    view.add_argument("--prometheus", action="store_true",
+                      help="render the dump's gauges (or, with no dump, "
+                           "this process's live serving_* registry) as "
+                           "Prometheus text")
+    view.add_argument("--latency-table", action="store_true",
+                      help="render the dump's per-request latency "
+                           "summaries")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code == 0 else 2
+
+    if args.flight_record is None:
+        if args.prometheus:
+            from ..utils import monitor
+
+            stats = monitor.stats_with_prefix("serving_")
+            print(prometheus_text(stats, types=_counter_types(stats)),
+                  end="")
+            return 0
+        parser.print_usage()
+        print("a view needs input: pass --flight-record PATH "
+              "(--prometheus alone reads the live registry)")
+        return 2
+
+    try:
+        with open(args.flight_record) as fh:
+            record = validate_flight_record(json.load(fh))
+    except (OSError, ValueError) as e:
+        print(f"cannot read flight record {args.flight_record!r}: {e}")
+        return 2
+
+    if args.prometheus:
+        print(prometheus_text(record["gauges"],
+                              types=_counter_types(record["gauges"])),
+              end="")
+    elif args.latency_table:
+        print(latency_table(record["requests"]))
+    else:
+        print(format_flight_record(record))
+    # findings contract: a dump that recorded alerts, or was written by a
+    # fatal/failure path, is a finding — scriptable triage
+    dirty = bool(record["alerts"]) or record["reason"] != "manual"
+    return 1 if dirty else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,21 +8,29 @@ tracks carry complete ("X") spans for the queued / prefill / decode phases
 rebuilt from the raw lifecycle events, with instants ("i") for
 preemptions, swaps, decode marks, and retirement; the engine track carries
 one span per step, labeled by its phase mix and carrying the step's batch
-size / page pressure / preemption count in ``args``. Timestamps are
-engine-clock seconds rebased to the earliest event and scaled to the
-microseconds the format requires — a virtual test clock exports exactly
-like a wall clock.
+size / page pressure / preemption count / phase attribution in ``args``,
+with a global instant per watchdog alert. Counter tracks (``ph: "C"`` —
+Perfetto renders them as stacked area charts above the spans) plot
+``pages_in_use`` / ``batch`` / ``queue_depth`` per step from the timeline
+ring, so resource pressure is visible alongside the request spans it
+explains. Timestamps are engine-clock seconds rebased to the earliest
+event and scaled to the microseconds the format requires — a virtual test
+clock exports exactly like a wall clock.
 
 Prometheus: standard text exposition (``# TYPE`` + samples) over the
 monitor registry's ``serving_*`` scalars and the obs histograms rendered
 as cumulative ``_bucket{le="..."}`` series with ``_sum``/``_count`` — the
-format every Prometheus scraper and promtool understands.
+format every Prometheus scraper and promtool understands. Labeled
+family members — registry keys shaped ``base{label=value}``, e.g.
+``serving_alerts_total{rule=queue_stall}`` and the
+``serving_step_phase_s{phase=}`` histogram children — render as one
+metric family per base with proper ``{label="value"}`` sample labels.
 """
 from __future__ import annotations
 
 import json
 
-from .histogram import Histogram
+from .histogram import Histogram, split_labels
 from .timeline import StepTimeline
 from .trace import RequestTrace
 
@@ -93,10 +101,19 @@ def _request_events(trace: RequestTrace) -> list[dict]:
     return out
 
 
-def chrome_trace(traces=(), timeline: StepTimeline | None = None) -> dict:
-    """Build the ``trace_event`` JSON dict from request traces and/or the
-    engine step timeline. Pure function of its inputs — safe to call on a
-    live engine between steps."""
+# the per-step counter tracks: (track name, StepRecord attribute) —
+# Perfetto plots each as an area chart above the spans, so page pressure
+# and queue depth are visible against the request activity they explain
+_COUNTER_TRACKS = (("pages_in_use", "pages_in_use"), ("batch", "batch"),
+                   ("queue_depth", "queue_depth"))
+
+
+def chrome_trace(traces=(), timeline: StepTimeline | None = None,
+                 alerts=()) -> dict:
+    """Build the ``trace_event`` JSON dict from request traces, the
+    engine step timeline, and/or the watchdog alert history. Pure
+    function of its inputs — safe to call on a live engine between
+    steps."""
     raw: list[dict] = []
     names: dict[int, str] = {_ENGINE_TID: "engine loop"}
     for trace in traces:
@@ -117,11 +134,25 @@ def chrome_trace(traces=(), timeline: StepTimeline | None = None) -> dict:
                 args["accepted"] = rec.accepted
             if rec.host_syncs is not None:
                 args["host_syncs"] = rec.host_syncs
+            if rec.phase_s:
+                args["phases"] = dict(rec.phase_s)
             args.update(rec.extra)
             raw.append({"name": rec.phase_mix(), "ph": "X",
                         "ts": rec.t_start, "dur": rec.duration,
                         "pid": _PID, "tid": _ENGINE_TID, "cat": "engine",
                         "args": args})
+            for track, attr in _COUNTER_TRACKS:
+                raw.append({"name": track, "ph": "C", "ts": rec.t_end,
+                            "pid": _PID, "tid": _ENGINE_TID,
+                            "cat": "engine",
+                            "args": {track: getattr(rec, attr)}})
+    for alert in alerts:
+        a = alert if isinstance(alert, dict) else alert.asdict()
+        raw.append({"name": f"alert:{a['rule']}", "ph": "i", "ts": a["t"],
+                    "pid": _PID, "tid": _ENGINE_TID, "s": "g",
+                    "cat": "alert",
+                    "args": {"step": a["step"], "message": a["message"],
+                             **(a.get("data") or {})}})
     # rebase to the earliest timestamp and scale seconds -> microseconds
     origin = min((e["ts"] for e in raw), default=0.0)
     for e in raw:
@@ -137,9 +168,10 @@ def chrome_trace(traces=(), timeline: StepTimeline | None = None) -> dict:
 
 
 def write_chrome_trace(path, traces=(),
-                       timeline: StepTimeline | None = None) -> dict:
+                       timeline: StepTimeline | None = None,
+                       alerts=()) -> dict:
     """Render and write the Perfetto-loadable JSON; returns the dict."""
-    doc = chrome_trace(traces, timeline)
+    doc = chrome_trace(traces, timeline, alerts)
     with open(path, "w") as f:
         json.dump(doc, f)
     return doc
@@ -151,27 +183,46 @@ def _fmt(v) -> str:
     return str(int(f)) if f == int(f) else repr(f)
 
 
+def _label_str(labels: dict) -> str:
+    """``{k="v",k2="v2"}`` — empty string for no labels."""
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+
+
 def prometheus_text(stats: dict, histograms=(), types: dict | None = None,
                     ) -> str:
-    """Text exposition of scalar stats (``types`` maps name -> "counter";
-    everything else is a gauge) plus histograms as cumulative bucket
-    series. Histogram-derived scalar mirrors (``<hist>_p50`` etc.) are
-    skipped — scrapers should aggregate the buckets themselves."""
+    """Text exposition of scalar stats (``types`` maps BASE name ->
+    "counter"; everything else is a gauge) plus histograms as cumulative
+    bucket series. Histogram-derived scalar mirrors (``<hist>_p50`` etc.)
+    are skipped — scrapers should aggregate the buckets themselves.
+    Registry keys shaped ``base{label=value}`` (the labeled-family
+    convention) render as one metric family per base with proper sample
+    labels; sorted key order keeps each family's samples contiguous, so
+    the ``# TYPE`` header is emitted once per base."""
     types = types or {}
     lines: list[str] = []
-    hist_prefixes = tuple(h.name for h in histograms)
+    hist_bases = tuple({split_labels(h.name)[0] for h in histograms})
+    last_typed = None
     for name in sorted(stats):
-        if name.startswith(hist_prefixes) and hist_prefixes:
+        base, labels = split_labels(name)
+        if base.startswith(hist_bases) and hist_bases:
             continue  # published as a real histogram below
-        lines.append(f"# TYPE {name} {types.get(name, 'gauge')}")
-        lines.append(f"{name} {_fmt(stats[name])}")
+        if base != last_typed:
+            lines.append(f"# TYPE {base} {types.get(base, 'gauge')}")
+            last_typed = base
+        lines.append(f"{base}{_label_str(labels)} {_fmt(stats[name])}")
     for h in histograms:
-        lines.append(f"# TYPE {h.name} histogram")
+        base, labels = split_labels(h.name)
+        if base != last_typed:
+            lines.append(f"# TYPE {base} histogram")
+            last_typed = base
         for edge, cum in h.cumulative_buckets():
             le = "+Inf" if edge == float("inf") else f"{edge:.10g}"
-            lines.append(f'{h.name}_bucket{{le="{le}"}} {cum}')
-        lines.append(f"{h.name}_sum {_fmt(h.sum)}")
-        lines.append(f"{h.name}_count {h.count}")
+            lines.append(f"{base}_bucket"
+                         f"{_label_str(dict(labels, le=le))} {cum}")
+        lines.append(f"{base}_sum{_label_str(labels)} {_fmt(h.sum)}")
+        lines.append(f"{base}_count{_label_str(labels)} {h.count}")
     return "\n".join(lines) + "\n"
 
 

@@ -27,8 +27,9 @@ from __future__ import annotations
 
 from bisect import bisect_left
 
-__all__ = ["Histogram", "LATENCY_EDGES_S", "OCCUPANCY_EDGES", "QUANTILES",
-           "percentile_from_counts"]
+__all__ = ["Histogram", "HistogramFamily", "LATENCY_EDGES_S",
+           "OCCUPANCY_EDGES", "QUANTILES", "percentile_from_counts",
+           "split_labels"]
 
 # Latency edges in seconds: ~Prometheus default widened to cover both a
 # microbenchmark CPU step (sub-millisecond) and a multi-minute queue wait.
@@ -138,3 +139,62 @@ class Histogram:
         return (f"Histogram({self.name!r}, count={self.count}, "
                 f"p50={self.percentile(0.5):.4g}, "
                 f"p99={self.percentile(0.99):.4g})")
+
+
+def split_labels(name: str) -> tuple[str, dict]:
+    """Parse a ``base{k=v,k2=v2}`` metric name into (base, labels) —
+    the registry-key convention labeled families use. A plain name
+    returns ``(name, {})``."""
+    if "{" not in name or not name.endswith("}"):
+        return name, {}
+    base, _, body = name.partition("{")
+    labels: dict[str, str] = {}
+    for part in body[:-1].split(","):
+        k, _, v = part.partition("=")
+        labels[k] = v
+    return base, labels
+
+
+class HistogramFamily:
+    """A label-keyed family of fixed-bucket histograms sharing one base
+    name — the mechanism behind ``serving_step_phase_s{phase=}`` (and the
+    per-tenant TTFT/TPOT classes the fleet router will reuse: the label
+    key is arbitrary). Children are created on first observation; the
+    declared ``values`` exist — and publish zeros — from construction,
+    the same presence contract the scalar ``_SEEDED`` registry enforces.
+    Each child is a plain :class:`Histogram` named
+    ``base{label=value}``, so every exporter that understands labeled
+    names renders it with no extra plumbing."""
+
+    def __init__(self, name: str, label: str, edges=LATENCY_EDGES_S,
+                 values=()):
+        self.name = name
+        self.label = label
+        self.edges = tuple(edges)
+        self._children: dict[str, Histogram] = {}
+        for v in values:
+            self.child(v)
+
+    def child(self, value) -> Histogram:
+        """The child histogram for one label value (created pre-seeded
+        when absent)."""
+        key = str(value)
+        h = self._children.get(key)
+        if h is None:
+            h = Histogram(f"{self.name}{{{self.label}={key}}}", self.edges)
+            self._children[key] = h
+        return h
+
+    def observe(self, value, sample: float) -> None:
+        self.child(value).observe(sample)
+
+    def children(self) -> dict[str, Histogram]:
+        """{label value: child histogram}, insertion-ordered."""
+        return dict(self._children)
+
+    def reset(self) -> None:
+        for h in self._children.values():
+            h.reset()
+
+    def __len__(self) -> int:
+        return len(self._children)

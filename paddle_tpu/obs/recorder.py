@@ -1,0 +1,148 @@
+"""Black-box flight recorder: a bounded JSON dump of what the engine was
+doing when something went wrong.
+
+The engine already keeps everything a post-mortem needs — the step
+timeline ring, the watchdog alert history, the metrics registry, the
+per-program hlocheck audit roll-ups, and the per-request latency
+summaries. The flight recorder is the BUNDLER: :func:`build_flight_record`
+snapshots those surfaces into one schema-versioned dict (bounded — the
+last ``max_steps`` step records, the last ``max_requests`` summaries, the
+alert ring is already capped) and :func:`dump_flight_record` writes it as
+JSON. The engine dumps automatically on its fatal paths (an exception
+escaping the step body, the stuck-engine backstop) and whenever a request
+retires FAILED — so every deterministic ``-m faults`` scenario doubles as
+a recorder test — and on demand via ``engine.dump_flight_record(path)``.
+
+``python -m paddle_tpu.obs --flight-record dump.json`` pretty-prints a
+dump (``--prometheus`` / ``--latency-table`` render its gauge and summary
+sections); :func:`validate_flight_record` is the schema gate both the CLI
+and the tests use.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import asdict
+
+__all__ = ["FLIGHT_RECORD_SCHEMA", "build_flight_record",
+           "dump_flight_record", "validate_flight_record",
+           "format_flight_record"]
+
+FLIGHT_RECORD_SCHEMA = "paddle-tpu/flight-record/v1"
+
+#: required top-level keys and their types — the schema contract the
+#: tests pin and the CLI enforces before pretty-printing
+_SCHEMA_KEYS = (("schema", str), ("reason", str), ("dumped_at", float),
+                ("step", int), ("config", dict), ("steps", list),
+                ("alerts", list), ("gauges", dict), ("programs", dict),
+                ("requests", list))
+
+
+def build_flight_record(*, reason: str, now: float, step: int,
+                        config: dict | None = None, timeline=None,
+                        alerts=(), gauges: dict | None = None,
+                        programs: dict | None = None, requests=(),
+                        max_steps: int = 64,
+                        max_requests: int = 64) -> dict:
+    """Assemble one flight record. ``timeline`` is a
+    :class:`~paddle_tpu.obs.timeline.StepTimeline` (or None — tracing
+    off), ``alerts`` an iterable of :class:`~paddle_tpu.obs.alerts.Alert`
+    (or already-dict entries), ``requests`` latency-summary dicts."""
+    steps = timeline.records()[-max_steps:] if timeline is not None else []
+    return {
+        "schema": FLIGHT_RECORD_SCHEMA,
+        "reason": str(reason),
+        "dumped_at": float(now),
+        "step": int(step),
+        "config": dict(config or {}),
+        "steps": [asdict(r) for r in steps],
+        "alerts": [a if isinstance(a, dict) else a.asdict()
+                   for a in alerts],
+        "gauges": dict(gauges or {}),
+        "programs": dict(programs or {}),
+        "requests": list(requests)[-max_requests:],
+    }
+
+
+def dump_flight_record(path, record: dict) -> dict:
+    """Write the record as JSON; returns it unchanged."""
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1, default=str)
+    return record
+
+
+def validate_flight_record(record) -> dict:
+    """Schema gate: raises ValueError naming the first violation; returns
+    the record for chaining."""
+    if not isinstance(record, dict):
+        raise ValueError(f"flight record must be a dict, got "
+                         f"{type(record).__name__}")
+    if record.get("schema") != FLIGHT_RECORD_SCHEMA:
+        raise ValueError(
+            f"unknown flight-record schema {record.get('schema')!r} "
+            f"(expected {FLIGHT_RECORD_SCHEMA!r})")
+    for key, typ in _SCHEMA_KEYS:
+        if key not in record:
+            raise ValueError(f"flight record missing key {key!r}")
+        if typ is float and isinstance(record[key], int):
+            continue  # JSON round-trips integral floats as ints
+        if not isinstance(record[key], typ):
+            raise ValueError(
+                f"flight record key {key!r} must be {typ.__name__}, got "
+                f"{type(record[key]).__name__}")
+    for rec in record["steps"]:
+        for field in ("step", "t_start", "t_end"):
+            if field not in rec:
+                raise ValueError(
+                    f"flight-record step entry missing {field!r}: {rec}")
+    for alert in record["alerts"]:
+        for field in ("rule", "step", "message"):
+            if field not in alert:
+                raise ValueError(
+                    f"flight-record alert entry missing {field!r}: {alert}")
+    return record
+
+
+def format_flight_record(record: dict) -> str:
+    """Human-readable rendering of a (validated) dump — the CLI's default
+    view: header, alert table, the newest step records, and the nonzero
+    headline gauges."""
+    lines = [f"flight record  schema={record['schema']}",
+             f"reason: {record['reason']}",
+             f"dumped at t={record['dumped_at']:.6f}s, engine step "
+             f"{record['step']}"]
+    cfg = record["config"]
+    if cfg:
+        lines.append("config: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(cfg.items())))
+    lines.append(f"\nalerts ({len(record['alerts'])}):")
+    for a in record["alerts"]:
+        lines.append(f"  step {a['step']:>5}  {a['rule']:<26} "
+                     f"{a['message']}")
+    if not record["alerts"]:
+        lines.append("  (none)")
+    steps = record["steps"]
+    lines.append(f"\nsteps (last {len(steps)} retained):")
+    for rec in steps[-10:]:
+        phases = rec.get("phase_s") or {}
+        mix = "+".join(sorted(k for k, v in phases.items() if v)) or "-"
+        fatal = (rec.get("extra") or {}).get("fatal")
+        dur = rec["t_end"] - rec["t_start"]
+        lines.append(
+            f"  step {rec['step']:>5}  dur={dur:.6f}s "
+            f"batch={rec.get('batch', 0)} "
+            f"queue={rec.get('queue_depth', 0)} "
+            f"pages={rec.get('pages_in_use', 0)} phases={mix}"
+            + (f"  FATAL: {fatal}" if fatal else ""))
+    if not steps:
+        lines.append("  (tracing was off — no step records)")
+    if record["programs"]:
+        lines.append("\naudited programs:")
+        for label, p in sorted(record["programs"].items()):
+            lines.append(f"  {label:<16} flops/step={p.get('flops', 0):.4g}"
+                         f"  peak_hbm={p.get('peak_hbm_bytes', 0)}")
+    nonzero = {k: v for k, v in sorted(record["gauges"].items())
+               if isinstance(v, (int, float)) and v}
+    lines.append(f"\nnonzero gauges ({len(nonzero)}):")
+    for k, v in nonzero.items():
+        lines.append(f"  {k} = {v}")
+    return "\n".join(lines)

@@ -34,6 +34,10 @@ class StepRecord:
     accepted: int = 0    # speculative candidates accepted this step
     # (ServingConfig(spec=); tokens emitted = batch + accepted per step)
     host_syncs: int | None = None  # SyncTally count (debug_checks only)
+    phase_s: dict = field(default_factory=dict)  # wall-time attribution:
+    # {phase: seconds} over obs.attribution.PHASES — sums to duration
+    # exactly (the PhaseAccumulator mark contract); {} with tracing off
+    # or on pre-attribution records
     extra: dict = field(default_factory=dict)  # exporter passthrough
 
     @property

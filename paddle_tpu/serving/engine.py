@@ -140,6 +140,26 @@ timeline (``engine.timeline``). The contract: O(1) appends per event,
 ONE attribute check per event site when tracing is off, and ZERO new
 host syncs on the decode loop either way (the SyncTally certification
 in bench/demo is unchanged with tracing enabled).
+
+Goodput attribution (rides ``enable_tracing``): each step's wall time is
+split exactly across its phases (admit/swap/prefill/chunk_prefill/
+decode-or-verify/evict/other) by clock-read marks at the phase
+boundaries — recorded on every StepRecord and rolled into the
+``serving_step_phase_s{phase=}`` histogram family — and each dispatch
+site's measured time accrues per compiled program against the analytic
+flops/HBM model the engine's own first-trace hlocheck audits hold, so
+``serving_mfu`` / ``serving_hbm_bw_util`` /
+``serving_cost_model_drift{program=}`` (and the kernelcheck
+predicted-vs-measured speedup A/B) are live gauge reads under
+``debug_checks``. Anomaly watchdogs (``enable_watchdogs``, default on)
+evaluate edge-triggered rules over host-resident ints at each step
+boundary — retrace-after-warmup, Pallas fallback, speculative-acceptance
+collapse, eviction thrash, queue stall — each firing a structured Alert
++ ``serving_alerts_total{rule=}`` + a Chrome instant. A black-box flight
+recorder (``engine.dump_flight_record(path)``; automatic on engine-fatal
+exceptions, the stuck-engine backstop, and every FAILED retirement)
+bundles the newest step records, alerts, gauges, audit roll-ups, and
+latency summaries into one schema-versioned JSON dump.
 """
 from __future__ import annotations
 
@@ -155,9 +175,13 @@ from ..analysis import hlocheck
 from ..analysis.tracecheck import (CompileGuard, DonationViolation,
                                    RetraceError, SyncTally, donation_audit)
 from ..core.tensor import Tensor
-from ..obs import StepRecord, StepTimeline, Tracer, chrome_trace
-from ..obs import write_chrome_trace
+from ..obs import (ALERT_RULES, PhaseAccumulator, RooflineTracker,
+                   StepRecord, StepTimeline, Tracer, Watchdog,
+                   WatchdogConfig, build_flight_record, chrome_trace,
+                   load_banked_kernel_speedups, write_chrome_trace)
+from ..obs.recorder import dump_flight_record as _write_flight_record
 from ..text.generation import sample_logits
+from ..utils import monitor
 from .faults import InjectedFault
 from .kv_cache import PagedCacheConfig, PagedKVCache
 from .metrics import ServingMetrics
@@ -227,6 +251,26 @@ class ServingConfig:
     trace_capacity: int = 2048  # retained traces (terminal evicted oldest)
     decode_mark_every: int = 32  # decode_mark trace event cadence (tokens)
     timeline_capacity: int = 512  # step records retained in the ring
+    enable_watchdogs: bool = True  # anomaly watchdogs (obs/alerts.py) at
+    # step boundaries — edge-triggered rules over host-resident ints
+    # (zero added syncs); active only with enable_tracing (they read the
+    # step record). Each firing bumps serving_alerts_total{rule=}, lands
+    # in the alert history + flight record, and renders as a Chrome
+    # instant on the engine track.
+    watchdog: WatchdogConfig | None = None  # rule thresholds; None =
+    # the conservative defaults (a clean engine never fires)
+    peak_flops_per_s: float = 0.0  # device peak for serving_mfu; 0 = the
+    # TPU v5e default (obs/attribution.py) — the generation kernelcheck
+    # certifies VMEM caps against
+    peak_hbm_bytes_per_s: float = 0.0  # device peak memory bandwidth for
+    # serving_hbm_bw_util; 0 = the v5e default
+    flight_record_path: str | None = None  # where the automatic flight-
+    # record dumps go (engine-fatal paths, stuck-engine backstop, any
+    # step that retired a request FAILED); None keeps the record only on
+    # engine.last_flight_record. engine.dump_flight_record(path) works
+    # either way.
+    flight_record_steps: int = 64  # step records per dump (the newest N
+    # of the timeline ring)
 
 
 def prefill_buckets(max_prompt_len: int) -> list[int]:
@@ -289,6 +333,9 @@ class ServingEngine:
                 "host_tier_bytes gives evicted INDEXED prefix pages a "
                 "second life — enable_prefix_caching=False would leave "
                 "nothing to spill; enable it or drop the tier")
+        if cfg.flight_record_steps < 1:
+            raise ValueError(
+                f"flight_record_steps {cfg.flight_record_steps} < 1")
         if cfg.spec is not None:
             # bad method/depth/draft-shape mismatches fail here, not at
             # the first verify trace; a prebuilt draft_model's real
@@ -321,6 +368,23 @@ class ServingEngine:
         self.metrics.on_tp_degree(cfg.tensor_parallel)
         self.metrics.on_kv_bytes_per_token(self.cache.cfg.kv_bytes_per_token)
         self.metrics.on_spec_depth(cfg.spec.depth if cfg.spec else 0)
+        # labeled-family presence: the watchdog rule counters, the
+        # per-program drift gauges (this engine's compiled-program set is
+        # known here), and the kernel A/B gauges for every banked
+        # kernelcheck roofline — all read 0 before anything happens, the
+        # same contract _SEEDED gives the scalars
+        self.metrics.seed_family("alerts_total", ALERT_RULES)
+        programs = [f"prefill[{b}]" for b in self.prefill_buckets] \
+            + ["decode"] + (["verify"] if cfg.spec is not None else [])
+        self.metrics.seed_family("cost_model_drift", programs)
+        banked_kernels = load_banked_kernel_speedups()
+        for fam in ("kernel_speedup_predicted", "kernel_speedup_measured",
+                    "kernel_speedup_drift"):
+            self.metrics.seed_family(fam, banked_kernels)
+        for kname, speedup in banked_kernels.items():
+            # the banked prediction is static — publish it now, so the
+            # A/B is half-populated before a kernel ever dispatches
+            self.metrics.on_kernel_ab(kname, predicted=speedup)
         params, _ = model.functional_state()
         self._p = {k: v._value for k, v in params.items()}
         if self._tp is not None:
@@ -338,9 +402,28 @@ class ServingEngine:
             self._tracer = Tracer(self.now, capacity=cfg.trace_capacity,
                                   mark_every=cfg.decode_mark_every)
             self._timeline = StepTimeline(cfg.timeline_capacity)
+            # goodput attribution (obs/attribution.py): the per-phase
+            # wall-time splitter and the measured-vs-predicted roofline
+            # tracker — clock reads and host floats only, zero device
+            # syncs (the SyncTally certification is pinned unchanged)
+            self._attr = PhaseAccumulator(self.now)
+            self._roofline = RooflineTracker(
+                cfg.peak_flops_per_s, cfg.peak_hbm_bytes_per_s,
+                banked_kernels=banked_kernels)
+            # anomaly watchdogs: edge-triggered rules over the step
+            # record + host counter totals, evaluated at step boundaries
+            self._watchdog = (Watchdog(cfg.watchdog or WatchdogConfig(),
+                                       clock=self.now)
+                              if cfg.enable_watchdogs else None)
         else:
             self._tracer = None
             self._timeline = None
+            self._attr = None
+            self._roofline = None
+            self._watchdog = None
+        self.last_flight_record: dict | None = None  # newest auto dump
+        self._failed_count = 0   # FAILED retirements ever (auto-dump edge)
+        self._failed_dumped = 0
         self._step_stats: dict | None = None  # _step -> step() handoff
         self.scheduler = Scheduler(
             self.cache, cfg.max_batch, max_waiting=cfg.max_waiting,
@@ -385,6 +468,18 @@ class ServingEngine:
         import weakref
 
         from ..kernels import paged_attention as _pa
+        from ..kernels._common import on_tpu_backend
+        from ..utils.flags import flag
+
+        # whether the Pallas paged-decode kernel is even dispatchable for
+        # this engine's shapes — the single decode_kernel_eligible
+        # predicate, read once; per-step the kernel A/B additionally
+        # checks the fallback counter so a trace-time degrade flips the
+        # measured dispatch times onto the composite leg
+        self._decode_pallas_eligible, _ = _pa.decode_kernel_eligible(
+            mc.hidden_size // mc.num_heads, pages_per_seq, cfg.page_size,
+            quantized=self.cache.cfg.quantized, on_tpu=on_tpu_backend(),
+            flags_on=bool(flag("FLAGS_use_pallas_kernels", True)))
 
         _self = weakref.ref(self)
 
@@ -753,6 +848,10 @@ class ServingEngine:
         req.state, req.error = state, error
         self._requests.pop(req.rid, None)
         self._retired[req.rid] = req
+        if state == FAILED:
+            # the flight recorder's auto-dump edge: step() compares this
+            # against the last-dumped count at every step boundary
+            self._failed_count += 1
         self._trace_retire(req, state)
 
     def _sweep_deadlines(self) -> None:
@@ -952,15 +1051,22 @@ class ServingEngine:
         followed by a ``PagedKVCache.check_invariants()`` sweep; the
         CompileGuards are strict, so an unexpected retrace or donation
         misuse raises instead of silently recompiling."""
-        if self.config.debug_checks:
-            with SyncTally() as tally:
+        try:
+            if self.config.debug_checks:
+                with SyncTally() as tally:
+                    finished = self._step()
+                self._host_syncs += tally.count
+                self.cache.check_invariants()
+                syncs = tally.count
+            else:
                 finished = self._step()
-            self._host_syncs += tally.count
-            self.cache.check_invariants()
-            syncs = tally.count
-        else:
-            finished = self._step()
-            syncs = None
+                syncs = None
+        except Exception as e:
+            # engine-fatal: flush the half-built step into the timeline
+            # ring and dump the flight record BEFORE re-raising — the
+            # black box must survive the crash it exists to explain
+            self._on_fatal(e)
+            raise
         retraces = sum(g.retraces for g in
                        (*self.guards.values(), *self.cache.guards.values()))
         # the counters are pre-seeded at 0, so the non-debug hot loop only
@@ -973,9 +1079,32 @@ class ServingEngine:
         # debug-mode sync tally covers the whole step body it reports on
         if self._timeline is not None and self._step_stats is not None:
             st, self._step_stats = self._step_stats, None
-            self._timeline.append(StepRecord(host_syncs=syncs, **st))
+            record = StepRecord(host_syncs=syncs, **st)
+            self._timeline.append(record)
             self.metrics.observe_step(st["t_end"] - st["t_start"],
                                       st["batch"])
+            # per-phase attribution into the serving_step_phase_s{phase=}
+            # family (zero-time phases stay unobserved — the record keeps
+            # the exact split)
+            for phase, secs in record.phase_s.items():
+                if secs > 0:
+                    self.metrics.on_phase(phase, secs)
+            # roofline gauges: recomputed only when new measurements
+            # landed against an audited program (one boolean check
+            # otherwise) — host floats, zero device syncs
+            self._roofline.publish(self.metrics)
+            # anomaly watchdogs: edge-triggered rules over the step
+            # record + already-host-resident counter totals
+            if self._watchdog is not None:
+                for alert in self._watchdog.on_step(
+                        record, self._watchdog_counters(retraces)):
+                    self.metrics.on_alert(alert.rule)
+        # a step that retired a request FAILED (injected or real fault)
+        # auto-dumps the flight record — every -m faults scenario doubles
+        # as a recorder test; int compare on the no-failure path
+        if self._failed_count != self._failed_dumped:
+            self._failed_dumped = self._failed_count
+            self._flight_auto("request-failure")
         # SLO-adaptive admission: windowed p99s over the histograms just
         # fed above — pure host-side integer reads, zero device syncs
         if self._slo is not None:
@@ -1000,7 +1129,12 @@ class ServingEngine:
                 self._skew += slow.delay_s
         self._sweep_deadlines()
 
-        t_start = self.now() if self._timeline is not None else 0.0
+        # goodput attribution: the phase accumulator opens with the step
+        # and every phase boundary below stamps a clock-read mark — the
+        # per-phase seconds sum EXACTLY to the step's wall time. None
+        # with tracing off (one attribute check per site).
+        att = self._attr
+        t_start = att.begin() if att is not None else 0.0
         preempt0 = self.scheduler.preemption_count
         n_prefills = n_active = 0
         finished_now = []
@@ -1018,6 +1152,8 @@ class ServingEngine:
         for req, err in self.scheduler.pop_restore_failures():
             self._retire(req, FAILED, err)
             self.metrics.on_failed()
+        if att is not None:
+            att.mark("admit")  # deadline sweep + admission + restores
         for req in admitted:
             if req.generated:  # swap-resume: KV restored by admit(); there
                 slot = req.slot   # is no prefill here for prefill_fail to hit
@@ -1034,6 +1170,8 @@ class ServingEngine:
                 if tr is not None:
                     tr.event(req.rid, "swap_in", tokens=len(req.generated))
                     tr.event(req.rid, "resumed", tokens=len(req.generated))
+                if att is not None:
+                    att.mark("swap")
                 continue
             if inj is not None and \
                     inj.hit("prefill_fail", step=step_idx, rid=req.rid):
@@ -1043,6 +1181,8 @@ class ServingEngine:
                     f"prefill_fail injected (step {step_idx}, "
                     f"rid {req.rid})"))
                 self.metrics.on_failed()
+                if att is not None:
+                    att.mark("admit")
                 continue
             if self.config.chunk_size:
                 # chunked prefill: hold the slot in PREFILLING and let the
@@ -1072,6 +1212,8 @@ class ServingEngine:
                         tr.event(req.rid, "prefill_start",
                                  tokens=req.prompt_len - req.prefilled_tokens,
                                  cached=req.cached_tokens, chunked=True)
+                if att is not None:
+                    att.mark("admit")  # PREFILLING handoff is admission
                 continue
             with profiler.RecordEvent("serving::prefill"):
                 # prefix-cache hit: only the uncached tail is prefilled,
@@ -1111,6 +1253,8 @@ class ServingEngine:
                         raise
                     self._retire(req, FAILED, e)
                     self.metrics.on_failed()
+                    if att is not None:
+                        att.mark("prefill")  # the failed attempt's time
                     continue
             self.cache.pools = pools
             # the prefill's sanctioned device->host sync: its first-token
@@ -1140,6 +1284,14 @@ class ServingEngine:
                 else:
                     self.metrics.on_prefix_miss()
             self.metrics.on_tokens(1)
+            if att is not None:
+                # this iteration's interval is this request's prefill
+                # (dispatch + the sanctioned first-token fetch, which is
+                # where the device time lands) — phase-attributed and
+                # fed to the roofline tracker under the program's audit
+                # label
+                self._roofline.on_call(f"prefill[{bucket}]",
+                                       att.mark("prefill"))
             if self._maybe_finish(req, tok):
                 finished_now.append(req.rid)
 
@@ -1173,6 +1325,8 @@ class ServingEngine:
                     n_prefills += 1
                     if self._maybe_finish(req, tok):
                         finished_now.append(req.rid)
+            if att is not None and (n_chunks or prefilling):
+                att.mark("chunk_prefill")
 
         if inj is not None:
             for slot in np.nonzero(self._active)[0]:
@@ -1205,6 +1359,10 @@ class ServingEngine:
 
         for req, slot in self.scheduler.ensure_decode_pages():
             self._preempt_one(req, slot)
+        if att is not None:
+            # injected faults + decode-page pressure: preemption, swap-out
+            # and eviction sweeps all happen in this window
+            att.mark("evict")
 
         n_accepted = 0
         if self._active.any() and self._spec is not None:
@@ -1245,6 +1403,18 @@ class ServingEngine:
                     finished_now.append(req.rid)
             self.metrics.on_tokens(n_new)
             n_active = n_new
+            if att is not None:
+                # decode phase: dispatch + the sanctioned token fetch
+                # (where the device time lands) + per-slot bookkeeping.
+                # The same interval feeds the roofline tracker and — for
+                # the kernel-eligible decode dispatch — the predicted-vs-
+                # measured kernel A/B, on whichever leg actually served
+                # (Pallas, unless ineligible or a fallback was counted).
+                dt = att.mark("decode")
+                self._roofline.on_call("decode", dt)
+                pallas = self._decode_pallas_eligible and monitor.stat_get(
+                    "serving_pallas_fallback_total", 0) == 0
+                self._roofline.on_kernel_call("paged_decode", dt, pallas)
 
         cs = self.cache.stats()
         self.metrics.on_state(
@@ -1262,15 +1432,20 @@ class ServingEngine:
             host_tier_spills=cs["host_tier_spills"],
             host_tier_restores=cs["host_tier_restores"])
         if self._timeline is not None:
+            # close the attribution: the residual (state roll-up, this
+            # very bookkeeping) lands in "other", and the phase dict sums
+            # to t_end - t_start exactly by the mark construction
+            t_end, phase_s = att.finish()
             self._step_stats = {
-                "step": step_idx, "t_start": t_start, "t_end": self.now(),
+                "step": step_idx, "t_start": t_start, "t_end": t_end,
                 "admitted": len(admitted), "prefills": n_prefills,
                 "chunks": n_chunks, "batch": n_active,
                 "accepted": n_accepted,
                 "finished": len(finished_now),
                 "preemptions": self.scheduler.preemption_count - preempt0,
                 "queue_depth": self.scheduler.queue_depth,
-                "pages_in_use": cs["pages_in_use"]}
+                "pages_in_use": cs["pages_in_use"],
+                "phase_s": phase_s}
         return finished_now
 
     def _verify_phase(self, finished_now: list) -> tuple[int, int]:
@@ -1345,6 +1520,10 @@ class ServingEngine:
                        req.tokens_resident] = req.generated[-emitted:]
         self.metrics.on_tokens(n_new)
         self.metrics.on_spec(proposed=K * n_slots, accepted=n_accepted)
+        if self._attr is not None:
+            # verify phase: the batched K+1 dispatch + packed fetch +
+            # accept bookkeeping, roofline-tracked under its audit label
+            self._roofline.on_call("verify", self._attr.mark("verify"))
         return n_slots, n_accepted
 
     def run(self, max_steps: int = 100000,
@@ -1375,14 +1554,118 @@ class ServingEngine:
                     done[rid] = self._finished[rid]
                 steps += 1
                 if steps > max_steps:
-                    raise RuntimeError(
+                    err = RuntimeError(
                         f"serving loop exceeded {max_steps} steps without "
                         f"draining: {self._state_summary()}")
+                    try:
+                        # a wedged engine is exactly what the black box
+                        # exists for: dump before the backstop raises
+                        self._flight_auto("stuck-engine")
+                    except Exception:  # noqa: BLE001 — backstop wins
+                        pass
+                    raise err
         finally:
             self.admit_paused = paused_before
         return done
 
     # -------------------------------------------------------- observability
+    def _watchdog_counters(self, retraces: int) -> dict:
+        """The monotonic totals the watchdog rules window over — every
+        value already host-resident (the monitor registry is a python
+        dict; zero device syncs)."""
+        return {
+            "retraces": retraces,
+            "fallbacks": monitor.stat_get(
+                "serving_pallas_fallback_total", 0),
+            "proposed": monitor.stat_get(
+                "serving_spec_proposed_tokens_total", 0),
+            "accepted": monitor.stat_get(
+                "serving_spec_accepted_tokens_total", 0),
+            "evictions": monitor.stat_get("serving_prefix_evictions", 0),
+            "spills": monitor.stat_get(
+                "serving_host_tier_spills_total", 0),
+        }
+
+    def alerts(self) -> list:
+        """The watchdog alert history (obs.alerts.Alert), oldest first —
+        empty with tracing or watchdogs off."""
+        return self._watchdog.alerts() if self._watchdog is not None else []
+
+    def flight_record(self, reason: str = "manual") -> dict:
+        """Assemble (but do not write) the black-box flight record: the
+        newest ``flight_record_steps`` step records, the alert history,
+        a full gauge snapshot, the per-program hlocheck audit roll-ups,
+        and the per-request latency summaries — schema-versioned,
+        JSON-ready."""
+        cfg = self.config
+        programs = {
+            label: {"flops": r.flops, "peak_hbm_bytes": r.peak_bytes,
+                    "collective_ops": len(r.collectives),
+                    "host_transfers": len(r.host_transfers)}
+            for label, r in self._hlo_audits.items()}
+        return build_flight_record(
+            reason=reason, now=self.now(), step=self._step_idx,
+            config={"max_batch": cfg.max_batch,
+                    "num_pages": cfg.num_pages,
+                    "page_size": cfg.page_size,
+                    "max_prompt_len": cfg.max_prompt_len,
+                    "chunk_size": cfg.chunk_size,
+                    "kv_dtype": cfg.kv_dtype,
+                    "tensor_parallel": cfg.tensor_parallel,
+                    "spec_depth": cfg.spec.depth if cfg.spec else 0,
+                    "preemption_mode": cfg.preemption_mode,
+                    "debug_checks": cfg.debug_checks},
+            timeline=self._timeline, alerts=self.alerts(),
+            gauges=self.metrics.snapshot(), programs=programs,
+            requests=self.latency_summaries(),
+            max_steps=cfg.flight_record_steps)
+
+    def dump_flight_record(self, path, reason: str = "manual") -> dict:
+        """Write the flight record as JSON to ``path``; returns it."""
+        return _write_flight_record(path, self.flight_record(reason))
+
+    def _flight_auto(self, reason: str) -> None:
+        """The automatic dump (fatal paths, stuck-engine backstop, any
+        FAILED retirement): records to ``last_flight_record`` always and
+        to ``flight_record_path`` when configured."""
+        rec = self.flight_record(reason)
+        self.last_flight_record = rec
+        if self.config.flight_record_path:
+            _write_flight_record(self.config.flight_record_path, rec)
+
+    def _on_fatal(self, exc: BaseException) -> None:
+        """An exception is escaping the step body. Whatever the
+        half-built step accumulated would die with the engine: close the
+        open attribution into a partial StepRecord (counts unknowable —
+        zeros — but timing, queue and page state are real, and ``extra``
+        names the fatal), flush it into the ring, and dump the flight
+        record. Best-effort: nothing here may mask the original
+        exception."""
+        try:
+            att = self._attr
+            fatal = {"fatal": f"{type(exc).__name__}: {exc}"}
+            if self._timeline is not None and att is not None and att.open:
+                t_end, phase_s = att.finish()
+                self._timeline.append(StepRecord(
+                    step=self._step_idx - 1, t_start=att.t0, t_end=t_end,
+                    admitted=0, prefills=0, batch=0, finished=0,
+                    preemptions=0,
+                    queue_depth=self.scheduler.queue_depth,
+                    pages_in_use=self.cache.allocator.pages_in_use,
+                    phase_s=phase_s, extra=fatal))
+                self._step_stats = None
+            elif self._timeline is not None and self._step_stats is not None:
+                # _step completed (attribution closed, full stats built)
+                # but a post-step debug sweep — check_invariants — raised
+                # before step() could append the record: the step that
+                # broke the engine must not be the one the black box
+                # misses
+                st, self._step_stats = self._step_stats, None
+                self._timeline.append(StepRecord(extra=fatal, **st))
+            self._flight_auto(f"engine-fatal: {type(exc).__name__}")
+        except Exception:  # noqa: BLE001 — the original fatal wins
+            pass
+
     def _audit_donation(self, guard: CompileGuard, args) -> None:
         """debug_checks satellite: before a guarded step's FIRST trace,
         audit it at jaxpr level (analysis.donation_audit) with the real
@@ -1426,6 +1709,13 @@ class ServingEngine:
             collective_ops=len(report.collectives),
             host_transfers=len(report.host_transfers),
             peak_hbm_bytes=report.peak_bytes, flops=report.flops)
+        if self._roofline is not None:
+            # the roofline tracker's prediction side: this audit IS the
+            # engine's analytic cost model for the program — no second
+            # lowering, serving_mfu / serving_cost_model_drift{program=}
+            # divide measured dispatch time by exactly these numbers
+            self._roofline.on_program(label, report.flops,
+                                      report.peak_bytes)
         if self._tp is not None:
             # the EQuARX baseline gauges, fed straight from the census:
             # collective ops per step and collective bytes per token this
@@ -1491,13 +1781,16 @@ class ServingEngine:
 
     def export_chrome_trace(self, path=None) -> dict:
         """Chrome ``trace_event`` JSON of every retained request trace
-        plus the engine step timeline — loadable in chrome://tracing and
-        ui.perfetto.dev. Writes to ``path`` when given; returns the
-        document either way (empty-track document with tracing off)."""
+        plus the engine step timeline — with per-step counter tracks
+        (pages_in_use / batch / queue_depth) and an instant per watchdog
+        alert — loadable in chrome://tracing and ui.perfetto.dev. Writes
+        to ``path`` when given; returns the document either way
+        (empty-track document with tracing off)."""
         traces = self.traces()
+        alerts = self.alerts()
         if path is not None:
-            return write_chrome_trace(path, traces, self._timeline)
-        return chrome_trace(traces, self._timeline)
+            return write_chrome_trace(path, traces, self._timeline, alerts)
+        return chrome_trace(traces, self._timeline, alerts)
 
     def result(self, rid: int) -> np.ndarray:
         return self._finished[rid]

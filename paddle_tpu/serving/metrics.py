@@ -137,17 +137,50 @@ bisect+add of the observation itself. High-watermark gauges
 ``serving_queue_depth_peak`` / ``serving_page_pool_peak`` keep the spikes
 a sampled gauge misses.
 
+Goodput attribution + watchdogs + flight recorder (PR 12):
+
+- serving_mfu                     gauge: achieved flops/s over the
+                                  audited programs' measured dispatch
+                                  time / device peak (0 until debug
+                                  audits supply the flops model)
+- serving_hbm_bw_util             gauge: same for the HBM byte roll-up
+                                  against peak memory bandwidth
+- serving_cost_model_drift{program=}  stat_max family: measured mean
+                                  step time / roofline-predicted time
+                                  per compiled program
+- serving_kernel_speedup_predicted{kernel=}  kernelcheck's banked
+                                  predicted speedup, surfaced live
+- serving_kernel_speedup_measured{kernel=}   measured composite/kernel
+                                  dispatch-time ratio once both paths
+                                  have served traffic
+- serving_kernel_speedup_drift{kernel=}      measured / predicted
+- serving_step_phase_s{phase=}    histogram family: per-phase step
+                                  wall-time attribution (admit / swap /
+                                  prefill / chunk_prefill / decode /
+                                  verify / evict / other)
+- serving_alerts_total{rule=}     counter family: watchdog firings per
+                                  rule (retrace_after_warmup /
+                                  pallas_fallback /
+                                  spec_acceptance_collapse /
+                                  eviction_thrash / queue_stall)
+
 Every counter incremented here is pre-seeded in ``_SEEDED`` — lint rule
 PT003 (this module shipped unseeded counters once) enforces it; every
 ``stat_set``/``stat_max`` gauge likewise, per the mirror rule PT008.
+Labeled-family names (``base{label=value}`` registry keys) are declared
+in ``_FAMILIES`` and their label values seeded at engine construction
+via :meth:`ServingMetrics.seed_family` — lint rule PT012 flags any
+labeled stat call whose base is in neither registry (the PT003/PT008
+blind spot for dynamically formatted names).
 """
 from __future__ import annotations
 
 import time
 from collections import deque
 
+from ..obs.attribution import PHASES
 from ..obs.histogram import (LATENCY_EDGES_S, OCCUPANCY_EDGES, QUANTILES,
-                             Histogram)
+                             Histogram, HistogramFamily)
 from ..utils import monitor
 
 PREFIX = "serving_"
@@ -176,8 +209,23 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "tp_degree", "tp_collective_ops_per_step",
            "tp_collective_bytes_per_token",
            "tokens_per_sec", "queue_depth", "active_requests",
-           "page_pool_used", "page_utilization",
+           "page_pool_used", "page_utilization", "mfu", "hbm_bw_util",
            "queue_depth_peak", "page_pool_peak")
+
+# labeled stat families: base name -> label key. Members live in the
+# monitor registry as ``serving_<base>{<label>=<value>}`` keys; label
+# VALUES are seeded at engine construction (seed_family) since most are
+# only known then (prefill bucket labels, registered kernels). Lint rule
+# PT012 checks every statically visible labeled stat call against this
+# registry — the dynamically-formatted-name blind spot of PT003/PT008.
+_FAMILIES = {
+    "step_phase_s": "phase",              # histogram family (below)
+    "alerts_total": "rule",               # counter: watchdog firings
+    "cost_model_drift": "program",        # stat_max: measured/predicted
+    "kernel_speedup_predicted": "kernel",  # banked kernelcheck contract
+    "kernel_speedup_measured": "kernel",   # live composite/kernel ratio
+    "kernel_speedup_drift": "kernel",      # measured / predicted
+}
 
 # histogram name -> bucket edges; percentile gauges <name>_{p50,p90,p99}
 # and <name>_count are seeded for each (dynamically — same presence
@@ -201,7 +249,8 @@ COUNTER_STATS = frozenset(
         "decode_steps", "rejected", "shed", "expired", "cancelled",
         "failed", "swap_outs", "swap_ins", "prefix_hits", "prefix_misses",
         "prefix_tokens_saved", "prefix_cow_copies", "prefix_evictions",
-        "hlo_collective_ops", "hlo_host_transfers"))
+        "hlo_collective_ops", "hlo_host_transfers")) \
+    | frozenset({PREFIX + "alerts_total"})  # labeled counter family base
 
 
 class ServingMetrics:
@@ -213,6 +262,14 @@ class ServingMetrics:
         self._samples: deque[tuple[float, float]] = deque()
         self.hists = {name: Histogram(PREFIX + name, edges)
                       for name, edges in _HISTOGRAMS}
+        # the per-phase step-time histogram family (label-generic: the
+        # same mechanism per-tenant latency classes will reuse)
+        self.phase_hist = HistogramFamily(
+            PREFIX + "step_phase_s", "phase", LATENCY_EDGES_S,
+            values=PHASES)
+        # scalar family members seeded so far: base -> ordered values
+        # (seed_family records them so reset() can replay the zeros)
+        self._family_values: dict[str, list[str]] = {}
         self.reset()
 
     def reset(self) -> None:
@@ -222,9 +279,28 @@ class ServingMetrics:
             monitor.stat_set(PREFIX + k, 0)
         for h in self.hists.values():
             h.reset()
+        self.phase_hist.reset()
+        for base, values in self._family_values.items():
+            label = _FAMILIES[base]
+            for v in values:
+                monitor.stat_set(PREFIX + f"{base}{{{label}={v}}}", 0)
         self._publish_hists()  # seed the percentile gauges at 0
         self._samples.clear()
         self._samples.append((time.perf_counter(), 0.0))
+
+    def seed_family(self, base: str, values) -> None:
+        """Pre-seed labeled family members at 0 — the presence contract
+        ``_SEEDED`` gives scalars, for label values only known at engine
+        construction (prefill buckets, watchdog rules, banked kernels).
+        ``base`` must be declared in ``_FAMILIES`` (the runtime
+        complement of lint rule PT012)."""
+        label = _FAMILIES[base]  # KeyError = undeclared family
+        seen = self._family_values.setdefault(base, [])
+        for v in values:
+            v = str(v)
+            if v not in seen:
+                seen.append(v)
+            monitor.stat_set(PREFIX + f"{base}{{{label}={v}}}", 0)
 
     # ------------------------------------------------------------- updates
     def on_prefill(self, tokens: int = 0) -> None:
@@ -373,6 +449,50 @@ class ServingMetrics:
         monitor.stat_max(PREFIX + "hlo_peak_hbm_bytes", int(peak_hbm_bytes))
         monitor.stat_max(PREFIX + "hlo_flops_per_step", float(flops))
 
+    # ------------------------------------------- attribution + watchdogs
+    def on_phase(self, phase: str, seconds: float) -> None:
+        """One phase's share of one step's wall time (attribution layer;
+        zero-time phases are not observed — the StepRecord keeps the
+        exact split)."""
+        self.phase_hist.observe(phase, seconds)
+
+    def on_roofline(self, mfu: float, hbm_bw_util: float) -> None:
+        """The live roofline gauges, recomputed from measured dispatch
+        time against the engine's own hlocheck audits."""
+        monitor.stat_set(PREFIX + "mfu", float(mfu))
+        monitor.stat_set(PREFIX + "hbm_bw_util", float(hbm_bw_util))
+
+    def on_drift(self, program: str, ratio: float) -> None:
+        """Measured/predicted step-time ratio for one compiled program —
+        a high-watermark, so the worst drift ever seen survives
+        sampling."""
+        monitor.stat_max(PREFIX + f"cost_model_drift{{program={program}}}",
+                         float(ratio))
+
+    def on_kernel_ab(self, kernel: str, predicted: float | None = None,
+                     measured: float | None = None,
+                     drift: float | None = None) -> None:
+        """One kernel's predicted-vs-measured speedup A/B: kernelcheck's
+        banked prediction beside the live composite/kernel dispatch-time
+        ratio (absent until both paths have served traffic)."""
+        if predicted is not None:
+            monitor.stat_set(
+                PREFIX + f"kernel_speedup_predicted{{kernel={kernel}}}",
+                float(predicted))
+        if measured is not None:
+            monitor.stat_set(
+                PREFIX + f"kernel_speedup_measured{{kernel={kernel}}}",
+                float(measured))
+        if drift is not None:
+            monitor.stat_set(
+                PREFIX + f"kernel_speedup_drift{{kernel={kernel}}}",
+                float(drift))
+
+    def on_alert(self, rule: str) -> None:
+        """One watchdog firing (the rule's family member is pre-seeded
+        at engine construction)."""
+        monitor.stat_add(PREFIX + f"alerts_total{{rule={rule}}}", 1)
+
     # ---------------------------------------------------------- histograms
     def observe_request(self, summary: dict) -> None:
         """Feed the request-latency histograms from one trace summary
@@ -399,6 +519,14 @@ class ServingMetrics:
                 monitor.stat_set(f"{PREFIX}{name}_{suffix}",
                                  h.percentile(q))
             monitor.stat_set(f"{PREFIX}{name}_count", h.count)
+        fam = self.phase_hist
+        for value, h in fam.children().items():
+            for suffix, q in QUANTILES:
+                monitor.stat_set(
+                    PREFIX + f"step_phase_s_{suffix}{{phase={value}}}",
+                    h.percentile(q))
+            monitor.stat_set(
+                PREFIX + f"step_phase_s_count{{phase={value}}}", h.count)
 
     # ------------------------------------------------------------ querying
     def snapshot(self) -> dict:
@@ -407,9 +535,12 @@ class ServingMetrics:
 
     def prometheus(self) -> str:
         """Prometheus text exposition of every serving stat: scalars typed
-        counter/gauge, the obs histograms as cumulative bucket series."""
+        counter/gauge (labeled family members rendered with proper
+        sample labels), the obs histograms — including the per-phase
+        family's children — as cumulative bucket series."""
         from ..obs.export import prometheus_text
 
         types = {k: "counter" for k in COUNTER_STATS}
-        return prometheus_text(self.snapshot(), list(self.hists.values()),
-                               types)
+        hists = list(self.hists.values()) + \
+            list(self.phase_hist.children().values())
+        return prometheus_text(self.snapshot(), hists, types)

@@ -1,0 +1,38 @@
+"""PT012 fixture: labeled stat families (``base{label=value}`` names,
+f-string formatted) written without a ``_FAMILIES`` declaration — the
+names PT003/PT008 cannot resolve statically."""
+from paddle_tpu.utils import monitor
+
+PREFIX = "serving_"
+_SEEDED = ("good_total",)
+_FAMILIES = {"known_total": "rule"}
+
+
+def rogue_fstring(rule):
+    # base "rogue_total" is in neither _FAMILIES nor _SEEDED: fires
+    monitor.stat_add(PREFIX + f"rogue_total{{rule={rule}}}", 1)
+
+
+def rogue_literal():
+    # a braced literal is PT012's too (PT003 defers names containing {)
+    monitor.stat_set(PREFIX + "rogue_gauge{kernel=paged_decode}", 1.0)
+
+
+def rogue_inline_prefix(rule):
+    # the prefix carried inline in the f-string instead of PREFIX +
+    monitor.stat_max(f"serving_rogue_peak{{rule={rule}}}", 2.0)
+
+
+def registered(rule):
+    # declared in _FAMILIES: clean
+    monitor.stat_add(PREFIX + f"known_total{{rule={rule}}}", 1)
+
+
+def seeded_scalar():
+    # plain seeded scalar: PT003's domain, not PT012's
+    monitor.stat_add(PREFIX + "good_total", 1)
+
+
+def suppressed(rule):
+    # the same defect, pragma-sanctioned
+    monitor.stat_add(PREFIX + f"rogue2_total{{rule={rule}}}", 1)  # lint: disable=PT012

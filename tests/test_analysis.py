@@ -369,6 +369,9 @@ _FIXTURE_CASES = {
                            {6: "PT010", 7: "PT010", 13: "PT010"}),
     "pt011_uncertified_pallas.py": ("kernels/pt011.py",
                                     {7: "PT011", 11: "PT011"}),
+    "pt012_unregistered_family.py": ("pt012.py",
+                                     {13: "PT012", 18: "PT012",
+                                      23: "PT012"}),
 }
 
 
@@ -387,8 +390,8 @@ def test_lint_rule_fixture(fixture):
 
 
 def test_lint_rule_table_is_complete():
-    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + ["PT010",
-                                                                  "PT011"]
+    assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + [
+        "PT010", "PT011", "PT012"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -518,6 +521,24 @@ def test_self_lint_catches_uncertified_pallas_kernel():
     assert ann != src
     assert not any(f.rule == "PT011" for f in lint_source(
         ann, "paddle_tpu/kernels/fused_layernorm.py"))
+
+
+def test_self_lint_catches_unregistered_stat_family():
+    """Deliberately strip the alerts family from metrics._FAMILIES: PT012
+    must fire at the on_alert stat_add — a formatted family name
+    PT003/PT008 can't resolve would otherwise ship with no pre-seeded
+    members. The declared original stays clean."""
+    path = REPO / "paddle_tpu" / "serving" / "metrics.py"
+    src = path.read_text()
+    bad = "\n".join(line for line in src.splitlines()
+                    if '"alerts_total": "rule",' not in line)
+    assert bad != src, "metrics.py no longer declares the alerts family"
+    findings = lint_source(bad, "paddle_tpu/serving/metrics.py")
+    assert any(f.rule == "PT012" and "alerts_total" in f.message
+               for f in findings)
+    assert not any(f.rule in ("PT003", "PT008", "PT012")
+                   for f in lint_source(
+                       src, "paddle_tpu/serving/metrics.py"))
 
 
 def test_self_lint_catches_reintroduced_wall_clock():

@@ -355,7 +355,7 @@ def test_chrome_trace_schema():
     events = doc["traceEvents"]
     assert doc["displayTimeUnit"] == "ms" and events
     for ev in events:
-        assert ev["ph"] in ("X", "i", "M"), ev
+        assert ev["ph"] in ("X", "i", "M", "C"), ev
         assert isinstance(ev["name"], str) and ev["name"]
         assert ev["pid"] == 1
         assert isinstance(ev["tid"], int)
@@ -364,7 +364,13 @@ def test_chrome_trace_schema():
         if ev["ph"] == "X":
             assert ev["dur"] >= 0.0
         if ev["ph"] == "i":
-            assert ev["s"] == "t"
+            # request-track instants are thread-scoped; watchdog alert
+            # instants on the engine track are global
+            assert ev["s"] in ("t", "g")
+        if ev["ph"] == "C":
+            # counter tracks: one numeric series per args key
+            assert all(isinstance(v, (int, float))
+                       for v in ev["args"].values()), ev
     # one named track per request + the engine loop
     threads = {ev["tid"]: ev["args"]["name"] for ev in events
                if ev["ph"] == "M" and ev["name"] == "thread_name"}

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Observability CLI entry point — flight-record reader + Prometheus
+exposition, exit-code-clean.
+
+Usage:
+    python tools/obs.py --flight-record dump.json               # pretty
+    python tools/obs.py --flight-record dump.json --prometheus
+    python tools/obs.py --flight-record dump.json --latency-table
+    python tools/obs.py --prometheus          # live registry of THIS proc
+
+Exit codes: 0 clean, 1 the dump records alerts or a fatal/failure
+reason, 2 bad usage / unreadable dump — the analysis CLI convention. The
+same engine runs as ``python -m paddle_tpu.obs``.
+
+The repo root is forced onto sys.path FIRST so this drives the checkout's
+paddle_tpu, never an installed copy (the tools/lint.py idiom).
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.obs.__main__ import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
