@@ -3,12 +3,14 @@ grid-race detection, and roofline contracts — before hardware ever runs one.
 
 Every Pallas kernel in-tree shipped uncertified: the paged-decode dispatch
 in ``kernels/paged_attention.py`` had never run on a chip, silently fell
-back on *any* exception, and is skipped entirely for the int8 pools the
+back on *any* exception, and was skipped entirely for the int8 pools the
 production path would actually serve. PRs 6 and 10 set the pattern —
 freeze a static budget, audit every compiled artifact once, fail loudly on
 drift — and this module extends that certification discipline down to the
-kernel level, so the upcoming unified ragged-attention kernel (ROADMAP top
-item, arxiv 2604.15464) lands against contracts instead of hope.
+kernel level. The unified ragged-attention kernel
+(``kernels/ragged_paged_attention.py``, arxiv 2604.15464) landed through
+exactly this strip: registered, budgeted, its data-dependent output map
+proven injective at runtime ``index_args``, roofline banked.
 
 ``certify(fn, args)`` traces a kernel entry point to its jaxpr (under the
 same ``i32_index_scope`` its launches use), finds every ``pallas_call``
@@ -38,7 +40,12 @@ against a frozen :class:`KernelBudget`:
   the KV dim) and passes only when the budget declares
   ``allow_output_revisits``. Index maps reading scalar-prefetch operands
   are data-dependent — injectivity is undecidable statically, so they
-  fail closed unless ``allow_data_dependent_outputs``.
+  fail closed unless ``allow_data_dependent_outputs`` — AND, when
+  ``certify(..., index_args=)`` supplies concrete runtime values for the
+  scalar operands (the ragged kernel's ``(ctx_lens, cu_q_lens,
+  page_table)``), the map is evaluated for real and the standard
+  injectivity proof runs on it: the declaration sanctions the
+  data-dependence, the runtime proof resolves it.
 - **Roofline contract** — analytical FLOPs (declared per registry entry),
   a static HBM traffic model (block bytes × index-map *transitions* over
   the row-major grid — Mosaic skips the refetch when consecutive steps
@@ -51,15 +58,18 @@ against a frozen :class:`KernelBudget`:
   in any analytic field; the composite-measured side is re-measured and
   reported, never hard-pinned (XLA cost models move across versions).
 
-:data:`REGISTRY` names the in-tree kernel families (flash/splash dense
-and splash causal attention, the paged ragged decode, fused layernorm
-fwd+dx, the fused Adam update), mirroring ``hlocheck.REGISTRY``;
-``run_kernel`` certifies one entry the way ``hlocheck.run_step`` audits
-one step. ``coverage_report()`` statically enumerates the dispatch gates
-(``FLAGS_use_pallas_kernels``, the ``decode_kernel_eligible`` shape
-gates, the int8 skip, flash ``supports_shape``) and reports which serving
-configs reach a Pallas kernel vs the composite — making "int8 decode has
-no fast kernel" a machine-readable finding instead of a docstring aside.
+:data:`REGISTRY` names the in-tree kernel families (flash/splash
+attention, the unified ragged paged kernel at its four mode shapes, the
+legacy library paged decode, fused layernorm fwd+dx, the fused Adam
+update), mirroring ``hlocheck.REGISTRY``; ``run_kernel`` certifies one
+entry the way ``hlocheck.run_step`` audits one step.
+``coverage_report()`` statically enumerates the dispatch gates
+(``FLAGS_use_pallas_kernels``, the unified ``ragged_kernel_eligible``
+rules, flash ``flash_route`` incl. the causal pad-to-block rescue) and
+reports which serving configs reach a Pallas kernel vs the composite —
+PR 11's "int8 decode has no fast kernel" / "head_dim 64 is kernel-less"
+findings flipped to covered when the ragged kernel landed, and the
+report keeps them that way.
 
 CLI: ``python -m paddle_tpu.analysis kernelcheck [--kernel NAME] [--bank]
 [--json PATH]`` (also ``tools/kernelcheck.py``), exit 0 clean / 1 on any
@@ -81,8 +91,8 @@ from dataclasses import dataclass, field
 __all__ = ["KernelBudget", "KernelFinding", "PallasCallReport",
            "KernelCertReport", "KernelCheckError", "VMEM_CAPS", "LANE",
            "certify", "KernelSpec", "REGISTRY", "run_kernel",
-           "coverage_report", "validate_flash_tuned", "bank_path",
-           "diff_banked", "main"]
+           "coverage_report", "validate_flash_tuned",
+           "validate_ragged_tuned", "bank_path", "diff_banked", "main"]
 
 
 class KernelCheckError(RuntimeError):
@@ -269,11 +279,20 @@ def _index_map_info(bm, n_grid: int):
     return data_dependent, constant
 
 
-def _eval_index_map(bm, grid, max_points: int):
+def _eval_index_map(bm, grid, max_points: int, index_args=None):
     """The index map's block-index tuple at each grid point, in row-major
     (pipeline) order. Returns (points, tuples, truncated). Evaluated
     under the i32 scope the map was traced in — the package-global x64
-    would promote the literal arithmetic and break mixed-dtype selects."""
+    would promote the literal arithmetic and break mixed-dtype selects.
+
+    ``index_args`` supplies CONCRETE runtime values for the map's
+    scalar-prefetch operands (``ctx_lens``/``cu_q_lens``/page tables —
+    the ragged kernel's parameterization): with them a data-dependent
+    map is evaluated for real and its injectivity PROVEN for that
+    representative call instead of failing closed. Scalar-prefetch
+    operands appear in the map jaxpr as Refs, so the jaxpr is discharged
+    to functional form first (discharge appends the final ref values as
+    extra outputs — sliced off)."""
     import jax
     import numpy as np
 
@@ -281,13 +300,31 @@ def _eval_index_map(bm, grid, max_points: int):
 
     jx = bm.index_map_jaxpr
     n_grid = len(grid)
-    n_extra = len(jx.jaxpr.invars) - n_grid
+    extras = jx.jaxpr.invars[n_grid:]
+    jaxpr, consts = jx.jaxpr, jx.consts
+    n_out = len(jaxpr.outvars)
+    if extras:
+        from jax._src.state.discharge import discharge_state
+
+        jaxpr, consts = discharge_state(jaxpr, consts)
+    if index_args is not None:
+        vals = [np.asarray(a) for a in index_args]
+        if len(vals) != len(extras):
+            raise ValueError(
+                f"index_args supplies {len(vals)} scalar-prefetch "
+                f"value(s) but the index map takes {len(extras)}")
+    else:
+        # non-data-dependent maps never read these; shape-correct zeros
+        # keep the discharged jaxpr evaluable either way
+        vals = [np.zeros(tuple(getattr(v.aval, "shape", ()) or ()),
+                         getattr(v.aval, "dtype", np.int32))
+                for v in extras]
     points, tuples = [], []
     it = itertools.product(*(range(int(g)) for g in grid))
     with i32_index_scope():
         for point in itertools.islice(it, max_points):
-            args = [np.int32(i) for i in point] + [np.int32(0)] * n_extra
-            out = jax.core.eval_jaxpr(jx.jaxpr, jx.consts, *args)
+            args = [np.int32(i) for i in point] + vals
+            out = jax.core.eval_jaxpr(jaxpr, consts, *args)[:n_out]
             points.append(point)
             tuples.append(tuple(int(x) for x in out))
     total = 1
@@ -297,7 +334,8 @@ def _eval_index_map(bm, grid, max_points: int):
 
 
 # ------------------------------------------------------------- certify core
-def _certify_call(eqn, budget: KernelBudget, name: str) -> PallasCallReport:
+def _certify_call(eqn, budget: KernelBudget, name: str,
+                  index_args=None) -> PallasCallReport:
     import numpy as np
 
     gm = eqn.params["grid_mapping"]
@@ -374,11 +412,14 @@ def _certify_call(eqn, budget: KernelBudget, name: str) -> PallasCallReport:
         # order (consecutive equal indices reuse the resident block)
         if constant:
             hbm += nbytes
-        elif data_dep:
+        elif data_dep and index_args is None:
             hbm += nbytes * n_steps  # undecidable: every-step upper bound
         else:
+            # data-dependent maps WITH runtime index_args evaluate for
+            # real — the banked HBM model reflects the canonical call
+            # instead of the every-step upper bound
             _, tuples, truncated = _eval_index_map(
-                bm, grid, budget.max_race_points)
+                bm, grid, budget.max_race_points, index_args)
             transitions = 1 + sum(1 for a, b in zip(tuples, tuples[1:])
                                   if a != b)
             hbm += nbytes * (n_steps if truncated else transitions)
@@ -415,7 +456,8 @@ def _certify_call(eqn, budget: KernelBudget, name: str) -> PallasCallReport:
                                                  gm.num_inputs
                                                  + gm.num_outputs]):
         data_dep, constant = _index_map_info(bm, len(grid))
-        if data_dep:
+        if data_dep and not (index_args is not None
+                             and budget.allow_data_dependent_outputs):
             sev = ("warn" if budget.allow_data_dependent_outputs
                    else "error")
             findings.append(KernelFinding(
@@ -423,13 +465,19 @@ def _certify_call(eqn, budget: KernelBudget, name: str) -> PallasCallReport:
                 f"{name} output {out_i}: index_map reads scalar-prefetch "
                 f"operands — injectivity over the grid is data-dependent "
                 f"and cannot be proven statically"
-                + ("" if sev == "warn" else
-                   " (declare allow_data_dependent_outputs to sanction)")))
+                + (" (pass index_args= with runtime scalar values to "
+                   "prove it for a representative call)" if sev == "warn"
+                   else " (declare allow_data_dependent_outputs to "
+                        "sanction)")))
             continue
+        # a data-dependent output map that reaches here is RESOLVED:
+        # allow_data_dependent_outputs is declared AND index_args carry
+        # the runtime scalar values, so the map evaluates for real below
+        # and the standard run/reappear injectivity proof applies to it
         if len(grid) == 0:
             continue
         points, tuples, truncated = _eval_index_map(
-            bm, grid, budget.max_race_points)
+            bm, grid, budget.max_race_points, index_args)
         if truncated:
             findings.append(KernelFinding(
                 "race", "warn",
@@ -504,14 +552,19 @@ def _certify_call(eqn, budget: KernelBudget, name: str) -> PallasCallReport:
 
 def certify(fn, args, *, name: str | None = None,
             budget: KernelBudget | None = None,
-            constraints=()) -> KernelCertReport:
+            constraints=(), index_args=None) -> KernelCertReport:
     """Trace ``fn(*args)`` to a jaxpr (args may be ShapeDtypeStructs —
     nothing executes, nothing materializes) and certify every
     ``pallas_call`` it contains against ``budget``. ``constraints`` are
     pre-evaluated entry-level dispatch checks ``(name, ok, detail)`` —
     a False one is a dispatch violation (the composite-fallback rules,
     e.g. flash's %block gate, checked statically instead of discovered
-    at runtime)."""
+    at runtime). ``index_args`` are concrete runtime values for the
+    kernel's scalar-prefetch operands (``ctx_lens``/``cu_q_lens``/page
+    table): with them, data-dependent output index maps sanctioned by
+    ``allow_data_dependent_outputs`` get a REAL injectivity proof for
+    the representative call (and data-dependent HBM traffic is counted
+    from actual transitions) — resolved, not suppressed."""
     import jax
 
     from ..kernels._common import i32_index_scope
@@ -544,7 +597,8 @@ def certify(fn, args, *, name: str | None = None,
             f"certified function dispatches to a composite path"))
     calls = tuple(
         _certify_call(eqn, budget,
-                      name if len(eqns) == 1 else f"{name}[{i}]")
+                      name if len(eqns) == 1 else f"{name}[{i}]",
+                      index_args=index_args)
         for i, eqn in enumerate(eqns))
     return KernelCertReport(name=name, calls=calls,
                             findings=tuple(findings))
@@ -580,6 +634,37 @@ def validate_flash_tuned(table: dict) -> list[str]:
         if d % 64:
             errors.append(f"{key!r}: head_dim {d} is not a multiple of "
                           f"the 64-lane tile the kernel requires")
+    return errors
+
+
+def validate_ragged_tuned(table: dict) -> list[str]:
+    """Constraint validation for ``kernels/ragged_tuned.json`` entries
+    (``"page_size,num_heads,head_dim" -> block_heads``), shared by the
+    load site in ``kernels/ragged_paged_attention.py`` and the writer in
+    ``tools/ragged_autotune.py`` — the flash_tuned discipline: load can
+    never see an entry bank rejected. Returns error strings (empty =
+    clean)."""
+    errors = []
+    for key, bh in sorted(table.items()):
+        try:
+            ps, h, d = (int(x) for x in str(key).split(","))
+        except ValueError:
+            errors.append(f"{key!r}: key must be "
+                          f"'page_size,num_heads,head_dim' ints")
+            continue
+        if not isinstance(bh, int) or bh <= 0:
+            errors.append(f"{key!r}: block_heads {bh!r} must be a "
+                          f"positive int")
+            continue
+        if ps <= 0 or h <= 0 or d <= 0:
+            errors.append(f"{key!r}: page_size/num_heads/head_dim must "
+                          f"be positive")
+            continue
+        if h % bh:
+            errors.append(f"{key!r}: block_heads {bh} does not divide "
+                          f"num_heads {h} — the head grid dim would "
+                          f"truncate and the tail heads would be "
+                          f"silently unserved")
     return errors
 
 
@@ -681,13 +766,17 @@ def _build_paged_decode():
     pool = _sds((p["num_pages"], ps, h, d), jnp.float32)
     table = _sds((b, pps), jnp.int32)
     ctx = _sds((b,), jnp.int32)
-    ok, _why = pa.decode_kernel_eligible(d, pps, ps)
-    ok_q8, why_q8 = pa.decode_kernel_eligible(d, pps, ps, quantized=True)
+    ok, _why = pa.decode_kernel_eligible(d, pps, ps, num_heads=h)
+    ok_q8, why_q8 = pa.decode_kernel_eligible(d, pps, ps, num_heads=h,
+                                              quantized=True)
     constraints = (
         ("decode_kernel_eligible", ok,
-         "the serving decode shape must pass every dispatch gate "
-         "(head_dim % 128, page-table width % pages_per_block)"),
-        ("int8_skip_is_declared", not ok_q8, why_q8),
+         "the serving decode shape must pass every dispatch gate"),
+        # the PR 11 'int8_skip_is_declared' constraint, inverted: the
+        # unified ragged kernel fuses the dequant, so the quantized
+        # serving path is now kernel-ELIGIBLE — certified here so the
+        # coverage flip can never silently regress
+        ("int8_served_by_unified_kernel", ok_q8, why_q8),
     )
 
     def composite(q, kp, vp, table, ctx):
@@ -704,6 +793,95 @@ def _build_paged_decode():
         flops=float(4 * b * h * S * d),
         composite=composite,
         composite_args=(q, pool, pool, table, ctx))
+
+
+def _build_ragged(mode: str):
+    """The unified ragged paged-attention kernel at one serving mode's
+    canonical shape: ``decode`` (s=1 fp32), ``q8`` (s=1, int8 codes +
+    per-page-per-head scales, dequant fused into the gather), ``verify``
+    (the spec K+1=5 contract), ``prefill`` (single-row chunk tail, 64-pad
+    bucket at ctx0=192). All four trace to the SAME program shape — one
+    kernel, four certificates. ``index_args`` carry the canonical runtime
+    scalar-prefetch values (ctx_lens, cu_q_lens, page table) so the
+    data-dependent output index map is PROVEN injective, and the HBM
+    model counts the canonical call's actual block transitions."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from ..kernels import paged_attention as pa
+    from ..kernels import ragged_paged_attention as rp
+    from ..kernels.attention import sdpa_reference
+
+    p = _PAGED_SHAPE
+    b, h, d = p["batch"], p["heads"], p["head_dim"]
+    ps, pps, npages = p["page_size"], p["pages_per_seq"], p["num_pages"]
+    s = {"decode": 1, "q8": 1, "verify": 5, "prefill": 64}[mode]
+    if mode == "prefill":
+        b = 1
+    quant = mode == "q8"
+    S = ps * pps
+    q = _sds((b, h, s, d), jnp.float32)
+    pool = _sds((npages, ps, h, d), jnp.int8 if quant else jnp.float32)
+    table = _sds((b, pps), jnp.int32)
+    ctx = _sds((b,), jnp.int32)
+    # canonical runtime values: a non-trivial page permutation and ragged
+    # mid-context lengths — what the injectivity proof and the banked HBM
+    # transition counts are evaluated at
+    tab_np = (np.arange(1, 1 + b * pps, dtype=np.int32)
+              .reshape(b, pps) % npages)
+    ctx_np = (np.asarray([192], np.int32) if mode == "prefill"
+              else np.asarray([317, 129][:b], np.int32))
+    cu_np = np.arange(b + 1, dtype=np.int32) * s
+    ok, why = rp.ragged_kernel_eligible(d, pps, ps, s, num_heads=h,
+                                        quantized=quant)
+    ok64, why64 = rp.ragged_kernel_eligible(64, pps, ps, s, num_heads=h,
+                                            quantized=quant)
+    constraints = (
+        ("ragged_kernel_eligible", ok, why or
+         "the canonical shape must pass every unified-kernel gate"),
+        # the two kernelcheck coverage gaps this kernel exists to close,
+        # certified so they can never silently reopen
+        ("head_dim_64_eligible", ok64, why64 or
+         "head_dim 64 must stay covered by the unified kernel"),
+    )
+
+    if quant:
+        scale = _sds((npages, h), jnp.float32)
+
+        def fn(q, kp, vp, t, c, ksc, vsc):
+            return rp.ragged_paged_attention(q, kp, vp, t, c,
+                                             k_scale=ksc, v_scale=vsc)
+
+        def composite(q, kp, vp, t, c, ksc, vsc):
+            k_all = pa.paged_gather_quant(kp, ksc, t, q.dtype)
+            v_all = pa.paged_gather_quant(vp, vsc, t, q.dtype)
+            mask = pa.ragged_mask(c, k_all.shape[2], s)
+            return sdpa_reference(q, k_all, v_all, mask=mask)
+
+        args = (q, pool, pool, table, ctx, scale, scale)
+    else:
+        def fn(q, kp, vp, t, c):
+            return rp.ragged_paged_attention(q, kp, vp, t, c)
+
+        def composite(q, kp, vp, t, c):
+            k_all = pa.paged_gather(kp, t)
+            v_all = pa.paged_gather(vp, t)
+            mask = pa.ragged_mask(c, k_all.shape[2], s)
+            return sdpa_reference(q, k_all, v_all, mask=mask)
+
+        args = (q, pool, pool, table, ctx)
+
+    return dict(
+        fn=fn, args=args,
+        # the data-dependent output map (cu_q_lens[b] // s) is sanctioned
+        # AND resolved: index_args below give the proof its runtime values
+        budget=KernelBudget(allow_data_dependent_outputs=True),
+        constraints=constraints,
+        index_args=(ctx_np, cu_np, tab_np),
+        # qk + av MACs over the gathered width, x2 flops/MAC
+        flops=float(4 * b * h * s * S * d),
+        composite=composite, composite_args=args)
 
 
 def _build_ln(which: str):
@@ -796,10 +974,28 @@ REGISTRY: dict[str, KernelSpec] = {s.name: s for s in (
     KernelSpec("splash_fwd", "causal splash attention forward (tile-"
                "skipping mask, seq 1024) — same accumulation contract",
                _build_splash),
-    KernelSpec("paged_decode", "ragged paged-attention decode (the "
-               "serving hot path): library TPU kernel at the canonical "
-               "serving shape; certifies the int8 skip as a declared "
-               "dispatch constraint", _build_paged_decode),
+    KernelSpec("paged_decode", "LEGACY library paged-decode kernel at "
+               "the canonical serving shape — kept certified as the "
+               "pre-unification A/B baseline; dispatch routes through "
+               "ragged_paged instead", _build_paged_decode),
+    KernelSpec("ragged_paged", "UNIFIED ragged paged attention, decode "
+               "mode (s=1, fp32) — one Pallas program for all four "
+               "serving attention modes; data-dependent output map "
+               "proven injective at runtime index_args",
+               lambda: _build_ragged("decode")),
+    KernelSpec("ragged_paged_q8", "unified ragged kernel, int8 mode: "
+               "per-page-per-head dequant fused into the page gather — "
+               "the quantized serving path's first kernel (closes the "
+               "int8-decode coverage gap)",
+               lambda: _build_ragged("q8")),
+    KernelSpec("ragged_paged_verify", "unified ragged kernel at the "
+               "speculative K+1=5 verify contract — the per-depth "
+               "verify programs collapse onto the one program shape",
+               lambda: _build_ragged("verify")),
+    KernelSpec("ragged_paged_prefill", "unified ragged kernel at the "
+               "single-row chunked-prefill tail (64-pad bucket, "
+               "ctx0=192) — prefill and chunk ride the same program",
+               lambda: _build_ragged("prefill")),
     KernelSpec("fused_layernorm_fwd", "fused LayerNorm forward (one HBM "
                "pass per row block, stats saved for the backward)",
                lambda: _build_ln("fwd")),
@@ -821,7 +1017,8 @@ def run_kernel(name: str) -> tuple[KernelCertReport, dict]:
                        f"(have: {', '.join(REGISTRY)})")
     b = spec.build()
     report = certify(b["fn"], b["args"], name=name, budget=b["budget"],
-                     constraints=b.get("constraints", ()))
+                     constraints=b.get("constraints", ()),
+                     index_args=b.get("index_args"))
     hbm = report.hbm_bytes
     flops = b["flops"]
     record = {
@@ -922,8 +1119,8 @@ def coverage_report() -> dict:
             for kv in ("float32", "int8"):
                 ok, why = pa.decode_kernel_eligible(
                     p["head_dim"], p["pages_per_seq"], p["page_size"],
-                    quantized=kv == "int8", on_tpu=platform == "tpu",
-                    flags_on=flags_on)
+                    num_heads=p["heads"], quantized=kv == "int8",
+                    on_tpu=platform == "tpu", flags_on=flags_on)
                 rows.append({
                     "family": "paged_decode",
                     "config": (f"platform={platform} "
@@ -932,22 +1129,54 @@ def coverage_report() -> dict:
                     "path": "pallas" if ok else "composite",
                     "blocked_by": why})
     ok, why = pa.decode_kernel_eligible(64, p["pages_per_seq"],
-                                        p["page_size"])
+                                        p["page_size"],
+                                        num_heads=p["heads"])
     rows.append({"family": "paged_decode",
                  "config": ("platform=tpu pallas_flag=on kv_dtype=float32 "
                             "head_dim=64"),
                  "path": "pallas" if ok else "composite",
                  "blocked_by": why})
+    # the unified kernel's multi-token modes: chunked-prefill tail (the
+    # pad bucket) and the speculative K+1 verify, both dtypes — the SAME
+    # decode_kernel_eligible predicate at num_query_tokens > 1, so these
+    # rows track the dispatch for free
+    for mode, nq in (("verify[K+1=5]", 5), ("prefill[64]", 64)):
+        for kv in ("float32", "int8"):
+            ok, why = pa.decode_kernel_eligible(
+                p["head_dim"], p["pages_per_seq"], p["page_size"],
+                num_heads=p["heads"], quantized=kv == "int8",
+                num_query_tokens=nq)
+            rows.append({
+                "family": "ragged_paged",
+                "config": (f"platform=tpu pallas_flag=on kv_dtype={kv} "
+                           f"mode={mode}"),
+                "path": "pallas" if ok else "composite",
+                "blocked_by": why})
     for s in (1024, 640, 512):
         shape = (1, 8, s, 128)
-        ok = fa.supports_shape(shape, shape)
+        route = fa.flash_route(shape, shape, causal=True)
+        path = {"direct": "pallas", "pad": "pallas[padded]"}.get(
+            route, "composite")
         rows.append({
             "family": "flash_prefill",
-            "config": f"platform=tpu pallas_flag=on seq={s}",
-            "path": "pallas" if ok else "composite",
-            "blocked_by": "" if ok else (
+            "config": f"platform=tpu pallas_flag=on seq={s} causal",
+            "path": path,
+            "blocked_by": "" if route else (
                 f"seq {s} fails supports_shape (%128 MXU tile and "
-                f"%{fa._block(s, 128)} block edge)")})
+                f"%{fa._block(s, 128)} block edge) and the causal "
+                f"pad-to-block route")})
+    # the %512 edge WITHOUT the causal pad rescue: non-causal can't pad
+    # (padded keys would be attended) — a loudly-counted fallback
+    # (serving_flash_edge_fallback_total), never a silent one
+    shape = (1, 8, 640, 128)
+    route = fa.flash_route(shape, shape, causal=False)
+    rows.append({
+        "family": "flash_prefill",
+        "config": "platform=tpu pallas_flag=on seq=640 non-causal",
+        "path": "pallas" if route else "composite[counted]",
+        "blocked_by": "" if route else (
+            "non-causal seq 640 cannot pad-to-block; composite serves "
+            "and serving_flash_edge_fallback_total counts it")})
     for gate, why in (("pallas_flag=off", "FLAGS_use_pallas_kernels off"),
                       ("platform=cpu", "CPU backend: Pallas TPU kernels "
                                        "unavailable")):

@@ -19,18 +19,13 @@ def _on_tpu() -> bool:
     return on_tpu_backend()
 
 
-def _use_pallas(q, k) -> bool:
+def _pallas_wanted() -> bool:
+    """Backend + flag half of the flash gate; the shape half is
+    ``flash_attention.flash_route`` (one source of truth with the
+    kernelcheck coverage report)."""
     from ..utils.flags import flag
 
-    if not flag("FLAGS_use_pallas_kernels", True) or not _on_tpu():
-        return False
-    # gate derived from the kernel's own tiling constraints — one source of truth
-    try:
-        from .flash_attention import supports_shape
-    except ImportError:  # pallas ops moved/absent in this jax build
-        return False
-
-    return supports_shape(q.shape, k.shape)
+    return bool(flag("FLAGS_use_pallas_kernels", True)) and _on_tpu()
 
 
 def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
@@ -53,24 +48,71 @@ def sdpa_reference(q, k, v, mask=None, is_causal=False, scale=None):
 
 
 _flash_fallback_logged: set[tuple] = set()
+_edge_logged: set[tuple] = set()
+
+
+def _log_flash_fallback(q, k, e: Exception) -> None:
+    # log once per (shape, error) — a silent fallback to the O(S^2)
+    # composite path invisibly costs HBM and MFU (VERDICT r3 weak #3)
+    sig = (q.shape, k.shape, type(e).__name__)
+    if sig not in _flash_fallback_logged:
+        _flash_fallback_logged.add(sig)
+        import sys
+
+        print(f"[paddle_tpu] pallas flash attention failed for "
+              f"q{tuple(q.shape)} k{tuple(k.shape)} "
+              f"({type(e).__name__}: {str(e)[:300]}); falling back to "
+              f"composite O(S^2) attention", file=sys.stderr, flush=True)
 
 
 def sdpa(q, k, v, mask=None, is_causal=False, scale=None):
-    if mask is None and _use_pallas(q, k):
+    if mask is None and _pallas_wanted():
         try:
-            from .flash_attention import flash_attention
+            from . import flash_attention as fa
+        except ImportError:  # pallas ops moved/absent in this jax build
+            fa = None
+        route = (fa.flash_route(q.shape, k.shape, bool(is_causal))
+                 if fa is not None else "")
+        if route:
+            try:
+                if route == "pad":
+                    # the seq-%512 edge (e.g. 640): causal self-attention
+                    # padded to the next block multiple — padded keys sit
+                    # strictly above the causal diagonal for every real
+                    # query, so the sliced-back rows are exact; counted
+                    # on the pre-seeded gauge where the dispatch Python
+                    # runs (once per traced program under jit — the
+                    # pallas_fallback_total growth-signal contract)
+                    from ..utils import monitor
 
-            return flash_attention(q, k, v, causal=is_causal, scale=scale)
-        except Exception as e:  # noqa: BLE001 — fall back on any pallas failure
-            # log once per (shape, error) — a silent fallback to the O(S^2)
-            # composite path invisibly costs HBM and MFU (VERDICT r3 weak #3)
-            sig = (q.shape, k.shape, type(e).__name__)
-            if sig not in _flash_fallback_logged:
-                _flash_fallback_logged.add(sig)
+                    monitor.stat_add("serving_flash_pad_total", 1)
+                    s = q.shape[-2]
+                    pad = fa.pad_seq_to_block(s) - s
+                    widths = [(0, 0)] * (q.ndim - 2) + [(0, pad), (0, 0)]
+                    out = fa.flash_attention(
+                        jnp.pad(q, widths), jnp.pad(k, widths),
+                        jnp.pad(v, widths), causal=True, scale=scale)
+                    return out[..., :s, :]
+                return fa.flash_attention(q, k, v, causal=is_causal,
+                                          scale=scale)
+            except Exception as e:  # noqa: BLE001 — fall back on any pallas failure
+                _log_flash_fallback(q, k, e)
+        elif fa is not None and fa.edge_missed(q.shape, k.shape):
+            # flash-shaped, TPU, flag on — yet no kernel route: the
+            # loudly-counted fallback (the coverage report's remaining
+            # flash edge), never a silent one
+            from ..utils import monitor
+
+            monitor.stat_add("serving_flash_edge_fallback_total", 1)
+            sig = (q.shape, k.shape, bool(is_causal))
+            if sig not in _edge_logged:
+                _edge_logged.add(sig)
                 import sys
 
-                print(f"[paddle_tpu] pallas flash attention failed for "
+                print(f"[paddle_tpu] flash-shaped attention "
                       f"q{tuple(q.shape)} k{tuple(k.shape)} "
-                      f"({type(e).__name__}: {str(e)[:300]}); falling back to "
-                      f"composite O(S^2) attention", file=sys.stderr, flush=True)
+                      f"causal={bool(is_causal)} has no kernel route "
+                      f"(alignment/non-causal edge); composite serves — "
+                      f"counted on serving_flash_edge_fallback_total",
+                      file=sys.stderr, flush=True)
     return sdpa_reference(q, k, v, mask, is_causal, scale)

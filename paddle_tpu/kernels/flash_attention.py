@@ -94,6 +94,48 @@ def supports_shape(q_shape, k_shape) -> bool:
             and s_q % _block(s_q, d) == 0 and s_k % _block(s_k, d) == 0)
 
 
+def pad_seq_to_block(s: int) -> int:
+    """Smallest 512-multiple >= s — the padding target of the causal
+    pad-to-block route (512 satisfies both the %128 MXU rule and the
+    default block edge; a tuned entry for the padded length is
+    load-validated to tile it)."""
+    return -(-s // 512) * 512
+
+
+def flash_route(q_shape, k_shape, causal: bool) -> str:
+    """How this shape reaches the Pallas kernels: ``"direct"`` (passes
+    ``supports_shape``), ``"pad"`` (the seq-%512 edge, e.g. 640: causal
+    self-attention padded to the next block multiple — padded keys sit
+    strictly above the causal diagonal for every real query row, so the
+    sliced-back output is exactly the unpadded computation), or ``""``
+    (composite; the dispatch counts it loudly when it was flash-shaped).
+    Single source of truth for the dispatch in kernels/attention.py AND
+    the kernelcheck coverage report — the seq-%512 configs can no longer
+    fall off the fast path silently."""
+    if supports_shape(q_shape, k_shape):
+        return "direct"
+    *_, s_q, d = q_shape
+    s_k = k_shape[-2]
+    if not causal or s_q != s_k or d % 64 or s_q < 128:
+        return ""  # padding non-causal attention would attend pad keys
+    pad = pad_seq_to_block(s_q)
+    shape = (*q_shape[:-2], pad, d)
+    if pad <= 2 * s_q and supports_shape(shape, shape):
+        return "pad"
+    return ""
+
+
+def edge_missed(q_shape, k_shape) -> bool:
+    """A flash-shaped call (seqs >= 128, 64-aligned head_dim) that still
+    has no kernel route — the alignment/non-causal edges the kernelcheck
+    coverage report names, counted loudly at dispatch
+    (``serving_flash_edge_fallback_total``). Sub-kernel shapes (tiny
+    seqs, odd head dims) are out of scope, not edges."""
+    *_, s_q, d = q_shape
+    s_k = k_shape[-2]
+    return d % 64 == 0 and s_q >= 128 and s_k >= 128
+
+
 def _block_sizes(s_q, s_k, d=None):
     b = _block(s_q, d)
     bk = _block(s_k, d)

@@ -5,11 +5,18 @@ fixed-size pages of a preallocated pool; each sequence owns a page table and
 requests of different lengths share ONE statically-shaped computation. Two
 paths, dispatched like kernels/attention.py:
 
-1. Pallas ragged decode kernel (jax.experimental.pallas paged_attention) on
-   TPU, behind the same ``FLAGS_use_pallas_kernels`` gate.
+1. The UNIFIED ragged Pallas kernel (:mod:`.ragged_paged_attention`) —
+   one program serving prefill, chunked prefill, decode, and the K+1
+   spec-verify contract, fp32 and int8 (dequant fused into the page
+   gather) — behind the ``FLAGS_use_pallas_kernels`` gate on TPU, or the
+   Pallas interpreter under ``FLAGS_ragged_interpret`` (the CPU
+   bit-identity path). ``ragged_kernel_eligible`` is the single gate.
 2. Composite XLA everywhere else: gather the sequence's pages via its page
    table, then a ragged-masked softmax through ``attention.sdpa`` — masked
    positions contribute exact zeros, so padding pages never change numerics.
+   The library decode kernel (``_pallas_decode``) remains as the certified
+   legacy reference (kernelcheck ``paged_decode``) but no longer serves
+   dispatch.
 
 Pool layout is ``[num_pages, page_size, num_heads, head_dim]`` per layer
 (serving/kv_cache.py owns allocation). Page 0 is reserved as the null page:
@@ -24,10 +31,10 @@ scatter-maxes the new tokens' |absmax| into the page scales, rescales the
 page's existing codes by ``old_scale / new_scale`` (exactly 1.0 — hence
 bit-stable — whenever the scale didn't grow), then writes the new tokens
 quantized at the final scale. The attention gather dequantizes
-``codes * scale / 127`` before the ragged-masked sdpa, so everything
-downstream of the gather — masking, page tables, sharding — is
-layout-blind; the Pallas decode kernel is skipped in quantized mode (it
-reads raw pools) in favor of the composite path.
+``codes * scale / 127`` — FUSED into the unified kernel's page gather on
+the kernel path, through :func:`paged_gather_quant` on the composite
+path — so everything downstream of the gather (masking, page tables,
+sharding) is layout-blind either way.
 """
 from __future__ import annotations
 
@@ -143,44 +150,50 @@ def paged_gather_quant(pool, scale, page_table, out_dtype=jnp.float32):
 
 def decode_kernel_eligible(head_dim: int, pages_per_seq: int,
                            page_size: int, *, quantized: bool = False,
-                           on_tpu: bool = True, flags_on: bool = True
-                           ) -> tuple[bool, str]:
-    """Single source of truth for the Pallas-decode dispatch gates.
+                           on_tpu: bool = True, flags_on: bool = True,
+                           num_heads: int | None = None,
+                           num_query_tokens: int = 1) -> tuple[bool, str]:
+    """Single source of truth for the kernel-dispatch gates, now
+    delegating to the UNIFIED ragged kernel's
+    :func:`~.ragged_paged_attention.ragged_kernel_eligible` (the engine's
+    per-shape predicate and the kernelcheck dispatch-coverage report both
+    call this, so the coverage table can never drift from the dispatch).
 
     Returns ``(eligible, reason)`` — ``reason`` names the FIRST gate that
-    blocks the kernel (empty when eligible). The runtime gate
-    ``_use_pallas_decode`` and the kernelcheck dispatch-coverage report
-    both call this, so the coverage table can never drift from what the
-    dispatch actually does (the flash ``supports_shape`` idiom)."""
-    if quantized:
-        # the int8 skip: the library kernel reads raw pools; a fused
-        # dequantizing gather does not exist in-tree — the quantized
-        # serving path (the one production runs) is kernel-less
-        return False, ("int8 pool: Pallas decode reads raw f32/bf16 pools "
-                       "and no fused-dequant kernel exists (composite "
-                       "gather+sdpa only)")
-    if not flags_on:
-        return False, "FLAGS_use_pallas_kernels is off"
-    if not on_tpu:
-        return False, "CPU backend: Pallas TPU kernels unavailable"
-    if head_dim % 128:
-        return False, f"head_dim {head_dim} % 128 != 0 (lane tile)"
-    ppb = _pages_per_block(page_size)
-    if pages_per_seq % ppb:
-        return False, (f"page_table width {pages_per_seq} % "
-                       f"pages_per_block {ppb} != 0")
-    return True, ""
+    blocks the kernel (empty when eligible). The old library-decode
+    gates — the int8 ban, ``head_dim % 128``, the page-table-width
+    alignment — are GONE: the unified kernel fuses the int8 dequant into
+    its gather and covers whole minor axes, which is exactly how the
+    kernelcheck int8-decode and head_dim-64 findings flipped to covered.
+    ``num_query_tokens`` generalizes the predicate to the prefill/chunk
+    (pad bucket) and spec-verify (``depth + 1``) call shapes."""
+    from ..utils.flags import flag
+    from .ragged_paged_attention import ragged_kernel_eligible
+
+    return ragged_kernel_eligible(
+        head_dim, pages_per_seq, page_size, num_query_tokens,
+        num_heads=num_heads, quantized=quantized, on_tpu=on_tpu,
+        flags_on=flags_on,
+        interpret=bool(flag("FLAGS_ragged_interpret", False)))
 
 
-def _use_pallas_decode(q, k_pool, page_table) -> bool:
+def _use_ragged_kernel(q, k_pool, page_table,
+                       quantized: bool) -> tuple[bool, bool]:
+    """Runtime dispatch gate: ``(eligible, interpret)`` for this call's
+    shapes. ``FLAGS_ragged_interpret`` routes the kernel through the
+    Pallas interpreter (CPU bit-identity test/bench path)."""
     from ..utils.flags import flag
     from ._common import on_tpu_backend
+    from .ragged_paged_attention import ragged_kernel_eligible
 
-    ok, _ = decode_kernel_eligible(
-        q.shape[-1], page_table.shape[1], k_pool.shape[1],
+    interp = bool(flag("FLAGS_ragged_interpret", False))
+    ok, _ = ragged_kernel_eligible(
+        q.shape[-1], page_table.shape[1], k_pool.shape[1], q.shape[2],
+        num_heads=q.shape[1], quantized=quantized,
         on_tpu=on_tpu_backend(),
-        flags_on=bool(flag("FLAGS_use_pallas_kernels", True)))
-    return ok
+        flags_on=bool(flag("FLAGS_use_pallas_kernels", True)),
+        interpret=interp)
+    return ok, interp
 
 
 def _pages_per_block(page_size: int) -> int:
@@ -222,7 +235,10 @@ def _note_fallback(e: Exception, q, k_pool) -> None:
 
 
 def _pallas_decode(q, k_pool, v_pool, page_table, ctx_lens, scale):
-    """Single-token ragged decode via the Pallas TPU kernel.
+    """Single-token ragged decode via the LIBRARY Pallas TPU kernel —
+    kept as the certified legacy reference (kernelcheck ``paged_decode``,
+    the pre-unification A/B baseline); dispatch now routes every mode
+    through :mod:`.ragged_paged_attention` instead.
 
     Kernel layout differs from the pool layout: q [b, heads, head_dim],
     pools [kv_heads, num_pages, page_size, head_dim]; the kernel applies no
@@ -271,27 +287,40 @@ def paged_attention(q, k_pool, v_pool, page_table, ctx_lens, scale=None,
     call always takes the composite gather + masked-sdpa path).
 
     ``k_scale``/``v_scale`` (both or neither): the pools are int8 codes
-    under per-page-per-head scales — the gather dequantizes and the same
-    ragged-masked sdpa runs on the reconstructed values (the Pallas kernel
-    reads raw pools, so quantized mode always takes the composite path).
+    under per-page-per-head scales — the unified kernel fuses the
+    ``codes * scale / 127`` dequant into its page gather; the composite
+    path dequantizes through :func:`paged_gather_quant` instead. Either
+    way nothing downstream of the gather knows the pool was compressed.
+
+    Dispatch: EVERY mode — prefill, chunked-prefill tail, decode,
+    spec-verify, fp32 AND int8 — routes through the ONE unified ragged
+    kernel (:mod:`.ragged_paged_attention`) when
+    ``ragged_kernel_eligible`` holds; anything else (flag off, CPU
+    without ``FLAGS_ragged_interpret``, a context too large for the VMEM
+    gate) takes the composite gather + masked-sdpa path, and a kernel
+    that RAISES falls back loudly (``serving_pallas_fallback_total`` +
+    the engine trace-event hook).
     """
     s = q.shape[2]
-    if k_scale is not None:
-        from .attention import sdpa as _sdpa
+    quantized = k_scale is not None
+    use_kernel, interpret = _use_ragged_kernel(q, k_pool, page_table,
+                                               quantized)
+    if use_kernel:
+        from . import ragged_paged_attention as _rp
 
-        k_all = paged_gather_quant(k_pool, k_scale, page_table, q.dtype)
-        v_all = paged_gather_quant(v_pool, v_scale, page_table, q.dtype)
-        mask = ragged_mask(ctx_lens, k_all.shape[2], s)
-        return _sdpa(q, k_all, v_all, mask=mask, scale=scale)
-    if s == 1 and _use_pallas_decode(q, k_pool, page_table):
         try:
-            return _pallas_decode(q, k_pool, v_pool, page_table, ctx_lens,
-                                  scale)
+            return _rp.ragged_paged_attention(
+                q, k_pool, v_pool, page_table, ctx_lens, scale=scale,
+                k_scale=k_scale, v_scale=v_scale, interpret=interpret)
         except Exception as e:  # noqa: BLE001 — fall back on any pallas failure
             _note_fallback(e, q, k_pool)
     from .attention import sdpa
 
-    k_all = paged_gather(k_pool, page_table)  # [b, h, S, d]
-    v_all = paged_gather(v_pool, page_table)
+    if quantized:
+        k_all = paged_gather_quant(k_pool, k_scale, page_table, q.dtype)
+        v_all = paged_gather_quant(v_pool, v_scale, page_table, q.dtype)
+    else:
+        k_all = paged_gather(k_pool, page_table)  # [b, h, S, d]
+        v_all = paged_gather(v_pool, page_table)
     mask = ragged_mask(ctx_lens, k_all.shape[2], s)
     return sdpa(q, k_all, v_all, mask=mask, scale=scale)

@@ -1,0 +1,365 @@
+"""Unified ragged paged-attention kernel — ONE Pallas program for every
+serving attention mode.
+
+Reference analog: Ragged Paged Attention (arxiv 2604.15464). The serving
+engine's four attention contracts — prefill, chunked-prefill tail, single
+-token decode, and the speculative K+1 verify — are all instances of one
+ragged computation (``paged_attention.ragged_mask``): ``s`` new-token
+queries per row entering at positions ``ctx_lens[b] .. ctx_lens[b]+s-1``
+against that row's paged KV prefix. Before this module the engine served
+them through a per-mode zoo (a fixed-shape library decode kernel that was
+skipped entirely in int8 mode, plus the gather+sdpa composite for
+everything ragged); this kernel serves all of them, fp32 AND int8, through
+one program shape:
+
+- **Grid** ``(batch, num_heads // block_heads)`` — one grid step owns one
+  row's head block end-to-end; no online-softmax accumulation, no output
+  revisits, and the full-width softmax runs the SAME ops in the SAME
+  order as the composite path, so interpret mode is bit-identical to the
+  jitted composite (the CPU-pinnable correctness contract; the tests pin
+  it for all four modes × fp32/int8).
+- **Scalar prefetch** ``(ctx_lens, cu_q_lens, page_table)`` — the ragged
+  parameterization. ``cu_q_lens[b] // s`` picks each row's query/output
+  block, which makes the OUTPUT index map data-dependent: kernelcheck
+  proves its injectivity by evaluating the map with runtime scalar
+  arguments (``index_args`` — the resolved, not suppressed,
+  ``allow_data_dependent_outputs`` contract).
+- **Paged KV gather** — the pools stay in HBM (``ANY`` memory space);
+  each grid step DMAs its row's pages into VMEM scratch through the page
+  table (all copies started before any is awaited, so the fetches
+  overlap in the DMA queue). In int8 mode the per-page-per-head dequant
+  ``codes * scale / 127`` is FUSED into this gather: the quantized pool
+  — the configuration production actually runs — finally has a kernel
+  path instead of being dispatch-banned.
+- **Tiling** — blocks cover whole minor axes (head_dim needs no 128
+  alignment: head_dim 64 is served, closing the second kernelcheck
+  coverage gap). ``block_heads`` (heads per grid step) is the tunable:
+  ``ragged_tuned.json`` (written by ``tools/ragged_autotune.py``, same
+  idiom as ``flash_tuned.json``) overrides the default, validated by
+  ``analysis.kernelcheck.validate_ragged_tuned`` at BANK and at LOAD so
+  load can never see an entry bank rejected.
+
+Certification: the ``ragged_paged`` / ``ragged_paged_q8`` /
+``ragged_paged_verify`` / ``ragged_paged_prefill`` kernelcheck entries
+freeze the VMEM budget, prove the data-dependent output map injective at
+canonical runtime arguments, and bank the roofline + predicted speedup to
+``profiles/kernelcheck.json``; the live A/B rides the engine's
+``serving_kernel_speedup_*{kernel=}`` gauges (obs/attribution.py).
+
+Dispatch lives in :mod:`.paged_attention` (``paged_attention()`` routes
+every eligible call here; ``decode_kernel_eligible`` delegates to
+:func:`ragged_kernel_eligible`, the single gate). On CPU the kernel runs
+through the Pallas interpreter when ``FLAGS_ragged_interpret`` is set —
+the bit-identity test path; a real TPU runs it compiled.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._common import i32_index_scope
+from .paged_attention import QMAX
+
+__all__ = ["ragged_paged_attention", "ragged_kernel_eligible",
+           "block_heads_for"]
+
+#: kernelcheck certificates this module's Pallas kernel is registered
+#: under (analysis/kernelcheck.py REGISTRY; lint rule PT011's contract) —
+#: one program, certified at each serving mode's canonical shape
+KERNELCHECK_CERTS = ("ragged_paged", "ragged_paged_q8",
+                     "ragged_paged_verify", "ragged_paged_prefill")
+
+#: VMEM cap the eligibility gate sizes against — mirrors kernelcheck's
+#: v5e budget (16 MiB * 0.9 headroom); the certificate enforces the same
+#: bound on the canonical shapes, this gate keeps RUNTIME shapes that
+#: would blow it on the composite path instead of dying inside Mosaic
+_VMEM_GATE_BYTES = int((16 << 20) * 0.9)
+
+_TUNED = None
+
+import os as _os
+
+#: overridable for tests; the shipped table lives beside this module
+_TUNED_PATH = _os.path.join(_os.path.dirname(__file__), "ragged_tuned.json")
+
+
+def _tuned_table() -> dict:
+    """kernels/ragged_tuned.json: on-chip autotuned ``block_heads`` keyed
+    ``"page_size,num_heads,head_dim"`` (written by
+    tools/ragged_autotune.py; absent = defaults). Entries are validated
+    against the kernel's own constraints at load time
+    (``analysis.kernelcheck.validate_ragged_tuned`` — the same validator
+    the autotune bank site runs, the flash_tuned.json discipline), so a
+    hand-edited entry that doesn't divide its head count raises HERE,
+    naming the entry, before any kernel is dispatched with it."""
+    global _TUNED
+    if _TUNED is None:
+        import json
+
+        path = _TUNED_PATH
+        try:
+            with open(path) as f:
+                table = dict(json.load(f))
+        except (OSError, ValueError):
+            table = {}  # absent/unreadable table = defaults, by design
+        if table:
+            from ..analysis.kernelcheck import validate_ragged_tuned
+
+            errors = validate_ragged_tuned(table)
+            if errors:
+                raise ValueError(
+                    f"ragged_tuned.json at {path} has entries violating "
+                    f"the ragged-kernel constraints:\n  "
+                    + "\n  ".join(errors)
+                    + "\nRe-run tools/ragged_autotune.py (which validates "
+                    "before writing) or fix the entries by hand.")
+        _TUNED = table
+    return _TUNED
+
+
+def block_heads_for(page_size: int, num_heads: int, head_dim: int) -> int:
+    """Heads per grid step: the tuned table wins when it has this
+    ``(page_size, num_heads, head_dim)``; default 1 (maximum grid
+    parallelism — the per-head KV working set is the VMEM driver). A
+    tuned value must divide ``num_heads`` (validated at load); defensive
+    fallback to 1 keeps a stale table from breaking the launch."""
+    tuned = _tuned_table().get(f"{page_size},{num_heads},{head_dim}")
+    if tuned and num_heads % int(tuned) == 0:
+        return int(tuned)
+    return 1
+
+
+def _vmem_working_set(head_dim: int, total_kv: int, num_query_tokens: int,
+                      block_heads: int, pages_per_seq: int,
+                      quantized: bool) -> int:
+    """Static per-grid-step VMEM estimate, mirroring kernelcheck's model:
+    K+V gather scratch (×1 — scratch is not double-buffered) plus the
+    q/output blocks (×2 — grid-varying blocks pipeline-double-buffer)
+    plus the gathered-scale blocks in int8 mode."""
+    kv_item = 1 if quantized else 4
+    ws = 2 * total_kv * block_heads * head_dim * kv_item
+    ws += 2 * 2 * num_query_tokens * block_heads * head_dim * 4
+    if quantized:
+        ws += 2 * 2 * block_heads * pages_per_seq * 4
+    return ws
+
+
+def ragged_kernel_eligible(head_dim: int, pages_per_seq: int,
+                           page_size: int, num_query_tokens: int = 1, *,
+                           num_heads: int | None = None,
+                           quantized: bool = False, on_tpu: bool = True,
+                           flags_on: bool = True, interpret: bool = False
+                           ) -> tuple[bool, str]:
+    """Single source of truth for the unified-kernel dispatch gates.
+
+    Returns ``(eligible, reason)`` — ``reason`` names the FIRST gate that
+    blocks the kernel (empty when eligible). The runtime dispatch
+    (``paged_attention.paged_attention``), the engine's kernel-A/B
+    predicate, and the kernelcheck dispatch-coverage report all call
+    this, so the coverage table can never drift from the dispatch.
+
+    Unlike the retired library-decode gates there is no int8 ban (the
+    dequant is fused into the gather), no ``head_dim % 128`` wall (all
+    blocks cover their whole minor axis), and no page-table-width
+    alignment rule — the remaining gates are the flag, the backend
+    (``interpret`` sanctions the CPU Pallas interpreter — the test/bench
+    path), a positive query count, and the VMEM working set."""
+    if not flags_on:
+        return False, "FLAGS_use_pallas_kernels is off"
+    if not on_tpu and not interpret:
+        return False, ("CPU backend: Pallas TPU kernels unavailable "
+                       "(set FLAGS_ragged_interpret to run the unified "
+                       "kernel through the Pallas interpreter)")
+    if num_query_tokens < 1:
+        return False, f"num_query_tokens {num_query_tokens} < 1"
+    bh = block_heads_for(page_size, num_heads or 1, head_dim)
+    ws = _vmem_working_set(head_dim, pages_per_seq * page_size,
+                           num_query_tokens, bh, pages_per_seq, quantized)
+    if ws > _VMEM_GATE_BYTES:
+        return False, (f"VMEM working set {ws} B (context "
+                       f"{pages_per_seq * page_size} x head_dim "
+                       f"{head_dim} x block_heads {bh}) exceeds the "
+                       f"{_VMEM_GATE_BYTES} B gate — composite path")
+    return True, ""
+
+
+def _tok_scales(sc_ref, page_size: int):
+    """One gathered-scale block ``[1, block_heads, pages_per_seq]`` to
+    per-token multipliers ``[total_kv, block_heads, 1]`` — every token of
+    page slot ``i`` dequantizes at that page's per-head scale, exactly
+    the broadcast ``paged_gather_quant`` applies."""
+    sc = sc_ref[0]                                  # (bh, pps)
+    sc = jnp.repeat(sc, page_size, axis=1)          # (bh, total_kv)
+    return jnp.transpose(sc, (1, 0))[:, :, None]    # (total_kv, bh, 1)
+
+
+def _ragged_kernel(s, page_size, pages_per_seq, block_heads, scale, quant,
+                   lift_batch,
+                   ctx_ref, cu_ref, tab_ref, q_ref, k_hbm, v_hbm, *rest):
+    """Kernel body for one ``(row, head block)`` grid step.
+
+    DMA phase: every page of the row's table is copied HBM -> VMEM (all
+    ``2 * pages_per_seq`` copies started before any is awaited — the DMA
+    queue overlaps them). Compute phase: the ragged-masked softmax over
+    the full gathered width, op-for-op the composite ``sdpa`` formula so
+    interpret mode is bit-identical to the composite path."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if quant:
+        ksc_ref, vsc_ref, o_ref, k_s, v_s, sems = rest
+    else:
+        o_ref, k_s, v_s, sems = rest
+    bi = pl.program_id(0)
+    h0 = pl.program_id(1) * block_heads
+
+    def _copy(i, src, dst, sem_slot):
+        return pltpu.make_async_copy(
+            src.at[tab_ref[bi, i], :, pl.ds(h0, block_heads), :],
+            dst.at[pl.ds(i * page_size, page_size)],
+            sems.at[sem_slot])
+
+    for i in range(pages_per_seq):
+        _copy(i, k_hbm, k_s, i).start()
+        _copy(i, v_hbm, v_s, pages_per_seq + i).start()
+    for i in range(pages_per_seq):
+        _copy(i, k_hbm, k_s, i).wait()
+        _copy(i, v_hbm, v_s, pages_per_seq + i).wait()
+
+    qb = q_ref[...]                       # (s, bh, d)
+    k = k_s[...]                          # (total_kv, bh, d) pool dtype
+    v = v_s[...]
+    if quant:
+        # the fused dequant: codes * (scale / 127), elementwise identical
+        # to paged_gather_quant's broadcast, then the composite's astype
+        k = (k.astype(jnp.float32) * _tok_scales(ksc_ref, page_size)
+             ).astype(qb.dtype)
+        v = (v.astype(jnp.float32) * _tok_scales(vsc_ref, page_size)
+             ).astype(qb.dtype)
+    qh = jnp.transpose(qb, (1, 0, 2))     # (bh, s, d)
+    kh = jnp.transpose(k, (1, 0, 2))      # (bh, total_kv, d)
+    vh = jnp.transpose(v, (1, 0, 2))
+    if lift_batch:
+        # bit-identity corner: XLA:CPU lowers the (batch=1, M=1) q.kT
+        # matvec through a different accumulation order than the
+        # batched form the composite's [b, h, 1, S] einsum takes
+        # (measured ~1e-7; batch>=2 and M>=2 are order-consistent).
+        # When the composite is batched (b*h >= 2) but this block is
+        # the degenerate cell (block_heads == 1, s == 1), duplicate the
+        # row — the lowering is data-independent, so row 0 of the
+        # batch-2 product is exactly the composite's value
+        logits = jax.lax.dot_general(
+            jnp.concatenate([qh, qh], axis=0),
+            jnp.concatenate([kh, kh], axis=0),
+            (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)[:1]
+    else:
+        logits = jax.lax.dot_general(
+            qh, kh, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+    # f32-pinned constants: the body is retraced at LOWERING time outside
+    # any i32/x64 scope, where a weak Python literal hardens to f64 and
+    # fails the verifier — np.float32 keeps it the same f32 value the
+    # composite's weak-typed literal converts to
+    sc = (np.float32(scale) if scale is not None
+          else 1.0 / jnp.sqrt(jnp.asarray(qb.shape[-1], jnp.float32)))
+    logits = logits * sc
+    total = kh.shape[1]
+    jpos = jax.lax.broadcasted_iota(jnp.int32, (s, total), 1)
+    tpos = jax.lax.broadcasted_iota(jnp.int32, (s, total), 0)
+    mask = jpos <= ctx_ref[bi] + tpos     # the ragged_mask contract
+    logits = jnp.where(mask[None], logits, np.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jax.lax.dot_general(
+        probs.astype(qb.dtype), vh, (((2,), (1,)), ((0,), (0,))))
+    o_ref[...] = jnp.transpose(out, (1, 0, 2)).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, k_pool, v_pool, page_table, ctx_lens, *,
+                           scale=None, k_scale=None, v_scale=None,
+                           block_heads: int | None = None,
+                           interpret: bool = False):
+    """The unified kernel entry: same contract as the composite
+    ``paged_attention`` path for every mode.
+
+    q ``[batch, heads, s, head_dim]`` — ``s`` is 1 for decode, the pad
+    bucket for prefill/chunk calls, ``depth + 1`` for spec-verify; pools
+    ``[num_pages, page_size, heads, head_dim]`` (int8 codes when
+    ``k_scale``/``v_scale`` — ``[num_pages, heads]`` f32 — are given);
+    ``ctx_lens [batch]`` tokens resident per row BEFORE this call's new
+    tokens (already written to the pool). Returns
+    ``[batch, heads, s, head_dim]``, bit-identical in interpret mode to
+    the composite gather + ragged-masked sdpa."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    ps = k_pool.shape[1]
+    pps = page_table.shape[1]
+    total_kv = pps * ps
+    bh = block_heads or block_heads_for(ps, h, d)
+    if h % bh:
+        bh = 1
+    quant = k_scale is not None
+
+    # the ragged token layout the paper's kernel contract uses: queries
+    # and outputs concatenate over rows, cu_q_lens locating each row's
+    # span — uniform s per call here, but the kernel only ever reads the
+    # prefetched cu_q_lens, so mixed-length batches are one table away
+    q_r = jnp.transpose(q, (0, 2, 1, 3)).reshape(b * s, h, d)
+    cu = jnp.arange(b + 1, dtype=jnp.int32) * s
+    ctx = ctx_lens.astype(jnp.int32)
+    tab = page_table.astype(jnp.int32)
+
+    # np.int32 divisor: index maps are (re)traced at LOWERING time,
+    # outside any i32_index_scope — a Python-int literal would promote
+    # the division to i64 under the package-global x64 and fail Mosaic
+    # (and the interpreter's) verifier
+    s_i32 = np.int32(s)
+
+    def q_map(bi, hb, ctx, cu, tab):
+        return (cu[bi] // s_i32, hb, 0)
+
+    in_specs = [
+        pl.BlockSpec((s, bh, d), q_map),
+        pl.BlockSpec(memory_space=pltpu.ANY),   # K pool: manual DMA
+        pl.BlockSpec(memory_space=pltpu.ANY),   # V pool: manual DMA
+    ]
+    operands = [ctx, cu, tab, q_r, k_pool, v_pool]
+    if quant:
+        # gather the tiny per-page scales OUTSIDE the kernel (b*pps*h
+        # floats — noise next to the code pools) with the exact
+        # paged_gather_quant divisor, laid out [batch, heads, pps] so the
+        # block covers the whole minor axis
+        ksc = jnp.transpose(k_scale[tab] / QMAX, (0, 2, 1))
+        vsc = jnp.transpose(v_scale[tab] / QMAX, (0, 2, 1))
+        sc_spec = pl.BlockSpec((1, bh, pps), lambda bi, hb, *_: (bi, hb, 0))
+        in_specs += [sc_spec, sc_spec]
+        operands += [ksc, vsc]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(b, h // bh),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((s, bh, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((total_kv, bh, d), k_pool.dtype),
+            pltpu.VMEM((total_kv, bh, d), v_pool.dtype),
+            pltpu.SemaphoreType.DMA((2 * pps,)),
+        ])
+    kernel = functools.partial(_ragged_kernel, s, ps, pps, bh,
+                               None if scale is None else float(scale),
+                               quant, s == 1 and bh == 1 and b * h >= 2)
+    with i32_index_scope():  # kernel index math assumes int32 defaults
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((b * s, h, d), q.dtype),
+            compiler_params=dict(mosaic=dict(
+                dimension_semantics=("parallel", "parallel"))),
+            interpret=interpret,
+        )(*operands)
+    return jnp.transpose(out.reshape(b, s, h, d), (0, 2, 1, 3))

@@ -471,15 +471,41 @@ class ServingEngine:
         from ..kernels._common import on_tpu_backend
         from ..utils.flags import flag
 
-        # whether the Pallas paged-decode kernel is even dispatchable for
-        # this engine's shapes — the single decode_kernel_eligible
-        # predicate, read once; per-step the kernel A/B additionally
-        # checks the fallback counter so a trace-time degrade flips the
-        # measured dispatch times onto the composite leg
+        # whether the unified ragged kernel is even dispatchable for this
+        # engine's shapes — the single decode_kernel_eligible predicate
+        # (now the ragged_kernel_eligible gate), read once per mode;
+        # per-step the kernel A/B additionally checks the fallback
+        # counter so a trace-time degrade flips the measured dispatch
+        # times onto the composite leg. The A/B gauge legs key on the
+        # kernelcheck certificate the dispatch actually exercises:
+        # ragged_paged (fp32 decode) / ragged_paged_q8 (int8 decode),
+        # plus ragged_paged_verify for the spec K+1 dispatch.
+        _gate_kw = dict(
+            num_heads=mc.num_heads, quantized=self.cache.cfg.quantized,
+            on_tpu=on_tpu_backend(),
+            flags_on=bool(flag("FLAGS_use_pallas_kernels", True)))
         self._decode_pallas_eligible, _ = _pa.decode_kernel_eligible(
             mc.hidden_size // mc.num_heads, pages_per_seq, cfg.page_size,
-            quantized=self.cache.cfg.quantized, on_tpu=on_tpu_backend(),
-            flags_on=bool(flag("FLAGS_use_pallas_kernels", True)))
+            **_gate_kw)
+        self._kernel_ab_name = ("ragged_paged_q8"
+                                if self.cache.cfg.quantized
+                                else "ragged_paged")
+        if cfg.spec is not None:
+            self._verify_pallas_eligible, _ = _pa.decode_kernel_eligible(
+                mc.hidden_size // mc.num_heads, pages_per_seq,
+                cfg.page_size, num_query_tokens=cfg.spec.depth + 1,
+                **_gate_kw)
+            # the verify A/B leg only has an fp32 banked baseline
+            # (ragged_paged_verify) — an int8 engine's verify times
+            # against it would read as spurious drift (int8 moves ~4x
+            # fewer HBM bytes), so the quantized verify leg stays off
+            # the gauge until an int8-verify certificate is banked
+            self._verify_ab_name = ("ragged_paged_verify"
+                                    if not self.cache.cfg.quantized
+                                    else None)
+        else:
+            self._verify_pallas_eligible = False
+            self._verify_ab_name = None
 
         _self = weakref.ref(self)
 
@@ -1414,7 +1440,8 @@ class ServingEngine:
                 self._roofline.on_call("decode", dt)
                 pallas = self._decode_pallas_eligible and monitor.stat_get(
                     "serving_pallas_fallback_total", 0) == 0
-                self._roofline.on_kernel_call("paged_decode", dt, pallas)
+                self._roofline.on_kernel_call(self._kernel_ab_name, dt,
+                                              pallas)
 
         cs = self.cache.stats()
         self.metrics.on_state(
@@ -1523,7 +1550,16 @@ class ServingEngine:
         if self._attr is not None:
             # verify phase: the batched K+1 dispatch + packed fetch +
             # accept bookkeeping, roofline-tracked under its audit label
-            self._roofline.on_call("verify", self._attr.mark("verify"))
+            # AND — the K+1 contract being unified-kernel-eligible — fed
+            # to the ragged_paged_verify A/B leg, same fallback check as
+            # the decode leg
+            dt = self._attr.mark("verify")
+            self._roofline.on_call("verify", dt)
+            if self._verify_ab_name is not None:
+                pallas = self._verify_pallas_eligible and monitor.stat_get(
+                    "serving_pallas_fallback_total", 0) == 0
+                self._roofline.on_kernel_call(self._verify_ab_name, dt,
+                                              pallas)
         return n_slots, n_accepted
 
     def run(self, max_steps: int = 100000,

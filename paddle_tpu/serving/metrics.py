@@ -84,6 +84,21 @@ Kernel-dispatch counters (pre-seeded):
                                  0 is the certified steady state — any
                                  growth means the serving hot path lost
                                  its fast kernel.
+- serving_flash_pad_total        flash dispatch SITES that took the
+                                 causal pad-to-block route (the seq %512
+                                 edge, e.g. 640 -> 1024): exact results,
+                                 visible pad presence. Counted where the
+                                 dispatch Python runs — once per traced
+                                 program under jit, per call when eager —
+                                 the serving_pallas_fallback_total
+                                 growth-signal contract, NOT a
+                                 per-inference-dispatch count
+- serving_flash_edge_fallback_total  flash-shaped dispatch sites (seqs
+                                 >= 128, 64-aligned head_dim, TPU, flag
+                                 on) with NO kernel route — the loudly-
+                                 counted composite fallback the coverage
+                                 report's flash edge rows name (same
+                                 trace-time counting contract as above)
 
 Analysis counters (paddle_tpu.analysis integration, pre-seeded):
 
@@ -203,6 +218,7 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "host_tier_hits_total", "host_tier_spills_total",
            "host_tier_restores_total",
            "pallas_fallback_total",
+           "flash_pad_total", "flash_edge_fallback_total",
            "analysis_retraces_total", "analysis_host_syncs_total",
            "hlo_collective_ops", "hlo_host_transfers",
            "hlo_peak_hbm_bytes", "hlo_flops_per_step",

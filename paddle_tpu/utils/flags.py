@@ -23,6 +23,11 @@ _FLAGS: dict[str, object] = {
     # fusing LN into its matmul neighbors, costing more than the one-pass
     # forward saves. Kept for standalone-LN-heavy workloads.
     "FLAGS_use_fused_layernorm": False,
+    # route paged attention through the unified ragged kernel's Pallas
+    # INTERPRETER on CPU (kernels/ragged_paged_attention.py) — the
+    # bit-identity test/bench path; a real TPU runs the kernel compiled
+    # and ignores this flag's absence
+    "FLAGS_ragged_interpret": False,
     # True/False force; "auto" picks splash for causal long-seq (>= 2048)
     # where skipping fully-masked KV tiles pays — at 1024 it measured even
     # with dense-block flash (round-3 on-chip A/B)

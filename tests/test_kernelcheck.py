@@ -39,7 +39,8 @@ def _run(name):
 
 
 FAST_FAMILIES = ("fused_layernorm_fwd", "fused_layernorm_dx", "fused_adam",
-                 "paged_decode")
+                 "paged_decode", "ragged_paged", "ragged_paged_q8",
+                 "ragged_paged_verify", "ragged_paged_prefill")
 
 
 # ------------------------------------------------------------ certification
@@ -69,15 +70,61 @@ def test_flash_and_splash_certify_with_declared_revisits():
         assert record["predicted_speedup"] > 1.0, name
 
 
-def test_paged_decode_certifies_the_int8_skip():
-    """The quantized pool's kernel-lessness is a DECLARED dispatch
-    constraint on the paged certificate, not a docstring aside."""
+def test_paged_decode_certifies_the_int8_flip():
+    """PR 11 certified the int8 SKIP as a declared constraint; the
+    unified ragged kernel inverts it — int8 decode is now
+    kernel-ELIGIBLE, certified on the legacy paged certificate so the
+    coverage flip can never silently regress."""
     report, _ = _run("paged_decode")
     assert report.ok
     spec = kc.REGISTRY["paged_decode"].build()
     names = {c[0]: c[1] for c in spec["constraints"]}
-    assert names["int8_skip_is_declared"] is True
+    assert names["int8_served_by_unified_kernel"] is True
     assert names["decode_kernel_eligible"] is True
+
+
+def test_ragged_entries_resolve_data_dependent_output_map():
+    """The unified kernel's output index map reads the prefetched
+    cu_q_lens (data-dependent) — and certifies with ZERO race findings:
+    the budget declares allow_data_dependent_outputs AND the builder's
+    index_args let kernelcheck evaluate the map at the canonical runtime
+    values and run the real injectivity proof. Resolved, not
+    suppressed."""
+    for name in ("ragged_paged", "ragged_paged_q8", "ragged_paged_verify",
+                 "ragged_paged_prefill"):
+        report, record = _run(name)
+        assert report.ok, (name, [str(f) for f in report.all_findings()])
+        races = [f for f in report.all_findings() if f.kind == "race"]
+        assert races == [], (name, [str(f) for f in races])
+        assert record["predicted_speedup"] > 1.0, name
+    # WITHOUT index_args the same kernel fails closed (error) or warns
+    # under the declaration — the resolve path is the index_args
+    spec = kc.REGISTRY["ragged_paged"].build()
+    undeclared = kc.certify(spec["fn"], spec["args"], name="ragged_paged",
+                            budget=kc.KernelBudget())
+    assert any(f.kind == "race" and f.severity == "error"
+               and "allow_data_dependent_outputs" in f.message
+               for f in undeclared.errors)
+    declared = kc.certify(spec["fn"], spec["args"], name="ragged_paged",
+                          budget=spec["budget"])
+    warns = [f for f in declared.all_findings()
+             if f.kind == "race" and f.severity == "warn"]
+    assert warns and "index_args" in warns[0].message
+    resolved = kc.certify(spec["fn"], spec["args"], name="ragged_paged",
+                          budget=spec["budget"],
+                          index_args=spec["index_args"])
+    assert not [f for f in resolved.all_findings() if f.kind == "race"]
+
+
+def test_ragged_q8_fused_dequant_speedup_banked():
+    """The int8 entry's roofline captures WHY the fused dequant matters:
+    the kernel moves int8 codes (+ tiny scales) where the composite
+    materializes the dequantized f32 gather — the banked predicted
+    speedup is the int8-decode headline."""
+    _, rec = _run("ragged_paged_q8")
+    _, rec_f32 = _run("ragged_paged")
+    assert rec["hbm_bytes"] < rec_f32["hbm_bytes"] / 2
+    assert rec["predicted_speedup"] > rec_f32["predicted_speedup"]
 
 
 # -------------------------------------------------------- defect fixtures
@@ -239,38 +286,75 @@ def test_fused_adam_interpret_matches_composite_bitwise():
 
 
 # ------------------------------------------------------- dispatch coverage
-def test_coverage_names_int8_decode_kernel_less():
+def test_coverage_int8_decode_and_head_dim_64_now_covered():
+    """The two kernel-less findings PR 11's coverage report named —
+    int8 decode and head_dim 64 — are CLOSED by the unified kernel, and
+    the seq-%512 flash edge routes through the causal pad instead of
+    silently falling off."""
     cov = kc.coverage_report()
-    assert any("kv_dtype=int8" in k and "paged_decode" in k
-               for k in cov["kernel_less"])
+    # nothing on the serving paged path is kernel-less anymore
+    assert not any("paged" in k for k in cov["kernel_less"]), \
+        cov["kernel_less"]
     by_config = {(r["family"], r["config"]): r for r in cov["rows"]}
     hot = by_config[("paged_decode",
                      "platform=tpu pallas_flag=on kv_dtype=float32")]
     assert hot["path"] == "pallas" and not hot["blocked_by"]
     q8 = by_config[("paged_decode",
                     "platform=tpu pallas_flag=on kv_dtype=int8")]
-    assert q8["path"] == "composite" and "int8" in q8["blocked_by"]
+    assert q8["path"] == "pallas" and not q8["blocked_by"]
+    d64 = by_config[("paged_decode",
+                     "platform=tpu pallas_flag=on kv_dtype=float32 "
+                     "head_dim=64")]
+    assert d64["path"] == "pallas" and not d64["blocked_by"]
     cpu = by_config[("paged_decode",
                      "platform=cpu pallas_flag=on kv_dtype=float32")]
     assert cpu["path"] == "composite"
-    # the %512 composite-fallback rule, certified statically
-    assert any(r["family"] == "flash_prefill" and "seq=640" in r["config"]
-               and r["path"] == "composite" for r in cov["rows"])
+    assert "FLAGS_ragged_interpret" in cpu["blocked_by"]
+    # the multi-token modes ride the same predicate, both dtypes
+    for kv in ("float32", "int8"):
+        for mode in ("verify[K+1=5]", "prefill[64]"):
+            r = by_config[("ragged_paged",
+                           f"platform=tpu pallas_flag=on kv_dtype={kv} "
+                           f"mode={mode}")]
+            assert r["path"] == "pallas", r
+    # the %512 edge: causal pads to the block, non-causal is a
+    # loudly-counted composite — neither is silent anymore
+    pad = by_config[("flash_prefill",
+                     "platform=tpu pallas_flag=on seq=640 causal")]
+    assert pad["path"] == "pallas[padded]"
+    nc = by_config[("flash_prefill",
+                    "platform=tpu pallas_flag=on seq=640 non-causal")]
+    assert nc["path"] == "composite[counted]"
+    assert "serving_flash_edge_fallback_total" in nc["blocked_by"]
+    assert not any("flash" in k and "640" in k for k in cov["kernel_less"])
 
 
 def test_coverage_predicate_is_the_runtime_gate():
-    """The coverage rows come from decode_kernel_eligible — the SAME
-    predicate _use_pallas_decode calls, so the table can't drift."""
+    """The coverage rows come from decode_kernel_eligible — now the
+    unified ragged_kernel_eligible gate the dispatch calls, so the table
+    can't drift. The PR 11 gates it retired (head_dim % 128, page-table
+    width alignment, the int8 ban) stay retired."""
     from paddle_tpu.kernels import paged_attention as pa
 
     ok, why = pa.decode_kernel_eligible(128, 32, 16)
     assert ok and why == ""
+    # the two closed coverage gaps — eligible now
     ok, why = pa.decode_kernel_eligible(64, 32, 16)
-    assert not ok and "% 128" in why
-    ok, why = pa.decode_kernel_eligible(128, 30, 16)
-    assert not ok and "pages_per_block" in why
+    assert ok and why == ""
     ok, why = pa.decode_kernel_eligible(128, 32, 16, quantized=True)
-    assert not ok and "int8" in why
+    assert ok and why == ""
+    # unaligned page-table widths no longer fall off the fast path
+    ok, _ = pa.decode_kernel_eligible(128, 30, 16)
+    assert ok
+    # the remaining honest gates
+    ok, why = pa.decode_kernel_eligible(128, 32, 16, flags_on=False)
+    assert not ok and "FLAGS_use_pallas_kernels" in why
+    ok, why = pa.decode_kernel_eligible(128, 32, 16, on_tpu=False)
+    assert not ok and "FLAGS_ragged_interpret" in why
+    ok, why = pa.decode_kernel_eligible(128, 4096, 512)  # 2M-token ctx
+    assert not ok and "VMEM" in why
+    ok, why = pa.decode_kernel_eligible(128, 32, 16, num_query_tokens=0)
+    assert not ok and "num_query_tokens" in why
 
 
 # -------------------------------------------------- flash_tuned validation
@@ -321,14 +405,16 @@ def test_autotune_refuses_to_bank_misaligned(monkeypatch):
 # ------------------------------------------------- fallback gauge + events
 def test_pallas_fallback_counts_gauge_and_calls_hook(monkeypatch):
     from paddle_tpu.kernels import paged_attention as pa
+    from paddle_tpu.kernels import ragged_paged_attention as rp
 
     calls = []
-    monkeypatch.setattr(pa, "_use_pallas_decode", lambda *a: True)
+    monkeypatch.setattr(pa, "_use_ragged_kernel",
+                        lambda *a, **k: (True, True))
 
     def boom(*a, **k):
         raise RuntimeError("mosaic says no")
 
-    monkeypatch.setattr(pa, "_pallas_decode", boom)
+    monkeypatch.setattr(rp, "ragged_paged_attention", boom)
     monkeypatch.setattr(pa, "fallback_hook",
                         lambda exc, sig: calls.append((exc, sig)))
     q = jnp.zeros((1, 2, 1, 8), jnp.float32)
@@ -393,11 +479,12 @@ def test_kernelcheck_certs_declarations_match_registry():
     entries, and every registry entry is declared by exactly one module —
     PT011's declaration can't go stale in either direction."""
     from paddle_tpu.kernels import (flash_attention, fused_layernorm,
-                                    fused_optimizer, paged_attention)
+                                    fused_optimizer, paged_attention,
+                                    ragged_paged_attention)
 
     declared = []
     for mod in (flash_attention, fused_layernorm, fused_optimizer,
-                paged_attention):
+                paged_attention, ragged_paged_attention):
         certs = mod.KERNELCHECK_CERTS
         assert certs, mod.__name__
         declared.extend(certs)
@@ -444,7 +531,10 @@ def test_cli_inprocess(tmp_path, capsys):
 
 def test_cli_coverage_and_violation_exit(tmp_path, capsys):
     """A drifted bank fails the default sweep loudly (the PR 6 contract);
-    the coverage table prints the kernel-less int8 finding either way."""
+    the coverage table shows the int8/head_dim-64 flips and — the
+    unified-kernel acceptance — NO kernel-less production section (every
+    TPU-flags-on serving config reaches a kernel or a counted
+    fallback)."""
     profile = tmp_path / "kernelcheck.json"
     bad = {name: {"grid": [], "vmem_bytes": 0, "flops": -1,
                   "hbm_bytes": 0} for name in kc.REGISTRY}
@@ -453,5 +543,5 @@ def test_cli_coverage_and_violation_exit(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "drifted from the banked contract" in out
-    assert "kernel-less production configs" in out
-    assert "kv_dtype=int8" in out
+    assert "kernel-less production configs" not in out
+    assert "kv_dtype=int8" in out  # the flipped row still prints, as pallas

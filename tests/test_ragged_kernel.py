@@ -1,0 +1,336 @@
+"""Unified ragged paged-attention kernel (kernels/ragged_paged_attention).
+
+- interpret-mode BIT-IDENTITY vs the jitted composite (gather + ragged-
+  masked sdpa) for all four serving modes — prefill, chunked-prefill
+  tail, decode, spec K+1 verify — in fp32 AND int8 (dequant fused into
+  the page gather), incl. head_dim 64 and tuned block_heads
+- the eligibility gate (single source of truth with the dispatch and the
+  kernelcheck coverage report)
+- ragged_tuned.json validation at LOAD (the flash_tuned discipline)
+- engine-level: kernel path FORCED ON via FLAGS_ragged_interpret —
+  outputs bit-identical to the composite engine, compile_counts equal,
+  sync-free certification unchanged, zero fallbacks; kernel A/B gauges
+  seeded from the bank; ineligible (CPU, flag off) stays composite with
+  the fallback gauge at zero
+- the flash seq-%512 pad-or-fallback satellite (kernels/attention.py)
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import monitor
+from paddle_tpu.utils.flags import set_flags
+
+pytestmark = pytest.mark.ragged
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.kernels import paged_attention as pa  # noqa: E402
+from paddle_tpu.kernels import ragged_paged_attention as rp  # noqa: E402
+
+
+@pytest.fixture
+def ragged_interpret():
+    set_flags({"FLAGS_ragged_interpret": True})
+    yield
+    set_flags({"FLAGS_ragged_interpret": False})
+
+
+# ------------------------------------------------------ kernel-level parity
+def _composite(q, kp, vp, tab, ctx, k_scale=None, v_scale=None,
+               scale=None):
+    from paddle_tpu.kernels.attention import sdpa
+
+    s = q.shape[2]
+    if k_scale is not None:
+        k_all = pa.paged_gather_quant(kp, k_scale, tab, q.dtype)
+        v_all = pa.paged_gather_quant(vp, v_scale, tab, q.dtype)
+    else:
+        k_all = pa.paged_gather(kp, tab)
+        v_all = pa.paged_gather(vp, tab)
+    mask = pa.ragged_mask(ctx, k_all.shape[2], s)
+    return sdpa(q, k_all, v_all, mask=mask, scale=scale)
+
+
+def _args(seed, b, h, s, d, ps, pps, npages, ctx_vals, quant=False):
+    rng = np.random.RandomState(seed)
+    if quant:
+        kp = jnp.asarray(rng.randint(-127, 128, (npages, ps, h, d)),
+                         jnp.int8)
+        vp = jnp.asarray(rng.randint(-127, 128, (npages, ps, h, d)),
+                         jnp.int8)
+        kw = dict(
+            k_scale=jnp.asarray(np.abs(rng.randn(npages, h)) + 0.1,
+                                jnp.float32),
+            v_scale=jnp.asarray(np.abs(rng.randn(npages, h)) + 0.1,
+                                jnp.float32))
+    else:
+        kp = jnp.asarray(rng.randn(npages, ps, h, d), jnp.float32)
+        vp = jnp.asarray(rng.randn(npages, ps, h, d), jnp.float32)
+        kw = {}
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    tab = jnp.asarray(
+        rng.choice(npages, (b, pps), replace=False).astype(np.int32))
+    ctx = jnp.asarray(ctx_vals, jnp.int32)
+    return (q, kp, vp, tab, ctx), kw
+
+
+# (mode, batch, heads, s, head_dim, page_size, pages_per_seq, num_pages,
+#  ctx_lens) — every serving contract: cold prefill (ctx 0), chunk tail
+# (ctx mid-prompt), decode (s=1), spec verify (s=K+1), ragged ctx mixes
+_MODES = [
+    ("prefill", 1, 2, 8, 8, 4, 4, 16, [0]),
+    ("chunk", 1, 2, 8, 8, 4, 4, 16, [4]),
+    ("decode", 2, 2, 1, 8, 4, 4, 16, [5, 9]),
+    ("verify", 3, 4, 5, 16, 4, 8, 40, [10, 3, 17]),
+]
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["fp32", "int8"])
+@pytest.mark.parametrize("mode", [m[0] for m in _MODES])
+def test_interpret_bit_identical_to_composite(mode, quant):
+    spec = next(m for m in _MODES if m[0] == mode)
+    # deterministic seed (hash() is salted per process — a failing run
+    # must be reproducible from the test id alone)
+    seed = [m[0] for m in _MODES].index(mode) * 2 + int(quant) + 1
+    args, kw = _args(seed, *spec[1:], quant=quant)
+    ref = jax.jit(lambda *a: _composite(*a, **kw))(*args)
+    out = jax.jit(lambda *a: rp.ragged_paged_attention(
+        *a, interpret=True, **kw))(*args)
+    assert np.array_equal(np.asarray(out), np.asarray(ref)), \
+        f"{mode}/{'int8' if quant else 'fp32'} diverged from composite"
+
+
+def test_interpret_bit_identical_head_dim_64_and_block_heads():
+    """The head_dim-64 coverage gap closed for real, and the tuned
+    block_heads knob changes the launch config without changing a bit."""
+    args, kw = _args(11, 2, 4, 1, 64, 4, 4, 16, [7, 12])
+    ref = jax.jit(lambda *a: _composite(*a))(*args)
+    for bh in (1, 2, 4):
+        out = jax.jit(lambda *a, _bh=bh: rp.ragged_paged_attention(
+            *a, interpret=True, block_heads=_bh))(*args)
+        assert np.array_equal(np.asarray(out), np.asarray(ref)), \
+            f"block_heads={bh} diverged"
+
+
+def test_scale_override_matches_composite():
+    args, _ = _args(13, 2, 2, 1, 8, 4, 4, 16, [5, 9])
+    ref = jax.jit(lambda *a: _composite(*a, scale=0.25))(*args)
+    out = jax.jit(lambda *a: rp.ragged_paged_attention(
+        *a, scale=0.25, interpret=True))(*args)
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+# --------------------------------------------------------- eligibility gate
+def test_ragged_kernel_eligible_gates():
+    ok, why = rp.ragged_kernel_eligible(128, 32, 16, 1, num_heads=8)
+    assert ok and why == ""
+    # int8, head_dim 64, unaligned widths, multi-token: all served
+    for kw in (dict(quantized=True), dict(num_query_tokens=5),
+               dict(num_query_tokens=64)):
+        ok, why = rp.ragged_kernel_eligible(64, 30, 16, num_heads=8, **kw)
+        assert ok, (kw, why)
+    ok, why = rp.ragged_kernel_eligible(128, 32, 16, flags_on=False)
+    assert not ok and "FLAGS_use_pallas_kernels" in why
+    ok, why = rp.ragged_kernel_eligible(128, 32, 16, on_tpu=False)
+    assert not ok and "FLAGS_ragged_interpret" in why
+    ok, why = rp.ragged_kernel_eligible(128, 32, 16, on_tpu=False,
+                                        interpret=True)
+    assert ok  # the interpreter sanctions the CPU backend
+    ok, why = rp.ragged_kernel_eligible(128, 4096, 512)
+    assert not ok and "VMEM" in why
+
+
+def test_validate_ragged_tuned():
+    from paddle_tpu.analysis.kernelcheck import validate_ragged_tuned
+
+    assert validate_ragged_tuned({"16,8,128": 4, "16,16,64": 1}) == []
+    errors = validate_ragged_tuned({
+        "16,8,128": 3,       # does not divide num_heads
+        "16,8": 2,           # unparseable key
+        "16,8,64": 0,        # non-positive
+        "16,8,96": "2",      # non-int value
+        "-4,8,64": 2,        # negative page size
+    })
+    msgs = "\n".join(errors)
+    assert "does not divide num_heads" in msgs
+    assert "page_size,num_heads,head_dim" in msgs
+    assert "positive int" in msgs and "must be positive" in msgs
+
+
+def test_shipped_ragged_tuned_table_is_valid():
+    from paddle_tpu.analysis.kernelcheck import validate_ragged_tuned
+
+    table = rp._tuned_table()  # raises on a bad shipped table
+    assert validate_ragged_tuned(table) == []
+
+
+def test_ragged_tuned_load_rejects_bad_entry(tmp_path, monkeypatch):
+    bad = tmp_path / "ragged_tuned.json"
+    bad.write_text(json.dumps({"16,8,128": 3}))
+    monkeypatch.setattr(rp, "_TUNED_PATH", str(bad))
+    monkeypatch.setattr(rp, "_TUNED", None)
+    with pytest.raises(ValueError, match="does not divide"):
+        rp._tuned_table()
+    monkeypatch.setattr(rp, "_TUNED", None)  # don't poison the cache
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"4,2,8": 2}))
+    monkeypatch.setattr(rp, "_TUNED_PATH", str(good))
+    assert rp.block_heads_for(4, 2, 8) == 2
+    assert rp.block_heads_for(16, 8, 128) == 1  # untuned default
+    monkeypatch.setattr(rp, "_TUNED", None)
+
+
+# ------------------------------------------------------------- engine level
+def _mk_engine(kv="float32", spec=None, **over):
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=61, hidden_size=16, num_layers=2, num_heads=2,
+        max_seq_len=64, dropout=0.0))
+    model.eval()
+    cfg = dict(max_batch=2, num_pages=32, page_size=4, max_prompt_len=16,
+               kv_dtype=kv, spec=spec)
+    cfg.update(over)
+    return ServingEngine(model, ServingConfig(**cfg))
+
+
+def _drive(eng, budget=10):
+    rng = np.random.RandomState(3)
+    rids = [eng.add_request(rng.randint(0, 61, (n,)).astype(np.int32),
+                            budget) for n in (5, 9)]
+    outs = eng.run()
+    return [outs[r] for r in rids]
+
+
+def test_engine_kernel_on_bit_identical_and_sync_free(ragged_interpret):
+    """The whole serving loop with EVERY attention dispatch through the
+    unified kernel (interpret mode): outputs bit-identical to the
+    composite engine, compile counts equal, the sync-free certification
+    formula unchanged, zero fallbacks."""
+    from paddle_tpu.analysis import SyncTally
+    from paddle_tpu.serving.spec import SpecConfig
+
+    set_flags({"FLAGS_ragged_interpret": False})
+    base = _mk_engine(spec=SpecConfig(method="ngram", depth=2))
+    off = _drive(base)
+    cc_off = dict(base.compile_counts)
+
+    set_flags({"FLAGS_ragged_interpret": True})
+    eng = _mk_engine(spec=SpecConfig(method="ngram", depth=2))
+    rng = np.random.RandomState(3)
+    rids = [eng.add_request(rng.randint(0, 61, (n,)).astype(np.int32), 10)
+            for n in (5, 9)]
+    pre = eng.metrics.snapshot()
+    with SyncTally() as tally:
+        outs = eng.run()
+    on = [outs[r] for r in rids]
+    for a, b in zip(off, on):
+        assert np.array_equal(a, b), "kernel-on output diverged"
+    assert dict(eng.compile_counts) == cc_off
+    snap = eng.metrics.snapshot()
+    fetches = int(snap["serving_decode_steps"] - pre["serving_decode_steps"]
+                  + snap["serving_prefills_total"]
+                  - pre["serving_prefills_total"])
+    assert tally.count == fetches, (
+        f"kernel-on loop not sync-free: {tally.count} syncs vs "
+        f"{fetches} sanctioned fetches")
+    assert snap["serving_pallas_fallback_total"] == 0
+    assert snap["serving_analysis_retraces_total"] == 0
+
+
+def test_engine_kernel_on_int8_bit_identical(ragged_interpret):
+    """The int8 pool — the config the old dispatch BANNED from the
+    kernel — served through the fused-dequant gather, bit-identical to
+    the quantized composite engine."""
+    set_flags({"FLAGS_ragged_interpret": False})
+    off = _drive(_mk_engine(kv="int8"))
+    set_flags({"FLAGS_ragged_interpret": True})
+    eng = _mk_engine(kv="int8")
+    on = _drive(eng)
+    for a, b in zip(off, on):
+        assert np.array_equal(a, b), "int8 kernel-on output diverged"
+    assert eng.metrics.snapshot()["serving_pallas_fallback_total"] == 0
+
+
+def test_engine_ineligible_stays_composite_with_zero_fallbacks():
+    """CPU without the interpret flag: the gate (not a fallback) routes
+    to the composite — the fallback gauge stays at its pre-seeded zero
+    and the A/B predicted gauges are seeded from the bank."""
+    eng = _mk_engine()
+    assert eng._decode_pallas_eligible is False
+    _drive(eng, budget=4)
+    snap = eng.metrics.snapshot()
+    assert snap["serving_pallas_fallback_total"] == 0
+    # the banked unified-kernel predictions seed the A/B gauges
+    pred = snap.get("serving_kernel_speedup_predicted{kernel=ragged_paged}")
+    assert pred is not None and pred > 1.0
+    assert snap.get(
+        "serving_kernel_speedup_predicted{kernel=ragged_paged_q8}") > 1.0
+    # measured legs absent until both dispatch paths have samples
+    assert snap.get(
+        "serving_kernel_speedup_measured{kernel=ragged_paged}", 0.0) == 0.0
+
+
+def test_engine_ab_keys_follow_kv_dtype():
+    eng = _mk_engine()
+    assert eng._kernel_ab_name == "ragged_paged"
+    eng8 = _mk_engine(kv="int8")
+    assert eng8._kernel_ab_name == "ragged_paged_q8"
+
+
+# ------------------------------------------- flash %512 pad-or-fallback
+def test_flash_route_and_pad_edge():
+    from paddle_tpu.kernels import flash_attention as fa
+
+    shape = (1, 8, 1024, 128)
+    assert fa.flash_route(shape, shape, causal=True) == "direct"
+    s640 = (1, 8, 640, 128)
+    assert fa.flash_route(s640, s640, causal=True) == "pad"
+    assert fa.pad_seq_to_block(640) == 1024
+    assert fa.flash_route(s640, s640, causal=False) == ""
+    assert fa.edge_missed(s640, s640)
+    tiny = (1, 8, 64, 128)
+    assert fa.flash_route(tiny, tiny, causal=True) == ""
+    assert not fa.edge_missed(tiny, tiny)  # sub-kernel, not an edge
+    # cross-attention and >2x pad blowups don't pad
+    assert fa.flash_route((1, 8, 640, 128), (1, 8, 1280, 128),
+                          causal=True) == ""
+
+
+def test_sdpa_pad_route_counts_gauge_and_is_exact(monkeypatch):
+    """Force the TPU gates on CPU: the 640 causal dispatch takes the pad
+    route (counted on serving_flash_pad_total), the padded flash raises
+    on the CPU backend, and the logged fallback serves the exact
+    composite — no silent fast-path loss anywhere on the way."""
+    from paddle_tpu.kernels import attention as at
+
+    monkeypatch.setattr(at, "_on_tpu", lambda: True)
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(1, 2, 640, 64), jnp.float32)
+    before_pad = monitor.stat_get("serving_flash_pad_total", 0)
+    out = at.sdpa(q, q, q, is_causal=True)
+    assert monitor.stat_get("serving_flash_pad_total", 0) == before_pad + 1
+    ref = at.sdpa_reference(q, q, q, is_causal=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref))
+    # non-causal 640: no route — the loudly-counted composite fallback
+    before_edge = monitor.stat_get("serving_flash_edge_fallback_total", 0)
+    at.sdpa(q, q, q, is_causal=False)
+    assert monitor.stat_get("serving_flash_edge_fallback_total", 0) \
+        == before_edge + 1
+
+
+def test_flash_edge_gauges_pre_seeded():
+    from paddle_tpu.serving.metrics import ServingMetrics
+
+    snap = ServingMetrics().snapshot()
+    assert snap["serving_flash_pad_total"] == 0
+    assert snap["serving_flash_edge_fallback_total"] == 0
+    prom = ServingMetrics().prometheus()
+    assert "# TYPE serving_flash_pad_total counter" in prom
+    assert "# TYPE serving_flash_edge_fallback_total counter" in prom

@@ -1,0 +1,148 @@
+"""On-chip block-size autotune for the unified ragged paged-attention
+kernel (kernels/ragged_paged_attention.py). For each serving-relevant
+``(page_size, num_heads, head_dim)``, times the decode-mode kernel across
+candidate ``block_heads`` (heads per grid step — the knob trading grid
+parallelism against per-step VMEM/DMA width) and writes the winners to
+paddle_tpu/kernels/ragged_tuned.json — the single ``block_heads_for``
+source consults it, so the dispatch gate and launch config stay
+consistent automatically (the flash_autotune idiom).
+
+The table is validated by ``analysis.kernelcheck.validate_ragged_tuned``
+BEFORE writing — the same validator the kernel runs at load time, so load
+can never see an entry bank rejected.
+
+TPU only (the compiled kernel; the CPU interpreter's timings are
+meaningless); prints a skip note otherwise. Results also bank to
+BENCH_TPU_HISTORY.jsonl as rung-experiments.
+
+Usage: python tools/ragged_autotune.py
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import paddle_tpu  # noqa: F401 — applies the jax_platforms=cpu override
+import numpy as np
+
+SHAPES = [  # (batch, num_heads, head_dim, page_size, pages_per_seq)
+    (8, 8, 128, 16, 32),    # bench-model serving shape, 512-token window
+    (8, 16, 64, 16, 32),    # head_dim-64 coverage shape
+    (4, 16, 128, 16, 64),   # long-context decode (1024-token window)
+    (8, 8, 128, 32, 16),    # bigger pages, same window
+]
+
+
+def _candidates(num_heads: int, head_dim: int, page_size: int,
+                pages_per_seq: int) -> list:
+    """block_heads values worth sweeping: must divide num_heads AND pass
+    the dispatch-side VMEM eligibility gate at the LARGEST query count a
+    serving call makes (the 64-pad prefill bucket) — a banked winner the
+    gate then rejects would silently route every call at that shape to
+    the composite path, the exact opposite of tuning."""
+    from paddle_tpu.kernels.ragged_paged_attention import (
+        _VMEM_GATE_BYTES, _vmem_working_set)
+
+    total_kv = pages_per_seq * page_size
+    return [bh for bh in (1, 2, 4, 8, 16) if num_heads % bh == 0
+            and bh <= num_heads
+            and _vmem_working_set(head_dim, total_kv, 64, bh,
+                                  pages_per_seq, False)
+            <= _VMEM_GATE_BYTES]
+
+
+def _time_config(q, kp, vp, tab, ctx, block_heads):
+    import jax
+
+    from _timing import time_fn
+    from paddle_tpu.kernels import ragged_paged_attention as rp
+
+    fn = jax.jit(lambda *a: rp.ragged_paged_attention(
+        *a, block_heads=block_heads))
+    return time_fn(fn, (q, kp, vp, tab, ctx), iters=5, inner=40)
+
+
+def main():
+    import jax
+
+    # decide from config, NOT jax.devices(): the axon register hook forces
+    # TPU-client init inside devices() even under jax_platforms=cpu, and a
+    # dead/contended tunnel then hangs this process (see bench.py's
+    # child-probe dance for the same reason)
+    if (jax.config.jax_platforms or "").strip().lower() == "cpu":
+        print("[ragged_autotune] CPU backend: pallas kernels unavailable; "
+              "run on TPU", file=sys.stderr)
+        return
+    dev = jax.devices()[0]
+    table = {}
+    records = []
+    for b, h, d, ps, pps in SHAPES:
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        npages = b * pps + 1
+        q = jnp.asarray(rng.rand(b, h, 1, d), jnp.float32)
+        kp = jnp.asarray(rng.rand(npages, ps, h, d), jnp.float32)
+        vp = jnp.asarray(rng.rand(npages, ps, h, d), jnp.float32)
+        tab = jnp.asarray(
+            np.arange(1, 1 + b * pps, dtype=np.int32).reshape(b, pps))
+        ctx = jnp.asarray(rng.randint(ps, ps * pps - 1, (b,)), jnp.int32)
+        results = {}
+        for bh in _candidates(h, d, ps, pps):
+            try:
+                results[bh] = _time_config(q, kp, vp, tab, ctx, bh)
+                print(f"[ragged_autotune] ps={ps} h={h} d={d} "
+                      f"block_heads={bh}: {results[bh] * 1e3:.3f} ms",
+                      file=sys.stderr, flush=True)
+            except Exception as e:  # noqa: BLE001 — OOM/unsupported config
+                print(f"[ragged_autotune] ps={ps} h={h} d={d} "
+                      f"block_heads={bh}: {type(e).__name__}",
+                      file=sys.stderr, flush=True)
+        if not results:
+            continue
+        best = min(results, key=results.get)
+        default_t = results.get(1)  # block_heads_for's untuned default
+        table[f"{ps},{h},{d}"] = best
+        records.append({
+            "metric": "ragged_paged_decode_ms",
+            "value": round(results[best] * 1e3, 4),
+            "unit": "ms",
+            "vs_baseline": round(default_t / results[best], 3)
+            if default_t else None,
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", "?"),
+            "config": {"batch": b, "heads": h, "head_dim": d,
+                       "page_size": ps, "pages_per_seq": pps,
+                       "best_block_heads": best,
+                       "sweep_ms": {str(kk): round(vv * 1e3, 4)
+                                    for kk, vv in results.items()}},
+            "provenance": "rung-experiment (ragged_autotune)",
+        })
+
+    # validate BEFORE writing: a bad entry would otherwise be rejected at
+    # every future load (kernels/ragged_paged_attention.py) — the
+    # kernelcheck constraints are the single source of truth
+    from paddle_tpu.analysis.kernelcheck import validate_ragged_tuned
+
+    errors = validate_ragged_tuned(table)
+    if errors:
+        raise ValueError(
+            "ragged_autotune produced entries violating the kernel "
+            "constraints (refusing to write ragged_tuned.json):\n  "
+            + "\n  ".join(errors))
+    out_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "paddle_tpu", "kernels", "ragged_tuned.json")
+    with open(out_path, "w") as f:
+        json.dump(table, f, indent=1, sort_keys=True)
+    print(f"[ragged_autotune] wrote {os.path.abspath(out_path)}: {table}",
+          file=sys.stderr)
+    import bench
+
+    for rec in records:
+        bench._bank_tpu_result(rec)
+    print(json.dumps({"tuned": table}))
+
+
+if __name__ == "__main__":
+    main()
