@@ -86,7 +86,8 @@ def _last_known_tpu() -> dict | None:
         if prov.startswith(("rung-experiment", "resnet50-bench", "longseq",
                             "bert-bench", "serving-kvq-bench",
                             "serving-spec-bench",
-                            "serving-ragged-kernel-bench")):
+                            "serving-ragged-kernel-bench",
+                            "serving-tenant-bench")):
             continue
         return rec
     return None
@@ -733,6 +734,112 @@ def _serving_spec_bench() -> dict:
     return out
 
 
+def _serving_tenant_bench() -> dict:
+    """Serving phase: per-tenant SLO observability — an interactive +
+    batch traffic mix served by one engine with the goodput ledger,
+    journeys, and the slo_burn watchdog ON. Per-tenant TTFT/TPOT p99s
+    and goodput fractions are EMITTED, never ratio-asserted (CPU noise
+    rule — a toy model's latency split says nothing about real SLO
+    headroom). The structural evidence IS asserted, exactly: outputs
+    bit-identical tenants-on vs tenants-off (the tenant label never
+    enters a traced program; compile counts equal, zero retraces), the
+    SyncTally certification formula (decode steps + prefills) unchanged
+    with the whole tenant layer on, ZERO alerts on the clean leg (the
+    targets are generous), and slo_burn firing EXACTLY ONCE on a rigged
+    leg whose tenant declares an unmeetable TTFT target."""
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import SyncTally
+    from paddle_tpu.obs import validate_flight_record, validate_journey
+    from paddle_tpu.serving import (ServingConfig, ServingEngine,
+                                    TenantSLO)
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(33)
+    cfg = GPTConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=96, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(17)
+    # interactive: short prompts, short outputs; batch: longer both ways
+    jobs = [(rng.randint(0, 96, (6,)).astype(np.int32), 8, "interactive")
+            for _ in range(6)] + \
+           [(rng.randint(0, 96, (14,)).astype(np.int32), 24, "batch")
+            for _ in range(3)]
+
+    def drive(tenants, tag_tenants):
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=4, num_pages=64, page_size=16, max_prompt_len=16,
+            enable_prefix_caching=False, tenants=tenants))
+        rids = [engine.add_request(p, n,
+                                   tenant=t if tag_tenants else "default")
+                for p, n, t in jobs]
+        t0 = time.perf_counter()
+        with SyncTally() as tally:
+            outs = engine.run()
+        dt = time.perf_counter() - t0
+        snap = engine.metrics.snapshot()
+        fetches = int(snap["serving_decode_steps"]
+                      + snap["serving_prefills_total"])
+        assert tally.count == fetches, (
+            f"tenant layer not sync-free: {tally.count} syncs vs "
+            f"{fetches} sanctioned fetches — events: {tally.events[:20]}")
+        assert snap["serving_analysis_retraces_total"] == 0, \
+            "compile budget violated in the tenant serving bench"
+        return engine, [outs[r] for r in rids], dt, snap
+
+    out = {}
+    # clean leg: generous targets, everything in_slo, zero alerts
+    slos = {"interactive": TenantSLO(ttft_p99_s=300.0, tpot_p99_s=300.0),
+            "batch": TenantSLO(ttft_p99_s=600.0, tpot_p99_s=600.0)}
+    eng_off, plain, dt_off, _ = drive(None, False)
+    eng_on, tagged, dt_on, snap = drive(slos, True)
+    for a, b in zip(plain, tagged):
+        assert np.array_equal(a, b), \
+            "tenant labels changed the served outputs"
+    assert eng_on.compile_counts == eng_off.compile_counts
+    assert eng_on.alerts() == [], \
+        f"clean tenant leg fired alerts: {eng_on.alerts()}"
+    report = eng_on.tenant_report()
+    total_tokens = sum(n for _, n, _ in jobs)
+    ledger_tokens = sum(sum(e["tokens"].values())
+                        for e in report.values())
+    assert ledger_tokens == total_tokens == \
+        int(snap["serving_tokens_total"]), \
+        "per-tenant ledger tokens must reconcile with the engine total"
+    for j in eng_on.journeys():
+        validate_journey(j.to_wire())
+    validate_flight_record(eng_on.flight_record())
+    for tenant in ("interactive", "batch"):
+        e = report[tenant]
+        out[f"serving_tenant_{tenant}_ttft_p99_s"] = round(
+            float(e.get("ttft_s_p99", 0.0)), 6)
+        out[f"serving_tenant_{tenant}_tpot_p99_s"] = round(
+            float(e.get("tpot_s_p99", 0.0)), 6)
+        out[f"serving_tenant_{tenant}_goodput_fraction"] = round(
+            float(e["goodput_fraction"]), 4)
+        out[f"serving_tenant_{tenant}_goodput_tokens"] = \
+            e["goodput_tokens"]
+    out["serving_tenant_tokens_per_sec"] = round(total_tokens / dt_on, 1)
+    out["serving_tenant_off_tokens_per_sec"] = round(
+        total_tokens / dt_off, 1)
+
+    # rigged leg: an unmeetable TTFT target — every retirement is
+    # ttft_late, and the burn-rate watchdog fires exactly once
+    rig, _, _, rig_snap = drive(
+        {"interactive": TenantSLO(ttft_p99_s=1e-9, tpot_p99_s=1e-9),
+         "batch": TenantSLO(ttft_p99_s=600.0, tpot_p99_s=600.0)}, True)
+    alerts = rig.alerts()
+    assert [a.rule for a in alerts] == ["slo_burn"], \
+        f"rigged leg must fire slo_burn exactly once, got {alerts}"
+    assert alerts[0].data["tenant"] == "interactive"
+    assert rig_snap["serving_alerts_total{rule=slo_burn}"] == 1
+    assert rig_snap["serving_tenant_goodput_tokens_total"
+                    "{tenant=interactive}"] == 0
+    out["serving_tenant_rigged_badput_tokens"] = int(
+        rig_snap["serving_tenant_badput_tokens_total{tenant=interactive}"])
+    return out
+
+
 def _serving_ragged_kernel_bench() -> dict:
     """Serving phase: the unified ragged paged-attention kernel vs the
     gather+sdpa composite, fp32 and int8 — the ROADMAP's raw-decode A/B.
@@ -1029,6 +1136,12 @@ def run_bench(platform: str) -> dict:
             print(f"[bench] serving ragged kernel phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
+        try:
+            r["serving_tenant"] = _serving_tenant_bench()
+        except Exception as e:  # noqa: BLE001 — never forfeit the headline number
+            print(f"[bench] serving tenant phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
         return r
 
     deadline = float(os.environ.get(_DEADLINE_ENV, time.time() + _TPU_BUDGET_S))
@@ -1110,6 +1223,18 @@ def run_bench(platform: str) -> dict:
                                   provenance="serving-ragged-kernel-bench"))
         except Exception as e:  # noqa: BLE001 — never forfeit the train number
             print(f"[bench] serving ragged kernel phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
+    if remaining() > 45:
+        try:
+            result["serving_tenant"] = _serving_tenant_bench()
+            # bank the on-chip per-tenant SLO numbers as their own
+            # provenance-labeled history row (skipped by last_known_tpu)
+            _bank_tpu_result(dict(result["serving_tenant"],
+                                  platform=result.get("platform"),
+                                  provenance="serving-tenant-bench"))
+        except Exception as e:  # noqa: BLE001 — never forfeit the train number
+            print(f"[bench] serving tenant phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
     return result

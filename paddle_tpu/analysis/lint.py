@@ -58,13 +58,18 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   ``analysis.kernelcheck.REGISTRY`` entries (a tier-1 test pins each
   name to a live entry).
 - PT012 — a LABELED stat family used at a ``stat_add``/``stat_set``/
-  ``stat_max`` call site (a name shaped ``base{label=value}``, usually
-  built with an f-string) whose base is in neither ``_SEEDED`` nor the
-  module's ``_FAMILIES`` registry: the dynamically formatted name is
-  invisible to PT003/PT008 — exactly the gap the
-  ``serving_alerts_total{rule=}`` / ``serving_step_phase_s{phase=}``
-  families opened — so an unregistered family ships with no pre-seeded
-  members and appears on dashboards only once its first event fires.
+  ``stat_max`` call site (a name shaped ``base{label=value}`` — or
+  multi-label ``base{a=,b=}`` — usually built with an f-string) whose
+  base is in neither ``_SEEDED`` nor the module's ``_FAMILIES``
+  registry: the dynamically formatted name is invisible to PT003/PT008
+  — exactly the gap the ``serving_alerts_total{rule=}`` /
+  ``serving_step_phase_s{phase=}`` families opened — so an unregistered
+  family ships with no pre-seeded members and appears on dashboards
+  only once its first event fires. Also fires when the call site's
+  statically visible label KEYS (or their order) disagree with the
+  ``_FAMILIES`` declaration: keys are part of the registry key, so a
+  reordered ``{class=,tenant=}`` write builds a member the seeding
+  never created.
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
@@ -198,18 +203,26 @@ def _seeding_contract(tree):
     return seeded, prefix
 
 
+#: stands in for each formatted field in a resolved name SKELETON — a
+#: character no real stat name contains
+_FMT_PLACEHOLDER = "\x00"
+
+
 def _stat_name_text(node, fn_suffixes, prefix):
-    """The leading static text of a ``stat_xxx`` call's name argument —
-    the ONE resolver behind PT003/PT008 (whole names) and PT012
-    (labeled-family heads), so a newly supported naming idiom lands in
-    exactly one place and the rules can never disagree about which call
-    sites they see. Resolves ``PREFIX + "..."`` / ``PREFIX + f"..."``
-    concatenations and bare (f-)strings carrying the prefix inline.
-    Returns ``(text, whole)`` where ``whole`` says the text is the
-    ENTIRE name (a plain constant) rather than the constant head of a
-    formatted one; None when the call isn't one of ``fn_suffixes`` or
-    nothing is statically visible (runtime-computed names can't be
-    checked statically)."""
+    """The statically visible text of a ``stat_xxx`` call's name
+    argument — the ONE resolver behind PT003/PT008 (whole names) and
+    PT012 (labeled-family heads AND label keys), so a newly supported
+    naming idiom lands in exactly one place and the rules can never
+    disagree about which call sites they see. Resolves ``PREFIX +
+    "..."`` / ``PREFIX + f"..."`` concatenations and bare (f-)strings
+    carrying the prefix inline. Returns ``(text, whole, skeleton)``:
+    ``text`` is the leading constant, ``whole`` says it is the ENTIRE
+    name (a plain constant), and ``skeleton`` is the full name with
+    every formatted field replaced by a placeholder — the surface the
+    multi-label family check (``base{a=,b=}``) reads its label keys
+    off. None when the call isn't one of ``fn_suffixes`` or nothing is
+    statically visible (runtime-computed names can't be checked
+    statically)."""
     if not (isinstance(node, ast.Call) and node.args
             and _unparse(node.func).endswith(fn_suffixes)):
         return None
@@ -219,19 +232,23 @@ def _stat_name_text(node, fn_suffixes, prefix):
             and _unparse(arg.left) == "PREFIX":
         arg, strip = arg.right, False
     if isinstance(arg, ast.Constant):
-        text, whole = arg.value, True
+        text, whole, skeleton = arg.value, True, arg.value
     elif isinstance(arg, ast.JoinedStr) and arg.values \
             and isinstance(arg.values[0], ast.Constant):
         text, whole = arg.values[0].value, False
+        skeleton = "".join(
+            str(v.value) if isinstance(v, ast.Constant)
+            else _FMT_PLACEHOLDER for v in arg.values)
     else:
         return None
-    if not isinstance(text, str):
+    if not isinstance(text, str) or not isinstance(skeleton, str):
         return None
     if strip:
         if not (prefix and text.startswith(prefix)):
             return None
         text = text[len(prefix):]
-    return text, whole
+        skeleton = skeleton[len(prefix):]
+    return text, whole, skeleton
 
 
 def _stat_call_name(node, fn_suffixes, prefix):
@@ -242,7 +259,7 @@ def _stat_call_name(node, fn_suffixes, prefix):
     resolved = _stat_name_text(node, fn_suffixes, prefix)
     if resolved is None:
         return None
-    text, whole = resolved
+    text, whole, _ = resolved
     if not whole or "{" in text:
         return None  # formatted tail / labeled family: PT012's domain
     return text
@@ -250,23 +267,36 @@ def _stat_call_name(node, fn_suffixes, prefix):
 
 _STAT_FNS = ("stat_add", "stat_set", "stat_max")
 
+# a COMPLETE static family shape: base{k=...,k2=...} with only the label
+# VALUES possibly formatted — the precondition for reading label keys
+_FULL_FAMILY = re.compile(
+    r"^[A-Za-z0-9_]+\{[A-Za-z_][A-Za-z0-9_]*=[^{}]*\}$")
+_LABEL_KEYS = re.compile(r"[{,]([A-Za-z_][A-Za-z0-9_]*)=")
 
-def _labeled_stat_head(node, prefix):
-    """The static HEAD of a labeled stat name at a ``stat_xxx`` call
-    site — the leading constant text of the name expression, when that
-    text contains a ``{`` (the ``base{label=value}`` family shape, e.g.
-    ``PREFIX + f"base{{label={v}}}"``). None for anything else — a name
-    whose brace only appears after a formatted field (e.g. the family
+
+def _labeled_stat_family(node, prefix):
+    """``(base, keys)`` of a labeled stat name at a ``stat_xxx`` call
+    site — ``base`` is the head before the first ``{`` of the leading
+    constant text (the ``base{label=value}`` / multi-label
+    ``base{a=,b=}`` family shapes, e.g. ``PREFIX +
+    f"base{{a={x},b={y}}}"``), and ``keys`` the ORDERED tuple of label
+    keys when the whole label structure is statically visible (only the
+    VALUES formatted), else None. None for anything else — a name whose
+    brace only appears after a formatted field (e.g. the family
     percentile mirrors ``f"base_{suffix}{{label=...}}"``) has no
     checkable base, the same documented blindness PT003 has to fully
     dynamic names."""
     resolved = _stat_name_text(node, _STAT_FNS, prefix)
     if resolved is None:
         return None
-    text, _ = resolved
+    text, _, skeleton = resolved
     if "{" not in text:
         return None
-    return text.split("{", 1)[0]
+    base = text.split("{", 1)[0]
+    keys = None
+    if _FULL_FAMILY.match(skeleton):
+        keys = tuple(_LABEL_KEYS.findall(skeleton))
+    return base, keys
 
 
 def _pt003(tree, path):
@@ -501,27 +531,44 @@ def _pt011(tree, path):
 
 
 def _family_registry(tree):
-    """The module's declared labeled-family bases: the constant keys of a
-    top-level ``_FAMILIES = {...}`` dict. None when the module declares
-    no registry."""
+    """The module's declared labeled families: ``{base: label keys}``
+    from a top-level ``_FAMILIES = {...}`` dict — a string value
+    normalizes to a 1-tuple, a tuple/list of strings is a multi-label
+    declaration in registry-key order, anything non-constant maps to
+    None (declared, keys not statically checkable). None when the
+    module declares no registry."""
     for node in tree.body:
         if isinstance(node, ast.Assign) and len(node.targets) == 1 \
                 and isinstance(node.targets[0], ast.Name) \
                 and node.targets[0].id == "_FAMILIES" \
                 and isinstance(node.value, ast.Dict):
-            return {k.value for k in node.value.keys
-                    if isinstance(k, ast.Constant)}
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if not isinstance(k, ast.Constant):
+                    continue
+                if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                    out[k.value] = (v.value,)
+                elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                        isinstance(e, ast.Constant) for e in v.elts):
+                    out[k.value] = tuple(e.value for e in v.elts)
+                else:
+                    out[k.value] = None
+            return out
     return None
 
 
 def _pt012(tree, path):
     """Labeled stat family written without a ``_FAMILIES`` declaration —
-    the dynamically-formatted-name gap of PT003/PT008. Gated, like them,
-    on the module declaring a ``_SEEDED`` contract."""
+    the dynamically-formatted-name gap of PT003/PT008 — or written with
+    label keys (or key ORDER) disagreeing with the declaration: the
+    label keys are part of the registry key, so a mismatched write
+    builds a member the seeding never created and dashboards keyed on
+    presence go blind exactly like the undeclared case. Gated, like
+    PT003/PT008, on the module declaring a ``_SEEDED`` contract."""
     seeded, prefix = _seeding_contract(tree)
     if seeded is None:  # no seeding registry in this module: no contract
         return
-    families = _family_registry(tree) or set()
+    families = _family_registry(tree) or {}
 
     def registered(base):
         # a declared family sanctions its derived mirror names too
@@ -530,8 +577,11 @@ def _pt012(tree, path):
             base == fam or base.startswith(fam + "_") for fam in families)
 
     for node in ast.walk(tree):
-        base = _labeled_stat_head(node, prefix)
-        if base is not None and not registered(base):
+        resolved = _labeled_stat_family(node, prefix)
+        if resolved is None:
+            continue
+        base, keys = resolved
+        if not registered(base):
             yield (node.lineno,
                    f"labeled stat family {base!r} ({base}{{...=...}}) is "
                    f"written but declared in neither _FAMILIES nor "
@@ -540,6 +590,16 @@ def _pt012(tree, path):
                    f"and dashboards keyed on presence are blind until "
                    f"the first event. Declare the base in _FAMILIES and "
                    f"seed its label values (ServingMetrics.seed_family).")
+        elif keys is not None and families.get(base) is not None \
+                and keys != families[base]:
+            yield (node.lineno,
+                   f"labeled stat family {base!r} is written with label "
+                   f"keys {keys} but _FAMILIES declares "
+                   f"{families[base]} — label keys and their ORDER are "
+                   f"part of the registry key, so this write builds a "
+                   f"member the seeding never created (it reads as "
+                   f"absent on dashboards and never resets). Write the "
+                   f"labels exactly as declared.")
 
 
 @dataclass(frozen=True)
@@ -572,9 +632,10 @@ RULES: dict[str, Rule] = {r.code: r for r in (
          _pt010, scope="serving"),
     Rule("PT011", "pallas_call in a module with no registered "
          "kernelcheck certificate (KERNELCHECK_CERTS)", _pt011),
-    Rule("PT012", "labeled stat family (base{label=}) written without a "
-         "_FAMILIES declaration — the PT003/PT008 gap for formatted "
-         "names", _pt012),
+    Rule("PT012", "labeled stat family (base{label=}, incl. multi-label "
+         "base{a=,b=}) written without a _FAMILIES declaration, or with "
+         "label keys disagreeing with it — the PT003/PT008 gap for "
+         "formatted names", _pt012),
 )}
 
 

@@ -33,8 +33,21 @@ doing right before it died.
   edge-triggered rules over host-resident step state — retrace after
   warmup, Pallas fallback, speculative-acceptance collapse, eviction
   thrash, queue stall — each firing a structured :class:`Alert`.
+- :mod:`~paddle_tpu.obs.journey` — request-journey records
+  (:class:`Journey`, :class:`JourneyBook`): every request's
+  enqueue → admit → chunk/decode/verify → preempt/swap → retire hop
+  list with engine-step refs, folded off the tracer's event stream and
+  exportable as the schema-versioned ``paddle-tpu/journey/v1`` wire
+  dict (:func:`validate_journey`) — the trace-export-over-the-wire
+  format the multi-host arc consumes.
+- :mod:`~paddle_tpu.obs.tenant` — per-tenant SLO classes
+  (:class:`TenantSLO`) and the goodput/badput ledger
+  (:class:`TenantLedger`): every retirement classified into one of
+  seven terminal classes, emitted tokens accrued per class, observe-only
+  (weighted admission stays with the fleet router).
 - :mod:`~paddle_tpu.obs.recorder` — the black-box flight recorder:
-  bounded schema-versioned JSON dumps of the step ring + alerts +
+  bounded schema-versioned JSON dumps (v2: + per-tenant roll-ups and a
+  journey ring; v1 dumps stay readable) of the step ring + alerts +
   gauges + audit roll-ups, written automatically on engine-fatal paths
   and request failures.
 - :mod:`~paddle_tpu.obs.export` — Chrome ``trace_event`` JSON (one track
@@ -61,9 +74,15 @@ from .export import (chrome_trace, latency_table,  # noqa: F401
 from .histogram import (LATENCY_EDGES_S, OCCUPANCY_EDGES,  # noqa: F401
                         QUANTILES, Histogram, HistogramFamily,
                         split_labels)
+from .journey import (JOURNEY_SCHEMA, Journey, JourneyBook,  # noqa: F401
+                      format_journey, validate_journey)
 from .recorder import (FLIGHT_RECORD_SCHEMA,  # noqa: F401
-                       build_flight_record, dump_flight_record,
-                       format_flight_record, validate_flight_record)
+                       FLIGHT_RECORD_SCHEMA_V1, build_flight_record,
+                       dump_flight_record, format_flight_record,
+                       validate_flight_record)
+from .tenant import TENANT_CLASSES  # noqa: F401
+from .tenant import (TenantLedger, TenantSLO,  # noqa: F401
+                     check_tenant_name, tenant_table)
 from .timeline import StepRecord, StepTimeline  # noqa: F401
 from .trace import RequestTrace, TraceEvent, Tracer  # noqa: F401
 
@@ -75,8 +94,12 @@ __all__ = ["Histogram", "HistogramFamily", "LATENCY_EDGES_S",
            "DEFAULT_PEAK_FLOPS_PER_S", "DEFAULT_PEAK_HBM_BYTES_PER_S",
            "load_banked_kernel_speedups",
            "Alert", "ALERT_RULES", "Watchdog", "WatchdogConfig",
-           "FLIGHT_RECORD_SCHEMA", "build_flight_record",
-           "dump_flight_record", "format_flight_record",
-           "validate_flight_record",
+           "JOURNEY_SCHEMA", "Journey", "JourneyBook",
+           "validate_journey", "format_journey",
+           "TENANT_CLASSES", "TenantSLO", "TenantLedger",
+           "check_tenant_name", "tenant_table",
+           "FLIGHT_RECORD_SCHEMA", "FLIGHT_RECORD_SCHEMA_V1",
+           "build_flight_record", "dump_flight_record",
+           "format_flight_record", "validate_flight_record",
            "chrome_trace", "write_chrome_trace", "prometheus_text",
            "latency_table"]

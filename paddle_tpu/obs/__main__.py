@@ -11,6 +11,12 @@ the automatic fatal/failure dumps):
     python -m paddle_tpu.obs --flight-record dump.json --latency-table
         render the dump's per-request latency summaries as the fixed-
         width table
+    python -m paddle_tpu.obs --flight-record dump.json --tenant-table
+        render the dump's per-tenant roll-ups (goodput %, TTFT/TPOT
+        p99, badput breakdown by class) — flight-record v2 dumps only
+    python -m paddle_tpu.obs --flight-record dump.json --journey RID
+        pretty-print one request's journey out of the dump's bounded
+        journey ring (hop table with engine-step refs)
     python -m paddle_tpu.obs --prometheus
         (no dump) text exposition of THIS process's live ``serving_*``
         registry — for embedding in a scrape handler
@@ -25,7 +31,9 @@ import json
 import sys
 
 from .export import latency_table, prometheus_text
+from .journey import format_journey
 from .recorder import format_flight_record, validate_flight_record
+from .tenant import tenant_table
 
 
 def _counter_types(gauges: dict) -> dict:
@@ -64,6 +72,12 @@ def main(argv=None) -> int:
     view.add_argument("--latency-table", action="store_true",
                       help="render the dump's per-request latency "
                            "summaries")
+    view.add_argument("--tenant-table", action="store_true",
+                      help="render the dump's per-tenant goodput/SLO "
+                           "roll-ups (flight-record v2)")
+    view.add_argument("--journey", metavar="RID", type=int, default=None,
+                      help="pretty-print one request's journey out of "
+                           "the dump's journey ring")
     try:
         args = parser.parse_args(argv)
     except SystemExit as e:
@@ -95,6 +109,27 @@ def main(argv=None) -> int:
               end="")
     elif args.latency_table:
         print(latency_table(record["requests"]))
+    elif args.tenant_table:
+        tenants = record.get("tenants")
+        if tenants is None:
+            print(f"dump {args.flight_record!r} has no tenant section "
+                  f"(flight-record v1, pre-tenant)")
+            return 2
+        print(tenant_table(tenants))
+    elif args.journey is not None:
+        ring = record.get("journeys")
+        if ring is None:  # v1 predates journeys — don't claim eviction
+            print(f"dump {args.flight_record!r} has no journey ring "
+                  f"(flight-record v1, pre-tenant)")
+            return 2
+        journeys = {j["rid"]: j for j in ring}
+        if args.journey not in journeys:
+            retained = sorted(journeys)
+            print(f"rid {args.journey} not in the dump's journey ring "
+                  f"(retained rids: {retained[:16]}"
+                  + ("..." if len(retained) > 16 else "") + ")")
+            return 2
+        print(format_journey(journeys[args.journey]))
     else:
         print(format_flight_record(record))
     # findings contract: a dump that recorded alerts, or was written by a

@@ -30,6 +30,12 @@ against:
 - ``queue_stall`` — requests are waiting but nothing was admitted and
   nothing is running for N consecutive steps: the engine is wedged (or
   paused with work queued), not merely busy.
+- ``slo_burn`` — a tenant's windowed SLO-violation fraction (violation
+  retirements / total retirements, from the per-tenant goodput ledger —
+  obs/tenant.py) crossed the threshold with enough retirements to mean
+  it: that tenant's latency promise is burning, per-tenant and latched
+  (re-arms only after a healthy window), the request-grain twin of the
+  engine-grain rules above.
 
 Each firing appends an :class:`Alert` to a bounded history ring, bumps
 the pre-seeded ``serving_alerts_total{rule=}`` counter family (via the
@@ -45,7 +51,8 @@ __all__ = ["Alert", "WatchdogConfig", "Watchdog", "RULES"]
 
 #: every rule name — the pre-seeded label set of serving_alerts_total{rule=}
 RULES = ("retrace_after_warmup", "pallas_fallback",
-         "spec_acceptance_collapse", "eviction_thrash", "queue_stall")
+         "spec_acceptance_collapse", "eviction_thrash", "queue_stall",
+         "slo_burn")
 
 
 @dataclass(frozen=True)
@@ -74,6 +81,10 @@ class WatchdogConfig:
     thrash_window_steps: int = 16
     thrash_events: int = 8          # evictions + spills in the window
     stall_steps: int = 4            # consecutive no-progress steps
+    slo_burn_window_steps: int = 16  # per-tenant retirement window
+    slo_burn_threshold: float = 0.5  # violation fraction that fires
+    slo_burn_min_retired: int = 4   # retirements before the fraction
+    # means anything (one late request out of one is not a burn)
     capacity: int = 256             # alert history ring bound
 
     def validate(self) -> None:
@@ -82,8 +93,13 @@ class WatchdogConfig:
         if not 0.0 < self.acceptance_floor < 1.0:
             raise ValueError(
                 f"acceptance_floor {self.acceptance_floor} outside (0, 1)")
+        if not 0.0 < self.slo_burn_threshold <= 1.0:
+            raise ValueError(
+                f"slo_burn_threshold {self.slo_burn_threshold} outside "
+                f"(0, 1]")
         for name in ("acceptance_min_proposed", "acceptance_window_steps",
                      "thrash_window_steps", "thrash_events", "stall_steps",
+                     "slo_burn_window_steps", "slo_burn_min_retired",
                      "capacity"):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} {getattr(self, name)} < 1")
@@ -114,6 +130,11 @@ class Watchdog:
             maxlen=self.cfg.thrash_window_steps)
         self._thrash_last = 0
         self._stall_streak = 0
+        # slo_burn: per-tenant (violation, retired) delta windows, last
+        # totals, and the per-tenant latch
+        self._burn_win: dict[str, deque] = {}
+        self._burn_last: dict[str, tuple[int, int]] = {}
+        self._burn_latched: set[str] = set()
 
     def _fire(self, out: list, rule: str, step: int, message: str,
               **data) -> None:
@@ -181,6 +202,42 @@ class Watchdog:
                        f"{cfg.thrash_events})",
                        window_events=wev)
             self._thrash_win.clear()  # re-arm after another full thrash
+
+        # slo burn, per-tenant, windowed and latched like the acceptance
+        # rule: the ledger hands monotonic (violations, retired) totals;
+        # fire at the onset edge, re-arm only after a healthy window
+        for tenant, (v, r) in (counters.get("tenant_slo") or {}).items():
+            win = self._burn_win.get(tenant)
+            if win is None:
+                win = self._burn_win[tenant] = deque(
+                    maxlen=cfg.slo_burn_window_steps)
+            lv, lr = self._burn_last.get(tenant, (0, 0))
+            self._burn_last[tenant] = (v, r)
+            win.append((v - lv, r - lr))
+            wv = sum(d[0] for d in win)
+            wr = sum(d[1] for d in win)
+            if wr < cfg.slo_burn_min_retired:
+                # too few retirements to judge a burn — but a FULL window
+                # with zero violations is unambiguously healthy, and must
+                # re-arm the latch even for a low-rate tenant (otherwise a
+                # sparse tenant's first burn latches forever and every
+                # later episode is silently missed)
+                if wv == 0 and len(win) == win.maxlen:
+                    self._burn_latched.discard(tenant)
+                continue
+            frac = wv / wr
+            if frac >= cfg.slo_burn_threshold:
+                if tenant not in self._burn_latched:
+                    self._burn_latched.add(tenant)
+                    self._fire(out, "slo_burn", step,
+                               f"tenant {tenant!r} windowed SLO-violation "
+                               f"fraction {frac:.3f} at/above threshold "
+                               f"{cfg.slo_burn_threshold} ({wv}/{wr} "
+                               f"retirements over {len(win)} steps)",
+                               tenant=tenant, window_violations=wv,
+                               window_retired=wr, fraction=frac)
+            else:
+                self._burn_latched.discard(tenant)
 
         # queue stall: waiting work, zero progress, N consecutive steps
         stalled = (record.queue_depth > 0 and record.admitted == 0

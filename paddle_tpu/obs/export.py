@@ -13,18 +13,24 @@ with a global instant per watchdog alert. Counter tracks (``ph: "C"`` —
 Perfetto renders them as stacked area charts above the spans) plot
 ``pages_in_use`` / ``batch`` / ``queue_depth`` per step from the timeline
 ring, so resource pressure is visible alongside the request spans it
-explains. Timestamps are engine-clock seconds rebased to the earliest
-event and scaled to the microseconds the format requires — a virtual test
-clock exports exactly like a wall clock.
+explains, and each tenant with retired journeys gets its own track of
+retirement instants. Timestamps are engine-clock seconds rebased to the
+earliest event and scaled to the microseconds the format requires — a
+virtual test clock exports exactly like a wall clock.
 
 Prometheus: standard text exposition (``# TYPE`` + samples) over the
 monitor registry's ``serving_*`` scalars and the obs histograms rendered
 as cumulative ``_bucket{le="..."}`` series with ``_sum``/``_count`` — the
 format every Prometheus scraper and promtool understands. Labeled
-family members — registry keys shaped ``base{label=value}``, e.g.
-``serving_alerts_total{rule=queue_stall}`` and the
-``serving_step_phase_s{phase=}`` histogram children — render as one
-metric family per base with proper ``{label="value"}`` sample labels.
+family members — registry keys shaped ``base{label=value}`` (one or
+more labels), e.g. ``serving_alerts_total{rule=queue_stall}``, the
+``serving_step_phase_s{phase=}`` / ``serving_ttft_s{tenant=}``
+histogram children, and the multi-label
+``serving_tenant_retired_total{tenant=,class=}`` counters — render as
+one metric family per base through the one label-set renderer
+(:func:`_label_str`: sorted ``k="v"`` pairs, escaped values), so a
+family bucket like ``serving_ttft_s_bucket{le="0.5",tenant="batch"}``
+is identical text on the live-registry and flight-record-dump paths.
 """
 from __future__ import annotations
 
@@ -108,17 +114,45 @@ _COUNTER_TRACKS = (("pages_in_use", "pages_in_use"), ("batch", "batch"),
                    ("queue_depth", "queue_depth"))
 
 
+#: tenant tracks sit far above any plausible request tid (tid = rid + 1)
+_TENANT_TID_BASE = 1_000_000
+
+
 def chrome_trace(traces=(), timeline: StepTimeline | None = None,
-                 alerts=()) -> dict:
+                 alerts=(), journeys=()) -> dict:
     """Build the ``trace_event`` JSON dict from request traces, the
-    engine step timeline, and/or the watchdog alert history. Pure
-    function of its inputs — safe to call on a live engine between
-    steps."""
+    engine step timeline, the watchdog alert history, and/or the
+    journey book — each tenant with retired journeys gets its own track
+    of retirement instants (state + token count + latency summary), so
+    per-tenant traffic reads alongside the per-request spans. Accepts
+    :class:`~paddle_tpu.obs.journey.Journey` objects or their wire
+    dicts. Pure function of its inputs — safe to call on a live engine
+    between steps."""
     raw: list[dict] = []
     names: dict[int, str] = {_ENGINE_TID: "engine loop"}
     for trace in traces:
         names[trace.rid + 1] = f"request {trace.rid}"
         raw.extend(_request_events(trace))
+    tenant_tids: dict[str, int] = {}
+    for j in journeys:
+        w = j if isinstance(j, dict) else j.to_wire()
+        if w.get("state") is None or w.get("e2e_s") is None:
+            continue  # still in flight: its request track tells the story
+        tid = tenant_tids.get(w["tenant"])
+        if tid is None:
+            tid = _TENANT_TID_BASE + len(tenant_tids)
+            tenant_tids[w["tenant"]] = tid
+            names[tid] = f"tenant {w['tenant']}"
+        retire_t = next((h["t"] for h in reversed(w["hops"])
+                         if h["kind"] == "retire"), None)
+        if retire_t is None:
+            continue
+        raw.append({"name": f"retire:{w['state']}", "ph": "i",
+                    "ts": retire_t, "pid": _PID, "tid": tid, "s": "t",
+                    "cat": "tenant",
+                    "args": {"rid": w["rid"], "tokens": w["tokens"],
+                             "ttft_s": w["ttft_s"], "tpot_s": w["tpot_s"],
+                             "e2e_s": w["e2e_s"]}})
     if timeline is not None:
         for rec in timeline.records():
             args = {"step": rec.step, "batch": rec.batch,
@@ -169,9 +203,9 @@ def chrome_trace(traces=(), timeline: StepTimeline | None = None,
 
 def write_chrome_trace(path, traces=(),
                        timeline: StepTimeline | None = None,
-                       alerts=()) -> dict:
+                       alerts=(), journeys=()) -> dict:
     """Render and write the Perfetto-loadable JSON; returns the dict."""
-    doc = chrome_trace(traces, timeline, alerts)
+    doc = chrome_trace(traces, timeline, alerts, journeys)
     with open(path, "w") as f:
         json.dump(doc, f)
     return doc
@@ -183,11 +217,25 @@ def _fmt(v) -> str:
     return str(int(f)) if f == int(f) else repr(f)
 
 
+def _escape_label(v) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    newline must be escaped inside the quoted value (the exposition
+    format's only three specials)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
 def _label_str(labels: dict) -> str:
-    """``{k="v",k2="v2"}`` — empty string for no labels."""
+    """The one label-set renderer behind every exposition sample:
+    ``{k="v",k2="v2"}`` with the pairs SORTED by key and the values
+    escaped — so a multi-label sample (a histogram-family bucket's
+    merged ``{tenant=, le=}``, a ``{tenant=, class=}`` counter) renders
+    the same valid text regardless of which path assembled the dict.
+    Empty string for no labels."""
     if not labels:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in labels.items()) + "}"
+    return "{" + ",".join(f'{k}="{_escape_label(v)}"'
+                          for k, v in sorted(labels.items())) + "}"
 
 
 def prometheus_text(stats: dict, histograms=(), types: dict | None = None,

@@ -14,39 +14,58 @@ retires FAILED — so every deterministic ``-m faults`` scenario doubles as
 a recorder test — and on demand via ``engine.dump_flight_record(path)``.
 
 ``python -m paddle_tpu.obs --flight-record dump.json`` pretty-prints a
-dump (``--prometheus`` / ``--latency-table`` render its gauge and summary
-sections); :func:`validate_flight_record` is the schema gate both the CLI
-and the tests use.
+dump (``--prometheus`` / ``--latency-table`` / ``--tenant-table`` /
+``--journey RID`` render its gauge, summary, per-tenant, and journey
+sections); :func:`validate_flight_record` is the schema gate both the
+CLI and the tests use — it accepts schema ``v2`` (current: adds the
+per-tenant goodput roll-ups and a bounded ring of wire journeys) AND
+the original ``v1`` (dumps written before the tenant layer existed
+stay readable).
 """
 from __future__ import annotations
 
 import json
 from dataclasses import asdict
 
-__all__ = ["FLIGHT_RECORD_SCHEMA", "build_flight_record",
+from .journey import validate_journey
+
+__all__ = ["FLIGHT_RECORD_SCHEMA", "FLIGHT_RECORD_SCHEMA_V1",
+           "MAX_FLIGHT_JOURNEYS", "build_flight_record",
            "dump_flight_record", "validate_flight_record",
            "format_flight_record"]
 
-FLIGHT_RECORD_SCHEMA = "paddle-tpu/flight-record/v1"
+FLIGHT_RECORD_SCHEMA_V1 = "paddle-tpu/flight-record/v1"
+FLIGHT_RECORD_SCHEMA = "paddle-tpu/flight-record/v2"
+
+#: journeys retained per dump — also the bound callers should apply
+#: BEFORE serializing (JourneyBook.wire_records(limit=...)), so a
+#: failure-path dump is O(kept), not O(every retained journey)
+MAX_FLIGHT_JOURNEYS = 64
 
 #: required top-level keys and their types — the schema contract the
-#: tests pin and the CLI enforces before pretty-printing
+#: tests pin and the CLI enforces before pretty-printing; v2 adds the
+#: per-tenant roll-ups and the journey ring on top of the v1 set
 _SCHEMA_KEYS = (("schema", str), ("reason", str), ("dumped_at", float),
                 ("step", int), ("config", dict), ("steps", list),
                 ("alerts", list), ("gauges", dict), ("programs", dict),
                 ("requests", list))
+_SCHEMA_KEYS_V2 = _SCHEMA_KEYS + (("tenants", dict), ("journeys", list))
 
 
 def build_flight_record(*, reason: str, now: float, step: int,
                         config: dict | None = None, timeline=None,
                         alerts=(), gauges: dict | None = None,
                         programs: dict | None = None, requests=(),
+                        tenants: dict | None = None, journeys=(),
                         max_steps: int = 64,
-                        max_requests: int = 64) -> dict:
-    """Assemble one flight record. ``timeline`` is a
+                        max_requests: int = 64,
+                        max_journeys: int = MAX_FLIGHT_JOURNEYS) -> dict:
+    """Assemble one flight record (schema v2). ``timeline`` is a
     :class:`~paddle_tpu.obs.timeline.StepTimeline` (or None — tracing
     off), ``alerts`` an iterable of :class:`~paddle_tpu.obs.alerts.Alert`
-    (or already-dict entries), ``requests`` latency-summary dicts."""
+    (or already-dict entries), ``requests`` latency-summary dicts,
+    ``tenants`` the :meth:`TenantLedger.rollup` dict, ``journeys`` wire
+    journey dicts (the newest ``max_journeys`` are kept)."""
     steps = timeline.records()[-max_steps:] if timeline is not None else []
     return {
         "schema": FLIGHT_RECORD_SCHEMA,
@@ -60,6 +79,8 @@ def build_flight_record(*, reason: str, now: float, step: int,
         "gauges": dict(gauges or {}),
         "programs": dict(programs or {}),
         "requests": list(requests)[-max_requests:],
+        "tenants": dict(tenants or {}),
+        "journeys": list(journeys)[-max_journeys:],
     }
 
 
@@ -76,11 +97,17 @@ def validate_flight_record(record) -> dict:
     if not isinstance(record, dict):
         raise ValueError(f"flight record must be a dict, got "
                          f"{type(record).__name__}")
-    if record.get("schema") != FLIGHT_RECORD_SCHEMA:
+    schema = record.get("schema")
+    if schema == FLIGHT_RECORD_SCHEMA:
+        keys = _SCHEMA_KEYS_V2
+    elif schema == FLIGHT_RECORD_SCHEMA_V1:
+        keys = _SCHEMA_KEYS  # back-compat: pre-tenant dumps stay readable
+    else:
         raise ValueError(
-            f"unknown flight-record schema {record.get('schema')!r} "
-            f"(expected {FLIGHT_RECORD_SCHEMA!r})")
-    for key, typ in _SCHEMA_KEYS:
+            f"unknown flight-record schema {schema!r} "
+            f"(expected {FLIGHT_RECORD_SCHEMA!r} or "
+            f"{FLIGHT_RECORD_SCHEMA_V1!r})")
+    for key, typ in keys:
         if key not in record:
             raise ValueError(f"flight record missing key {key!r}")
         if typ is float and isinstance(record[key], int):
@@ -99,6 +126,8 @@ def validate_flight_record(record) -> dict:
             if field not in alert:
                 raise ValueError(
                     f"flight-record alert entry missing {field!r}: {alert}")
+    for journey in record.get("journeys", ()):
+        validate_journey(journey)  # each ring entry is itself schema-gated
     return record
 
 
@@ -140,6 +169,15 @@ def format_flight_record(record: dict) -> str:
         for label, p in sorted(record["programs"].items()):
             lines.append(f"  {label:<16} flops/step={p.get('flops', 0):.4g}"
                          f"  peak_hbm={p.get('peak_hbm_bytes', 0)}")
+    tenants = record.get("tenants") or {}
+    if tenants:
+        from .tenant import tenant_table
+
+        lines.append(f"\ntenants ({len(tenants)}):")
+        lines.append(tenant_table(tenants))
+        n_journeys = len(record.get("journeys") or ())
+        lines.append(f"journeys retained: {n_journeys} "
+                     f"(--journey RID prints one)")
     nonzero = {k: v for k, v in sorted(record["gauges"].items())
                if isinstance(v, (int, float)) and v}
     lines.append(f"\nnonzero gauges ({len(nonzero)}):")

@@ -150,6 +150,11 @@ class Tracer:
         self.mark_every = mark_every  # decode_mark cadence, in tokens
         self._traces: OrderedDict[int, RequestTrace] = OrderedDict()
         self.evicted = 0
+        # optional event-stream tap: a callable(rid, name, t, args) every
+        # event ALSO flows through — the journey book subscribes here, so
+        # journeys fold over the exact stream the traces record with zero
+        # new instrumentation sites (and one attribute check when unset)
+        self.journal = None
 
     def begin(self, rid: int) -> RequestTrace:
         """Create the trace for a new request and stamp ``enqueued``.
@@ -165,7 +170,11 @@ class Tracer:
                 self.evicted += 1
         trace = RequestTrace(rid)
         self._traces[rid] = trace
-        trace.add("enqueued", self._clock())
+        t = self._clock()
+        trace.add("enqueued", t)
+        j = self.journal
+        if j is not None:
+            j(rid, "enqueued", t, None)
         return trace
 
     def event(self, rid: int, name: str, **args) -> None:
@@ -174,7 +183,11 @@ class Tracer:
         pressure; dropping a late event beats unbounded retention)."""
         trace = self._traces.get(rid)
         if trace is not None:
-            trace.add(name, self._clock(), args or None)
+            t = self._clock()
+            trace.add(name, t, args or None)
+            j = self.journal
+            if j is not None:
+                j(rid, name, t, args)
 
     def get(self, rid: int) -> RequestTrace | None:
         return self._traces.get(rid)

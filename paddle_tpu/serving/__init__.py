@@ -53,9 +53,17 @@ Observability layer (paddle_tpu.obs, on by default): per-request
 lifecycle traces off the engine clock (``engine.trace(rid)`` — queue
 wait / TTFT / TPOT / e2e summaries), streaming latency histograms with
 ``_p50/_p90/_p99`` gauges in ``ServingMetrics.snapshot()``, a bounded
-per-step timeline, and Chrome-trace/Prometheus exporters
-(``engine.export_chrome_trace()``, ``ServingMetrics.prometheus()``).
+per-step timeline, Chrome-trace/Prometheus exporters
+(``engine.export_chrome_trace()``, ``ServingMetrics.prometheus()``),
+and — the request/tenant grain — wire-exportable request journeys
+(``engine.journey(rid)``) plus per-tenant SLO classes with a
+goodput/badput ledger and an ``slo_burn`` burn-rate watchdog
+(``ServingConfig(tenants={name: TenantSLO(...)})``, observe-only:
+weighted per-tenant admission belongs to the fleet router).
 """
+from ..obs import TenantLedger, TenantSLO  # noqa: F401 — the per-tenant
+# SLO class + ledger live in obs (serving imports obs, never the
+# reverse); re-exported here because ServingConfig(tenants=) takes them
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      prefill_buckets)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
@@ -72,4 +80,4 @@ __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "Request", "Scheduler", "EngineOverloaded", "FaultInjector",
            "InjectedFault", "prefill_buckets", "SLOConfig",
            "SLOController", "HostTier", "HostTierRestoreError",
-           "SpilledPage", "SpecConfig"]
+           "SpilledPage", "SpecConfig", "TenantSLO", "TenantLedger"]

@@ -160,6 +160,23 @@ recorder (``engine.dump_flight_record(path)``; automatic on engine-fatal
 exceptions, the stuck-engine backstop, and every FAILED retirement)
 bundles the newest step records, alerts, gauges, audit roll-ups, and
 latency summaries into one schema-versioned JSON dump.
+
+Per-tenant SLO observability (rides ``enable_tracing``): requests carry
+``add_request(tenant=)``, every retirement is classified by the
+goodput/badput ledger (obs/tenant.py — 7 terminal classes against the
+``ServingConfig(tenants={name: TenantSLO(...)})`` targets, emitted
+tokens accrued per class so the per-tenant totals reconcile exactly
+with ``serving_tokens_total``), and every request accrues a **journey**
+(obs/journey.py — enqueue → admit → chunks → decode/verify → preempt/
+swap → retire hops with engine-step refs, folded off the tracer's own
+event stream), exportable as the schema-versioned
+``paddle-tpu/journey/v1`` wire dict. The ``slo_burn`` watchdog rule
+windows each tenant's violation fraction; the flight record (schema
+v2) grows per-tenant roll-ups + a bounded journey ring; Chrome export
+grows one track per tenant. All of it is host dict work off stamps
+that already existed: zero added device syncs (the SyncTally formula
+is pinned unchanged with tenants + journeys on), and the tenant label
+never enters a traced program.
 """
 from __future__ import annotations
 
@@ -175,10 +192,12 @@ from ..analysis import hlocheck
 from ..analysis.tracecheck import (CompileGuard, DonationViolation,
                                    RetraceError, SyncTally, donation_audit)
 from ..core.tensor import Tensor
-from ..obs import (ALERT_RULES, PhaseAccumulator, RooflineTracker,
-                   StepRecord, StepTimeline, Tracer, Watchdog,
-                   WatchdogConfig, build_flight_record, chrome_trace,
+from ..obs import (ALERT_RULES, JourneyBook, PhaseAccumulator,
+                   RooflineTracker, StepRecord, StepTimeline, TenantLedger,
+                   TenantSLO, Tracer, Watchdog, WatchdogConfig,
+                   build_flight_record, check_tenant_name, chrome_trace,
                    load_banked_kernel_speedups, write_chrome_trace)
+from ..obs.recorder import MAX_FLIGHT_JOURNEYS as _MAX_FLIGHT_JOURNEYS
 from ..obs.recorder import dump_flight_record as _write_flight_record
 from ..text.generation import sample_logits
 from ..utils import monitor
@@ -271,6 +290,19 @@ class ServingConfig:
     # either way.
     flight_record_steps: int = 64  # step records per dump (the newest N
     # of the timeline ring)
+    tenants: dict | None = None  # {name: obs.TenantSLO(ttft_p99_s=,
+    # tpot_p99_s=)} — per-tenant SLO classes (interactive vs batch).
+    # OBSERVE-ONLY this layer: requests carry add_request(tenant=) as a
+    # label, every retirement is classified into the 7-class goodput/
+    # badput ledger (obs/tenant.py) + the per-tenant latency families,
+    # and the slo_burn watchdog windows each tenant's violation
+    # fraction — but admission/scheduling never read the tenant
+    # (weighted admission belongs to the fleet router). Unknown tenants
+    # are served under their own label with no SLO (everything finished
+    # is in_slo); None declares no classes — the implicit "default"
+    # tenant still keeps books. The tenant label never enters a traced
+    # program: compile counts and the sync-free certification are
+    # byte-identical with tenants on.
 
 
 def prefill_buckets(max_prompt_len: int) -> list[int]:
@@ -336,6 +368,14 @@ class ServingEngine:
         if cfg.flight_record_steps < 1:
             raise ValueError(
                 f"flight_record_steps {cfg.flight_record_steps} < 1")
+        for tname, slo in (cfg.tenants or {}).items():
+            # bad names/targets fail here, not at the first retirement
+            check_tenant_name(tname)
+            if not isinstance(slo, TenantSLO):
+                raise ValueError(
+                    f"tenants[{tname!r}] must be an obs.TenantSLO, got "
+                    f"{type(slo).__name__}")
+            slo.validate()
         if cfg.spec is not None:
             # bad method/depth/draft-shape mismatches fail here, not at
             # the first verify trace; a prebuilt draft_model's real
@@ -402,6 +442,15 @@ class ServingEngine:
             self._tracer = Tracer(self.now, capacity=cfg.trace_capacity,
                                   mark_every=cfg.decode_mark_every)
             self._timeline = StepTimeline(cfg.timeline_capacity)
+            # request journeys (obs/journey.py): a pure fold over the
+            # tracer's event stream (the journal tap) + the host step
+            # counter — zero new instrumentation sites, zero syncs
+            self._journeys = JourneyBook(lambda: self._now_step,
+                                         capacity=cfg.trace_capacity)
+            self._tracer.journal = self._journeys.on_event
+            # the per-tenant goodput/badput ledger (obs/tenant.py) —
+            # observe-only, fed once per retirement in _trace_retire
+            self._tenants = TenantLedger(cfg.tenants)
             # goodput attribution (obs/attribution.py): the per-phase
             # wall-time splitter and the measured-vs-predicted roofline
             # tracker — clock reads and host floats only, zero device
@@ -421,6 +470,16 @@ class ServingEngine:
             self._attr = None
             self._roofline = None
             self._watchdog = None
+            self._journeys = None
+            self._tenants = None
+        # the per-tenant metric families are pre-seeded for the declared
+        # tenants + "default" regardless of tracing (the presence
+        # contract); _seeded_tenants makes the known-tenant add_request
+        # path one set lookup
+        tenant_names = ["default"] + sorted(
+            t for t in (cfg.tenants or {}) if t != "default")
+        self.metrics.seed_tenants(tenant_names)
+        self._seeded_tenants = set(tenant_names)
         self.last_flight_record: dict | None = None  # newest auto dump
         self._failed_count = 0   # FAILED retirements ever (auto-dump edge)
         self._failed_dumped = 0
@@ -776,13 +835,30 @@ class ServingEngine:
                      exc=exc_name, signature=signature)
 
     def add_request(self, prompt, max_new_tokens: int,
-                    deadline_s: float | None = None) -> int:
+                    deadline_s: float | None = None,
+                    tenant: str = "default") -> int:
         """Queue a prompt; returns the request id. ``deadline_s`` is a
         wall-clock budget from now — a request still waiting or running when
-        it elapses is retired EXPIRED at the next step boundary. Raises
-        ValueError when the request could never fit (prompt too long for the
-        bucket, the model, or the whole pool) and EngineOverloaded when the
-        bounded waiting queue is full under the reject policy."""
+        it elapses is retired EXPIRED at the next step boundary.
+        ``tenant`` labels the request's SLO/traffic class for the
+        goodput ledger, journey, and per-tenant latency families —
+        observe-only (scheduling never reads it); tenants beyond the
+        declared ``ServingConfig(tenants=)`` set are served under their
+        own label with no SLO targets. Raises ValueError when the
+        request could never fit (prompt too long for the bucket, the
+        model, or the whole pool) or the tenant name is malformed, and
+        EngineOverloaded when the bounded waiting queue is full under
+        the reject policy."""
+        if tenant not in self._seeded_tenants:
+            # first sight of an ad-hoc tenant: validate the name and
+            # seed its families now (declared tenants + "default" were
+            # seeded at construction — this path is one set lookup for
+            # every later request of the same tenant)
+            check_tenant_name(tenant)
+            self.metrics.seed_tenants([tenant])
+            self._seeded_tenants.add(tenant)
+            if self._tenants is not None:
+                self._tenants.ensure(tenant)
         prompt = np.asarray(
             prompt._value if isinstance(prompt, Tensor) else prompt)
         if prompt.ndim != 1:
@@ -805,7 +881,8 @@ class ServingEngine:
         req = Request(prompt=prompt.astype(np.int32),
                       max_new_tokens=int(max_new_tokens),
                       deadline=(self.now() + float(deadline_s)
-                                if deadline_s is not None else None))
+                                if deadline_s is not None else None),
+                      tenant=tenant)
         try:
             shed = self.scheduler.add(req)  # validates against pool capacity
         except EngineOverloaded:
@@ -813,6 +890,9 @@ class ServingEngine:
             raise
         tr = self._tracer
         if tr is not None:
+            # journey first: the tracer's begin() stamps "enqueued",
+            # which the journal tap routes onto the journey just opened
+            self._journeys.begin(req.rid, tenant)
             tr.begin(req.rid)
         if shed is not None:
             self._requests.pop(shed.rid, None)
@@ -853,16 +933,29 @@ class ServingEngine:
         return self._requests.get(rid) or self._retired.get(rid)
 
     def _trace_retire(self, req: Request, state: str) -> None:
-        """Stamp the terminal ``retired`` trace event and feed the
-        request-latency histograms from the completed lifecycle. One
-        attribute check when tracing is off."""
+        """Stamp the terminal ``retired`` trace event, feed the
+        request-latency histograms from the completed lifecycle, and
+        settle the tenant ledger (classify the retirement, accrue the
+        emitted tokens to goodput or badput, feed the per-tenant
+        latency families). One attribute check when tracing is off —
+        host dict work only, zero device syncs."""
         tr = self._tracer
         if tr is not None:
             tr.event(req.rid, "retired", state=state,
                      tokens=len(req.generated))
             trace = tr.get(req.rid)
             if trace is not None:
-                self.metrics.observe_request(trace.summary())
+                summary = trace.summary()
+                self.metrics.observe_request(summary)
+                cls = self._tenants.on_retire(
+                    req.tenant, state, ttft=summary["ttft"],
+                    tpot=summary["tpot"], tokens=req.tokens_emitted)
+                self.metrics.on_tenant_retire(req.tenant, cls,
+                                              req.tokens_emitted)
+                self.metrics.observe_tenant(
+                    req.tenant, ttft=summary["ttft"],
+                    tpot=summary["tpot"],
+                    queue_delay=summary["queue_wait"])
 
     def _retire(self, req: Request, state: str,
                 error: BaseException | None = None) -> None:
@@ -1010,6 +1103,7 @@ class ServingEngine:
         # PT005 polices on the unchunked path)
         tok = int(np.asarray(tok))  # lint: disable=PT005
         req.generated.append(tok)
+        req.tokens_emitted += 1
         slot = req.slot
         self._ctx[slot] = req.prompt_len
         self._last_tok[slot] = tok
@@ -1288,6 +1382,7 @@ class ServingEngine:
             # (a bare int() coercion would sync invisibly to the linter)
             tok = int(np.asarray(tok))  # lint: disable=PT005
             req.generated.append(tok)
+            req.tokens_emitted += 1
             self._ctx[req.slot] = req.prompt_len
             self._last_tok[req.slot] = tok
             self._active[req.slot] = True
@@ -1416,6 +1511,7 @@ class ServingEngine:
                 req = self.scheduler.running[int(slot)]
                 tok = int(toks[slot])
                 req.generated.append(tok)
+                req.tokens_emitted += 1
                 req.fresh = False  # it has decoded: fair game for preemption
                 self._ctx[slot] += 1
                 self._last_tok[slot] = tok
@@ -1522,6 +1618,7 @@ class ServingEngine:
                 # stopping at eos/budget exactly like sequential decode
                 tok = int(tok)
                 req.generated.append(tok)
+                req.tokens_emitted += 1
                 emitted += 1
                 if tr is not None and \
                         len(req.generated) % tr.mark_every == 0:
@@ -1620,6 +1717,10 @@ class ServingEngine:
             "evictions": monitor.stat_get("serving_prefix_evictions", 0),
             "spills": monitor.stat_get(
                 "serving_host_tier_spills_total", 0),
+            # slo_burn: the ledger's per-tenant (violations, retired)
+            # monotonic totals — plain python ints off host dicts
+            "tenant_slo": self._tenants.burn_totals()
+            if self._tenants is not None else {},
         }
 
     def alerts(self) -> list:
@@ -1628,11 +1729,12 @@ class ServingEngine:
         return self._watchdog.alerts() if self._watchdog is not None else []
 
     def flight_record(self, reason: str = "manual") -> dict:
-        """Assemble (but do not write) the black-box flight record: the
-        newest ``flight_record_steps`` step records, the alert history,
-        a full gauge snapshot, the per-program hlocheck audit roll-ups,
-        and the per-request latency summaries — schema-versioned,
-        JSON-ready."""
+        """Assemble (but do not write) the black-box flight record
+        (schema v2): the newest ``flight_record_steps`` step records,
+        the alert history, a full gauge snapshot, the per-program
+        hlocheck audit roll-ups, the per-request latency summaries, the
+        per-tenant goodput roll-ups, and a bounded ring of wire
+        journeys — schema-versioned, JSON-ready."""
         cfg = self.config
         programs = {
             label: {"flops": r.flops, "peak_hbm_bytes": r.peak_bytes,
@@ -1654,6 +1756,12 @@ class ServingEngine:
             timeline=self._timeline, alerts=self.alerts(),
             gauges=self.metrics.snapshot(), programs=programs,
             requests=self.latency_summaries(),
+            tenants=self.tenant_report() or {},
+            # serialize only what the record will keep — a fatal-path
+            # dump must be O(kept journeys), not O(trace_capacity)
+            journeys=self._journeys.wire_records(
+                limit=_MAX_FLIGHT_JOURNEYS)
+            if self._journeys is not None else (),
             max_steps=cfg.flight_record_steps)
 
     def dump_flight_record(self, path, reason: str = "manual") -> dict:
@@ -1804,6 +1912,29 @@ class ServingEngine:
         evicted under the retention bound."""
         return self._tracer.get(rid) if self._tracer is not None else None
 
+    def journey(self, rid: int):
+        """The request's journey (obs.Journey) — hop list with engine-
+        step refs, wire-exportable via ``.to_wire()`` — or None when
+        tracing is off or the journey was evicted under the retention
+        bound (the obs-off contract: None, never a raise)."""
+        return self._journeys.get(rid) if self._journeys is not None \
+            else None
+
+    def journeys(self) -> list:
+        """Every retained journey, oldest first (empty with tracing
+        off)."""
+        return self._journeys.journeys() if self._journeys is not None \
+            else []
+
+    def tenant_report(self) -> dict | None:
+        """The per-tenant goodput roll-up (obs.TenantLedger.rollup
+        merged with the observed per-tenant p99s) — the flight record's
+        ``tenants`` section and the CLI ``--tenant-table`` input. None
+        with tracing off (the obs-off contract)."""
+        if self._tenants is None:
+            return None
+        return self._tenants.rollup(self.metrics.tenant_hists)
+
     def traces(self) -> list:
         """Every retained RequestTrace, oldest first (empty with tracing
         off)."""
@@ -1818,15 +1949,18 @@ class ServingEngine:
     def export_chrome_trace(self, path=None) -> dict:
         """Chrome ``trace_event`` JSON of every retained request trace
         plus the engine step timeline — with per-step counter tracks
-        (pages_in_use / batch / queue_depth) and an instant per watchdog
-        alert — loadable in chrome://tracing and ui.perfetto.dev. Writes
-        to ``path`` when given; returns the document either way
+        (pages_in_use / batch / queue_depth), an instant per watchdog
+        alert, and one track per tenant of retirement instants —
+        loadable in chrome://tracing and ui.perfetto.dev. Writes to
+        ``path`` when given; returns the document either way
         (empty-track document with tracing off)."""
         traces = self.traces()
         alerts = self.alerts()
+        journeys = self.journeys()
         if path is not None:
-            return write_chrome_trace(path, traces, self._timeline, alerts)
-        return chrome_trace(traces, self._timeline, alerts)
+            return write_chrome_trace(path, traces, self._timeline,
+                                      alerts, journeys)
+        return chrome_trace(traces, self._timeline, alerts, journeys)
 
     def result(self, rid: int) -> np.ndarray:
         return self._finished[rid]

@@ -177,16 +177,46 @@ Goodput attribution + watchdogs + flight recorder (PR 12):
                                   rule (retrace_after_warmup /
                                   pallas_fallback /
                                   spec_acceptance_collapse /
-                                  eviction_thrash / queue_stall)
+                                  eviction_thrash / queue_stall /
+                                  slo_burn)
+
+Per-tenant SLO observability (PR 15 — the goodput/badput ledger,
+obs/tenant.py; every family pre-seeded for the declared tenants +
+"default" at engine construction, ad-hoc tenants on first sight):
+
+- serving_tenant_goodput_tokens_total{tenant=}  tokens emitted by
+                                  requests that retired in_slo —
+                                  the tenant's useful work
+- serving_tenant_badput_tokens_total{tenant=}   tokens emitted by every
+                                  other retirement (late / shed /
+                                  expired / cancelled / failed); the
+                                  two families together reconcile
+                                  EXACTLY with serving_tokens_total
+                                  once every request has retired
+- serving_tenant_retired_total{tenant=,class=}  multi-label counter:
+                                  retirements per terminal class
+                                  (in_slo / ttft_late / tpot_late /
+                                  shed / expired / cancelled / failed)
+                                  — the badput breakdown the CLI
+                                  --tenant-table renders
+- serving_ttft_s{tenant=} / serving_tpot_s{tenant=} /
+  serving_queue_delay_s{tenant=}  histogram families: the per-tenant
+                                  latency classes (percentile mirrors +
+                                  real labeled bucket series, like the
+                                  phase family)
 
 Every counter incremented here is pre-seeded in ``_SEEDED`` — lint rule
 PT003 (this module shipped unseeded counters once) enforces it; every
 ``stat_set``/``stat_max`` gauge likewise, per the mirror rule PT008.
-Labeled-family names (``base{label=value}`` registry keys) are declared
-in ``_FAMILIES`` and their label values seeded at engine construction
-via :meth:`ServingMetrics.seed_family` — lint rule PT012 flags any
-labeled stat call whose base is in neither registry (the PT003/PT008
-blind spot for dynamically formatted names).
+Labeled-family names (``base{label=value}`` registry keys — one label,
+or an ORDERED label tuple for multi-label families like
+``tenant_retired_total{tenant=,class=}``) are declared in ``_FAMILIES``
+and their label values seeded at engine construction via
+:meth:`ServingMetrics.seed_family` — lint rule PT012 flags any labeled
+stat call whose base is in neither registry, and (since the multi-label
+extension) any call whose statically visible label keys disagree with
+the declaration — a reordered ``{class=,tenant=}`` write would build a
+registry key the seeding never created.
 """
 from __future__ import annotations
 
@@ -196,6 +226,7 @@ from collections import deque
 from ..obs.attribution import PHASES
 from ..obs.histogram import (LATENCY_EDGES_S, OCCUPANCY_EDGES, QUANTILES,
                              Histogram, HistogramFamily)
+from ..obs.tenant import CLASSES as TENANT_CLASSES
 from ..utils import monitor
 
 PREFIX = "serving_"
@@ -228,11 +259,14 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "page_pool_used", "page_utilization", "mfu", "hbm_bw_util",
            "queue_depth_peak", "page_pool_peak")
 
-# labeled stat families: base name -> label key. Members live in the
-# monitor registry as ``serving_<base>{<label>=<value>}`` keys; label
-# VALUES are seeded at engine construction (seed_family) since most are
-# only known then (prefill bucket labels, registered kernels). Lint rule
-# PT012 checks every statically visible labeled stat call against this
+# labeled stat families: base name -> label key, or an ORDERED tuple of
+# label keys for multi-label families. Members live in the monitor
+# registry as ``serving_<base>{<l1>=<v1>,<l2>=<v2>}`` keys (labels in
+# declared order — seeding and every write site must agree, which the
+# PT012 label-key check enforces); label VALUES are seeded at engine
+# construction (seed_family) since most are only known then (prefill
+# bucket labels, registered kernels, declared tenants). Lint rule PT012
+# checks every statically visible labeled stat call against this
 # registry — the dynamically-formatted-name blind spot of PT003/PT008.
 _FAMILIES = {
     "step_phase_s": "phase",              # histogram family (below)
@@ -241,6 +275,14 @@ _FAMILIES = {
     "kernel_speedup_predicted": "kernel",  # banked kernelcheck contract
     "kernel_speedup_measured": "kernel",   # live composite/kernel ratio
     "kernel_speedup_drift": "kernel",      # measured / predicted
+    "tenant_goodput_tokens_total": "tenant",   # in_slo tokens per tenant
+    "tenant_badput_tokens_total": "tenant",    # everything-else tokens
+    "tenant_retired_total": ("tenant", "class"),  # retirements per
+    # terminal class — the one multi-label family (badput breakdown)
+    "ttft_s": "tenant",                   # histogram family (per-tenant
+    "tpot_s": "tenant",                   # latency classes; the plain
+    "queue_delay_s": "tenant",            # serving_ttft_s etc. hist
+    # keeps the engine-wide view, these children split it by tenant)
 }
 
 # histogram name -> bucket edges; percentile gauges <name>_{p50,p90,p99}
@@ -266,7 +308,11 @@ COUNTER_STATS = frozenset(
         "failed", "swap_outs", "swap_ins", "prefix_hits", "prefix_misses",
         "prefix_tokens_saved", "prefix_cow_copies", "prefix_evictions",
         "hlo_collective_ops", "hlo_host_transfers")) \
-    | frozenset({PREFIX + "alerts_total"})  # labeled counter family base
+    | frozenset({  # labeled counter family bases
+        PREFIX + "alerts_total",
+        PREFIX + "tenant_goodput_tokens_total",
+        PREFIX + "tenant_badput_tokens_total",
+        PREFIX + "tenant_retired_total"})
 
 
 class ServingMetrics:
@@ -279,14 +325,47 @@ class ServingMetrics:
         self.hists = {name: Histogram(PREFIX + name, edges)
                       for name, edges in _HISTOGRAMS}
         # the per-phase step-time histogram family (label-generic: the
-        # same mechanism per-tenant latency classes will reuse)
+        # mechanism the per-tenant latency classes below reuse)
         self.phase_hist = HistogramFamily(
             PREFIX + "step_phase_s", "phase", LATENCY_EDGES_S,
             values=PHASES)
+        # per-tenant latency classes: children of the SAME base names as
+        # the engine-wide hists (plus queue_delay_s), split by tenant —
+        # children are created by seed_tenants / first observation
+        self.tenant_hists = {
+            "ttft_s": HistogramFamily(PREFIX + "ttft_s", "tenant",
+                                      LATENCY_EDGES_S),
+            "tpot_s": HistogramFamily(PREFIX + "tpot_s", "tenant",
+                                      LATENCY_EDGES_S),
+            "queue_delay_s": HistogramFamily(PREFIX + "queue_delay_s",
+                                             "tenant", LATENCY_EDGES_S),
+        }
         # scalar family members seeded so far: base -> ordered values
-        # (seed_family records them so reset() can replay the zeros)
-        self._family_values: dict[str, list[str]] = {}
+        # (str, or a tuple matching a multi-label declaration;
+        # seed_family records them so reset() can replay the zeros)
+        self._family_values: dict[str, list] = {}
         self.reset()
+
+    def _hist_families(self):
+        return (self.phase_hist, *self.tenant_hists.values())
+
+    @staticmethod
+    def _family_key(base: str, value) -> str:
+        """The registry key of one family member: ``base{l=v}`` for a
+        single label, ``base{l1=v1,l2=v2}`` in DECLARED label order for
+        a multi-label family (every write site must render the same
+        order — the PT012 label-key check pins the statically visible
+        ones)."""
+        label = _FAMILIES[base]  # KeyError = undeclared family
+        if isinstance(label, tuple):
+            if not isinstance(value, tuple) or len(value) != len(label):
+                raise ValueError(
+                    f"family {base!r} declares labels {label} — seed "
+                    f"values must be {len(label)}-tuples, got {value!r}")
+            body = ",".join(f"{k}={v}" for k, v in zip(label, value))
+        else:
+            body = f"{label}={value}"
+        return PREFIX + f"{base}{{{body}}}"
 
     def reset(self) -> None:
         for k in list(monitor.stats_with_prefix(PREFIX)):
@@ -295,11 +374,11 @@ class ServingMetrics:
             monitor.stat_set(PREFIX + k, 0)
         for h in self.hists.values():
             h.reset()
-        self.phase_hist.reset()
+        for fam in self._hist_families():
+            fam.reset()
         for base, values in self._family_values.items():
-            label = _FAMILIES[base]
             for v in values:
-                monitor.stat_set(PREFIX + f"{base}{{{label}={v}}}", 0)
+                monitor.stat_set(self._family_key(base, v), 0)
         self._publish_hists()  # seed the percentile gauges at 0
         self._samples.clear()
         self._samples.append((time.perf_counter(), 0.0))
@@ -307,16 +386,33 @@ class ServingMetrics:
     def seed_family(self, base: str, values) -> None:
         """Pre-seed labeled family members at 0 — the presence contract
         ``_SEEDED`` gives scalars, for label values only known at engine
-        construction (prefill buckets, watchdog rules, banked kernels).
-        ``base`` must be declared in ``_FAMILIES`` (the runtime
-        complement of lint rule PT012)."""
-        label = _FAMILIES[base]  # KeyError = undeclared family
+        construction (prefill buckets, watchdog rules, banked kernels,
+        declared tenants). ``base`` must be declared in ``_FAMILIES``
+        (the runtime complement of lint rule PT012); a multi-label base
+        takes value TUPLES in declared label order."""
         seen = self._family_values.setdefault(base, [])
         for v in values:
-            v = str(v)
+            v = tuple(str(x) for x in v) if isinstance(v, tuple) \
+                else str(v)
+            key = self._family_key(base, v)
             if v not in seen:
                 seen.append(v)
-            monitor.stat_set(PREFIX + f"{base}{{{label}={v}}}", 0)
+            monitor.stat_set(key, 0)
+
+    def seed_tenants(self, tenants) -> None:
+        """Pre-seed every per-tenant surface for the given tenant names:
+        the goodput/badput counter families, the (tenant, class)
+        retirement grid, and the three latency histogram-family
+        children — called at engine construction for the declared
+        tenants + "default", and on first sight of an ad-hoc tenant."""
+        tenants = [str(t) for t in tenants]
+        self.seed_family("tenant_goodput_tokens_total", tenants)
+        self.seed_family("tenant_badput_tokens_total", tenants)
+        self.seed_family("tenant_retired_total",
+                         [(t, c) for t in tenants for c in TENANT_CLASSES])
+        for fam in self.tenant_hists.values():
+            for t in tenants:
+                fam.child(t)
 
     # ------------------------------------------------------------- updates
     def on_prefill(self, tokens: int = 0) -> None:
@@ -509,6 +605,35 @@ class ServingMetrics:
         at engine construction)."""
         monitor.stat_add(PREFIX + f"alerts_total{{rule={rule}}}", 1)
 
+    # ------------------------------------------------- per-tenant ledger
+    def on_tenant_retire(self, tenant: str, cls: str, tokens: int) -> None:
+        """One classified retirement from the tenant ledger: bump the
+        (tenant, class) retirement counter and accrue the request's
+        emitted tokens to goodput (``in_slo``) or badput (anything
+        else). Family members are pre-seeded for declared tenants; the
+        engine seeds ad-hoc tenants on first sight."""
+        monitor.stat_add(
+            PREFIX + f"tenant_retired_total{{tenant={tenant},class={cls}}}",
+            1)
+        if cls == "in_slo":
+            monitor.stat_add(
+                PREFIX + f"tenant_goodput_tokens_total{{tenant={tenant}}}",
+                int(tokens))
+        else:
+            monitor.stat_add(
+                PREFIX + f"tenant_badput_tokens_total{{tenant={tenant}}}",
+                int(tokens))
+
+    def observe_tenant(self, tenant: str, ttft, tpot,
+                       queue_delay) -> None:
+        """Feed the per-tenant latency histogram families at one
+        retirement — None fields (milestones the lifecycle never
+        reached) are skipped, the observe_request contract."""
+        for key, v in (("ttft_s", ttft), ("tpot_s", tpot),
+                       ("queue_delay_s", queue_delay)):
+            if v is not None:
+                self.tenant_hists[key].observe(tenant, v)
+
     # ---------------------------------------------------------- histograms
     def observe_request(self, summary: dict) -> None:
         """Feed the request-latency histograms from one trace summary
@@ -529,20 +654,21 @@ class ServingMetrics:
     def _publish_hists(self) -> None:
         """Mirror percentiles + counts into the monitor registry. Called
         lazily from snapshot()/reset(), never on the serving hot path —
-        observation stays O(log buckets)."""
+        observation stays O(log buckets). Family children mirror as
+        ``<base>_<suffix>{<label>=<value>}`` — the phase family and
+        every per-tenant family through the same loop."""
         for name, h in self.hists.items():
             for suffix, q in QUANTILES:
                 monitor.stat_set(f"{PREFIX}{name}_{suffix}",
                                  h.percentile(q))
             monitor.stat_set(f"{PREFIX}{name}_count", h.count)
-        fam = self.phase_hist
-        for value, h in fam.children().items():
-            for suffix, q in QUANTILES:
-                monitor.stat_set(
-                    PREFIX + f"step_phase_s_{suffix}{{phase={value}}}",
-                    h.percentile(q))
-            monitor.stat_set(
-                PREFIX + f"step_phase_s_count{{phase={value}}}", h.count)
+        for fam in self._hist_families():
+            for value, h in fam.children().items():
+                lab = f"{{{fam.label}={value}}}"
+                for suffix, q in QUANTILES:
+                    monitor.stat_set(f"{fam.name}_{suffix}" + lab,
+                                     h.percentile(q))
+                monitor.stat_set(f"{fam.name}_count" + lab, h.count)
 
     # ------------------------------------------------------------ querying
     def snapshot(self) -> dict:
@@ -552,11 +678,23 @@ class ServingMetrics:
     def prometheus(self) -> str:
         """Prometheus text exposition of every serving stat: scalars typed
         counter/gauge (labeled family members rendered with proper
-        sample labels), the obs histograms — including the per-phase
-        family's children — as cumulative bucket series."""
+        sample labels through the sorted/escaped label renderer), the
+        obs histograms — including the per-phase family's children and
+        the per-tenant latency families — as cumulative bucket series.
+        Histograms sharing a base name (the plain ``serving_ttft_s`` and
+        its ``{tenant=}`` children) are emitted adjacent, so the
+        ``# TYPE`` header appears exactly once per family."""
         from ..obs.export import prometheus_text
 
         types = {k: "counter" for k in COUNTER_STATS}
-        hists = list(self.hists.values()) + \
-            list(self.phase_hist.children().values())
+        hists = []
+        for name, h in self.hists.items():
+            hists.append(h)
+            fam = self.tenant_hists.get(name)
+            if fam is not None:  # tenant children ride under the same base
+                hists.extend(fam.children().values())
+        for name, fam in self.tenant_hists.items():
+            if name not in self.hists:  # queue_delay_s: family-only base
+                hists.extend(fam.children().values())
+        hists.extend(self.phase_hist.children().values())
         return prometheus_text(self.snapshot(), hists, types)

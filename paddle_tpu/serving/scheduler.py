@@ -102,6 +102,15 @@ class Request:        # generated dataclass __eq__ chokes on ndarray fields
     # credits the tokens the cache genuinely served.
     resumed_from_swap: bool = False  # set by admit()'s swap-restore path,
     # consumed (cleared) by the engine when it stamps swap_in/resumed
+    tenant: str = "default"  # the request's SLO/traffic class (obs/
+    # tenant.py) — observe-only: admission and scheduling never read it
+    # (weighted per-tenant admission belongs to the fleet router), it
+    # only labels the goodput ledger, journey, and latency families
+    tokens_emitted: int = 0  # tokens this request EVER emitted, incl.
+    # tokens a recompute preemption dropped and replayed — the ledger
+    # accrues this at retirement so per-tenant goodput+badput token
+    # totals reconcile exactly with serving_tokens_total (which also
+    # counts re-emissions); len(generated) is the client-visible count
 
     @property
     def prompt_len(self) -> int:

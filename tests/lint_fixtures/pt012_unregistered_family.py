@@ -1,11 +1,12 @@
-"""PT012 fixture: labeled stat families (``base{label=value}`` names,
-f-string formatted) written without a ``_FAMILIES`` declaration — the
-names PT003/PT008 cannot resolve statically."""
+"""PT012 fixture: labeled stat families (``base{label=value}`` and
+multi-label ``base{a=,b=}`` names, f-string formatted) written without a
+``_FAMILIES`` declaration — or with label keys disagreeing with it —
+the names PT003/PT008 cannot resolve statically."""
 from paddle_tpu.utils import monitor
 
 PREFIX = "serving_"
 _SEEDED = ("good_total",)
-_FAMILIES = {"known_total": "rule"}
+_FAMILIES = {"known_total": "rule", "known_ml_total": ("tenant", "class")}
 
 
 def rogue_fstring(rule):
@@ -36,3 +37,25 @@ def seeded_scalar():
 def suppressed(rule):
     # the same defect, pragma-sanctioned
     monitor.stat_add(PREFIX + f"rogue2_total{{rule={rule}}}", 1)  # lint: disable=PT012
+
+
+def rogue_multilabel(tenant, cls):
+    # multi-label family in neither registry: fires
+    monitor.stat_add(PREFIX + f"rogue_ml_total{{tenant={tenant},class={cls}}}", 1)
+
+
+def registered_multilabel(tenant, cls):
+    # declared with matching ordered keys: clean
+    monitor.stat_add(PREFIX + f"known_ml_total{{tenant={tenant},class={cls}}}", 1)
+
+
+def wrong_key(rule):
+    # the base IS declared — but the written label key disagrees, so the
+    # registry key can never match the seeded member: fires
+    monitor.stat_add(PREFIX + f"known_total{{tenant={rule}}}", 1)
+
+
+def wrong_order(tenant, cls):
+    # declared keys in the wrong ORDER build a different registry key
+    # than seed_family created: fires
+    monitor.stat_add(PREFIX + f"known_ml_total{{class={cls},tenant={tenant}}}", 1)

@@ -370,8 +370,9 @@ _FIXTURE_CASES = {
     "pt011_uncertified_pallas.py": ("kernels/pt011.py",
                                     {7: "PT011", 11: "PT011"}),
     "pt012_unregistered_family.py": ("pt012.py",
-                                     {13: "PT012", 18: "PT012",
-                                      23: "PT012"}),
+                                     {14: "PT012", 19: "PT012",
+                                      24: "PT012", 44: "PT012",
+                                      55: "PT012", 61: "PT012"}),
 }
 
 
@@ -539,6 +540,34 @@ def test_self_lint_catches_unregistered_stat_family():
     assert not any(f.rule in ("PT003", "PT008", "PT012")
                    for f in lint_source(
                        src, "paddle_tpu/serving/metrics.py"))
+
+
+def test_self_lint_catches_unregistered_multilabel_family():
+    """Deliberately strip the multi-label tenant_retired_total family
+    from metrics._FAMILIES: PT012 must fire at the on_tenant_retire
+    stat_add — the ``base{tenant=,class=}`` shape must not dodge the
+    registry — and reordering the write's label keys against the
+    declaration must fire the key-mismatch arm (keys are part of the
+    registry key the seeding created)."""
+    path = REPO / "paddle_tpu" / "serving" / "metrics.py"
+    src = path.read_text()
+    marker = '"tenant_retired_total": ("tenant", "class"),'
+    bad = "\n".join(line for line in src.splitlines()
+                    if marker not in line)
+    assert bad != src, "metrics.py no longer declares the tenant grid"
+    findings = lint_source(bad, "paddle_tpu/serving/metrics.py")
+    assert any(f.rule == "PT012" and "tenant_retired_total" in f.message
+               for f in findings)
+    # a write whose label ORDER disagrees with the declaration fires too
+    swapped = src.replace(
+        "tenant_retired_total{{tenant={tenant},class={cls}}}",
+        "tenant_retired_total{{class={cls},tenant={tenant}}}")
+    assert swapped != src
+    findings = lint_source(swapped, "paddle_tpu/serving/metrics.py")
+    assert any(f.rule == "PT012" and "label keys" in f.message
+               for f in findings)
+    assert not any(f.rule == "PT012" for f in lint_source(
+        src, "paddle_tpu/serving/metrics.py"))
 
 
 def test_self_lint_catches_reintroduced_wall_clock():

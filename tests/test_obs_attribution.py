@@ -130,9 +130,10 @@ def test_phase_family_histograms_fed_and_pre_seeded(model):
     snap = engine.metrics.snapshot()
     assert snap["serving_step_phase_s_count{phase=decode}"] > 0
     assert snap["serving_step_phase_s_p99{phase=decode}"] > 0
-    # prometheus renders the family as real labeled bucket series
+    # prometheus renders the family as real labeled bucket series (the
+    # label-set renderer emits sorted k="v" pairs: le before phase)
     prom = engine.metrics.prometheus()
-    assert '_bucket{phase="decode",le="' in prom
+    assert '_bucket{le="' in prom and ',phase="decode"}' in prom
     assert "# TYPE serving_step_phase_s histogram" in prom
 
 

@@ -1,0 +1,665 @@
+"""Per-tenant SLO observability: request journeys, the goodput/badput
+ledger, and the slo_burn burn-rate watchdog.
+
+Five layers of coverage:
+
+- journey exactness: the hop sequence (enqueue → admit → chunks →
+  decode/verify → preempt/swap → retire) with engine-step refs on a
+  virtual clock, across the swap + recompute preemption paths and the
+  retire-before-admit terminals (shed/expired/cancelled), plus the wire
+  round-trip through ``validate_journey`` and the hop-cap bound.
+- ledger classification: all 7 terminal classes deterministically, and
+  the acceptance pin — per-tenant goodput + badput token totals
+  reconcile EXACTLY with ``serving_tokens_total`` once every request
+  has retired (recompute-replayed tokens counted on both sides).
+- slo_burn: fires exactly once per onset (unit-level synthetic feeds
+  and a live engine with an unmeetable target), re-arms on a healthy
+  window, and never fires on a clean run.
+- invariants: the SyncTally certification formula (decode_steps +
+  prefills) and ``compile_counts`` are byte-identical with tenants +
+  journeys + watchdogs ON, and outputs are bit-identical tenants-on vs
+  off; obs-off surfaces return None rather than raising.
+- surfaces: families pre-seeded (incl. the multi-label retirement
+  grid), the sorted/escaped Prometheus label renderer scrape-parses on
+  the live and dump paths, flight-record v2 validates with v1
+  back-compat, Chrome tenant tracks, CLI exit codes.
+
+Everything runs on a virtual clock — sleep-free, deterministic.
+"""
+import json
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import SyncTally
+from paddle_tpu.obs import (FLIGHT_RECORD_SCHEMA, FLIGHT_RECORD_SCHEMA_V1,
+                            JOURNEY_SCHEMA, JourneyBook, TenantLedger,
+                            TenantSLO, Watchdog, WatchdogConfig,
+                            prometheus_text, tenant_table,
+                            validate_flight_record, validate_journey)
+from paddle_tpu.obs.__main__ import main as obs_main
+from paddle_tpu.obs.tenant import CLASSES, check_tenant_name
+from paddle_tpu.obs.timeline import StepRecord
+from paddle_tpu.serving import (FaultInjector, ServingConfig, ServingEngine,
+                                SpecConfig)
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.journey
+
+
+class VirtualClock:
+    """Integer-stepped fake engine clock: 1.0 s per read, so latency
+    fields are EXACT float arithmetic."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(37)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=48, dropout=0.0))
+    m.eval()
+    return m
+
+
+def _engine(model, clock=None, fault_injector=None, **overrides):
+    kw = dict(max_batch=2, num_pages=20, page_size=4, max_prompt_len=8)
+    kw.update(overrides)
+    return ServingEngine(model, ServingConfig(**kw),
+                         clock=clock or VirtualClock(),
+                         fault_injector=fault_injector)
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(0, 97, (n,)).astype(np.int32)
+
+
+def _kinds(journey):
+    return [h["kind"] for h in journey.hops]
+
+
+# ---------------------------------------------------------------- journeys
+def test_journey_golden_chunked_prefill(model):
+    engine = _engine(model, chunk_size=2)
+    rid = engine.add_request(_prompt(5), 3, tenant="interactive")
+    engine.run()
+    j = engine.journey(rid)
+    assert j.tenant == "interactive" and j.state == "finished"
+    # 5 prompt tokens at chunk_size=2: chunks of 2, 2, 1 — one per step
+    assert _kinds(j) == ["enqueue", "admit", "prefill_start",
+                         "prefill_chunk", "prefill_chunk", "prefill_chunk",
+                         "prefill_end", "first_token", "retire"]
+    chunks = [h for h in j.hops if h["kind"] == "prefill_chunk"]
+    assert [c["tokens"] for c in chunks] == [2, 2, 1]
+    assert [c["final"] for c in chunks] == [False, False, True]
+    assert [c["start"] for c in chunks] == [0, 2, 4]
+    # step refs: one chunk per engine step, consecutive
+    steps = [c["step"] for c in chunks]
+    assert steps == [steps[0], steps[0] + 1, steps[0] + 2]
+    # hop timestamps are the engine clock, monotonic
+    ts = [h["t"] for h in j.hops]
+    assert ts == sorted(ts)
+    w = validate_journey(j.to_wire())
+    assert w["tokens"] == 3 and w["tpot_s"] is not None
+    assert w["queue_delay_s"] == j.admitted_t - j.enqueued_t
+    assert w["ttft_s"] == j.first_token_t - j.enqueued_t
+
+
+def test_journey_swap_preemption_path(model):
+    inj = FaultInjector().arm("pool_exhausted", step=2)
+    engine = _engine(model, preemption_mode="swap", fault_injector=inj,
+                     max_batch=2)
+    rids = [engine.add_request(_prompt(5, seed=i), 6) for i in range(2)]
+    engine.run()
+    victims = [engine.journey(r) for r in rids]
+    swapped = next(j for j in victims if j.preemptions)
+    kinds = _kinds(swapped)
+    # the swap round trip is visible with its step refs: preempt +
+    # swap_out at the eviction step, swap_in + resume at re-admission
+    for kind in ("preempt", "swap_out", "swap_in", "resume"):
+        assert kind in kinds, (kind, kinds)
+    out_hop = next(h for h in swapped.hops if h["kind"] == "swap_out")
+    in_hop = next(h for h in swapped.hops if h["kind"] == "swap_in")
+    assert in_hop["step"] > out_hop["step"]
+    assert out_hop["pages"] > 0
+    assert swapped.state == "finished"
+    validate_journey(swapped.to_wire())
+
+
+def test_journey_recompute_preemption_path(model):
+    inj = FaultInjector().arm("pool_exhausted", step=2)
+    engine = _engine(model, fault_injector=inj, max_batch=2)
+    rids = [engine.add_request(_prompt(5, seed=i), 6) for i in range(2)]
+    engine.run()
+    victim = next(j for j in (engine.journey(r) for r in rids)
+                  if j.preemptions)
+    kinds = _kinds(victim)
+    # recompute replays from prefill: a second prefill_start after the
+    # preempt hop, no swap hops anywhere
+    assert kinds.count("prefill_start") == 2
+    assert "swap_out" not in kinds and "swap_in" not in kinds
+    assert kinds.index("preempt") < len(kinds) - 1 - \
+        kinds[::-1].index("prefill_start")
+    w = victim.to_wire()
+    assert w["preemptions"] == 1
+    validate_journey(w)
+
+
+def test_journeys_for_retire_before_admit_terminals(model):
+    engine = _engine(model, max_waiting=1, shed_policy="shed-oldest")
+    engine.admit_paused = True
+    r_shed = engine.add_request(_prompt(4, seed=0), 4)
+    r_kept = engine.add_request(_prompt(4, seed=1), 4)  # sheds r_shed
+    r_cancel = None
+    engine.cancel(r_kept)
+    r_cancel = r_kept
+    # an already-expired deadline retires at the next step boundary
+    engine.admit_paused = False
+    r_expired = engine.add_request(_prompt(4, seed=2), 4, deadline_s=0.0)
+    engine.step()
+    for rid, state in ((r_shed, "shed"), (r_cancel, "cancelled"),
+                       (r_expired, "expired")):
+        j = engine.journey(rid)
+        assert j.state == state, (rid, state, j)
+        w = validate_journey(j.to_wire())
+        # never admitted: no admit hop, no queue delay, no TTFT
+        assert "admit" not in _kinds(j)
+        assert w["queue_delay_s"] is None and w["ttft_s"] is None
+        assert w["tpot_s"] is None and w["tokens"] == 0
+        assert w["e2e_s"] is not None  # enqueue -> retire is real
+
+
+def test_journey_verify_hops_carry_accepted_counts(model):
+    engine = _engine(model, max_prompt_len=16, num_pages=24,
+                     spec=SpecConfig(method="ngram", depth=2))
+    prompt = np.array([5, 6, 7, 5, 6, 7, 5, 6], np.int32)
+    rid = engine.add_request(prompt, 6)
+    engine.run()
+    j = engine.journey(rid)
+    verifies = [h for h in j.hops if h["kind"] == "verify"]
+    assert verifies, _kinds(j)
+    assert all(v["proposed"] == 2 and 0 <= v["accepted"] <= 2
+               for v in verifies)
+    # verify hops ride the decode steps: strictly increasing step refs
+    steps = [v["step"] for v in verifies]
+    assert steps == sorted(steps) and len(set(steps)) == len(steps)
+    validate_journey(j.to_wire())
+
+
+def test_journey_wire_roundtrip_and_schema_gate(model):
+    engine = _engine(model)
+    rid = engine.add_request(_prompt(5), 4)
+    engine.run()
+    w = engine.journey(rid).to_wire()
+    assert w["schema"] == JOURNEY_SCHEMA
+    loaded = json.loads(json.dumps(w))
+    assert validate_journey(loaded) == loaded and loaded == w
+    with pytest.raises(ValueError, match="schema"):
+        validate_journey(dict(w, schema="nope"))
+    with pytest.raises(ValueError, match="missing key"):
+        validate_journey({k: v for k, v in w.items() if k != "hops"})
+    with pytest.raises(ValueError, match="hop kind"):
+        validate_journey(dict(w, hops=[{"kind": "warp", "step": 0,
+                                        "t": 0.0}]))
+    with pytest.raises(ValueError, match="dict"):
+        validate_journey([w])
+
+
+def test_journey_hop_cap_bounds_but_keeps_retire():
+    book = JourneyBook(lambda: 0, max_hops=8)
+    book.begin(1, "default")
+    book.on_event(1, "enqueued", 0.0, None)
+    for i in range(20):
+        book.on_event(1, "decode_mark", float(i), {"tokens": i})
+    book.on_event(1, "retired", 21.0, {"state": "finished", "tokens": 20})
+    j = book.get(1)
+    assert len(j.hops) == 9  # 8 capped + the always-kept retire
+    assert j.hops[-1]["kind"] == "retire"
+    assert j.dropped_hops == 13
+    w = validate_journey(j.to_wire())
+    assert w["dropped_hops"] == 13
+
+
+def test_journey_book_evicts_oldest_terminal_only():
+    book = JourneyBook(lambda: 0, capacity=2)
+    for rid in (1, 2):
+        book.begin(rid, "default")
+        book.on_event(rid, "retired", 1.0, {"state": "finished",
+                                            "tokens": 0})
+    book.begin(3, "default")  # at capacity: evicts rid 1 (terminal)
+    assert book.get(1) is None and book.get(2) is not None
+    assert book.evicted == 1
+
+
+# ------------------------------------------------------------------ ledger
+def test_ledger_classification_goldens():
+    slo = TenantSLO(ttft_p99_s=1.0, tpot_p99_s=0.1)
+    led = TenantLedger({"t": slo})
+    assert led.classify("t", "finished", ttft=0.5, tpot=0.05) == "in_slo"
+    assert led.classify("t", "finished", ttft=2.0, tpot=0.05) == "ttft_late"
+    assert led.classify("t", "finished", ttft=0.5, tpot=0.5) == "tpot_late"
+    for state in ("shed", "expired", "cancelled", "failed"):
+        assert led.classify("t", state, ttft=None, tpot=None) == state
+    # no declared SLO (incl. the implicit default tenant): finished is
+    # in_slo regardless of latency
+    assert led.classify("default", "finished", ttft=9e9, tpot=9e9) \
+        == "in_slo"
+    with pytest.raises(ValueError, match="unknown terminal state"):
+        led.classify("t", "vaporized", None, None)
+    # accrual: one class per retirement, tokens land exactly once
+    led.on_retire("t", "finished", ttft=0.5, tpot=0.05, tokens=10)
+    led.on_retire("t", "finished", ttft=2.0, tpot=0.05, tokens=4)
+    led.on_retire("t", "cancelled", ttft=None, tpot=None, tokens=3)
+    tokens = led.token_totals()["t"]
+    assert tokens["in_slo"] == 10 and tokens["ttft_late"] == 4
+    assert tokens["cancelled"] == 3
+    assert led.burn_totals()["t"] == (1, 3)  # cancelled isn't a violation
+
+
+def test_engine_ledger_tokens_reconcile_exactly(model):
+    # the acceptance pin: goodput + badput tokens across every tenant ==
+    # serving_tokens_total, with a recompute preemption in the mix (the
+    # replayed tokens are counted by BOTH sides) and cancelled/failed/
+    # expired retirements contributing their emitted spans to badput
+    inj = FaultInjector().arm("pool_exhausted", step=2) \
+        .arm("decode_fail", step=5)
+    engine = _engine(model, fault_injector=inj, max_batch=2,
+                     tenants={"interactive": TenantSLO(1e6, 1e6)})
+    rids = [engine.add_request(_prompt(5, seed=i), 6,
+                               tenant="interactive" if i % 2 else "default")
+            for i in range(3)]
+    engine.run()
+    states = {engine.status(r) for r in rids}
+    assert states == {"finished", "failed"}, states
+    for r in rids:  # every terminal state exports a validate-clean dict
+        w = validate_journey(engine.journey(r).to_wire())
+        assert w["state"] == engine.status(r)
+    snap = engine.metrics.snapshot()
+    ledger_total = sum(sum(book.values())
+                       for book in engine._tenants.token_totals().values())
+    assert ledger_total == snap["serving_tokens_total"], \
+        (engine._tenants.token_totals(), snap["serving_tokens_total"])
+    good = sum(v for k, v in snap.items()
+               if k.startswith("serving_tenant_goodput_tokens_total"))
+    bad = sum(v for k, v in snap.items()
+              if k.startswith("serving_tenant_badput_tokens_total"))
+    assert good + bad == snap["serving_tokens_total"]
+    # the retirement grid counts every request exactly once
+    retired = sum(v for k, v in snap.items()
+                  if k.startswith("serving_tenant_retired_total"))
+    assert retired == len(rids)
+
+
+def test_engine_ttft_and_tpot_late_classes(model):
+    clock = VirtualClock()
+    engine = _engine(model, clock=clock, tenants={
+        "tight_ttft": TenantSLO(ttft_p99_s=1e-9, tpot_p99_s=1e6),
+        "tight_tpot": TenantSLO(ttft_p99_s=1e6, tpot_p99_s=1e-9)})
+    r1 = engine.add_request(_prompt(5, seed=0), 4, tenant="tight_ttft")
+    r2 = engine.add_request(_prompt(5, seed=1), 4, tenant="tight_tpot")
+    engine.run()
+    snap = engine.metrics.snapshot()
+    assert snap["serving_tenant_retired_total"
+                "{tenant=tight_ttft,class=ttft_late}"] == 1
+    assert snap["serving_tenant_retired_total"
+                "{tenant=tight_tpot,class=tpot_late}"] == 1
+    # all their tokens are badput, none goodput
+    assert snap["serving_tenant_goodput_tokens_total"
+                "{tenant=tight_ttft}"] == 0
+    assert snap["serving_tenant_badput_tokens_total"
+                "{tenant=tight_ttft}"] == 4
+    # the per-tenant latency families saw the observations
+    assert snap["serving_ttft_s_count{tenant=tight_ttft}"] == 1
+    assert snap["serving_tpot_s_count{tenant=tight_tpot}"] == 1
+    assert snap["serving_queue_delay_s_count{tenant=tight_ttft}"] == 1
+    assert engine.journey(r1).state == "finished"
+    assert engine.journey(r2).state == "finished"
+
+
+# ---------------------------------------------------------------- slo_burn
+def _record(step, queue_depth=0):
+    return StepRecord(step=step, t_start=float(step), t_end=step + 1.0,
+                      admitted=0, prefills=0, batch=0, finished=0,
+                      preemptions=0, queue_depth=queue_depth,
+                      pages_in_use=0)
+
+
+def test_slo_burn_fires_once_per_onset_and_rearms():
+    cfg = WatchdogConfig(slo_burn_window_steps=4, slo_burn_threshold=0.5,
+                         slo_burn_min_retired=2)
+    wd = Watchdog(cfg)
+    feed = lambda step, v, r: wd.on_step(  # noqa: E731
+        _record(step), {"tenant_slo": {"batch": (v, r)}})
+    assert feed(0, 0, 1) == []          # below min_retired
+    fired = feed(1, 2, 3)               # 2/3 violations >= 0.5: onset
+    assert [a.rule for a in fired] == ["slo_burn"]
+    assert fired[0].data["tenant"] == "batch"
+    assert feed(2, 3, 4) == []          # still burning: latched, quiet
+    # a healthy stretch re-arms (fraction in the window drops below the
+    # threshold), then a second onset fires again
+    assert feed(3, 3, 8) == []
+    assert feed(4, 3, 12) == []
+    assert feed(5, 3, 16) == []         # window now all-healthy deltas
+    fired = feed(6, 15, 24)             # 12/20 in-window: second onset
+    assert [a.rule for a in fired] == ["slo_burn"]
+    assert wd.fired_total["slo_burn"] == 2
+    # per-tenant isolation: a second tenant's burn is its own onset
+    # (batch stays latched and quiet)
+    fired = wd.on_step(_record(7), {"tenant_slo": {
+        "batch": (15, 24), "vip": (4, 4)}})
+    assert [(a.rule, a.data["tenant"]) for a in fired] == [("slo_burn",
+                                                           "vip")]
+
+
+def test_slo_burn_rearms_for_sparse_tenants():
+    # the latch must not be held forever by a tenant whose healthy
+    # traffic is too sparse to reach min_retired per window: a FULL
+    # zero-violation window re-arms, and a later burn fires again
+    cfg = WatchdogConfig(slo_burn_window_steps=3, slo_burn_threshold=0.5,
+                         slo_burn_min_retired=4)
+    wd = Watchdog(cfg)
+    feed = lambda step, v, r: wd.on_step(  # noqa: E731
+        _record(step), {"tenant_slo": {"t": (v, r)}})
+    assert [a.rule for a in feed(0, 4, 4)] == ["slo_burn"]  # onset
+    assert feed(1, 4, 5) == []  # sparse, still violations in window
+    assert feed(2, 4, 5) == []
+    assert feed(3, 4, 6) == []  # window now full + zero violations:
+    fired = feed(4, 8, 10)      # re-armed, second burn fires
+    assert [a.rule for a in fired] == ["slo_burn"]
+    assert wd.fired_total["slo_burn"] == 2
+
+
+def test_engine_slo_burn_fires_once_and_stamps_instant(model):
+    engine = _engine(
+        model, max_batch=2,
+        tenants={"victim": TenantSLO(ttft_p99_s=1e-9, tpot_p99_s=1e-9)},
+        watchdog=WatchdogConfig(slo_burn_window_steps=16,
+                                slo_burn_min_retired=4))
+    for i in range(6):
+        engine.add_request(_prompt(4, seed=i), 2, tenant="victim")
+    engine.run()
+    alerts = engine.alerts()
+    assert [a.rule for a in alerts] == ["slo_burn"]  # exactly once
+    assert alerts[0].data["tenant"] == "victim"
+    snap = engine.metrics.snapshot()
+    assert snap["serving_alerts_total{rule=slo_burn}"] == 1
+    doc = engine.export_chrome_trace()
+    instants = [e for e in doc["traceEvents"]
+                if e["ph"] == "i" and e["name"] == "alert:slo_burn"]
+    assert len(instants) == 1 and instants[0]["s"] == "g"
+
+
+def test_clean_run_fires_no_slo_burn(model):
+    engine = _engine(model, tenants={
+        "interactive": TenantSLO(ttft_p99_s=1e6, tpot_p99_s=1e6)})
+    for i in range(4):
+        engine.add_request(_prompt(4, seed=i), 4, tenant="interactive")
+    engine.run()
+    assert engine.alerts() == []
+    snap = engine.metrics.snapshot()
+    assert all(v == 0 for k, v in snap.items()
+               if k.startswith("serving_alerts_total"))
+
+
+def test_slo_burn_config_validation():
+    with pytest.raises(ValueError, match="slo_burn_threshold"):
+        Watchdog(WatchdogConfig(slo_burn_threshold=1.5))
+    with pytest.raises(ValueError, match="slo_burn_min_retired"):
+        Watchdog(WatchdogConfig(slo_burn_min_retired=0))
+
+
+# -------------------------------------------------------------- invariants
+def test_sync_free_and_compile_counts_with_tenants_and_journeys_on(model):
+    # the acceptance pin: the SyncTally certification formula (one token
+    # fetch per decode step + one per completed prefill) and the
+    # compile counts are UNCHANGED with tenants + journeys + the
+    # burn-rate watchdog ON — the tenant label never enters a traced
+    # program
+    engine = _engine(model, tenants={
+        "interactive": TenantSLO(ttft_p99_s=1e6, tpot_p99_s=1e6)})
+    assert engine.config.enable_tracing and engine.config.enable_watchdogs
+    for i in range(3):
+        engine.add_request(_prompt(4, seed=i), 4,
+                           tenant="interactive" if i % 2 else "default")
+    with SyncTally() as tally:
+        engine.run()
+    snap = engine.metrics.snapshot()
+    fetches = int(snap["serving_decode_steps"]
+                  + snap["serving_prefills_total"])
+    assert tally.count == fetches, (tally.events, fetches)
+    assert engine.compile_counts == {"prefill": 1, "decode": 1}
+    assert len(engine.journeys()) == 3  # journeys really on
+
+
+def test_outputs_bit_identical_tenants_on_vs_off(model):
+    prompts = [_prompt(5, seed=i) for i in range(3)]
+
+    def run(tenants, tags):
+        engine = _engine(model, tenants=tenants)
+        rids = [engine.add_request(p, 5, tenant=t)
+                for p, t in zip(prompts, tags)]
+        outs = engine.run()
+        return [outs[r] for r in rids], engine.compile_counts
+
+    base, cc_off = run(None, ["default"] * 3)
+    tagged, cc_on = run({"interactive": TenantSLO(1e6, 1e6),
+                         "batch": TenantSLO(1e6, 1e6)},
+                        ["interactive", "batch", "interactive"])
+    for a, b in zip(base, tagged):
+        assert np.array_equal(a, b)
+    assert cc_on == cc_off
+
+
+def test_obs_off_tenant_and_journey_surfaces_return_none(model):
+    engine = _engine(model, enable_tracing=False,
+                     tenants={"interactive": TenantSLO(1e6, 1e6)})
+    rid = engine.add_request(_prompt(5), 4, tenant="interactive")
+    engine.run()
+    # the obs-off contract: None / empty, never a raise
+    assert engine.journey(rid) is None
+    assert engine.journeys() == []
+    assert engine.tenant_report() is None
+    assert engine._journeys is None and engine._tenants is None
+    rec = engine.flight_record()
+    assert rec["tenants"] == {} and rec["journeys"] == []
+    validate_flight_record(rec)
+
+
+def test_tenant_validation_and_adhoc_seeding(model):
+    with pytest.raises(ValueError, match="tenant name"):
+        _engine(model, tenants={"bad{name": TenantSLO(1.0, 1.0)})
+    with pytest.raises(ValueError, match="TenantSLO"):
+        _engine(model, tenants={"ok": (1.0, 1.0)})
+    with pytest.raises(ValueError, match="ttft_p99_s"):
+        _engine(model, tenants={"ok": TenantSLO(-1.0, 1.0)})
+    engine = _engine(model)
+    with pytest.raises(ValueError, match="tenant name"):
+        engine.add_request(_prompt(4), 4, tenant="a,b")
+    with pytest.raises(ValueError, match="tenant name"):
+        check_tenant_name("")
+    # an ad-hoc (undeclared) tenant seeds its families on first sight
+    snap = engine.metrics.snapshot()
+    assert "serving_tenant_goodput_tokens_total{tenant=adhoc}" not in snap
+    engine.add_request(_prompt(4), 4, tenant="adhoc")
+    snap = engine.metrics.snapshot()
+    assert snap["serving_tenant_goodput_tokens_total{tenant=adhoc}"] == 0
+    assert snap["serving_tenant_retired_total"
+                "{tenant=adhoc,class=failed}"] == 0
+
+
+def test_tenant_families_pre_seeded_at_construction(model):
+    engine = _engine(model, tenants={
+        "interactive": TenantSLO(1e6, 1e6), "batch": TenantSLO(1e6, 1e6)})
+    snap = engine.metrics.snapshot()
+    for t in ("default", "interactive", "batch"):
+        assert snap[f"serving_tenant_goodput_tokens_total{{tenant={t}}}"] \
+            == 0
+        assert snap[f"serving_tenant_badput_tokens_total{{tenant={t}}}"] \
+            == 0
+        for cls in CLASSES:
+            assert snap[f"serving_tenant_retired_total"
+                        f"{{tenant={t},class={cls}}}"] == 0
+        for hist in ("ttft_s", "tpot_s", "queue_delay_s"):
+            assert snap[f"serving_{hist}_count{{tenant={t}}}"] == 0
+            assert snap[f"serving_{hist}_p99{{tenant={t}}}"] == 0
+
+
+# ------------------------------------------------------ exposition + wire
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'                    # metric name
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"'  # first label
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*")*\})?'
+    r' -?[0-9.e+Inf]+$')
+
+
+def _scrape_parse(text):
+    """A strict mini scrape parser: every non-comment line must match
+    the exposition sample grammar, label keys must be sorted, and each
+    # TYPE must appear at most once per metric name."""
+    typed = {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE"):
+            _, _, name, typ = ln.split()
+            assert name not in typed, f"duplicate TYPE for {name}"
+            typed[name] = typ
+            continue
+        assert _SAMPLE_RE.match(ln), f"unparseable sample line: {ln!r}"
+        if "{" in ln:
+            keys = re.findall(r'[{,]([a-zA-Z_][a-zA-Z0-9_]*)="', ln)
+            assert keys == sorted(keys), f"unsorted labels: {ln!r}"
+    return typed
+
+
+def test_prometheus_multilabel_scrape_parses_live_and_dump(model,
+                                                           tmp_path):
+    engine = _engine(model, tenants={"batch": TenantSLO(1e6, 1e6)})
+    engine.add_request(_prompt(5), 4, tenant="batch")
+    engine.run()
+    # live path: the full exposition incl. tenant family buckets
+    text = engine.metrics.prometheus()
+    typed = _scrape_parse(text)
+    assert typed["serving_tenant_goodput_tokens_total"] == "counter"
+    assert typed["serving_tenant_retired_total"] == "counter"
+    assert typed["serving_ttft_s"] == "histogram"
+    assert 'serving_ttft_s_bucket{le="+Inf",tenant="batch"}' in text
+    assert 'serving_queue_delay_s_bucket{le="+Inf",tenant="batch"}' \
+        in text
+    assert 'serving_tenant_retired_total{class="in_slo",tenant="batch"}' \
+        " 1" in text
+    # dump path: same renderer over the flight record's gauges
+    dump = tmp_path / "dump.json"
+    engine.dump_flight_record(dump)
+    assert obs_main(["--flight-record", str(dump), "--prometheus"]) == 0
+
+
+def test_label_values_escaped_in_exposition():
+    text = prometheus_text({'weird{path=a"b\\c}': 1.0})
+    assert 'weird{path="a\\"b\\\\c"} 1' in text
+
+
+def test_flight_record_v2_with_v1_backcompat(model, tmp_path):
+    engine = _engine(model, tenants={"batch": TenantSLO(1e6, 1e6)})
+    engine.add_request(_prompt(5), 4, tenant="batch")
+    engine.run()
+    rec = engine.flight_record()
+    assert rec["schema"] == FLIGHT_RECORD_SCHEMA
+    validate_flight_record(rec)
+    assert rec["tenants"]["batch"]["goodput_tokens"] == 4
+    assert rec["tenants"]["batch"]["slo"] == {"ttft_p99_s": 1e6,
+                                              "tpot_p99_s": 1e6}
+    assert [validate_journey(j) for j in rec["journeys"]]
+    # json round trip stays valid
+    validate_flight_record(json.loads(json.dumps(rec)))
+    # v1 dumps (no tenant/journey sections) stay readable
+    v1 = {k: v for k, v in rec.items() if k not in ("tenants", "journeys")}
+    v1["schema"] = FLIGHT_RECORD_SCHEMA_V1
+    validate_flight_record(v1)
+    # ... but a v2 record missing its sections does not
+    broken = dict(rec)
+    del broken["journeys"]
+    with pytest.raises(ValueError, match="journeys"):
+        validate_flight_record(broken)
+    # and a corrupt journey inside the ring is named
+    bad = dict(rec, journeys=[{"schema": "nope"}])
+    with pytest.raises(ValueError, match="journey schema"):
+        validate_flight_record(bad)
+
+
+def test_chrome_export_grows_tenant_tracks(model):
+    engine = _engine(model, tenants={"batch": TenantSLO(1e6, 1e6)})
+    engine.add_request(_prompt(5, seed=0), 4, tenant="batch")
+    engine.add_request(_prompt(5, seed=1), 4)
+    engine.run()
+    doc = engine.export_chrome_trace()
+    json.loads(json.dumps(doc))
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"tenant batch", "tenant default"} <= names
+    retires = [e for e in doc["traceEvents"]
+               if e.get("cat") == "tenant" and e["ph"] == "i"]
+    assert len(retires) == 2
+    assert all(e["name"] == "retire:finished" and "tokens" in e["args"]
+               for e in retires)
+
+
+def test_tenant_table_renders(model):
+    engine = _engine(model, tenants={"batch": TenantSLO(1e6, 1e6)})
+    engine.add_request(_prompt(5), 4, tenant="batch")
+    engine.run()
+    table = tenant_table(engine.tenant_report())
+    assert "batch" in table and "default" in table
+    assert "100.0%" in table  # everything finished in_slo
+    assert "goodput" in table and "ttft_p99" in table
+
+
+def test_obs_cli_tenant_table_and_journey_views(model, tmp_path, capsys):
+    engine = _engine(model, tenants={"batch": TenantSLO(1e6, 1e6)})
+    rid = engine.add_request(_prompt(5), 4, tenant="batch")
+    engine.run()
+    dump = tmp_path / "dump.json"
+    engine.dump_flight_record(dump)
+
+    assert obs_main(["--flight-record", str(dump), "--tenant-table"]) == 0
+    out = capsys.readouterr().out
+    assert "batch" in out and "goodput" in out
+
+    assert obs_main(["--flight-record", str(dump),
+                     "--journey", str(rid)]) == 0
+    out = capsys.readouterr().out
+    assert f"journey rid={rid}" in out and "first_token" in out
+
+    # a rid outside the ring is bad usage, naming the retained set
+    assert obs_main(["--flight-record", str(dump),
+                     "--journey", "99999"]) == 2
+    assert "not in the dump's journey ring" in capsys.readouterr().out
+
+    # the default pretty-print grows the tenant section
+    assert obs_main(["--flight-record", str(dump)]) == 0
+    out = capsys.readouterr().out
+    assert "tenants (" in out and "journeys retained:" in out
+
+    # --tenant-table on a v1 (pre-tenant) dump is bad usage, explained
+    rec = json.loads(dump.read_text())
+    v1 = {k: v for k, v in rec.items() if k not in ("tenants", "journeys")}
+    v1["schema"] = FLIGHT_RECORD_SCHEMA_V1
+    old = tmp_path / "v1.json"
+    old.write_text(json.dumps(v1))
+    assert obs_main(["--flight-record", str(old), "--tenant-table"]) == 2
+    assert "no tenant section" in capsys.readouterr().out
+    # --journey on a v1 dump names the real reason, not a fake eviction
+    assert obs_main(["--flight-record", str(old), "--journey", "0"]) == 2
+    assert "no journey ring" in capsys.readouterr().out
+    # ... but the other views still read it (back-compat)
+    assert obs_main(["--flight-record", str(old)]) == 0
+    capsys.readouterr()

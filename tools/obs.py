@@ -6,6 +6,8 @@ Usage:
     python tools/obs.py --flight-record dump.json               # pretty
     python tools/obs.py --flight-record dump.json --prometheus
     python tools/obs.py --flight-record dump.json --latency-table
+    python tools/obs.py --flight-record dump.json --tenant-table
+    python tools/obs.py --flight-record dump.json --journey RID
     python tools/obs.py --prometheus          # live registry of THIS proc
 
 Exit codes: 0 clean, 1 the dump records alerts or a fatal/failure
