@@ -87,7 +87,8 @@ def _last_known_tpu() -> dict | None:
                             "bert-bench", "serving-kvq-bench",
                             "serving-spec-bench",
                             "serving-ragged-kernel-bench",
-                            "serving-tenant-bench")):
+                            "serving-tenant-bench",
+                            "serving-fleet-bench")):
             continue
         return rec
     return None
@@ -840,6 +841,112 @@ def _serving_tenant_bench() -> dict:
     return out
 
 
+def _serving_fleet_bench() -> dict:
+    """Serving phase: the N-replica fleet router — a shared-system-prompt
+    multi-tenant mix through a 3-replica fleet with prefix-affinity
+    routing, vs the same trace through one bare engine. Tokens/s and
+    per-tenant p99s are EMITTED, never ratio-asserted (CPU noise rule —
+    three toy replicas on one core say nothing about fleet speedup; on
+    TPU the replicas still share one chip). The structural evidence IS
+    asserted, exactly: zero retraces on every replica (routing never
+    perturbs the compiled programs), affinity hits > 0 on the warm wave
+    (the router really homes repeats on warm replicas), ZERO alerts on
+    the clean leg, and EXACTLY ONE slo_burn weight change on a rigged
+    leg with an unmeetable TTFT target."""
+    import paddle_tpu as paddle
+    from paddle_tpu.obs import TenantSLO, WatchdogConfig
+    from paddle_tpu.serving import (FleetConfig, FleetRouter,
+                                    ServingConfig, ServingEngine)
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(34)
+    cfg = GPTConfig(vocab_size=96, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=96, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(18)
+    system = rng.randint(0, 96, (16,)).astype(np.int32)  # one shared
+    # warm prefix (4 pages) every request rides — the affinity signal
+
+    def jobs():
+        mk = lambda tail: np.concatenate(  # noqa: E731
+            [system, rng.randint(0, 96, (tail,))]).astype(np.int32)
+        return [(mk(4), 8, "interactive") for _ in range(6)] + \
+               [(mk(8), 24, "batch") for _ in range(3)]
+
+    eng_cfg = dict(max_batch=4, num_pages=64, page_size=4,
+                   max_prompt_len=32)
+    slos = {"interactive": TenantSLO(ttft_p99_s=300.0, tpot_p99_s=300.0),
+            "batch": TenantSLO(ttft_p99_s=600.0, tpot_p99_s=600.0)}
+
+    out = {}
+    # clean leg: two waves through 3 replicas — wave 1 warms the gossip,
+    # wave 2 must route on affinity
+    fleet = FleetRouter(model, FleetConfig(
+        num_replicas=3, engine=ServingConfig(tenants=slos, **eng_cfg)))
+    trace = jobs() + jobs()
+    total_tokens = sum(n for _, n, _ in trace)
+    t0 = time.perf_counter()
+    for p, n, t in jobs():
+        fleet.submit(p, n, tenant=t)
+    fleet.run()
+    for p, n, t in jobs():  # the warm wave
+        fleet.submit(p, n, tenant=t)
+    fleet.run()
+    dt = time.perf_counter() - t0
+    snap = fleet.metrics.snapshot()
+    assert snap["serving_analysis_retraces_total"] == 0, \
+        "compile budget violated in the fleet serving bench"
+    for i, eng in enumerate(fleet.replicas):
+        assert eng.compile_counts.get("decode", 0) <= 1, \
+            f"replica {i} retraced decode: {eng.compile_counts}"
+    hits = int(snap["serving_fleet_prefix_affinity_hits_total"])
+    assert hits > 0, "warm wave produced no affinity-routed requests"
+    assert fleet.alerts() == [], \
+        f"clean fleet leg fired alerts: {fleet.alerts()}"
+    assert fleet.weight_changes == []
+    out["serving_fleet_replicas"] = len(fleet.replicas)
+    out["serving_fleet_affinity_hits"] = hits
+    out["serving_fleet_spills"] = int(snap["serving_fleet_spills_total"])
+    out["serving_fleet_prefill_tokens"] = int(
+        snap["serving_prefill_tokens_total"])
+    out["serving_fleet_tokens_per_sec"] = round(total_tokens / dt, 1)
+    for tenant in ("interactive", "batch"):
+        out[f"serving_fleet_{tenant}_ttft_p99_s"] = round(
+            float(snap[f"serving_ttft_s_p99{{tenant={tenant}}}"]), 6)
+        out[f"serving_fleet_{tenant}_tpot_p99_s"] = round(
+            float(snap[f"serving_tpot_s_p99{{tenant={tenant}}}"]), 6)
+
+    # baseline: the SAME trace through one bare engine (emitted only)
+    engine = ServingEngine(model, ServingConfig(tenants=slos, **eng_cfg))
+    t0 = time.perf_counter()
+    for p, n, t in trace:
+        engine.add_request(p, n, tenant=t)
+    engine.run()
+    out["serving_fleet_single_engine_tokens_per_sec"] = round(
+        total_tokens / (time.perf_counter() - t0), 1)
+
+    # rigged leg: an unmeetable interactive TTFT target through the
+    # router — the burn onset must actuate the admission weight exactly
+    # once (the watchdog's edge trigger is the dedupe)
+    rig = FleetRouter(model, FleetConfig(num_replicas=1, engine=(
+        ServingConfig(tenants={
+            "interactive": TenantSLO(ttft_p99_s=1e-9, tpot_p99_s=1e-9),
+            "batch": TenantSLO(ttft_p99_s=600.0, tpot_p99_s=600.0)},
+            watchdog=WatchdogConfig(slo_burn_window_steps=16,
+                                    slo_burn_min_retired=4),
+            **eng_cfg))))
+    for p, n, t in jobs():
+        rig.submit(p, n, tenant=t)
+    rig.run()
+    assert [(t, w) for _, t, w in rig.weight_changes] == \
+        [("interactive", 2.0)], \
+        f"rigged leg must gain weight exactly once: {rig.weight_changes}"
+    assert rig.weight("interactive") == 2.0
+    out["serving_fleet_rigged_weight"] = rig.weight("interactive")
+    return out
+
+
 def _serving_ragged_kernel_bench() -> dict:
     """Serving phase: the unified ragged paged-attention kernel vs the
     gather+sdpa composite, fp32 and int8 — the ROADMAP's raw-decode A/B.
@@ -1142,6 +1249,12 @@ def run_bench(platform: str) -> dict:
             print(f"[bench] serving tenant phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
+        try:
+            r["serving_fleet"] = _serving_fleet_bench()
+        except Exception as e:  # noqa: BLE001 — never forfeit the headline number
+            print(f"[bench] serving fleet phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
         return r
 
     deadline = float(os.environ.get(_DEADLINE_ENV, time.time() + _TPU_BUDGET_S))
@@ -1235,6 +1348,18 @@ def run_bench(platform: str) -> dict:
                                   provenance="serving-tenant-bench"))
         except Exception as e:  # noqa: BLE001 — never forfeit the train number
             print(f"[bench] serving tenant phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
+    if remaining() > 45:
+        try:
+            result["serving_fleet"] = _serving_fleet_bench()
+            # bank the on-chip fleet-router numbers as their own
+            # provenance-labeled history row (skipped by last_known_tpu)
+            _bank_tpu_result(dict(result["serving_fleet"],
+                                  platform=result.get("platform"),
+                                  provenance="serving-fleet-bench"))
+        except Exception as e:  # noqa: BLE001 — never forfeit the train number
+            print(f"[bench] serving fleet phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
     return result

@@ -70,6 +70,13 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   ``_FAMILIES`` declaration: keys are part of the registry key, so a
   reordered ``{class=,tenant=}`` write builds a member the seeding
   never created.
+- PT013 — a direct ``.add_request(...)`` call in ``serving/fleet*.py``:
+  every fleet-side admission must flow through the router's weighted
+  admission path (prefix-affinity placement, per-tenant weights,
+  spill-before-shed, journeys + fleet counters) — a direct engine call
+  silently bypasses ALL of it, the exact hole the fleet layer exists to
+  close. The router's one sanctioned dispatch site carries the pragma;
+  anything else in a fleet module fires.
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
@@ -102,7 +109,7 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
     "lint_fixtures": {f"PT00{i}" for i in range(1, 10)}
-    | {"PT010", "PT011", "PT012"},
+    | {"PT010", "PT011", "PT012", "PT013"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -602,6 +609,28 @@ def _pt012(tree, path):
                    f"labels exactly as declared.")
 
 
+def _pt013(tree, path):
+    """Direct ServingEngine.add_request call in a fleet module. Scope is
+    the serving/fleet* files only (gated on the filename — the rule
+    encodes a fleet-layer contract, not an engine one): the router's
+    single sanctioned dispatch site — the line every request reaches
+    only AFTER weighted admission placed it — pragma-suppresses itself;
+    any other ``.add_request`` attribute access in a fleet module is an
+    admission bypass."""
+    if not Path(path).name.startswith("fleet"):
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "add_request":
+            yield (node.lineno,
+                   "direct .add_request in a fleet module bypasses the "
+                   "router's admission path — no prefix-affinity "
+                   "placement, no per-tenant weight, no "
+                   "spill-before-shed, no fleet counters or journey "
+                   "hops. Route the request through "
+                   "FleetRouter.submit() (the router's one sanctioned "
+                   "dispatch site carries the pragma).")
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -636,6 +665,9 @@ RULES: dict[str, Rule] = {r.code: r for r in (
          "base{a=,b=}) written without a _FAMILIES declaration, or with "
          "label keys disagreeing with it — the PT003/PT008 gap for "
          "formatted names", _pt012),
+    Rule("PT013", "direct ServingEngine.add_request in serving/fleet* "
+         "bypassing the router's weighted admission path", _pt013,
+         scope="serving"),
 )}
 
 
@@ -701,7 +733,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Repo linter: invariants this repo shipped bugs "
-                    "against, enforced (rules PT001-PT012).")
+                    "against, enforced (rules PT001-PT013).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the installed "
                              "paddle_tpu package plus the repo's --include "

@@ -3,9 +3,12 @@ trip through the engine.
 
 A :class:`Journey` is the request-grain complement of the engine-grain
 flight recorder: every request accrues an ordered list of **hops** —
-enqueue → admit (queue delay, prefix-hit width, restore/spill page refs)
-→ each prefill chunk → decode/verify step refs (with accepted counts
-under speculation) → preemptions/swaps → retire (terminal state) — each
+enqueue → router hops when a fleet router is in front (``routed`` /
+``spilled`` with the chosen replica and warm-prefix width, or ``shed``
+when the router retires it unserved) → admit (queue delay, prefix-hit
+width, restore/spill page refs) → each prefill chunk → decode/verify
+step refs (with accepted counts under speculation) →
+preemptions/swaps → retire (terminal state) — each
 hop stamped with the ENGINE STEP INDEX it happened in and the engine
 clock time. Nothing here reads the device: journeys are assembled
 purely from the lifecycle events the tracer and scheduler already stamp
@@ -59,6 +62,12 @@ _EVENT_KINDS = {
     "resumed": "resume",
     "pallas_fallback": "fallback",
     "retired": "retire",
+    # fleet-router hops (PR 16): the router stamps these on the owning
+    # replica's tracer before the engine's own lifecycle events, a
+    # version-compatible v1 extension (JOURNEY_KINDS grows, nothing moves)
+    "routed": "routed",
+    "spilled": "spilled",
+    "shed_by_router": "shed",
 }
 
 #: every hop kind a validate_journey-clean record may carry

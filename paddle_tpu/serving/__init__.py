@@ -60,6 +60,15 @@ and — the request/tenant grain — wire-exportable request journeys
 goodput/badput ledger and an ``slo_burn`` burn-rate watchdog
 (``ServingConfig(tenants={name: TenantSLO(...)})``, observe-only:
 weighted per-tenant admission belongs to the fleet router).
+
+Fleet layer (serving/fleet.py): N replicas behind a ``FleetRouter`` —
+prefix-affinity routing off gossiped page-digest sets
+(``prefix_digest`` / ``PagedKVCache.gossip_digests``), least-loaded
+spillover before shedding, and ledger-weighted per-tenant admission
+actuating the ``slo_burn`` signal (the outer loop over each replica's
+AIMD SLO controller); ``serving/fleet_sim.py`` replays a journey dump
+against hypothetical fleet shapes (``python -m
+paddle_tpu.serving.fleet_sim``).
 """
 from ..obs import TenantLedger, TenantSLO  # noqa: F401 — the per-tenant
 # SLO class + ledger live in obs (serving imports obs, never the
@@ -67,9 +76,10 @@ from ..obs import TenantLedger, TenantSLO  # noqa: F401 — the per-tenant
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      prefill_buckets)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
+from .fleet import FleetConfig, FleetRouter  # noqa: F401
 from .kv_cache import (HostTier, HostTierRestoreError,  # noqa: F401
                        PagedCacheConfig, PagedKVCache, PageAllocator,
-                       SpilledPage, SwapHandle)
+                       SpilledPage, SwapHandle, prefix_digest)
 from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import EngineOverloaded, Request, Scheduler  # noqa: F401
 from .slo import SLOConfig, SLOController  # noqa: F401
@@ -80,4 +90,5 @@ __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "Request", "Scheduler", "EngineOverloaded", "FaultInjector",
            "InjectedFault", "prefill_buckets", "SLOConfig",
            "SLOController", "HostTier", "HostTierRestoreError",
-           "SpilledPage", "SpecConfig", "TenantSLO", "TenantLedger"]
+           "SpilledPage", "SpecConfig", "TenantSLO", "TenantLedger",
+           "FleetConfig", "FleetRouter", "prefix_digest"]

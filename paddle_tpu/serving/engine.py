@@ -836,7 +836,8 @@ class ServingEngine:
 
     def add_request(self, prompt, max_new_tokens: int,
                     deadline_s: float | None = None,
-                    tenant: str = "default") -> int:
+                    tenant: str = "default",
+                    rid: int | None = None) -> int:
         """Queue a prompt; returns the request id. ``deadline_s`` is a
         wall-clock budget from now — a request still waiting or running when
         it elapses is retired EXPIRED at the next step boundary.
@@ -844,11 +845,15 @@ class ServingEngine:
         goodput ledger, journey, and per-tenant latency families —
         observe-only (scheduling never reads it); tenants beyond the
         declared ``ServingConfig(tenants=)`` set are served under their
-        own label with no SLO targets. Raises ValueError when the
-        request could never fit (prompt too long for the bucket, the
-        model, or the whole pool) or the tenant name is malformed, and
-        EngineOverloaded when the bounded waiting queue is full under
-        the reject policy."""
+        own label with no SLO targets. ``rid`` lets the fleet router
+        pass through an id it already drew (from the same global
+        counter — ids stay process-unique) so a request keeps one id
+        across routing hops and re-homes; callers without a router
+        leave it None. Raises ValueError when the request could never
+        fit (prompt too long for the bucket, the model, or the whole
+        pool) or the tenant name is malformed, and EngineOverloaded
+        when the bounded waiting queue is full under the reject
+        policy."""
         if tenant not in self._seeded_tenants:
             # first sight of an ad-hoc tenant: validate the name and
             # seed its families now (declared tenants + "default" were
@@ -882,7 +887,8 @@ class ServingEngine:
                       max_new_tokens=int(max_new_tokens),
                       deadline=(self.now() + float(deadline_s)
                                 if deadline_s is not None else None),
-                      tenant=tenant)
+                      tenant=tenant,
+                      **({} if rid is None else {"rid": int(rid)}))
         try:
             shed = self.scheduler.add(req)  # validates against pool capacity
         except EngineOverloaded:

@@ -29,6 +29,20 @@ boundaries — named points, matched by (point, step index, request id):
   without sleeping — deadline expiry and wall-clock budgets become
   deterministically testable.
 
+Two fleet-grain points consulted by the ROUTER (serving/fleet.py), not
+the engine — install the injector on the FleetRouter for these:
+
+- ``route_fail``    the routing decision for a request fails (a gossip
+  or transport fault): the router sheds that request immediately — it
+  retires SHED with a validate_journey-clean journey and never reaches
+  a replica; matched by ``rid`` like the engine points.
+- ``replica_down``  a replica dies at a step boundary. Here ``rid``
+  carries the REPLICA INDEX, not a request id (the injector matches on
+  the same field; arm with ``rid=<replica index>``). The dead replica's
+  never-admitted waiters drain back to the router and re-route to
+  survivors (counted as spills); its in-flight requests retire FAILED;
+  survivors keep serving and the ``serving_fleet_replicas`` gauge drops.
+
 Every fault is consulted BEFORE the state transition it poisons, so the
 host-side scheduler/cache state after a fault equals the pre-step snapshot
 minus the retired request — no partial mutations to roll back, and page
@@ -43,7 +57,8 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 POINTS = ("prefill_fail", "chunk_fail", "decode_fail", "verify_fail",
-          "pool_exhausted", "restore_fail", "slow_step")
+          "pool_exhausted", "restore_fail", "slow_step",
+          "route_fail", "replica_down")
 
 
 class InjectedFault(RuntimeError):

@@ -281,6 +281,51 @@ class HostTier:
         return e
 
 
+# ------------------------------------------------------ prefix digests
+# Chained per-page digests of a token stream — the fleet router's gossip
+# currency. The cache's own index keys stay EXACT token tuples (a digest
+# collision there would splice foreign KV); digests are advisory routing
+# hints only, so a collision costs at worst one suboptimal route. FNV-1a
+# 64-bit with explicit constants: python's hash() is salted per process
+# and could never gossip across replicas or runs.
+DIGEST_SEED = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_U64 = (1 << 64) - 1
+
+
+def _block_tokens(tokens, page_size: int, i: int) -> tuple:
+    """Block ``i`` of ``tokens`` as a plain int tuple — the single place
+    token blocks are sliced for keying, shared by the exact index keys
+    and the gossip digests so they can never disagree."""
+    return tuple(int(t) for t in tokens[i * page_size:(i + 1) * page_size])
+
+
+def _digest_step(parent_digest: int, block: tuple) -> int:
+    """Fold one page-aligned token block into its parent chain digest."""
+    h = parent_digest
+    for t in block:
+        for shift in (0, 8, 16, 24):  # 4 bytes/token covers any vocab
+            h ^= (t >> shift) & 0xFF
+            h = (h * _FNV_PRIME) & _U64
+        h ^= 0xFE  # token delimiter: (1,2),(3) never equals (1),(2,3)
+        h = (h * _FNV_PRIME) & _U64
+    return h
+
+
+def prefix_digest(tokens, page_size: int) -> tuple:
+    """Chained digests for every FULL page-aligned prefix of ``tokens``:
+    element ``i`` summarizes blocks ``0..i`` inclusive. The router hashes
+    an incoming prompt once with this and counts how many leading
+    elements appear in a replica's gossiped digest set — that count times
+    ``page_size`` equals what ``cached_prefix_tokens`` would report
+    locally (pinned by a parity test)."""
+    out, h = [], DIGEST_SEED
+    for i in range(len(tokens) // page_size):
+        h = _digest_step(h, _block_tokens(tokens, page_size, i))
+        out.append(h)
+    return tuple(out)
+
+
 @dataclass(frozen=True)
 class PagedCacheConfig:
     num_layers: int
@@ -530,9 +575,8 @@ class PagedKVCache:
         another prompt's KV into a request, so exactness is a correctness
         requirement, not a nicety; the parent serial carries the rest of
         the prefix transitively."""
-        ps = self.cfg.page_size
         return (parent_serial,
-                tuple(int(t) for t in tokens[i * ps:(i + 1) * ps]))
+                _block_tokens(tokens, self.cfg.page_size, i))
 
     def match_prefix(self, tokens) -> list[int]:
         """Longest chain of cached FULL pages covering a prefix of
@@ -627,6 +671,31 @@ class PagedKVCache:
         spilled = self._match_host_tail(tokens, parent, len(pages),
                                         touch=False)
         return (len(pages) + len(spilled)) * self.cfg.page_size
+
+    def gossip_digests(self) -> frozenset:
+        """Chain digests for every prefix chain reachable from the root —
+        device index plus the host tier's continuations — as a compact set
+        the fleet router gossips instead of token content. A digest is
+        included iff the whole chain up to it is resolvable, so counting
+        leading ``prefix_digest`` elements in this set reproduces
+        ``cached_prefix_tokens`` exactly (parity-pinned). Registration
+        walks chains left-to-right, so a child's serial always exceeds its
+        parent's — one serial-ordered pass resolves every node."""
+        if not self.cfg.enable_prefix_caching:
+            return frozenset()
+        nodes = [(self._page_serial[page], key)
+                 for key, page in self._key_to_page.items()]
+        if self.host_tier is not None:
+            nodes.extend((e.serial, key)
+                         for key, e in self.host_tier._entries.items())
+        by_serial = {0: DIGEST_SEED}  # serial -> chain digest
+        for serial, (parent_serial, block) in sorted(nodes):
+            parent = by_serial.get(parent_serial)
+            if parent is None:
+                continue  # ancestor purged: chain unreachable from root
+            by_serial[serial] = _digest_step(parent, block)
+        del by_serial[0]
+        return frozenset(by_serial.values())
 
     def _unregister(self, page: int) -> None:
         key = self._page_key.pop(page, None)

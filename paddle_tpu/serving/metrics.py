@@ -205,6 +205,25 @@ obs/tenant.py; every family pre-seeded for the declared tenants +
                                   real labeled bucket series, like the
                                   phase family)
 
+Fleet router (PR 16 — serving/fleet.py; N replicas share this ONE
+process-global registry, so the fleet counters are fleet-wide totals and
+token reconciliation across replicas is automatic):
+
+- serving_fleet_replicas          gauge: live replicas behind the router
+                                  (set at construction, lowered by a
+                                  replica_down fault)
+- serving_fleet_prefix_affinity_hits_total  requests routed to a replica
+                                  whose gossiped digest set held a warm
+                                  prefix match
+- serving_fleet_spills_total      requests spilled off their warm (or
+                                  dead) replica to the least-loaded
+                                  survivor
+- serving_fleet_tenant_weight{tenant=}  gauge family: the router's
+                                  per-tenant admission weight — 1.0 at
+                                  seed, multiplied by weight_gain once
+                                  per slo_burn onset (the outer loop
+                                  actuating PR 15's ledger)
+
 Every counter incremented here is pre-seeded in ``_SEEDED`` — lint rule
 PT003 (this module shipped unseeded counters once) enforces it; every
 ``stat_set``/``stat_max`` gauge likewise, per the mirror rule PT008.
@@ -257,6 +276,8 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "tp_collective_bytes_per_token",
            "tokens_per_sec", "queue_depth", "active_requests",
            "page_pool_used", "page_utilization", "mfu", "hbm_bw_util",
+           "fleet_replicas", "fleet_prefix_affinity_hits_total",
+           "fleet_spills_total",
            "queue_depth_peak", "page_pool_peak")
 
 # labeled stat families: base name -> label key, or an ORDERED tuple of
@@ -279,6 +300,8 @@ _FAMILIES = {
     "tenant_badput_tokens_total": "tenant",    # everything-else tokens
     "tenant_retired_total": ("tenant", "class"),  # retirements per
     # terminal class — the one multi-label family (badput breakdown)
+    "fleet_tenant_weight": "tenant",      # router admission weight (the
+    # slo_burn-actuated outer-loop gain; 1.0 until a burn onset)
     "ttft_s": "tenant",                   # histogram family (per-tenant
     "tpot_s": "tenant",                   # latency classes; the plain
     "queue_delay_s": "tenant",            # serving_ttft_s etc. hist
@@ -623,6 +646,28 @@ class ServingMetrics:
             monitor.stat_add(
                 PREFIX + f"tenant_badput_tokens_total{{tenant={tenant}}}",
                 int(tokens))
+
+    # ------------------------------------------------------ fleet router
+    def on_fleet_replicas(self, n: int) -> None:
+        """Live replica count — set at router construction and again when
+        a ``replica_down`` fault retires a replica."""
+        monitor.stat_set(PREFIX + "fleet_replicas", int(n))
+
+    def on_fleet_affinity_hit(self) -> None:
+        """One request routed to a replica with a warm prefix match."""
+        monitor.stat_add(PREFIX + "fleet_prefix_affinity_hits_total", 1)
+
+    def on_fleet_spill(self) -> None:
+        """One request spilled off its warm replica (or re-homed off a
+        dead one) to the least-loaded survivor."""
+        monitor.stat_add(PREFIX + "fleet_spills_total", 1)
+
+    def on_fleet_tenant_weight(self, tenant: str, weight: float) -> None:
+        """The router's admission weight for one tenant (family member
+        pre-seeded at router construction)."""
+        monitor.stat_set(
+            PREFIX + f"fleet_tenant_weight{{tenant={tenant}}}",
+            float(weight))
 
     def observe_tenant(self, tenant: str, ttft, tpot,
                        queue_delay) -> None:

@@ -1,0 +1,13 @@
+"""PT013 fixture: a direct ServingEngine.add_request call inside a
+fleet module (linted as if it lived at serving/fleet_rogue.py) — the
+admission bypass the rule exists to close — plus the pragma-suppressed
+twin, the router's sanctioned dispatch idiom."""
+
+
+def rogue_dispatch(engine, prompt):
+    # bypasses weighted admission, affinity placement, fleet counters
+    return engine.add_request(prompt, 8)
+
+
+def sanctioned_dispatch(engine, prompt, rid):
+    return engine.add_request(prompt, 8, rid=rid)  # lint: disable=PT013

@@ -373,6 +373,8 @@ _FIXTURE_CASES = {
                                      {14: "PT012", 19: "PT012",
                                       24: "PT012", 44: "PT012",
                                       55: "PT012", 61: "PT012"}),
+    "pt013_direct_add_request.py": ("serving/fleet_rogue.py",
+                                    {9: "PT013"}),
 }
 
 
@@ -392,7 +394,7 @@ def test_lint_rule_fixture(fixture):
 
 def test_lint_rule_table_is_complete():
     assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + [
-        "PT010", "PT011", "PT012"]
+        "PT010", "PT011", "PT012", "PT013"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -568,6 +570,25 @@ def test_self_lint_catches_unregistered_multilabel_family():
                for f in findings)
     assert not any(f.rule == "PT012" for f in lint_source(
         src, "paddle_tpu/serving/metrics.py"))
+
+
+def test_self_lint_catches_unsanctioned_fleet_dispatch():
+    """Deliberately strip the pragma off the fleet router's one
+    sanctioned add_request site: PT013 must fire — a fleet dispatch
+    outside the weighted admission path is the bypass the rule exists
+    to close. The pragma'd original stays clean, and the pragma must
+    actually exist (a silently deleted site would pass vacuously)."""
+    path = REPO / "paddle_tpu" / "serving" / "fleet.py"
+    src = path.read_text()
+    assert "# lint: disable=PT013" in src, \
+        "fleet.py lost its sanctioned dispatch pragma"
+    bad = src.replace("  # lint: disable=PT013", "")
+    assert bad != src
+    findings = lint_source(bad, "paddle_tpu/serving/fleet.py")
+    assert any(f.rule == "PT013" and "admission" in f.message
+               for f in findings)
+    assert not any(f.rule == "PT013" for f in lint_source(
+        src, "paddle_tpu/serving/fleet.py"))
 
 
 def test_self_lint_catches_reintroduced_wall_clock():

@@ -1,0 +1,448 @@
+"""N-replica fleet router: prefix-affinity routing, ledger-weighted
+admission, fault drill, and the trace-driven simulator.
+
+Coverage, one layer per block:
+
+- digest parity: ``prefix_digest`` chains vs ``cached_prefix_tokens``
+  through ``gossip_digests`` — device index AND the host spill tier —
+  plus the prefix/determinism properties the router's affinity math
+  assumes.
+- routing: affinity strictly beats round-robin on the same trace with
+  the prefill-token arithmetic pinned EXACTLY (conservation against
+  tokens_saved, 2x hit count, saved-diff == prefill-diff), and a
+  warm-but-full replica spills to the least-loaded survivor BEFORE
+  anything is shed.
+- admission: the slo_burn golden — exactly one weight gain per onset,
+  gauge + weight_changes agree, and goodput + badput still reconcile
+  with serving_tokens_total fleet-wide.
+- equivalence: a 1-replica fleet is bit-identical to the bare engine,
+  and the SyncTally certification + per-replica compile counts are
+  UNCHANGED with the router on (routing never touches a device value).
+- faults: ``route_fail`` sheds with a validate_journey-clean router
+  journey; ``replica_down`` re-homes clean waiters to survivors as
+  spills, fails in-flight requests, and drops the replica gauge —
+  every journey on every book stays schema-clean.
+- simulator: ``replay_classes`` reproduces the live fleet's per-tenant
+  retirement-class counts EXACTLY from the journey dump, the what-if
+  projection is sane, and the CLI round-trips a dump file.
+
+Everything runs on the shared virtual clock — sleep-free, deterministic.
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import SyncTally
+from paddle_tpu.obs import TenantSLO, WatchdogConfig, validate_journey
+from paddle_tpu.serving import (FaultInjector, FleetConfig, FleetRouter,
+                                ServingConfig, ServingEngine, prefix_digest)
+from paddle_tpu.serving.fleet_sim import main as sim_main
+from paddle_tpu.serving.fleet_sim import replay_classes, simulate
+from paddle_tpu.serving.scheduler import FAILED, SHED
+from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+pytestmark = pytest.mark.fleet
+
+
+class VirtualClock:
+    """Integer-stepped fake clock shared by every replica: 1.0 s per
+    read, so latency fields are exact float arithmetic."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(41)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=97, hidden_size=32, num_layers=2, num_heads=2,
+        max_seq_len=48, dropout=0.0))
+    m.eval()
+    return m
+
+
+_ENG = dict(max_batch=2, num_pages=20, page_size=4, max_prompt_len=8)
+
+
+def _fleet(model, num_replicas=3, eng=None, injector=None, **fleet_kw):
+    kw = dict(_ENG)
+    kw.update(eng or {})
+    cfg = FleetConfig(num_replicas=num_replicas,
+                      engine=ServingConfig(**kw), **fleet_kw)
+    return FleetRouter(model, cfg, clock=VirtualClock(),
+                       fault_injector=injector)
+
+
+def _prompt(n, seed=0):
+    return np.random.RandomState(seed).randint(0, 97, (n,)).astype(np.int32)
+
+
+# ------------------------------------------------------------ digest parity
+def test_prefix_digest_properties():
+    a = _prompt(8, seed=1)
+    b = _prompt(8, seed=2)
+    da = prefix_digest(a, 4)
+    assert len(da) == 2  # one chained digest per FULL page
+    assert prefix_digest(a, 4) == da  # deterministic
+    assert prefix_digest(a[:4], 4) == da[:1]  # prefix property: a
+    # chain's digests are its own prefixes' digests
+    assert prefix_digest(b, 4)[0] != da[0]
+    assert prefix_digest(a[:3], 4) == ()  # partial pages never digest
+
+
+def test_digest_parity_with_cached_prefix_tokens(model):
+    # the router-side affinity count and the cache-side probe must agree
+    # EXACTLY — both derive from one key helper, and this pin is what
+    # makes digest disagreement impossible by construction
+    eng = ServingEngine(model, ServingConfig(**_ENG),
+                        clock=VirtualClock())
+    warm = _prompt(8, seed=1)
+    eng.add_request(warm, 3)
+    eng.run()
+    gossip = eng.cache.gossip_digests()
+    n = 0
+    for d in prefix_digest(warm, 4):
+        if d not in gossip:
+            break
+        n += 1
+    assert n * 4 == eng.cache.cached_prefix_tokens(warm) == 8
+    cold = _prompt(8, seed=9)
+    assert not any(d in gossip for d in prefix_digest(cold, 4))
+
+
+def test_digest_parity_covers_host_tier(model):
+    # a prefix chain spilled to the host tier must still gossip — the
+    # router would otherwise route a warm request to a cold replica
+    rng = np.random.RandomState(29)
+    system = rng.randint(0, 97, (16,)).astype(np.int32)
+    eng = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=14, page_size=4, max_prompt_len=32,
+        host_tier_bytes=1 << 20), clock=VirtualClock())
+    eng.add_request(np.concatenate([system, [1, 2, 3]]).astype(np.int32), 4)
+    eng.run()
+    for _ in range(2):  # cold whales sweep the system pages to the tier
+        eng.add_request(rng.randint(0, 97, (22,)).astype(np.int32), 2)
+    eng.run()
+    assert eng.cache.match_prefix(system) == []  # gone from the device
+    cached = eng.cache.cached_prefix_tokens(system)
+    assert cached == 16  # ...but fully served from the host tier
+    gossip = eng.cache.gossip_digests()
+    n = 0
+    for d in prefix_digest(system, 4):
+        if d not in gossip:
+            break
+        n += 1
+    assert n * 4 == cached
+
+
+# ----------------------------------------------------------------- config
+def test_fleet_config_validation(model):
+    with pytest.raises(ValueError, match="num_replicas"):
+        FleetConfig(num_replicas=0).validate()
+    with pytest.raises(ValueError, match="routing"):
+        FleetConfig(routing="random").validate()
+    with pytest.raises(ValueError, match="gossip_every"):
+        FleetConfig(gossip_every=0).validate()
+    with pytest.raises(ValueError, match="weight_gain"):
+        FleetConfig(weight_gain=1.0).validate()
+    fleet = _fleet(model, num_replicas=1)
+    with pytest.raises(ValueError, match="1-D"):
+        fleet.submit(np.zeros((2, 2), np.int32), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        fleet.submit(_prompt(4), 0)
+    with pytest.raises(ValueError, match="max_prompt_len"):
+        fleet.submit(_prompt(9), 4)
+    with pytest.raises(ValueError, match="tenant name"):
+        fleet.submit(_prompt(4), 4, tenant="a,b")
+
+
+# ---------------------------------------------------------------- routing
+def test_affinity_beats_round_robin_exact_prefill_tokens(model):
+    # acceptance pin (a): the SAME trace through both policies — two
+    # warm families A/B, then a second wave of repeats. Affinity homes
+    # every repeat on its warm replica; round-robin's rotation lands 2
+    # of the 4 repeats on cold replicas and pays their full prefill.
+    A, B = _prompt(8, seed=1), _prompt(8, seed=2)
+
+    def run_policy(routing):
+        fleet = _fleet(model, num_replicas=3, routing=routing)
+        w1 = [fleet.submit(A, 3), fleet.submit(B, 3)]
+        fleet.run()
+        w2 = [fleet.submit(A, 3), fleet.submit(A, 3),
+              fleet.submit(B, 3), fleet.submit(B, 3)]
+        fleet.run()
+        snap = fleet.metrics.snapshot()  # BEFORE the next fleet resets
+        assert all(fleet.status(r) == "finished" for r in w1 + w2)
+        return fleet, snap
+
+    aff, aff_snap = run_policy("affinity")
+    # wave 2 all warm: routed (not spilled), 8 gossiped warm tokens each
+    w2_routes = sorted(aff.routes.items())[-4:]
+    assert [(kind, tok) for _, (_, kind, tok) in w2_routes] == \
+        [("routed", 8)] * 4
+    assert aff_snap["serving_fleet_prefix_affinity_hits_total"] == 4
+    assert aff_snap["serving_fleet_spills_total"] == 0
+    assert aff_snap["serving_prefix_hits"] == 4
+
+    rr, rr_snap = run_policy("round_robin")
+    # rotation: wave 1 warms r0/r1; wave 2 [A->r2 cold, A->r0 warm,
+    # B->r1 warm, B->r2 cold] — half the hits, never counted as
+    # router affinity (round-robin ignores gossip by construction)
+    assert rr_snap["serving_fleet_prefix_affinity_hits_total"] == 0
+    assert rr_snap["serving_prefix_hits"] == 2
+
+    aff_fill = aff_snap["serving_prefill_tokens_total"]
+    rr_fill = rr_snap["serving_prefill_tokens_total"]
+    aff_saved = aff_snap["serving_prefix_tokens_saved"]
+    rr_saved = rr_snap["serving_prefix_tokens_saved"]
+    assert aff_fill < rr_fill  # the headline: strictly fewer tokens
+    # exact arithmetic: 48 prompt tokens either way — what one policy
+    # saves the other prefills, and affinity saves exactly twice as
+    # much (4 warm hits vs 2, same tokens saved per hit)
+    assert aff_fill + aff_saved == rr_fill + rr_saved == 6 * 8
+    assert aff_saved == 2 * rr_saved
+    assert rr_fill - aff_fill == rr_saved > 0
+
+
+def test_spillover_before_shed(model):
+    # warm-but-full never sheds while a survivor has room: the order is
+    # routed (warm) -> spilled (least-loaded) -> pending -> shed, and
+    # the shed victim is always the NEWCOMER
+    fleet = _fleet(model, num_replicas=2, max_replica_load=1,
+                   max_pending=1)
+    A = _prompt(8, seed=1)
+    fleet.submit(A, 3)
+    fleet.run()  # r0 is now the warm replica
+    r1 = fleet.submit(A, 4)   # warm, room -> routed to r0
+    r2 = fleet.submit(A, 4)   # warm replica full -> spill to r1
+    r3 = fleet.submit(A, 4)   # both full -> router pending
+    r4 = fleet.submit(A, 4)   # pending full -> shed the newcomer
+    assert fleet.routes[r1][0:2] == (0, "routed")
+    assert fleet.routes[r2][0:2] == (1, "spilled")
+    assert fleet.status(r3) == "pending"
+    assert fleet.status(r4) == SHED
+    snap = fleet.metrics.snapshot()
+    assert snap["serving_fleet_spills_total"] == 1
+    assert snap["serving_shed"] == 1
+    fleet.run()  # r3 dispatches once a replica frees; nothing else sheds
+    assert fleet.status(r1) == fleet.status(r2) == \
+        fleet.status(r3) == "finished"
+    retired = fleet.pop_retired()
+    assert retired[r4].state == SHED
+    shed_j = [j for j in fleet.journeys() if j.rid == r4]
+    assert len(shed_j) == 1 and shed_j[0].state == SHED
+    wire = validate_journey(shed_j[0].to_wire())
+    assert [h["kind"] for h in wire["hops"]] == \
+        ["enqueue", "shed", "retire"]
+    assert wire["hops"][1]["reason"] == "router_queue_full"
+    assert fleet.metrics.snapshot()["serving_fleet_spills_total"] == 1
+
+
+# -------------------------------------------------------------- admission
+def test_burn_weighted_admission_golden(model):
+    # acceptance pin (b): a tenant burning an unmeetable SLO gains
+    # admission weight EXACTLY once per onset (the watchdog's edge
+    # trigger is the dedupe), the gauge tracks it, and the fleet's
+    # goodput/badput books still reconcile to the token counter
+    fleet = _fleet(
+        model, num_replicas=1,
+        eng=dict(tenants={"victim": TenantSLO(ttft_p99_s=1e-9,
+                                              tpot_p99_s=1e-9)},
+                 watchdog=WatchdogConfig(slo_burn_window_steps=16,
+                                         slo_burn_min_retired=4)))
+    assert fleet.weight("victim") == 1.0
+    for i in range(6):
+        fleet.submit(_prompt(4, seed=i), 2, tenant="victim")
+    fleet.run()
+    assert [a.rule for a in fleet.alerts()] == ["slo_burn"]
+    assert [(t, w) for _, t, w in fleet.weight_changes] == \
+        [("victim", 2.0)]  # one onset -> one gain, not one per alert read
+    assert fleet.weight("victim") == 2.0
+    assert fleet.weight("default") == 1.0
+    snap = fleet.metrics.snapshot()
+    assert snap["serving_fleet_tenant_weight{tenant=victim}"] == 2.0
+    assert snap["serving_fleet_tenant_weight{tenant=default}"] == 1.0
+    # the ledger the weight is justified by still balances exactly
+    good = sum(v for k, v in snap.items()
+               if k.startswith("serving_tenant_goodput_tokens_total"))
+    bad = sum(v for k, v in snap.items()
+              if k.startswith("serving_tenant_badput_tokens_total"))
+    assert good + bad == snap["serving_tokens_total"] > 0
+
+
+def test_weighted_drain_orders_pending_by_tenant_weight(model):
+    # with the burning tenant's weight raised, its pending requests
+    # dispatch before earlier-arrived default ones — stable FIFO within
+    # a weight class
+    fleet = _fleet(model, num_replicas=1, max_replica_load=1)
+    first = fleet.submit(_prompt(4, seed=0), 2)  # occupies the replica
+    d1 = fleet.submit(_prompt(4, seed=1), 2)               # pending
+    v1 = fleet.submit(_prompt(4, seed=2), 2, tenant="vip")  # pending
+    fleet._actuate_weight("vip")  # as a live slo_burn alert would
+    fleet.run()
+    assert all(fleet.status(r) == "finished" for r in (first, d1, v1))
+    # vip overtook the earlier default arrival at dispatch time
+    order = sorted((rid, fleet.routes[rid]) for rid in (d1, v1))
+    assert v1 > d1  # arrived later...
+    vip_j = [j for j in fleet.journeys() if j.rid == v1][0]
+    d_j = [j for j in fleet.journeys() if j.rid == d1][0]
+    assert vip_j.admitted_t < d_j.admitted_t  # ...served earlier
+    assert order  # routes recorded for both
+
+
+# ------------------------------------------------------------ equivalence
+def test_one_replica_fleet_bit_identical_to_bare_engine(model):
+    prompts = [_prompt(5 + i % 3, seed=i) for i in range(3)]
+
+    def outputs(build):
+        box = build()
+        rids = [box[1](p, 4) for p in prompts]
+        outs = box[0].run()
+        return [outs[r] for r in rids]
+
+    bare = outputs(lambda: (lambda e: (e, e.add_request))(
+        ServingEngine(model, ServingConfig(**_ENG),
+                      clock=VirtualClock())))
+    routed = outputs(lambda: (lambda f: (f, f.submit))(
+        _fleet(model, num_replicas=1)))
+    for a, b in zip(bare, routed):
+        assert np.array_equal(a, b)
+
+
+def test_sync_free_and_compile_counts_with_router_on(model):
+    # the SyncTally certification formula (one token fetch per decode
+    # step + one per completed prefill) holds FLEET-WIDE — routing,
+    # gossip, and weighted drain never touch a device value — and every
+    # replica stays at one compile per program (zero retraces)
+    fleet = _fleet(model, num_replicas=3)
+    A = _prompt(8, seed=1)
+    for i in range(3):
+        fleet.submit(_prompt(6, seed=i), 3)
+    with SyncTally() as tally:
+        fleet.run()
+        fleet.submit(A, 3)
+        fleet.submit(A, 3)  # a warm wave exercises the affinity path
+        fleet.run()
+    snap = fleet.metrics.snapshot()
+    fetches = int(snap["serving_decode_steps"]
+                  + snap["serving_prefills_total"])
+    assert tally.count == fetches, (tally.events, fetches)
+    for eng in fleet.replicas:
+        assert eng.compile_counts == {"prefill": 1, "decode": 1}
+
+
+# ------------------------------------------------------------------ faults
+@pytest.mark.faults
+def test_route_fail_sheds_with_clean_journey(model):
+    inj = FaultInjector().arm("route_fail", step=0, times=1)
+    fleet = _fleet(model, num_replicas=2, injector=inj)
+    shed_rid = fleet.submit(_prompt(6, seed=0), 3)  # consumed by the arm
+    ok_rid = fleet.submit(_prompt(6, seed=1), 3)
+    assert fleet.status(shed_rid) == SHED
+    fleet.run()
+    assert fleet.status(ok_rid) == "finished"
+    assert fleet.pop_retired()[shed_rid].state == SHED
+    j = [j for j in fleet.journeys() if j.rid == shed_rid][0]
+    wire = validate_journey(j.to_wire())
+    assert wire["state"] == SHED and wire["tokens"] == 0
+    assert wire["hops"][1]["reason"] == "route_fail"
+    assert fleet.metrics.snapshot()["serving_shed"] == 1
+
+
+@pytest.mark.faults
+def test_replica_down_drains_waiters_to_survivors(model):
+    # the drill: replica 0 dies at step 2 — its in-flight request
+    # retires FAILED, its clean waiter re-homes to the survivor as a
+    # spill under the SAME rid, the gauge drops, and every journey on
+    # every book (including the dead replica's non-terminal half of the
+    # re-homed pair) stays schema-clean
+    inj = FaultInjector().arm("replica_down", step=2, rid=0)
+    fleet = _fleet(model, num_replicas=2, max_replica_load=4,
+                   eng=dict(max_batch=1), injector=inj)
+    rids = [fleet.submit(_prompt(6, seed=i), 6) for i in range(4)]
+    # cold least-loaded placement alternates: r0 gets rids[0] (running)
+    # + rids[2] (waiting), r1 gets rids[1] + rids[3]
+    assert [fleet.routes[r][0] for r in rids] == [0, 1, 0, 1]
+    fleet.run()
+    snap = fleet.metrics.snapshot()
+    assert snap["serving_fleet_replicas"] == 1
+    assert snap["serving_failed"] == 1
+    assert snap["serving_fleet_spills_total"] == 1
+    assert fleet.status(rids[0]) == FAILED  # in-flight on the dead replica
+    for r in rids[1:]:
+        assert fleet.status(r) == "finished"
+    assert fleet.routes[rids[2]][0:2] == (1, "spilled")  # re-homed
+    wires = [validate_journey(j.to_wire()) for j in fleet.journeys()]
+    halves = sorted((w["state"] is None) for w in wires
+                    if w["rid"] == rids[2])
+    assert halves == [False, True]  # dead-replica half stays
+    # non-terminal; the survivor's carries the real retirement
+    dead_half = [w for w in wires
+                 if w["rid"] == rids[2] and w["state"] is None][0]
+    spill_hops = [h for h in dead_half["hops"] if h["kind"] == "spilled"]
+    assert spill_hops and spill_hops[0]["reason"] == "replica_down"
+    assert [w["state"] for w in wires if w["rid"] == rids[0]] == [FAILED]
+
+
+# --------------------------------------------------------------- simulator
+def test_simulator_replay_reproduces_live_classes(model, tmp_path, capsys):
+    # acceptance pin (c): re-classifying the journey dump through a
+    # fresh ledger reproduces the live per-tenant retirement-class
+    # counts EXACTLY — including the router's own shed retirements
+    fleet = _fleet(
+        model, num_replicas=2, max_replica_load=1, max_pending=1,
+        eng=dict(tenants={
+            "interactive": TenantSLO(ttft_p99_s=1e6, tpot_p99_s=1e6),
+            "batch": TenantSLO(ttft_p99_s=1e-9, tpot_p99_s=1e-9)}))
+    for i in range(3):
+        fleet.submit(_prompt(6, seed=i), 3, tenant="interactive")
+        fleet.submit(_prompt(6, seed=10 + i), 3, tenant="batch")
+    fleet.run()
+    live = fleet.retirement_class_counts()
+    assert sum(sum(row.values()) for row in live.values()) == 6
+    dump = fleet.journey_dump()
+    replay = replay_classes(dump, slos=dict(fleet.config.engine.tenants))
+    for tenant, row in live.items():
+        if any(row.values()):
+            assert replay[tenant] == row
+        else:  # zero-traffic tenants never appear in a dump
+            assert tenant not in replay
+    assert any(v for row in replay.values() for v in row.values())
+    # the what-if projection: every served request replays, queueing is
+    # non-negative, and fewer slots can only lengthen the makespan
+    one = simulate(dump, replicas=1, slots=1)
+    two = simulate(dump, replicas=2, slots=2)
+    assert one["served"] == two["served"] > 0
+    assert one["makespan_s"] >= two["makespan_s"]
+    assert all(row["queue_delay_max_s"] >= 0.0
+               for row in one["tenants"].values())
+    # the CLI round-trips a dump file
+    path = tmp_path / "dump.json"
+    path.write_text(json.dumps(dump))
+    assert sim_main([str(path), "--replicas", "2", "--slots", "2",
+                     "--slo", "batch=0.000000001:0.000000001",
+                     "--weight", "batch=2.0"]) == 0
+    out = capsys.readouterr().out
+    assert "replayed retirement classes" in out and "what-if" in out
+
+
+def test_chrome_export_merges_one_track_per_replica(model, tmp_path):
+    fleet = _fleet(model, num_replicas=2)
+    fleet.submit(_prompt(6, seed=0), 3)
+    fleet.submit(_prompt(6, seed=1), 3)
+    fleet.run()
+    path = tmp_path / "fleet.json"
+    doc = fleet.export_chrome_trace(path)
+    assert {e["pid"] for e in doc["traceEvents"]} == {1, 2}
+    names = sorted(e["args"]["name"] for e in doc["traceEvents"]
+                   if e.get("ph") == "M" and e["name"] == "process_name")
+    assert names == ["paddle_tpu.serving/replica0",
+                     "paddle_tpu.serving/replica1"]
+    assert json.loads(path.read_text())["traceEvents"]
