@@ -88,7 +88,8 @@ def _last_known_tpu() -> dict | None:
                             "serving-spec-bench",
                             "serving-ragged-kernel-bench",
                             "serving-tenant-bench",
-                            "serving-fleet-bench")):
+                            "serving-fleet-bench",
+                            "serving-wire-bench")):
             continue
         return rec
     return None
@@ -947,6 +948,125 @@ def _serving_fleet_bench() -> dict:
     return out
 
 
+def _serving_wire_bench() -> dict:
+    """Serving phase: the KV-fabric wire transport — codec throughput
+    over a mixed fp32/int8 page bank, then the same fleet trace at
+    0% / 2% / 10% seeded wire loss. Throughputs are EMITTED, never
+    ratio-asserted (CPU noise rule — a host-side codec on a busy core
+    says nothing about the fabric). The structural evidence IS
+    asserted, exactly: ZERO lost rids at every loss rate (every
+    submission completes — loss degrades, it never loses), the tenant
+    ledger reconciles to the token counter at drain, and wire retries
+    are observed at >0% loss ONLY (a lossless channel never retries —
+    the bit-identical parity pin's precondition)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import (FleetConfig, FleetRouter,
+                                    ServingConfig)
+    from paddle_tpu.serving.channel import (ChannelConfig, SimChannel,
+                                            Transport, TransportConfig)
+    from paddle_tpu.serving.kv_cache import SpilledPage
+    from paddle_tpu.serving.wire import decode_frame, encode_page
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    out = {}
+    # codec leg: encode + decode MB/s over 48 pages, alternating fp32
+    # and int8+scales — the two pool dtypes the fleet actually ships
+    rng = np.random.RandomState(7)
+    shape = (4, 8, 4, 32)  # [layers, page, heads, head_dim]
+    pages = []
+    for i in range(48):
+        key = (i, tuple(int(t) for t in rng.randint(0, 96, 4)))
+        if i % 2:
+            scale = rng.rand(4, 4).astype(np.float32)
+            pages.append(SpilledPage(
+                key=key, serial=i,
+                k=rng.randint(-128, 128, shape).astype(np.int8),
+                v=rng.randint(-128, 128, shape).astype(np.int8),
+                k_scale=scale, v_scale=scale))
+        else:
+            pages.append(SpilledPage(
+                key=key, serial=i,
+                k=rng.randn(*shape).astype(np.float32),
+                v=rng.randn(*shape).astype(np.float32),
+                k_scale=None, v_scale=None))
+    t0 = time.perf_counter()
+    frames = [encode_page(p) for p in pages]
+    enc_dt = time.perf_counter() - t0
+    nbytes = sum(len(f) for f in frames)
+    t0 = time.perf_counter()
+    for f in frames:
+        kind, _ = decode_frame(f)
+        assert kind == "page"
+    dec_dt = time.perf_counter() - t0
+    out["serving_wire_frame_bytes"] = nbytes
+    out["serving_wire_encode_mb_per_sec"] = round(nbytes / enc_dt / 1e6, 1)
+    out["serving_wire_decode_mb_per_sec"] = round(nbytes / dec_dt / 1e6, 1)
+
+    # fleet legs: one shared warm prefix (the affinity + page-fetch
+    # signal), two waves through 2 replicas, the wire dialed from
+    # lossless to 10% drop + 5% corrupt
+    paddle.seed(34)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=96, hidden_size=64, num_layers=2, num_heads=4,
+        max_seq_len=96, dropout=0.0))
+    model.eval()
+    wrng = np.random.RandomState(21)
+    system = wrng.randint(0, 96, (16,)).astype(np.int32)
+
+    def jobs():
+        mk = lambda tail: np.concatenate(  # noqa: E731
+            [system, wrng.randint(0, 96, (tail,))]).astype(np.int32)
+        return [(mk(4), 8) for _ in range(6)]
+
+    eng = ServingConfig(max_batch=2, num_pages=64, page_size=4,
+                        max_prompt_len=32, host_tier_bytes=1 << 20)
+    for loss in (0.0, 0.02, 0.10):
+        transport = Transport(
+            SimChannel(ChannelConfig(seed=11, drop_rate=loss,
+                                     corrupt_rate=loss / 2)),
+            TransportConfig(seed=11, timeout_s=0.5))
+        fleet = FleetRouter(model, FleetConfig(
+            num_replicas=2, engine=eng, transport=transport,
+            fetch_pages=True))
+        trace = jobs() + jobs()
+        total_tokens = sum(n for _, n in trace)
+        rids, outs = [], {}
+        t0 = time.perf_counter()
+        for p, n in jobs():
+            rids.append(fleet.submit(p, n))
+        outs.update(fleet.run())
+        for p, n in jobs():  # the warm wave rides the wire's fetches
+            rids.append(fleet.submit(p, n))
+        outs.update(fleet.run())
+        dt = time.perf_counter() - t0
+        tag = f"loss_{int(loss * 100)}pct"
+        assert sorted(outs) == sorted(rids), \
+            f"{tag}: wire loss lost rids " \
+            f"{sorted(set(rids) - set(outs))}"
+        snap = fleet.metrics.snapshot()
+        good = sum(v for k, v in snap.items() if k.startswith(
+            "serving_tenant_goodput_tokens_total"))
+        bad = sum(v for k, v in snap.items() if k.startswith(
+            "serving_tenant_badput_tokens_total"))
+        assert good + bad == snap["serving_tokens_total"], \
+            f"{tag}: ledger does not reconcile: {good}+{bad} != " \
+            f"{snap['serving_tokens_total']}"
+        if loss == 0.0:
+            assert transport.retries_total == 0, \
+                "lossless channel retried — the parity pin is void"
+        else:
+            assert transport.retries_total > 0, \
+                f"{tag}: seeded loss produced no retries"
+        out[f"serving_wire_tokens_per_sec_{tag}"] = round(
+            total_tokens / dt, 1)
+        out[f"serving_wire_retries_{tag}"] = transport.retries_total
+        out[f"serving_wire_timeouts_{tag}"] = transport.timeouts_total
+        out[f"serving_wire_tx_bytes_{tag}"] = transport.tx_bytes
+        out[f"serving_wire_refetch_fallbacks_{tag}"] = int(
+            snap["serving_wire_refetch_fallback_total"])
+    return out
+
+
 def _serving_ragged_kernel_bench() -> dict:
     """Serving phase: the unified ragged paged-attention kernel vs the
     gather+sdpa composite, fp32 and int8 — the ROADMAP's raw-decode A/B.
@@ -1255,6 +1375,12 @@ def run_bench(platform: str) -> dict:
             print(f"[bench] serving fleet phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
+        try:
+            r["serving_wire"] = _serving_wire_bench()
+        except Exception as e:  # noqa: BLE001 — never forfeit the headline number
+            print(f"[bench] serving wire phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
         return r
 
     deadline = float(os.environ.get(_DEADLINE_ENV, time.time() + _TPU_BUDGET_S))
@@ -1360,6 +1486,18 @@ def run_bench(platform: str) -> dict:
                                   provenance="serving-fleet-bench"))
         except Exception as e:  # noqa: BLE001 — never forfeit the train number
             print(f"[bench] serving fleet phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
+    if remaining() > 45:
+        try:
+            result["serving_wire"] = _serving_wire_bench()
+            # bank the wire-transport numbers as their own provenance-
+            # labeled history row (skipped by last_known_tpu)
+            _bank_tpu_result(dict(result["serving_wire"],
+                                  platform=result.get("platform"),
+                                  provenance="serving-wire-bench"))
+        except Exception as e:  # noqa: BLE001 — never forfeit the train number
+            print(f"[bench] serving wire phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
     return result

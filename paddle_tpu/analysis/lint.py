@@ -77,6 +77,16 @@ Every rule encodes a regression that cost a review cycle (or worse, landed):
   silently bypasses ALL of it, the exact hole the fleet layer exists to
   close. The router's one sanctioned dispatch site carries the pragma;
   anything else in a fleet module fires.
+- PT014 — a raw serialization/transport primitive (``pickle``/``socket``
+  imports, ``pickle.*``/``socket.*`` attribute use, or ``struct``
+  pack/unpack) in ``serving/`` outside ``wire.py``: every byte that
+  crosses a replica boundary must go through the ONE versioned codec
+  (``serving/wire.py`` — magic + version + length-prefixed frames, CRC
+  trailer, typed ``WireError`` taxonomy). Ad-hoc framing forks the
+  schema invisibly, pickle swallows corruption that the taxonomy counts
+  by kind, and a raw socket bypasses the transport's retry/breaker
+  policy AND its fault points — the codec module itself is gated out by
+  filename (it IS the sanctioned user).
 
 Suppression: a ``# lint: disable=PT001`` (comma-separated for several)
 pragma on the finding's line, or an entry in :data:`ALLOWLIST` mapping a
@@ -109,7 +119,7 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
     "lint_fixtures": {f"PT00{i}" for i in range(1, 10)}
-    | {"PT010", "PT011", "PT012", "PT013"},
+    | {"PT010", "PT011", "PT012", "PT013", "PT014"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -631,6 +641,48 @@ def _pt013(tree, path):
                    "dispatch site carries the pragma).")
 
 
+_PT014_MODULES = ("pickle", "socket")
+_PT014_STRUCT_FNS = ("pack", "unpack", "pack_into", "unpack_from",
+                     "iter_unpack", "calcsize", "Struct")
+
+
+def _pt014(tree, path):
+    """Raw serialization/transport primitive in serving/ outside the
+    codec module. Gated on the filename (like PT013): serving/wire.py
+    IS the sanctioned user — the rule exists so the versioned framed
+    codec stays the only place replica-boundary bytes are shaped."""
+    if Path(path).name == "wire.py":
+        return
+    for node in ast.walk(tree):
+        mods = []
+        if isinstance(node, ast.Import):
+            mods = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom):
+            mods = [(node.module or "").split(".")[0]]
+        for m in mods:
+            if m in _PT014_MODULES + ("struct",):
+                yield (node.lineno,
+                       f"raw {m!r} import in serving/ outside wire.py — "
+                       f"bytes that cross a replica boundary go through "
+                       f"the versioned wire codec (serving/wire.py: "
+                       f"encode_*/decode_frame, CRC-trailed, typed "
+                       f"WireError taxonomy). Ad-hoc {m} framing forks "
+                       f"the schema and skips corruption accounting, "
+                       f"retry policy, and the wire fault points.")
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name):
+            base = node.value.id
+            if base in _PT014_MODULES or (
+                    base == "struct" and node.attr in _PT014_STRUCT_FNS):
+                yield (node.lineno,
+                       f"raw {base}.{node.attr} in serving/ outside "
+                       f"wire.py — shape these bytes through the "
+                       f"versioned wire codec (serving/wire.py) so the "
+                       f"frame format stays single-sourced and every "
+                       f"decode failure lands in the typed WireError "
+                       f"taxonomy the transport counts by kind.")
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -668,6 +720,9 @@ RULES: dict[str, Rule] = {r.code: r for r in (
     Rule("PT013", "direct ServingEngine.add_request in serving/fleet* "
          "bypassing the router's weighted admission path", _pt013,
          scope="serving"),
+    Rule("PT014", "raw pickle/socket/struct in serving/ outside "
+         "wire.py — replica-boundary bytes must go through the "
+         "versioned wire codec", _pt014, scope="serving"),
 )}
 
 

@@ -50,7 +50,8 @@ _PID = 1
 _INSTANTS = ("pallas_fallback",
              "preempted", "swap_out", "swap_in", "decode_mark",
              "prefill_chunk", "retired", "spill", "restore",
-             "spec_verify")
+             "spec_verify",
+             "wire_retry", "refetch_fallback", "breaker_open")
 
 
 def _request_events(trace: RequestTrace) -> list[dict]:

@@ -68,6 +68,12 @@ _EVENT_KINDS = {
     "routed": "routed",
     "spilled": "spilled",
     "shed_by_router": "shed",
+    # wire-transport hops (PR 17): stamped by the router around its
+    # transport exchanges — the same v1-compatible extension shape
+    # (JOURNEY_KINDS grows, nothing moves, old dumps stay valid)
+    "wire_retry": "wire_retry",
+    "refetch_fallback": "refetch_fallback",
+    "breaker_open": "breaker_open",
 }
 
 #: every hop kind a validate_journey-clean record may carry

@@ -69,10 +69,27 @@ actuating the ``slo_burn`` signal (the outer loop over each replica's
 AIMD SLO controller); ``serving/fleet_sim.py`` replays a journey dump
 against hypothetical fleet shapes (``python -m
 paddle_tpu.serving.fleet_sim``).
+
+Wire layer (serving/wire.py + serving/channel.py + serving/chaos.py):
+the fleet's replica boundary as BYTES — a versioned framed codec
+(``paddle-tpu/wire/v1``: spilled KV pages fp32 and int8, gossip digest
+sets, re-home records, CRC32 trailers, a typed ``WireError`` taxonomy)
+under a fault-tolerant ``Transport`` policy (per-peer timeouts, bounded
+retries with exponential backoff + deterministic jitter, optional
+hedged reads, per-peer circuit breakers) over a seeded lossy
+``SimChannel``. A lossless channel is pinned bit-identical to the
+in-process fleet; every loss mode degrades (local re-prefill, local
+re-home, stale-gossip routing) and never loses an accepted request —
+``serving/chaos.py`` + ``tools/chaos_soak.py`` keep that honest by
+arming EVERY registered fault point over a lossy fleet and sweeping
+the pool/journey/ledger invariants after every step.
 """
 from ..obs import TenantLedger, TenantSLO  # noqa: F401 — the per-tenant
 # SLO class + ledger live in obs (serving imports obs, never the
 # reverse); re-exported here because ServingConfig(tenants=) takes them
+from .channel import (ChannelConfig, CircuitBreaker,  # noqa: F401
+                      SimChannel, Transport, TransportConfig)
+from .chaos import ChaosConfig, ChaosInvariantError  # noqa: F401
 from .engine import (ServingConfig, ServingEngine,  # noqa: F401
                      prefill_buckets)
 from .faults import FaultInjector, InjectedFault  # noqa: F401
@@ -84,6 +101,9 @@ from .metrics import ServingMetrics  # noqa: F401
 from .scheduler import EngineOverloaded, Request, Scheduler  # noqa: F401
 from .slo import SLOConfig, SLOController  # noqa: F401
 from .spec import SpecConfig  # noqa: F401
+from .wire import (WIRE_SCHEMA, RehomeRecord, WireError,  # noqa: F401
+                   decode_frame, encode_digests, encode_page,
+                   encode_rehome)
 
 __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "PagedKVCache", "PageAllocator", "SwapHandle", "ServingMetrics",
@@ -91,4 +111,9 @@ __all__ = ["ServingConfig", "ServingEngine", "PagedCacheConfig",
            "InjectedFault", "prefill_buckets", "SLOConfig",
            "SLOController", "HostTier", "HostTierRestoreError",
            "SpilledPage", "SpecConfig", "TenantSLO", "TenantLedger",
-           "FleetConfig", "FleetRouter", "prefix_digest"]
+           "FleetConfig", "FleetRouter", "prefix_digest",
+           "WIRE_SCHEMA", "WireError", "RehomeRecord", "encode_page",
+           "encode_digests", "encode_rehome", "decode_frame",
+           "ChannelConfig", "SimChannel", "TransportConfig",
+           "Transport", "CircuitBreaker", "ChaosConfig",
+           "ChaosInvariantError"]

@@ -43,6 +43,27 @@ the engine — install the injector on the FleetRouter for these:
   survivors (counted as spills); its in-flight requests retire FAILED;
   survivors keep serving and the ``serving_fleet_replicas`` gauge drops.
 
+Four wire-grain points consulted by the TRANSPORT (serving/channel.py)
+per attempt, when the router has attached its injector to it:
+
+- ``wire_drop``     every frame of one transport attempt vanishes in
+  flight — matched by the request id the exchange serves (``rid=None``
+  arms also hit gossip exchanges, which carry no rid). The transport
+  waits out the timeout and retries with backoff; an exchange whose
+  whole retry budget is drop-armed fails and the caller degrades
+  (stale gossip / local re-prefill / in-process re-home) — never a
+  lost request.
+- ``wire_corrupt``  one frame of the attempt is bit-flipped in flight:
+  the decode fails with a typed WireError, is counted by kind in
+  ``serving_wire_corrupt_total{kind=}``, and the attempt retries.
+- ``wire_delay``    the attempt's arrival latency is inflated by
+  ``delay_s`` virtual seconds — push it past the transport's
+  ``timeout_s`` to drill the slow-peer (not dead-peer) path.
+- ``peer_timeout``  the attempt times out outright. Like
+  ``replica_down``, ``rid`` carries the PEER (replica) INDEX — arm
+  with ``rid=<peer index>`` to make one peer unresponsive; enough
+  consecutive failed exchanges then open that peer's circuit breaker.
+
 Every fault is consulted BEFORE the state transition it poisons, so the
 host-side scheduler/cache state after a fault equals the pre-step snapshot
 minus the retired request — no partial mutations to roll back, and page
@@ -58,7 +79,8 @@ from dataclasses import dataclass, field
 
 POINTS = ("prefill_fail", "chunk_fail", "decode_fail", "verify_fail",
           "pool_exhausted", "restore_fail", "slow_step",
-          "route_fail", "replica_down")
+          "route_fail", "replica_down",
+          "wire_drop", "wire_corrupt", "wire_delay", "peer_timeout")
 
 
 class InjectedFault(RuntimeError):

@@ -41,14 +41,36 @@ validate_journey-clean journeys in the router's OWN book (``shed`` hop,
 ``retired`` terminal). Chrome export merges one process track per
 replica (pid = replica index + 1; timestamps are per-replica rebased).
 
+**Wire transport** (``FleetConfig(transport=...)``, a
+:class:`~paddle_tpu.serving.channel.Transport`): with a transport
+attached, everything that crosses a replica boundary travels as
+``paddle-tpu/wire/v1`` frames (serving/wire.py) instead of method
+calls — gossip digest sets, re-homed waiters off a dead replica, and
+(``fetch_pages=True``) warm prefix pages fetched from a better-matched
+peer into the destination's host tier before dispatch. Every transfer
+can die, and every death degrades instead of failing: a failed gossip
+exchange keeps the stale digest set; a failed re-home frame falls back
+to the in-process hand-off (a lost frame can never lose a request); a
+corrupt/timed-out page fetch falls back to local re-prefill — counted
+in ``serving_wire_refetch_fallback_total`` and stamped as a
+``refetch_fallback`` journey hop, never a FAILED retirement; a peer
+behind an open circuit breaker contributes zero affinity, so routing
+degrades to least-loaded until the breaker half-opens. Over a lossless
+channel the wire fleet is bit-identical (outputs, retirement classes,
+host-sync counts) to the in-process ``transport=None`` fleet — pinned
+by test; transport time runs on its own deterministic timeline
+precisely so the parity can hold.
+
 Fault points (serving/faults.py, consulted on the ROUTER's injector):
 ``route_fail`` sheds one request at its routing decision;
 ``replica_down`` (armed with ``rid=<replica index>``) kills a replica
 at a step boundary — its never-admitted waiters drain back to the
 router and re-route to survivors as spills, its in-flight requests
-retire FAILED, and the ``serving_fleet_replicas`` gauge drops. The
-whole fleet runs on the deterministic clock: N replicas, faults and
-all, fully sleep-free-testable on CPU.
+retire FAILED, and the ``serving_fleet_replicas`` gauge drops. With a
+transport attached the same injector also drives the wire-grain points
+(``wire_drop`` / ``wire_corrupt`` / ``wire_delay`` / ``peer_timeout``).
+The whole fleet runs on the deterministic clock: N replicas, faults
+and all, fully sleep-free-testable on CPU.
 
 The admission path is the router — lint rule PT013 flags any direct
 ``.add_request(...)`` call in ``serving/fleet*.py`` except the one
@@ -71,6 +93,8 @@ from .faults import InjectedFault
 from .kv_cache import prefix_digest
 from .metrics import PREFIX as _METRIC_PREFIX
 from .metrics import TENANT_CLASSES
+from .wire import (encode_digests, encode_page, encode_rehome,
+                   WIRE_ERROR_KINDS)
 from .scheduler import (EXPIRED, FAILED, SHED, WAITING, EngineOverloaded,
                         _rid_counter)
 from .scheduler import Request as _Request
@@ -99,6 +123,14 @@ class FleetConfig:
     weight_gain: float = 2.0  # admission-weight multiplier per slo_burn
     # onset (the outer-loop gain; weights never decay on their own —
     # the inner AIMD controller is the fast loop)
+    transport: object = None  # a channel.Transport; None keeps every
+    # replica boundary an in-process method call (the pre-wire fleet,
+    # byte-for-byte — the parity baseline)
+    fetch_pages: bool = False  # with a transport: fetch a warmer peer's
+    # prefix pages into the destination's host tier before dispatch
+    # (restores then hit locally); off by default — a fetch turns cold
+    # dispatches into host-tier restores, which changes the host-sync
+    # profile the lossless parity pin holds fixed
 
     def validate(self) -> None:
         if self.num_replicas < 1:
@@ -117,6 +149,14 @@ class FleetConfig:
             raise ValueError(
                 f"weight_gain {self.weight_gain} must be > 1 (a gain "
                 f"<= 1 could never grant a burning tenant capacity)")
+        if self.fetch_pages and self.transport is None:
+            raise ValueError("fetch_pages needs a transport (pages "
+                             "move as wire frames, never in-process)")
+        if self.fetch_pages and not self.engine.host_tier_bytes:
+            raise ValueError(
+                "fetch_pages needs engine.host_tier_bytes > 0 — the "
+                "host tier is the landing zone fetched pages restore "
+                "from")
 
 
 @dataclass(eq=False)  # identity semantics — the ndarray prompt field
@@ -182,6 +222,17 @@ class FleetRouter:
         #: the once-per-onset pin reads this
         self.weight_changes: list[tuple[int, str, float]] = []
         self._weights: dict[str, float] = {}
+        self.transport = cfg.transport
+        if self.transport is not None:
+            self.transport.attach(metrics=self.metrics,
+                                  injector=fault_injector)
+        # wire families are pre-seeded whether or not a transport is
+        # attached — the presence contract (PT003/PT012) is about
+        # dashboards, and a dashboard doesn't know the fleet's config
+        self.metrics.seed_family("wire_corrupt_total",
+                                 list(WIRE_ERROR_KINDS))
+        self.metrics.seed_family("breaker_open_total",
+                                 [str(i) for i in range(cfg.num_replicas)])
         self.metrics.on_fleet_replicas(cfg.num_replicas)
         for t in ["default"] + sorted(
                 n for n in (cfg.engine.tenants or {}) if n != "default"):
@@ -266,13 +317,66 @@ class FleetRouter:
     def _affinity(self, digests: tuple, i: int) -> int:
         """Warm-match tokens replica ``i``'s gossiped digest set holds
         for a prompt with chain ``digests`` — the router-side mirror of
-        ``cached_prefix_tokens`` (parity-pinned)."""
+        ``cached_prefix_tokens`` (parity-pinned). A peer behind an OPEN
+        circuit breaker contributes zero: its digests are stale by
+        definition (every refresh is failing), so affinity routing
+        degrades to least-loaded until the breaker half-opens."""
+        if self.transport is not None and self.transport.peer_open(i):
+            return 0
         n = 0
         for d in digests:
             if d not in self._gossip[i]:
                 break
             n += 1
         return n * self._page_size
+
+    def _refresh_gossip(self, i: int) -> frozenset:
+        """Replica ``i``'s current digest set, through the transport
+        when one is attached (one digests frame each way). A failed
+        exchange — loss past the retry budget, timeout, open breaker —
+        keeps the STALE set: gossip is advisory, so degradation costs
+        at worst a suboptimal route, never a lost refresh loop."""
+        digests = self.replicas[i].cache.gossip_digests()
+        if self.transport is None:
+            return digests
+        got = self.transport.exchange(i, [encode_digests(digests)],
+                                      step=self._step_idx)
+        if got is None:
+            return self._gossip[i]
+        return got[0][1]
+
+    def _fetch_pages(self, p: _Pending, dest: int):
+        """Cross-replica KV-fabric fetch for one placed request: when a
+        live peer's gossiped digests hold a strictly longer warm match
+        than the destination, export that peer's prefix chain, move it
+        as page frames through the transport (hedged per the transport
+        config), and import it into the destination's host tier — the
+        admission that follows then restores the pages as an ordinary
+        (bit-exact) host-tier hit. Returns ``(donor, ok, info)`` with
+        donor None when no fetch was warranted; a failed fetch is the
+        caller's cue to stamp ``refetch_fallback`` and dispatch anyway
+        (local re-prefill) — NEVER to fail the request."""
+        digests = prefix_digest(p.prompt, self._page_size)
+        local = self._affinity(digests, dest)
+        donors = [j for j in self._live() if j != dest
+                  and self._affinity(digests, j) > local]
+        if not donors:
+            return (None, True, None)
+        donor = max(donors, key=lambda j: (self._affinity(digests, j), -j))
+        src = self.replicas[donor].cache
+        entries = src.export_prefix_chain(
+            p.prompt, max_pages=src.cfg.pages_per_seq)
+        if not entries:
+            return (None, True, None)  # stale gossip: nothing to move
+        got = self.transport.exchange(
+            donor, [encode_page(e) for e in entries],
+            step=self._step_idx, rid=p.rid)
+        info = self.transport.last
+        if got is None:
+            return (donor, False, info)
+        self.replicas[dest].cache.import_spilled_chain(
+            [v for _, v in got])
+        return (donor, True, info)
 
     def _place(self, p: _Pending) -> tuple[int, str, int] | None:
         """(replica, kind, affinity_tokens) for one request, or None
@@ -323,6 +427,12 @@ class FleetRouter:
         if placed is None:
             return False
         i, kind, affinity_tokens = placed
+        donor, fetch_ok, fetch_info = (None, True, None)
+        if self.transport is not None and self.config.fetch_pages:
+            # move a warmer peer's pages BEFORE dispatch so the
+            # admission below restores them as a plain host-tier hit;
+            # a dead fetch degrades to local re-prefill, stamped below
+            donor, fetch_ok, fetch_info = self._fetch_pages(p, i)
         eng = self.replicas[i]
         remaining = None if p.deadline is None \
             else max(p.deadline - self.now(), 0.0)
@@ -338,6 +448,17 @@ class FleetRouter:
         if tr is not None:
             tr.event(rid, "routed" if kind == "routed" else "spilled",
                      replica=i, affinity_tokens=affinity_tokens)
+            if fetch_info is not None:
+                # the journey is born at the enqueue above, so the
+                # fetch's transport hops are stamped here, just after
+                for k in range(fetch_info.retries):
+                    tr.event(rid, "wire_retry", peer=donor, attempt=k + 1)
+                if fetch_info.breaker_open:
+                    tr.event(rid, "breaker_open", peer=donor)
+            if not fetch_ok:
+                tr.event(rid, "refetch_fallback", peer=donor)
+        if not fetch_ok:
+            self.metrics.on_wire_refetch_fallback()
         self.routes[rid] = (i, kind, affinity_tokens)
         if kind == "spilled":
             self.metrics.on_fleet_spill()
@@ -410,12 +531,38 @@ class FleetRouter:
                              reason="replica_down")
                 eng.scheduler.evict(req)
                 eng._requests.pop(req.rid, None)
-                self._pending.append(_Pending(
+                pend = _Pending(
                     rid=req.rid, prompt=req.prompt,
                     max_new_tokens=req.max_new_tokens,
                     deadline=req.deadline, tenant=req.tenant,
                     seq=next(self._seq), submit_t=self.now(),
-                    spill=True))
+                    spill=True)
+                if self.transport is not None:
+                    # the waiter travels as a rehome frame; when the
+                    # exchange dies the LOCAL copy re-homes instead (a
+                    # lost frame can never lose a request — the frame
+                    # is the transport, not the custody)
+                    got = self.transport.exchange(
+                        i, [encode_rehome(req.rid, req.prompt,
+                                          req.max_new_tokens,
+                                          req.deadline, req.tenant)],
+                        step=self._step_idx, rid=req.rid)
+                    info = self.transport.last
+                    if tr is not None:
+                        for k in range(info.retries):
+                            tr.event(req.rid, "wire_retry", peer=i,
+                                     attempt=k + 1)
+                        if info.breaker_open:
+                            tr.event(req.rid, "breaker_open", peer=i)
+                    if got is not None:
+                        rh = got[0][1]
+                        pend = _Pending(
+                            rid=rh.rid, prompt=rh.prompt,
+                            max_new_tokens=rh.max_new_tokens,
+                            deadline=rh.deadline, tenant=rh.tenant,
+                            seq=pend.seq, submit_t=pend.submit_t,
+                            spill=True)
+                self._pending.append(pend)
             else:
                 eng._retire(req, FAILED, fault)
                 eng.metrics.on_failed()
@@ -441,7 +588,7 @@ class FleetRouter:
                     self._mark_down(i)
         if (self._step_idx - 1) % self.config.gossip_every == 0:
             for i in self._live():
-                self._gossip[i] = self.replicas[i].cache.gossip_digests()
+                self._gossip[i] = self._refresh_gossip(i)
         now = self.now()
         expired = [p for p in self._pending
                    if p.deadline is not None and now >= p.deadline]
@@ -589,6 +736,20 @@ class FleetRouter:
                     ev["args"] = {
                         "name": f"paddle_tpu.serving/replica{i}"}
                 events.append(ev)
+        if self.transport is not None and self.transport.breaker_events:
+            # circuit-breaker transitions get their own process track —
+            # they live on the transport's deterministic timeline, not
+            # any replica's clock, so they must not share a rebase
+            pid = len(self.replicas) + 1
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": pid, "tid": 0,
+                           "args": {"name":
+                                    "paddle_tpu.serving/transport"}})
+            for t, peer, state in self.transport.breaker_events:
+                events.append({"name": f"breaker:{state}", "ph": "i",
+                               "ts": t * 1e6, "pid": pid, "tid": 0,
+                               "s": "g", "cat": "transport",
+                               "args": {"peer": peer, "state": state}})
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
         if path is not None:
             with open(path, "w") as f:

@@ -24,7 +24,10 @@ touching a model or a device:
 
 Non-terminal records (state None — e.g. the dead-replica half of a
 re-homed request's journey pair) are skipped by both: they describe no
-retirement and consumed no attributable service.
+retirement and consumed no attributable service. Hops of kinds this
+build does not know (a NEWER writer's v1-compatible extension) are
+stripped and counted, never fatal — the what-if report carries the
+count so a truncated replay is visible, not silent.
 
 CLI::
 
@@ -41,7 +44,7 @@ from __future__ import annotations
 import argparse
 import json
 
-from ..obs.journey import validate_journey
+from ..obs.journey import JOURNEY_KINDS, validate_journey
 from ..obs.tenant import CLASSES, TenantLedger, TenantSLO
 
 __all__ = ["replay_classes", "simulate", "main"]
@@ -55,12 +58,34 @@ def _percentile(values: list[float], q: float) -> float:
     return xs[idx]
 
 
-def _records(dump) -> list[dict]:
+def _records(dump) -> tuple[list[dict], int]:
     """Normalize a dump: a flight record (dict with ``journeys``) or a
-    bare list of wire journeys; every record is schema-validated."""
+    bare list of wire journeys; every record is schema-validated.
+    Returns ``(records, unknown_hops)``: hops whose ``kind`` a NEWER
+    writer minted (the journey schema is a v1-compatible extension
+    point — ``JOURNEY_KINDS`` grows, nothing moves) are stripped and
+    counted instead of failing validation, so an old replayer degrades
+    to skipping the hops it cannot interpret rather than refusing the
+    whole dump. Malformed hops (non-dict, missing fields) still fail —
+    forward-compat forgives NEW vocabulary, not broken grammar."""
     if isinstance(dump, dict):
         dump = dump.get("journeys", [])
-    return [validate_journey(r) for r in dump]
+    out, unknown = [], 0
+    for rec in dump:
+        if isinstance(rec, dict) and isinstance(rec.get("hops"), list):
+            keep = []
+            for hop in rec["hops"]:
+                if (isinstance(hop, dict)
+                        and all(f in hop for f in ("kind", "step", "t"))
+                        and isinstance(hop["kind"], str)
+                        and hop["kind"] not in JOURNEY_KINDS):
+                    unknown += 1
+                else:
+                    keep.append(hop)
+            if len(keep) != len(rec["hops"]):
+                rec = dict(rec, hops=keep)
+        out.append(validate_journey(rec))
+    return out, unknown
 
 
 def replay_classes(dump, slos: dict | None = None) -> dict:
@@ -71,7 +96,7 @@ def replay_classes(dump, slos: dict | None = None) -> dict:
     record preserves verbatim."""
     ledger = TenantLedger(slos)
     counts: dict[str, dict[str, int]] = {}
-    for rec in _records(dump):
+    for rec in _records(dump)[0]:
         state = rec["state"]
         if state is None:
             continue
@@ -109,7 +134,8 @@ def simulate(dump, replicas: int, slots: int,
         raise ValueError(f"slots {slots} < 1")
     weights = dict(weights or {})
     jobs, unserved = [], 0
-    for rec in _records(dump):
+    records, unknown_hops = _records(dump)
+    for rec in records:
         if rec["state"] is None:
             continue
         t0 = _arrival(rec)
@@ -131,7 +157,8 @@ def simulate(dump, replicas: int, slots: int,
         delays.setdefault(tenant, []).append(start - t0)
     out = {
         "replicas": replicas, "slots": slots, "served": len(jobs),
-        "unserved": unserved, "makespan_s": makespan, "tenants": {}}
+        "unserved": unserved, "unknown_hops": unknown_hops,
+        "makespan_s": makespan, "tenants": {}}
     for tenant, ds in sorted(delays.items()):
         out["tenants"][tenant] = {
             "requests": len(ds),
@@ -178,6 +205,9 @@ def format_report(classes: dict, what_if: dict) -> str:
         f"{what_if['slots']} slot(s) — {what_if['served']} served, "
         f"{what_if['unserved']} unserved, "
         f"makespan {what_if['makespan_s']:.3f}s")
+    if what_if.get("unknown_hops"):
+        lines.append(f"note: skipped {what_if['unknown_hops']} hop(s) "
+                     f"of kinds newer than this build")
     lines.append(f"{'tenant':<16}{'requests':>10}{'qd_mean_s':>12}"
                  f"{'qd_p99_s':>12}{'qd_max_s':>12}")
     for tenant, row in sorted(what_if["tenants"].items()):

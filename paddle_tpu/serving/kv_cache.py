@@ -697,6 +697,109 @@ class PagedKVCache:
         del by_serial[0]
         return frozenset(by_serial.values())
 
+    def export_prefix_chain(self, tokens,
+                            max_pages: int | None = None) -> list:
+        """The longest resolvable prefix chain covering ``tokens`` as
+        STANDALONE :class:`SpilledPage` copies — the payload of a
+        cross-replica page fetch (serving/fleet.py encodes each through
+        serving/wire.py). Device-index pages are gathered through the
+        same jitted program spills use (chunked at ``pages_per_seq`` —
+        an export can never retrigger a compile), then the host tier's
+        continuation is copied as-is. Read-only: no refcounts move, no
+        tier LRU reorder, no index change — the donor replica keeps
+        serving exactly as before. Entries come back in chain order
+        from the root."""
+        import jax.numpy as jnp
+
+        pages = self.match_prefix(tokens)
+        parent = self._page_serial[pages[-1]] if pages else 0
+        spilled = self._match_host_tail(tokens, parent, len(pages),
+                                        touch=False)
+        if max_pages is not None:
+            pages = pages[:max_pages]
+            spilled = spilled[:max(0, max_pages - len(pages))]
+        out: list[SpilledPage] = []
+        w = self.cfg.pages_per_seq
+        for at in range(0, len(pages), w):
+            chunk = pages[at:at + w]
+            got = self._gather_jit(self.pools,
+                                   jnp.asarray(self._padded_idx(chunk)))
+            if self.cfg.quantized:
+                k, v, ks, vs = (np.asarray(a) for a in got)
+            else:
+                k, v = (np.asarray(a) for a in got)
+                ks = vs = None
+            for j, page in enumerate(chunk):
+                out.append(SpilledPage(
+                    key=self._page_key[page],
+                    serial=self._page_serial[page],
+                    k=k[:, j].copy(), v=v[:, j].copy(),
+                    k_scale=None if ks is None else ks[:, j].copy(),
+                    v_scale=None if vs is None else vs[:, j].copy()))
+        out.extend(SpilledPage(
+            key=e.key, serial=e.serial, k=e.k.copy(), v=e.v.copy(),
+            k_scale=None if e.k_scale is None else e.k_scale.copy(),
+            v_scale=None if e.v_scale is None else e.v_scale.copy())
+            for e in spilled)
+        return out
+
+    def import_spilled_chain(self, entries) -> int:
+        """Adopt a peer's exported prefix chain into the LOCAL host
+        tier — the receiving half of a cross-replica page fetch. Serial
+        spaces are per-cache (``itertools.count(1)``), so peer serials
+        are REMAPPED: entries are chain-walked from the root (arrival
+        order is irrelevant — the wire may reorder frames), and each
+        block either already exists locally — device index or tier,
+        first-registration-wins, the peer copy is dropped — or is
+        inserted under a FRESH local serial with its key re-parented
+        onto the local chain. The next admission then restores these
+        pages bit-exactly through the ordinary host-tier path (the
+        tier IS the landing zone). Returns pages newly inserted."""
+        if self.host_tier is None:
+            raise ValueError(
+                "import_spilled_chain needs the host tier "
+                "(host_tier_bytes > 0) as its landing zone")
+        want_dtype = np.dtype(np.int8) if self.cfg.quantized \
+            else np.dtype(np.float32)
+        by_parent: dict[int, SpilledPage] = {}
+        for e in entries:
+            by_parent.setdefault(int(e.key[0]), e)
+        new = 0
+        src_parent = 0  # cursor in the PEER's serial space
+        parent = 0      # the chain so far in the LOCAL serial space
+        while src_parent in by_parent:
+            e = by_parent.pop(src_parent)
+            src_parent = int(e.serial)
+            if e.k.dtype != want_dtype \
+                    or (e.k_scale is None) == self.cfg.quantized:
+                raise ValueError(
+                    f"imported page dtype {e.k.dtype}/scales="
+                    f"{e.k_scale is not None} does not match this "
+                    f"pool (kv_dtype={self.cfg.kv_dtype!r})")
+            key = (parent, tuple(e.key[1]))
+            page = self._key_to_page.get(key)
+            if page is not None:
+                parent = self._page_serial[page]
+                continue
+            held = self.host_tier.get(key, touch=False)
+            if held is not None:
+                parent = held.serial
+                continue
+            serial = next(self._serials)
+            self.host_tier.put(SpilledPage(
+                key=key, serial=serial,
+                k=np.array(e.k, copy=True), v=np.array(e.v, copy=True),
+                k_scale=None if e.k_scale is None
+                else np.array(e.k_scale, copy=True),
+                v_scale=None if e.v_scale is None
+                else np.array(e.v_scale, copy=True)))
+            if self.host_tier.get(key, touch=False) is None:
+                break  # refused at the byte bound: descendants would
+                # chain onto a parent the tier no longer holds
+            parent = serial
+            new += 1
+        return new
+
     def _unregister(self, page: int) -> None:
         key = self._page_key.pop(page, None)
         if key is not None:

@@ -278,6 +278,9 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "page_pool_used", "page_utilization", "mfu", "hbm_bw_util",
            "fleet_replicas", "fleet_prefix_affinity_hits_total",
            "fleet_spills_total",
+           "wire_tx_bytes_total", "wire_rx_bytes_total",
+           "wire_retries_total", "wire_hedge_wins_total",
+           "wire_refetch_fallback_total",
            "queue_depth_peak", "page_pool_peak")
 
 # labeled stat families: base name -> label key, or an ORDERED tuple of
@@ -302,6 +305,10 @@ _FAMILIES = {
     # terminal class — the one multi-label family (badput breakdown)
     "fleet_tenant_weight": "tenant",      # router admission weight (the
     # slo_burn-actuated outer-loop gain; 1.0 until a burn onset)
+    "wire_corrupt_total": "kind",         # counter: decode failures by
+    # WireError taxonomy kind (truncated / corrupt / bad_version)
+    "breaker_open_total": "peer",         # counter: circuit-breaker
+    # open transitions per peer replica index
     "ttft_s": "tenant",                   # histogram family (per-tenant
     "tpot_s": "tenant",                   # latency classes; the plain
     "queue_delay_s": "tenant",            # serving_ttft_s etc. hist
@@ -335,7 +342,9 @@ COUNTER_STATS = frozenset(
         PREFIX + "alerts_total",
         PREFIX + "tenant_goodput_tokens_total",
         PREFIX + "tenant_badput_tokens_total",
-        PREFIX + "tenant_retired_total"})
+        PREFIX + "tenant_retired_total",
+        PREFIX + "wire_corrupt_total",
+        PREFIX + "breaker_open_total"})
 
 
 class ServingMetrics:
@@ -420,7 +429,13 @@ class ServingMetrics:
             key = self._family_key(base, v)
             if v not in seen:
                 seen.append(v)
-            monitor.stat_set(key, 0)
+            # seeding declares PRESENCE — it must never erase history.
+            # Replicas share the one monitor registry, and a second
+            # replica first seeing an ad-hoc tenant mid-run would
+            # otherwise zero counts the first replica already accrued
+            # (found by the chaos soak's trickled arrivals).
+            if monitor.stat_get(key, None) is None:
+                monitor.stat_set(key, 0)
 
     def seed_tenants(self, tenants) -> None:
         """Pre-seed every per-tenant surface for the given tenant names:
@@ -668,6 +683,45 @@ class ServingMetrics:
         monitor.stat_set(
             PREFIX + f"fleet_tenant_weight{{tenant={tenant}}}",
             float(weight))
+
+    # ------------------------------------------------------ wire transport
+    def on_wire_tx(self, nbytes: int) -> None:
+        """Frame bytes handed to the channel (counted per attempt —
+        a retried or hedged frame pays its bytes again, the real cost)."""
+        monitor.stat_add(PREFIX + "wire_tx_bytes_total", int(nbytes))
+
+    def on_wire_rx(self, nbytes: int) -> None:
+        """Frame bytes of a SUCCESSFUL exchange's winning copy, decoded
+        clean (corrupt arrivals count in the corrupt family instead)."""
+        monitor.stat_add(PREFIX + "wire_rx_bytes_total", int(nbytes))
+
+    def on_wire_retry(self) -> None:
+        """One transport retry (the attempt after a backoff)."""
+        monitor.stat_add(PREFIX + "wire_retries_total", 1)
+
+    def on_wire_corrupt(self, kind: str) -> None:
+        """One frame that failed to decode, by WireError taxonomy kind
+        (family pre-seeded at router construction for the three
+        kinds)."""
+        monitor.stat_add(
+            PREFIX + f"wire_corrupt_total{{kind={kind}}}", 1)
+
+    def on_wire_hedge_win(self) -> None:
+        """One hedged read won by the hedge copy (the second transfer
+        completed first or alone)."""
+        monitor.stat_add(PREFIX + "wire_hedge_wins_total", 1)
+
+    def on_wire_refetch_fallback(self) -> None:
+        """One cross-replica page fetch that failed (corrupt / timed
+        out / breaker open) and degraded to local re-prefill instead of
+        failing the request."""
+        monitor.stat_add(PREFIX + "wire_refetch_fallback_total", 1)
+
+    def on_breaker_open(self, peer) -> None:
+        """One circuit-breaker open transition for ``peer`` (family
+        pre-seeded at router construction for every replica index)."""
+        monitor.stat_add(
+            PREFIX + f"breaker_open_total{{peer={peer}}}", 1)
 
     def observe_tenant(self, tenant: str, ttft, tpot,
                        queue_delay) -> None:

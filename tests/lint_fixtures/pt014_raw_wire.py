@@ -1,0 +1,25 @@
+"""PT014 fixture: raw serialization/transport primitives in a serving
+module that is NOT wire.py (linted as if at serving/sidechannel.py) —
+ad-hoc framing that forks the versioned wire schema — plus the
+pragma-suppressed twins of the same calls."""
+import pickle
+import socket
+import struct
+from pickle import loads  # noqa: F401
+
+
+def rogue_page_bytes(page):
+    return pickle.dumps(page)
+
+
+def rogue_peer_read():
+    return socket.socket()
+
+
+def rogue_frame(serial):
+    return struct.pack("<Q", serial)
+
+
+def suppressed_twin(page, serial):
+    blob = pickle.dumps(page)  # lint: disable=PT014
+    return blob + struct.pack("<Q", serial)  # lint: disable=PT014

@@ -375,6 +375,10 @@ _FIXTURE_CASES = {
                                       55: "PT012", 61: "PT012"}),
     "pt013_direct_add_request.py": ("serving/fleet_rogue.py",
                                     {9: "PT013"}),
+    "pt014_raw_wire.py": ("serving/sidechannel.py",
+                          {5: "PT014", 6: "PT014", 7: "PT014",
+                           8: "PT014", 12: "PT014", 16: "PT014",
+                           20: "PT014"}),
 }
 
 
@@ -394,7 +398,7 @@ def test_lint_rule_fixture(fixture):
 
 def test_lint_rule_table_is_complete():
     assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + [
-        "PT010", "PT011", "PT012", "PT013"]
+        "PT010", "PT011", "PT012", "PT013", "PT014"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -589,6 +593,21 @@ def test_self_lint_catches_unsanctioned_fleet_dispatch():
                for f in findings)
     assert not any(f.rule == "PT013" for f in lint_source(
         src, "paddle_tpu/serving/fleet.py"))
+
+
+def test_self_lint_pt014_gate_is_the_filename():
+    """serving/wire.py is the ONE sanctioned struct user: the very same
+    codec source linted under any other serving filename fires PT014 —
+    the gate is the filename, so moving frame-packing bytes out of
+    wire.py (a second codec, a 'quick' side channel) reintroduces the
+    raw-struct finding. The real wire.py stays clean, and it genuinely
+    exercises the gate (it must actually use struct)."""
+    path = REPO / "paddle_tpu" / "serving" / "wire.py"
+    src = path.read_text()
+    assert "struct" in src, "wire.py no longer packs with struct?"
+    assert lint_source(src, "paddle_tpu/serving/wire.py") == []
+    findings = lint_source(src, "paddle_tpu/serving/wire2.py")
+    assert any(f.rule == "PT014" for f in findings)
 
 
 def test_self_lint_catches_reintroduced_wall_clock():
