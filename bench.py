@@ -89,7 +89,8 @@ def _last_known_tpu() -> dict | None:
                             "serving-ragged-kernel-bench",
                             "serving-tenant-bench",
                             "serving-fleet-bench",
-                            "serving-wire-bench")):
+                            "serving-wire-bench",
+                            "serving-overlap-bench")):
             continue
         return rec
     return None
@@ -1312,6 +1313,156 @@ def _serving_tp_bench() -> dict:
     }
 
 
+_OVERLAP_CHILD_ENV = "PADDLE_TPU_BENCH_OVERLAP_CHILD"  # respawned child
+
+
+def _serving_overlap_bench() -> dict:
+    """Serving phase: the decode-overlap triad at TP=2 — the
+    latency-hiding-scheduler flag (``tp_overlap_scheduler``, a no-op on
+    CPU backends) and the quantized logits all-reduce
+    (``tp_quantized_logits``) against the baseline sharded engine, on a
+    forced 2-device CPU mesh when no wider mesh is visible. Emits decode
+    throughput + TPOT for the three legs, the compiled collective census
+    (op count, bytes/token, overlap fraction) of the quantized programs,
+    and the f32-vs-int8 bytes/token shrink. All timings EMITTED, never
+    ratio-asserted (CPU noise rule — a forced host mesh timeshares one
+    core, and the scheduler flag only bites on chip); the structural
+    contracts are asserted, since they are exact: the overlap-on /
+    quantized-OFF leg is bit-identical to the baseline, every leg's
+    decode loop is sync-free with zero retraces, and the census + gauges
+    are populated."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        if os.environ.get(_OVERLAP_CHILD_ENV):
+            raise RuntimeError("forced 2-device CPU mesh did not take "
+                               "effect in the respawned overlap child")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env[_OVERLAP_CHILD_ENV] = "1"
+        # APPEND the forced count (last occurrence wins in XLA) so
+        # operator-supplied flags survive into the child
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=2"
+                            ).strip()
+        deadline = os.environ.get(_DEADLINE_ENV)
+        budget = 600.0
+        if deadline is not None:
+            budget = min(budget, max(60.0, float(deadline) - time.time()))
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            timeout=budget, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        sys.stderr.write(proc.stderr.decode(errors="replace")[-2000:])
+        for line in reversed(proc.stdout.decode(errors="replace")
+                             .splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # stray dict-repr line; keep scanning
+        raise RuntimeError(f"overlap bench child rc={proc.returncode} "
+                           f"with no JSON output")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import SyncTally
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.serving import scheduler as sched_mod
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(17)
+    cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=128, dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 512, (24,)).astype(np.int32)
+               for _ in range(10)]
+    budget = 12  # decode-heavy: TPOT is the number under test
+
+    def drive(overlap, quantized):
+        import itertools
+
+        sched_mod._rid_counter = itertools.count(70000)  # align rids
+        engine = ServingEngine(model, ServingConfig(
+            max_batch=4, num_pages=64, page_size=16, max_prompt_len=32,
+            tensor_parallel=2, tp_overlap_scheduler=overlap,
+            tp_quantized_logits=quantized))
+        for p in prompts[:2]:  # warm the prefill bucket out of timing
+            engine.add_request(p, budget)
+            engine.run()
+        pre = engine.metrics.snapshot()
+        for p in prompts[2:]:
+            engine.add_request(p, budget)
+        t0 = time.perf_counter()
+        with SyncTally() as tally:
+            outs = engine.run()
+        dt = time.perf_counter() - t0
+        snap = engine.metrics.snapshot()
+        fetches = int(snap["serving_decode_steps"]
+                      - pre["serving_decode_steps"]
+                      + snap["serving_prefills_total"]
+                      - pre["serving_prefills_total"])
+        assert tally.count == fetches, (
+            f"decode loop not sync-free (overlap={overlap}, "
+            f"quantized={quantized}): {tally.count} syncs vs {fetches} "
+            f"sanctioned token fetches")
+        assert snap["serving_analysis_retraces_total"] == 0, \
+            f"compile budget violated (overlap={overlap}, q={quantized})"
+        tokens = (len(prompts) - 2) * budget
+        return tokens / dt, 1000.0 * dt / tokens, \
+            [outs[k] for k in sorted(outs)]
+
+    tps_base, tpot_base, outs_base = drive(False, False)
+    tps_ov, tpot_ov, outs_ov = drive(True, False)
+    # the scheduler flag reorders collectives, never what they compute —
+    # and the quantized branch never traced: bit-identity is exact
+    assert len(outs_base) == len(outs_ov) and all(
+        np.array_equal(a, b) for a, b in zip(outs_base, outs_ov)), \
+        "overlap-on / quantized-off leg diverged from the baseline"
+    tps_q, tpot_q, _ = drive(True, True)
+
+    # compiled-artifact facts for the quantized programs: one short
+    # debug_checks run audits the census + feeds the gauges
+    eng_dbg = ServingEngine(model, ServingConfig(
+        max_batch=4, num_pages=64, page_size=16, max_prompt_len=32,
+        tensor_parallel=2, tp_overlap_scheduler=True,
+        tp_quantized_logits=True, debug_checks=True))
+    for p in prompts[:2]:
+        eng_dbg.add_request(p, 2)
+        eng_dbg.run()
+    snap_dbg = eng_dbg.metrics.snapshot()
+    assert snap_dbg["serving_tp_collective_bytes_per_token"] > 0, \
+        "census gauge not fed at the first-trace audit"
+    assert "serving_tp_collective_overlap_frac" in snap_dbg, \
+        "overlap gauge not seeded"
+    # the f32 twin's bytes/token, for the shrink the JSON reports
+    from paddle_tpu.serving.tp import TPContext
+    f32_cap = TPContext(2, cfg).step_budget(batch=4, seq=1)
+    q_cap = TPContext(2, cfg, quantized_logits=True).step_budget(4, 1)
+    return {
+        "serving_tp2_baseline_tokens_per_sec": round(tps_base, 1),
+        "serving_tp2_overlap_tokens_per_sec": round(tps_ov, 1),
+        "serving_tp2_overlap_qlogits_tokens_per_sec": round(tps_q, 1),
+        "serving_tp2_baseline_tpot_ms": round(tpot_base, 2),
+        "serving_tp2_overlap_tpot_ms": round(tpot_ov, 2),
+        "serving_tp2_overlap_qlogits_tpot_ms": round(tpot_q, 2),
+        "serving_tp_collective_bytes_per_token":
+            round(snap_dbg["serving_tp_collective_bytes_per_token"], 1),
+        "serving_tp_collective_overlap_frac":
+            round(snap_dbg["serving_tp_collective_overlap_frac"], 3),
+        "decode_collective_bytes_f32": int(f32_cap.max_collective_bytes),
+        "decode_collective_bytes_qlogits":
+            int(q_cap.max_collective_bytes),
+        "serving_overlap_hlo": {
+            name: {"collective_ops": len(r.collectives),
+                   "collective_bytes": int(r.collective_bytes),
+                   "async": r.async_collectives,
+                   "overlapped": r.overlapped_collectives}
+            for name, r in sorted(eng_dbg.hlo_audits.items())},
+    }
+
+
 def run_bench(platform: str) -> dict:
     import jax
 
@@ -1343,6 +1494,12 @@ def run_bench(platform: str) -> dict:
             r["serving_tp"] = _serving_tp_bench()
         except Exception as e:  # noqa: BLE001 — never forfeit the headline number
             print(f"[bench] serving tp phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
+        try:
+            r["serving_overlap"] = _serving_overlap_bench()
+        except Exception as e:  # noqa: BLE001 — never forfeit the headline number
+            print(f"[bench] serving overlap phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
         try:
@@ -1425,6 +1582,20 @@ def run_bench(platform: str) -> dict:
             result["serving_tp"] = _serving_tp_bench()
         except Exception as e:  # noqa: BLE001 — never forfeit the train number
             print(f"[bench] serving tp phase failed: "
+                  f"{type(e).__name__}: {str(e)[:300]}",
+                  file=sys.stderr, flush=True)
+    if remaining() > 45:
+        try:
+            result["serving_overlap"] = _serving_overlap_bench()
+            # bank the on-chip overlap/quantized-collective A/B as its own
+            # provenance-labeled history row (skipped by last_known_tpu) —
+            # on chip the scheduler flag and the int8 payload actually
+            # move TPOT, unlike the timeshared CPU mesh
+            _bank_tpu_result(dict(result["serving_overlap"],
+                                  platform=result.get("platform"),
+                                  provenance="serving-overlap-bench"))
+        except Exception as e:  # noqa: BLE001 — never forfeit the train number
+            print(f"[bench] serving overlap phase failed: "
                   f"{type(e).__name__}: {str(e)[:300]}",
                   file=sys.stderr, flush=True)
     if remaining() > 45:
@@ -1569,6 +1740,12 @@ def main():
         # TP child mode: the respawned forced-2-device-mesh child runs
         # ONLY the tensor-parallel phase, prints its JSON, and exits
         print(json.dumps(_serving_tp_bench()), flush=True)
+        return
+
+    if os.environ.get(_OVERLAP_CHILD_ENV):
+        # overlap child mode: same respawn mechanism, decode-overlap
+        # triad phase only
+        print(json.dumps(_serving_overlap_bench()), flush=True)
         return
 
     child_platform = os.environ.get(_CHILD_ENV)

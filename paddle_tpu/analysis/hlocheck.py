@@ -56,9 +56,9 @@ from dataclasses import dataclass, field
 
 __all__ = ["CollectiveBudget", "CollectiveOp", "HostTransfer",
            "HloAuditReport", "HloCheckError", "CollectiveBudgetError",
-           "HostTransferError", "AliasingViolation", "SINGLE_CHIP",
-           "census", "audit", "audit_guard", "StepSpec", "REGISTRY",
-           "run_step", "main"]
+           "CollectiveOverlapError", "HostTransferError",
+           "AliasingViolation", "SINGLE_CHIP", "census", "audit",
+           "audit_guard", "StepSpec", "REGISTRY", "run_step", "main"]
 
 
 class HloCheckError(RuntimeError):
@@ -68,6 +68,13 @@ class HloCheckError(RuntimeError):
 class CollectiveBudgetError(HloCheckError):
     """The compiled step issues more collective traffic than its declared
     CollectiveBudget. The message names the op kind, count, and bytes."""
+
+
+class CollectiveOverlapError(HloCheckError):
+    """The compiled step's async collectives do not overlap enough compute:
+    fewer than ``min_overlap_frac`` of the ``-start``/``-done`` pairs have
+    ANY instruction scheduled between them — the scheduler serialized the
+    collective against the compute it was supposed to hide under."""
 
 
 class HostTransferError(HloCheckError):
@@ -143,6 +150,12 @@ class CollectiveOp:
     nbytes: int   # payload bytes parsed from the result type
     instr: str    # HLO instruction name (%...)
     line: str     # the instruction line, trimmed
+    # async `-start`/`-done` pair (vs the sync single-instruction form)
+    is_async: bool = False
+    # overlap depth: instructions the scheduler placed between this
+    # collective's -start and its -done — the compute it hides under.
+    # Always 0 for sync collectives (nothing can interleave)
+    overlap: int = 0
 
 
 @dataclass(frozen=True)
@@ -152,32 +165,58 @@ class HostTransfer:
     line: str
 
 
+_REF_RE = re.compile(r"%([\w.\-]+)")
+
+
 def census(hlo_text: str) -> tuple[tuple[CollectiveOp, ...],
                                    tuple[HostTransfer, ...]]:
     """Walk optimized HLO text and collect (collectives, host transfers).
-    Async ``-start``/``-done`` pairs count once (at the start)."""
-    colls: list[CollectiveOp] = []
+    Async ``-start``/``-done`` pairs count once (at the start), and each
+    carries its OVERLAP depth: the number of instructions the scheduler
+    placed between the ``-start`` and its matching ``-done`` — the compute
+    the collective hides under. A ``-start`` immediately followed by its
+    ``-done`` overlaps nothing (the async form bought no latency hiding),
+    which is exactly what the latency-hiding-scheduler census exists to
+    catch."""
+    entries: list[dict] = []   # mutable while scanning (overlap counts)
     hosts: list[HostTransfer] = []
+    open_starts: dict[str, int] = {}  # -start instr name -> entries index
     for raw in hlo_text.splitlines():
         m = _INSTR_RE.match(raw)
         if m is None:
             continue
         op = m.group("op")
         line = raw.strip()[:200]
+        if op.endswith("-done") and op[:-5] in COLLECTIVE_KINDS:
+            # close the start this done names (its operand): instructions
+            # after this point no longer overlap that collective
+            ref = _REF_RE.search(raw[m.end():])
+            if ref is not None:
+                open_starts.pop(ref.group(1), None)
+            continue
         base = op[:-6] if op.endswith("-start") else op
-        if base in COLLECTIVE_KINDS and not op.endswith("-done"):
+        if base in COLLECTIVE_KINDS:
             # an async `-start` result is a tuple carrying operand AND
             # result buffers — ((op, res)) scalar form, ((op0..opN-1,
             # res0..resN-1)) when XLA's combiner merged N collectives.
             # Charge the result half only: the payload the sync form(s)
             # would report, so byte caps hold across sync/async/combined
             # compilation of the same traffic
+            is_async = op.endswith("-start")
             elems = _shape_elem_bytes(m.group("type"))
             nbytes = (sum(elems[len(elems) // 2:])
-                      if op.endswith("-start") and len(elems) > 1
-                      else sum(elems))
-            colls.append(CollectiveOp(base, nbytes, m.group("iname"), line))
-        elif op in ("infeed", "outfeed"):
+                      if is_async and len(elems) > 1 else sum(elems))
+            if is_async:
+                open_starts[m.group("iname")] = len(entries)
+            entries.append(dict(kind=base, nbytes=nbytes,
+                                instr=m.group("iname"), line=line,
+                                is_async=is_async))
+            continue
+        # any other instruction scheduled while a -start is in flight is
+        # work the collective overlaps (credited to every open start)
+        for idx in open_starts.values():
+            entries[idx]["overlap"] = entries[idx].get("overlap", 0) + 1
+        if op in ("infeed", "outfeed"):
             hosts.append(HostTransfer(op, op, line))
         elif op in ("send", "recv") and "is_host_transfer=true" in raw:
             hosts.append(HostTransfer(op, op, line))
@@ -185,7 +224,7 @@ def census(hlo_text: str) -> tuple[tuple[CollectiveOp, ...],
             t = _TARGET_RE.search(raw)
             if t is not None and _HOST_TARGET_RE.search(t.group(1)):
                 hosts.append(HostTransfer("custom-call", t.group(1), line))
-    return tuple(colls), tuple(hosts)
+    return tuple(CollectiveOp(**e) for e in entries), tuple(hosts)
 
 
 # ------------------------------------------------------------------ budgets
@@ -203,6 +242,12 @@ class CollectiveBudget:
     collective_broadcast: int = 0
     host_transfers: int = 0
     max_collective_bytes: int | None = None
+    # minimum fraction of ASYNC collectives that must overlap at least one
+    # instruction (latency-hiding-scheduler census). Enforced over async
+    # `-start`/`-done` pairs ONLY: a backend that compiles everything to
+    # sync collectives (CPU) has nothing to schedule and passes vacuously,
+    # so the same budget certifies on a forced host mesh and on chip
+    min_overlap_frac: float = 0.0
 
     def allowed(self, kind: str) -> int:
         return getattr(self, kind.replace("-", "_"), 0)
@@ -250,6 +295,25 @@ class HloAuditReport:
     def collective_bytes(self) -> int:
         return sum(c.nbytes for c in self.collectives)
 
+    @property
+    def async_collectives(self) -> int:
+        """Collectives compiled to the async -start/-done form."""
+        return sum(1 for c in self.collectives if c.is_async)
+
+    @property
+    def overlapped_collectives(self) -> int:
+        """Async collectives with at least one instruction scheduled
+        between their -start and -done — actually hidden under compute."""
+        return sum(1 for c in self.collectives
+                   if c.is_async and c.overlap > 0)
+
+    @property
+    def overlap_frac(self) -> float:
+        """overlapped / async collectives; 0.0 when the program has no
+        async collectives (sync-only compilation overlaps nothing)."""
+        n = self.async_collectives
+        return self.overlapped_collectives / n if n else 0.0
+
     def enforce(self, budget: CollectiveBudget) -> "HloAuditReport":
         """Raise naming the offending op when the artifact exceeds the
         budget; aliasing of donated buffers is always enforced."""
@@ -269,6 +333,19 @@ class HloAuditReport:
                 f"{self.collective_bytes} bytes exceeds the declared cap of "
                 f"{budget.max_collective_bytes} bytes "
                 f"({', '.join(sorted(self.counts()))})")
+        n_async = self.async_collectives
+        if budget.min_overlap_frac > 0.0 and n_async and \
+                self.overlap_frac < budget.min_overlap_frac:
+            worst = next(c for c in self.collectives
+                         if c.is_async and c.overlap == 0)
+            raise CollectiveOverlapError(
+                f"hlocheck({self.name!r}): only "
+                f"{self.overlapped_collectives}/{n_async} async "
+                f"collective(s) overlap any compute "
+                f"(frac {self.overlap_frac:.2f} < declared minimum "
+                f"{budget.min_overlap_frac:.2f}) — the scheduler "
+                f"serialized -start against -done. First serialized op: "
+                f"{worst.line}")
         if len(self.host_transfers) > budget.host_transfers:
             first = self.host_transfers[0]
             raise HostTransferError(
@@ -296,11 +373,32 @@ class HloAuditReport:
         coll = ", ".join(f"{k}x{v}" for k, v in sorted(c.items())) or "none"
         alias = (f"{self.aliased_leaves}/{self.donated_leaves} donated "
                  f"aliased" if self.donated_leaves else "no donation")
+        ov = (f"overlap {self.overlapped_collectives}/"
+              f"{self.async_collectives} async"
+              if self.async_collectives else "overlap n/a (sync)")
         return (f"hlocheck {self.name}: collectives {coll} "
-                f"({_fmt_bytes(self.collective_bytes)}); host transfers "
-                f"{len(self.host_transfers)}; {alias}; "
+                f"({_fmt_bytes(self.collective_bytes)}); {ov}; host "
+                f"transfers {len(self.host_transfers)}; {alias}; "
                 f"flops/step {self.flops:.4g}; peak HBM "
                 f"{_fmt_bytes(self.peak_bytes)}")
+
+    def overlap_summary(self) -> str:
+        """The ``--overlap`` CLI view: one line per collective naming its
+        compiled form (sync vs async) and the number of instructions the
+        scheduler placed while it was in flight."""
+        head = (f"hlocheck {self.name}: "
+                f"{self.overlapped_collectives}/{self.async_collectives} "
+                f"async collective(s) overlapped"
+                if self.async_collectives else
+                f"hlocheck {self.name}: all collectives compiled sync "
+                f"(no async -start/-done pairs to overlap)")
+        lines = [head]
+        for c in self.collectives:
+            form = "async" if c.is_async else "sync"
+            lines.append(f"  {form:<5} {c.kind:<20} "
+                         f"{_fmt_bytes(c.nbytes):>9}  overlap={c.overlap}"
+                         f"  %{c.instr}")
+        return "\n".join(lines)
 
 
 # -------------------------------------------------------------------- audit
@@ -434,7 +532,8 @@ class StepSpec:
 
 
 def _build_engine_step(which: str, tensor_parallel: int = 1,
-                       kv_dtype: str = "float32"):
+                       kv_dtype: str = "float32",
+                       quantized_logits: bool = False):
     """Engine-step audit targets. ``tensor_parallel=2`` builds the SAME
     step on a 2-device mesh (Megatron weight + KV-pool shards via
     serving/tp.py shard_map) with the budget the engine itself declares:
@@ -469,7 +568,13 @@ def _build_engine_step(which: str, tensor_parallel: int = 1,
             if which == "verify_spec" else None)
     eng = ServingEngine(model, ServingConfig(
         max_batch=2, num_pages=16, page_size=4, max_prompt_len=8,
-        tensor_parallel=tensor_parallel, kv_dtype=kv_dtype, spec=spec))
+        tensor_parallel=tensor_parallel, kv_dtype=kv_dtype, spec=spec,
+        # the tp2 entries certify WITH the overlap contract declared:
+        # min_overlap_frac=1.0 over async collectives (vacuous where the
+        # backend compiles them sync — the forced CPU mesh — and binding
+        # on chip, where the latency-hiding scheduler must deliver)
+        tp_overlap_scheduler=tensor_parallel > 1,
+        tp_quantized_logits=quantized_logits))
     if which == "verify_spec":
         args = (eng._p, eng.cache.pools,
                 jnp.asarray(eng.cache.page_table), jnp.asarray(eng._ctx),
@@ -663,6 +768,19 @@ REGISTRY: dict[str, StepSpec] = {s.name: s for s in (
              lambda: _build_engine_step("decode", tensor_parallel=2,
                                         kv_dtype="int8"),
              min_devices=2),
+    # ---- quantized logits all-reduce (tp_quantized_logits=True): the
+    # b*s*V f32 logits payload ships as int8 codes + a 4-byte shared
+    # scale — budget 2L+2 all-reduces with the logits byte term counted
+    # at 1 byte/element by the census's bit-accurate dtype table. The
+    # byte cap is ~4x tighter than the f32 twin's, so a silently
+    # unquantized psum fails loudly here
+    StepSpec("tp2_engine_decode_qlogits", "TENSOR-PARALLEL decode with "
+             "the EQuARX-style int8 logits all-reduce (budget 2L+2 "
+             "all-reduces, logits bytes counted at s8 width + 4-byte "
+             "scale)",
+             lambda: _build_engine_step("decode", tensor_parallel=2,
+                                        quantized_logits=True),
+             min_devices=2),
 )}
 
 
@@ -695,7 +813,8 @@ def run_step(name: str) -> HloAuditReport:
 _CHILD_ENV = "PADDLE_TPU_HLOCHECK_CHILD"  # set in respawned children
 
 
-def _run_in_subprocess(spec: StepSpec) -> tuple[int, str]:
+def _run_in_subprocess(spec: StepSpec,
+                       overlap: bool = False) -> tuple[int, str]:
     """Re-run one step in a child forced onto a CPU mesh wide enough for
     it (the certification is a virtual-mesh proof, not an on-chip run).
     Returns (exit code, relayed child output) so the caller can classify
@@ -716,11 +835,13 @@ def _run_in_subprocess(spec: StepSpec) -> tuple[int, str]:
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
     print(f"[hlocheck] {spec.name}: needs {spec.min_devices} devices — "
           f"re-running on a forced {spec.min_devices}-device CPU mesh")
+    cmd = [sys.executable, "-m", "paddle_tpu.analysis", "--hlo",
+           "--step", spec.name]
+    if overlap:  # the child prints the per-collective view for us
+        cmd.append("--overlap")
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "paddle_tpu.analysis", "--hlo",
-             "--step", spec.name],
-            env=env, timeout=900,
+            cmd, env=env, timeout=900,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
     except subprocess.TimeoutExpired as e:
         # a wedged child must not crash the sweep: report it as an
@@ -749,6 +870,10 @@ def main(argv=None) -> int:
                              "(repeatable; default: all)")
     parser.add_argument("--list-steps", action="store_true",
                         help="print the step registry and exit")
+    parser.add_argument("--overlap", action="store_true",
+                        help="print the per-collective overlap census "
+                             "(sync/async form + instructions scheduled "
+                             "in flight) for each audited step")
     args = parser.parse_args(argv)
 
     if args.list_steps:
@@ -778,7 +903,7 @@ def main(argv=None) -> int:
                       f"not a budget violation)")
                 errors += 1
                 continue
-            rc, out = _run_in_subprocess(spec)
+            rc, out = _run_in_subprocess(spec, overlap=args.overlap)
             if rc == 0:
                 continue
             # a child exits 1 for a real budget violation AND for its own
@@ -795,7 +920,10 @@ def main(argv=None) -> int:
                 errors += 1
             continue
         try:
-            print(run_step(name).summary())
+            report = run_step(name)
+            print(report.summary())
+            if args.overlap:
+                print(report.overlap_summary())
         except HloCheckError as e:
             print(f"FAIL {name}: {e}")
             violations += 1

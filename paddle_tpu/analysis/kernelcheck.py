@@ -638,33 +638,68 @@ def validate_flash_tuned(table: dict) -> list[str]:
 
 
 def validate_ragged_tuned(table: dict) -> list[str]:
-    """Constraint validation for ``kernels/ragged_tuned.json`` entries
-    (``"page_size,num_heads,head_dim" -> block_heads``), shared by the
-    load site in ``kernels/ragged_paged_attention.py`` and the writer in
-    ``tools/ragged_autotune.py`` — the flash_tuned discipline: load can
-    never see an entry bank rejected. Returns error strings (empty =
-    clean)."""
+    """Constraint validation for ``kernels/ragged_tuned.json`` entries,
+    shared by the load site in ``kernels/ragged_paged_attention.py`` and
+    the writer in ``tools/ragged_autotune.py`` — the flash_tuned
+    discipline: load can never see an entry bank rejected. A value under
+    a ``"page_size,num_heads,head_dim"`` key is either the legacy bare
+    ``block_heads`` int or the pipeline-aware dict schema
+    ``{"block_heads": B, "pipeline_chunk": C, "pages_per_seq": P}``:
+    ``B`` must divide ``num_heads`` and ``C`` must divide the ``P``
+    recorded at tune time — a STALE entry whose chunk no longer divides
+    its page count is rejected here, not discovered as a mis-tiled
+    launch. Returns error strings (empty = clean)."""
     errors = []
-    for key, bh in sorted(table.items()):
+    for key, val in sorted(table.items()):
         try:
             ps, h, d = (int(x) for x in str(key).split(","))
         except ValueError:
             errors.append(f"{key!r}: key must be "
                           f"'page_size,num_heads,head_dim' ints")
             continue
-        if not isinstance(bh, int) or bh <= 0:
-            errors.append(f"{key!r}: block_heads {bh!r} must be a "
-                          f"positive int")
-            continue
         if ps <= 0 or h <= 0 or d <= 0:
             errors.append(f"{key!r}: page_size/num_heads/head_dim must "
                           f"be positive")
+            continue
+        if isinstance(val, dict):
+            unknown = set(val) - {"block_heads", "pipeline_chunk",
+                                  "pages_per_seq"}
+            if unknown:
+                errors.append(f"{key!r}: unknown field(s) "
+                              f"{sorted(unknown)} — the dict schema is "
+                              f"block_heads/pipeline_chunk/pages_per_seq")
+                continue
+            bh = val.get("block_heads", 1)
+            chunk = val.get("pipeline_chunk")
+            pages = val.get("pages_per_seq")
+        else:
+            bh, chunk, pages = val, None, None
+        if not isinstance(bh, int) or bh <= 0:
+            errors.append(f"{key!r}: block_heads {bh!r} must be a "
+                          f"positive int")
             continue
         if h % bh:
             errors.append(f"{key!r}: block_heads {bh} does not divide "
                           f"num_heads {h} — the head grid dim would "
                           f"truncate and the tail heads would be "
                           f"silently unserved")
+        if chunk is None:
+            continue
+        if not isinstance(chunk, int) or chunk <= 0:
+            errors.append(f"{key!r}: pipeline_chunk {chunk!r} must be a "
+                          f"positive int")
+            continue
+        if not isinstance(pages, int) or pages <= 0:
+            errors.append(f"{key!r}: pipeline_chunk {chunk} without a "
+                          f"positive pages_per_seq — the chunk is only "
+                          f"meaningful against the page count it was "
+                          f"tuned at")
+            continue
+        if pages % chunk:
+            errors.append(f"{key!r}: pipeline_chunk {chunk} does not "
+                          f"divide pages_per_seq {pages} — a stale "
+                          f"entry (the page count moved since the "
+                          f"tune); re-run tools/ragged_autotune.py")
     return errors
 
 
@@ -801,7 +836,11 @@ def _build_ragged(mode: str):
     per-page-per-head scales, dequant fused into the gather), ``verify``
     (the spec K+1=5 contract), ``prefill`` (single-row chunk tail, 64-pad
     bucket at ctx0=192). All four trace to the SAME program shape — one
-    kernel, four certificates. ``index_args`` carry the canonical runtime
+    kernel, four certificates — and all four certify the PIPELINED form
+    (``pipeline_chunk=8`` over the 32-page canonical row: 4 chunks
+    through 2 alternating staging buffers), so the scratch the VMEM
+    model prices carries the ×2 double-buffer cost explicitly in its
+    leading axis. ``index_args`` carry the canonical runtime
     scalar-prefetch values (ctx_lens, cu_q_lens, page table) so the
     data-dependent output index map is PROVEN injective, and the HBM
     model counts the canonical call's actual block transitions."""
@@ -833,13 +872,16 @@ def _build_ragged(mode: str):
     ctx_np = (np.asarray([192], np.int32) if mode == "prefill"
               else np.asarray([317, 129][:b], np.int32))
     cu_np = np.arange(b + 1, dtype=np.int32) * s
+    chunk = 8  # 4 chunks over the canonical 32-page row: pipeline ON
     ok, why = rp.ragged_kernel_eligible(d, pps, ps, s, num_heads=h,
-                                        quantized=quant)
+                                        quantized=quant,
+                                        pipeline_chunk=chunk)
     ok64, why64 = rp.ragged_kernel_eligible(64, pps, ps, s, num_heads=h,
                                             quantized=quant)
     constraints = (
         ("ragged_kernel_eligible", ok, why or
-         "the canonical shape must pass every unified-kernel gate"),
+         "the canonical shape must pass every unified-kernel gate "
+         "(incl. the x2 staged buffers at the certified chunk)"),
         # the two kernelcheck coverage gaps this kernel exists to close,
         # certified so they can never silently reopen
         ("head_dim_64_eligible", ok64, why64 or
@@ -851,7 +893,8 @@ def _build_ragged(mode: str):
 
         def fn(q, kp, vp, t, c, ksc, vsc):
             return rp.ragged_paged_attention(q, kp, vp, t, c,
-                                             k_scale=ksc, v_scale=vsc)
+                                             k_scale=ksc, v_scale=vsc,
+                                             pipeline_chunk=chunk)
 
         def composite(q, kp, vp, t, c, ksc, vsc):
             k_all = pa.paged_gather_quant(kp, ksc, t, q.dtype)
@@ -862,7 +905,8 @@ def _build_ragged(mode: str):
         args = (q, pool, pool, table, ctx, scale, scale)
     else:
         def fn(q, kp, vp, t, c):
-            return rp.ragged_paged_attention(q, kp, vp, t, c)
+            return rp.ragged_paged_attention(q, kp, vp, t, c,
+                                             pipeline_chunk=chunk)
 
         def composite(q, kp, vp, t, c):
             k_all = pa.paged_gather(kp, t)

@@ -119,7 +119,7 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
     "lint_fixtures": {f"PT00{i}" for i in range(1, 10)}
-    | {"PT010", "PT011", "PT012", "PT013", "PT014"},
+    | {"PT010", "PT011", "PT012", "PT013", "PT014", "PT015"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -683,6 +683,55 @@ def _pt014(tree, path):
                        f"taxonomy the transport counts by kind.")
 
 
+def _pt015(tree, path):
+    """Raw ``psum`` in serving/ outside tp.py. Gated on the filename
+    (like PT013/PT014): serving/tp.py IS the sanctioned collective entry
+    point — its ``quantized_psum`` and the model's ``tp_axis`` psums are
+    the only reductions the declared CollectiveBudgets (and hlocheck's
+    overlap/byte census) account for. A raw ``lax.psum`` anywhere else in
+    serving/ is an unbudgeted collective: it lands over the step budget
+    at the first debug_checks audit at best, and silently serializes a
+    decode step against the mesh at worst. Flags the attribute forms
+    (``lax.psum``/``jax.lax.psum``) and the from-import (any alias)."""
+    if Path(path).name == "tp.py":
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "lax" or mod.endswith(".lax") or mod == "jax.lax":
+                for a in node.names:
+                    if a.name == "psum":
+                        yield (node.lineno,
+                               f"raw `from {mod} import psum"
+                               + (f" as {a.asname}`" if a.asname else "`")
+                               + " in serving/ outside tp.py — every "
+                               "serving collective must route through "
+                               "serving/tp.py (quantized_psum or the "
+                               "tp_axis model psums) so it is declared "
+                               "in the step's CollectiveBudget and "
+                               "counted by hlocheck's byte/overlap "
+                               "census. An unbudgeted psum fails the "
+                               "first debug_checks audit.")
+        elif isinstance(node, ast.Attribute) and node.attr == "psum":
+            base, dotted = node.value, None
+            if isinstance(base, ast.Name):
+                dotted = base.id
+            elif isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name):
+                dotted = f"{base.value.id}.{base.attr}"
+            if dotted in ("lax", "jax.lax"):
+                yield (node.lineno,
+                       f"raw {dotted}.psum in serving/ outside tp.py — "
+                       f"route the reduction through serving/tp.py "
+                       f"(quantized_psum, or a tp_axis model psum) so "
+                       f"the collective is declared in the step's "
+                       f"CollectiveBudget and counted by hlocheck's "
+                       f"byte/overlap census; an undeclared collective "
+                       f"lands over budget at the first debug_checks "
+                       f"audit and hides unbudgeted mesh traffic until "
+                       f"then.")
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -723,6 +772,10 @@ RULES: dict[str, Rule] = {r.code: r for r in (
     Rule("PT014", "raw pickle/socket/struct in serving/ outside "
          "wire.py — replica-boundary bytes must go through the "
          "versioned wire codec", _pt014, scope="serving"),
+    Rule("PT015", "raw lax.psum / jax.lax.psum (attribute or "
+         "from-import, incl. aliases) in serving/ outside tp.py — the "
+         "budgeted/quantized psum wrappers are the single collective "
+         "entry point", _pt015, scope="serving"),
 )}
 
 

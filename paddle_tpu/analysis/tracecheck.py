@@ -168,7 +168,7 @@ class CompileGuard:
 
     def __init__(self, fn, name: str | None = None, *, budget: int | None
                  = None, strict: bool = False, static_argnums=(),
-                 donate_argnums=(), group_by=None):
+                 donate_argnums=(), group_by=None, compiler_options=None):
         import jax
 
         self.fn = fn
@@ -177,6 +177,9 @@ class CompileGuard:
         self.strict = strict
         self.static_argnums = tuple(static_argnums)
         self.donate_argnums = tuple(donate_argnums)
+        # per-jit XLA options (e.g. the TP latency-hiding scheduler);
+        # None/{} = backend defaults, byte-identical to the old guard
+        self.compiler_options = dict(compiler_options or {})
         self.traces = 0
         self.calls = 0
         self.retraces = 0  # traces beyond budget (counted even unstrict)
@@ -201,6 +204,8 @@ class CompileGuard:
             jit_kwargs["static_argnums"] = self.static_argnums
         if self.donate_argnums:
             jit_kwargs["donate_argnums"] = self.donate_argnums
+        if self.compiler_options:
+            jit_kwargs["compiler_options"] = self.compiler_options
         self._jit = jax.jit(counted, **jit_kwargs)
 
     # ------------------------------------------------------------- auditing

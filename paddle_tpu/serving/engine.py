@@ -236,6 +236,19 @@ class ServingConfig:
     # all-reduces per step (row-parallel out_proj + fc2 per block, one
     # for the logits) — declared as a CollectiveBudget and certified by
     # the hlocheck audit under debug_checks. 1 = single-chip serving.
+    tp_overlap_scheduler: bool = False  # ask XLA's latency-hiding
+    # scheduler to overlap each per-block all-reduce's async -start/-done
+    # pair with independent compute (the T3/async-collective idiom).
+    # When on, the declared step budget requires min_overlap_frac=1.0 —
+    # every collective the backend compiles async must hide under compute
+    # (hlocheck's overlap census; vacuous where collectives compile
+    # sync, e.g. the forced CPU meshes). No-op unless tensor_parallel>1.
+    tp_quantized_logits: bool = False  # ship the b*s*V logits all-reduce
+    # as int8 codes + one 4-byte shared-scale psum (serving/tp.py
+    # quantized_psum, EQuARX-style): the step's largest collective
+    # payload shrinks ~4x at a bounded greedy-quality delta. Off =
+    # bit-identical to the unquantized engine (the branch never traces).
+    # No-op unless tensor_parallel > 1.
     chunk_size: int = 0  # prefill tokens per step per request; 0 = whole
     # tail in one pass (chunking off). Chunks ride the SAME prefill jit
     # (ctx_lens = tokens already resident) padded into the existing
@@ -386,7 +399,10 @@ class ServingEngine:
             # mesh + Megatron shard specs + shard_map wrappers; validates
             # divisibility (heads/hidden/ffn) and the visible device count
             from .tp import TPContext
-            self._tp = TPContext(cfg.tensor_parallel, mc)
+            self._tp = TPContext(
+                cfg.tensor_parallel, mc,
+                overlap_scheduler=cfg.tp_overlap_scheduler,
+                quantized_logits=cfg.tp_quantized_logits)
         else:
             self._tp = None
         pages_per_seq = cfg.pages_per_seq or \
@@ -621,6 +637,10 @@ class ServingEngine:
         # once, so a same-bucket retrace (e.g. dtype drift) can't hide in
         # the headroom of buckets this workload never used
         prefill_impl, decode_impl = self._prefill_impl, self._decode_impl
+        # per-jit XLA options: only the TP latency-hiding scheduler today
+        # (tp_overlap_scheduler; None on backends without it / single-chip)
+        xla_opts = (self._tp.compiler_options()
+                    if self._tp is not None else None)
         if self._tp is not None:
             # sharded programs: the SAME step bodies run inside shard_map
             # (params/pools under their shard specs, host operands
@@ -636,10 +656,12 @@ class ServingEngine:
         self._prefill_jit = CompileGuard(
             prefill_impl, "prefill", donate_argnums=(1,),
             budget=len(self.prefill_buckets), strict=cfg.debug_checks,
-            group_by=lambda *a: tuple(a[2].shape))
+            group_by=lambda *a: tuple(a[2].shape),
+            compiler_options=xla_opts)
         self._decode_jit = CompileGuard(
             decode_impl, "decode", donate_argnums=(1,),
-            budget=1, strict=cfg.debug_checks)
+            budget=1, strict=cfg.debug_checks,
+            compiler_options=xla_opts)
         self.guards = {"prefill": self._prefill_jit,
                        "decode": self._decode_jit}
         if cfg.spec is not None:
@@ -658,7 +680,8 @@ class ServingEngine:
                     quantized=self.cache.cfg.quantized)
             self._verify_jit = CompileGuard(
                 verify_impl, "verify", donate_argnums=(1,),
-                budget=1, strict=cfg.debug_checks)
+                budget=1, strict=cfg.debug_checks,
+                compiler_options=xla_opts)
             self.guards["verify"] = self._verify_jit
         else:
             self._verify_jit = None
@@ -1874,7 +1897,8 @@ class ServingEngine:
             b, s = self._step_shape(label)
             self.metrics.on_tp_audit(
                 collective_ops=len(report.collectives),
-                bytes_per_token=report.collective_bytes / (b * s))
+                bytes_per_token=report.collective_bytes / (b * s),
+                overlap_frac=report.overlap_frac)
 
     def _step_shape(self, label: str) -> tuple[int, int]:
         """(batch, seq) of a compiled engine program, from its audit label

@@ -273,7 +273,7 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "hlo_collective_ops", "hlo_host_transfers",
            "hlo_peak_hbm_bytes", "hlo_flops_per_step",
            "tp_degree", "tp_collective_ops_per_step",
-           "tp_collective_bytes_per_token",
+           "tp_collective_bytes_per_token", "tp_collective_overlap_frac",
            "tokens_per_sec", "queue_depth", "active_requests",
            "page_pool_used", "page_utilization", "mfu", "hbm_bw_util",
            "fleet_replicas", "fleet_prefix_affinity_hits_total",
@@ -576,18 +576,23 @@ class ServingMetrics:
         construction so dashboards can segment every other gauge by it."""
         monitor.stat_set(PREFIX + "tp_degree", int(degree))
 
-    def on_tp_audit(self, collective_ops: int,
-                    bytes_per_token: float) -> None:
+    def on_tp_audit(self, collective_ops: int, bytes_per_token: float,
+                    overlap_frac: float = 0.0) -> None:
         """One tensor-parallel hlocheck audit (debug_checks, once per
-        compiled program): the per-step collective op count and the
+        compiled program): the per-step collective op count, the
         collective payload bytes per token the program advances — the
         baseline numbers EQuARX-style quantized collectives get measured
-        against. stat_max keeps the steady-state (decode) worst case
-        across programs."""
+        against — and the overlap census fraction (overlapped / async
+        collectives; 0.0 where the backend compiled everything sync).
+        stat_max keeps the steady-state (decode) worst case across
+        programs (for overlap, the best program observed — the gauge
+        answers \"did the latency-hiding scheduler engage at all\")."""
         monitor.stat_max(PREFIX + "tp_collective_ops_per_step",
                          int(collective_ops))
         monitor.stat_max(PREFIX + "tp_collective_bytes_per_token",
                          float(bytes_per_token))
+        monitor.stat_max(PREFIX + "tp_collective_overlap_frac",
+                         float(overlap_frac))
 
     def on_hlo_audit(self, collective_ops: int, host_transfers: int,
                      peak_hbm_bytes: int, flops: float) -> None:

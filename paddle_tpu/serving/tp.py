@@ -51,7 +51,42 @@ import numpy as np
 
 from ..analysis.hlocheck import CollectiveBudget
 
-__all__ = ["TPContext"]
+__all__ = ["TPContext", "quantized_psum"]
+
+
+def quantized_psum(x, axis: str):
+    """EQuARX-style quantized all-reduce: ship int8 codes instead of f32.
+
+    Each shard quantizes against a SHARED step derived from the psum of
+    the per-shard absmaxes — a 4-byte scalar all-reduce — then psums the
+    int8 codes and dequantizes. The payload for a ``[.., vocab]`` logits
+    reduction shrinks 4x (f32 -> s8), at bounded quantization error.
+
+    The step is ``psum(absmax) / (127 - n)`` (``n`` = axis size, resolved
+    statically — no collective), NOT ``absmax / 127``: with ``n`` shards
+    each contributing codes up to ``amax_i/step + 1/2`` in magnitude, the
+    accumulated int8 sum is bounded by ``sum(amax_i)/step + n/2 =
+    (127 - n) + n/2 < 127`` — the all-reduce itself can never overflow
+    the int8 accumulator, for any shard count and any input. ``step`` is
+    identical on every shard (it is a psum result), so dequantization is
+    replicated bit-exactly.
+
+    This is the serving stack's ONE quantized collective entry point —
+    flag-gated by ``ServingConfig(tp_quantized_logits=True)`` and routed
+    through ``text/gpt.py::_tp_logits``; its budget shape (one extra tiny
+    all-reduce, int8 payload) is declared by ``TPContext.step_budget``
+    and certified bit-accurately by hlocheck's sub-byte dtype census."""
+    import jax.numpy as jnp
+    from jax import lax  # lint: disable=PT015 — the sanctioned wrapper
+
+    n = lax.psum(1, axis)  # axis size: constant-folded, no collective
+    amax = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    total = lax.psum(amax, axis)            # 4-byte scale all-reduce
+    step = total / jnp.float32(127 - n)
+    step = jnp.where(step > 0, step, jnp.float32(1.0))  # all-zero input
+    codes = jnp.clip(jnp.round(x / step), -127, 127).astype(jnp.int8)
+    ysum = lax.psum(codes, axis)            # the int8 payload all-reduce
+    return ysum.astype(x.dtype) * step.astype(x.dtype)
 
 #: the paged pool's sharded axis: [num_pages, page_size, HEADS, head_dim]
 _POOL_AXES = (None, None, "tp", None)
@@ -71,7 +106,9 @@ class TPContext:
 
     AXIS = "tp"
 
-    def __init__(self, degree: int, model_cfg, devices=None):
+    def __init__(self, degree: int, model_cfg, devices=None, *,
+                 overlap_scheduler: bool = False,
+                 quantized_logits: bool = False):
         import jax
         from jax.sharding import Mesh
 
@@ -94,6 +131,15 @@ class TPContext:
                     f"the MLP, hidden shards the LM-head contraction)")
         self.degree = degree
         self.model_cfg = model_cfg
+        # latency hiding: ask XLA to schedule each psum's -start/-done
+        # pair around independent compute (ServingConfig(
+        # tp_overlap_scheduler=True)); when on, step_budget demands every
+        # async collective actually overlap (min_overlap_frac=1.0) —
+        # vacuous on backends that compile collectives sync (CPU)
+        self.overlap_scheduler = bool(overlap_scheduler)
+        # EQuARX-style int8 logits all-reduce (quantized_psum above),
+        # routed through text/gpt.py's _tp_logits at trace time
+        self.quantized_logits = bool(quantized_logits)
         self.mesh = Mesh(np.array(devs[:degree]), (self.AXIS,))
         self.param_specs: dict[str, object] = {}
 
@@ -201,7 +247,8 @@ class TPContext:
         from ..text.gpt import tp_axis
 
         def stepped(p, pools, *rest):
-            with tp_axis(self.AXIS):
+            with tp_axis(self.AXIS,
+                         quantized_logits=self.quantized_logits):
                 return fn(p, pools, *rest)
 
         pool = self._pool_specs(num_layers, quantized)
@@ -235,6 +282,22 @@ class TPContext:
         return self._shard_map(fn, in_specs=in_specs, out_specs=out_specs)
 
     # ------------------------------------------------------------- budgets
+    def compiler_options(self) -> dict | None:
+        """Per-jit XLA options for the sharded engine steps: the latency-
+        hiding scheduler (overlap each psum's async -start/-done with
+        independent compute), on backends that implement it. CPU's
+        collectives compile sync — no scheduler to engage — so this
+        returns None there and the steps compile exactly as before; the
+        overlap contract is still DECLARED (step_budget's
+        min_overlap_frac) and enforced wherever async pairs appear."""
+        if not self.overlap_scheduler:
+            return None
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return None
+        return {"xla_tpu_enable_latency_hiding_scheduler": True}
+
     def step_budget(self, batch: int, seq: int,
                     itemsize: int = 4) -> CollectiveBudget:
         """The collectives one sharded engine step implies — nothing more:
@@ -242,10 +305,22 @@ class TPContext:
         out_proj + row-parallel MLP fc2, each ``[batch, seq, hidden]``)
         plus one for the logits (``[batch, seq, vocab]``), byte-capped at
         exactly that payload. An implicit resharding collective XLA
-        sneaks in lands over this budget and fails the hlocheck audit."""
+        sneaks in lands over this budget and fails the hlocheck audit.
+
+        With ``quantized_logits`` the logits reduction becomes TWO
+        all-reduces — the 4-byte shared-scale psum plus the int8 codes —
+        so the count is ``2L + 2`` and the logits payload shrinks 4x
+        (counted bit-accurately by hlocheck's dtype census). With
+        ``overlap_scheduler`` the budget additionally demands that every
+        collective XLA compiles async actually overlaps compute
+        (``min_overlap_frac=1.0``; vacuous when compiled sync)."""
         c = self.model_cfg
         per_block = batch * seq * c.hidden_size * itemsize
-        logits = batch * seq * c.vocab_size * itemsize
+        if self.quantized_logits:
+            extra_ar, logits = 1, batch * seq * c.vocab_size * 1 + 4
+        else:
+            extra_ar, logits = 0, batch * seq * c.vocab_size * itemsize
         return CollectiveBudget(
-            all_reduce=2 * c.num_layers + 1,
-            max_collective_bytes=2 * c.num_layers * per_block + logits)
+            all_reduce=2 * c.num_layers + 1 + extra_ar,
+            max_collective_bytes=2 * c.num_layers * per_block + logits,
+            min_overlap_frac=1.0 if self.overlap_scheduler else 0.0)

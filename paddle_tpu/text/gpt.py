@@ -30,19 +30,26 @@ from ..nn import functional as F
 # exactly one ``lax.psum`` each. None (the default) is a no-op on every
 # single-chip path.
 _TP_AXIS: str | None = None
+# trace-time toggle for the EQuARX-style int8 logits all-reduce
+# (serving/tp.py quantized_psum): set alongside the axis by tp_axis(...,
+# quantized_logits=True); only the LM-head psum routes through it — the
+# per-block residual psums stay exact f32
+_TP_QUANTIZED: bool = False
 
 
 @contextmanager
-def tp_axis(name: str):
+def tp_axis(name: str, quantized_logits: bool = False):
     """Trace-time context: the mesh axis name the model's row-parallel
-    partial sums psum over. Used by serving/tp.py around the shard_map'd
+    partial sums psum over (and whether the logits psum ships int8 codes
+    instead of f32). Used by serving/tp.py around the shard_map'd
     engine steps; nested/exception-safe."""
-    global _TP_AXIS
-    prev, _TP_AXIS = _TP_AXIS, name
+    global _TP_AXIS, _TP_QUANTIZED
+    prev = (_TP_AXIS, _TP_QUANTIZED)
+    _TP_AXIS, _TP_QUANTIZED = name, bool(quantized_logits)
     try:
         yield
     finally:
-        _TP_AXIS = prev
+        _TP_AXIS, _TP_QUANTIZED = prev
 
 
 def _tp_psum(t: Tensor) -> Tensor:
@@ -76,6 +83,12 @@ def _tp_logits(h: Tensor, weight: Tensor, transpose_y: bool) -> Tensor:
     else:            # untied lm_head [hidden, vocab]: slice its rows
         w_loc = lax.dynamic_slice_in_dim(wv, i * k, k, axis=0)
         part = h_loc @ w_loc
+    if _TP_QUANTIZED:
+        # flag-gated int8 logits reduction: the single largest collective
+        # payload (b*s*V f32) shrinks 4x; bit-identical when the flag is
+        # off because this branch then never traces
+        from ..serving.tp import quantized_psum
+        return Tensor(quantized_psum(part, _TP_AXIS))
     return Tensor(lax.psum(part, _TP_AXIS))
 
 

@@ -1,0 +1,21 @@
+"""PT015 fixture: raw psum in serving/ outside tp.py — the attribute and
+from-import (aliased) forms fire; the pragma'd twin and non-psum lax
+usage stay quiet."""
+import jax
+from jax import lax
+from jax.lax import psum
+from jax.lax import psum as raw_sum
+
+
+def rogue_reduce(x):
+    y = lax.psum(x, "tp")
+    z = jax.lax.psum(y, "tp")
+    return y + z + psum(x, "tp") + raw_sum(x, "tp")
+
+
+def sanctioned(x):
+    return lax.psum(x, "tp")  # lint: disable=PT015
+
+
+def fine(x):
+    return lax.stop_gradient(x) + jax.lax.exp(x)

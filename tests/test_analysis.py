@@ -379,6 +379,9 @@ _FIXTURE_CASES = {
                           {5: "PT014", 6: "PT014", 7: "PT014",
                            8: "PT014", 12: "PT014", 16: "PT014",
                            20: "PT014"}),
+    "pt015_raw_psum.py": ("serving/rogue_collective.py",
+                          {6: "PT015", 7: "PT015",
+                           11: "PT015", 12: "PT015"}),
 }
 
 
@@ -398,7 +401,7 @@ def test_lint_rule_fixture(fixture):
 
 def test_lint_rule_table_is_complete():
     assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + [
-        "PT010", "PT011", "PT012", "PT013", "PT014"]
+        "PT010", "PT011", "PT012", "PT013", "PT014", "PT015"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -608,6 +611,33 @@ def test_self_lint_pt014_gate_is_the_filename():
     assert lint_source(src, "paddle_tpu/serving/wire.py") == []
     findings = lint_source(src, "paddle_tpu/serving/wire2.py")
     assert any(f.rule == "PT014" for f in findings)
+
+
+def test_self_lint_pt015_gate_is_the_filename():
+    """serving/tp.py is the ONE sanctioned psum user: the very same
+    module linted under any other serving filename fires PT015 — moving
+    a collective out of tp.py (a 'quick' raw reduction beside the
+    budgeted wrappers) reintroduces the unbudgeted-psum finding. The
+    real tp.py stays clean, and it genuinely exercises the gate (it must
+    actually call lax.psum — quantized_psum does)."""
+    path = REPO / "paddle_tpu" / "serving" / "tp.py"
+    src = path.read_text()
+    assert "lax.psum" in src, "tp.py no longer reduces with lax.psum?"
+    assert not any(f.rule == "PT015" for f in lint_source(
+        src, "paddle_tpu/serving/tp.py"))
+    findings = lint_source(src, "paddle_tpu/serving/tp_rogue.py")
+    assert any(f.rule == "PT015" for f in findings)
+    # and a raw psum pasted into any other serving module fires too —
+    # the strip-reintroduction direction: engine.py grows a psum, PT015
+    # catches it at the line
+    eng = (REPO / "paddle_tpu" / "serving" / "engine.py").read_text()
+    bad = eng + "\n\ndef _rogue(x):\n    import jax\n" \
+                "    return jax.lax.psum(x, 'tp')\n"
+    findings = lint_source(bad, "paddle_tpu/serving/engine.py")
+    assert any(f.rule == "PT015" and "tp.py" in f.message
+               for f in findings)
+    assert not any(f.rule == "PT015" for f in lint_source(
+        eng, "paddle_tpu/serving/engine.py"))
 
 
 def test_self_lint_catches_reintroduced_wall_clock():

@@ -286,7 +286,8 @@ def test_registry_names_are_stable():
                              "tp2_engine_verify_spec", "tp2_swap_gather",
                              "tp2_swap_scatter", "tp2_cow_copy",
                              "engine_decode_q8", "swap_gather_q8",
-                             "swap_scatter_q8", "tp2_engine_decode_q8"}
+                             "swap_scatter_q8", "tp2_engine_decode_q8",
+                             "tp2_engine_decode_qlogits"}
     assert REGISTRY["tp8_decode"].min_devices == 8
     assert all(REGISTRY[n].min_devices == 2 for n in REGISTRY
                if n.startswith("tp2_"))

@@ -1,15 +1,26 @@
-"""On-chip block-size autotune for the unified ragged paged-attention
-kernel (kernels/ragged_paged_attention.py). For each serving-relevant
-``(page_size, num_heads, head_dim)``, times the decode-mode kernel across
-candidate ``block_heads`` (heads per grid step — the knob trading grid
-parallelism against per-step VMEM/DMA width) and writes the winners to
-paddle_tpu/kernels/ragged_tuned.json — the single ``block_heads_for``
-source consults it, so the dispatch gate and launch config stay
-consistent automatically (the flash_autotune idiom).
+"""On-chip launch-parameter autotune for the unified ragged
+paged-attention kernel (kernels/ragged_paged_attention.py). For each
+serving-relevant ``(page_size, num_heads, head_dim)``, times the
+decode-mode kernel across the candidate grid of ``block_heads`` (heads
+per grid step — grid parallelism vs per-step VMEM/DMA width) ×
+``pipeline_chunk`` (pages staged per DMA chunk — chunk == pages_per_seq
+is the exact single-buffer gather; a smaller chunk turns on the
+double-buffered DMA/compute pipeline at ×2 staging VMEM) and writes the
+winners to paddle_tpu/kernels/ragged_tuned.json — the single
+``block_heads_for``/``pipeline_chunk_for`` source consults it, so the
+dispatch gate and launch config stay consistent automatically (the
+flash_autotune idiom).
+
+Candidates are pre-filtered through the dispatch-side VMEM gate
+(``_vmem_working_set`` INCLUDING the ×2 staged buffers a sub-row chunk
+implies) before any is timed — a banked winner the gate then rejects
+would silently route every call at that shape to the composite path,
+the exact opposite of tuning.
 
 The table is validated by ``analysis.kernelcheck.validate_ragged_tuned``
-BEFORE writing — the same validator the kernel runs at load time, so load
-can never see an entry bank rejected.
+BEFORE writing — the same validator the kernel runs at load time (incl.
+the stale-chunk rule: a pipeline_chunk must divide the pages_per_seq it
+was tuned at), so load can never see an entry bank rejected.
 
 TPU only (the compiled kernel; the CPU interpreter's timings are
 meaningless); prints a skip note otherwise. Results also bank to
@@ -36,30 +47,34 @@ SHAPES = [  # (batch, num_heads, head_dim, page_size, pages_per_seq)
 
 def _candidates(num_heads: int, head_dim: int, page_size: int,
                 pages_per_seq: int) -> list:
-    """block_heads values worth sweeping: must divide num_heads AND pass
-    the dispatch-side VMEM eligibility gate at the LARGEST query count a
-    serving call makes (the 64-pad prefill bucket) — a banked winner the
-    gate then rejects would silently route every call at that shape to
-    the composite path, the exact opposite of tuning."""
+    """(block_heads, pipeline_chunk) pairs worth sweeping: block_heads
+    must divide num_heads, the chunk must divide pages_per_seq, and the
+    pair must pass the dispatch-side VMEM eligibility gate — sized with
+    the ×2 staged buffers a sub-row chunk implies — at the LARGEST query
+    count a serving call makes (the 64-pad prefill bucket)."""
     from paddle_tpu.kernels.ragged_paged_attention import (
         _VMEM_GATE_BYTES, _vmem_working_set)
 
     total_kv = pages_per_seq * page_size
-    return [bh for bh in (1, 2, 4, 8, 16) if num_heads % bh == 0
+    chunks = [c for c in (2, 4, 8, 16, 32) if c < pages_per_seq
+              and pages_per_seq % c == 0] + [pages_per_seq]
+    return [(bh, c)
+            for bh in (1, 2, 4, 8, 16) if num_heads % bh == 0
             and bh <= num_heads
-            and _vmem_working_set(head_dim, total_kv, 64, bh,
-                                  pages_per_seq, False)
+            for c in chunks
+            if _vmem_working_set(head_dim, total_kv, 64, bh,
+                                 pages_per_seq, False, pipeline_chunk=c)
             <= _VMEM_GATE_BYTES]
 
 
-def _time_config(q, kp, vp, tab, ctx, block_heads):
+def _time_config(q, kp, vp, tab, ctx, block_heads, pipeline_chunk):
     import jax
 
     from _timing import time_fn
     from paddle_tpu.kernels import ragged_paged_attention as rp
 
     fn = jax.jit(lambda *a: rp.ragged_paged_attention(
-        *a, block_heads=block_heads))
+        *a, block_heads=block_heads, pipeline_chunk=pipeline_chunk))
     return time_fn(fn, (q, kp, vp, tab, ctx), iters=5, inner=40)
 
 
@@ -89,33 +104,46 @@ def main():
             np.arange(1, 1 + b * pps, dtype=np.int32).reshape(b, pps))
         ctx = jnp.asarray(rng.randint(ps, ps * pps - 1, (b,)), jnp.int32)
         results = {}
-        for bh in _candidates(h, d, ps, pps):
+        for bh, chunk in _candidates(h, d, ps, pps):
             try:
-                results[bh] = _time_config(q, kp, vp, tab, ctx, bh)
+                results[(bh, chunk)] = _time_config(q, kp, vp, tab, ctx,
+                                                    bh, chunk)
                 print(f"[ragged_autotune] ps={ps} h={h} d={d} "
-                      f"block_heads={bh}: {results[bh] * 1e3:.3f} ms",
+                      f"block_heads={bh} chunk={chunk}: "
+                      f"{results[(bh, chunk)] * 1e3:.3f} ms",
                       file=sys.stderr, flush=True)
             except Exception as e:  # noqa: BLE001 — OOM/unsupported config
                 print(f"[ragged_autotune] ps={ps} h={h} d={d} "
-                      f"block_heads={bh}: {type(e).__name__}",
+                      f"block_heads={bh} chunk={chunk}: "
+                      f"{type(e).__name__}",
                       file=sys.stderr, flush=True)
         if not results:
             continue
-        best = min(results, key=results.get)
-        default_t = results.get(1)  # block_heads_for's untuned default
-        table[f"{ps},{h},{d}"] = best
+        best_bh, best_chunk = min(results, key=results.get)
+        # block_heads_for's untuned default (bh=1, single chunk)
+        default_t = results.get((1, pps))
+        table[f"{ps},{h},{d}"] = {
+            "block_heads": best_bh,
+            "pipeline_chunk": best_chunk,
+            # the chunk's divisibility anchor: validate_ragged_tuned
+            # rejects the entry as STALE if a future sweep/model changes
+            # the window so the chunk no longer divides the page count
+            "pages_per_seq": pps,
+        }
+        best_t = results[(best_bh, best_chunk)]
         records.append({
             "metric": "ragged_paged_decode_ms",
-            "value": round(results[best] * 1e3, 4),
+            "value": round(best_t * 1e3, 4),
             "unit": "ms",
-            "vs_baseline": round(default_t / results[best], 3)
+            "vs_baseline": round(default_t / best_t, 3)
             if default_t else None,
             "platform": dev.platform,
             "device_kind": getattr(dev, "device_kind", "?"),
             "config": {"batch": b, "heads": h, "head_dim": d,
                        "page_size": ps, "pages_per_seq": pps,
-                       "best_block_heads": best,
-                       "sweep_ms": {str(kk): round(vv * 1e3, 4)
+                       "best_block_heads": best_bh,
+                       "best_pipeline_chunk": best_chunk,
+                       "sweep_ms": {f"{kk[0]},{kk[1]}": round(vv * 1e3, 4)
                                     for kk, vv in results.items()}},
             "provenance": "rung-experiment (ragged_autotune)",
         })
