@@ -28,10 +28,23 @@ Turns the serving stack's hand-pinned invariants into enforced checks:
   hlocheck cost roll-up. ``python -m paddle_tpu.analysis kernelcheck``
   sweeps the registry + the dispatch-coverage report (which serving
   configs reach a Pallas kernel vs the composite).
-- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT011 distilled from bugs
+- :mod:`~paddle_tpu.analysis.meshcheck` — the topology-aware complement
+  to hlocheck's topology-blind census: attribute every collective's
+  ``replica_groups`` to a declared :class:`MeshTopology` axis, classify
+  each axis ICI vs DCN via the cluster model's ``axis_medium``, enforce
+  :class:`CollectiveBudget`'s per-medium arms (``max_ici_bytes`` /
+  ``max_dcn_bytes`` / ``max_dcn_ops``), and bank the link-time model to
+  ``profiles/meshcheck.json``. ``python -m paddle_tpu.analysis
+  meshcheck`` sweeps the entry registry (the tp2 engine steps on a
+  1-host topology with a BINDING zero-DCN budget, plus the 2-host x
+  1-chip entry whose tp axis provably crosses the host boundary).
+- :mod:`~paddle_tpu.analysis.lint` — rules PT001-PT016 distilled from bugs
   this repo shipped, with ``# lint: disable=PTxxx`` pragmas and allowlists.
   ``python -m paddle_tpu.analysis paddle_tpu/`` must stay clean (a tier-1
   test enforces zero findings).
+- :mod:`~paddle_tpu.analysis.check_all` — the one-shot gate: all four
+  engines back to back, in process, one exit code
+  (``python -m paddle_tpu.analysis all`` / ``tools/check_all.py``).
 """
 from .hlocheck import (SINGLE_CHIP, AliasingViolation,  # noqa: F401
                        CollectiveBudget, CollectiveBudgetError,
@@ -42,6 +55,10 @@ from .kernelcheck import (KernelBudget, KernelCertReport,  # noqa: F401
 from .kernelcheck import certify as certify_kernel  # noqa: F401
 from .lint import (ALLOWLIST, RULES, Finding, lint_paths,  # noqa: F401
                    lint_source)
+from .meshcheck import (MeshCheckError, MeshReport,  # noqa: F401
+                        MeshTopology, multi_host_topology,
+                        single_host_topology)
+from .meshcheck import analyze as analyze_mesh  # noqa: F401
 from .tracecheck import (CompileGuard, DonationViolation,  # noqa: F401
                          RetraceError, SyncTally, SyncViolation,
                          abstract_signature, donation_audit,
@@ -56,4 +73,6 @@ __all__ = ["CompileGuard", "RetraceError", "DonationViolation",
            "AliasingViolation", "SINGLE_CHIP",
            "KernelBudget", "KernelCertReport", "KernelCheckError",
            "KernelFinding", "certify_kernel", "validate_flash_tuned",
+           "MeshTopology", "MeshReport", "MeshCheckError",
+           "single_host_topology", "multi_host_topology", "analyze_mesh",
            "Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths"]

@@ -3,14 +3,24 @@ runs the repo linter; ``python -m paddle_tpu.analysis --hlo [--step NAME]``
 runs the compiled-artifact auditor over the registered step registry;
 ``python -m paddle_tpu.analysis kernelcheck [--kernel NAME]`` runs the
 static Pallas-kernel certifier (VMEM/tiling/race/roofline + dispatch
-coverage). One entry point, three engines, shared exit-code contract
-(0 clean, 1 findings/violations, 2 bad usage)."""
+coverage); ``python -m paddle_tpu.analysis meshcheck [--step NAME]`` runs
+the topology-aware collective placement analyzer (per-medium ICI/DCN
+budgets + link-time bank); ``python -m paddle_tpu.analysis all`` runs the
+whole static-analysis gate in one shot. One entry point, five engines,
+shared exit-code contract (0 clean, 1 findings/violations, 2 bad
+usage)."""
 import sys
 
 argv = list(sys.argv[1:])
 if argv[:1] == ["kernelcheck"]:
     argv = argv[1:]
     from .kernelcheck import main
+elif argv[:1] == ["meshcheck"]:
+    argv = argv[1:]
+    from .meshcheck import main
+elif argv[:1] == ["all"]:
+    argv = argv[1:]
+    from .check_all import main
 elif "--hlo" in argv:
     argv.remove("--hlo")
     from .hlocheck import main

@@ -1,0 +1,102 @@
+"""One-shot static-analysis gate: every engine, one exit code.
+
+``python -m paddle_tpu.analysis all`` (or ``tools/check_all.py``) runs
+the four analysis engines back to back, IN PROCESS, and folds their
+exit codes into the shared contract (0 clean / 1 findings / 2 usage):
+
+1. the lint default sweep (rules PT001-PT016 over the package +
+   ``tests/`` + ``examples/``),
+2. the hlocheck step registry (collective census, aliasing, byte caps),
+3. the kernelcheck kernel registry (VMEM/tiling/race/roofline bank),
+4. the meshcheck entry registry (per-medium placement + link-time bank).
+
+Every engine runs even when an earlier one fails — a gate that stops at
+the first finding hides the rest of the report — and the summary names
+each engine's verdict. Narrowing flags (``--hlo-step`` / ``--kernel`` /
+``--mesh-step``, each repeatable; ``--skip ENGINE``) keep the in-process
+tier-1 pin of the clean run cheap without forking four interpreters.
+"""
+from __future__ import annotations
+
+__all__ = ["ENGINES", "main"]
+
+#: engine name -> (module attr producing main(argv), description)
+ENGINES = ("lint", "hlocheck", "kernelcheck", "meshcheck")
+
+
+def _engine_main(name: str):
+    if name == "lint":
+        from .lint import main
+    elif name == "hlocheck":
+        from .hlocheck import main
+    elif name == "kernelcheck":
+        from .kernelcheck import main
+    else:
+        from .meshcheck import main
+    return main
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis all",
+        description="One-shot static-analysis gate: lint sweep + "
+                    "hlocheck registry + kernelcheck registry + "
+                    "meshcheck registry, unified exit codes (0 clean, "
+                    "1 findings, 2 usage).")
+    parser.add_argument("--skip", action="append", default=[],
+                        choices=list(ENGINES), metavar="ENGINE",
+                        help="skip one engine (repeatable)")
+    parser.add_argument("--hlo-step", action="append", default=None,
+                        metavar="NAME",
+                        help="narrow hlocheck to these steps (repeatable)")
+    parser.add_argument("--kernel", action="append", default=None,
+                        metavar="NAME",
+                        help="narrow kernelcheck to these kernels "
+                             "(repeatable)")
+    parser.add_argument("--mesh-step", action="append", default=None,
+                        metavar="NAME",
+                        help="narrow meshcheck to these entries "
+                             "(repeatable)")
+    args = parser.parse_args(argv)
+
+    engine_argv = {
+        "lint": [],
+        "hlocheck": [a for n in (args.hlo_step or [])
+                     for a in ("--step", n)],
+        "kernelcheck": [a for n in (args.kernel or [])
+                        for a in ("--kernel", n)],
+        "meshcheck": [a for n in (args.mesh_step or [])
+                      for a in ("--step", n)],
+    }
+    results: dict[str, int] = {}
+    for name in ENGINES:
+        if name in args.skip:
+            continue
+        print(f"==== {name} ".ljust(60, "="))
+        try:
+            rc = _engine_main(name)(engine_argv[name])
+        except SystemExit as e:  # argparse errors inside an engine
+            rc = int(e.code or 0)
+        except Exception as e:  # noqa: BLE001 — one broken engine must
+            # not mask the others' reports; it still fails the gate
+            print(f"FAIL {name}: {type(e).__name__}: {e}")
+            rc = 1
+        results[name] = rc
+
+    print("==== gate ".ljust(60, "="))
+    for name, rc in results.items():
+        verdict = ("clean" if rc == 0 else
+                   "FINDINGS" if rc == 1 else f"USAGE ERROR (rc={rc})")
+        print(f"{name:<12} {verdict}")
+    if not results:
+        print("nothing ran (everything skipped)")
+        return 2
+    if any(rc == 2 for rc in results.values()):
+        return 2
+    return 1 if any(results.values()) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

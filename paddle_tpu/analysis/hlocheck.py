@@ -112,6 +112,18 @@ _INSTR_RE = re.compile(
 _ALIAS_RE = re.compile(
     r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{[\d,\s]*\},\s*(?:may|must)-alias\)")
 
+# replica_groups in the explicit form `{{0,1},{2,3}}` (empty `{}` = one
+# group of every participant) and the iota form `[2,2]<=[4]` (optionally
+# transposed: `[2,2]<=[2,2]T(1,0)`) newer XLA emits for large meshes.
+# collective-permute carries `source_target_pairs` instead — same `{{a,b}}`
+# surface, pair semantics.
+_GROUPS_RE = re.compile(
+    r"(?:replica_groups|source_target_pairs)=\{((?:\{[\d,\s]*\},?\s*)*)\}")
+_GROUP_RE = re.compile(r"\{([\d,\s]*)\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+
 _TARGET_RE = re.compile(r'custom_call_target="([^"]*)"')
 
 COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
@@ -156,6 +168,14 @@ class CollectiveOp:
     # collective's -start and its -done — the compute it hides under.
     # Always 0 for sync collectives (nothing can interleave)
     overlap: int = 0
+    # participant structure, parsed once here so --overlap and meshcheck
+    # share a single HLO walk. For collective-permute these are the
+    # (source, target) pairs; empty with group_count 0 means the
+    # instruction named no groups (= one group of every participant).
+    replica_groups: tuple = ()
+    group_count: int = 0
+    channel_id: int | None = None
+    use_global_device_ids: bool = False
 
 
 @dataclass(frozen=True)
@@ -166,6 +186,50 @@ class HostTransfer:
 
 
 _REF_RE = re.compile(r"%([\w.\-]+)")
+
+
+def _parse_replica_groups(raw: str) -> tuple[tuple, int]:
+    """Decode the participant groups of one collective instruction line.
+    Handles the explicit ``replica_groups={{0,1},{2,3}}`` form (and the
+    same-surface ``source_target_pairs`` of collective-permute), plus the
+    iota form ``replica_groups=[G,S]<=[d0,d1]T(p0,p1)``: ranks 0..prod(d)-1
+    reshaped to ``[d0,d1,...]`` C-order, transposed by the permutation,
+    flattened, and chunked into G groups of S. Returns (groups, count);
+    ``((), 0)`` when the line names no groups at all."""
+    m = _GROUPS_RE.search(raw)
+    if m is not None:
+        groups = tuple(
+            tuple(int(x) for x in g.split(",") if x.strip())
+            for g in _GROUP_RE.findall(m.group(1)))
+        groups = tuple(g for g in groups if g)
+        return groups, len(groups)
+    m = _IOTA_GROUPS_RE.search(raw)
+    if m is not None:
+        n_groups, group_size = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        perm = ([int(p) for p in m.group(4).split(",")] if m.group(4)
+                else list(range(len(dims))))
+        tdims = [dims[p] for p in perm]
+        flat = []
+        for pos in range(n_groups * group_size):
+            # multi-index in the transposed shape, C-order
+            tidx, rem = [], pos
+            for d in reversed(tdims):
+                tidx.append(rem % d)
+                rem //= d
+            tidx.reverse()
+            # map back through the permutation and ravel in the original
+            oidx = [0] * len(dims)
+            for axis, t in zip(perm, tidx):
+                oidx[axis] = t
+            rank = 0
+            for d, i in zip(dims, oidx):
+                rank = rank * d + i
+            flat.append(rank)
+        groups = tuple(tuple(flat[g * group_size:(g + 1) * group_size])
+                       for g in range(n_groups))
+        return groups, n_groups
+    return (), 0
 
 
 def census(hlo_text: str) -> tuple[tuple[CollectiveOp, ...],
@@ -208,9 +272,14 @@ def census(hlo_text: str) -> tuple[tuple[CollectiveOp, ...],
                       if is_async and len(elems) > 1 else sum(elems))
             if is_async:
                 open_starts[m.group("iname")] = len(entries)
-            entries.append(dict(kind=base, nbytes=nbytes,
-                                instr=m.group("iname"), line=line,
-                                is_async=is_async))
+            groups, group_count = _parse_replica_groups(raw)
+            ch = _CHANNEL_RE.search(raw)
+            entries.append(dict(
+                kind=base, nbytes=nbytes, instr=m.group("iname"),
+                line=line, is_async=is_async,
+                replica_groups=groups, group_count=group_count,
+                channel_id=int(ch.group(1)) if ch else None,
+                use_global_device_ids="use_global_device_ids=true" in raw))
             continue
         # any other instruction scheduled while a -start is in flight is
         # work the collective overlaps (credited to every open start)
@@ -242,6 +311,14 @@ class CollectiveBudget:
     collective_broadcast: int = 0
     host_transfers: int = 0
     max_collective_bytes: int | None = None
+    # per-medium arms: byte/op caps split by the link each collective
+    # rides — ICI (within a host) vs DCN (across hosts). Enforcement
+    # needs a declared MeshTopology to classify each collective's axis,
+    # so these are checked by meshcheck's MeshReport.check(), not by
+    # HloAuditReport.enforce() (which stays topology-blind)
+    max_ici_bytes: int | None = None
+    max_dcn_bytes: int | None = None
+    max_dcn_ops: int | None = None
     # minimum fraction of ASYNC collectives that must overlap at least one
     # instruction (latency-hiding-scheduler census). Enforced over async
     # `-start`/`-done` pairs ONLY: a backend that compiles everything to
@@ -814,11 +891,16 @@ _CHILD_ENV = "PADDLE_TPU_HLOCHECK_CHILD"  # set in respawned children
 
 
 def _run_in_subprocess(spec: StepSpec,
-                       overlap: bool = False) -> tuple[int, str]:
+                       overlap: bool = False,
+                       cmd_args: list | None = None,
+                       label: str = "hlocheck") -> tuple[int, str]:
     """Re-run one step in a child forced onto a CPU mesh wide enough for
     it (the certification is a virtual-mesh proof, not an on-chip run).
     Returns (exit code, relayed child output) so the caller can classify
-    a nonzero exit as budget violation vs execution error."""
+    a nonzero exit as budget violation vs execution error. meshcheck
+    reuses this respawn mechanism by supplying its own ``cmd_args``
+    (the argv after ``-m paddle_tpu.analysis``) and ``label``; only
+    ``spec.name`` and ``spec.min_devices`` are read then."""
     import pathlib
     import subprocess
     import sys
@@ -833,12 +915,13 @@ def _run_in_subprocess(spec: StepSpec,
                         f"{spec.min_devices}").strip()
     root = str(pathlib.Path(__file__).resolve().parents[2])
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
-    print(f"[hlocheck] {spec.name}: needs {spec.min_devices} devices — "
+    print(f"[{label}] {spec.name}: needs {spec.min_devices} devices — "
           f"re-running on a forced {spec.min_devices}-device CPU mesh")
-    cmd = [sys.executable, "-m", "paddle_tpu.analysis", "--hlo",
-           "--step", spec.name]
-    if overlap:  # the child prints the per-collective view for us
-        cmd.append("--overlap")
+    if cmd_args is None:
+        cmd_args = ["--hlo", "--step", spec.name]
+        if overlap:  # the child prints the per-collective view for us
+            cmd_args.append("--overlap")
+    cmd = [sys.executable, "-m", "paddle_tpu.analysis"] + list(cmd_args)
     try:
         proc = subprocess.run(
             cmd, env=env, timeout=900,
@@ -848,7 +931,7 @@ def _run_in_subprocess(spec: StepSpec,
         # execution error (rc 124, the conventional timeout code) so the
         # remaining steps still run and the summary stays honest
         tail = (e.stdout or b"").decode(errors="replace")[-2000:]
-        print(f"[hlocheck] {spec.name}: child timed out after 900s"
+        print(f"[{label}] {spec.name}: child timed out after 900s"
               + (f"\n{tail}" if tail else ""))
         return 124, ""
     out = proc.stdout.decode(errors="replace")

@@ -119,7 +119,7 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
     "lint_fixtures": {f"PT00{i}" for i in range(1, 10)}
-    | {"PT010", "PT011", "PT012", "PT013", "PT014", "PT015"},
+    | {"PT010", "PT011", "PT012", "PT013", "PT014", "PT015", "PT016"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -732,6 +732,111 @@ def _pt015(tree, path):
                        f"then.")
 
 
+_PT016_SANCTIONED = ("engine.py", "channel.py")
+_PT016_SEEDED_CTORS = ("RandomState", "default_rng", "Generator", "Random",
+                      "PRNGKey", "key")
+
+
+def _pt016(tree, path):
+    """Determinism fence: nondeterminism sources in serving/ outside the
+    clock- and channel-sanctioned modules. Gated on the filename (like
+    PT013/PT014/PT015): serving/engine.py OWNS the pluggable clock
+    (``self._clock = clock or time.monotonic`` is the one sanctioned
+    wall-clock binding) and serving/channel.py owns the seeded lossy-
+    channel RNG. Everything else in serving/ must be replayable from
+    (config, seed, trace) alone — the discipline ``chaos_soak``'s
+    >=5-seed matrix and ``SimChannel``'s deterministic loss schedule
+    depend on. Flags:
+
+    - ``time.monotonic`` (attribute use or from-import — ``time.time``
+      is already PT004's arm of the same fence; together they close the
+      wall clock),
+    - the process-global RNGs: any ``random.*`` call, any
+      ``np.random.*`` / ``numpy.random.*`` call that is not a SEEDED
+      constructor (``RandomState(seed)`` / ``default_rng(seed)`` /
+      ``Random(seed)`` with an explicit argument),
+    - ``id()``-keyed ordering: ``key=id`` in a sort/min/max call or an
+      ``id(x)`` subscript key — iteration order then depends on
+      allocator addresses, which no seed replays."""
+    if Path(path).name in _PT016_SANCTIONED:
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr == "monotonic" \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("time", "_time"):
+            yield (node.lineno,
+                   "time.monotonic in serving/ outside engine.py — the "
+                   "engine clock is pluggable (ServingConfig(clock=)); a "
+                   "raw monotonic read is wall time no seed replays and "
+                   "no virtual clock can skew. Take the engine's clock "
+                   "(engine.now() / the injected clock callable) "
+                   "instead.")
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in ("monotonic", "time"):
+                    yield (node.lineno,
+                           f"`from time import {a.name}` in serving/ "
+                           f"outside engine.py — binds the wall clock "
+                           f"directly; route timing through the "
+                           f"pluggable engine clock so replay and the "
+                           f"slow_step fault skew keep working.")
+        elif isinstance(node, ast.ImportFrom) and node.module == "random":
+            for a in node.names:
+                if a.name not in ("Random", "SystemRandom"):
+                    yield (node.lineno,
+                           f"`from random import {a.name}` in serving/ "
+                           f"— the process-global RNG is shared mutable "
+                           f"state no (config, seed) pair replays. Use "
+                           f"a seeded random.Random(seed) / "
+                           f"np.random.RandomState(seed) instance owned "
+                           f"by the component.")
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                    and f.value.id == "random":
+                if not (f.attr in ("Random", "SystemRandom") and node.args):
+                    yield (node.lineno,
+                           f"random.{f.attr}(...) in serving/ — the "
+                           f"global RNG's state is shared across every "
+                           f"module and call order; chaos_soak's seed "
+                           f"matrix and SimChannel replay need a seeded "
+                           f"per-component random.Random(seed) / "
+                           f"RandomState(seed) instead.")
+            elif isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Attribute) \
+                    and f.value.attr == "random" \
+                    and isinstance(f.value.value, ast.Name) \
+                    and f.value.value.id in ("np", "numpy"):
+                if not (f.attr in _PT016_SEEDED_CTORS and node.args):
+                    yield (node.lineno,
+                           f"np.random.{f.attr}(...) in serving/ — "
+                           f"global numpy RNG (or an unseeded "
+                           f"constructor): not replayable from (config, "
+                           f"seed). Construct "
+                           f"np.random.RandomState(seed) / "
+                           f"default_rng(seed) with an explicit seed "
+                           f"and own it on the component.")
+            for kw in node.keywords:
+                if kw.arg == "key" and isinstance(kw.value, ast.Name) \
+                        and kw.value.id == "id":
+                    yield (node.lineno,
+                           "key=id ordering in serving/ — sorts by "
+                           "allocator address, which differs run to run "
+                           "under identical (config, seed, trace). Key "
+                           "on a stable field (rid, arrival index) "
+                           "instead.")
+        elif isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Call) and isinstance(sl.func, ast.Name) \
+                    and sl.func.id == "id":
+                yield (node.lineno,
+                       "id()-keyed table in serving/ — the key is an "
+                       "allocator address: dict iteration order (and "
+                       "anything derived from it) stops being "
+                       "replayable. Key on a stable identity (rid, "
+                       "sequence number) instead.")
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -776,6 +881,12 @@ RULES: dict[str, Rule] = {r.code: r for r in (
          "from-import, incl. aliases) in serving/ outside tp.py — the "
          "budgeted/quantized psum wrappers are the single collective "
          "entry point", _pt015, scope="serving"),
+    Rule("PT016", "determinism fence: time.monotonic / global or "
+         "unseeded random / id()-keyed ordering in serving/ outside the "
+         "clock-sanctioned engine.py and RNG-sanctioned channel.py — "
+         "with PT004 (time.time) this closes every nondeterminism "
+         "source deterministic replay depends on", _pt016,
+         scope="serving"),
 )}
 
 
@@ -841,7 +952,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Repo linter: invariants this repo shipped bugs "
-                    "against, enforced (rules PT001-PT013).")
+                    "against, enforced (rules PT001-PT016).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the installed "
                              "paddle_tpu package plus the repo's --include "

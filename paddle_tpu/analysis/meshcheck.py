@@ -1,0 +1,786 @@
+"""meshcheck: topology-aware collective PLACEMENT analyzer.
+
+hlocheck's census answers "how many collectives, how many bytes". It is
+deliberately topology-blind, which means it cannot answer the question
+the multi-host arc lives or dies on: WHICH LINK does each collective
+ride? A 2L+1 all-reduce budget that is fine over ICI (45-90 GB/s per
+link) is a serving disaster over DCN (25 Gb-class host NICs shared by
+every chip on the host). This module closes that gap statically, before
+any multi-host code exists to get it wrong:
+
+1. **Topology declaration** — :class:`MeshTopology` binds a
+   ``distributed/auto_parallel/cluster.py`` :class:`Cluster` (hosts x
+   chips-per-host, the two media's bandwidths) to an ordered tuple of
+   named logical mesh axes, device-major C order (last axis fastest-
+   varying), exactly how ``jax.sharding.Mesh`` lays ranks out.
+
+2. **Axis attribution** — every collective's ``replica_groups`` (parsed
+   once, in hlocheck's census) is matched against the group structure
+   each axis subset would produce. A collective either attributes to a
+   named axis (or ``"a+b"`` for a multi-axis reduce, ``"global"`` for
+   the full mesh) or the report refuses to certify: the declared
+   topology must explain every collective in the program.
+
+3. **Medium classification** — each attributed axis is classified
+   ``ici`` vs ``dcn`` by handing its REAL rank groups to
+   ``Cluster.axis_medium`` (which checks ``host_of`` per rank and fails
+   closed to ``dcn``). :class:`CollectiveBudget`'s per-medium arms —
+   ``max_ici_bytes`` / ``max_dcn_bytes`` / ``max_dcn_ops`` — are
+   enforced here in :meth:`MeshReport.check`, beside the total-byte and
+   overlap arms hlocheck already enforces.
+
+4. **Link-time model** — predicted collective-seconds per step from
+   bytes / per-medium bandwidth with the standard ring factors
+   (all-reduce moves ``2(g-1)/g`` of the payload per rank, gather /
+   scatter / all-to-all ``(g-1)/g``, permute and broadcast ship the
+   payload once) plus per-hop latency. Banked to
+   ``profiles/meshcheck.json`` with kernelcheck-style drift-on-load:
+   structural keys (collective count, per-medium bytes/ops, the
+   axis->medium map) must match EXACTLY; the modeled seconds may drift
+   25% before warning.
+
+Certification mirrors the hlocheck/kernelcheck pattern: a registry of
+named entries (the tp2 engine steps on a declared 1-host topology where
+a ZERO-DCN budget is binding, plus a forced 2-host x 1-chip CPU mesh
+entry whose tp axis provably crosses the host boundary), a CLI
+(``python -m paddle_tpu.analysis meshcheck``) that respawns onto a
+forced CPU mesh when the step needs more devices than the process has,
+and exit codes 0 clean / 1 findings / 2 usage.
+
+The serving engine feeds this at its existing first-trace audit hook:
+gauges ``serving_ici_bytes_per_token`` / ``serving_dcn_bytes_per_token``
+/ ``serving_collective_time_predicted_s`` are pre-seeded and written
+per step label under ``debug_checks`` (see serving/metrics.py).
+
+Imports stay lazy the hlocheck way: the Cluster import (which pulls the
+distributed package) happens inside the topology factories, never at
+module import.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import math
+import os
+from dataclasses import dataclass, field, replace
+
+from .hlocheck import (
+    CollectiveBudget,
+    CollectiveBudgetError,
+    CollectiveOp,
+    HloCheckError,
+    _fmt_bytes,
+)
+
+
+class MeshCheckError(HloCheckError):
+    """A topology-aware placement audit failed: a collective the declared
+    topology cannot attribute to any axis subset, a topology that does
+    not cover the program's ranks, or a drifted bank."""
+
+
+# --------------------------------------------------------------- topology
+@dataclass(frozen=True)
+class MeshTopology:
+    """hosts x chips-per-host x named logical axes.
+
+    ``cluster`` supplies the physical facts (``host_of``, the two media's
+    bandwidths/latencies); ``axes`` is the ordered ``(name, size)`` tuple
+    of logical mesh axes in device-major C order — axis ``i``'s stride is
+    the product of the sizes after it, so the LAST axis maps to adjacent
+    ranks (exactly ``jax.sharding.Mesh``'s layout). The axis sizes must
+    multiply out to the cluster's chip count: a topology that does not
+    cover its cluster cannot classify anything honestly.
+    """
+
+    cluster: object  # distributed.auto_parallel.cluster.Cluster
+    axes: tuple = ()  # ((name, size), ...)
+
+    def __post_init__(self):
+        sizes = [int(s) for _, s in self.axes]
+        n = 1
+        for s in sizes:
+            if s < 1:
+                raise MeshCheckError(f"axis sizes must be >= 1: {self.axes}")
+            n *= s
+        if n != self.cluster.n_chips:
+            raise MeshCheckError(
+                f"topology axes {self.axes} cover {n} ranks but the "
+                f"cluster has {self.cluster.n_chips} chips "
+                f"({self.cluster.n_hosts} host(s) x "
+                f"{self.cluster.chips_per_host}/host) — the declared mesh "
+                f"must tile the whole cluster")
+        names = [a for a, _ in self.axes]
+        if len(set(names)) != len(names):
+            raise MeshCheckError(f"duplicate axis names: {names}")
+
+    # ------------------------------------------------------------ derived
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for _, s in self.axes:
+            n *= int(s)
+        return n
+
+    @property
+    def axis_names(self) -> tuple:
+        return tuple(a for a, _ in self.axes)
+
+    def axis_size(self, name: str) -> int:
+        for a, s in self.axes:
+            if a == name:
+                return int(s)
+        raise KeyError(name)
+
+    def _strides(self) -> tuple:
+        sizes = [int(s) for _, s in self.axes]
+        strides, acc = [], 1
+        for s in reversed(sizes):
+            strides.append(acc)
+            acc *= s
+        return tuple(reversed(strides))
+
+    def subset_groups(self, names) -> tuple:
+        """Rank groups of a collective reducing over the axis subset
+        ``names`` jointly: every group varies exactly those axes' indices
+        and pins the rest. Groups are sorted rank tuples; group count is
+        the product of the OTHER axes' sizes."""
+        names = tuple(names)
+        for n in names:
+            self.axis_size(n)  # raises KeyError on unknown axis
+        sizes = [int(s) for _, s in self.axes]
+        strides = self._strides()
+        varying = [i for i, (a, _) in enumerate(self.axes) if a in names]
+        pinned = [i for i in range(len(self.axes)) if i not in varying]
+        groups = []
+        for pin in itertools.product(*(range(sizes[i]) for i in pinned)):
+            base = sum(p * strides[i] for i, p in zip(pinned, pin))
+            group = []
+            for var in itertools.product(
+                    *(range(sizes[i]) for i in varying)):
+                group.append(base + sum(
+                    v * strides[i] for i, v in zip(varying, var)))
+            groups.append(tuple(sorted(group)))
+        return tuple(sorted(groups))
+
+    def axis_groups(self, name: str) -> tuple:
+        """Rank groups of a single-axis collective over ``name``."""
+        return self.subset_groups((name,))
+
+    def medium_of(self, names) -> str:
+        """'ici' when every group of the subset lives inside one host,
+        else 'dcn' — classified from REAL rank groups via the cluster's
+        ``axis_medium`` (which checks ``host_of`` per rank and fails
+        closed)."""
+        groups = self.subset_groups(tuple(names))
+        size = len(groups[0]) if groups else 0
+        return self.cluster.axis_medium(size, groups=groups)
+
+    def describe(self) -> str:
+        ax = " x ".join(f"{a}={s}" for a, s in self.axes) or "(scalar)"
+        return (f"{self.cluster.accelerator_type} "
+                f"{self.cluster.n_hosts}h x {self.cluster.chips_per_host}c "
+                f"[{ax}]")
+
+
+def single_host_topology(degree: int, axis: str = "tp",
+                         accelerator_type: str = "cpu-test") -> MeshTopology:
+    """The test tier's default declaration: one host, ``degree`` chips,
+    a single tensor-parallel axis. Everything is ICI — a zero-DCN budget
+    is binding, not vacuous, because misattribution would fail closed to
+    'dcn' and trip it."""
+    from ..distributed.auto_parallel.cluster import Cluster
+
+    return MeshTopology(
+        Cluster(accelerator_type=accelerator_type, n_hosts=1,
+                chips_per_host=degree),
+        ((axis, degree),))
+
+
+def multi_host_topology(n_hosts: int, chips_per_host: int, axes,
+                        accelerator_type: str = "cpu-test",
+                        **cluster_kw) -> MeshTopology:
+    """Declare a multi-host mesh: any axis whose groups straddle the
+    ``chips_per_host`` boundary classifies DCN."""
+    from ..distributed.auto_parallel.cluster import Cluster
+
+    return MeshTopology(
+        Cluster(accelerator_type=accelerator_type, n_hosts=n_hosts,
+                chips_per_host=chips_per_host, **cluster_kw),
+        tuple((str(a), int(s)) for a, s in axes))
+
+
+# ------------------------------------------------------------- attribution
+def _normalize_groups(groups) -> tuple:
+    return tuple(sorted(tuple(sorted(int(r) for r in g)) for g in groups))
+
+
+def attribute(op: CollectiveOp, topology: MeshTopology):
+    """Attribute one collective to the axis subset it communicates over.
+
+    Returns ``(axis_label, medium, group_size)`` where ``axis_label`` is
+    the axis name, ``"a+b"`` for a joint multi-axis reduce, or
+    ``"global"`` when the instruction named no groups at all (one group
+    of everyone) — or ``None`` when the declared topology cannot explain
+    the op's groups (the caller refuses to
+    certify; a wrong answer here would cost-model a DCN collective at
+    ICI bandwidth).
+
+    collective-permute records (source, target) PAIRS, not groups: it
+    attributes to an axis iff every pair's endpoints differ along exactly
+    that one axis, and its medium is decided by the pairs themselves
+    (any cross-host pair -> dcn).
+    """
+    n = topology.n_devices
+    every = tuple(range(n))
+    if op.kind == "collective-permute":
+        return _attribute_permute(op, topology)
+    groups = _normalize_groups(op.replica_groups)
+    if not groups:
+        # the instruction named no groups at all: one group of everyone
+        medium = topology.cluster.axis_medium(n, groups=(every,))
+        return "global", medium, n
+    # try every non-empty axis subset, single axes first — so a full-mesh
+    # collective on a 1-axis topology reports THAT axis's name, and a
+    # joint reduce reports "a+b" (always matchable: the all-axes subset
+    # IS the full mesh)
+    names = topology.axis_names
+    for r in range(1, len(names) + 1):
+        for subset in itertools.combinations(names, r):
+            if groups == topology.subset_groups(subset):
+                label = "+".join(subset)
+                return label, topology.medium_of(subset), len(groups[0])
+    if groups == (every,):  # full mesh on a zero-axis topology
+        medium = topology.cluster.axis_medium(n, groups=(every,))
+        return "global", medium, n
+    return None
+
+
+def _attribute_permute(op: CollectiveOp, topology: MeshTopology):
+    pairs = tuple((int(p[0]), int(p[1])) for p in op.replica_groups
+                  if len(p) == 2)
+    if not pairs:
+        return None
+    sizes = [int(s) for _, s in topology.axes]
+    strides = topology._strides()
+
+    def coords(rank):
+        return tuple((rank // strides[i]) % sizes[i]
+                     for i in range(len(sizes)))
+
+    differing = set()
+    for src, dst in pairs:
+        if not (0 <= src < topology.n_devices
+                and 0 <= dst < topology.n_devices):
+            return None
+        d = [i for i in range(len(sizes))
+             if coords(src)[i] != coords(dst)[i]]
+        if len(d) != 1:
+            return None  # a diagonal hop is not one axis's permute
+        differing.add(d[0])
+    if len(differing) != 1:
+        return None
+    axis = topology.axes[differing.pop()][0]
+    medium = topology.cluster.axis_medium(2, groups=pairs)
+    return axis, medium, topology.axis_size(axis)
+
+
+# --------------------------------------------------------- link-time model
+# ring traffic factors: fraction of the payload each rank moves per
+# collective (Chan et al. ring algorithms; all-to-all modeled as the
+# (g-1)/g pairwise exchange), and latency hops per collective
+_TIME_MODEL = {
+    "all-reduce": (lambda g: 2 * (g - 1) / g, lambda g: 2 * (g - 1)),
+    "all-gather": (lambda g: (g - 1) / g, lambda g: g - 1),
+    "reduce-scatter": (lambda g: (g - 1) / g, lambda g: g - 1),
+    "all-to-all": (lambda g: (g - 1) / g, lambda g: g - 1),
+    "collective-permute": (lambda g: 1.0, lambda g: 1),
+    "collective-broadcast": (lambda g: 1.0, lambda g: g - 1),
+}
+
+
+def predicted_seconds(kind: str, nbytes: int, group_size: int,
+                      medium: str, cluster) -> float:
+    """Analytic wall time of one collective on its declared link: ring
+    bytes / per-medium bandwidth + hops x per-medium latency. DCN
+    bandwidth is the host NIC's share per chip (``dcn_bandwidth /
+    chips_per_host``) — the same split ``Cluster.bandwidth`` uses."""
+    g = max(int(group_size), 1)
+    bytes_f, hops_f = _TIME_MODEL.get(
+        kind, (lambda g: 1.0, lambda g: 1))
+    if medium == "ici":
+        bw = cluster.device("ici_bandwidth")
+        lat = cluster.device("ici_latency")
+    else:
+        bw = cluster.dcn_bandwidth / cluster.chips_per_host
+        lat = cluster.dcn_latency
+    if g == 1:
+        return 0.0  # a self-group moves nothing off-device
+    return bytes_f(g) * nbytes / bw + hops_f(g) * lat
+
+
+# ------------------------------------------------------------------ report
+@dataclass(frozen=True)
+class MeshRow:
+    """One collective, placed: which axis it reduces over, which link
+    that axis rides, and what the link-time model charges it."""
+    kind: str
+    nbytes: int
+    axis: str | None       # axis name / "a+b" / "global" / None
+    medium: str | None     # "ici" | "dcn" | None when unattributed
+    group_size: int
+    group_count: int
+    predicted_s: float
+    instr: str
+
+
+@dataclass(frozen=True)
+class MeshReport:
+    """Per-medium roll-up of one step's collectives on one topology."""
+    name: str
+    topology: MeshTopology = field(repr=False)
+    rows: tuple = ()
+
+    # ----------------------------------------------------------- roll-ups
+    @property
+    def unattributed(self) -> tuple:
+        return tuple(r for r in self.rows if r.axis is None)
+
+    def _bytes(self, medium: str) -> int:
+        return sum(r.nbytes for r in self.rows if r.medium == medium)
+
+    def _ops(self, medium: str) -> int:
+        return sum(1 for r in self.rows if r.medium == medium)
+
+    @property
+    def ici_bytes(self) -> int:
+        return self._bytes("ici")
+
+    @property
+    def dcn_bytes(self) -> int:
+        return self._bytes("dcn")
+
+    @property
+    def ici_ops(self) -> int:
+        return self._ops("ici")
+
+    @property
+    def dcn_ops(self) -> int:
+        return self._ops("dcn")
+
+    @property
+    def predicted_s(self) -> float:
+        return sum(r.predicted_s for r in self.rows)
+
+    @property
+    def axis_media(self) -> dict:
+        """{axis label: medium} over attributed rows — the structural
+        fingerprint the bank pins."""
+        out: dict = {}
+        for r in self.rows:
+            if r.axis is not None:
+                out[r.axis] = r.medium
+        return out
+
+    # -------------------------------------------------------- enforcement
+    def check(self, budget: CollectiveBudget) -> "MeshReport":
+        """Enforce the per-medium arms of ``budget``. Raises
+        :class:`MeshCheckError` when the topology failed to attribute any
+        collective, :class:`CollectiveBudgetError` (naming the axis, the
+        medium, and the measured bytes) when a per-medium cap is
+        breached. The topology-blind arms (per-kind counts, total bytes,
+        overlap) stay with ``HloAuditReport.enforce``."""
+        bad = self.unattributed
+        if bad:
+            lines = "; ".join(
+                f"{r.kind} %{r.instr} groups x{r.group_count}"
+                for r in bad[:4])
+            raise MeshCheckError(
+                f"meshcheck({self.name!r}): {len(bad)} collective(s) the "
+                f"declared topology {self.topology.describe()} cannot "
+                f"attribute to any axis subset: {lines} — every "
+                f"collective must map to a declared mesh axis before "
+                f"per-medium budgets mean anything")
+        for medium, cap in (("dcn", budget.max_dcn_bytes),
+                            ("ici", budget.max_ici_bytes)):
+            if cap is None:
+                continue
+            measured = self._bytes(medium)
+            if measured > cap:
+                axes = sorted({r.axis for r in self.rows
+                               if r.medium == medium})
+                raise CollectiveBudgetError(
+                    f"meshcheck({self.name!r}): axis "
+                    f"{'+'.join(axes)!r} rides {medium.upper()} — "
+                    f"{self._ops(medium)} collective(s), "
+                    f"{measured} bytes ({_fmt_bytes(measured)}) > "
+                    f"max_{medium}_bytes={cap} on topology "
+                    f"{self.topology.describe()}")
+        if budget.max_dcn_ops is not None and self.dcn_ops > budget.max_dcn_ops:
+            axes = sorted({r.axis for r in self.rows if r.medium == "dcn"})
+            raise CollectiveBudgetError(
+                f"meshcheck({self.name!r}): axis {'+'.join(axes)!r} "
+                f"rides DCN — {self.dcn_ops} collective(s) > "
+                f"max_dcn_ops={budget.max_dcn_ops} "
+                f"({self.dcn_bytes} bytes across the host boundary) on "
+                f"topology {self.topology.describe()}")
+        return self
+
+    # ------------------------------------------------------------ display
+    def summary(self) -> str:
+        head = (f"meshcheck {self.name!r} on {self.topology.describe()}: "
+                f"{len(self.rows)} collective(s) — "
+                f"ici {self.ici_ops} op(s)/{_fmt_bytes(self.ici_bytes)}, "
+                f"dcn {self.dcn_ops} op(s)/{_fmt_bytes(self.dcn_bytes)}, "
+                f"predicted {self.predicted_s * 1e6:.1f} us/step")
+        lines = [head]
+        for r in self.rows:
+            axis = r.axis if r.axis is not None else "UNATTRIBUTED"
+            med = r.medium if r.medium is not None else "?"
+            lines.append(
+                f"  {r.kind:<22} axis={axis:<10} {med:<4} "
+                f"g={r.group_size:<3} x{r.group_count:<3} "
+                f"{_fmt_bytes(r.nbytes):>10}  "
+                f"{r.predicted_s * 1e6:8.2f} us  %{r.instr}")
+        return "\n".join(lines)
+
+
+def analyze(collectives, topology: MeshTopology,
+            name: str = "step") -> MeshReport:
+    """Place every collective of one step on the declared topology."""
+    rows = []
+    for op in collectives:
+        placed = attribute(op, topology)
+        if placed is None:
+            rows.append(MeshRow(op.kind, op.nbytes, None, None, 0,
+                                op.group_count, 0.0, op.instr))
+            continue
+        axis, medium, group_size = placed
+        rows.append(MeshRow(
+            op.kind, op.nbytes, axis, medium, group_size,
+            op.group_count or 1,
+            predicted_seconds(op.kind, op.nbytes, group_size, medium,
+                              topology.cluster),
+            op.instr))
+    return MeshReport(name=name, topology=topology, rows=tuple(rows))
+
+
+# -------------------------------------------------------------------- bank
+#: structural keys pinned EXACTLY by the bank — a changed collective
+#: count, per-medium byte/op split, or axis->medium map is a placement
+#: regression, not drift
+ANALYTIC_KEYS = ("collectives", "ici_bytes", "dcn_bytes", "ici_ops",
+                 "dcn_ops", "axes")
+
+
+def bank_path() -> str:
+    """repo-root/profiles/meshcheck.json — beside kernelcheck's bank."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "profiles", "meshcheck.json")
+
+
+def record(report: MeshReport) -> dict:
+    return {
+        "topology": report.topology.describe(),
+        "collectives": len(report.rows),
+        "ici_bytes": report.ici_bytes,
+        "dcn_bytes": report.dcn_bytes,
+        "ici_ops": report.ici_ops,
+        "dcn_ops": report.dcn_ops,
+        "axes": {k: v for k, v in sorted(report.axis_media.items())},
+        "predicted_s": round(report.predicted_s, 9),
+    }
+
+
+@dataclass(frozen=True)
+class MeshFinding:
+    category: str   # "drift"
+    severity: str   # "error" | "warn"
+    message: str
+
+
+def diff_banked(records: dict, banked: dict) -> list:
+    """kernelcheck-style drift-on-load: structural keys exact (error),
+    modeled seconds within 25% (warn beyond). A missing bank entry is an
+    error that names the fix (--bank)."""
+    findings = []
+    for name, rec in sorted(records.items()):
+        old = banked.get(name)
+        if old is None:
+            findings.append(MeshFinding(
+                "drift", "error",
+                f"{name}: no banked placement — run "
+                f"`python -m paddle_tpu.analysis meshcheck --bank` to "
+                f"freeze the contract"))
+            continue
+        for key in ANALYTIC_KEYS:
+            if rec.get(key) != old.get(key):
+                findings.append(MeshFinding(
+                    "drift", "error",
+                    f"{name}: {key} drifted from banked "
+                    f"{old.get(key)!r} to {rec.get(key)!r} — placement "
+                    f"is analytic; an unexplained change is a "
+                    f"regression (re-bank only with the diff in hand)"))
+        new_s, old_s = rec.get("predicted_s", 0.0), old.get("predicted_s")
+        if old_s is not None and not math.isclose(
+                new_s, old_s, rel_tol=0.25, abs_tol=1e-12):
+            findings.append(MeshFinding(
+                "drift", "warn",
+                f"{name}: predicted_s drifted {old_s:.3e} -> "
+                f"{new_s:.3e} (>25%) — link-time model or cluster "
+                f"constants changed"))
+    return findings
+
+
+# ----------------------------------------------------------------- registry
+@dataclass(frozen=True)
+class MeshStepSpec:
+    """One certifiable placement: an hlocheck registry step re-audited on
+    a declared topology, with the per-medium budget derived from the
+    step's own hlocheck budget by ``budget(base)``."""
+    name: str
+    doc: str
+    hlo_step: str
+    topology: object = field(repr=False)   # () -> MeshTopology
+    budget: object = field(repr=False)     # (base: CollectiveBudget) -> ...
+
+
+def _all_ici_budget(base: CollectiveBudget) -> CollectiveBudget:
+    """1-host contract: every byte the step may move rides ICI, and the
+    DCN arms are ZERO — binding, because any misattributed or cross-host
+    group fails closed to 'dcn' and trips them."""
+    return replace(base, max_ici_bytes=base.max_collective_bytes,
+                   max_dcn_bytes=0, max_dcn_ops=0)
+
+
+def _all_dcn_budget(base: CollectiveBudget) -> CollectiveBudget:
+    """2-host x 1-chip contract: the tp axis HAS no intra-host pair, so
+    every collective must classify DCN — zero ICI bytes, and the DCN
+    arms inherit the step's own caps."""
+    ops = (base.all_reduce + base.all_gather + base.reduce_scatter +
+           base.collective_permute + base.all_to_all +
+           base.collective_broadcast)
+    return replace(base, max_ici_bytes=0,
+                   max_dcn_bytes=base.max_collective_bytes,
+                   max_dcn_ops=ops)
+
+
+def _tp2_topology() -> MeshTopology:
+    return single_host_topology(2)
+
+
+def _tp2_2host_topology() -> MeshTopology:
+    # 2 hosts x 1 chip: rank 0 on host 0, rank 1 on host 1 — the SAME
+    # tp=2 program's one axis now provably crosses the host boundary
+    return multi_host_topology(2, 1, (("tp", 2),))
+
+
+MESH_REGISTRY: dict = {s.name: s for s in (
+    MeshStepSpec(
+        "tp8_toy_1host",
+        "toy tp8 shard_map decode on a declared 1-host x 8-chip mesh: "
+        "the one all-reduce attributes to axis 'tp', all-ICI, zero-DCN "
+        "budget binding",
+        "tp8_decode", lambda: single_host_topology(8), _all_ici_budget),
+    MeshStepSpec(
+        "tp2_engine_prefill_1host",
+        "TP=2 serving prefill on a declared 1-host topology: 2L+1 "
+        "all-reduces all attribute to 'tp', all-ICI, DCN=0 binding",
+        "tp2_engine_prefill", _tp2_topology, _all_ici_budget),
+    MeshStepSpec(
+        "tp2_engine_prefill_chunk_1host",
+        "TP=2 chunked prefill (mid-prompt chunk) on the 1-host topology",
+        "tp2_engine_prefill_chunk", _tp2_topology, _all_ici_budget),
+    MeshStepSpec(
+        "tp2_engine_decode_1host",
+        "TP=2 serving decode on the 1-host topology: DCN=0 binding",
+        "tp2_engine_decode", _tp2_topology, _all_ici_budget),
+    MeshStepSpec(
+        "tp2_engine_verify_spec_1host",
+        "TP=2 speculative verify on the 1-host topology: the in-jit "
+        "proposer adds zero collectives, so the placement is decode's",
+        "tp2_engine_verify_spec", _tp2_topology, _all_ici_budget),
+    MeshStepSpec(
+        "tp2_engine_decode_2host",
+        "the SAME tp=2 decode program declared on a 2-host x 1-chip "
+        "mesh: axis 'tp' provably crosses the host boundary, every "
+        "all-reduce classifies DCN — the byte cap the multi-host arc "
+        "will inherit (a zero-DCN budget on this entry must raise)",
+        "tp2_engine_decode", _tp2_2host_topology, _all_dcn_budget),
+)}
+
+
+def run_entry(name: str):
+    """Build + audit one registry entry: hlocheck-audit the underlying
+    step (enforcing its topology-blind budget first — meshcheck never
+    weakens the existing gate), then attribute on the declared topology
+    and enforce the per-medium budget. Returns (HloAuditReport,
+    MeshReport)."""
+    spec = MESH_REGISTRY.get(name)
+    if spec is None:
+        raise KeyError(f"unknown meshcheck entry {name!r} "
+                       f"(have: {', '.join(MESH_REGISTRY)})")
+    from . import hlocheck
+
+    hspec = hlocheck.REGISTRY[spec.hlo_step]
+    import jax
+
+    have = len(jax.devices())
+    if have < hspec.min_devices:
+        raise MeshCheckError(
+            f"entry {name!r} needs {hspec.min_devices} devices, have "
+            f"{have} — run under XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={hspec.min_devices} "
+            f"(the meshcheck CLI does this automatically)")
+    target, args, jit_kwargs, base = hspec.build()
+    from .tracecheck import CompileGuard
+
+    if isinstance(target, CompileGuard):
+        report = hlocheck.audit_guard(target, args, budget=base, name=name)
+    else:
+        report = hlocheck.audit(target, args, name=name, budget=base,
+                                **(jit_kwargs or {}))
+    topology = spec.topology()
+    mesh_report = analyze(report.collectives, topology, name=name)
+    mesh_report.check(spec.budget(base))
+    return report, mesh_report
+
+
+def min_devices(name: str) -> int:
+    from . import hlocheck
+
+    return hlocheck.REGISTRY[MESH_REGISTRY[name].hlo_step].min_devices
+
+
+# ---------------------------------------------------------------------- CLI
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis meshcheck",
+        description="Topology-aware collective placement analyzer: "
+                    "attribute every collective to its mesh axis, "
+                    "classify ICI vs DCN, enforce per-medium byte "
+                    "budgets, model link time, pin the placements to "
+                    "profiles/meshcheck.json.")
+    parser.add_argument("--step", action="append", default=None,
+                        metavar="NAME",
+                        help="certify only these registry entries "
+                             "(repeatable; default: all)")
+    parser.add_argument("--list-steps", action="store_true",
+                        help="print the entry registry and exit")
+    parser.add_argument("--bank", action="store_true",
+                        help="(re)write profiles/meshcheck.json from this "
+                             "run's placements (refused while any entry "
+                             "is in violation)")
+    parser.add_argument("--profile", default=None, metavar="PATH",
+                        help="bank file to check/write "
+                             "(default: profiles/meshcheck.json)")
+    args = parser.parse_args(argv)
+
+    from . import hlocheck
+
+    if args.list_steps:
+        for s in MESH_REGISTRY.values():
+            need = hlocheck.REGISTRY[s.hlo_step].min_devices
+            extra = f" [needs {need} devices]" if need > 1 else ""
+            print(f"{s.name}  {s.doc}{extra}")
+        return 0
+    names = args.step or list(MESH_REGISTRY)
+    unknown = [n for n in names if n not in MESH_REGISTRY]
+    if unknown:
+        print(f"unknown entry(s): {', '.join(unknown)} "
+              f"(have: {', '.join(MESH_REGISTRY)})")
+        return 2
+    import jax
+
+    profile = args.profile or bank_path()
+    violations = errors = 0
+    records: dict = {}
+    for name in names:
+        spec = MESH_REGISTRY[name]
+        hspec = hlocheck.REGISTRY[spec.hlo_step]
+        if len(jax.devices()) < hspec.min_devices:
+            if os.environ.get(hlocheck._CHILD_ENV):
+                print(f"FAIL {name}: forced {hspec.min_devices}-device "
+                      f"CPU mesh did not take effect in the respawned "
+                      f"child (execution error, not a budget violation)")
+                errors += 1
+                continue
+            # reuse hlocheck's respawn mechanism: same env forcing, same
+            # recursion guard, our argv — banking is delegated to the
+            # child, whose partial --bank merges into the shared profile
+            cmd = ["meshcheck", "--step", name]
+            if args.bank:
+                cmd.append("--bank")
+            if args.profile:
+                cmd += ["--profile", args.profile]
+            child_spec = hlocheck.StepSpec(
+                name=name, doc=spec.doc, build=None,
+                min_devices=hspec.min_devices)
+            rc, out = hlocheck._run_in_subprocess(
+                child_spec, cmd_args=cmd, label="meshcheck")
+            if rc == 0:
+                continue
+            if rc == 1 and "FAIL" in out \
+                    and "not a budget violation" not in out:
+                violations += 1
+            else:
+                print(f"FAIL {name}: respawned child exited rc={rc} "
+                      f"(execution error, not a budget violation)")
+                errors += 1
+            continue
+        try:
+            _, mrep = run_entry(name)
+            print(mrep.summary())
+            records[name] = record(mrep)
+        except (MeshCheckError, CollectiveBudgetError, HloCheckError) as e:
+            print(f"FAIL {name}: {e}")
+            violations += 1
+        except Exception as e:  # noqa: BLE001 — one broken entry must not
+            # abort the sweep (same contract as the hlocheck CLI)
+            print(f"FAIL {name}: {type(e).__name__}: {e} "
+                  f"(execution error, not a budget violation)")
+            errors += 1
+
+    if args.bank:
+        if violations or errors:
+            print("not banking: certification violations above")
+        elif records:
+            merged = dict(records)
+            if set(records) != set(MESH_REGISTRY) \
+                    and os.path.exists(profile):
+                with open(profile) as fh:
+                    merged = {**json.load(fh), **records}
+            os.makedirs(os.path.dirname(profile), exist_ok=True)
+            with open(profile, "w") as fh:
+                json.dump(dict(sorted(merged.items())), fh, indent=2,
+                          sort_keys=True)
+                fh.write("\n")
+            print(f"banked {len(records)} placement(s) -> {profile}")
+    elif records:
+        if not os.path.exists(profile):
+            print(f"no banked placements at {profile} — run --bank to "
+                  f"freeze the contracts")
+            violations += len(records)
+        else:
+            with open(profile) as fh:
+                banked = json.load(fh)
+            for f in diff_banked(records, banked):
+                print(f"{f.severity.upper()} {f.message}")
+                if f.severity == "error":
+                    violations += 1
+
+    if violations or errors:
+        print(f"{violations} entry(s) in violation, {errors} entry(s) "
+              f"errored")
+    else:
+        print(f"meshcheck clean: {len(names)} entry(s) within "
+              f"per-medium budget")
+    return 1 if (violations or errors) else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -180,6 +180,7 @@ never enters a traced program.
 """
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import time
 from dataclasses import dataclass
@@ -249,6 +250,15 @@ class ServingConfig:
     # payload shrinks ~4x at a bounded greedy-quality delta. Off =
     # bit-identical to the unquantized engine (the branch never traces).
     # No-op unless tensor_parallel > 1.
+    mesh_topology: object | None = None  # analysis.meshcheck.MeshTopology
+    # declaring WHERE the tp mesh lives (hosts x chips-per-host x named
+    # axes). Under debug_checks the first-trace audit attributes every
+    # collective to its axis, classifies ICI vs DCN, enforces the
+    # step budget's per-medium arms (zero-DCN binding when the declared
+    # topology is single-host), and feeds the serving_{ici,dcn}_bytes_
+    # per_token / serving_collective_time_predicted_s gauges. None =
+    # a default single-host topology over tensor_parallel chips (gauges
+    # still fed; per-medium arms not enforced — nothing was declared).
     chunk_size: int = 0  # prefill tokens per step per request; 0 = whole
     # tail in one pass (chunking off). Chunks ride the SAME prefill jit
     # (ctx_lens = tokens already resident) padded into the existing
@@ -1899,6 +1909,31 @@ class ServingEngine:
                 collective_ops=len(report.collectives),
                 bytes_per_token=report.collective_bytes / (b * s),
                 overlap_frac=report.overlap_frac)
+            # meshcheck placement: attribute every collective to its mesh
+            # axis on the declared topology (default: single-host over
+            # the tp degree), classify ICI vs DCN, and feed the
+            # per-medium gauges. A DECLARED topology is also enforced —
+            # per-medium budget arms, zero-DCN binding when single-host —
+            # so a misdeclared mesh fails here, not in production
+            from ..analysis import meshcheck
+
+            topology = self.config.mesh_topology
+            if topology is None:
+                topology = meshcheck.single_host_topology(self._tp.degree)
+            mesh_report = meshcheck.analyze(
+                report.collectives, topology, name=label)
+            if self.config.mesh_topology is not None:
+                budget = self._step_budget(label)
+                if topology.cluster.n_hosts == 1:
+                    budget = dataclasses.replace(
+                        budget,
+                        max_ici_bytes=budget.max_collective_bytes,
+                        max_dcn_bytes=0, max_dcn_ops=0)
+                mesh_report.check(budget)
+            self.metrics.on_mesh_audit(
+                ici_bytes_per_token=mesh_report.ici_bytes / (b * s),
+                dcn_bytes_per_token=mesh_report.dcn_bytes / (b * s),
+                predicted_s=mesh_report.predicted_s)
 
     def _step_shape(self, label: str) -> tuple[int, int]:
         """(batch, seq) of a compiled engine program, from its audit label

@@ -133,6 +133,18 @@ each sharded program's first-trace audit — the EQuARX baseline numbers):
 - serving_tp_collective_bytes_per_token  max collective payload bytes
                                          per token a program advances
 
+Collective placement (pre-seeded; fed from the meshcheck attribution at
+the same first-trace audit — per-medium split on the declared, or
+default single-host, MeshTopology):
+
+- serving_ici_bytes_per_token            max per-token collective bytes
+                                         riding ICI (within a host)
+- serving_dcn_bytes_per_token            max per-token collective bytes
+                                         riding DCN (across hosts) —
+                                         0.0 IS the single-host contract
+- serving_collective_time_predicted_s    max link-time-model predicted
+                                         collective seconds per step
+
 Latency histograms (paddle_tpu.obs integration): fixed-bucket streaming
 histograms — bounded memory, O(log buckets) per observation — feed the
 percentile gauges ``serving_<hist>_p50/p90/p99`` (+ ``_count``) for:
@@ -274,6 +286,8 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "hlo_peak_hbm_bytes", "hlo_flops_per_step",
            "tp_degree", "tp_collective_ops_per_step",
            "tp_collective_bytes_per_token", "tp_collective_overlap_frac",
+           "ici_bytes_per_token", "dcn_bytes_per_token",
+           "collective_time_predicted_s",
            "tokens_per_sec", "queue_depth", "active_requests",
            "page_pool_used", "page_utilization", "mfu", "hbm_bw_util",
            "fleet_replicas", "fleet_prefix_affinity_hits_total",
@@ -593,6 +607,23 @@ class ServingMetrics:
                          float(bytes_per_token))
         monitor.stat_max(PREFIX + "tp_collective_overlap_frac",
                          float(overlap_frac))
+
+    def on_mesh_audit(self, ici_bytes_per_token: float,
+                      dcn_bytes_per_token: float,
+                      predicted_s: float) -> None:
+        """One meshcheck placement audit (debug_checks, once per compiled
+        program): the per-token collective payload split by the link it
+        rides — ICI within a host vs DCN across hosts, attributed by
+        analysis/meshcheck against the declared (or default single-host)
+        MeshTopology — and the link-time model's predicted collective
+        seconds per step. stat_max keeps the worst program observed;
+        dcn_bytes_per_token staying 0.0 IS the single-host contract."""
+        monitor.stat_max(PREFIX + "ici_bytes_per_token",
+                         float(ici_bytes_per_token))
+        monitor.stat_max(PREFIX + "dcn_bytes_per_token",
+                         float(dcn_bytes_per_token))
+        monitor.stat_max(PREFIX + "collective_time_predicted_s",
+                         float(predicted_s))
 
     def on_hlo_audit(self, collective_ops: int, host_transfers: int,
                      peak_hbm_bytes: int, flops: float) -> None:

@@ -1,0 +1,43 @@
+"""PT016 fixture: nondeterminism sources (wall clock, global RNG,
+id()-keyed ordering) in serving/ outside the sanctioned modules. The
+fixture is linted AS IF it lived at serving/pt016.py; its intentional
+positives are what the rule test pins. time.time() is deliberately
+absent — that arm of the fence is PT004's."""
+import random
+import time
+
+import numpy as np
+
+
+def stamp(events):
+    t = time.monotonic()  # finding: wall clock outside the engine clock
+    return [(t, e) for e in events]
+
+
+def jitter():
+    return random.random() + np.random.rand()  # finding: global RNGs
+
+
+def shuffle(requests):
+    random.shuffle(requests)  # finding: global RNG state
+    return sorted(requests, key=id)  # finding: allocator-address order
+
+
+def dedup(requests):
+    seen = {}
+    for r in requests:
+        seen[id(r)] = r  # finding: id()-keyed table
+    return seen
+
+
+def stamp_suppressed(events):
+    t = time.monotonic()  # lint: disable=PT016
+    return [(t, e) for e in events]
+
+
+def good(engine, requests, seed):
+    now = engine.now()  # the pluggable clock: not a finding
+    rng = np.random.RandomState(seed)  # seeded constructor: fine
+    local = random.Random(seed)  # seeded instance: fine
+    order = sorted(requests, key=lambda r: r.rid)  # stable key: fine
+    return now, rng.rand(), local.random(), order

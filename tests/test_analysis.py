@@ -382,6 +382,9 @@ _FIXTURE_CASES = {
     "pt015_raw_psum.py": ("serving/rogue_collective.py",
                           {6: "PT015", 7: "PT015",
                            11: "PT015", 12: "PT015"}),
+    "pt016_wallclock.py": ("serving/pt016.py",
+                           {13: "PT016", 18: "PT016", 22: "PT016",
+                            23: "PT016", 29: "PT016"}),
 }
 
 
@@ -401,7 +404,7 @@ def test_lint_rule_fixture(fixture):
 
 def test_lint_rule_table_is_complete():
     assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + [
-        "PT010", "PT011", "PT012", "PT013", "PT014", "PT015"]
+        "PT010", "PT011", "PT012", "PT013", "PT014", "PT015", "PT016"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -648,6 +651,34 @@ def test_self_lint_catches_reintroduced_wall_clock():
     assert bad != src
     findings = lint_source(bad, "paddle_tpu/serving/engine.py")
     assert any(f.rule == "PT004" for f in findings)
+
+
+def test_self_lint_pt016_determinism_fence():
+    """PT016's two strip-reintroduction directions. (1) chaos.py's RNG is
+    sanctioned ONLY because it is seeded: stripping the seed argument
+    from its RandomState fires. (2) the clock gate is the filename:
+    engine.py's pluggable-clock default (`clock or time.monotonic`) is
+    the one sanctioned wall-clock binding — the very same module linted
+    under any other serving filename fires, so moving the clock binding
+    out of engine.py reintroduces the finding."""
+    chaos = (REPO / "paddle_tpu" / "serving" / "chaos.py").read_text()
+    assert "np.random.RandomState(cfg.seed)" in chaos, \
+        "chaos.py no longer seeds its RNG this way?"
+    assert not any(f.rule == "PT016" for f in lint_source(
+        chaos, "paddle_tpu/serving/chaos.py"))
+    unseeded = chaos.replace("np.random.RandomState(cfg.seed)",
+                             "np.random.RandomState()")
+    findings = lint_source(unseeded, "paddle_tpu/serving/chaos.py")
+    assert any(f.rule == "PT016" and "seed" in f.message
+               for f in findings)
+
+    eng = (REPO / "paddle_tpu" / "serving" / "engine.py").read_text()
+    assert "clock or time.monotonic" in eng
+    assert not any(f.rule == "PT016" for f in lint_source(
+        eng, "paddle_tpu/serving/engine.py"))
+    findings = lint_source(eng, "paddle_tpu/serving/scheduler.py")
+    assert any(f.rule == "PT016" and "monotonic" in f.message
+               for f in findings)
 
 
 @pytest.mark.slow  # re-tiered 2026-08 (PR 8): tier-1 crossed its 870 s budget on the 1-core box; --durations top mover

@@ -1,0 +1,515 @@
+"""meshcheck: topology-aware collective placement (analysis/meshcheck.py).
+
+The contract under test, layer by layer:
+
+- **Census parse** (the hlocheck satellite): every collective kind, in
+  grouped AND global forms, sync and async, carries its
+  ``replica_groups`` / ``group_count`` / ``channel_id`` /
+  ``use_global_device_ids`` on the existing census rows — one parse,
+  no topology needed, both explicit ``{{...}}`` and iota
+  ``[G,S]<=[dims]T(perm)`` syntaxes.
+- **Axis attribution goldens** on declared 1-host and 2-host
+  topologies: single axis, joint multi-axis, global, permute pairs,
+  and the refuse-to-certify path for groups the topology cannot
+  explain.
+- **Per-medium budgets**: ``max_ici_bytes`` / ``max_dcn_bytes`` /
+  ``max_dcn_ops`` violations name the axis, the medium, and the
+  measured bytes.
+- **Link-time model**: exact ring-factor formulas against the cluster
+  constants, per medium.
+- **Bank round-trip + drift**: kernelcheck-style — structural keys
+  exact (error), predicted seconds 25% tolerance (warn), missing entry
+  names ``--bank``.
+- **Registry certification**: the tp2 engine entries on the 1-host
+  topology (zero-DCN budget BINDING), and the acceptance gate — the
+  2-host x 1-chip entry whose tp axis provably crosses the host
+  boundary, where a zero-DCN budget must raise naming axis, medium,
+  and bytes.
+- **Serving integration**: gauges pre-seeded at zero, and the engine's
+  first-trace audit hook feeding them under a declared topology.
+- **The one-shot gate**: ``check_all`` runs all four engines in
+  process and folds the exit codes.
+
+Runs on the conftest-forced 8-device CPU mesh; sharded engine builds
+are the cost center, so registry entries are module-scoped fixtures.
+"""
+import json
+
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.analysis import check_all, meshcheck as mc
+from paddle_tpu.analysis.hlocheck import (SINGLE_CHIP, CollectiveBudget,
+                                          CollectiveBudgetError,
+                                          CollectiveOp, census)
+from paddle_tpu.distributed.auto_parallel.cluster import (Cluster,
+                                                          cpu_test_cluster)
+
+pytestmark = pytest.mark.meshcheck
+
+HIDDEN, LAYERS, VOCAB = 32, 2, 97  # the registry's toy GPT
+
+
+# ------------------------------------------------------------ census parse
+_SNIPPETS = {
+    # kind -> (instruction line, expected groups, count, channel, global)
+    "all-reduce": (
+        "  %ar = f32[4,8]{1,0} all-reduce(f32[4,8]{1,0} %p), channel_id=1,"
+        " replica_groups={{0,1},{2,3}}, use_global_device_ids=true,"
+        " to_apply=%add",
+        ((0, 1), (2, 3)), 2, 1, True),
+    "all-gather": (
+        "  %ag = f32[8,8]{1,0} all-gather(f32[4,8]{1,0} %p),"
+        " replica_groups={{0,1,2,3}}, dimensions={0}",
+        ((0, 1, 2, 3),), 1, None, False),
+    "reduce-scatter": (
+        "  %rs = f32[1,8]{1,0} reduce-scatter(f32[4,8]{1,0} %p),"
+        " replica_groups={}, dimensions={0}, to_apply=%add",
+        (), 0, None, False),
+    "all-to-all": (
+        "  %a2a = f32[4,8]{1,0} all-to-all(f32[4,8]{1,0} %p),"
+        " channel_id=3, replica_groups={{0,2},{1,3}}, dimensions={0}",
+        ((0, 2), (1, 3)), 2, 3, False),
+    "collective-permute": (
+        "  %cp = f32[4,8]{1,0} collective-permute(f32[4,8]{1,0} %p),"
+        " channel_id=4, source_target_pairs={{0,1},{1,0}}",
+        ((0, 1), (1, 0)), 2, 4, False),
+    "collective-broadcast": (
+        "  %cb = f32[4,8]{1,0} collective-broadcast(f32[4,8]{1,0} %p),"
+        " replica_groups={{0,1,2,3}}",
+        ((0, 1, 2, 3),), 1, None, False),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(_SNIPPETS))
+def test_census_parses_groups_per_kind(kind):
+    """Each collective kind's participant structure lands on the census
+    row — no topology declared, no second HLO walk."""
+    line, groups, count, channel, glob = _SNIPPETS[kind]
+    cols, _ = census(f"ENTRY %main {{\n{line}\n}}\n")
+    assert len(cols) == 1
+    op = cols[0]
+    assert op.kind == kind
+    assert op.replica_groups == groups
+    assert op.group_count == count
+    assert op.channel_id == channel
+    assert op.use_global_device_ids is glob
+
+
+def test_census_parses_groups_on_async_start():
+    """Async pairs record groups at the ``-start`` (where XLA prints
+    them), still counting once and charging the result half."""
+    hlo = (
+        "ENTRY %m {\n"
+        "  %s = (f32[4,8]{1,0}, f32[4,8]{1,0}) all-reduce-start("
+        "f32[4,8]{1,0} %p), channel_id=7, replica_groups={{0,1},{2,3}},"
+        " use_global_device_ids=true, to_apply=%add\n"
+        "  %w = f32[4,8]{1,0} multiply(f32[4,8]{1,0} %p, f32[4,8]{1,0} %p)\n"
+        "  %d = f32[4,8]{1,0} all-reduce-done((f32[4,8]{1,0},"
+        " f32[4,8]{1,0}) %s)\n"
+        "}\n")
+    cols, _ = census(hlo)
+    assert len(cols) == 1
+    op = cols[0]
+    assert op.is_async and op.overlap == 1
+    assert op.replica_groups == ((0, 1), (2, 3))
+    assert op.channel_id == 7 and op.use_global_device_ids
+
+
+def test_census_parses_iota_replica_groups():
+    """The iota form newer XLA emits for large meshes: ranks reshaped to
+    the dims (C order), optionally transposed, chunked into G groups of
+    S — decoded to the same explicit tuples."""
+    plain = ("ENTRY %m {\n  %ar = f32[4]{0} all-reduce(f32[4]{0} %p),"
+             " replica_groups=[2,2]<=[4], to_apply=%add\n}\n")
+    (op,), _ = census(plain)
+    assert op.replica_groups == ((0, 1), (2, 3)) and op.group_count == 2
+    transposed = ("ENTRY %m {\n  %ar = f32[4]{0} all-reduce(f32[4]{0} %p),"
+                  " replica_groups=[2,2]<=[2,2]T(1,0), to_apply=%add\n}\n")
+    (op,), _ = census(transposed)
+    assert op.replica_groups == ((0, 2), (1, 3)) and op.group_count == 2
+
+
+# ---------------------------------------------------------------- topology
+def _topo_2x2():
+    # 2 hosts x 2 chips, dp major, tp minor: tp pairs live within a
+    # host, dp pairs straddle the boundary
+    return mc.multi_host_topology(2, 2, (("dp", 2), ("tp", 2)))
+
+
+def test_topology_validation_and_groups():
+    with pytest.raises(mc.MeshCheckError, match="tile the whole cluster"):
+        mc.MeshTopology(cpu_test_cluster(8), (("tp", 4),))
+    with pytest.raises(mc.MeshCheckError, match="duplicate"):
+        mc.MeshTopology(cpu_test_cluster(4), (("tp", 2), ("tp", 2)))
+    topo = _topo_2x2()
+    assert topo.n_devices == 4
+    assert topo.axis_groups("tp") == ((0, 1), (2, 3))
+    assert topo.axis_groups("dp") == ((0, 2), (1, 3))
+    assert topo.subset_groups(("dp", "tp")) == ((0, 1, 2, 3),)
+    assert topo.medium_of(("tp",)) == "ici"
+    assert topo.medium_of(("dp",)) == "dcn"
+
+
+def _op(kind, groups, nbytes=1024, instr="c.1"):
+    return CollectiveOp(kind, nbytes, instr, "line",
+                        replica_groups=groups, group_count=len(groups))
+
+
+@pytest.mark.parametrize("groups,expect", [
+    (((0, 1), (2, 3)), ("tp", "ici", 2)),       # minor axis: intra-host
+    (((0, 2), (1, 3)), ("dp", "dcn", 2)),       # major axis: cross-host
+    (((0, 1, 2, 3),), ("dp+tp", "dcn", 4)),     # joint reduce: full mesh
+    ((), ("global", "dcn", 4)),                 # no groups named at all
+    (((0, 3), (1, 2)), None),                   # no axis produces these
+])
+def test_attribution_goldens_2host(groups, expect):
+    assert mc.attribute(_op("all-reduce", groups), _topo_2x2()) == expect
+
+
+def test_attribution_goldens_1host():
+    """On the declared single-host topology everything is ICI — and the
+    full-mesh group attributes to the one axis BY NAME (not 'global'),
+    which is what makes the zero-DCN budget binding, not vacuous."""
+    topo = mc.single_host_topology(2)
+    assert mc.attribute(_op("all-reduce", ((0, 1),)), topo) == \
+        ("tp", "ici", 2)
+    assert mc.attribute(_op("all-gather", ()), topo) == ("global", "ici", 2)
+
+
+def test_attribution_permute_pairs():
+    topo = _topo_2x2()
+    intra = _op("collective-permute", ((0, 1), (1, 0)))
+    assert mc.attribute(intra, topo) == ("tp", "ici", 2)
+    cross = _op("collective-permute", ((0, 2), (2, 0)))
+    assert mc.attribute(cross, topo) == ("dp", "dcn", 2)
+    diagonal = _op("collective-permute", ((0, 3),))
+    assert mc.attribute(diagonal, topo) is None
+
+
+# ------------------------------------------------------ per-medium budgets
+def test_check_unattributed_refuses_to_certify():
+    rep = mc.analyze([_op("all-reduce", ((0, 3), (1, 2)))], _topo_2x2(),
+                     name="rogue")
+    with pytest.raises(mc.MeshCheckError, match="cannot attribute"):
+        rep.check(CollectiveBudget(all_reduce=1))
+
+
+def test_check_violation_messages_name_axis_medium_bytes():
+    """The acceptance-criteria message shape: axis, medium, and measured
+    bytes all present, for each of the three per-medium arms."""
+    topo = _topo_2x2()
+    dcn_rep = mc.analyze([_op("all-reduce", ((0, 2), (1, 3)),
+                              nbytes=2048)], topo, name="s")
+    with pytest.raises(CollectiveBudgetError) as ei:
+        dcn_rep.check(CollectiveBudget(all_reduce=1, max_dcn_bytes=0))
+    msg = str(ei.value)
+    assert "'dp'" in msg and "DCN" in msg and "2048" in msg \
+        and "max_dcn_bytes=0" in msg
+
+    with pytest.raises(CollectiveBudgetError, match="max_dcn_ops=0"):
+        dcn_rep.check(CollectiveBudget(all_reduce=1, max_dcn_ops=0))
+
+    ici_rep = mc.analyze([_op("all-reduce", ((0, 1), (2, 3)),
+                              nbytes=4096)], topo, name="s")
+    with pytest.raises(CollectiveBudgetError) as ei:
+        ici_rep.check(CollectiveBudget(all_reduce=1, max_ici_bytes=100))
+    msg = str(ei.value)
+    assert "'tp'" in msg and "ICI" in msg and "4096" in msg
+
+    # within caps: clean, and check() is idempotent
+    ici_rep.check(CollectiveBudget(all_reduce=1, max_ici_bytes=4096,
+                                   max_dcn_bytes=0, max_dcn_ops=0))
+
+
+def test_budget_derivations():
+    base = CollectiveBudget(all_reduce=5, max_collective_bytes=1800)
+    ici = mc._all_ici_budget(base)
+    assert (ici.max_ici_bytes, ici.max_dcn_bytes, ici.max_dcn_ops) == \
+        (1800, 0, 0)
+    dcn = mc._all_dcn_budget(base)
+    assert (dcn.max_ici_bytes, dcn.max_dcn_bytes, dcn.max_dcn_ops) == \
+        (0, 1800, 5)
+    assert SINGLE_CHIP.max_dcn_bytes is None  # per-medium arms default off
+
+
+# --------------------------------------------------------- link-time model
+def test_link_time_model_formulas():
+    cl = cpu_test_cluster(4)  # ici 10e9 B/s, 2us; dcn 25e9 / chips, 10us
+    nb = 10_000
+    ici_bw, ici_lat = 10e9, 2e-6
+    assert mc.predicted_seconds("all-reduce", nb, 4, "ici", cl) == \
+        pytest.approx(2 * 3 / 4 * nb / ici_bw + 6 * ici_lat)
+    assert mc.predicted_seconds("all-gather", nb, 4, "ici", cl) == \
+        pytest.approx(3 / 4 * nb / ici_bw + 3 * ici_lat)
+    assert mc.predicted_seconds("collective-permute", nb, 2, "ici", cl) \
+        == pytest.approx(nb / ici_bw + ici_lat)
+    dcn_bw = cl.dcn_bandwidth / cl.chips_per_host
+    assert mc.predicted_seconds("reduce-scatter", nb, 2, "dcn", cl) == \
+        pytest.approx(1 / 2 * nb / dcn_bw + cl.dcn_latency)
+    # a self-group moves nothing
+    assert mc.predicted_seconds("all-reduce", nb, 1, "ici", cl) == 0.0
+    # dcn is slower than ici for the same payload — the whole point
+    assert mc.predicted_seconds("all-reduce", nb, 2, "dcn", cl) > \
+        mc.predicted_seconds("all-reduce", nb, 2, "ici", cl)
+
+
+# --------------------------------------------------------- bank round-trip
+def _toy_report():
+    return mc.analyze([_op("all-reduce", ((0, 1), (2, 3)), nbytes=512)],
+                      _topo_2x2(), name="toy")
+
+
+def test_bank_roundtrip_and_drift():
+    rep = _toy_report()
+    rec = mc.record(rep)
+    assert rec["axes"] == {"tp": "ici"} and rec["ici_bytes"] == 512
+    # identical records: clean
+    assert mc.diff_banked({"toy": rec}, {"toy": dict(rec)}) == []
+    # structural drift: error, names the key
+    bent = dict(rec, ici_bytes=9999)
+    finds = mc.diff_banked({"toy": rec}, {"toy": bent})
+    assert [f.severity for f in finds] == ["error"]
+    assert "ici_bytes" in finds[0].message
+    # predicted-seconds drift: warn beyond 25%, quiet within
+    warm = dict(rec, predicted_s=rec["predicted_s"] * 1.2)
+    assert mc.diff_banked({"toy": rec}, {"toy": warm}) == []
+    hot = dict(rec, predicted_s=rec["predicted_s"] * 2.0)
+    finds = mc.diff_banked({"toy": rec}, {"toy": hot})
+    assert [f.severity for f in finds] == ["warn"]
+    # missing entry: error that names the fix
+    finds = mc.diff_banked({"toy": rec}, {})
+    assert finds[0].severity == "error" and "--bank" in finds[0].message
+
+
+def test_bank_cli_roundtrip(tmp_path, capsys):
+    """The CLI bank workflow end to end on the cheap toy entry: --bank
+    writes, a clean re-check reads, a corrupted bank fails with a drift
+    error, a missing bank names --bank."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    profile = tmp_path / "meshcheck.json"
+    assert mc.main(["--step", "tp8_toy_1host", "--bank",
+                    "--profile", str(profile)]) == 0
+    banked = json.loads(profile.read_text())
+    assert banked["tp8_toy_1host"]["axes"] == {"tp": "ici"}
+    assert mc.main(["--step", "tp8_toy_1host",
+                    "--profile", str(profile)]) == 0
+    banked["tp8_toy_1host"]["dcn_ops"] = 3
+    profile.write_text(json.dumps(banked))
+    assert mc.main(["--step", "tp8_toy_1host",
+                    "--profile", str(profile)]) == 1
+    out = capsys.readouterr().out
+    assert "dcn_ops drifted" in out
+    missing = tmp_path / "nothing.json"
+    assert mc.main(["--step", "tp8_toy_1host",
+                    "--profile", str(missing)]) == 1
+    assert "run --bank" in capsys.readouterr().out
+
+
+def test_committed_bank_matches_registry():
+    """The committed profiles/meshcheck.json stays in lockstep with the
+    registry — every entry banked, every banked name registered (the
+    kernelcheck bank-coverage idiom)."""
+    with open(mc.bank_path()) as fh:
+        banked = json.load(fh)
+    assert set(banked) == set(mc.MESH_REGISTRY)
+    for name, rec in banked.items():
+        assert set(mc.ANALYTIC_KEYS) <= set(rec), name
+
+
+# ------------------------------------------------- registry certification
+@pytest.fixture(scope="module")
+def decode_1host():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    paddle.seed(102)
+    return mc.run_entry("tp2_engine_decode_1host")
+
+
+@pytest.fixture(scope="module")
+def decode_2host():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    paddle.seed(102)
+    return mc.run_entry("tp2_engine_decode_2host")
+
+
+def test_tp2_1host_certifies_all_ici_zero_dcn_binding(decode_1host):
+    """The tp2 decode entry on the declared 1-host topology: every
+    all-reduce attributes to axis 'tp', classifies ICI, and the entry's
+    budget carries max_dcn_bytes=0 / max_dcn_ops=0 — enforced, binding
+    (run_entry already called check()), with the exact byte split the
+    engine's budget formula predicts."""
+    report, mrep = decode_1host
+    assert all(r.axis == "tp" and r.medium == "ici" for r in mrep.rows)
+    assert len(mrep.rows) == 2 * LAYERS + 1
+    assert mrep.dcn_bytes == 0 and mrep.dcn_ops == 0
+    b = 2  # the registry engine's max_batch, decode is one token wide
+    assert mrep.ici_bytes == 2 * LAYERS * b * HIDDEN * 4 + b * VOCAB * 4
+    assert mrep.predicted_s > 0
+    # the census satellite: the raw rows carry the parsed groups even
+    # though the hlocheck audit itself declared no topology
+    assert all(op.replica_groups == ((0, 1),) and op.group_count == 1
+               for op in report.collectives)
+    assert all(op.channel_id is not None for op in report.collectives)
+
+
+def test_tp2_2host_acceptance_gate(decode_2host):
+    """ISSUE 19's acceptance criteria, verbatim: the 2-host topology
+    entry classifies the tp axis as DCN, certifies under its derived
+    all-DCN budget, and a zero-DCN budget on it raises a
+    CollectiveBudgetError naming the axis, the medium, and the measured
+    bytes."""
+    report, mrep = decode_2host
+    assert all(r.axis == "tp" and r.medium == "dcn" for r in mrep.rows)
+    assert mrep.ici_bytes == 0 and mrep.dcn_ops == 2 * LAYERS + 1
+    measured = mrep.dcn_bytes
+    assert measured > 0
+    with pytest.raises(CollectiveBudgetError) as ei:
+        mrep.check(CollectiveBudget(all_reduce=2 * LAYERS + 1,
+                                    max_dcn_bytes=0))
+    msg = str(ei.value)
+    assert "'tp'" in msg          # the axis
+    assert "DCN" in msg           # the medium
+    assert str(measured) in msg   # the measured bytes
+    # DCN time is modeled slower than the same program's ICI placement
+    ici_s = mc.analyze(report.collectives, mc.single_host_topology(2),
+                       name="same").predicted_s
+    assert mrep.predicted_s > ici_s
+
+
+def test_registry_prefill_and_verify_entries_certify():
+    """The remaining tp2 1-host entries certify (prefill, chunk, verify
+    ride the same fence). Kept to ONE extra engine build: the chunk and
+    verify entries share decode's placement contract, so certifying the
+    prefill entry plus the already-fixtured decode pair covers every
+    program shape the engine compiles."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    paddle.seed(102)
+    _, mrep = mc.run_entry("tp2_engine_prefill_1host")
+    assert all(r.medium == "ici" for r in mrep.rows)
+    assert len(mrep.rows) == 2 * LAYERS + 1
+    bucket = 8  # the registry engine's one prefill pad bucket
+    assert mrep.ici_bytes == 2 * LAYERS * bucket * HIDDEN * 4 \
+        + bucket * VOCAB * 4
+
+
+def test_run_entry_unknown_name():
+    with pytest.raises(KeyError, match="unknown meshcheck entry"):
+        mc.run_entry("nope")
+
+
+# ------------------------------------------------------ serving integration
+def test_gauges_preseeded_at_zero():
+    """PT003/PT008 contract: the per-medium gauges are visible at zero
+    before any audit ever runs."""
+    from paddle_tpu.serving.metrics import ServingMetrics
+
+    snap = ServingMetrics().snapshot()
+    for k in ("serving_ici_bytes_per_token",
+              "serving_dcn_bytes_per_token",
+              "serving_collective_time_predicted_s"):
+        assert snap[k] == 0, k
+
+
+def test_engine_audit_hook_feeds_mesh_gauges():
+    """A TP=2 engine with a DECLARED single-host topology under
+    debug_checks: the first-trace audit attributes every program's
+    collectives, enforces the zero-DCN arm, and feeds the per-medium
+    gauges — bytes/token matches the budget formula, DCN stays zero."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    from paddle_tpu.serving import ServingConfig, ServingEngine
+    from paddle_tpu.text.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(23)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=VOCAB, hidden_size=HIDDEN, num_layers=LAYERS,
+        num_heads=4, max_seq_len=32, dropout=0.0))
+    model.eval()
+    eng = ServingEngine(model, ServingConfig(
+        max_batch=2, num_pages=16, page_size=4, max_prompt_len=8,
+        tensor_parallel=2, debug_checks=True,
+        mesh_topology=mc.single_host_topology(2)))
+    eng.add_request(np.arange(3, dtype=np.int32) + 5, 3)
+    eng.run()
+    snap = eng.metrics.snapshot()
+    # every program advances bytes/token at the same rate here (payloads
+    # scale with tokens), so the max over programs is the formula itself
+    assert snap["serving_ici_bytes_per_token"] == \
+        (2 * LAYERS * HIDDEN + VOCAB) * 4
+    assert snap["serving_dcn_bytes_per_token"] == 0
+    assert snap["serving_collective_time_predicted_s"] > 0
+
+
+# ----------------------------------------------------------- one-shot gate
+def test_check_all_gate_clean_run(capsys):
+    """The in-process tier-1 pin of the clean gate: all four engines run
+    (narrowed to their cheap entries), each reports clean, exit code 0."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the conftest 8-device CPU mesh")
+    rc = check_all.main(["--hlo-step", "cow_copy",
+                         "--kernel", "fused_adam",
+                         "--mesh-step", "tp8_toy_1host"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    for engine in check_all.ENGINES:
+        assert f"==== {engine} " in out
+        assert f"{engine:<12} clean" in out
+    assert "==== gate " in out
+
+
+def test_check_all_usage_paths():
+    assert check_all.main(["--skip", "lint", "--skip", "hlocheck",
+                           "--skip", "kernelcheck",
+                           "--skip", "meshcheck"]) == 2
+    with pytest.raises(SystemExit):
+        check_all.main(["--skip", "not_an_engine"])
+
+
+def test_check_all_folds_findings(tmp_path, monkeypatch, capsys):
+    """A finding in any one engine fails the whole gate with rc 1 while
+    the OTHER engines still run (the no-masking contract)."""
+    calls = []
+
+    def fake_main(name, rc):
+        def run(argv):
+            calls.append(name)
+            return rc
+        return run
+
+    monkeypatch.setattr(check_all, "_engine_main",
+                        lambda name: fake_main(name,
+                                               1 if name == "lint" else 0))
+    assert check_all.main([]) == 1
+    assert calls == list(check_all.ENGINES)
+    out = capsys.readouterr().out
+    assert f"{'lint':<12} FINDINGS" in out
+    assert f"{'meshcheck':<12} clean" in out
+
+
+@pytest.mark.slow
+def test_meshcheck_cli_respawns_onto_forced_mesh(tmp_path):
+    """From a 1-device parent the CLI respawns the entry onto a forced
+    CPU mesh via the hlocheck mechanism (recursion-guarded), and the
+    respawned child's certification carries the exit code."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "PADDLE_TPU_HLOCHECK_CHILD")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.analysis", "meshcheck",
+         "--step", "tp8_toy_1host"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "re-running on a forced 8-device CPU mesh" in proc.stdout
+    assert "meshcheck clean" in proc.stdout

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""CI one-shot static-analysis gate — every engine, one exit code.
+
+Usage:
+    python tools/check_all.py                 # lint + hlocheck +
+                                              # kernelcheck + meshcheck
+    python tools/check_all.py --skip kernelcheck
+    python tools/check_all.py --hlo-step cow_copy --mesh-step \
+        tp8_toy_1host --kernel fused_adam     # the cheap narrowed gate
+
+Exit codes: 0 clean, 1 findings, 2 bad usage. The same gate runs as
+``python -m paddle_tpu.analysis all``; every engine runs even when an
+earlier one fails, and the trailing summary names each verdict.
+
+The repo root is forced onto sys.path FIRST so the gate audits this
+checkout, never an installed copy.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis.check_all import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""CI meshcheck entry point — topology-aware collective placement.
+
+Usage:
+    python tools/meshcheck.py                  # certify every entry
+    python tools/meshcheck.py --step tp2_engine_decode_2host
+    python tools/meshcheck.py --list-steps
+    python tools/meshcheck.py --bank           # freeze placements ->
+                                               # profiles/meshcheck.json
+
+Exit codes: 0 clean, 1 violations/drift, 2 bad usage. The same engine
+runs as ``python -m paddle_tpu.analysis meshcheck``; entries needing
+more devices than the process has respawn onto a forced CPU mesh (the
+hlocheck mechanism).
+
+The repo root is forced onto sys.path FIRST so the registry audits this
+checkout, never an installed copy.
+"""
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis.meshcheck import main  # noqa: E402
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
