@@ -119,7 +119,8 @@ __all__ = ["Finding", "RULES", "ALLOWLIST", "lint_source", "lint_paths",
 # visible at the offending line.
 ALLOWLIST: dict[str, set[str]] = {
     "lint_fixtures": {f"PT00{i}" for i in range(1, 10)}
-    | {"PT010", "PT011", "PT012", "PT013", "PT014", "PT015", "PT016"},
+    | {"PT010", "PT011", "PT012", "PT013", "PT014", "PT015", "PT016",
+       "PT017"},
 }
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable=([A-Z0-9_,\s]+)")
@@ -837,6 +838,34 @@ def _pt016(tree, path):
                        "sequence number) instead.")
 
 
+def _pt017(tree, path):
+    """Contextless wire exchange: a ``.exchange(...)`` call in serving/
+    that omits the ``rid=`` or ``step=`` keyword. Those two keywords are
+    what ties an exchange to a request journey and an engine step — an
+    exchange without them produces a span/journey hop nothing can join
+    against (rid) or order (step), which is exactly the blind spot
+    fleetscope exists to close. Calls that deliberately carry no
+    request (gossip) must say so with an explicit ``rid=None``; a
+    ``**kwargs`` splat is assumed to forward the context."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "exchange"):
+            continue
+        kws = {kw.arg for kw in node.keywords}
+        if None in kws:  # **splat forwards the caller's context
+            continue
+        missing = [k for k in ("rid", "step") if k not in kws]
+        if missing:
+            yield (node.lineno,
+                   f".exchange(...) without {'/'.join(missing)}= — the "
+                   f"exchange is invisible to fleetscope: no rid to "
+                   f"join the span to a journey, no step to order it "
+                   f"on the fleet timeline. Pass rid= (rid=None if the "
+                   f"exchange genuinely carries no request, e.g. "
+                   f"gossip) and step=.")
+
+
 @dataclass(frozen=True)
 class Rule:
     code: str
@@ -887,6 +916,10 @@ RULES: dict[str, Rule] = {r.code: r for r in (
          "with PT004 (time.time) this closes every nondeterminism "
          "source deterministic replay depends on", _pt016,
          scope="serving"),
+    Rule("PT017", "wire .exchange(...) in serving/ without rid=/step= "
+         "keywords — the exchange's span/journey hop cannot be joined "
+         "to a request or ordered on the fleet timeline (rid=None is "
+         "the explicit no-request spelling)", _pt017, scope="serving"),
 )}
 
 
@@ -952,7 +985,7 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
         description="Repo linter: invariants this repo shipped bugs "
-                    "against, enforced (rules PT001-PT016).")
+                    "against, enforced (rules PT001-PT017).")
     parser.add_argument("paths", nargs="*",
                         help="files or directories (default: the installed "
                              "paddle_tpu package plus the repo's --include "

@@ -53,10 +53,20 @@ doing right before it died.
 - :mod:`~paddle_tpu.obs.export` — Chrome ``trace_event`` JSON (one track
   per request + the engine loop + counter tracks + alert instants; loads
   in Perfetto) and Prometheus text exposition with labeled families.
+- :mod:`~paddle_tpu.obs.fleetscope` — cluster-grain observability:
+  cross-replica exchange spans (:class:`FleetScope`, deterministic
+  :func:`span_id`, Chrome flow events via :func:`flow_events`),
+  fleet-wide scrape merging (:class:`FleetMetrics`, ``replica=``
+  labels), and the schema-versioned ``paddle-tpu/fleet-record/v1``
+  cluster flight recorder (:func:`validate_fleet_record`) bundling
+  per-replica flight records + router state + the exchange-span ring.
 
 ``python -m paddle_tpu.obs --flight-record DUMP`` pretty-prints a flight
 record (``--prometheus`` / ``--latency-table`` render its gauge and
-latency sections); exit 0 clean, 1 alerts/fatal recorded, 2 bad usage.
+latency sections); ``--fleet-record DUMP`` pretty-prints a cluster
+record (``--span RID`` renders one request's exchange span trees,
+``--prometheus`` the merged ``replica=``-labeled exposition); exit 0
+clean, 1 alerts/fatal recorded, 2 bad usage.
 
 Imports nothing from ``paddle_tpu.serving`` — serving imports us. Tracing
 is on by default in the engine (``ServingConfig(enable_tracing=)``); the
@@ -71,6 +81,11 @@ from .attribution import (DEFAULT_PEAK_FLOPS_PER_S,  # noqa: F401
                           load_banked_kernel_speedups)
 from .export import (chrome_trace, latency_table,  # noqa: F401
                      prometheus_text, write_chrome_trace)
+from .fleetscope import (FLEET_RECORD_SCHEMA,  # noqa: F401
+                         FleetMetrics, FleetScope, build_fleet_record,
+                         dump_fleet_record, flow_events,
+                         format_fleet_record, format_span_tree,
+                         span_id, span_key, validate_fleet_record)
 from .histogram import (LATENCY_EDGES_S, OCCUPANCY_EDGES,  # noqa: F401
                         QUANTILES, Histogram, HistogramFamily,
                         split_labels)
@@ -102,4 +117,8 @@ __all__ = ["Histogram", "HistogramFamily", "LATENCY_EDGES_S",
            "build_flight_record", "dump_flight_record",
            "format_flight_record", "validate_flight_record",
            "chrome_trace", "write_chrome_trace", "prometheus_text",
-           "latency_table"]
+           "latency_table",
+           "FLEET_RECORD_SCHEMA", "FleetScope", "FleetMetrics",
+           "span_id", "span_key", "flow_events", "build_fleet_record",
+           "dump_fleet_record", "validate_fleet_record",
+           "format_fleet_record", "format_span_tree"]

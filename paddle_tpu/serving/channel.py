@@ -237,7 +237,9 @@ class CircuitBreaker:
 @dataclass
 class ExchangeInfo:
     """What one ``exchange()`` went through — the router reads this to
-    stamp journey hops (wire_retry / breaker_open) after dispatch."""
+    stamp journey hops (wire_retry / breaker_open) after dispatch and
+    to feed the per-peer transport families (``serving_wire_rtt_s`` /
+    ``serving_wire_attempts`` / ``serving_wire_bytes_total``)."""
 
     ok: bool = False
     retries: int = 0
@@ -246,6 +248,14 @@ class ExchangeInfo:
     hedge_win: bool = False
     breaker_open: bool = False
     latency_s: float = 0.0
+    peer: int = -1
+    span: int | None = None       # fleetscope span id riding the frames
+    attempts: int = 0             # copies actually sent (retries + 1)
+    backoff_s: float = 0.0        # total backoff waited on the timeline
+    tx_bytes: int = 0
+    rx_bytes: int = 0
+    t_start: float = 0.0          # transport-timeline bounds of the
+    t_end: float = 0.0            # whole exchange (rtt = end - start)
 
 
 @dataclass
@@ -273,6 +283,7 @@ class Transport:
         self.t = 0.0  # the transport timeline (see module docstring)
         self.metrics = None
         self.injector = None
+        self.scope = None  # FleetScope (obs.fleetscope) or None
         self.breakers: dict[int, CircuitBreaker] = {}
         #: (t, peer, state) per breaker transition — Chrome instants
         self.breaker_events: list[tuple[float, int, str]] = []
@@ -285,11 +296,14 @@ class Transport:
         self.hedge_wins_total = 0
         self.exchanges_total = 0
 
-    def attach(self, metrics=None, injector=None) -> "Transport":
+    def attach(self, metrics=None, injector=None,
+               scope=None) -> "Transport":
         """Bind the router's ServingMetrics + FaultInjector (the wire_*
-        / peer_timeout points are consulted on the latter)."""
+        / peer_timeout points are consulted on the latter) and,
+        optionally, a fleetscope span recorder."""
         self.metrics = metrics
         self.injector = injector
+        self.scope = scope
         return self
 
     # ------------------------------------------------------------ breaker
@@ -310,8 +324,18 @@ class Transport:
 
     def _transition(self, peer: int, state: str) -> None:
         self.breaker_events.append((self.t, peer, state))
-        if state == "open" and self.metrics is not None:
-            self.metrics.on_breaker_open(peer)
+        m = self.metrics
+        if m is not None:
+            # EVERY transition reaches the serving_breaker_state gauge
+            # (closed/half_open/open as 0/1/2) — metering only the open
+            # edge made the gauge skip the half_open -> closed recovery
+            m.on_breaker_state(peer, state)
+            if state == "open":
+                m.on_breaker_open(peer)
+        sc = self.scope
+        if sc is not None and self.last.span is not None:
+            sc.child(self.last.span, "breaker", self.t, self.t,
+                     state=state, peer=peer)
 
     # ------------------------------------------------------------ attempt
     def backoff_for(self, peer: int, attempt: int) -> float:
@@ -369,15 +393,44 @@ class Transport:
 
     # ----------------------------------------------------------- exchange
     def exchange(self, peer: int, frames, *, step: int = 0, rid=None,
-                 hedge: bool | None = None):
+                 hedge: bool | None = None, span=None):
         """Deliver ``frames`` to ``peer`` and decode what comes back:
         a list of ``(kind, value)`` in arrival order on success, None
         when the breaker is open or the retry budget runs out.
-        ``self.last`` carries the attempt accounting either way."""
-        c = self.config
-        frames = list(frames)
-        info = self.last = ExchangeInfo()
+        ``self.last`` carries the attempt accounting either way.
+        ``span`` is the fleetscope span id the frames were encoded
+        under (None when fleetscope is off) — retry attempts, backoff
+        waits, and breaker transitions become its child spans."""
+        info = self.last = ExchangeInfo(peer=peer, span=span)
+        info.t_start = self.t
         self.exchanges_total += 1
+        m = self.metrics
+        if m is not None:
+            m.on_fleet_inflight(1)
+        tx0, rx0 = self.tx_bytes, self.rx_bytes
+        try:
+            return self._exchange_body(peer, list(frames), step, rid,
+                                       hedge, info)
+        finally:
+            info.t_end = self.t
+            info.tx_bytes = self.tx_bytes - tx0
+            info.rx_bytes = self.rx_bytes - rx0
+            sc = self.scope
+            if sc is not None and span is not None:
+                sc.end(span, t=self.t, ok=info.ok,
+                       retries=info.retries)
+            if m is not None:
+                m.on_fleet_inflight(-1)
+
+    def _exchange_body(self, peer: int, frames: list, step: int, rid,
+                       hedge, info: ExchangeInfo):
+        c = self.config
+        sc = self.scope
+
+        def _attempt_span(t0: float, ok: bool, **kw) -> None:
+            if sc is not None and info.span is not None:
+                sc.child(info.span, "attempt", t0, self.t, ok=ok, **kw)
+
         if not frames:
             info.ok = True
             return []
@@ -390,17 +443,26 @@ class Transport:
         use_hedge = c.hedge if hedge is None else hedge
         for attempt in range(c.retries + 1):
             if attempt:
-                self.t += self.backoff_for(peer, attempt)
+                wait = self.backoff_for(peer, attempt)
+                t0 = self.t
+                self.t += wait
                 info.retries += 1
+                info.backoff_s += wait
                 self.retries_total += 1
                 if self.metrics is not None:
                     self.metrics.on_wire_retry()
+                if sc is not None and info.span is not None:
+                    sc.child(info.span, "backoff", t0, self.t,
+                             attempt=attempt)
+            info.attempts += 1
+            a0 = self.t
             drop, corrupt, extra_delay, forced_timeout = \
                 self._consult_faults(peer, rid, step)
             if forced_timeout:
                 self.t += c.timeout_s
                 info.timeouts += 1
                 self.timeouts_total += 1
+                _attempt_span(a0, False, timeout=True)
                 continue
             sent = frames
             if drop:
@@ -431,15 +493,19 @@ class Transport:
                 self.rx_bytes += best.rx_bytes
                 if self.metrics is not None:
                     self.metrics.on_wire_rx(best.rx_bytes)
+                _attempt_span(a0, True)
                 if br.on_success():
                     self._transition(peer, "closed")
                 info.ok = True
                 return best.values
             worst = max(t.latency_s for t in tries)
             self.t += worst
-            if any(t.timeout for t in tries):
+            timed_out = any(t.timeout for t in tries)
+            if timed_out:
                 info.timeouts += 1
                 self.timeouts_total += 1
+            _attempt_span(a0, False, timeout=timed_out,
+                          corrupt=sum(t.corrupt for t in tries))
         if br.on_failure(self.t):
             self._transition(peer, "open")
         return None

@@ -84,6 +84,9 @@ class ChaosConfig:
     dup_rate: float = 0.08
     reorder_rate: float = 0.15
     engine: ServingConfig | None = None  # None -> the tiny CI shape
+    # armed -> a ChaosInvariantError auto-dumps the cluster flight
+    # recorder (fleet-record/v1) here before the error propagates
+    fleet_record_path: str | None = None
 
     def validate(self) -> None:
         if self.num_replicas < 2:
@@ -181,9 +184,24 @@ def _sweep(fl: FleetRouter, cfg: ChaosConfig, step: int) -> None:
 
 def soak(model, config: ChaosConfig | None = None) -> dict:
     """Run one fully-armed chaos soak; returns the report dict (see
-    :func:`format_report`) or raises :class:`ChaosInvariantError`."""
+    :func:`format_report`) or raises :class:`ChaosInvariantError`.
+    When ``cfg.fleet_record_path`` is set, an invariant failure dumps
+    the cluster flight recorder there before the error propagates —
+    the post-mortem ships with the stack trace."""
     cfg = config or ChaosConfig()
     cfg.validate()
+    state: dict = {}
+    try:
+        return _soak_run(model, cfg, state)
+    except ChaosInvariantError:
+        fl = state.get("fleet")
+        if fl is not None and cfg.fleet_record_path is not None:
+            fl.dump_fleet_record(cfg.fleet_record_path,
+                                 reason="chaos_invariant")
+        raise
+
+
+def _soak_run(model, cfg: ChaosConfig, state: dict) -> dict:
     router_inj, replica_injs = build_schedule(cfg)
     channel = SimChannel(ChannelConfig(
         seed=cfg.seed, drop_rate=cfg.drop_rate,
@@ -199,6 +217,7 @@ def soak(model, config: ChaosConfig | None = None) -> dict:
     fl = FleetRouter(model, fleet_cfg, clock=_VirtualClock(),
                      fault_injector=router_inj,
                      replica_injectors=replica_injs)
+    state["fleet"] = fl  # soak()'s auto-dump handler reaches it here
     rng = np.random.RandomState(cfg.seed)
     # arrivals trickle across the fault horizon so the fleet still
     # carries traffic when the late-armed points fire — a burst that

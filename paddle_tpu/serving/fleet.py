@@ -87,10 +87,12 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..obs import JourneyBook, TenantLedger, check_tenant_name
+from ..obs import fleetscope as _fleetscope
 from ..utils import monitor
 from .engine import ServingConfig, ServingEngine
 from .faults import InjectedFault
 from .kv_cache import prefix_digest
+from .metrics import COUNTER_STATS
 from .metrics import PREFIX as _METRIC_PREFIX
 from .metrics import TENANT_CLASSES
 from .wire import (encode_digests, encode_page, encode_rehome,
@@ -131,6 +133,11 @@ class FleetConfig:
     # (restores then hit locally); off by default — a fetch turns cold
     # dispatches into host-tier restores, which changes the host-sync
     # profile the lossless parity pin holds fixed
+    fleetscope: bool = True  # record cross-replica exchange spans (and
+    # carry their ids in the wire frames); off -> scope is None, one
+    # attribute check per site, frames byte-identical to plain v1
+    fleet_record_path: str | None = None  # when set, fleet records
+    # auto-dumped on replica_down land here (chaos arms this too)
 
     def validate(self) -> None:
         if self.num_replicas < 1:
@@ -223,9 +230,19 @@ class FleetRouter:
         self.weight_changes: list[tuple[int, str, float]] = []
         self._weights: dict[str, float] = {}
         self.transport = cfg.transport
+        #: the fleetscope span recorder (None when cfg.fleetscope is
+        #: off — every consult is one attribute check, the tracer-None
+        #: idiom) and the most recent fleet record assembled by an
+        #: auto-dump
+        self.scope = _fleetscope.FleetScope(
+            capacity=cfg.engine.trace_capacity) if cfg.fleetscope \
+            else None
+        self.last_fleet_record: dict | None = None
+        self._gossip_step = [0] * cfg.num_replicas
         if self.transport is not None:
             self.transport.attach(metrics=self.metrics,
-                                  injector=fault_injector)
+                                  injector=fault_injector,
+                                  scope=self.scope)
         # wire families are pre-seeded whether or not a transport is
         # attached — the presence contract (PT003/PT012) is about
         # dashboards, and a dashboard doesn't know the fleet's config
@@ -233,6 +250,9 @@ class FleetRouter:
                                  list(WIRE_ERROR_KINDS))
         self.metrics.seed_family("breaker_open_total",
                                  [str(i) for i in range(cfg.num_replicas)])
+        self.metrics.seed_family("wire_bytes_total",
+                                 ["page", "digests", "rehome"])
+        self.metrics.seed_wire_peers(range(cfg.num_replicas))
         self.metrics.on_fleet_replicas(cfg.num_replicas)
         for t in ["default"] + sorted(
                 n for n in (cfg.engine.tenants or {}) if n != "default"):
@@ -241,6 +261,27 @@ class FleetRouter:
     # ----------------------------------------------------------- plumbing
     def now(self) -> float:
         return self.replicas[0].now()
+
+    def _open_span(self, *, kind: str, src, dst=None, rid=None):
+        """Begin one fleetscope exchange span (None when the scope is
+        detached) — opened on the TRANSPORT timeline, where the child
+        spans will land."""
+        sc = self.scope
+        if sc is None:
+            return None
+        return sc.open(kind=kind, src=src, dst=dst, rid=rid,
+                       step=self._step_idx, t=self.transport.t)
+
+    def _meter_exchange(self, kind: str) -> None:
+        """Feed the per-peer transport families from the ExchangeInfo
+        the exchange just left in ``transport.last`` — rtt (whole
+        exchange, backoffs included), copies sent, and tx bytes by
+        frame type."""
+        info = self.transport.last
+        self.metrics.on_wire_exchange(
+            info.peer, rtt_s=info.t_end - info.t_start,
+            attempts=info.attempts)
+        self.metrics.on_wire_frame_bytes(kind, info.tx_bytes)
 
     def _live(self) -> list[int]:
         return [i for i in range(len(self.replicas))
@@ -339,8 +380,11 @@ class FleetRouter:
         digests = self.replicas[i].cache.gossip_digests()
         if self.transport is None:
             return digests
-        got = self.transport.exchange(i, [encode_digests(digests)],
-                                      step=self._step_idx)
+        sid = self._open_span(kind="digests", src=i, rid=None)
+        got = self.transport.exchange(
+            i, [encode_digests(digests, span=sid)],
+            step=self._step_idx, rid=None, span=sid)
+        self._meter_exchange("digests")
         if got is None:
             return self._gossip[i]
         return got[0][1]
@@ -368,9 +412,12 @@ class FleetRouter:
             p.prompt, max_pages=src.cfg.pages_per_seq)
         if not entries:
             return (None, True, None)  # stale gossip: nothing to move
+        sid = self._open_span(kind="page", src=donor, dst=dest,
+                              rid=p.rid)
         got = self.transport.exchange(
-            donor, [encode_page(e) for e in entries],
-            step=self._step_idx, rid=p.rid)
+            donor, [encode_page(e, span=sid) for e in entries],
+            step=self._step_idx, rid=p.rid, span=sid)
+        self._meter_exchange("page")
         info = self.transport.last
         if got is None:
             return (donor, False, info)
@@ -450,13 +497,18 @@ class FleetRouter:
                      replica=i, affinity_tokens=affinity_tokens)
             if fetch_info is not None:
                 # the journey is born at the enqueue above, so the
-                # fetch's transport hops are stamped here, just after
+                # fetch's transport hops are stamped here, just after.
+                # The span ref is a v1-compatible hop extension (hops
+                # are open dicts): absent when fleetscope is off
+                sp = {} if fetch_info.span is None else {
+                    "span": _fleetscope.span_key(fetch_info.span)}
                 for k in range(fetch_info.retries):
-                    tr.event(rid, "wire_retry", peer=donor, attempt=k + 1)
+                    tr.event(rid, "wire_retry", peer=donor,
+                             attempt=k + 1, **sp)
                 if fetch_info.breaker_open:
-                    tr.event(rid, "breaker_open", peer=donor)
+                    tr.event(rid, "breaker_open", peer=donor, **sp)
             if not fetch_ok:
-                tr.event(rid, "refetch_fallback", peer=donor)
+                tr.event(rid, "refetch_fallback", peer=donor, **sp)
         if not fetch_ok:
             self.metrics.on_wire_refetch_fallback()
         self.routes[rid] = (i, kind, affinity_tokens)
@@ -542,18 +594,25 @@ class FleetRouter:
                     # exchange dies the LOCAL copy re-homes instead (a
                     # lost frame can never lose a request — the frame
                     # is the transport, not the custody)
+                    sid = self._open_span(kind="rehome", src=i,
+                                          rid=req.rid)
                     got = self.transport.exchange(
                         i, [encode_rehome(req.rid, req.prompt,
                                           req.max_new_tokens,
-                                          req.deadline, req.tenant)],
-                        step=self._step_idx, rid=req.rid)
+                                          req.deadline, req.tenant,
+                                          span=sid)],
+                        step=self._step_idx, rid=req.rid, span=sid)
+                    self._meter_exchange("rehome")
                     info = self.transport.last
                     if tr is not None:
+                        sp = {} if info.span is None else {
+                            "span": _fleetscope.span_key(info.span)}
                         for k in range(info.retries):
                             tr.event(req.rid, "wire_retry", peer=i,
-                                     attempt=k + 1)
+                                     attempt=k + 1, **sp)
                         if info.breaker_open:
-                            tr.event(req.rid, "breaker_open", peer=i)
+                            tr.event(req.rid, "breaker_open", peer=i,
+                                     **sp)
                     if got is not None:
                         rh = got[0][1]
                         pend = _Pending(
@@ -570,6 +629,9 @@ class FleetRouter:
             eng._retire(req, FAILED, fault)
             eng.metrics.on_failed()
         self.metrics.on_fleet_replicas(len(self._live()))
+        # a replica death is exactly what the cluster flight recorder
+        # exists for — capture the fleet's state at the boundary
+        self._fleet_auto(f"replica_down: replica {i}")
 
     # ------------------------------------------------------------ stepping
     def step(self) -> list[int]:
@@ -589,6 +651,7 @@ class FleetRouter:
         if (self._step_idx - 1) % self.config.gossip_every == 0:
             for i in self._live():
                 self._gossip[i] = self._refresh_gossip(i)
+                self._gossip_step[i] = self._step_idx
         now = self.now()
         expired = [p for p in self._pending
                    if p.deadline is not None and now >= p.deadline]
@@ -611,6 +674,13 @@ class FleetRouter:
             for a in fresh:
                 if a.rule == "slo_burn":
                     self._actuate_weight(a.data.get("tenant", "default"))
+        # fleet goodput roll-up: the sum of every tenant's in-SLO
+        # tokens, mirrored once per step (the host_tier mirror idiom)
+        self.metrics.on_fleet_goodput(sum(
+            int(monitor.stat_get(
+                _METRIC_PREFIX
+                + f"tenant_goodput_tokens_total{{tenant={t}}}", 0))
+            for t in self._weights))
         return finished
 
     def _actuate_weight(self, tenant: str) -> None:
@@ -718,6 +788,77 @@ class FleetRouter:
                 for cls in TENANT_CLASSES}
         return out
 
+    def fleet_metrics(self) -> "_fleetscope.FleetMetrics":
+        """The merged fleet scrape: one registry snapshot per replica,
+        each sample gaining a ``replica=`` label. In-process replicas
+        share ONE registry, so every replica reports the same snapshot
+        — this is the schema (and the exact exposition pipeline) the
+        multi-host fleet will fill with genuinely distinct ones."""
+        snap = self.metrics.snapshot()
+        return _fleetscope.FleetMetrics(
+            {i: snap for i in range(len(self.replicas))},
+            types={k: "counter" for k in COUNTER_STATS})
+
+    def spans(self, rid) -> list | None:
+        """Every recorded exchange span for one request id — None when
+        fleetscope is off (the obs-off contract: surfaces go quiet,
+        they never raise)."""
+        sc = self.scope
+        if sc is None:
+            return None
+        return sc.spans_for(rid)
+
+    # ------------------------------------------------- cluster recorder
+    def fleet_record(self, reason: str = "manual") -> dict:
+        """Assemble a ``paddle-tpu/fleet-record/v1``: every replica's
+        flight record (v2 schema each), router state, the exchange-span
+        ring, and the merged replica-attributed alert history."""
+        n = len(self.replicas)
+        tr = self.transport
+        router = {
+            "step": self._step_idx,
+            "weights": {t: float(w)
+                        for t, w in sorted(self._weights.items())},
+            "gossip_ages": [self._step_idx - self._gossip_step[i]
+                            for i in range(n)],
+            "pending": [p.rid for p in self._pending],
+            "live": self._live(),
+            "down": sorted(self._down),
+            "routes": {str(rid): list(v) for rid, v in
+                       list(self.routes.items())[-64:]},
+            "weight_changes": [list(w) for w in self.weight_changes],
+            "breakers": ({str(p): br.state
+                          for p, br in sorted(tr.breakers.items())}
+                         if tr is not None else {}),
+        }
+        return _fleetscope.build_fleet_record(
+            reason=reason, now=self.now(), step=self._step_idx,
+            replicas=[eng.flight_record(reason=f"fleet: {reason}")
+                      for eng in self.replicas],
+            router=router,
+            exchanges=(self.scope.records()
+                       if self.scope is not None else []),
+            alerts=[dict(a.asdict(), replica=i)
+                    for i, eng in enumerate(self.replicas)
+                    for a in eng.alerts()])
+
+    def dump_fleet_record(self, path, reason: str = "manual") -> dict:
+        """Assemble, validate, and write one fleet record; returns the
+        record (also kept as ``last_fleet_record``)."""
+        rec = self.fleet_record(reason)
+        self.last_fleet_record = rec
+        return _fleetscope.dump_fleet_record(path, rec)
+
+    def _fleet_auto(self, reason: str) -> None:
+        """Auto-capture on replica_down: the record is always kept in
+        memory; ``config.fleet_record_path`` additionally lands it on
+        disk."""
+        path = self.config.fleet_record_path
+        if path:
+            self.dump_fleet_record(path, reason)
+        else:
+            self.last_fleet_record = self.fleet_record(reason)
+
     def export_chrome_trace(self, path=None) -> dict:
         """The merged fleet Chrome trace: one process per replica
         (pid = index + 1, named ``paddle_tpu.serving/replica<i>``), each
@@ -750,6 +891,13 @@ class FleetRouter:
                                "ts": t * 1e6, "pid": pid, "tid": 0,
                                "s": "g", "cat": "transport",
                                "args": {"peer": peer, "state": state}})
+        if self.scope is not None and self.scope.records():
+            # fleetscope exchange spans: X slices + flow arrows (ph
+            # "s"/"f") from the sender's wire lane to the receiver's,
+            # on the transport timeline like the breaker instants
+            events.extend(_fleetscope.flow_events(
+                self.scope.records(),
+                transport_pid=len(self.replicas) + 1))
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
         if path is not None:
             with open(path, "w") as f:

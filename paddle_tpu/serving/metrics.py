@@ -292,6 +292,7 @@ _SEEDED = ("tokens_total", "prefills_total", "prefill_tokens_total",
            "page_pool_used", "page_utilization", "mfu", "hbm_bw_util",
            "fleet_replicas", "fleet_prefix_affinity_hits_total",
            "fleet_spills_total",
+           "fleet_goodput_tokens_total", "fleet_inflight_exchanges",
            "wire_tx_bytes_total", "wire_rx_bytes_total",
            "wire_retries_total", "wire_hedge_wins_total",
            "wire_refetch_fallback_total",
@@ -323,6 +324,14 @@ _FAMILIES = {
     # WireError taxonomy kind (truncated / corrupt / bad_version)
     "breaker_open_total": "peer",         # counter: circuit-breaker
     # open transitions per peer replica index
+    "breaker_state": "peer",              # gauge: current breaker state
+    # per peer (closed/half_open/open as 0/1/2 — every transition
+    # metered, the gauge can never skip a state)
+    "wire_bytes_total": "type",           # counter: exchange tx bytes
+    # by frame type (page / digests / rehome), fed from ExchangeInfo
+    "wire_rtt_s": "peer",                 # histogram family (below):
+    "wire_attempts": "peer",              # per-peer exchange round-trip
+    # time and copies-sent count, fed from ExchangeInfo post-exchange
     "ttft_s": "tenant",                   # histogram family (per-tenant
     "tpot_s": "tenant",                   # latency classes; the plain
     "queue_delay_s": "tenant",            # serving_ttft_s etc. hist
@@ -358,7 +367,12 @@ COUNTER_STATS = frozenset(
         PREFIX + "tenant_badput_tokens_total",
         PREFIX + "tenant_retired_total",
         PREFIX + "wire_corrupt_total",
-        PREFIX + "breaker_open_total"})
+        PREFIX + "breaker_open_total",
+        PREFIX + "wire_bytes_total"})
+
+#: serving_breaker_state{peer=} gauge values — the breaker state
+#: machine's three states in escalation order
+BREAKER_STATE_VALUES = {"closed": 0, "half_open": 1, "open": 2}
 
 
 class ServingMetrics:
@@ -386,6 +400,15 @@ class ServingMetrics:
             "queue_delay_s": HistogramFamily(PREFIX + "queue_delay_s",
                                              "tenant", LATENCY_EDGES_S),
         }
+        # per-peer transport families, fed from ExchangeInfo after every
+        # exchange — children created by seed_wire_peers at router
+        # construction (or on first sight of a peer)
+        self.wire_hists = {
+            "wire_rtt_s": HistogramFamily(PREFIX + "wire_rtt_s",
+                                          "peer", LATENCY_EDGES_S),
+            "wire_attempts": HistogramFamily(PREFIX + "wire_attempts",
+                                             "peer", OCCUPANCY_EDGES),
+        }
         # scalar family members seeded so far: base -> ordered values
         # (str, or a tuple matching a multi-label declaration;
         # seed_family records them so reset() can replay the zeros)
@@ -393,7 +416,8 @@ class ServingMetrics:
         self.reset()
 
     def _hist_families(self):
-        return (self.phase_hist, *self.tenant_hists.values())
+        return (self.phase_hist, *self.tenant_hists.values(),
+                *self.wire_hists.values())
 
     @staticmethod
     def _family_key(base: str, value) -> str:
@@ -465,6 +489,17 @@ class ServingMetrics:
         for fam in self.tenant_hists.values():
             for t in tenants:
                 fam.child(t)
+
+    def seed_wire_peers(self, peers) -> None:
+        """Pre-seed every per-peer transport surface for the given
+        replica indices: the ``breaker_state`` gauge family (at 0 =
+        closed) and the ``wire_rtt_s`` / ``wire_attempts`` histogram
+        children — called at router construction."""
+        peers = [str(p) for p in peers]
+        self.seed_family("breaker_state", peers)
+        for fam in self.wire_hists.values():
+            for p in peers:
+                fam.child(p)
 
     # ------------------------------------------------------------- updates
     def on_prefill(self, tokens: int = 0) -> None:
@@ -759,6 +794,43 @@ class ServingMetrics:
         monitor.stat_add(
             PREFIX + f"breaker_open_total{{peer={peer}}}", 1)
 
+    def on_breaker_state(self, peer, state: str) -> None:
+        """The breaker's CURRENT state for ``peer`` as a gauge
+        (closed/half_open/open as 0/1/2) — fed on every transition, so
+        a scrape between transitions always shows the true state and
+        the gauge can never skip half_open on the way back to
+        closed."""
+        monitor.stat_set(
+            PREFIX + f"breaker_state{{peer={peer}}}",
+            BREAKER_STATE_VALUES[state])
+
+    def on_wire_exchange(self, peer, *, rtt_s: float,
+                         attempts: int) -> None:
+        """One finished exchange (success or failure), fed from
+        ``Transport.last``: whole-exchange round-trip time (backoffs
+        included) and copies sent, both split per peer."""
+        peer = str(peer)
+        self.wire_hists["wire_rtt_s"].observe(peer, float(rtt_s))
+        self.wire_hists["wire_attempts"].observe(peer, int(attempts))
+
+    def on_wire_frame_bytes(self, kind: str, nbytes: int) -> None:
+        """Exchange tx bytes attributed to their frame type (family
+        pre-seeded at router construction for the three kinds)."""
+        monitor.stat_add(
+            PREFIX + f"wire_bytes_total{{type={kind}}}", int(nbytes))
+
+    def on_fleet_inflight(self, delta: int) -> None:
+        """Exchanges currently on the wire — +1 at exchange entry, -1
+        on return (a scrape mid-exchange shows 1)."""
+        monitor.stat_add(PREFIX + "fleet_inflight_exchanges", int(delta))
+
+    def on_fleet_goodput(self, tokens: int) -> None:
+        """Fleet-wide goodput roll-up: the sum of every tenant's in-SLO
+        tokens, mirrored as one counter (stat_set of a monotonic sum —
+        the host_tier mirror idiom)."""
+        monitor.stat_set(PREFIX + "fleet_goodput_tokens_total",
+                         int(tokens))
+
     def observe_tenant(self, tenant: str, ttft, tpot,
                        queue_delay) -> None:
         """Feed the per-tenant latency histogram families at one
@@ -832,4 +904,6 @@ class ServingMetrics:
             if name not in self.hists:  # queue_delay_s: family-only base
                 hists.extend(fam.children().values())
         hists.extend(self.phase_hist.children().values())
+        for fam in self.wire_hists.values():
+            hists.extend(fam.children().values())
         return prometheus_text(self.snapshot(), hists, types)

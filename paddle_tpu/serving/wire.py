@@ -42,6 +42,14 @@ Payload schemas:
 - **rehome**: rid (u64) + max_new_tokens (u32) + deadline flag/f64 +
   tenant (u16 length + utf-8) + prompt length (u32) + tokens (i64
   each) — the record a dead replica's clean waiter travels in.
+
+Span extension (v1-compatible): every payload may end with an optional
+tail of ``flag`` (u8, 1) + ``span`` (u64) — the fleetscope span id the
+exchange travels under. Encoders emit it only when ``span=`` is
+passed, so a frame without a span is byte-identical to the pre-
+extension encoding (the codec goldens hold for readers without the
+field). ``decode_frame`` ignores the tail; ``decode_frame_span``
+returns it as the third element (None when absent).
 """
 from __future__ import annotations
 
@@ -57,7 +65,7 @@ __all__ = ["WIRE_SCHEMA", "WIRE_ERROR_KINDS", "WireError",
            "WireTruncatedError",
            "WireCorruptError", "WireVersionError", "RehomeRecord",
            "encode_page", "encode_digests", "encode_rehome",
-           "decode_frame"]
+           "decode_frame", "decode_frame_span"]
 
 WIRE_SCHEMA = "paddle-tpu/wire/v1"
 
@@ -158,6 +166,27 @@ _I64 = struct.Struct("<q")
 _F64 = struct.Struct("<d")
 
 
+def _span_tail(span) -> bytes:
+    """The optional span extension: empty (byte-identical v1 frame)
+    when no span rides the exchange."""
+    if span is None:
+        return b""
+    return _U8.pack(1) + _U64.pack(int(span) & 0xFFFFFFFFFFFFFFFF)
+
+
+def _read_span_tail(r: _Reader):
+    """Consume the optional span tail, then enforce payload-exhausted.
+    Returns the span id or None."""
+    if r.at == len(r.buf):
+        return None
+    (flag,) = r.unpack(_U8)
+    if flag != 1:
+        raise WireCorruptError(f"unknown payload extension flag {flag}")
+    (span,) = r.unpack(_U64)
+    r.done()
+    return int(span)
+
+
 def _pack_tokens(tokens) -> bytes:
     return b"".join(_I64.pack(int(t)) for t in tokens)
 
@@ -167,7 +196,7 @@ def _read_tokens(r: _Reader, n: int) -> tuple:
 
 
 # ---------------------------------------------------------------- pages
-def encode_page(page: SpilledPage) -> bytes:
+def encode_page(page: SpilledPage, *, span=None) -> bytes:
     """One :class:`SpilledPage` as a wire frame — key, serial, dtype,
     shape, and the raw KV bytes (plus scale planes when quantized)."""
     parent, block = page.key
@@ -188,6 +217,7 @@ def encode_page(page: SpilledPage) -> bytes:
         out += [_U8.pack(1), ks.tobytes(), vs.tobytes()]
     else:
         out.append(_U8.pack(0))
+    out.append(_span_tail(span))
     return _frame(FRAME_PAGE, b"".join(out))
 
 
@@ -214,30 +244,31 @@ def _decode_page(r: _Reader) -> SpilledPage:
         sn = int(np.prod(sshape)) * 4
         ks = np.frombuffer(r.take(sn), np.float32).reshape(sshape).copy()
         vs = np.frombuffer(r.take(sn), np.float32).reshape(sshape).copy()
-    r.done()
+    span = _read_span_tail(r)
     return SpilledPage(key=(int(parent), block), serial=int(serial),
-                       k=k, v=v, k_scale=ks, v_scale=vs)
+                       k=k, v=v, k_scale=ks, v_scale=vs), span
 
 
 # -------------------------------------------------------------- digests
-def encode_digests(digests) -> bytes:
+def encode_digests(digests, *, span=None) -> bytes:
     """A gossip digest set as a wire frame (sorted — one set, one
     encoding)."""
     ds = sorted(int(d) for d in digests)
     return _frame(FRAME_DIGESTS,
-                  _U32.pack(len(ds)) + b"".join(_U64.pack(d) for d in ds))
+                  _U32.pack(len(ds)) + b"".join(_U64.pack(d) for d in ds)
+                  + _span_tail(span))
 
 
-def _decode_digests(r: _Reader) -> frozenset:
+def _decode_digests(r: _Reader):
     (n,) = r.unpack(_U32)
     out = frozenset(r.unpack(_U64)[0] for _ in range(n))
-    r.done()
-    return out
+    return out, _read_span_tail(r)
 
 
 # --------------------------------------------------------------- rehome
 def encode_rehome(rid: int, prompt, max_new_tokens: int,
-                  deadline: float | None, tenant: str) -> bytes:
+                  deadline: float | None, tenant: str, *,
+                  span=None) -> bytes:
     """A dead replica's clean waiter as a wire frame."""
     tb = tenant.encode("utf-8")
     prompt = np.asarray(prompt)
@@ -245,11 +276,12 @@ def encode_rehome(rid: int, prompt, max_new_tokens: int,
            _U8.pack(0 if deadline is None else 1),
            _F64.pack(0.0 if deadline is None else float(deadline)),
            _U16.pack(len(tb)), tb,
-           _U32.pack(prompt.shape[0]), _pack_tokens(prompt)]
+           _U32.pack(prompt.shape[0]), _pack_tokens(prompt),
+           _span_tail(span)]
     return _frame(FRAME_REHOME, b"".join(out))
 
 
-def _decode_rehome(r: _Reader) -> RehomeRecord:
+def _decode_rehome(r: _Reader):
     (rid,) = r.unpack(_U64)
     (mnt,) = r.unpack(_U32)
     (has_deadline,) = r.unpack(_U8)
@@ -264,11 +296,11 @@ def _decode_rehome(r: _Reader) -> RehomeRecord:
     # np.asarray spelling reads as a device sync to the PT005 heuristic)
     prompt = np.frombuffer(r.take(8 * plen), dtype="<i8") \
         .astype(np.int32)
-    r.done()
+    span = _read_span_tail(r)
     return RehomeRecord(rid=int(rid), prompt=prompt,
                         max_new_tokens=int(mnt),
                         deadline=float(deadline) if has_deadline else None,
-                        tenant=tenant)
+                        tenant=tenant), span
 
 
 # --------------------------------------------------------------- decode
@@ -282,6 +314,14 @@ def decode_frame(buf: bytes):
     SpilledPage)``, ``("digests", frozenset)`` or ``("rehome",
     RehomeRecord)``. Total over arbitrary bytes: every failure is a
     :class:`WireError` subclass, nothing narrower ever escapes."""
+    kind, value, _ = decode_frame_span(buf)
+    return (kind, value)
+
+
+def decode_frame_span(buf: bytes):
+    """:func:`decode_frame` plus the span extension: ``(kind, value,
+    span)`` where ``span`` is the fleetscope span id the frame carried
+    (None for a plain v1 frame). Same totality guarantee."""
     if not isinstance(buf, (bytes, bytearray, memoryview)):
         raise WireCorruptError(f"frame must be bytes, "
                                f"got {type(buf).__name__}")
@@ -311,7 +351,8 @@ def decode_frame(buf: bytes):
     if decoder is None:
         raise WireCorruptError(f"unknown frame type {ftype}")
     try:
-        value = decoder(_Reader(buf[_HEADER.size:total - _TRAILER.size]))
+        value, span = decoder(
+            _Reader(buf[_HEADER.size:total - _TRAILER.size]))
     except WireError:
         raise
     except Exception as e:  # noqa: BLE001 — taxonomy totality: a frame
@@ -319,4 +360,4 @@ def decode_frame(buf: bytes):
         # codec disagreement, which IS corruption to the transport
         raise WireCorruptError(
             f"payload decode failed: {type(e).__name__}: {e}") from e
-    return (_FRAME_KINDS[ftype], value)
+    return (_FRAME_KINDS[ftype], value, span)

@@ -1,0 +1,30 @@
+"""PT017 fixture: wire ``.exchange(...)`` calls that omit the
+``rid=``/``step=`` journey-join context. The fixture is linted AS IF it
+lived at serving/pt017.py; its intentional positives are what the rule
+test pins. ``rid=None`` is the sanctioned no-request spelling (gossip),
+and a ``**kwargs`` splat is assumed to forward the caller's context."""
+
+
+def gossip(transport, peer, frames):
+    return transport.exchange(peer, frames)  # finding: no rid/step
+
+
+def fetch(transport, donor, frames, step):
+    # finding: rid missing even though step is threaded
+    return transport.exchange(donor, frames, step=step)
+
+
+def rehome(transport, peer, frames, rid):
+    # finding: step missing even though rid is threaded
+    return transport.exchange(peer, frames, rid=rid)
+
+
+def fetch_suppressed(transport, donor, frames):
+    return transport.exchange(donor, frames)  # lint: disable=PT017
+
+
+def good(transport, peer, frames, rid, step, kwargs):
+    a = transport.exchange(peer, frames, step=step, rid=rid)
+    b = transport.exchange(peer, frames, step=step, rid=None)  # gossip
+    c = transport.exchange(peer, frames, **kwargs)  # splat forwards it
+    return a, b, c

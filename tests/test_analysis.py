@@ -385,6 +385,9 @@ _FIXTURE_CASES = {
     "pt016_wallclock.py": ("serving/pt016.py",
                            {13: "PT016", 18: "PT016", 22: "PT016",
                             23: "PT016", 29: "PT016"}),
+    "pt017_contextless_exchange.py": ("serving/pt017.py",
+                                      {9: "PT017", 14: "PT017",
+                                       19: "PT017"}),
 }
 
 
@@ -404,7 +407,8 @@ def test_lint_rule_fixture(fixture):
 
 def test_lint_rule_table_is_complete():
     assert sorted(RULES) == [f"PT00{i}" for i in range(1, 10)] + [
-        "PT010", "PT011", "PT012", "PT013", "PT014", "PT015", "PT016"]
+        "PT010", "PT011", "PT012", "PT013", "PT014", "PT015", "PT016",
+        "PT017"]
     for code, rule in RULES.items():
         assert rule.doc and rule.code == code
 
@@ -678,6 +682,24 @@ def test_self_lint_pt016_determinism_fence():
         eng, "paddle_tpu/serving/engine.py"))
     findings = lint_source(eng, "paddle_tpu/serving/scheduler.py")
     assert any(f.rule == "PT016" and "monotonic" in f.message
+               for f in findings)
+
+
+def test_self_lint_pt017_contextless_exchange():
+    """PT017 strip-reintroduction: fleet.py's gossip exchange carries an
+    EXPLICIT ``rid=None`` — that spelling is the sanctioning. Stripping
+    it (the natural refactor slip: "gossip has no request, drop the
+    keyword") reintroduces the finding on the very call the rule was
+    written for."""
+    fleet = (REPO / "paddle_tpu" / "serving" / "fleet.py").read_text()
+    assert "step=self._step_idx, rid=None, span=sid" in fleet, \
+        "fleet.py's gossip exchange no longer spells rid=None this way?"
+    assert not any(f.rule == "PT017" for f in lint_source(
+        fleet, "paddle_tpu/serving/fleet.py"))
+    stripped = fleet.replace("step=self._step_idx, rid=None, span=sid",
+                             "step=self._step_idx, span=sid")
+    findings = lint_source(stripped, "paddle_tpu/serving/fleet.py")
+    assert any(f.rule == "PT017" and "rid" in f.message
                for f in findings)
 
 

@@ -373,6 +373,9 @@ def test_chunk_fail_retires_mid_prefill_and_survivors_keep_serving():
         "a mid-prefill failure must not leak the partial prompt's pages"
 
 
+@pytest.mark.slow  # re-tiered 2026-08 (PR 20): tier-1 crossed its 870 s
+# budget; the budget-drain preemption test keeps the victim-resume path
+# hot in tier-1
 def test_pool_exhausted_injection_forces_preemption():
     # the pool is actually ample — the injector simulates it running dry,
     # and the victim-policy preemption must still converge to full parity

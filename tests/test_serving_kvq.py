@@ -295,7 +295,11 @@ def _gather_pages(cache, pages):
 # maps) and the costlier [int8] variant pins the same roundtrip plus the
 # scale leaves; fp32-unchanged is pinned separately
 @pytest.mark.parametrize("kv_dtype", [
-    pytest.param("float32", marks=pytest.mark.slow), "int8"])
+    pytest.param("float32", marks=pytest.mark.slow),
+    # re-tiered 2026-08 (PR 20): tier-1 crossed its 870 s budget; the
+    # full roundtrip now lives in the slow tier (int8_prefix_hit_parity
+    # and restore_fail keep the int8 spill path hot in tier-1)
+    pytest.param("int8", marks=pytest.mark.slow)])
 def test_evict_spill_hit_restore_roundtrip_bit_exact(model, kv_dtype):
     """The tentpole round trip: a warm prefix's pages are captured, the
     pool is thrashed (eviction -> spill), and a re-admission restores the

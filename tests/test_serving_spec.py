@@ -65,7 +65,11 @@ def _engine(model, spec, **kw):
 
 
 # ------------------------------------------------------------------ parity
-@pytest.mark.parametrize("method", ["ngram", "draft"])
+@pytest.mark.parametrize("method", [
+    "ngram",
+    # re-tiered 2026-08 (PR 20): tier-1 crossed its 870 s budget; the
+    # ngram variant keeps the verify-program pin hot in tier-1
+    pytest.param("draft", marks=pytest.mark.slow)])
 def test_greedy_parity_and_one_verify_program_per_depth(method):
     """The acceptance pin: greedy outputs bit-identical speculation on vs
     off for K in {1, 2, 4} and both proposer methods, with exactly ONE

@@ -12,6 +12,14 @@ report. Exit 0 when every invariant held on every seed, 1 on the first
 :class:`ChaosInvariantError` (its message names seed, step, and the
 violated invariant), 2 on bad usage.
 
+Every soak is armed with a cluster flight-recorder path
+(``--fleet-record-dir``, default the working directory): an invariant
+failure auto-dumps ``chaos_fleet_record_seed{N}.json`` — a
+``paddle-tpu/fleet-record/v1`` bundle of per-replica flight records,
+router state, the span-tree exchange ring, and merged alerts — which
+this CLI re-validates and names in the FAIL line, so the post-mortem
+is one file, already schema-checked.
+
 The repo root is forced onto sys.path FIRST so this drives the
 checkout's paddle_tpu, never an installed copy (the tools/lint.py
 idiom).
@@ -36,12 +44,18 @@ def main(argv=None) -> int:
                     help="fleet size per soak (default 2)")
     ap.add_argument("--requests", type=int, default=10,
                     help="requests submitted per soak (default 10)")
+    ap.add_argument("--fleet-record-dir", default=".",
+                    help="directory the auto-dumped fleet record lands "
+                         "in on an invariant failure (default '.')")
     args = ap.parse_args(argv)
     if args.seeds < 1:
         ap.error(f"--seeds {args.seeds} < 1")
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import json
+
     import paddle_tpu as paddle
+    from paddle_tpu.obs.fleetscope import validate_fleet_record
     from paddle_tpu.serving.chaos import (ChaosConfig,
                                           ChaosInvariantError,
                                           format_report, soak)
@@ -53,12 +67,22 @@ def main(argv=None) -> int:
         max_seq_len=48, dropout=0.0))
     model.eval()
     for seed in range(args.seeds):
+        record_path = os.path.join(
+            args.fleet_record_dir, f"chaos_fleet_record_seed{seed}.json")
         try:
             rep = soak(model, ChaosConfig(seed=seed,
                                           num_replicas=args.replicas,
-                                          requests=args.requests))
+                                          requests=args.requests,
+                                          fleet_record_path=record_path))
         except ChaosInvariantError as e:
-            print(f"chaos soak FAIL: {e}", file=sys.stderr)
+            # the soak already dumped the recorder; re-validate it so a
+            # broken dump is its own loud failure, then name the path
+            with open(record_path) as f:
+                validate_fleet_record(json.load(f))
+            print(f"chaos soak FAIL: {e}\n"
+                  f"  fleet record dumped to {record_path} "
+                  f"(validated paddle-tpu/fleet-record/v1)",
+                  file=sys.stderr)
             return 1
         print(format_report(rep))
     print(f"chaos soak PASS: {args.seeds} seed(s) x {args.replicas} "

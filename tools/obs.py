@@ -9,6 +9,9 @@ Usage:
     python tools/obs.py --flight-record dump.json --tenant-table
     python tools/obs.py --flight-record dump.json --journey RID
     python tools/obs.py --prometheus          # live registry of THIS proc
+    python tools/obs.py --fleet-record dump.json        # cluster view
+    python tools/obs.py --fleet-record dump.json --span RID
+    python tools/obs.py --fleet-record dump.json --prometheus
 
 Exit codes: 0 clean, 1 the dump records alerts or a fatal/failure
 reason, 2 bad usage / unreadable dump — the analysis CLI convention. The
